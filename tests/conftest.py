@@ -1,0 +1,17 @@
+"""Test harness config: run everything on a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; per the framework's test
+strategy (SURVEY.md §4: local multi-process/virtual-device backend + chaos env
+hooks, mirroring the reference's MiniCluster in tony-mini), all sharding and
+collective paths are exercised on ``--xla_force_host_platform_device_count=8``
+CPU devices. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("TONY_TEST_MODE", "1")

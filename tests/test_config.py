@@ -1,0 +1,139 @@
+"""Config-layer tests.
+
+Mirrors the reference's config hygiene + parsing coverage:
+- TestTonyConfigurationFields.java:15-63 (keys ⇄ defaults bijection)
+- Utils.parseContainerRequests / parseMemoryString unit coverage (TestUtils.java)
+"""
+
+import os
+
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import (TonyConfig, parse_cli_confs,
+                                  parse_memory_string, read_conf_file)
+
+
+def test_keys_defaults_bijection():
+    """Every static *_KEY constant has a default and vice versa (the
+    TestTonyConfigurationFields analog)."""
+    declared = {
+        getattr(K, name)
+        for name in dir(K)
+        if name.endswith("_KEY") and isinstance(getattr(K, name), str)
+    }
+    assert declared == set(K.DEFAULTS), (
+        "keys.py *_KEY constants and DEFAULTS registry out of sync: "
+        f"missing defaults={declared - set(K.DEFAULTS)}, "
+        f"orphan defaults={set(K.DEFAULTS) - declared}")
+
+
+def test_parse_memory_string():
+    assert parse_memory_string("2g") == 2048
+    assert parse_memory_string("2048m") == 2048
+    assert parse_memory_string("2048") == 2048
+    assert parse_memory_string("1t") == 1024 * 1024
+    assert parse_memory_string("512M") == 512
+    with pytest.raises(ValueError):
+        parse_memory_string("lots")
+
+
+def test_job_type_discovery():
+    conf = TonyConfig({
+        "tony.worker.instances": "4",
+        "tony.ps.instances": "1",
+        "tony.evaluator.instances": "1",
+        "tony.application.name": "x",       # must not be treated as a job type
+        "tony.task.instances": "9",         # reserved word, not a job type
+    })
+    assert conf.job_types() == ["evaluator", "ps", "worker"]
+
+
+def test_task_requests_resources_and_priorities():
+    conf = TonyConfig({
+        "tony.worker.instances": "2",
+        "tony.worker.memory": "4g",
+        "tony.worker.vcores": "2",
+        "tony.worker.tpus": "4",
+        "tony.worker.tpu.topology": "2x2",
+        "tony.worker.env": "A=1,B=2",
+        "tony.ps.instances": "1",
+    })
+    reqs = conf.task_requests()
+    assert set(reqs) == {"worker", "ps"}
+    w = reqs["worker"]
+    assert (w.instances, w.memory_mb, w.vcores, w.tpus, w.tpu_topology) == \
+        (2, 4096, 2, 4, "2x2")
+    assert w.env == {"A": "1", "B": "2"}
+    assert reqs["ps"].memory_mb == 2048  # per-type default
+    # unique priority per job type (Utils.java:330-336)
+    assert reqs["worker"].priority != reqs["ps"].priority
+
+
+def test_zero_instance_job_types_skipped():
+    conf = TonyConfig({"tony.worker.instances": "0"})
+    assert conf.task_requests() == {}
+
+
+def test_untracked_job_types_default_ps():
+    conf = TonyConfig()
+    assert not conf.is_job_type_tracked("ps")
+    assert conf.is_job_type_tracked("worker")
+    conf.set(K.APPLICATION_UNTRACKED_KEY, "ps,evaluator")
+    assert not conf.is_job_type_tracked("evaluator")
+
+
+def test_layering_precedence(tmp_path):
+    """defaults → conf file → CLI overrides → site (TonyClient.java:364-380)."""
+    job = tmp_path / "tony.xml"
+    job.write_text(
+        "<configuration>"
+        "<property><name>tony.application.name</name><value>from-job</value></property>"
+        "<property><name>tony.worker.instances</name><value>2</value></property>"
+        "<property><name>tony.am.retry-count</name><value>1</value></property>"
+        "</configuration>")
+    site_dir = tmp_path / "confdir"
+    site_dir.mkdir()
+    (site_dir / "tony-site.xml").write_text(
+        "<configuration>"
+        "<property><name>tony.am.retry-count</name><value>7</value></property>"
+        "</configuration>")
+    conf = TonyConfig.load(str(job),
+                           cli_overrides={"tony.application.name": "from-cli"},
+                           conf_dir=str(site_dir))
+    assert conf.get("tony.application.name") == "from-cli"      # CLI beats job file
+    assert conf.get_int("tony.am.retry-count") == 7             # site wins last
+    assert conf.get_int("tony.worker.instances") == 2           # job file kept
+    assert conf.get(K.APPLICATION_FRAMEWORK_KEY) == "jax"       # default kept
+
+
+def test_xml_roundtrip_and_kv_files(tmp_path):
+    conf = TonyConfig({"tony.worker.instances": "3", "tony.application.mesh": "dp=2,tp=4"})
+    out = tmp_path / "tony-final.xml"
+    conf.write_xml(str(out))
+    back = TonyConfig(read_conf_file(str(out)), load_defaults=False)
+    assert back.as_dict() == conf.as_dict()
+
+    kv = tmp_path / "job.conf"
+    kv.write_text("# comment\ntony.worker.instances = 5\n\ntony.ps.instances=1\n")
+    d = read_conf_file(str(kv))
+    assert d == {"tony.worker.instances": "5", "tony.ps.instances": "1"}
+
+
+def test_mesh_axes_and_cli_confs():
+    conf = TonyConfig({"tony.application.mesh": "dp=2, tp=2, sp=2"})
+    assert conf.mesh_axes() == {"dp": 2, "tp": 2, "sp": 2}
+    assert parse_cli_confs(["a=1", "b=x=y"]) == {"a": "1", "b": "x=y"}
+    with pytest.raises(ValueError):
+        parse_cli_confs(["nope"])
+
+
+def test_site_via_env(tmp_path, monkeypatch):
+    site_dir = tmp_path / "cd"
+    site_dir.mkdir()
+    (site_dir / "tony-site.xml").write_text(
+        "<configuration><property><name>tony.scheduler.backend</name>"
+        "<value>tpu</value></property></configuration>")
+    monkeypatch.setenv("TONY_CONF_DIR", str(site_dir))
+    conf = TonyConfig.load(None)
+    assert conf.get(K.SCHEDULER_BACKEND_KEY) == "tpu"

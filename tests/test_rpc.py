@@ -1,0 +1,137 @@
+"""Control-plane RPC tests: in-process server + retrying client.
+
+Covers the seven-method protocol end to end over real gRPC, plus the client's
+retry-until-coordinator-up behavior (the reference relies on Hadoop RetryProxy
+for the same race, ApplicationRpcClient.java:80-104)."""
+
+import threading
+import time
+
+import pytest
+
+from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
+from tony_tpu.rpc.server import ApplicationRpcServer, find_free_port
+from tony_tpu.rpc.service import ApplicationRpc, TaskUrl, WorkerSpecResponse
+
+
+class FakeImpl(ApplicationRpc):
+    """Scriptable ApplicationRpc with a 2-task gang barrier."""
+
+    def __init__(self, expected=2):
+        self.expected = expected
+        self.registered = {}
+        self.heartbeats = []
+        self.results = []
+        self.tb_url = None
+        self.finished = False
+        self.lock = threading.Lock()
+
+    def get_task_urls(self):
+        return [TaskUrl("worker", "0", "http://w0/logs")]
+
+    def get_cluster_spec(self, task_id):
+        with self.lock:
+            if len(self.registered) < self.expected:
+                return ""
+            return '{"worker": ["h0:1", "h1:1"]}'
+
+    def register_worker_spec(self, worker, spec):
+        with self.lock:
+            self.registered[worker] = spec
+            if len(self.registered) < self.expected:
+                return WorkerSpecResponse()
+            return WorkerSpecResponse(
+                spec='{"worker": ["h0:1", "h1:1"]}',
+                coordinator_address="h0:9999",
+                process_id=sorted(self.registered).index(worker),
+                num_processes=self.expected, mesh_spec='{"axes": {"dp": 2}}')
+
+    def register_tensorboard_url(self, spec):
+        self.tb_url = spec
+        return spec
+
+    def register_execution_result(self, exit_code, job_name, job_index, session_id):
+        self.results.append((exit_code, job_name, job_index, session_id))
+        return "RECEIVED"
+
+    def finish_application(self):
+        self.finished = True
+        return "SUCCEEDED"
+
+    def task_executor_heartbeat(self, task_id):
+        self.heartbeats.append(task_id)
+
+
+@pytest.fixture
+def server():
+    impl = FakeImpl()
+    srv = ApplicationRpcServer(impl)
+    srv.start()
+    yield impl, srv
+    srv.stop(0)
+
+
+def test_all_seven_methods(server):
+    impl, srv = server
+    client = ApplicationRpcClient(f"localhost:{srv.port}")
+
+    # gang barrier: first registration held back
+    r0 = client.register_worker_spec("worker:0", "h0:1")
+    assert not r0.released
+    assert client.get_cluster_spec("worker:0") == ""
+    r1 = client.register_worker_spec("worker:1", "h1:1")
+    assert r1.released and r1.num_processes == 2
+    assert r1.coordinator_address == "h0:9999"
+    # re-register after release returns the full spec + stable ids
+    r0b = client.register_worker_spec("worker:0", "h0:1")
+    assert r0b.released and r0b.process_id == 0
+    assert "worker" in client.get_cluster_spec("worker:0")
+
+    urls = client.get_task_urls()
+    assert urls == [TaskUrl("worker", "0", "http://w0/logs")]
+    assert client.register_tensorboard_url("http://tb") == "http://tb"
+    assert client.register_execution_result(0, "worker", "0", "0") == "RECEIVED"
+    client.task_executor_heartbeat("worker:0")
+    client.task_executor_heartbeat("worker:1")
+    assert impl.heartbeats == ["worker:0", "worker:1"]
+    assert client.finish_application() == "SUCCEEDED"
+    assert impl.finished
+    client.close()
+
+
+def test_client_retries_until_server_up():
+    port = find_free_port((20000, 30000))
+    client = ApplicationRpcClient(f"localhost:{port}", max_retries=50,
+                                  base_backoff_s=0.05)
+    impl = FakeImpl(expected=1)
+
+    def start_late():
+        time.sleep(0.5)
+        srv = ApplicationRpcServer(impl, port=port)
+        srv.start()
+        start_late.srv = srv
+
+    t = threading.Thread(target=start_late)
+    t.start()
+    resp = client.register_worker_spec("worker:0", "h:1")  # issued before server exists
+    t.join()
+    assert resp.released
+    start_late.srv.stop(0)
+    client.close()
+
+
+def test_client_retry_budget_exhausted():
+    port = find_free_port((20000, 30000))
+    client = ApplicationRpcClient(f"localhost:{port}", max_retries=3,
+                                  base_backoff_s=0.01)
+    with pytest.raises(RpcRetryError):
+        client.get_task_urls()
+    client.close()
+
+
+def test_singleton_per_address(server):
+    _, srv = server
+    a = ApplicationRpcClient.get_instance(f"localhost:{srv.port}")
+    b = ApplicationRpcClient.get_instance(f"localhost:{srv.port}")
+    assert a is b
+    a.close()

@@ -1,0 +1,8 @@
+"""tony_tpu — a TPU-native distributed-training orchestration framework.
+
+Brand-new rebuild of the capability set of LinkedIn TonY (reference mounted at
+/root/reference) for Cloud TPU pod slices and the JAX/XLA runtime. See
+SURVEY.md for the blueprint.
+"""
+
+__version__ = "0.1.0"

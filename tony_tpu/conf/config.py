@@ -1,0 +1,259 @@
+"""Layered configuration: defaults → job file → CLI overrides → site file.
+
+TPU-native rebuild of the reference's Hadoop-Configuration-based config stack
+(reference: TonyClient.initTonyConf, tony-core/.../TonyClient.java:364-380 and
+tony-default.xml). We keep the exact layering contract and the Hadoop
+``<configuration><property>`` XML on-disk format so a TonY user's ``tony.xml``
+files work unchanged, without depending on Hadoop: stdlib ElementTree parses
+and writes it. ``key=value`` files and CLI ``--conf k=v`` overrides are also
+accepted.
+
+The frozen result is written as ``tony-final.xml`` and shipped to every
+process (reference: TonyClient.java:186-192, TaskExecutor.init:167).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping
+
+from tony_tpu.conf import keys as K
+
+_MEMORY_RE = re.compile(r"^\s*(\d+(?:\.\d+)?)\s*([kKmMgGtT]?)[bB]?\s*$")
+_MEM_MULT = {"": 1, "k": 1.0 / 1024, "m": 1, "g": 1024, "t": 1024 * 1024}
+
+
+def parse_memory_string(value: str) -> int:
+    """Parse '2g' / '2048m' / '2048' → MiB (reference: Utils.parseMemoryString,
+    util/Utils.java:131-143)."""
+    m = _MEMORY_RE.match(str(value))
+    if not m:
+        raise ValueError(f"cannot parse memory string: {value!r}")
+    amount = float(m.group(1))
+    mib = int(amount * _MEM_MULT[m.group(2).lower()])
+    # Sub-MiB asks ("512k") round up to 1 MiB rather than truncating to zero.
+    return 1 if mib == 0 and amount > 0 else mib
+
+
+@dataclass
+class TaskRequest:
+    """Per-job-type resource ask. Analog of TensorFlowContainerRequest
+    (reference: tony-core/.../tensorflow/TensorFlowContainerRequest.java:16-56),
+    extended with the north-star TPU resource dimensions."""
+    job_type: str
+    instances: int
+    memory_mb: int = 2048
+    vcores: int = 1
+    gpus: int = 0
+    tpus: int = 0                 # TPU chips per task (tony.{job}.tpus)
+    tpu_topology: str = ""        # pod-slice topology, e.g. "2x4" (tony.{job}.tpu.topology)
+    resources: str = ""           # extra localized resources (comma-sep paths)
+    env: dict[str, str] = field(default_factory=dict)
+    priority: int = 0             # unique per job type (Utils.java:330-336, YARN-7631)
+
+
+class TonyConfig:
+    """A flat ``str → str`` configuration with typed getters.
+
+    Same data model as Hadoop ``Configuration`` (all values are strings), so
+    behavior matches the reference everywhere it passes config across process
+    boundaries via tony-final.xml.
+    """
+
+    def __init__(self, values: Mapping[str, str] | None = None,
+                 load_defaults: bool = True) -> None:
+        self._values: dict[str, str] = {}
+        if load_defaults:
+            self._values.update(K.DEFAULTS)
+        if values:
+            self._values.update({str(k): str(v) for k, v in values.items()})
+
+    # -- mapping surface ----------------------------------------------------
+    def __contains__(self, key: str) -> bool:
+        return key in self._values
+
+    def __iter__(self):
+        return iter(self._values)
+
+    def items(self):
+        return self._values.items()
+
+    def as_dict(self) -> dict[str, str]:
+        return dict(self._values)
+
+    def set(self, key: str, value: object) -> None:
+        self._values[str(key)] = str(value)
+
+    def update(self, other: Mapping[str, str]) -> None:
+        for k, v in other.items():
+            self.set(k, v)
+
+    # -- typed getters ------------------------------------------------------
+    def get(self, key: str, default: str | None = None) -> str | None:
+        return self._values.get(key, default)
+
+    def get_int(self, key: str, default: int = 0) -> int:
+        v = self._values.get(key)
+        return int(v) if v not in (None, "") else default
+
+    def get_float(self, key: str, default: float = 0.0) -> float:
+        v = self._values.get(key)
+        return float(v) if v not in (None, "") else default
+
+    def get_bool(self, key: str, default: bool = False) -> bool:
+        v = self._values.get(key)
+        if v in (None, ""):
+            return default
+        return str(v).strip().lower() in ("true", "1", "yes")
+
+    def get_memory_mb(self, key: str, default: int = 0) -> int:
+        v = self._values.get(key)
+        return parse_memory_string(v) if v not in (None, "") else default
+
+    def get_list(self, key: str, default: Iterable[str] = ()) -> list[str]:
+        v = self._values.get(key)
+        if v in (None, ""):
+            return list(default)
+        return [s.strip() for s in str(v).split(",") if s.strip()]
+
+    # -- layered loading ----------------------------------------------------
+    @classmethod
+    def load(cls, conf_file: str | None = None,
+             cli_overrides: Mapping[str, str] | None = None,
+             conf_dir: str | None = None) -> "TonyConfig":
+        """defaults → conf_file (tony.xml) → CLI overrides → site file.
+
+        Exactly the reference's precedence (TonyClient.initTonyConf:364-380):
+        the site file (``$TONY_CONF_DIR/tony-site.xml``) wins last so cluster
+        operators can pin values.
+        """
+        conf = cls()
+        if conf_file is None and os.path.exists("tony.xml"):
+            conf_file = "tony.xml"
+        if conf_file:
+            conf.update(read_conf_file(conf_file))
+        if cli_overrides:
+            conf.update(cli_overrides)
+        conf_dir = conf_dir or os.environ.get("TONY_CONF_DIR")
+        if conf_dir:
+            site = os.path.join(conf_dir, "tony-site.xml")
+            if os.path.exists(site):
+                conf.update(read_conf_file(site))
+        return conf
+
+    @classmethod
+    def from_file(cls, path: str) -> "TonyConfig":
+        conf = cls()
+        conf.update(read_conf_file(path))
+        return conf
+
+    def write_xml(self, path: str) -> None:
+        """Write Hadoop-style configuration XML (the tony-final.xml freeze)."""
+        root = ET.Element("configuration")
+        for k in sorted(self._values):
+            prop = ET.SubElement(root, "property")
+            ET.SubElement(prop, "name").text = k
+            ET.SubElement(prop, "value").text = self._values[k]
+        tree = ET.ElementTree(root)
+        ET.indent(tree)
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        tree.write(path, encoding="unicode", xml_declaration=True)
+
+    # -- job-type / task-request assembly -----------------------------------
+    def job_types(self) -> list[str]:
+        return K.discover_job_types(self._values)
+
+    def task_requests(self) -> dict[str, TaskRequest]:
+        """Build per-job-type resource asks from config.
+
+        Mirror of Utils.parseContainerRequests (reference: util/Utils.java:
+        314-340): regex-discovered job types, per-type resource keys, a unique
+        priority per type so allocations can be matched back.
+        """
+        requests: dict[str, TaskRequest] = {}
+        for priority, jt in enumerate(self.job_types()):
+            instances = self.get_int(K.instances_key(jt), 0)
+            if instances <= 0:
+                continue
+            env = {}
+            for pair in self.get_list(K.env_key(jt)):
+                if "=" in pair:
+                    k, _, v = pair.partition("=")
+                    env[k] = v
+            requests[jt] = TaskRequest(
+                job_type=jt,
+                instances=instances,
+                memory_mb=self.get_memory_mb(
+                    K.memory_key(jt), parse_memory_string(K.JOB_TYPE_DEFAULTS["memory"])),
+                vcores=self.get_int(K.vcores_key(jt), int(K.JOB_TYPE_DEFAULTS["vcores"])),
+                gpus=self.get_int(K.gpus_key(jt), 0),
+                tpus=self.get_int(K.tpus_key(jt), 0),
+                tpu_topology=self.get(K.tpu_topology_key(jt), "") or "",
+                resources=self.get(K.resources_key(jt), "") or "",
+                env=env,
+                priority=priority,
+            )
+        return requests
+
+    def untracked_job_types(self) -> set[str]:
+        """Job types excluded from completion counting (reference:
+        Utils.isJobTypeTracked, util/Utils.java:475; default 'ps')."""
+        return set(self.get_list(K.APPLICATION_UNTRACKED_KEY))
+
+    def is_job_type_tracked(self, job_type: str) -> bool:
+        return job_type not in self.untracked_job_types()
+
+    def mesh_axes(self) -> dict[str, int]:
+        """Parse tony.application.mesh: 'dp=2,tp=4' → {'dp': 2, 'tp': 4}."""
+        axes: dict[str, int] = {}
+        for part in self.get_list(K.APPLICATION_MESH_KEY):
+            name, _, size = part.partition("=")
+            if name and size:
+                axes[name.strip()] = int(size)
+        return axes
+
+
+def read_conf_file(path: str) -> dict[str, str]:
+    """Read a config file: Hadoop-style XML or flat ``key=value`` lines."""
+    if path.endswith(".xml"):
+        return _read_xml(path)
+    return _read_kv(path)
+
+
+def _read_xml(path: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    root = ET.parse(path).getroot()
+    for prop in root.iter("property"):
+        name = prop.findtext("name")
+        value = prop.findtext("value")
+        if name is not None:
+            out[name.strip()] = (value or "").strip()
+    return out
+
+
+def _read_kv(path: str) -> dict[str, str]:
+    out: dict[str, str] = {}
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            k, sep, v = line.partition("=")
+            if sep:
+                out[k.strip()] = v.strip()
+    return out
+
+
+def parse_cli_confs(pairs: Iterable[str]) -> dict[str, str]:
+    """Parse repeated ``--conf k=v`` flags (reference: Utils.parseKeyValue,
+    util/Utils.java:207)."""
+    out: dict[str, str] = {}
+    for pair in pairs:
+        k, sep, v = pair.partition("=")
+        if not sep:
+            raise ValueError(f"--conf expects key=value, got {pair!r}")
+        out[k.strip()] = v.strip()
+    return out

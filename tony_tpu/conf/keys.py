@@ -1,0 +1,214 @@
+"""The ``tony.*`` configuration key namespace and its defaults.
+
+TPU-native analog of the reference's ``TonyConfigurationKeys.java`` (reference:
+tony-core/src/main/java/com/linkedin/tony/TonyConfigurationKeys.java:1-206) and
+``tony-default.xml`` (tony-core/src/main/resources/tony-default.xml). The two
+are kept in lock-step here by construction — every ``*_KEY`` constant must have
+an entry in ``DEFAULTS`` (or be a documented dynamic-key builder), enforced by
+``tests/test_config.py::test_keys_defaults_bijection`` (mirror of the
+reference's ``TestTonyConfigurationFields.java:15-63``).
+
+Additions over the reference (the "north star" of BASELINE.json): TPU topology
+is a first-class per-job-type resource (``tony.{job}.tpus``,
+``tony.{job}.tpu.topology``) and mesh-axis layout is declarative config
+(``tony.application.mesh``).
+"""
+
+from __future__ import annotations
+
+import re
+
+TONY_PREFIX = "tony."
+
+# ---------------------------------------------------------------------------
+# Application-level keys (TonyConfigurationKeys.java "tony.application.*")
+# ---------------------------------------------------------------------------
+APPLICATION_NAME_KEY = "tony.application.name"
+APPLICATION_FRAMEWORK_KEY = "tony.application.framework"          # jax|tensorflow|pytorch
+APPLICATION_SINGLE_NODE_KEY = "tony.application.single-node"
+APPLICATION_TIMEOUT_KEY = "tony.application.timeout"              # ms; 0 = none
+APPLICATION_NODE_LABEL_KEY = "tony.application.node-label"
+APPLICATION_PREPROCESS_KEY = "tony.application.enable-preprocess"
+APPLICATION_SECURITY_KEY = "tony.application.security.enabled"
+APPLICATION_MESH_KEY = "tony.application.mesh"                    # e.g. "dp=2,tp=4" (TPU-native)
+APPLICATION_UNTRACKED_KEY = "tony.application.untracked.jobtypes" # e.g. "ps"
+
+# ---------------------------------------------------------------------------
+# Coordinator keys ("tony.am.*" in the reference; name kept for compat)
+# ---------------------------------------------------------------------------
+AM_RETRY_COUNT_KEY = "tony.am.retry-count"
+AM_MEMORY_KEY = "tony.am.memory"
+AM_VCORES_KEY = "tony.am.vcores"
+AM_GPUS_KEY = "tony.am.gpus"
+
+# ---------------------------------------------------------------------------
+# Task keys ("tony.task.*")
+# ---------------------------------------------------------------------------
+TASK_EXECUTOR_PYTHON_OPTS_KEY = "tony.task.executor.python-opts"  # jvm-opts analog
+TASK_HEARTBEAT_INTERVAL_KEY = "tony.task.heartbeat-interval-ms"
+TASK_MAX_MISSED_HEARTBEATS_KEY = "tony.task.max-missed-heartbeats"
+TASK_REGISTRATION_TIMEOUT_KEY = "tony.task.registration-timeout-ms"
+TASK_EXECUTION_TIMEOUT_KEY = "tony.task.execution-timeout-ms"
+
+# ---------------------------------------------------------------------------
+# Chief designation (TonyConfigurationKeys: chief name/index)
+# ---------------------------------------------------------------------------
+CHIEF_REGEX_KEY = "tony.application.chief.name"
+CHIEF_INDEX_KEY = "tony.application.chief.index"
+
+# ---------------------------------------------------------------------------
+# History / events ("tony.history.*")
+# ---------------------------------------------------------------------------
+HISTORY_LOCATION_KEY = "tony.history.location"
+HISTORY_INTERMEDIATE_KEY = "tony.history.intermediate"
+HISTORY_FINISHED_KEY = "tony.history.finished"
+HISTORY_RETENTION_SECONDS_KEY = "tony.history.retention-seconds"
+HISTORY_SERVER_PORT_KEY = "tony.history.server.port"
+
+# ---------------------------------------------------------------------------
+# Backend / scheduler ("tony.scheduler.*" — new layer; the reference hardwires
+# YARN, we make the slice provider pluggable: local | tpu)
+# ---------------------------------------------------------------------------
+SCHEDULER_BACKEND_KEY = "tony.scheduler.backend"
+TPU_PROJECT_KEY = "tony.tpu.project"
+TPU_ZONE_KEY = "tony.tpu.zone"
+TPU_ACCELERATOR_TYPE_KEY = "tony.tpu.accelerator-type"
+TPU_RUNTIME_VERSION_KEY = "tony.tpu.runtime-version"
+TPU_PREEMPTIBLE_KEY = "tony.tpu.preemptible"
+TPU_PROVISION_TIMEOUT_KEY = "tony.tpu.provision-timeout-ms"
+
+# ---------------------------------------------------------------------------
+# Staging / storage ("tony.staging.*"; HDFS-dir analog)
+# ---------------------------------------------------------------------------
+STAGING_DIR_KEY = "tony.staging.dir"
+SRC_DIR_KEY = "tony.application.src-dir"
+PYTHON_VENV_KEY = "tony.application.python-venv"
+PYTHON_BINARY_PATH_KEY = "tony.application.python-binary-path"
+CONTAINER_LOG_DIR_KEY = "tony.container.log-dir"
+
+# ---------------------------------------------------------------------------
+# Docker passthrough (TonyClient.java:340-349)
+# ---------------------------------------------------------------------------
+DOCKER_ENABLED_KEY = "tony.docker.enabled"
+DOCKER_IMAGE_KEY = "tony.docker.image"
+
+# ---------------------------------------------------------------------------
+# Defaults registry — the tony-default.xml analog. One entry per static key.
+# Values are strings, exactly like Hadoop Configuration; typed getters on
+# TonyConfig parse them.
+# ---------------------------------------------------------------------------
+DEFAULTS: dict[str, str] = {
+    APPLICATION_NAME_KEY: "tony-tpu-application",
+    APPLICATION_FRAMEWORK_KEY: "jax",
+    APPLICATION_SINGLE_NODE_KEY: "false",
+    APPLICATION_TIMEOUT_KEY: "0",
+    APPLICATION_NODE_LABEL_KEY: "",
+    APPLICATION_PREPROCESS_KEY: "false",
+    APPLICATION_SECURITY_KEY: "false",
+    APPLICATION_MESH_KEY: "",
+    APPLICATION_UNTRACKED_KEY: "ps",
+    AM_RETRY_COUNT_KEY: "0",
+    AM_MEMORY_KEY: "2g",
+    AM_VCORES_KEY: "1",
+    AM_GPUS_KEY: "0",
+    TASK_EXECUTOR_PYTHON_OPTS_KEY: "",
+    TASK_HEARTBEAT_INTERVAL_KEY: "1000",
+    TASK_MAX_MISSED_HEARTBEATS_KEY: "25",
+    TASK_REGISTRATION_TIMEOUT_KEY: "300000",
+    TASK_EXECUTION_TIMEOUT_KEY: "0",
+    CHIEF_REGEX_KEY: "^(chief|master)$",
+    CHIEF_INDEX_KEY: "0",
+    HISTORY_LOCATION_KEY: "",
+    HISTORY_INTERMEDIATE_KEY: "",
+    HISTORY_FINISHED_KEY: "",
+    HISTORY_RETENTION_SECONDS_KEY: "2592000",
+    HISTORY_SERVER_PORT_KEY: "19886",
+    SCHEDULER_BACKEND_KEY: "local",
+    TPU_PROJECT_KEY: "",
+    TPU_ZONE_KEY: "",
+    TPU_ACCELERATOR_TYPE_KEY: "",
+    TPU_RUNTIME_VERSION_KEY: "tpu-ubuntu2204-base",
+    TPU_PREEMPTIBLE_KEY: "false",
+    TPU_PROVISION_TIMEOUT_KEY: "600000",
+    STAGING_DIR_KEY: "",
+    SRC_DIR_KEY: "src",
+    PYTHON_VENV_KEY: "",
+    PYTHON_BINARY_PATH_KEY: "",
+    CONTAINER_LOG_DIR_KEY: "",
+    DOCKER_ENABLED_KEY: "false",
+    DOCKER_IMAGE_KEY: "",
+}
+
+# ---------------------------------------------------------------------------
+# Per-job-type dynamic keys. Job types are DISCOVERED from config by regex,
+# exactly like the reference (TonyConfigurationKeys.java:136 regex
+# ``tony\.([a-z]+)\.instances``; Utils.parseContainerRequests:314-340). Any
+# ``tony.<type>.instances`` in config creates a task group — no code change.
+# ---------------------------------------------------------------------------
+INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
+
+# Keys that never denote a job type even though they match the shape.
+NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
+                                "scheduler", "staging", "docker", "container"})
+
+
+def instances_key(job_type: str) -> str:
+    return f"tony.{job_type}.instances"
+
+
+def memory_key(job_type: str) -> str:
+    return f"tony.{job_type}.memory"
+
+
+def vcores_key(job_type: str) -> str:
+    return f"tony.{job_type}.vcores"
+
+
+def gpus_key(job_type: str) -> str:
+    return f"tony.{job_type}.gpus"
+
+
+def tpus_key(job_type: str) -> str:
+    """North-star addition: TPU chips per task of this job type."""
+    return f"tony.{job_type}.tpus"
+
+
+def tpu_topology_key(job_type: str) -> str:
+    """North-star addition: pod-slice topology for this job type, e.g. '4x4'."""
+    return f"tony.{job_type}.tpu.topology"
+
+
+def resources_key(job_type: str) -> str:
+    return f"tony.{job_type}.resources"
+
+
+def env_key(job_type: str) -> str:
+    return f"tony.{job_type}.env"
+
+
+# Per-job-type defaults applied when the dynamic key is absent
+# (tony-default.xml ships worker/ps defaults; we do the same via this table).
+JOB_TYPE_DEFAULTS: dict[str, str] = {
+    "instances": "0",
+    "memory": "2g",
+    "vcores": "1",
+    "gpus": "0",
+    "tpus": "0",
+    "tpu.topology": "",
+    "resources": "",
+    "env": "",
+}
+
+
+def discover_job_types(conf_dict: dict[str, str]) -> list[str]:
+    """Find all job types declared in a flat config mapping.
+
+    Mirror of Utils.parseContainerRequests' regex-driven discovery
+    (reference: tony-core/src/main/java/com/linkedin/tony/util/Utils.java:314-340).
+    """
+    types = []
+    for key in conf_dict:
+        m = INSTANCES_REGEX.match(key)
+        if m and m.group(1) not in NON_JOB_TYPE_WORDS:
+            types.append(m.group(1))
+    return sorted(types)

@@ -1,0 +1,96 @@
+"""Framework-wide constants: env-var names, file names, job names, chaos hooks.
+
+TPU-native analog of the reference's ``Constants.java`` (reference:
+tony-core/src/main/java/com/linkedin/tony/Constants.java:1-101). Same role —
+the single table of magic strings shared by client, coordinator and executor —
+but the exported runtime environment targets ``jax.distributed`` on TPU pod
+slices instead of TF_CONFIG/CUDA.
+"""
+
+# ---------------------------------------------------------------------------
+# Job / task naming (Constants.java: am/worker/ps/notebook/driver)
+# ---------------------------------------------------------------------------
+COORDINATOR_JOB_NAME = "am"        # kept as "am" for config compat with the reference
+WORKER_JOB_NAME = "worker"
+PS_JOB_NAME = "ps"
+CHIEF_JOB_NAME = "chief"
+NOTEBOOK_JOB_NAME = "notebook"
+DRIVER_JOB_NAME = "driver"
+EVALUATOR_JOB_NAME = "evaluator"
+
+# ---------------------------------------------------------------------------
+# Core task env vars (Constants.java: JOB_NAME/TASK_INDEX/TASK_NUM/...)
+# ---------------------------------------------------------------------------
+JOB_NAME = "JOB_NAME"
+TASK_INDEX = "TASK_INDEX"
+TASK_NUM = "TASK_NUM"
+SESSION_ID = "SESSION_ID"
+ATTEMPT_NUMBER = "ATTEMPT_NUMBER"
+CLUSTER_SPEC = "CLUSTER_SPEC"
+IS_CHIEF = "IS_CHIEF"
+
+# TensorFlow adapter (Constants.java: TF_CONFIG, TB_PORT)
+TF_CONFIG = "TF_CONFIG"
+TB_PORT = "TB_PORT"
+
+# PyTorch adapter (Constants.java:29-33 RANK/WORLD/INIT_METHOD)
+RANK = "RANK"
+WORLD = "WORLD"
+INIT_METHOD = "INIT_METHOD"
+
+# JAX adapter — the TPU-native first-class runtime. The direct analog of the
+# reference's TF_CONFIG assembly (TaskExecutor.java:131-141): everything a
+# process needs for jax.distributed.initialize() plus mesh/topology metadata.
+JAX_COORDINATOR_ADDRESS = "TONY_JAX_COORDINATOR_ADDRESS"
+JAX_PROCESS_ID = "TONY_JAX_PROCESS_ID"
+JAX_NUM_PROCESSES = "TONY_JAX_NUM_PROCESSES"
+TPU_TOPOLOGY = "TONY_TPU_TOPOLOGY"
+TPU_CHIPS_PER_HOST = "TONY_TPU_CHIPS_PER_HOST"
+MESH_SPEC = "TONY_MESH_SPEC"           # JSON: {"axes": {"dp": 2, "tp": 4, ...}}
+SLICE_ID = "TONY_SLICE_ID"
+
+# Data-feed handshake (replaces the reference's PY4J_GATEWAY_PORT,
+# Constants.java / TaskExecutor.java:87 — pure-Python executor needs no py4j).
+DATA_FEED_SPEC = "TONY_DATA_FEED_SPEC"
+
+# ---------------------------------------------------------------------------
+# File names (Constants.java: tony-final.xml, tony_src.zip, venv.zip)
+# ---------------------------------------------------------------------------
+TONY_FINAL_XML = "tony-final.xml"
+TONY_XML = "tony.xml"
+TONY_SITE_XML = "tony-site.xml"
+TONY_SRC_ZIP = "tony_src.zip"
+TONY_VENV_ZIP = "venv.zip"
+TONY_JOB_DIR_PREFIX = ".tony"          # staging dir per-application
+TONY_LOG_DIR = "logs"
+CORE_SITE_CONF = "core-site.xml"
+
+# History file suffixes (HistoryFileUtils.java:11-32)
+HISTFILE_SUFFIX = "jhist"
+INPROGRESS_SUFFIX = "inprogress"
+
+# ---------------------------------------------------------------------------
+# Chaos-test env hooks (Constants.java:73-78). These are read by PRODUCTION
+# code, exactly as in the reference — the E2E suite drives failure paths
+# through them (TestTonyE2E.java:86-117,179-207).
+# ---------------------------------------------------------------------------
+TEST_AM_CRASH = "TEST_AM_CRASH"                              # coordinator suicides after start
+TEST_WORKER_TERMINATION = "TEST_WORKER_TERMINATION"          # coordinator kills workers when chief registers
+TEST_TASK_EXECUTOR_HANG = "TEST_TASK_EXECUTOR_HANG"          # executor sleeps 20s then exits
+TEST_TASK_EXECUTOR_NUM_HB_MISS = "TEST_TASK_EXECUTOR_NUM_HB_MISS"  # heartbeater skips N pings
+TEST_TASK_EXECUTOR_SKEW = "TEST_TASK_EXECUTOR_SKEW"          # "job#idx#ms" sleep after training
+TEST_PREEMPT_SLICE = "TEST_PREEMPT_SLICE"                    # TPU-only: simulate slice preemption
+
+# ---------------------------------------------------------------------------
+# Exit codes / misc
+# ---------------------------------------------------------------------------
+EXIT_SUCCESS = 0
+EXIT_FAILURE = -1
+COORDINATOR_RPC_PORT_RANGE = (10000, 15000)  # ApplicationRpcServer.java:36
+
+# Framework adapters (MLFramework enum, TonyConfigurationKeys.java:8-11,
+# extended with JAX as the TPU-first default).
+FRAMEWORK_JAX = "jax"
+FRAMEWORK_TENSORFLOW = "tensorflow"
+FRAMEWORK_PYTORCH = "pytorch"
+SUPPORTED_FRAMEWORKS = (FRAMEWORK_JAX, FRAMEWORK_TENSORFLOW, FRAMEWORK_PYTORCH)

@@ -1,0 +1,156 @@
+"""Retrying control-plane RPC client, shared by the job client and executors.
+
+Analog of the reference's singleton retry-proxy ``ApplicationRpcClient``
+(reference: tony-core/src/main/java/com/linkedin/tony/rpc/impl/
+ApplicationRpcClient.java:48-162): one instance per coordinator address, every
+call wrapped in retry-with-backoff so executor startup races against
+coordinator startup resolve themselves (the reference uses Hadoop
+RetryProxy with exponential backoff, :80-92)."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+import grpc
+
+from tony_tpu.rpc import tony_pb2 as pb
+from tony_tpu.rpc.server import SERVICE_NAME
+from tony_tpu.rpc.service import ApplicationRpc, TaskUrl, WorkerSpecResponse
+
+log = logging.getLogger(__name__)
+
+_instances: dict[str, "ApplicationRpcClient"] = {}
+_instances_lock = threading.Lock()
+
+
+class RpcRetryError(RuntimeError):
+    """Raised when a call keeps failing past the retry budget."""
+
+
+class ApplicationRpcClient(ApplicationRpc):
+    """gRPC client with retry/backoff implementing ApplicationRpc."""
+
+    def __init__(self, address: str, max_retries: int = 30,
+                 base_backoff_s: float = 0.1, max_backoff_s: float = 5.0) -> None:
+        self.address = address
+        self.max_retries = max_retries
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._channel = grpc.insecure_channel(address)
+        m = f"/{SERVICE_NAME}/"
+        self._get_task_urls = self._channel.unary_unary(
+            m + "GetTaskUrls",
+            request_serializer=pb.GetTaskUrlsRequest.SerializeToString,
+            response_deserializer=pb.GetTaskUrlsResponse.FromString)
+        self._get_cluster_spec = self._channel.unary_unary(
+            m + "GetClusterSpec",
+            request_serializer=pb.GetClusterSpecRequest.SerializeToString,
+            response_deserializer=pb.GetClusterSpecResponse.FromString)
+        self._register_worker_spec = self._channel.unary_unary(
+            m + "RegisterWorkerSpec",
+            request_serializer=pb.RegisterWorkerSpecRequest.SerializeToString,
+            response_deserializer=pb.RegisterWorkerSpecResponse.FromString)
+        self._register_tb_url = self._channel.unary_unary(
+            m + "RegisterTensorBoardUrl",
+            request_serializer=pb.RegisterTensorBoardUrlRequest.SerializeToString,
+            response_deserializer=pb.RegisterTensorBoardUrlResponse.FromString)
+        self._register_result = self._channel.unary_unary(
+            m + "RegisterExecutionResult",
+            request_serializer=pb.RegisterExecutionResultRequest.SerializeToString,
+            response_deserializer=pb.RegisterExecutionResultResponse.FromString)
+        self._finish = self._channel.unary_unary(
+            m + "FinishApplication",
+            request_serializer=pb.FinishApplicationRequest.SerializeToString,
+            response_deserializer=pb.FinishApplicationResponse.FromString)
+        self._heartbeat = self._channel.unary_unary(
+            m + "TaskExecutorHeartbeat",
+            request_serializer=pb.HeartbeatRequest.SerializeToString,
+            response_deserializer=pb.HeartbeatResponse.FromString)
+
+    @classmethod
+    def get_instance(cls, address: str) -> "ApplicationRpcClient":
+        """Singleton per address (reference: ApplicationRpcClient.getInstance:
+        48-55)."""
+        with _instances_lock:
+            if address not in _instances:
+                _instances[address] = cls(address)
+            return _instances[address]
+
+    def close(self) -> None:
+        self._channel.close()
+        with _instances_lock:
+            # Only evict the registry entry if it is THIS client — a
+            # directly-constructed client must not break the singleton.
+            if _instances.get(self.address) is self:
+                del _instances[self.address]
+
+    # -- retry wrapper ------------------------------------------------------
+    def _call(self, stub, request, retries: int | None = None,
+              idempotent: bool = True):
+        """Retry policy: UNAVAILABLE always retries (the request never reached
+        a serving coordinator). DEADLINE_EXCEEDED may mean the server *did*
+        process the call, so it only retries for idempotent methods — the
+        coordinator's register_worker_spec/heartbeat are idempotent by
+        contract (keyed on task id); register_execution_result is not."""
+        retries = self.max_retries if retries is None else retries
+        backoff = self.base_backoff_s
+        last_err: Exception | None = None
+        for _ in range(retries):
+            try:
+                return stub(request, timeout=10.0)
+            except grpc.RpcError as e:
+                code = e.code() if hasattr(e, "code") else None
+                retryable = code == grpc.StatusCode.UNAVAILABLE or (
+                    idempotent and code == grpc.StatusCode.DEADLINE_EXCEEDED)
+                if not retryable:
+                    raise
+                last_err = e
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.max_backoff_s)
+        raise RpcRetryError(
+            f"RPC to {self.address} failed after {retries} retries: {last_err}")
+
+    # -- the seven methods --------------------------------------------------
+    def get_task_urls(self) -> list[TaskUrl]:
+        resp = self._call(self._get_task_urls, pb.GetTaskUrlsRequest())
+        return [TaskUrl(u.name, u.index, u.url) for u in resp.task_urls]
+
+    def get_cluster_spec(self, task_id: str) -> str:
+        resp = self._call(self._get_cluster_spec,
+                          pb.GetClusterSpecRequest(task_id=task_id))
+        return resp.cluster_spec
+
+    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
+        resp = self._call(self._register_worker_spec,
+                          pb.RegisterWorkerSpecRequest(worker=worker, spec=spec))
+        return WorkerSpecResponse(
+            spec=resp.spec, coordinator_address=resp.coordinator_address,
+            process_id=resp.process_id, num_processes=resp.num_processes,
+            mesh_spec=resp.mesh_spec)
+
+    def register_tensorboard_url(self, spec: str) -> str:
+        resp = self._call(self._register_tb_url,
+                          pb.RegisterTensorBoardUrlRequest(spec=spec))
+        return resp.spec
+
+    def register_execution_result(self, exit_code: int, job_name: str,
+                                  job_index: str, session_id: str) -> str:
+        resp = self._call(self._register_result,
+                          pb.RegisterExecutionResultRequest(
+                              exit_code=exit_code, job_name=job_name,
+                              job_index=job_index, session_id=session_id),
+                          idempotent=False)
+        return resp.message
+
+    def finish_application(self) -> str:
+        resp = self._call(self._finish, pb.FinishApplicationRequest())
+        return resp.message
+
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        # Heartbeats get a tight retry budget: the executor-side heartbeater
+        # counts consecutive failures itself (reference: TaskExecutor.java:
+        # 264-268 dies after 5 failed sends).
+        self._call(self._heartbeat, pb.HeartbeatRequest(task_id=task_id),
+                   retries=2)

@@ -1,0 +1,130 @@
+"""gRPC server hosting the control-plane protocol inside the coordinator.
+
+Analog of the reference's ``ApplicationRpcServer`` (reference: tony-core/src/
+main/java/com/linkedin/tony/rpc/ApplicationRpcServer.java:1-154): a server
+thread inside the coordinator on a port from the 10000-15000 range, fronting an
+``ApplicationRpc`` implementation. Hadoop IPC + ProtobufRpcEngine becomes
+gRPC; the 14 PBImpl translation classes become the inline request/response
+lambdas below. Handlers are registered generically (no codegen plugin needed —
+protoc only generates the messages)."""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket
+from concurrent import futures
+
+import grpc
+
+from tony_tpu import constants
+from tony_tpu.rpc import tony_pb2 as pb
+from tony_tpu.rpc.service import ApplicationRpc
+
+log = logging.getLogger(__name__)
+
+SERVICE_NAME = "tony_tpu.ApplicationRpc"
+
+
+def find_free_port(port_range: tuple[int, int] | None = None) -> int:
+    """Pick a free port, preferring the reference's 10000-15000 range
+    (ApplicationRpcServer.java:36)."""
+    lo, hi = port_range or constants.COORDINATOR_RPC_PORT_RANGE
+    for _ in range(64):
+        port = random.randint(lo, hi)
+        with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                s.bind(("", port))
+                return port
+            except OSError:
+                continue
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class ApplicationRpcServer:
+    """Wraps a grpc.Server around an ApplicationRpc implementation."""
+
+    def __init__(self, impl: ApplicationRpc, port: int | None = None,
+                 max_workers: int = 32) -> None:
+        self.impl = impl
+        explicit_port = port is not None
+        self.port = port if explicit_port else find_free_port()
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=max_workers),
+            options=[("grpc.so_reuseport", 0)])
+        self._server.add_generic_rpc_handlers((self._make_handler(),))
+        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        if bound == 0:
+            if explicit_port:
+                # The caller advertised this port; silently moving would
+                # strand every client. Fail loudly instead.
+                raise OSError(f"could not bind RPC server on requested port {self.port}")
+            # Race on our self-chosen port — re-pick and retry once.
+            self.port = find_free_port((20000, 30000))
+            if self._server.add_insecure_port(f"[::]:{self.port}") == 0:
+                raise OSError("could not bind RPC server port")
+
+    # -- handler table ------------------------------------------------------
+    def _make_handler(self) -> grpc.GenericRpcHandler:
+        impl = self.impl
+
+        def _get_task_urls(req, ctx):
+            return pb.GetTaskUrlsResponse(task_urls=[
+                pb.TaskUrlProto(name=u.name, index=u.index, url=u.url)
+                for u in impl.get_task_urls()])
+
+        def _get_cluster_spec(req, ctx):
+            return pb.GetClusterSpecResponse(
+                cluster_spec=impl.get_cluster_spec(req.task_id))
+
+        def _register_worker_spec(req, ctx):
+            r = impl.register_worker_spec(req.worker, req.spec)
+            return pb.RegisterWorkerSpecResponse(
+                spec=r.spec, coordinator_address=r.coordinator_address,
+                process_id=r.process_id, num_processes=r.num_processes,
+                mesh_spec=r.mesh_spec)
+
+        def _register_tb_url(req, ctx):
+            return pb.RegisterTensorBoardUrlResponse(
+                spec=impl.register_tensorboard_url(req.spec))
+
+        def _register_result(req, ctx):
+            return pb.RegisterExecutionResultResponse(
+                message=impl.register_execution_result(
+                    req.exit_code, req.job_name, req.job_index, req.session_id))
+
+        def _finish(req, ctx):
+            return pb.FinishApplicationResponse(message=impl.finish_application())
+
+        def _heartbeat(req, ctx):
+            impl.task_executor_heartbeat(req.task_id)
+            return pb.HeartbeatResponse()
+
+        methods = {
+            "GetTaskUrls": (_get_task_urls, pb.GetTaskUrlsRequest),
+            "GetClusterSpec": (_get_cluster_spec, pb.GetClusterSpecRequest),
+            "RegisterWorkerSpec": (_register_worker_spec, pb.RegisterWorkerSpecRequest),
+            "RegisterTensorBoardUrl": (_register_tb_url, pb.RegisterTensorBoardUrlRequest),
+            "RegisterExecutionResult": (_register_result, pb.RegisterExecutionResultRequest),
+            "FinishApplication": (_finish, pb.FinishApplicationRequest),
+            "TaskExecutorHeartbeat": (_heartbeat, pb.HeartbeatRequest),
+        }
+        handlers = {
+            name: grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=req_cls.FromString,
+                response_serializer=lambda msg: msg.SerializeToString())
+            for name, (fn, req_cls) in methods.items()
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        self._server.start()
+        log.info("ApplicationRpcServer listening on port %d", self.port)
+        return self.port
+
+    def stop(self, grace: float = 1.0) -> None:
+        self._server.stop(grace)

@@ -1,0 +1,66 @@
+"""The control-plane service interface.
+
+Python analog of the reference's ``ApplicationRpc`` interface (reference:
+tony-core/src/main/java/com/linkedin/tony/rpc/ApplicationRpc.java) — the same
+seven methods, implemented by the coordinator and consumed by the client and
+the task executors. The ~1300 LoC of protobuf record/PBImpl translation
+boilerplate in the reference (rpc/impl/pb/*) collapses into the dataclasses
+below plus direct proto construction in server.py/client.py.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class TaskUrl:
+    """(name, index, url) record surfaced to the client (reference:
+    rpc/TaskUrl.java:11-41)."""
+    name: str
+    index: str
+    url: str
+
+
+@dataclass(frozen=True)
+class WorkerSpecResponse:
+    """Gang-barrier response: empty ``spec`` means "not all registered yet,
+    poll again"; once released it carries the cluster spec plus the JAX/TPU
+    bootstrap assignment (the TF_CONFIG replacement)."""
+    spec: str = ""
+    coordinator_address: str = ""
+    process_id: int = -1
+    num_processes: int = 0
+    mesh_spec: str = ""
+
+    @property
+    def released(self) -> bool:
+        return bool(self.spec)
+
+
+class ApplicationRpc(abc.ABC):
+    """Seven-method control-plane protocol (reference proto:
+    tensorflow_cluster_service_protos.proto:11-19)."""
+
+    @abc.abstractmethod
+    def get_task_urls(self) -> list[TaskUrl]: ...
+
+    @abc.abstractmethod
+    def get_cluster_spec(self, task_id: str) -> str: ...
+
+    @abc.abstractmethod
+    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse: ...
+
+    @abc.abstractmethod
+    def register_tensorboard_url(self, spec: str) -> str: ...
+
+    @abc.abstractmethod
+    def register_execution_result(self, exit_code: int, job_name: str,
+                                  job_index: str, session_id: str) -> str: ...
+
+    @abc.abstractmethod
+    def finish_application(self) -> str: ...
+
+    @abc.abstractmethod
+    def task_executor_heartbeat(self, task_id: str) -> None: ...
