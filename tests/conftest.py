@@ -1,17 +1,46 @@
 """Test harness config: run everything on a virtual 8-device CPU mesh.
 
 Multi-chip TPU hardware is not available in CI; per the framework's test
-strategy (SURVEY.md §4: local multi-process/virtual-device backend + chaos env
-hooks, mirroring the reference's MiniCluster in tony-mini), all sharding and
+strategy (SURVEY.md §4: local fake-cluster backend + chaos env hooks,
+mirroring the reference's MiniCluster in tony-mini), all sharding and
 collective paths are exercised on ``--xla_force_host_platform_device_count=8``
-CPU devices. Must run before jax is imported anywhere.
+CPU devices.
+
+The dev image's sitecustomize pre-imports jax at interpreter startup and pins
+the TPU platform, making in-process env configuration too late — so
+``pytest_configure`` re-execs pytest once with a clean environment (CPU
+platform, 8 virtual devices, no sitecustomize on PYTHONPATH). Capture is
+stopped first so the re-exec'd run inherits the real stdout/stderr.
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+def _clean_env() -> dict[str, str]:
+    env = dict(os.environ)
+    env["TONY_PYTEST_CLEAN"] = "1"
+    env["TONY_TEST_MODE"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon_site" not in p)
+    return env
+
+
+def pytest_configure(config):
+    if os.environ.get("TONY_PYTEST_CLEAN") == "1":
+        return
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.stop_global_capturing()   # restore real stdout/stderr fds
+    args = list(config.invocation_params.args)
+    os.execve(sys.executable, [sys.executable, "-m", "pytest"] + args,
+              _clean_env())
+
+
 os.environ.setdefault("TONY_TEST_MODE", "1")

@@ -1,0 +1,4 @@
+"""Asserts shell env propagated (reference fixture: exit_0_check_env.py)."""
+import os, sys
+assert os.environ.get("TONY_TEST_SHELL_VAR") == "hello", os.environ.get("TONY_TEST_SHELL_VAR")
+sys.exit(0)

@@ -1,0 +1,15 @@
+"""Asserts the JAX runtime adapter env (the TF_CONFIG-replacement payload)."""
+import json, os, sys
+for var in ("TONY_JAX_COORDINATOR_ADDRESS", "TONY_JAX_PROCESS_ID",
+            "TONY_JAX_NUM_PROCESSES", "TONY_MESH_SPEC", "CLUSTER_SPEC",
+            "JOB_NAME", "TASK_INDEX", "TASK_NUM", "SESSION_ID"):
+    assert os.environ.get(var) not in (None, ""), f"missing {var}"
+spec = json.loads(os.environ["CLUSTER_SPEC"])
+nproc = int(os.environ["TONY_JAX_NUM_PROCESSES"])
+assert sum(len(v) for v in spec.values()) == nproc, (spec, nproc)
+pid = int(os.environ["TONY_JAX_PROCESS_ID"])
+assert 0 <= pid < nproc
+coord = os.environ["TONY_JAX_COORDINATOR_ADDRESS"]
+assert coord in [h for v in spec.values() for h in v]
+json.loads(os.environ["TONY_MESH_SPEC"])
+sys.exit(0)

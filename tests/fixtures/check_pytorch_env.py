@@ -1,0 +1,6 @@
+"""Asserts RANK/WORLD/INIT_METHOD (reference: exit_0_check_pytorchenv.py)."""
+import os, sys
+assert os.environ["INIT_METHOD"].startswith("tcp://"), os.environ.get("INIT_METHOD")
+rank, world = int(os.environ["RANK"]), int(os.environ["WORLD"])
+assert 0 <= rank < world
+sys.exit(0)

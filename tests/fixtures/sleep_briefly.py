@@ -1,0 +1,2 @@
+import time, sys
+time.sleep(float(sys.argv[1]) if len(sys.argv) > 1 else 2.0)

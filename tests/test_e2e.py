@@ -1,0 +1,179 @@
+"""End-to-end suite: real client → coordinator → executor subprocess trees.
+
+The TPU-build analog of the reference's ``TestTonyE2E`` (reference: tony-core/
+src/test/java/com/linkedin/tony/TestTonyE2E.java:69-273, 13 scenarios on an
+in-process MiniYARN cluster). Here the fake cluster is the local subprocess
+backend; every test submits through the real TonyClient and asserts on the
+exit code, with the same chaos-env-hook coverage (HB miss, AM crash, worker
+termination, skew)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events.events import find_job_files, parse_events
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+PY = sys.executable
+
+
+def make_client(tmp_path, command, confs=None, shell_env=None, src_dir=None):
+    base = {
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.application.timeout": "60000",   # safety net for the suite
+    }
+    base.update(confs or {})
+    conf = TonyConfig(base)
+    return TonyClient(conf, command, src_dir=src_dir, shell_env=shell_env)
+
+
+def fixture_cmd(name, *args):
+    return " ".join([PY, os.path.join(FIXTURES, name), *args])
+
+
+@pytest.mark.e2e
+class TestE2E:
+    def test_single_worker_succeeds(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("exit_0.py"),
+                             {"tony.worker.instances": "1"})
+        assert client.run() == 0
+
+    def test_worker_failure_fails_job(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("exit_1.py"),
+                             {"tony.worker.instances": "1"})
+        assert client.run() == 1
+
+    def test_ps_worker_topology(self, tmp_path):
+        """2 workers + 1 ps; ps sleeps forever and is untracked — the job
+        must finish when workers do (reference: tracked-jobtype semantics)."""
+        client = make_client(
+            tmp_path,
+            f'bash -c "if [ $JOB_NAME = ps ]; then {fixture_cmd("sleep_forever.py")};'
+            f' else {fixture_cmd("exit_0.py")}; fi"',
+            {"tony.worker.instances": "2", "tony.ps.instances": "1"})
+        assert client.run() == 0
+
+    def test_shell_env_propagation(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("check_env.py"),
+                             {"tony.worker.instances": "1"},
+                             shell_env={"TONY_TEST_SHELL_VAR": "hello"})
+        assert client.run() == 0
+
+    def test_jax_runtime_env(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("check_jax_env.py"),
+                             {"tony.worker.instances": "2",
+                              "tony.ps.instances": "1",
+                              "tony.application.mesh": "dp=2"})
+        assert client.run() == 0
+
+    def test_pytorch_runtime_env(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("check_pytorch_env.py"),
+                             {"tony.worker.instances": "2",
+                              "tony.application.framework": "pytorch"})
+        assert client.run() == 0
+
+    def test_heartbeat_miss_fails_job(self, tmp_path):
+        """Executor skips pings while the task sleeps → liveness expiry →
+        job fails (reference: testTaskExecutorHeartbeatMiss)."""
+        client = make_client(
+            tmp_path, fixture_cmd("sleep_briefly.py", "10"),
+            {"tony.worker.instances": "1",
+             "tony.task.heartbeat-interval-ms": "100",
+             "tony.task.max-missed-heartbeats": "3"},
+            shell_env={"TEST_TASK_EXECUTOR_NUM_HB_MISS": "100"})
+        assert client.run() == 1
+
+    def test_am_crash_fails_job(self, tmp_path):
+        """Coordinator suicide → no final status → client reports failure
+        (reference: testAMCrashTonyShouldFail)."""
+        client = make_client(tmp_path, fixture_cmd("exit_0.py"),
+                             {"tony.worker.instances": "1"},
+                             shell_env={"TEST_AM_CRASH": "true"})
+        assert client.run() == 1
+
+    def test_worker_termination_fails_job(self, tmp_path):
+        """Chief registers → chaos kills worker:1 → gang failure
+        (reference: testAMStopsJobAfterWorker0Killed)."""
+        client = make_client(
+            tmp_path,
+            fixture_cmd("sleep_briefly.py", "15"),
+            {"tony.worker.instances": "2"},
+            shell_env={"TEST_WORKER_TERMINATION": "true"})
+        assert client.run() == 1
+
+    def test_session_retry_recovers(self, tmp_path):
+        """First session fails (worker exits 1 once), retry succeeds: the
+        fixture exits 1 iff a marker file does not exist yet, then creates it
+        (reference: AM retry loop, TonyApplicationMaster.java:351-377)."""
+        marker = tmp_path / "attempt.marker"
+        cmd = (f'bash -c "if [ -f {marker} ]; then exit 0; '
+               f'else touch {marker}; exit 1; fi"')
+        client = make_client(tmp_path, cmd,
+                             {"tony.worker.instances": "1",
+                              "tony.am.retry-count": "1"})
+        assert client.run() == 0
+
+    def test_skew_chaos_still_succeeds(self, tmp_path):
+        client = make_client(
+            tmp_path, fixture_cmd("exit_0.py"),
+            {"tony.worker.instances": "2"},
+            shell_env={"TEST_TASK_EXECUTOR_SKEW": "worker#0#1500"})
+        assert client.run() == 0
+
+    def test_execution_timeout_kills_task(self, tmp_path):
+        client = make_client(
+            tmp_path, fixture_cmd("sleep_forever.py"),
+            {"tony.worker.instances": "1",
+             "tony.task.execution-timeout-ms": "1500"})
+        assert client.run() == 1
+
+    def test_history_events_written(self, tmp_path):
+        client = make_client(tmp_path, fixture_cmd("exit_0.py"),
+                             {"tony.worker.instances": "1"})
+        assert client.run() == 0
+        hist_dir = os.path.join(client.job_dir, "history")
+        files = find_job_files(hist_dir)
+        assert len(files) == 1 and files[0].endswith(".jhist")
+        types = [e.event_type for e in parse_events(files[0])]
+        assert types[0] == "APPLICATION_INITED"
+        assert "TASK_REGISTERED" in types and "TASK_FINISHED" in types
+        assert types[-1] == "APPLICATION_FINISHED"
+        assert "SUCCEEDED" in os.path.basename(files[0])
+
+    def test_distributed_jax_mnist_trains(self, tmp_path):
+        """The minimum end-to-end slice (SURVEY.md §7.5): client →
+        coordinator → 2 local workers → jax.distributed bootstrap over the
+        gang barrier → data-parallel MNIST trains across both processes and
+        exits 0. JAX_PLATFORMS=cpu + a clean PYTHONPATH keep the worker
+        processes on the multi-process CPU backend."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "mnist", "mnist_distributed.py")
+        client = make_client(
+            tmp_path, f"{PY} {script} --steps 60 --batch_size 128",
+            {"tony.worker.instances": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.application.timeout": "120000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       # 1 device per process (don't inherit the harness's
+                       # 8-virtual-device XLA_FLAGS — 16 gloo ranks crawl)
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read() + \
+            open(os.path.join(client.job_dir, "logs", "worker-1.stdout")).read()
+        assert "2 global devices" in out       # both processes federated
+        assert "done:" in out
+
+    def test_task_logs_written(self, tmp_path):
+        client = make_client(
+            tmp_path, 'bash -c "echo training-output-marker; exit 0"',
+            {"tony.worker.instances": "1"})
+        assert client.run() == 0
+        log = os.path.join(client.job_dir, "logs", "worker-0.stdout")
+        assert os.path.exists(log)
+        assert "training-output-marker" in open(log).read()

@@ -1,0 +1,101 @@
+"""Unit tests: heartbeat monitor + event log (mirrors TestEventHandler,
+TestHistoryFileUtils, TestParserUtils in the reference)."""
+
+import os
+import threading
+import time
+
+from tony_tpu.cluster.liveness import HeartbeatMonitor
+from tony_tpu.events.events import (EventHandler, JobMetadata, find_job_files,
+                                    history_file_name,
+                                    is_valid_history_file_name, parse_events)
+
+
+def test_monitor_expires_silent_task():
+    dead = []
+    fired = threading.Event()
+
+    def on_dead(tid):
+        dead.append(tid)
+        fired.set()
+
+    m = HeartbeatMonitor(hb_interval_ms=50, max_missed=3, on_expired=on_dead)
+    m.start()
+    m.register("worker:0")
+    m.register("worker:1")
+    stop_pinger = threading.Event()
+
+    def pinger():
+        while not stop_pinger.wait(0.05):
+            m.ping("worker:1")
+
+    t = threading.Thread(target=pinger, daemon=True)
+    t.start()
+    assert fired.wait(timeout=3.0)
+    time.sleep(0.3)   # give a wrongly-expiring worker:1 a chance to fire
+    stop_pinger.set()
+    m.stop()
+    assert dead == ["worker:0"]   # fired once, only for the silent task
+
+
+def test_monitor_unregister_prevents_expiry():
+    dead = []
+    m = HeartbeatMonitor(hb_interval_ms=50, max_missed=3,
+                         on_expired=dead.append)
+    m.start()
+    m.register("worker:0")
+    m.unregister("worker:0")      # completed normally
+    time.sleep(0.5)
+    m.stop()
+    assert dead == []
+
+
+def test_monitor_reset_forgets_tasks():
+    dead = []
+    m = HeartbeatMonitor(hb_interval_ms=50, max_missed=3,
+                         on_expired=dead.append)
+    m.start()
+    m.register("worker:0")
+    m.reset()                     # session retry
+    time.sleep(0.5)
+    m.stop()
+    assert dead == []
+
+
+def test_history_file_name_codec():
+    name = history_file_name("app_1_2", 1000, "alice", completed_ms=2000,
+                             status="SUCCEEDED")
+    assert name == "app_1_2-1000-2000-alice-SUCCEEDED.jhist"
+    md = JobMetadata.from_file_name(name)
+    assert (md.app_id, md.started_ms, md.completed_ms, md.user, md.status) == \
+        ("app_1_2", 1000, 2000, "alice", "SUCCEEDED")
+    inprog = history_file_name("app_1_2", 1000, "alice", in_progress=True)
+    assert inprog.endswith(".jhist.inprogress")
+    assert JobMetadata.from_file_name(inprog).in_progress
+    assert is_valid_history_file_name(name)
+    assert not is_valid_history_file_name("random.txt")
+    assert not is_valid_history_file_name("x-notanumber-user.jhist")
+
+
+def test_event_handler_roundtrip(tmp_path):
+    h = EventHandler(str(tmp_path), "app_9", "bob")
+    h.start()
+    h.emit("APPLICATION_INITED", app_id="app_9", num_tasks=2)
+    h.emit("TASK_FINISHED", task="worker:0", exit_code=0)
+    final = h.stop("SUCCEEDED")
+    assert os.path.exists(final) and final.endswith(".jhist")
+    assert not any(f.endswith(".inprogress") for f in os.listdir(tmp_path))
+    events = parse_events(final)
+    assert [e.event_type for e in events] == ["APPLICATION_INITED",
+                                              "TASK_FINISHED"]
+    assert events[0].payload["num_tasks"] == 2
+    assert events[0].timestamp > 0
+    assert find_job_files(str(tmp_path)) == [final]
+
+
+def test_parse_skips_malformed_lines(tmp_path):
+    p = tmp_path / "a-1-2-u-SUCCEEDED.jhist"
+    p.write_text('{"event_type": "X", "payload": {}, "timestamp": 1}\n'
+                 'garbage\n'
+                 '{"event_type": "Y", "payload": {}, "timestamp": 2}\n')
+    assert [e.event_type for e in parse_events(str(p))] == ["X", "Y"]

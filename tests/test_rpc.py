@@ -11,7 +11,8 @@ import pytest
 
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
 from tony_tpu.rpc.server import ApplicationRpcServer, find_free_port
-from tony_tpu.rpc.service import ApplicationRpc, TaskUrl, WorkerSpecResponse
+from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
+                                  WorkerSpecResponse)
 
 
 class FakeImpl(ApplicationRpc):
@@ -61,6 +62,10 @@ class FakeImpl(ApplicationRpc):
     def task_executor_heartbeat(self, task_id):
         self.heartbeats.append(task_id)
 
+    def get_application_status(self):
+        return ApplicationStatus(
+            status="SUCCEEDED" if self.finished else "RUNNING", session_id=0)
+
 
 @pytest.fixture
 def server():
@@ -94,8 +99,11 @@ def test_all_seven_methods(server):
     client.task_executor_heartbeat("worker:0")
     client.task_executor_heartbeat("worker:1")
     assert impl.heartbeats == ["worker:0", "worker:1"]
+    assert client.get_application_status().status == "RUNNING"
     assert client.finish_application() == "SUCCEEDED"
     assert impl.finished
+    st = client.get_application_status()
+    assert st.finished and st.status == "SUCCEEDED"
     client.close()
 
 

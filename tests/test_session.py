@@ -1,0 +1,127 @@
+"""Session state-machine tests (TonySession semantics, SURVEY.md §2.1/§3)."""
+
+import json
+
+from tony_tpu.cluster.session import (Session, SessionStatus, TaskStatus,
+                                      next_session)
+from tony_tpu.conf.config import TonyConfig
+
+
+def make_conf(**extra):
+    base = {"tony.worker.instances": "2", "tony.ps.instances": "1"}
+    base.update(extra)
+    return TonyConfig(base)
+
+
+def test_task_layout_and_chief():
+    s = Session(make_conf())
+    assert {jt: len(ts) for jt, ts in s.tasks.items()} == {"worker": 2, "ps": 1}
+    # no explicit chief type → worker:0 is chief
+    assert s.is_chief("worker", 0)
+    assert not s.is_chief("worker", 1)
+    assert not s.is_chief("ps", 0)
+
+
+def test_explicit_chief_type():
+    s = Session(make_conf(**{"tony.chief.instances": "1"}))
+    assert s.is_chief("chief", 0)
+    assert not s.is_chief("worker", 0)
+
+
+def test_gang_barrier_and_process_ids():
+    s = Session(make_conf())
+    assert s.register_task_spec("worker:1", "h1:1000") is None
+    assert s.register_task_spec("ps:0", "h2:1000") is None
+    payload = s.register_task_spec("worker:0", "h0:1000")
+    assert payload is not None
+    assert payload["num_processes"] == 3
+    # chief (worker:0) is process 0 → hosts the jax.distributed coordinator
+    assert s.process_id_of("worker:0") == 0
+    assert payload["coordinator_address"] == "h0:1000"
+    spec = json.loads(payload["cluster_spec"])
+    assert spec == {"worker": ["h0:1000", "h1:1000"], "ps": ["h2:1000"]}
+    # dense unique ids
+    pids = sorted(t.process_id for t in s.all_tasks())
+    assert pids == [0, 1, 2]
+    # idempotent re-registration, stable ids
+    again = s.register_task_spec("worker:1", "h1:1000")
+    assert again == payload and s.process_id_of("worker:1") != 0
+
+
+def test_completion_reduction_success():
+    s = Session(make_conf())
+    for tid in ("worker:0", "worker:1", "ps:0"):
+        s.register_task_spec(tid, "h:1")
+    s.on_task_completed("worker", 1, 0)
+    assert not s.training_finished()          # worker:0 still running
+    s.on_task_completed("worker", 0, 0)
+    assert s.training_finished()              # ps untracked → not required
+    assert s.status is SessionStatus.SUCCEEDED
+
+
+def test_tracked_failure_fails_session():
+    s = Session(make_conf())
+    s.on_task_completed("worker", 1, 3)
+    assert s.status is SessionStatus.FAILED
+    assert "worker:1" in s.failure_message
+
+
+def test_untracked_failure_ignored():
+    s = Session(make_conf())
+    s.on_task_completed("ps", 0, 1)
+    assert s.status is SessionStatus.RUNNING
+
+
+def test_chief_completion_short_circuits():
+    s = Session(make_conf())
+    s.on_task_completed("worker", 0, 0)       # chief succeeds
+    assert s.status is SessionStatus.SUCCEEDED
+    # worker:1 never finished — chief completion ends the job (reference :266-271)
+
+
+def test_stale_session_events_ignored():
+    s = Session(make_conf(), session_id=1)
+    s.on_task_completed("worker", 0, 1, session_id=0)   # from previous attempt
+    assert s.status is SessionStatus.RUNNING
+    assert s.get_task("worker", 0).status is TaskStatus.NEW
+
+
+def test_duplicate_completion_ignored():
+    s = Session(make_conf())
+    s.on_task_completed("worker", 1, 0)
+    s.on_task_completed("worker", 1, 5)       # RPC result + process exit race
+    assert s.get_task("worker", 1).exit_code == 0
+    assert s.status is SessionStatus.RUNNING
+
+
+def test_deemed_dead():
+    s = Session(make_conf())
+    s.on_task_deemed_dead("worker:1")
+    assert s.status is SessionStatus.FAILED
+    assert "heartbeat" in s.failure_message
+
+
+def test_allocation_matching():
+    s = Session(make_conf())
+    t0 = s.next_allocation("worker")
+    t1 = s.next_allocation("worker")
+    assert (t0.index, t1.index) == (0, 1)
+    assert s.next_allocation("worker") is None
+    assert t0.status is TaskStatus.SCHEDULED
+    assert t0.allocation_id != t1.allocation_id
+
+
+def test_retry_session_versioning():
+    s = Session(make_conf())
+    s.on_task_completed("worker", 0, 1)
+    s2 = next_session(s)
+    assert s2.session_id == s.session_id + 1
+    assert s2.status is SessionStatus.RUNNING
+    assert all(t.status is TaskStatus.NEW for t in s2.all_tasks())
+
+
+def test_mesh_spec_in_payload():
+    s = Session(make_conf(**{"tony.application.mesh": "dp=2,tp=1"}))
+    for tid in ("worker:0", "worker:1", "ps:0"):
+        payload = s.register_task_spec(tid, "h:1")
+    assert json.loads(payload["mesh_spec"]) == {"axes": {"dp": 2, "tp": 1}}

@@ -1,0 +1,70 @@
+"""TPU slice backend: command construction + gating (the launch-command unit
+tests, mirroring the reference's TestTonyClient.java:23-31 /
+TestTonyApplicationMaster.java:13-34 style)."""
+
+import pytest
+
+from tony_tpu.backend.base import LaunchSpec
+from tony_tpu.backend.tpu import (TpuProvisioningError, TpuSliceBackend,
+                                  slice_name)
+from tony_tpu.conf.config import TonyConfig
+
+
+def tpu_conf(**extra):
+    base = {
+        "tony.scheduler.backend": "tpu",
+        "tony.tpu.project": "my-proj",
+        "tony.tpu.zone": "us-central2-b",
+        "tony.tpu.accelerator-type": "v5litepod",
+        "tony.worker.instances": "4",
+        "tony.worker.tpus": "4",
+        "tony.worker.tpu.topology": "4x4",
+    }
+    base.update(extra)
+    return TonyConfig(base)
+
+
+def test_requires_config_when_live():
+    with pytest.raises(TpuProvisioningError):
+        TpuSliceBackend(TonyConfig({"tony.scheduler.backend": "tpu"}),
+                        dry_run=False)
+
+
+def test_create_command_shape():
+    b = TpuSliceBackend(tpu_conf(), app_id="application_1_abc", dry_run=True)
+    cmd = b.create_slice_command("worker", "4x4")
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "create",
+                       "tony-application-1-abc-worker"]
+    assert "--accelerator-type=v5litepod-16" in cmd  # 4x4 topology → 16 chips
+    assert "--project=my-proj" in cmd and "--zone=us-central2-b" in cmd
+
+
+def test_preemptible_flag():
+    b = TpuSliceBackend(tpu_conf(**{"tony.tpu.preemptible": "true"}),
+                        app_id="a", dry_run=True)
+    assert "--preemptible" in b.create_slice_command("worker", "2x2")
+
+
+def test_ssh_and_delete_commands():
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    ssh = b.ssh_command("worker", 2, "echo hi")
+    assert "--worker=2" in ssh and "--command=echo hi" in ssh
+    assert slice_name("app1", "worker") in ssh
+    delete = b.delete_slice_command("worker")
+    assert "delete" in delete and "--async" in delete
+
+
+def test_dry_run_gang_provisions_once():
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    for i in range(4):
+        b.launch_task(LaunchSpec(task_id=f"worker:{i}", command="run",
+                                 env={}, log_dir="/tmp", tpu_topology="4x4"))
+    # one slice (gang) for all 4 hosts of the job type
+    assert list(b._slices) == ["worker"]
+    assert b.poll_completed() == []
+    b.stop()
+
+
+def test_slice_name_sanitized_and_bounded():
+    n = slice_name("application_1785325254085_2d827d" * 3, "worker")
+    assert "_" not in n and len(n) <= 61

@@ -1,0 +1,60 @@
+"""Scheduler-backend abstraction: where task processes actually run.
+
+The reference hardwires YARN (AMRMClientAsync/NMClientAsync inside
+TonyApplicationMaster.java:990-1151); the TPU build makes the substrate
+pluggable, because TPU pod slices are gang-allocated (one allocation = every
+host of a slice) while the local test backend allocates per-process. Backends
+implement launch/poll/kill; the coordinator owns all policy (matching, retry,
+liveness)."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+
+
+@dataclass
+class LaunchSpec:
+    """Everything needed to start one task process."""
+    task_id: str            # "jobtype:index"
+    command: str            # executor launch command (shell)
+    env: dict[str, str]     # additional environment
+    log_dir: str            # where stdout/stderr land
+    cwd: str = ""           # working dir for the task process (job dir)
+    memory_mb: int = 2048
+    vcores: int = 1
+    gpus: int = 0
+    tpus: int = 0
+    tpu_topology: str = ""
+
+
+@dataclass
+class CompletionEvent:
+    task_id: str
+    exit_code: int
+    preempted: bool = False  # TPU slices can be preempted wholesale; the
+                             # monitor treats preemption as retryable
+
+
+class SchedulerBackend(abc.ABC):
+    """Minimal container-management surface the coordinator needs."""
+
+    @abc.abstractmethod
+    def launch_task(self, spec: LaunchSpec) -> None: ...
+
+    @abc.abstractmethod
+    def poll_completed(self) -> list[CompletionEvent]:
+        """Non-blocking: completion events observed since the last poll.
+        Process/container exit is the authoritative task result, exactly as
+        YARN container completion is in the reference (RMCallbackHandler.
+        onContainersCompleted:992)."""
+
+    @abc.abstractmethod
+    def kill_task(self, task_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def kill_all(self) -> None:
+        """Stop every running task (session reset / shutdown)."""
+
+    @abc.abstractmethod
+    def stop(self) -> None: ...

@@ -1,0 +1,116 @@
+"""Local subprocess backend — the in-process fake cluster.
+
+Analog of the reference's tony-mini MiniCluster (reference: tony-mini/src/
+main/java/com/linkedin/minitony/cluster/MiniCluster.java:44-60, a
+MiniYARNCluster + MiniDFSCluster used by the whole E2E suite). Here the
+"containers" are plain subprocesses on this host with stdout/stderr redirected
+to per-task log files (the YARN container-log-dir analog, reference:
+TonyApplicationMaster.java:1119-1127). This backend is how the entire
+distributed control plane — gang barrier, heartbeats, chief short-circuit,
+session retries, chaos hooks — is exercised on a dev box or CI without TPUs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import threading
+
+from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
+from tony_tpu.utils.env import with_framework_path
+
+log = logging.getLogger(__name__)
+
+
+class LocalBackend(SchedulerBackend):
+    def __init__(self) -> None:
+        self._procs: dict[str, subprocess.Popen] = {}
+        self._files: dict[str, list] = {}
+        self._reported: set[str] = set()
+        self._killed: set[str] = set()
+        self._lock = threading.Lock()
+
+    def launch_task(self, spec: LaunchSpec) -> None:
+        os.makedirs(spec.log_dir, exist_ok=True)
+        # Relaunch of the same task id (session retry racing a slow death):
+        # reap the previous generation first so its exit event and fds are
+        # not leaked by the dict overwrite below.
+        with self._lock:
+            old = self._procs.get(spec.task_id)
+            if old is not None and old.poll() is None:
+                self._kill_proc(spec.task_id, old)
+                try:
+                    old.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    log.warning("previous %s did not die in 5s", spec.task_id)
+            for f in self._files.pop(spec.task_id, ()):
+                f.close()
+        safe = spec.task_id.replace(":", "-")
+        out = open(os.path.join(spec.log_dir, f"{safe}.stdout"), "ab")
+        err = open(os.path.join(spec.log_dir, f"{safe}.stderr"), "ab")
+        env = with_framework_path(dict(os.environ))
+        env.update(spec.env)
+        proc = subprocess.Popen(
+            ["bash", "-c", spec.command], env=env, stdout=out, stderr=err,
+            cwd=spec.cwd or None,
+            start_new_session=True)  # own process group → clean group kill
+        with self._lock:
+            self._procs[spec.task_id] = proc
+            self._files[spec.task_id] = [out, err]
+            self._reported.discard(spec.task_id)
+            self._killed.discard(spec.task_id)
+        log.info("launched %s as pid %d", spec.task_id, proc.pid)
+
+    def poll_completed(self) -> list[CompletionEvent]:
+        events = []
+        with self._lock:
+            for task_id, proc in self._procs.items():
+                if task_id in self._reported:
+                    continue
+                code = proc.poll()
+                if code is None:
+                    continue
+                self._reported.add(task_id)
+                for f in self._files.pop(task_id, ()):
+                    f.close()
+                # Tasks we killed ourselves (session reset / worker
+                # termination chaos) are reported as preempted so the
+                # coordinator can distinguish them from user-code crashes.
+                events.append(CompletionEvent(
+                    task_id, code, preempted=task_id in self._killed))
+        return events
+
+    def _kill_proc(self, task_id: str, proc: subprocess.Popen) -> None:
+        if proc.poll() is not None:
+            return
+        self._killed.add(task_id)
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def kill_task(self, task_id: str) -> None:
+        with self._lock:
+            proc = self._procs.get(task_id)
+            if proc:
+                self._kill_proc(task_id, proc)
+
+    def kill_all(self) -> None:
+        with self._lock:
+            for task_id, proc in self._procs.items():
+                self._kill_proc(task_id, proc)
+
+    def stop(self) -> None:
+        self.kill_all()
+        with self._lock:
+            for proc in self._procs.values():
+                try:
+                    proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    pass
+            for files in self._files.values():
+                for f in files:
+                    f.close()
+            self._files.clear()

@@ -1,0 +1,85 @@
+"""Command-line submitters.
+
+Analog of the reference's tony-cli module (reference: tony-cli/src/main/java/
+com/linkedin/tony/cli/ClusterSubmitter.java:37-88, LocalSubmitter.java:33-71,
+NotebookSubmitter.java:43-126). One binary, subcommand per submitter:
+
+  python -m tony_tpu.client.cli submit  --src_dir src --executes 'python m.py' \\
+      --conf tony.worker.instances=2 [--conf_file tony.xml]
+  python -m tony_tpu.client.cli local    ... (forces the local backend —
+      the zero-install LocalSubmitter experience)
+  python -m tony_tpu.client.cli notebook --executes 'jupyter lab' (single-node
+      notebook job with a long default timeout)
+
+CLI option names follow the reference's common options
+(Utils.getCommonOptions:234: --conf, --conf_file, --src_dir, --executes,
+--python_venv, --shell_env, --task_params)."""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from tony_tpu import constants
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig, parse_cli_confs
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tony", description="TPU-native distributed ML job orchestrator")
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name, help_text in (
+            ("submit", "submit a job (ClusterSubmitter analog)"),
+            ("local", "submit forcing the local subprocess backend"),
+            ("notebook", "run a single-node notebook job")):
+        p = sub.add_parser(name, help=help_text)
+        p.add_argument("--executes", required=(name != "notebook"),
+                       default="jupyter lab" if name == "notebook" else None,
+                       help="command each task runs (the training script)")
+        p.add_argument("--conf_file", help="job config (tony.xml or k=v file)")
+        p.add_argument("--conf", action="append", default=[],
+                       help="config override key=value (repeatable)")
+        p.add_argument("--src_dir", help="source tree staged to every task")
+        p.add_argument("--python_venv", help="venv zip staged to every task")
+        p.add_argument("--shell_env", action="append", default=[],
+                       help="extra env forwarded to tasks (k=v, repeatable)")
+        p.add_argument("--task_params", default="",
+                       help="extra args appended to --executes")
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    args = build_parser().parse_args(argv)
+    overrides = parse_cli_confs(args.conf)
+    conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
+    if args.python_venv:
+        conf.set(K.PYTHON_VENV_KEY, args.python_venv)
+    if args.command == "local":
+        conf.set(K.SCHEDULER_BACKEND_KEY, "local")
+    elif args.command == "notebook":
+        # Single-node, long-lived (reference: NotebookSubmitter 24h timeout)
+        conf.set(K.APPLICATION_SINGLE_NODE_KEY, "true")
+        if K.instances_key(constants.NOTEBOOK_JOB_NAME) not in conf:
+            conf.set(K.instances_key(constants.NOTEBOOK_JOB_NAME), "1")
+        if conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) == 0:
+            conf.set(K.APPLICATION_TIMEOUT_KEY, str(24 * 3600 * 1000))
+    command = args.executes
+    if args.task_params:
+        command = f"{command} {args.task_params}"
+    shell_env = {}
+    for pair in args.shell_env:
+        k, _, v = pair.partition("=")
+        shell_env[k] = v
+    client = TonyClient(conf, command, src_dir=args.src_dir,
+                        shell_env=shell_env)
+    return client.run()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

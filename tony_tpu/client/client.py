@@ -1,0 +1,224 @@
+"""Job-submission client.
+
+TPU-native rebuild of the reference's ``TonyClient`` (reference: tony-core/
+src/main/java/com/linkedin/tony/TonyClient.java:139-720). Same flow:
+
+1. build the final layered config and freeze it as ``tony-final.xml``
+   (``initTonyConf:364``, written :186-192)
+2. stage the user's source tree (and optional venv) into a per-application
+   job directory — the ``.tony/<appId>`` HDFS staging dir analog (:163-185)
+3. launch the coordinator (the AM-launch ``createAMContainerSpec:386`` +
+   YARN ``submitApplication``; here a subprocess or a TPU VM)
+4. poll status + print task log URLs (``monitorApplication:572``), with a
+   client-side timeout kill (:606-613)
+5. signal ``finishApplication`` so the coordinator can exit (:710), and
+   relaunch the coordinator on crash — the YARN max-app-attempts analog
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import time
+import uuid
+
+from tony_tpu import constants
+from tony_tpu.cluster.coordinator import COORDINATOR_ADDR_FILE, FINAL_STATUS_FILE
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+import json
+
+from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
+from tony_tpu.utils.env import with_framework_path
+
+log = logging.getLogger("tony_tpu.client")
+
+
+def new_app_id() -> str:
+    """application_<ts>_<rand> — shaped like a YARN application id."""
+    return f"application_{int(time.time() * 1000)}_{uuid.uuid4().hex[:6]}"
+
+
+class TonyClient:
+    POLL_PERIOD_S = 0.3
+
+    def __init__(self, conf: TonyConfig, task_command: str,
+                 src_dir: str | None = None,
+                 shell_env: dict[str, str] | None = None) -> None:
+        self.conf = conf
+        self.task_command = task_command
+        self.src_dir = src_dir
+        self.shell_env = shell_env or {}
+        self.app_id = new_app_id()
+        staging_root = (conf.get(K.STAGING_DIR_KEY) or
+                        os.path.join(os.getcwd(), constants.TONY_JOB_DIR_PREFIX))
+        self.job_dir = os.path.join(staging_root, self.app_id)
+        self.timeout_s = conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) / 1000.0
+        self.am_proc: subprocess.Popen | None = None
+        self.rpc: ApplicationRpcClient | None = None
+        self._printed_urls = False
+
+    # ------------------------------------------------------------------
+    def stage(self) -> None:
+        """Create the job dir and localize sources (reference :163-192)."""
+        os.makedirs(self.job_dir, exist_ok=True)
+        os.makedirs(os.path.join(self.job_dir, constants.TONY_LOG_DIR),
+                    exist_ok=True)
+        if self.src_dir:
+            dst = os.path.join(self.job_dir,
+                               os.path.basename(os.path.normpath(self.src_dir)))
+            shutil.copytree(self.src_dir, dst, dirs_exist_ok=True)
+        venv = self.conf.get(K.PYTHON_VENV_KEY)
+        if venv and os.path.exists(venv):
+            shutil.copy(venv, os.path.join(self.job_dir, constants.TONY_VENV_ZIP))
+        self.conf.write_xml(os.path.join(self.job_dir, constants.TONY_FINAL_XML))
+
+    def launch_coordinator(self, attempt: int) -> None:
+        """Start the coordinator process (the AM launch, reference
+        buildCommand:430)."""
+        cmd = [sys.executable, "-m", "tony_tpu.cluster.coordinator",
+               "--conf_file", os.path.join(self.job_dir, constants.TONY_FINAL_XML),
+               "--app_id", self.app_id,
+               "--job_dir", self.job_dir,
+               "--task_command", self.task_command]
+        env = with_framework_path(dict(os.environ))
+        env.update(self.shell_env)
+        env[constants.ATTEMPT_NUMBER] = str(attempt)
+        logs = os.path.join(self.job_dir, constants.TONY_LOG_DIR)
+        out = open(os.path.join(logs, "am.stdout"), "ab")
+        err = open(os.path.join(logs, "am.stderr"), "ab")
+        self.am_proc = subprocess.Popen(cmd, env=env, cwd=self.job_dir,
+                                        stdout=out, stderr=err)
+        log.info("launched coordinator attempt %d as pid %d", attempt,
+                 self.am_proc.pid)
+
+    def _read_coordinator_addr(self) -> str | None:
+        """Non-blocking read of the coordinator's published RPC address."""
+        path = os.path.join(self.job_dir, COORDINATOR_ADDR_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return f.read().strip() or None
+
+    def _wait_for_coordinator_addr(self, timeout_s: float = 30.0) -> str | None:
+        deadline = time.monotonic() + timeout_s
+        while True:
+            addr = self._read_coordinator_addr()
+            if addr:
+                return addr
+            if self.am_proc and self.am_proc.poll() is not None:
+                return None
+            if time.monotonic() >= deadline:
+                return None
+            time.sleep(0.1)
+
+    def _read_final_status(self) -> dict | None:
+        path = os.path.join(self.job_dir, FINAL_STATUS_FILE)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+
+    def _print_task_urls(self) -> None:
+        if self._printed_urls or not self.rpc:
+            return
+        try:
+            urls = self.rpc.get_task_urls()
+        except Exception:
+            return
+        if urls:
+            self._printed_urls = True
+            for u in urls:
+                log.info("task %s:%s logs: %s", u.name, u.index, u.url)
+
+    # ------------------------------------------------------------------
+    def monitor(self) -> int:
+        """Poll until the job finishes (reference: monitorApplication:572).
+        Returns the process-style exit code (0 success)."""
+        started = time.monotonic()
+        while True:
+            time.sleep(self.POLL_PERIOD_S)
+            final = self._read_final_status()
+            if final is not None:
+                status = final["status"]
+                log.info("application %s finished: %s %s", self.app_id, status,
+                         final.get("message", ""))
+                self._signal_finish()
+                return 0 if status == "SUCCEEDED" else 1
+            if self.timeout_s > 0 and time.monotonic() - started > self.timeout_s:
+                log.error("client-side timeout after %.0fs — killing job",
+                          self.timeout_s)
+                self.kill()
+                return 1
+            if self.am_proc and self.am_proc.poll() is not None:
+                # Coordinator died without a final status — crash.
+                return self._handle_am_crash()
+            if self.rpc is None:
+                addr = self._read_coordinator_addr()
+                if addr:
+                    self.rpc = ApplicationRpcClient(addr)
+            self._print_task_urls()
+
+    def _handle_am_crash(self) -> int:
+        """Coordinator crash → relaunch with attempt+1 if retries remain (the
+        YARN max-app-attempts analog driving the TEST_AM_CRASH E2E)."""
+        retries = self.conf.get_int(K.AM_RETRY_COUNT_KEY, 0)
+        self._attempt = getattr(self, "_attempt", 0) + 1
+        if self._attempt > retries:
+            log.error("coordinator exited with %s and no final status — FAILED",
+                      self.am_proc.returncode)
+            return 1
+        log.warning("coordinator crashed (attempt %d/%d) — relaunching",
+                    self._attempt, retries)
+        for stale in (COORDINATOR_ADDR_FILE,):
+            p = os.path.join(self.job_dir, stale)
+            if os.path.exists(p):
+                os.remove(p)
+        self.rpc = None
+        self._printed_urls = False
+        self.launch_coordinator(self._attempt)
+        return self.monitor()
+
+    def _signal_finish(self) -> None:
+        """Let the coordinator exit (reference: TonyClient.main:710 finally
+        calls amRpcClient.finishApplication())."""
+        if self.rpc is None:
+            addr = self._wait_for_coordinator_addr(timeout_s=1)
+            if addr:
+                self.rpc = ApplicationRpcClient(addr)
+        if self.rpc:
+            try:
+                self.rpc.finish_application()
+            except Exception:
+                pass
+        if self.am_proc:
+            try:
+                self.am_proc.wait(timeout=40)
+            except subprocess.TimeoutExpired:
+                self.am_proc.kill()
+
+    def kill(self) -> None:
+        if self.am_proc and self.am_proc.poll() is None:
+            self.am_proc.terminate()
+            try:
+                self.am_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                self.am_proc.kill()
+
+    # ------------------------------------------------------------------
+    def run(self) -> int:
+        """Submit and babysit to completion (reference: run:139)."""
+        self.stage()
+        self._attempt = 0
+        self.launch_coordinator(0)
+        addr = self._wait_for_coordinator_addr()
+        if addr:
+            self.rpc = ApplicationRpcClient(addr)
+            log.info("coordinator up at %s; job dir %s", addr, self.job_dir)
+        try:
+            return self.monitor()
+        finally:
+            self.kill()

@@ -1,0 +1,421 @@
+"""The job coordinator: schedules, monitors, retries the distributed job.
+
+TPU-native rebuild of the reference's ``TonyApplicationMaster`` (reference:
+tony-core/src/main/java/com/linkedin/tony/TonyApplicationMaster.java:200-1183).
+Structure kept one-for-one where it is load-bearing:
+
+- ``init``/``prepare``: load the frozen config, build the session, start the
+  control-plane RPC server (random 10000-15000 port) and event handler
+  (:200, :420-463)
+- ``start``/``schedule_tasks``: bind tasks to backend allocations and launch
+  executors (:520-566); the YARN AMRMClient/NMClient pair collapses into the
+  pluggable SchedulerBackend
+- ``monitor``: the 0.5s control loop breaking on timeout / client stop /
+  training finished / missed heartbeat / all-tracked-done (:591-646)
+- retry loop: on failure with retries left, kill everything, rebuild the
+  session with session_id+1, relaunch (:351-377, reset:570-585)
+- ``stop``: emit APPLICATION_FINISHED, wait up to 30s for the client's
+  finishApplication signal, write the final-status file (:669-694)
+
+The coordinator's RPC address is published to the client via
+``coordinator.addr`` in the job dir (the YARN application-report channel the
+reference used does not exist here). Chaos hooks TEST_AM_CRASH and
+TEST_WORKER_TERMINATION are honored in production code (reference :352-357,
+:1169-1180)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import shlex
+import socket
+import sys
+import threading
+import time
+
+from tony_tpu import constants
+from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
+from tony_tpu.backend.local import LocalBackend
+from tony_tpu.cluster.liveness import HeartbeatMonitor
+from tony_tpu.cluster.session import (Session, SessionStatus, TaskStatus,
+                                      next_session)
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events import events as ev
+from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
+                                  WorkerSpecResponse)
+
+log = logging.getLogger("tony_tpu.coordinator")
+
+COORDINATOR_ADDR_FILE = "coordinator.addr"
+FINAL_STATUS_FILE = "final-status.json"
+
+
+def make_backend(conf: TonyConfig, app_id: str = "app") -> SchedulerBackend:
+    name = (conf.get(K.SCHEDULER_BACKEND_KEY) or "local").lower()
+    if name == "local":
+        return LocalBackend()
+    if name == "tpu":
+        from tony_tpu.backend.tpu import TpuSliceBackend
+        return TpuSliceBackend(conf, app_id=app_id)
+    raise ValueError(f"unknown scheduler backend: {name}")
+
+
+class CoordinatorRpc(ApplicationRpc):
+    """RPC facade over the coordinator (reference: inner RpcForClient:772)."""
+
+    def __init__(self, coordinator: "Coordinator") -> None:
+        self.co = coordinator
+
+    def get_task_urls(self) -> list[TaskUrl]:
+        return [TaskUrl(n, i, u) for n, i, u in self.co.session.task_urls()]
+
+    def get_cluster_spec(self, task_id: str) -> str:
+        if not self.co.session.barrier_released():
+            return ""
+        return self.co.session.bootstrap_payload()["cluster_spec"]
+
+    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
+        return self.co.on_register_worker_spec(worker, spec)
+
+    def register_tensorboard_url(self, spec: str) -> str:
+        self.co.tensorboard_url = spec
+        log.info("TensorBoard URL registered: %s", spec)
+        return spec
+
+    def register_execution_result(self, exit_code: int, job_name: str,
+                                  job_index: str, session_id: str) -> str:
+        # Informational early signal; process exit stays authoritative
+        # (reference: RpcForClient.registerExecutionResult + container
+        # completion both feed onTaskCompleted).
+        self.co.record_completion(
+            job_name, job_index, exit_code,
+            session_id=int(session_id) if session_id else None)
+        return "RECEIVED"
+
+    def finish_application(self) -> str:
+        self.co.client_signalled_finish.set()
+        return self.co.final_status or "RUNNING"
+
+    def task_executor_heartbeat(self, task_id: str) -> None:
+        self.co.hb_monitor.ping(task_id)
+
+    def get_application_status(self) -> ApplicationStatus:
+        if self.co.final_status:
+            return ApplicationStatus(self.co.final_status,
+                                     self.co.failure_message or "",
+                                     self.co.session.session_id)
+        return ApplicationStatus("RUNNING", "", self.co.session.session_id)
+
+
+class Coordinator:
+    MONITOR_PERIOD_S = 0.2
+
+    def __init__(self, conf: TonyConfig, app_id: str, job_dir: str) -> None:
+        self.conf = conf
+        self.app_id = app_id
+        self.job_dir = os.path.abspath(job_dir)
+        self.log_dir = os.path.join(self.job_dir, constants.TONY_LOG_DIR)
+        self.session = Session(conf, session_id=0)
+        self.backend = make_backend(conf, app_id)
+        self.tensorboard_url: str | None = None
+        self.final_status: str | None = None
+        self.failure_message: str | None = None
+        self.client_signalled_finish = threading.Event()
+        self.task_missed_hb = threading.Event()
+        self._completion_lock = threading.Lock()
+        self.retries_left = conf.get_int(K.AM_RETRY_COUNT_KEY, 0)
+        self.timeout_s = conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) / 1000.0
+        self.hb_monitor = HeartbeatMonitor(
+            conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000),
+            conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS_KEY, 25),
+            self._on_task_dead)
+        self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self))
+        history_dir = (conf.get(K.HISTORY_INTERMEDIATE_KEY) or
+                       os.path.join(self.job_dir, "history"))
+        self.events = ev.EventHandler(history_dir, app_id,
+                                      os.environ.get("USER", "unknown"))
+        self._workers_terminated = False
+
+    # ------------------------------------------------------------------
+    # RPC-driven hooks
+    # ------------------------------------------------------------------
+    def on_register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
+        try:
+            task = self.session.get_task_by_id(worker)
+        except (KeyError, IndexError):
+            log.warning("registration from unknown task %r ignored", worker)
+            return WorkerSpecResponse()
+        first_registration = not task.registered
+        payload = self.session.register_task_spec(worker, spec)
+        if not first_registration:
+            # Barrier re-polls count as liveness: an executor waiting at the
+            # gang barrier has no Heartbeater yet, and slow allocations
+            # elsewhere must not expire it.
+            self.hb_monitor.ping(worker)
+        else:
+            self.hb_monitor.register(worker)
+            self.events.emit(ev.TASK_REGISTERED, task=worker, spec=spec,
+                             session_id=self.session.session_id)
+            self.session.set_task_url(
+                task.job_type, task.index,
+                "file://" + os.path.join(
+                    self.log_dir, f"{worker.replace(':', '-')}.stdout"))
+            # Chaos: kill the non-chief workers once the chief registers
+            # (reference: TonyApplicationMaster.java:1169-1180) — simulates
+            # losing part of the gang.
+            if (os.environ.get(constants.TEST_WORKER_TERMINATION)
+                    and self.session.is_chief(task.job_type, task.index)
+                    and not self._workers_terminated):
+                self._workers_terminated = True
+                threading.Thread(target=self._terminate_workers,
+                                 daemon=True).start()
+        if payload is None:
+            return WorkerSpecResponse()
+        return WorkerSpecResponse(
+            spec=payload["cluster_spec"],
+            coordinator_address=payload["coordinator_address"],
+            process_id=self.session.process_id_of(worker),
+            num_processes=payload["num_processes"],
+            mesh_spec=payload["mesh_spec"])
+
+    def _terminate_workers(self) -> None:
+        time.sleep(0.5)
+        for task in self.session.all_tasks():
+            if not self.session.is_chief(task.job_type, task.index) \
+                    and self.session.is_tracked(task.job_type):
+                log.info("chaos: terminating %s", task.task_id)
+                self.backend.kill_task(task.task_id)
+
+    def _on_task_dead(self, task_id: str) -> None:
+        """Missed-heartbeat expiry (reference: onTaskDeemedDead:1155-1165)."""
+        self.session.on_task_deemed_dead(task_id)
+        self.task_missed_hb.set()
+
+    # ------------------------------------------------------------------
+    # Scheduling
+    # ------------------------------------------------------------------
+    def _executor_command(self, user_command: str) -> str:
+        """Build the executor launch command (reference: TonySession.
+        getTaskCommand:72 builds 'java ... TaskExecutor --am_address ...
+        --task_command ...')."""
+        conf_path = os.path.join(self.job_dir, constants.TONY_FINAL_XML)
+        addr = f"{socket.gethostname()}:{self.rpc_server.port}"
+        python = (self.conf.get(K.PYTHON_BINARY_PATH_KEY) or sys.executable)
+        return (f"{python} -m tony_tpu.cluster.executor "
+                f"--am_address {addr} "
+                f"--conf_file {shlex.quote(conf_path)} "
+                f"--task_command {shlex.quote(user_command)}")
+
+    def schedule_tasks(self, user_command: str) -> None:
+        """Bind every task to an allocation and launch it (reference:
+        scheduleTasks:549 + ContainerLauncher.run:1080)."""
+        requests = self.session.requests
+        for job_type, request in requests.items():
+            while True:
+                task = self.session.next_allocation(job_type)
+                if task is None:
+                    break
+                env = {
+                    constants.JOB_NAME: task.job_type,
+                    constants.TASK_INDEX: str(task.index),
+                    constants.TASK_NUM: str(request.instances),
+                    constants.SESSION_ID: str(self.session.session_id),
+                    constants.ATTEMPT_NUMBER: os.environ.get(
+                        constants.ATTEMPT_NUMBER, "0"),
+                }
+                env.update(request.env)
+                self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
+                                 session_id=self.session.session_id)
+                self.backend.launch_task(LaunchSpec(
+                    task_id=task.task_id,
+                    command=self._executor_command(user_command),
+                    env=env,
+                    log_dir=self.log_dir,
+                    cwd=self.job_dir,
+                    memory_mb=request.memory_mb,
+                    vcores=request.vcores,
+                    gpus=request.gpus,
+                    tpus=request.tpus,
+                    tpu_topology=request.tpu_topology))
+
+    # ------------------------------------------------------------------
+    # Monitor loop
+    # ------------------------------------------------------------------
+    def record_completion(self, job_type: str, index: int | str,
+                          exit_code: int, preempted: bool = False,
+                          session_id: int | None = None) -> None:
+        """Single funnel for task completion from BOTH sources — the
+        executor's RPC result and the backend's process-exit observation —
+        so state transition and the TASK_FINISHED event happen exactly once
+        whichever arrives first. The check-then-act is serialized by
+        ``_completion_lock`` (RPC threads race the monitor thread here)."""
+        with self._completion_lock:
+            try:
+                task = self.session.get_task(job_type, index)
+            except (KeyError, IndexError):
+                return
+            if session_id is not None and session_id != self.session.session_id:
+                return
+            already_done = task.completed
+            self.session.on_task_completed(job_type, index, exit_code,
+                                           session_id=session_id)
+            if not already_done and task.completed:
+                self.hb_monitor.unregister(task.task_id)
+                self.events.emit(ev.TASK_FINISHED, task=task.task_id,
+                                 exit_code=task.exit_code, preempted=preempted,
+                                 session_id=self.session.session_id)
+
+    def _apply_completions(self, completions: list[CompletionEvent]) -> None:
+        for c in completions:
+            jt, _, idx = c.task_id.partition(":")
+            log.info("task %s exited with code %d%s", c.task_id, c.exit_code,
+                     " (preempted)" if c.preempted else "")
+            self.hb_monitor.unregister(c.task_id)
+            self.record_completion(jt, idx, c.exit_code, preempted=c.preempted)
+
+    def monitor(self, started_at: float) -> SessionStatus:
+        """The hot control loop (reference: monitor:591-646)."""
+        while True:
+            time.sleep(self.MONITOR_PERIOD_S)
+            self._apply_completions(self.backend.poll_completed())
+            if self.timeout_s > 0 and time.monotonic() - started_at > self.timeout_s:
+                self.failure_message = (
+                    f"application timed out after {self.timeout_s:.0f}s")
+                self.session.status = SessionStatus.FAILED
+                return SessionStatus.FAILED
+            if self.client_signalled_finish.is_set():
+                return self.session.update_session_status()
+            if self.task_missed_hb.is_set():
+                return SessionStatus.FAILED
+            if self.session.training_finished():
+                return self.session.status
+
+    # ------------------------------------------------------------------
+    # Lifecycle
+    # ------------------------------------------------------------------
+    def run(self, user_command: str) -> int:
+        self.events.start()
+        self.rpc_server.start()
+        self.hb_monitor.start()
+        addr = f"{socket.gethostname()}:{self.rpc_server.port}"
+        addr_path = os.path.join(self.job_dir, COORDINATOR_ADDR_FILE)
+        tmp = addr_path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write(addr)
+        os.replace(tmp, addr_path)  # atomic: client never reads a partial file
+        log.info("coordinator %s serving on %s", self.app_id, addr)
+        self.events.emit(ev.APPLICATION_INITED, app_id=self.app_id,
+                         num_tasks=self.session.total_tasks(),
+                         host=socket.gethostname())
+
+        # Chaos: coordinator suicide before any task is scheduled (reference:
+        # TEST_AM_CRASH, TonyApplicationMaster.java:352-357 returns false
+        # before start()). The client observes a dead coordinator with no
+        # final status and fails (or relaunches if retries remain).
+        if os.environ.get(constants.TEST_AM_CRASH) == "true":
+            log.error("chaos: TEST_AM_CRASH set — exiting hard")
+            os._exit(3)
+
+        status = SessionStatus.FAILED
+        while True:
+            started = time.monotonic()
+            self.schedule_tasks(user_command)
+            status = self.monitor(started)
+            if status is SessionStatus.SUCCEEDED or self.retries_left <= 0 \
+                    or self.client_signalled_finish.is_set() \
+                    or (self.timeout_s > 0
+                        and time.monotonic() - started > self.timeout_s):
+                break
+            # reset (reference: reset:570-585): stop everything, new session
+            self.retries_left -= 1
+            log.warning("session %d failed (%s) — retrying (%d retries left)",
+                        self.session.session_id, self.session.failure_message,
+                        self.retries_left)
+            self.backend.kill_all()
+            # drain completion events from the killed generation so they are
+            # not misattributed to the new session
+            deadline = time.monotonic() + 10
+            while any(not t.completed for t in self.session.all_tasks()
+                      if t.status is not TaskStatus.NEW) \
+                    and time.monotonic() < deadline:
+                self._apply_completions(self.backend.poll_completed())
+                time.sleep(0.1)
+            self.hb_monitor.reset()
+            self.task_missed_hb.clear()
+            self.events.emit(ev.SESSION_RESET,
+                             old_session_id=self.session.session_id)
+            self.session = next_session(self.session)
+
+        return self.stop(status)
+
+    def stop(self, status: SessionStatus) -> int:
+        self.final_status = status.value
+        self.failure_message = self.failure_message or self.session.failure_message
+        log.info("application finished: %s (%s)", self.final_status,
+                 self.failure_message or "ok")
+        # Final-status file FIRST — it is the client's authoritative signal,
+        # so the client is not kept waiting on our teardown.
+        final = {"status": self.final_status,
+                 "message": self.failure_message or "",
+                 "app_id": self.app_id,
+                 "tensorboard_url": self.tensorboard_url or ""}
+        tmp = os.path.join(self.job_dir, FINAL_STATUS_FILE + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(final, f)
+        os.replace(tmp, os.path.join(self.job_dir, FINAL_STATUS_FILE))
+        self.backend.kill_all()
+        self.backend.stop()
+        self.hb_monitor.stop()
+        self.events.emit(
+            ev.APPLICATION_FINISHED, app_id=self.app_id,
+            status=self.final_status,
+            failed_tasks=[t.task_id for t in self.session.all_tasks()
+                          if t.status is TaskStatus.FAILED],
+            metrics={})
+        self.events.stop(self.final_status)
+        # Wait briefly for the client's finish signal (reference: stop:669-694
+        # polls up to 30s for finishApplication), then stop serving RPC.
+        self.client_signalled_finish.wait(
+            timeout=5 if os.environ.get("TONY_TEST_MODE") else 30)
+        self.rpc_server.stop()
+        return 0 if status is SessionStatus.SUCCEEDED else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    parser = argparse.ArgumentParser(prog="tony-coordinator")
+    parser.add_argument("--conf_file", required=True)
+    parser.add_argument("--app_id", required=True)
+    parser.add_argument("--job_dir", required=True)
+    parser.add_argument("--task_command", required=True)
+    args = parser.parse_args(argv)
+    conf = TonyConfig.from_file(args.conf_file)
+    coordinator = Coordinator(conf, args.app_id, args.job_dir)
+
+    def _terminate(signum, frame):
+        # Client timeout kill / Ctrl-C: executors and user processes run in
+        # their own process groups, so without this sweep they would outlive
+        # the coordinator (the reference relies on YARN reclaiming
+        # containers; here we are the reaper).
+        log.warning("received signal %d — killing all tasks and exiting",
+                    signum)
+        try:
+            coordinator.failure_message = f"killed by signal {signum}"
+            coordinator.stop(SessionStatus.KILLED)
+        finally:
+            os._exit(1)
+
+    import signal as _signal
+    _signal.signal(_signal.SIGTERM, _terminate)
+    _signal.signal(_signal.SIGINT, _terminate)
+    return coordinator.run(args.task_command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

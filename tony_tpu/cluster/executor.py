@@ -1,0 +1,263 @@
+"""Per-host task executor: the agent that wraps the user training process.
+
+TPU-native rebuild of the reference's ``TaskExecutor`` (reference: tony-core/
+src/main/java/com/linkedin/tony/TaskExecutor.java:83-343). Lifecycle kept
+one-for-one: reserve a data-plane port → register with the coordinator and
+poll the gang barrier → export the framework runtime environment → fork-exec
+the user command → heartbeat on a schedule → report the exit code and exit
+with it (the process exit status stays the authoritative result, as in the
+reference where the YARN container exit code is what the AM trusts).
+
+The framework env switch (reference :131-154) gains a JAX arm — the TPU-first
+default — exporting everything ``tony_tpu.runtime.initialize()`` needs for
+``jax.distributed.initialize``: coordinator address (process 0's endpoint),
+dense process id, process count, and the mesh spec. TF_CONFIG and
+RANK/WORLD/INIT_METHOD arms are kept for reference-parity.
+
+Chaos hooks (TEST_TASK_EXECUTOR_HANG / _NUM_HB_MISS / _SKEW) are read by this
+production code exactly as in the reference (TaskExecutor.java:238-340) so the
+E2E suite can drive failure paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+from tony_tpu import constants
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
+
+log = logging.getLogger("tony_tpu.executor")
+
+
+def reserve_port() -> int:
+    """Reserve a free port for the task's data plane (the jax.distributed
+    coordinator service when this task becomes process 0). Reference reserves
+    via ServerSocket(0) then releases (TaskExecutor.java:69-81)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class Heartbeater(threading.Thread):
+    """1s-period heartbeat sender (reference: TaskExecutor.Heartbeater:234-273).
+    Dies — taking the whole executor with it — after 5 consecutive failed
+    sends. Supports the TEST_TASK_EXECUTOR_NUM_HB_MISS chaos hook (skip the
+    first N pings to trigger coordinator-side expiry)."""
+
+    MAX_CONSECUTIVE_FAILURES = 5
+
+    def __init__(self, rpc: ApplicationRpcClient, task_id: str,
+                 interval_s: float) -> None:
+        super().__init__(name="heartbeater", daemon=True)
+        self.rpc = rpc
+        self.task_id = task_id
+        self.interval_s = interval_s
+        self.stop_event = threading.Event()
+        self.skip_remaining = int(
+            os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
+        self._failures = 0
+
+    def run(self) -> None:
+        while not self.stop_event.wait(self.interval_s):
+            if self.skip_remaining > 0:
+                self.skip_remaining -= 1
+                log.info("chaos: skipping heartbeat (%d more to skip)",
+                         self.skip_remaining)
+                continue
+            try:
+                self.rpc.task_executor_heartbeat(self.task_id)
+                self._failures = 0
+            except Exception:  # any send failure counts
+                self._failures += 1
+                log.warning("heartbeat send failure %d/%d", self._failures,
+                            self.MAX_CONSECUTIVE_FAILURES)
+                if self._failures >= self.MAX_CONSECUTIVE_FAILURES:
+                    log.error("too many heartbeat failures — exiting")
+                    os._exit(constants.EXIT_FAILURE & 0xFF)
+
+
+class TaskExecutor:
+    def __init__(self, am_address: str, task_command: str,
+                 conf: TonyConfig, shell_env: dict[str, str]) -> None:
+        self.am_address = am_address
+        self.task_command = task_command
+        self.conf = conf
+        self.shell_env = shell_env
+        self.job_name = os.environ[constants.JOB_NAME]
+        self.task_index = int(os.environ[constants.TASK_INDEX])
+        self.task_num = int(os.environ[constants.TASK_NUM])
+        self.session_id = os.environ.get(constants.SESSION_ID, "0")
+        self.task_id = f"{self.job_name}:{self.task_index}"
+        self.data_port = reserve_port()
+        self.tb_port = reserve_port()
+        self.rpc = ApplicationRpcClient.get_instance(am_address)
+        self.hb_interval_s = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000) / 1000.0
+        self.registration_timeout_s = conf.get_int(
+            K.TASK_REGISTRATION_TIMEOUT_KEY, 300000) / 1000.0
+        self.bootstrap: dict | None = None
+
+    # ------------------------------------------------------------------
+    def register_and_get_cluster_spec(self) -> dict:
+        """Register our endpoint, then poll until the gang barrier releases
+        (reference: registerAndGetClusterSpec:196-212 polls until non-null)."""
+        host = socket.gethostname()
+        spec = f"{host}:{self.data_port}"
+        deadline = time.monotonic() + self.registration_timeout_s
+        backoff = 0.1
+        while True:
+            resp = self.rpc.register_worker_spec(self.task_id, spec)
+            if resp.released:
+                self.bootstrap = {
+                    "cluster_spec": resp.spec,
+                    "coordinator_address": resp.coordinator_address,
+                    "process_id": resp.process_id,
+                    "num_processes": resp.num_processes,
+                    "mesh_spec": resp.mesh_spec,
+                }
+                return self.bootstrap
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"gang barrier did not release within "
+                    f"{self.registration_timeout_s:.0f}s")
+            time.sleep(backoff)
+            backoff = min(backoff * 1.5, 2.0)
+
+    # ------------------------------------------------------------------
+    def framework_env(self) -> dict[str, str]:
+        """The runtime adapter switch (reference: TaskExecutor.java:131-154),
+        with JAX as the first-class TPU arm."""
+        assert self.bootstrap is not None
+        env: dict[str, str] = {
+            constants.JOB_NAME: self.job_name,
+            constants.TASK_INDEX: str(self.task_index),
+            constants.TASK_NUM: str(self.task_num),
+            constants.SESSION_ID: self.session_id,
+            constants.CLUSTER_SPEC: self.bootstrap["cluster_spec"],
+            constants.TB_PORT: str(self.tb_port),
+        }
+        framework = (self.conf.get(K.APPLICATION_FRAMEWORK_KEY) or
+                     constants.FRAMEWORK_JAX).lower()
+        cluster = json.loads(self.bootstrap["cluster_spec"])
+        if framework == constants.FRAMEWORK_JAX:
+            env[constants.JAX_COORDINATOR_ADDRESS] = self.bootstrap["coordinator_address"]
+            env[constants.JAX_PROCESS_ID] = str(self.bootstrap["process_id"])
+            env[constants.JAX_NUM_PROCESSES] = str(self.bootstrap["num_processes"])
+            env[constants.MESH_SPEC] = self.bootstrap["mesh_spec"]
+        elif framework == constants.FRAMEWORK_TENSORFLOW:
+            # TF_CONFIG assembly (reference: Utils.constructTFConfig:383)
+            env[constants.TF_CONFIG] = json.dumps({
+                "cluster": cluster,
+                "task": {"type": self.job_name, "index": self.task_index},
+            })
+        elif framework == constants.FRAMEWORK_PYTORCH:
+            # tcp:// rendezvous at the first worker (reference:
+            # Utils.parseClusterSpecForPytorch:447)
+            workers = cluster.get(constants.WORKER_JOB_NAME) or next(
+                iter(cluster.values()))
+            env[constants.INIT_METHOD] = f"tcp://{workers[0]}"
+            env[constants.RANK] = str(self.bootstrap["process_id"])
+            env[constants.WORLD] = str(self.bootstrap["num_processes"])
+        else:
+            raise ValueError(f"unsupported framework: {framework}")
+        return env
+
+    # ------------------------------------------------------------------
+    def run_user_process(self, extra_env: dict[str, str]) -> int:
+        """Fork-exec the user command via the shell, stream output, wait.
+        (reference: Utils.executeShell:263 — 'bash -c <cmd>' with timeout)."""
+        env = dict(os.environ)
+        env.update(self.shell_env)
+        env.update(extra_env)
+        timeout_s = self.conf.get_int(K.TASK_EXECUTION_TIMEOUT_KEY, 0) / 1000.0
+        log.info("launching user process: %s", self.task_command)
+        proc = subprocess.Popen(["bash", "-c", self.task_command], env=env,
+                                start_new_session=True)
+        try:
+            return proc.wait(timeout=timeout_s if timeout_s > 0 else None)
+        except subprocess.TimeoutExpired:
+            log.error("user process exceeded %.0fs timeout — killing", timeout_s)
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
+            return constants.EXIT_FAILURE
+
+    # ------------------------------------------------------------------
+    def apply_chaos_after_training(self) -> None:
+        """TEST_TASK_EXECUTOR_SKEW='job#idx#ms' and TEST_TASK_EXECUTOR_HANG
+        (reference: TaskExecutor.java:301-340)."""
+        skew = os.environ.get(constants.TEST_TASK_EXECUTOR_SKEW, "")
+        if skew:
+            try:
+                job, idx, ms = skew.split("#")
+                if job == self.job_name and int(idx) == self.task_index:
+                    log.info("chaos: skew sleep %sms", ms)
+                    time.sleep(int(ms) / 1000.0)
+            except ValueError:
+                log.warning("malformed %s: %r",
+                            constants.TEST_TASK_EXECUTOR_SKEW, skew)
+        if os.environ.get(constants.TEST_TASK_EXECUTOR_HANG):
+            log.info("chaos: hanging 20s before exit")
+            time.sleep(20)
+
+    def run(self) -> int:
+        log.info("task %s registering with coordinator %s",
+                 self.task_id, self.am_address)
+        self.register_and_get_cluster_spec()
+        heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s)
+        heartbeater.start()
+        if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
+            try:
+                host = socket.gethostname()
+                self.rpc.register_tensorboard_url(f"http://{host}:{self.tb_port}")
+            except Exception:
+                log.warning("TensorBoard URL registration failed", exc_info=True)
+        exit_code = self.run_user_process(self.framework_env())
+        self.apply_chaos_after_training()
+        heartbeater.stop_event.set()
+        try:
+            self.rpc.register_execution_result(
+                exit_code, self.job_name, str(self.task_index), self.session_id)
+        except Exception:
+            # Informational only — the process exit code is authoritative
+            # (reference: TaskExecutor.java:160-163).
+            log.warning("could not report execution result", exc_info=True)
+        return exit_code
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s: %(message)s")
+    parser = argparse.ArgumentParser(prog="tony-task-executor")
+    parser.add_argument("--am_address", required=True)
+    parser.add_argument("--task_command", required=True)
+    parser.add_argument("--conf_file", default=constants.TONY_FINAL_XML)
+    parser.add_argument("--shell_env", action="append", default=[],
+                        help="k=v pairs forwarded into the user process")
+    args = parser.parse_args(argv)
+    conf = (TonyConfig.from_file(args.conf_file)
+            if os.path.exists(args.conf_file) else TonyConfig())
+    shell_env = {}
+    for pair in args.shell_env:
+        k, _, v = pair.partition("=")
+        shell_env[k] = v
+    executor = TaskExecutor(args.am_address, args.task_command, conf, shell_env)
+    return executor.run()
+
+
+if __name__ == "__main__":
+    code = main()
+    # Container exit status is the authoritative task result
+    # (reference: TaskExecutor.java:163 System.exit(exitCode)).
+    sys.exit(code & 0xFF if code < 0 else min(code, 255))

@@ -1,0 +1,85 @@
+"""Heartbeat liveness monitoring inside the coordinator.
+
+Analog of the reference's use of Hadoop's ``AbstractLivelinessMonitor``
+(reference: TonyApplicationMaster.java:168-193 constructs the monitor with
+expiry = hb-interval * max(3, max-consecutive-missed), :811-819 receives
+pings, :1155-1165 declares tasks dead). A dead task fails the whole job —
+acceptable for gang-scheduled SPMD, where one lost process stalls every
+collective."""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable
+
+log = logging.getLogger(__name__)
+
+
+class HeartbeatMonitor:
+    """Tracks last-ping times; fires ``on_expired(task_id)`` once per task
+    whose silence exceeds ``hb_interval_ms * max(3, max_missed)``."""
+
+    def __init__(self, hb_interval_ms: int, max_missed: int,
+                 on_expired: Callable[[str], None]) -> None:
+        self.expiry_s = hb_interval_ms / 1000.0 * max(3, max_missed)
+        # Check at least 4x/s so expiry detection and shutdown joins stay
+        # snappy even with the default 1s heartbeat interval.
+        self.check_period_s = min(max(hb_interval_ms / 1000.0, 0.05), 0.25)
+        self.on_expired = on_expired
+        self._last_ping: dict[str, float] = {}
+        self._expired: set[str] = set()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def register(self, task_id: str) -> None:
+        """Start tracking a task (first ping = registration time, reference
+        :833 registers the task with the monitor when its spec arrives)."""
+        with self._lock:
+            self._last_ping[task_id] = time.monotonic()
+
+    def unregister(self, task_id: str) -> None:
+        """Stop tracking (task completed normally)."""
+        with self._lock:
+            self._last_ping.pop(task_id, None)
+            self._expired.discard(task_id)
+
+    def ping(self, task_id: str) -> None:
+        with self._lock:
+            if task_id in self._last_ping:
+                self._last_ping[task_id] = time.monotonic()
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="hb-monitor",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def reset(self) -> None:
+        """Forget all tasks (session retry rebuilds registrations)."""
+        with self._lock:
+            self._last_ping.clear()
+            self._expired.clear()
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.check_period_s):
+            now = time.monotonic()
+            newly_dead = []
+            with self._lock:
+                for task_id, last in self._last_ping.items():
+                    if task_id not in self._expired and now - last > self.expiry_s:
+                        self._expired.add(task_id)
+                        newly_dead.append(task_id)
+            for task_id in newly_dead:
+                log.warning("task %s missed heartbeats for %.1fs — deemed dead",
+                            task_id, self.expiry_s)
+                try:
+                    self.on_expired(task_id)
+                except Exception:
+                    log.exception("on_expired callback failed for %s", task_id)

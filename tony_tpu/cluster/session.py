@@ -1,0 +1,285 @@
+"""In-coordinator job state machine.
+
+TPU-native rebuild of the reference's ``TonySession`` (reference: tony-core/
+src/main/java/com/linkedin/tony/tensorflow/TonySession.java:1-539). Keeps the
+load-bearing semantics intact:
+
+- job-type → task-array bookkeeping, built from config-discovered job types
+  (``getContainersRequests:162`` → :meth:`Session.task_requests`)
+- cluster-spec assembly from registered host:port specs (``getClusterSpec:227``)
+- the registration **gang barrier**: registration returns nothing until every
+  expected task has registered (AM-side ``registerWorkerSpec:822-856``)
+- per-task exit status + final-status reduction (``onTaskCompleted:252``,
+  ``updateSessionStatus:281``)
+- chief-failure/-completion short-circuit (``:266-271``, ``isChief:365``)
+- untracked job types (ps) excluded from completion counting
+- sessions are rebuilt with ``session_id + 1`` on whole-job retry so stale
+  events from a previous attempt are ignored (``sessionId`` plumbing)
+
+TPU-first additions: on barrier release the session assigns **dense, stable
+JAX process ids** and derives the ``jax.distributed`` coordinator address from
+process 0's registered endpoint — the direct replacement for TF_CONFIG
+assembly — plus a mesh spec (axes layout) shipped to every task.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import re
+import threading
+from dataclasses import dataclass, field
+from enum import Enum
+
+from tony_tpu import constants
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TaskRequest, TonyConfig
+
+log = logging.getLogger(__name__)
+
+
+class TaskStatus(Enum):
+    NEW = "NEW"
+    SCHEDULED = "SCHEDULED"
+    REGISTERED = "REGISTERED"
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+
+
+class SessionStatus(Enum):
+    RUNNING = "RUNNING"
+    SUCCEEDED = "SUCCEEDED"
+    FAILED = "FAILED"
+    KILLED = "KILLED"
+
+
+@dataclass
+class SessionTask:
+    """One task of one job type (reference: TonySession.TonyTask:419)."""
+    job_type: str
+    index: int
+    session_id: int
+    spec: str = ""                  # "host:port" registered by the executor
+    status: TaskStatus = TaskStatus.NEW
+    exit_code: int | None = None
+    url: str = ""
+    process_id: int = -1            # dense JAX process id, assigned at barrier
+    allocation_id: int = -1         # backend allocation handle
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.job_type}:{self.index}"
+
+    @property
+    def registered(self) -> bool:
+        return bool(self.spec)
+
+    @property
+    def completed(self) -> bool:
+        return self.status in (TaskStatus.SUCCEEDED, TaskStatus.FAILED)
+
+
+class Session:
+    """State machine for one attempt of one job."""
+
+    def __init__(self, conf: TonyConfig, session_id: int = 0) -> None:
+        self.conf = conf
+        self.session_id = session_id
+        self.status = SessionStatus.RUNNING
+        self.failure_message: str | None = None
+        self._lock = threading.RLock()
+        self._chief_regex = re.compile(conf.get(K.CHIEF_REGEX_KEY) or "$^")
+        self._chief_index = conf.get_int(K.CHIEF_INDEX_KEY, 0)
+        self._untracked = conf.untracked_job_types()
+        self.requests: dict[str, TaskRequest] = conf.task_requests()
+        self.tasks: dict[str, list[SessionTask]] = {
+            jt: [SessionTask(jt, i, session_id) for i in range(req.instances)]
+            for jt, req in self.requests.items()
+        }
+        self._mesh_spec = json.dumps({"axes": conf.mesh_axes()})
+        # allocation-id → task binding (getAndInitMatchingTask:209 analog)
+        self._next_allocation_id = 0
+
+    # ------------------------------------------------------------------
+    # Introspection
+    # ------------------------------------------------------------------
+    def all_tasks(self) -> list[SessionTask]:
+        return [t for tasks in self.tasks.values() for t in tasks]
+
+    def get_task(self, job_type: str, index: int | str) -> SessionTask:
+        return self.tasks[job_type][int(index)]
+
+    def get_task_by_id(self, task_id: str) -> SessionTask:
+        jt, _, idx = task_id.partition(":")
+        return self.get_task(jt, idx)
+
+    def total_tasks(self) -> int:
+        return sum(len(v) for v in self.tasks.values())
+
+    def is_chief(self, job_type: str, index: int | str) -> bool:
+        """Reference: TonySession.isChief:365 — the configured chief job name
+        (regex, default ^(chief|master)$) at the chief index, or worker:0 when
+        no explicit chief type exists."""
+        if self._chief_regex.match(job_type):
+            return int(index) == self._chief_index
+        has_explicit_chief = any(self._chief_regex.match(jt) for jt in self.tasks)
+        return (not has_explicit_chief and job_type == constants.WORKER_JOB_NAME
+                and int(index) == self._chief_index)
+
+    def is_tracked(self, job_type: str) -> bool:
+        return job_type not in self._untracked
+
+    # ------------------------------------------------------------------
+    # Registration / gang barrier
+    # ------------------------------------------------------------------
+    def register_task_spec(self, task_id: str, spec: str) -> dict | None:
+        """Record a task's data-plane endpoint. Returns None until ALL tasks
+        registered; then a dict with cluster spec + JAX bootstrap. Idempotent:
+        re-registration overwrites the spec and re-returns the payload."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            task.spec = spec
+            if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
+                task.status = TaskStatus.REGISTERED
+            if not self.barrier_released():
+                return None
+            self._assign_process_ids()
+            for t in self.all_tasks():
+                if t.status is TaskStatus.REGISTERED:
+                    t.status = TaskStatus.RUNNING
+            return self.bootstrap_payload()
+
+    def barrier_released(self) -> bool:
+        return all(t.registered for t in self.all_tasks())
+
+    def _assign_process_ids(self) -> None:
+        """Dense, deterministic process ids: chief task first (JAX process 0
+        hosts the distributed coordinator service), then remaining tasks in
+        (job_type, index) order. Stable across re-registration."""
+        ordered = sorted(
+            self.all_tasks(),
+            key=lambda t: (not self.is_chief(t.job_type, t.index),
+                           t.job_type, t.index))
+        for pid, task in enumerate(ordered):
+            task.process_id = pid
+
+    def cluster_spec(self) -> dict[str, list[str]]:
+        """{"worker": ["host:port", ...], ...} (getClusterSpec:227)."""
+        return {jt: [t.spec for t in tasks] for jt, tasks in self.tasks.items()}
+
+    def coordinator_address(self) -> str:
+        """The jax.distributed coordinator endpoint = process 0's registered
+        spec (that process starts the coordination service)."""
+        for t in self.all_tasks():
+            if t.process_id == 0:
+                return t.spec
+        return ""
+
+    def bootstrap_payload(self) -> dict:
+        return {
+            "cluster_spec": json.dumps(self.cluster_spec()),
+            "coordinator_address": self.coordinator_address(),
+            "num_processes": self.total_tasks(),
+            "mesh_spec": self._mesh_spec,
+        }
+
+    def process_id_of(self, task_id: str) -> int:
+        return self.get_task_by_id(task_id).process_id
+
+    # ------------------------------------------------------------------
+    # Allocation matching (backend → task binding)
+    # ------------------------------------------------------------------
+    def next_allocation(self, job_type: str) -> SessionTask | None:
+        """Bind the next unscheduled task of ``job_type`` to a new allocation
+        (reference: getAndInitMatchingTask:209, matching by allocation
+        request id; slices/processes arrive per-job-type here)."""
+        with self._lock:
+            for t in self.tasks.get(job_type, ()):
+                if t.status == TaskStatus.NEW:
+                    t.status = TaskStatus.SCHEDULED
+                    t.allocation_id = self._next_allocation_id
+                    self._next_allocation_id += 1
+                    return t
+            return None
+
+    # ------------------------------------------------------------------
+    # Completion reduction
+    # ------------------------------------------------------------------
+    def on_task_completed(self, job_type: str, index: int | str,
+                          exit_code: int, session_id: int | None = None) -> None:
+        """Record a task exit. Mirrors TonySession.onTaskCompleted:252-276:
+        - events from a stale session (previous attempt) are ignored
+        - first failure of a *tracked* task fails the whole session
+        - chief completion short-circuits the session with the chief's status
+        """
+        with self._lock:
+            if session_id is not None and session_id != self.session_id:
+                log.info("ignoring stale completion from session %s (now %s)",
+                         session_id, self.session_id)
+                return
+            task = self.get_task(job_type, index)
+            if task.completed:  # duplicate report (RPC + process exit race)
+                return
+            task.exit_code = exit_code
+            task.status = (TaskStatus.SUCCEEDED if exit_code == 0
+                           else TaskStatus.FAILED)
+            if exit_code != 0 and self.is_tracked(job_type):
+                self.status = SessionStatus.FAILED
+                self.failure_message = (
+                    f"task {task.task_id} failed with exit code {exit_code}")
+            if self.is_chief(job_type, index):
+                # Chief done ⇒ job done, with the chief's status
+                # (reference :266-271).
+                if self.status is SessionStatus.RUNNING:
+                    self.status = (SessionStatus.SUCCEEDED if exit_code == 0
+                                   else SessionStatus.FAILED)
+
+    def on_task_deemed_dead(self, task_id: str) -> None:
+        """Missed-heartbeat expiry fails the task and thus the session
+        (reference: onTaskDeemedDead:1155-1165 — 'we just kill the job')."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if not task.completed:
+                task.status = TaskStatus.FAILED
+                task.exit_code = -1
+            self.status = SessionStatus.FAILED
+            self.failure_message = f"task {task_id} missed heartbeats, deemed dead"
+
+    def update_session_status(self) -> SessionStatus:
+        """Reduce task states to a final status once all *tracked* tasks are
+        done (reference: updateSessionStatus:281)."""
+        with self._lock:
+            if self.status is not SessionStatus.RUNNING:
+                return self.status
+            tracked = [t for t in self.all_tasks() if self.is_tracked(t.job_type)]
+            if tracked and all(t.completed for t in tracked):
+                failed = [t for t in tracked if t.status is TaskStatus.FAILED]
+                self.status = (SessionStatus.FAILED if failed
+                               else SessionStatus.SUCCEEDED)
+                if failed:
+                    self.failure_message = (
+                        f"{len(failed)} tracked task(s) failed: "
+                        + ", ".join(t.task_id for t in failed))
+            return self.status
+
+    def training_finished(self) -> bool:
+        return self.update_session_status() is not SessionStatus.RUNNING
+
+    # ------------------------------------------------------------------
+    # Task URLs
+    # ------------------------------------------------------------------
+    def set_task_url(self, job_type: str, index: int | str, url: str) -> None:
+        with self._lock:
+            self.get_task(job_type, index).url = url
+
+    def task_urls(self) -> list[tuple[str, str, str]]:
+        return [(t.job_type, str(t.index), t.url)
+                for t in self.all_tasks() if t.url]
+
+
+def next_session(prev: Session) -> Session:
+    """Build the retry session: same conf, session_id + 1 (reference:
+    TonyApplicationMaster.reset:570-585 rebuilds the session so stale
+    container events are ignored via the id check)."""
+    return Session(prev.conf, prev.session_id + 1)

@@ -1,0 +1,176 @@
+"""Job-history events: schema, async writer, filename codec, parser.
+
+Rebuild of the reference's events layer (reference: tony-core/src/main/avro/
+*.avsc schemas, events/EventHandler.java:22-134, util/HistoryFileUtils.java:
+11-32, util/ParserUtils.java). The reference appends Avro records to an
+``.jhist.inprogress`` file on HDFS from a background thread and renames it to
+``appId-started[-completed]-user-STATUS.jhist`` on completion; the history
+server replays them. We keep the exact lifecycle and filename codec but encode
+events as JSON-lines (self-describing, no Avro runtime in this image; the
+schema below mirrors Event.avsc's
+``{type, event, timestamp}`` union shape).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import re
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+
+log = logging.getLogger(__name__)
+
+# Event types (reference: EventType.avsc — APPLICATION_INITED/FINISHED; we add
+# the finer-grained task lifecycle the reference's TODOs point at).
+APPLICATION_INITED = "APPLICATION_INITED"
+APPLICATION_FINISHED = "APPLICATION_FINISHED"
+TASK_SCHEDULED = "TASK_SCHEDULED"
+TASK_REGISTERED = "TASK_REGISTERED"
+TASK_FINISHED = "TASK_FINISHED"
+SESSION_RESET = "SESSION_RESET"
+
+
+@dataclass
+class Event:
+    """Mirror of Event.avsc: {event_type, payload union, timestamp(ms)}."""
+    event_type: str
+    payload: dict = field(default_factory=dict)
+    timestamp: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), sort_keys=True)
+
+    @classmethod
+    def from_json(cls, line: str) -> "Event":
+        d = json.loads(line)
+        return cls(d["event_type"], d.get("payload", {}), d.get("timestamp", 0))
+
+
+# ---------------------------------------------------------------------------
+# Filename codec (reference: HistoryFileUtils.generateFileName:11-32):
+#   appId-started[-completed]-user[-STATUS].jhist[.inprogress]
+# ---------------------------------------------------------------------------
+_HIST_RE = re.compile(
+    r"^(?P<app>[\w\-]+?)-(?P<started>\d+)(?:-(?P<completed>\d+))?"
+    r"-(?P<user>[a-zA-Z][\w]*?)(?:-(?P<status>SUCCEEDED|FAILED|KILLED|RUNNING))?"
+    r"\.jhist(?P<inprogress>\.inprogress)?$")
+
+
+def history_file_name(app_id: str, started_ms: int, user: str,
+                      completed_ms: int | None = None,
+                      status: str | None = None,
+                      in_progress: bool = False) -> str:
+    parts = [app_id, str(started_ms)]
+    if completed_ms is not None:
+        parts.append(str(completed_ms))
+    parts.append(user)
+    if status:
+        parts.append(status)
+    name = "-".join(parts) + ".jhist"
+    return name + ".inprogress" if in_progress else name
+
+
+@dataclass
+class JobMetadata:
+    """Parsed jhist filename (reference: models/JobMetadata.java:31-44)."""
+    app_id: str
+    started_ms: int
+    user: str
+    completed_ms: int | None = None
+    status: str | None = None
+    in_progress: bool = False
+
+    @classmethod
+    def from_file_name(cls, name: str) -> "JobMetadata | None":
+        m = _HIST_RE.match(os.path.basename(name))
+        if not m:
+            return None
+        return cls(app_id=m.group("app"), started_ms=int(m.group("started")),
+                   user=m.group("user"),
+                   completed_ms=(int(m.group("completed"))
+                                 if m.group("completed") else None),
+                   status=m.group("status"),
+                   in_progress=bool(m.group("inprogress")))
+
+
+def is_valid_history_file_name(name: str) -> bool:
+    """Reference: ParserUtils.isValidHistFileName:60."""
+    return JobMetadata.from_file_name(name) is not None
+
+
+# ---------------------------------------------------------------------------
+# Async writer (reference: EventHandler.java — blocking queue drained by a
+# daemon thread into the .inprogress file; stop() drains and renames).
+# ---------------------------------------------------------------------------
+class EventHandler:
+    def __init__(self, history_dir: str, app_id: str, user: str) -> None:
+        self.history_dir = history_dir
+        self.app_id = app_id
+        self.user = user
+        self.started_ms = int(time.time() * 1000)
+        self._queue: queue.Queue[Event | None] = queue.Queue()
+        self._thread: threading.Thread | None = None
+        os.makedirs(history_dir, exist_ok=True)
+        self._inprogress_path = os.path.join(
+            history_dir,
+            history_file_name(app_id, self.started_ms, user, in_progress=True))
+        self.final_path: str | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run, name="event-handler",
+                                        daemon=True)
+        self._thread.start()
+
+    def emit(self, event_type: str, **payload) -> None:
+        self._queue.put(Event(event_type, payload, int(time.time() * 1000)))
+
+    def _run(self) -> None:
+        with open(self._inprogress_path, "a", encoding="utf-8") as f:
+            while True:
+                ev = self._queue.get()
+                if ev is None:
+                    break
+                f.write(ev.to_json() + "\n")
+                f.flush()
+
+    def stop(self, status: str) -> str:
+        """Drain queue, close, rename to final name (EventHandler.stop:125)."""
+        self._queue.put(None)
+        if self._thread:
+            self._thread.join(timeout=10)
+        completed = int(time.time() * 1000)
+        self.final_path = os.path.join(
+            self.history_dir,
+            history_file_name(self.app_id, self.started_ms, self.user,
+                              completed_ms=completed, status=status))
+        os.replace(self._inprogress_path, self.final_path)
+        return self.final_path
+
+
+def parse_events(path: str) -> list[Event]:
+    """Replay an event file (reference: ParserUtils.parseEvents:176)."""
+    events = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(Event.from_json(line))
+            except (json.JSONDecodeError, KeyError):
+                log.warning("skipping malformed event line in %s", path)
+    return events
+
+
+def find_job_files(history_dir: str) -> list[str]:
+    """Recursive jhist discovery (reference: HdfsUtils.getJobFolders:123)."""
+    out = []
+    for root, _, files in os.walk(history_dir):
+        for name in files:
+            if is_valid_history_file_name(name):
+                out.append(os.path.join(root, name))
+    return sorted(out)

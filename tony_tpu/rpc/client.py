@@ -17,7 +17,8 @@ import grpc
 
 from tony_tpu.rpc import tony_pb2 as pb
 from tony_tpu.rpc.server import SERVICE_NAME
-from tony_tpu.rpc.service import ApplicationRpc, TaskUrl, WorkerSpecResponse
+from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
+                                  WorkerSpecResponse)
 
 log = logging.getLogger(__name__)
 
@@ -68,6 +69,10 @@ class ApplicationRpcClient(ApplicationRpc):
             m + "TaskExecutorHeartbeat",
             request_serializer=pb.HeartbeatRequest.SerializeToString,
             response_deserializer=pb.HeartbeatResponse.FromString)
+        self._get_status = self._channel.unary_unary(
+            m + "GetApplicationStatus",
+            request_serializer=pb.GetApplicationStatusRequest.SerializeToString,
+            response_deserializer=pb.GetApplicationStatusResponse.FromString)
 
     @classmethod
     def get_instance(cls, address: str) -> "ApplicationRpcClient":
@@ -154,3 +159,8 @@ class ApplicationRpcClient(ApplicationRpc):
         # 264-268 dies after 5 failed sends).
         self._call(self._heartbeat, pb.HeartbeatRequest(task_id=task_id),
                    retries=2)
+
+    def get_application_status(self) -> ApplicationStatus:
+        resp = self._call(self._get_status, pb.GetApplicationStatusRequest())
+        return ApplicationStatus(status=resp.status, message=resp.message,
+                                 session_id=resp.session_id)

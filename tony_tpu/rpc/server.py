@@ -103,6 +103,11 @@ class ApplicationRpcServer:
             impl.task_executor_heartbeat(req.task_id)
             return pb.HeartbeatResponse()
 
+        def _get_status(req, ctx):
+            s = impl.get_application_status()
+            return pb.GetApplicationStatusResponse(
+                status=s.status, message=s.message, session_id=s.session_id)
+
         methods = {
             "GetTaskUrls": (_get_task_urls, pb.GetTaskUrlsRequest),
             "GetClusterSpec": (_get_cluster_spec, pb.GetClusterSpecRequest),
@@ -111,6 +116,7 @@ class ApplicationRpcServer:
             "RegisterExecutionResult": (_register_result, pb.RegisterExecutionResultRequest),
             "FinishApplication": (_finish, pb.FinishApplicationRequest),
             "TaskExecutorHeartbeat": (_heartbeat, pb.HeartbeatRequest),
+            "GetApplicationStatus": (_get_status, pb.GetApplicationStatusRequest),
         }
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(

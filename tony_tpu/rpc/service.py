@@ -24,6 +24,18 @@ class TaskUrl:
 
 
 @dataclass(frozen=True)
+class ApplicationStatus:
+    """Coordinator-served job status (replaces YARN application reports)."""
+    status: str = "RUNNING"
+    message: str = ""
+    session_id: int = 0
+
+    @property
+    def finished(self) -> bool:
+        return self.status in ("SUCCEEDED", "FAILED", "KILLED")
+
+
+@dataclass(frozen=True)
 class WorkerSpecResponse:
     """Gang-barrier response: empty ``spec`` means "not all registered yet,
     poll again"; once released it carries the cluster spec plus the JAX/TPU
@@ -64,3 +76,6 @@ class ApplicationRpc(abc.ABC):
 
     @abc.abstractmethod
     def task_executor_heartbeat(self, task_id: str) -> None: ...
+
+    @abc.abstractmethod
+    def get_application_status(self) -> ApplicationStatus: ...

@@ -1,0 +1,24 @@
+"""Process-environment helpers shared by client/coordinator/executor."""
+
+from __future__ import annotations
+
+import os
+
+import tony_tpu
+
+
+def framework_root() -> str:
+    """Directory containing the ``tony_tpu`` package (the repo root when
+    running from a checkout)."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(tony_tpu.__file__)))
+
+
+def with_framework_path(env: dict[str, str]) -> dict[str, str]:
+    """Ensure child processes can ``import tony_tpu`` regardless of their
+    working directory — the analog of the reference shipping its fat jar into
+    every container's classpath (ClusterSubmitter.java:57-66)."""
+    root = framework_root()
+    existing = env.get("PYTHONPATH", "")
+    if root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = (root + os.pathsep + existing) if existing else root
+    return env
