@@ -207,13 +207,11 @@ class TonyConfig:
         return job_type not in self.untracked_job_types()
 
     def mesh_axes(self) -> dict[str, int]:
-        """Parse tony.application.mesh: 'dp=2,tp=4' → {'dp': 2, 'tp': 4}."""
-        axes: dict[str, int] = {}
-        for part in self.get_list(K.APPLICATION_MESH_KEY):
-            name, _, size = part.partition("=")
-            if name and size:
-                axes[name.strip()] = int(size)
-        return axes
+        """Parse tony.application.mesh: 'dp=2,tp=4' → {'dp': 2, 'tp': 4}.
+        Strict — a malformed axis raises at submission time rather than
+        surfacing as a bad mesh inside every task."""
+        from tony_tpu.parallel.mesh import parse_mesh_string
+        return parse_mesh_string(self.get(K.APPLICATION_MESH_KEY, "") or "")
 
 
 def read_conf_file(path: str) -> dict[str, str]:

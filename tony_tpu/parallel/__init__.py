@@ -1,0 +1,48 @@
+"""Parallelism layer: mesh presets, sharding rules, and SPMD strategies.
+
+First-class in the TPU build (the reference delegates parallelism entirely to
+user TF/PT code — SURVEY.md §2.3): DP/FSDP/TP/SP as sharding rules over a
+global mesh, CP as ring attention, PP as a GPipe shard_map schedule, EP as
+gshard dense dispatch. All collectives are XLA-inserted (pjit) or explicit
+ppermute/psum (shard_map) riding ICI/DCN.
+"""
+
+from tony_tpu.parallel.mesh import (
+    AXIS_ORDER,
+    PRESETS,
+    make_mesh,
+    parse_mesh_string,
+)
+from tony_tpu.parallel.moe import MoEMetrics, default_capacity, moe_ffn
+from tony_tpu.parallel.pipeline import pipeline_apply
+from tony_tpu.parallel.ring_attention import (
+    ring_attention,
+    ring_attention_local,
+)
+from tony_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    constrain,
+    logical_sharding,
+    logical_to_spec,
+    param_shardings,
+    shard_pytree,
+)
+
+__all__ = [
+    "AXIS_ORDER",
+    "DEFAULT_RULES",
+    "MoEMetrics",
+    "PRESETS",
+    "constrain",
+    "default_capacity",
+    "logical_sharding",
+    "logical_to_spec",
+    "make_mesh",
+    "moe_ffn",
+    "param_shardings",
+    "parse_mesh_string",
+    "pipeline_apply",
+    "ring_attention",
+    "ring_attention_local",
+    "shard_pytree",
+]

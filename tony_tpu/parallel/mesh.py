@@ -1,0 +1,133 @@
+"""Device-mesh construction and named presets.
+
+The reference has no parallelism layer of its own — it delegates to TF/PT in
+user code and only ships host:port lists (SURVEY.md §2.3; reference:
+TonySession.getClusterSpec:227). On TPU the mesh IS the parallelism contract:
+every strategy (DP/FSDP/TP/SP/CP/PP/EP) is an axis of one global
+``jax.sharding.Mesh``, and XLA inserts the collectives (psum/all-gather/
+reduce-scatter/ppermute) that ride ICI within a slice and DCN across slices.
+This module is therefore a first-class component of the TPU build even though
+it has no direct reference analog.
+
+Canonical axis names (used by sharding rules, models, and ops):
+
+    dp    data parallelism (batch split, gradient psum)
+    fsdp  fully-sharded data parallelism (batch + param shard, same axis)
+    tp    tensor parallelism (feature/heads split inside a layer)
+    sp    sequence parallelism for norms/activations (reuses tp axis groups)
+    cp    context parallelism (sequence split for ring attention)
+    pp    pipeline parallelism (layer stages)
+    ep    expert parallelism (MoE expert split)
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "cp", "ep", "tp")
+"""Canonical major→minor ordering. Minor-most axes get neighboring devices
+(fastest ICI links), so tp — the most latency-sensitive collective group —
+is last; pp — the least chatty (point-to-point activations only) — is first
+so stages may even span DCN."""
+
+
+def make_mesh(axes: dict[str, int] | None = None,
+              devices=None,
+              axis_order: tuple[str, ...] | None = None):
+    """Build a ``jax.sharding.Mesh`` over all global devices.
+
+    ``axes`` maps axis name → size; at most one size may be -1/0 (inferred
+    from the device count). Axes of size 1 are kept, so sharding rules that
+    name them still resolve. Empty/None axes yields ``{"dp": n}``.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = np.array(jax.devices() if devices is None else devices)
+    n = devs.size
+    axes = dict(axes or {})
+    if not axes:
+        axes = {"dp": n}
+    unknown = [k for k, v in axes.items() if v in (-1, 0)]
+    known = math.prod(v for v in axes.values() if v not in (-1, 0))
+    if len(unknown) == 1:
+        if n % known:
+            raise ValueError(f"cannot infer {unknown[0]}: {n} devices not "
+                             f"divisible by {known}")
+        axes[unknown[0]] = n // known
+    elif len(unknown) > 1:
+        raise ValueError(f"at most one inferred (-1) mesh axis: {axes}")
+    total = math.prod(axes.values())
+    if total != n:
+        raise ValueError(f"mesh axes {axes} require {total} devices, have {n}")
+    if axis_order is None:
+        # canonical order first, then any custom axes in declaration order
+        names = tuple(a for a in AXIS_ORDER if a in axes)
+        names += tuple(a for a in axes if a not in names)
+    else:
+        names = tuple(axis_order)
+    shape = tuple(axes[name] for name in names)
+    return Mesh(devs.reshape(shape), names)
+
+
+def parse_mesh_string(spec: str) -> dict[str, int]:
+    """Parse the ``tony.application.mesh`` config value: "dp=2,tp=4" →
+    {"dp": 2, "tp": 4}. "-1" sizes are allowed (inferred at mesh build)."""
+    axes: dict[str, int] = {}
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, size = part.partition("=")
+        if not size:
+            raise ValueError(f"malformed mesh axis {part!r} in {spec!r}")
+        axes[name.strip()] = int(size)
+    return axes
+
+
+# ---------------------------------------------------------------------------
+# Presets: the strategies the task brief requires as first-class citizens.
+# Each returns an axes dict for make_mesh; -1 folds the remaining devices in.
+# ---------------------------------------------------------------------------
+
+def preset_dp() -> dict[str, int]:
+    """Pure data parallelism — every chip holds full params."""
+    return {"dp": -1}
+
+
+def preset_fsdp() -> dict[str, int]:
+    """Fully-sharded DP: batch and params sharded over one axis."""
+    return {"fsdp": -1}
+
+
+def preset_dp_tp(tp: int) -> dict[str, int]:
+    """2D: batch over dp, layer internals over tp (minor axis → ICI)."""
+    return {"dp": -1, "tp": tp}
+
+
+def preset_fsdp_tp(tp: int) -> dict[str, int]:
+    return {"fsdp": -1, "tp": tp}
+
+
+def preset_long_context(cp: int, tp: int = 1) -> dict[str, int]:
+    """Long-context: sequence over cp (ring attention), internals over tp."""
+    return {"dp": -1, "cp": cp, "tp": tp}
+
+
+def preset_pipeline(pp: int, tp: int = 1) -> dict[str, int]:
+    return {"pp": pp, "dp": -1, "tp": tp}
+
+
+def preset_moe(ep: int, tp: int = 1) -> dict[str, int]:
+    """Expert parallelism: experts over ep, dense internals over tp."""
+    return {"dp": -1, "ep": ep, "tp": tp}
+
+
+PRESETS = {
+    "dp": preset_dp,
+    "fsdp": preset_fsdp,
+    "dp_tp": preset_dp_tp,
+    "fsdp_tp": preset_fsdp_tp,
+    "long_context": preset_long_context,
+    "pipeline": preset_pipeline,
+    "moe": preset_moe,
+}

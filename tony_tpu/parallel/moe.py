@@ -1,0 +1,102 @@
+"""Expert parallelism: gshard-style mixture-of-experts dispatch.
+
+Green-field for the TPU build (SURVEY.md §2.3: EP absent from the reference).
+TPU-first design: dispatch/combine are *dense einsums* against a capacity-
+bounded one-hot routing tensor, with experts sharded over the mesh's ``ep``
+axis via logical-axis constraints — XLA then lowers the resharding to
+all_to_all collectives over ICI. No per-token gather/scatter loops (which
+would defeat MXU tiling and force dynamic shapes).
+
+Static shapes everywhere: each expert processes a fixed ``capacity`` of
+tokens; overflow tokens are dropped (their combine weight is zero), the
+standard gshard/switch trade for compile-time-known shapes.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tony_tpu.parallel.sharding import constrain
+
+
+class MoEMetrics(NamedTuple):
+    """Router health numbers (load-balance aux loss per Switch-Transformer)."""
+    aux_loss: jax.Array        # scalar: E * sum(frac_tokens * frac_probs)
+    dropped_fraction: jax.Array
+
+
+def router_dispatch(logits: jax.Array, num_experts: int, *, top_k: int = 2,
+                    capacity: int):
+    """Top-k routing with capacity. logits: [B, S, E].
+
+    Returns (dispatch [B,S,E,C] one-hot, combine [B,S,E,C] weights, metrics).
+    """
+    b, s, e = logits.shape
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, top_k)          # [B,S,k]
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)  # [B,S,k,E]
+    # token-major priority: earlier sequence positions win capacity slots
+    oh = onehot.reshape(b, s * top_k, e)
+    pos = jnp.cumsum(oh, axis=1) - oh                       # slot within expert
+    keep = (pos < capacity).astype(jnp.float32) * oh        # [B,S*k,E]
+    pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity,
+                            dtype=jnp.float32) * keep[..., None]
+    combine = pos_oh * gate_vals.reshape(b, s * top_k, 1, 1)
+    dispatch = pos_oh.reshape(b, s, top_k, e, capacity).sum(2)
+    combine = combine.reshape(b, s, top_k, e, capacity).sum(2)
+
+    # Switch-Transformer load-balance loss: E * Σ_e f_e * p_e
+    frac_tokens = onehot[:, :, 0, :].mean(axis=(0, 1))      # top-1 assignment
+    frac_probs = probs.mean(axis=(0, 1))
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+    routed = keep.sum() / jnp.maximum(oh.sum(), 1.0)
+    return dispatch, combine, MoEMetrics(aux, 1.0 - routed)
+
+
+def default_capacity(tokens_per_group: int, num_experts: int, top_k: int,
+                     capacity_factor: float = 1.25) -> int:
+    c = int(math.ceil(top_k * tokens_per_group / num_experts
+                      * capacity_factor))
+    return max(c, 1)
+
+
+def moe_ffn(x: jax.Array, router_w: jax.Array, w_in: jax.Array,
+            w_out: jax.Array, *, top_k: int = 2,
+            capacity_factor: float = 1.25,
+            activation=jax.nn.gelu) -> tuple[jax.Array, MoEMetrics]:
+    """Mixture-of-experts feed-forward block.
+
+    x: [B, S, D]; router_w: [D, E]; w_in: [E, D, H]; w_out: [E, H, D].
+    Experts carry logical axis "expert" → mesh ``ep``; the two big einsums
+    below keep data in [E, B, C, D] layout so the ep resharding is a single
+    all_to_all on entry and exit.
+    """
+    b, s, d = x.shape
+    e = router_w.shape[1]
+    capacity = default_capacity(s, e, top_k, capacity_factor)
+
+    logits = jnp.einsum("bsd,de->bse", x, router_w,
+                        preferred_element_type=jnp.float32)
+    dispatch, combine, metrics = router_dispatch(
+        logits, e, top_k=top_k, capacity=capacity)
+
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    # [B,S,E,C] × [B,S,D] → [E,B,C,D]: the all_to_all boundary (ep enters)
+    expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+    expert_in = constrain(expert_in, ("expert", "batch", None, "embed"))
+    h = activation(jnp.einsum("ebcd,edh->ebch", expert_in, w_in))
+    h = constrain(h, ("expert", "batch", None, "mlp"))
+    expert_out = jnp.einsum("ebch,ehd->ebcd", h, w_out)
+    # [B,S,E,C] × [E,B,C,D] → [B,S,D]: ep exits (second all_to_all)
+    out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
+    out = constrain(out, ("batch", "seq", "embed"))
+    return out, metrics

@@ -1,0 +1,111 @@
+"""Pipeline parallelism: GPipe-style microbatch pipelining over ``pp``.
+
+Green-field for the TPU build (SURVEY.md §2.3: PP absent from the reference).
+Stages live on different devices along the mesh's ``pp`` axis; activations
+hop stage→stage with ``lax.ppermute`` (point-to-point, so pp tolerates DCN);
+microbatches fill the pipeline GPipe-fashion: with S stages and M
+microbatches the steady loop runs M+S-1 ticks and bubble overhead is
+(S-1)/(M+S-1). Differentiable end-to-end: AD through scan+ppermute yields
+the reverse pipeline schedule automatically.
+
+Constraint: the stage function must map activations to activations of the
+same shape/dtype (natural for transformer blocks). Per-stage params are
+stacked on a leading [S, ...] axis, sharded P("pp") — each device reads only
+its own stage's slice.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
+                    stage_fn: Callable[[Any, jax.Array], jax.Array],
+                    axis_name: str) -> jax.Array:
+    """Per-device pipeline body (inside shard_map over ``axis_name``).
+
+    stage_params: this stage's params (leading [1, ...] shard dim squeezed).
+    microbatches: [M, mb, ...] — replicated input; stage 0 consumes it.
+    Returns [M, mb, ...] final-stage outputs, replicated via psum.
+    """
+    s = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda x: x[0], stage_params)
+    m = microbatches.shape[0]
+    state = jnp.zeros_like(microbatches[0])
+    outputs = jnp.zeros_like(microbatches)
+    shift = [(i, (i + 1) % s) for i in range(s)]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t while t < M; later stages use the
+        # activation that arrived from the previous stage last tick
+        inp = jnp.where(stage == 0, microbatches[jnp.minimum(t, m - 1)], state)
+        out = stage_fn(params, inp)
+        # the final stage finishes microbatch t-(S-1) at tick t
+        widx = t - (s - 1)
+        take = jnp.logical_and(stage == s - 1, widx >= 0)
+        slot = jnp.clip(widx, 0, m - 1)
+        outputs = outputs.at[slot].set(
+            jnp.where(take, out, outputs[slot]))
+        state = lax.ppermute(out, axis_name, shift)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs),
+                               jnp.arange(m + s - 1, dtype=jnp.int32))
+    # only the last stage holds real outputs; broadcast around the ring so
+    # the result is replicated over pp (out_spec P() below)
+    mask = (stage == s - 1).astype(outputs.dtype)
+    return lax.psum(outputs * mask, axis_name)
+
+
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                   stacked_params: Any, x: jax.Array, mesh: Mesh, *,
+                   num_microbatches: int, axis_name: str = "pp",
+                   batch_axes: tuple[str, ...] = ("dp", "fsdp")) -> jax.Array:
+    """Run x through S pipeline stages of ``stage_fn``.
+
+    stacked_params: pytree whose leaves lead with the stage axis [S, ...];
+    S must equal the ``pp`` mesh axis size (one stage per pp rank).
+    x: [B, ...] global batch; must divide into ``num_microbatches``; the
+    microbatch dim stays sharded over the live batch axes (dp/fsdp).
+    Returns [B, ...] outputs (replicated over pp).
+    """
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible into "
+                         f"{num_microbatches} microbatches")
+    num_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+
+    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        # degenerate: no pp axis — run stages sequentially via scan
+        def body(h, p):
+            return stage_fn(p, h), None
+        out, _ = lax.scan(body, x, stacked_params)
+        return out
+
+    pp = mesh.shape[axis_name]
+    if num_stages != pp:
+        raise ValueError(f"{num_stages} stacked stages but pp axis has "
+                         f"{pp} ranks — need exactly one stage per rank")
+    mb = b // num_microbatches
+    xs = x.reshape((num_microbatches, mb) + x.shape[1:])
+
+    live = tuple(a for a in batch_axes
+                 if a in mesh.shape and mesh.shape[a] > 1)
+    data_spec = P(None, live if len(live) > 1 else (live[0] if live else None))
+    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    fn = functools.partial(_pipeline_local, stage_fn=stage_fn,
+                           axis_name=axis_name)
+    out = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, data_spec),
+        out_specs=data_spec,
+        check_vma=False)(stacked_params, xs)
+    return out.reshape((b,) + out.shape[2:])

@@ -1,0 +1,133 @@
+"""Ring attention: context parallelism over the mesh's ``cp`` axis.
+
+Green-field for the TPU build (SURVEY.md §2.3 / §5: the reference scales
+nodes, not sequence length). Sequence is sharded over ``cp``; each device
+holds a Q/K/V chunk, computes blockwise attention against the K/V chunk it
+currently holds, then rotates K/V one hop around the ring with
+``lax.ppermute`` (ICI neighbor exchange) while accumulating an online
+softmax — so peak memory is O(seq/cp) and the full sequence is never
+materialized on one chip. Differentiable as-is: the backward pass is the
+transposed ring (ppermute has a transpose rule), driven by JAX AD through
+the scan.
+
+Numerics follow flash attention: f32 running max ``m``, normalizer ``l`` and
+unnormalized output ``o``; fully-masked blocks (causal, future chunks) are
+handled with a -1e30 additive mask so ``m`` never becomes -inf.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1.0e30
+
+
+def _block_attn(q, k, v, m, l, o, scale, q_off, kv_off, causal):
+    """One blockwise-attention accumulation step (online softmax).
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
+    q_off/kv_off are the global sequence offsets of the chunks (for causal
+    masking across ring hops).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_off + lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        kv_pos = kv_off + lax.broadcasted_iota(jnp.int32, s.shape, 3)
+        s = jnp.where(q_pos >= kv_pos, s, _NEG_INF)
+    m_new = jnp.maximum(m, s.max(axis=-1))
+    # exp of masked lanes underflows to 0; correction stays finite because
+    # m is floored at _NEG_INF rather than -inf.
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m - m_new)
+    l_new = l * corr + p.sum(axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
+    return m_new, l_new, o_new
+
+
+def ring_attention_local(q, k, v, *, axis_name: str = "cp",
+                         causal: bool = True, scale: float | None = None):
+    """Per-shard ring attention body — call inside ``shard_map`` (or any
+    SPMD context where ``axis_name`` is bound and the sequence dim is the
+    shard axis).
+
+    q, k, v: [B, S_local, H, D] local chunks. Returns [B, S_local, H, D].
+    """
+    b, s_loc, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    cp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    q_off = idx * s_loc
+
+    q32 = q.astype(jnp.float32) if q.dtype == jnp.float64 else q
+    m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s_loc), jnp.float32)
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+
+    if cp == 1:
+        m, l, o = _block_attn(q32, k, v, m0, l0, o0, scale, q_off, q_off,
+                              causal)
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def step(carry, hop):
+        k_cur, v_cur, m, l, o = carry
+        # chunk held at this hop originated on device (idx - hop) mod cp
+        kv_off = ((idx - hop) % cp) * s_loc
+        m, l, o = _block_attn(q32, k_cur, v_cur, m, l, o, scale, q_off,
+                              kv_off, causal)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, m, l, o), None
+
+    (_, _, m, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
+                                  jnp.arange(cp, dtype=jnp.int32))
+    # causal + f32: every query attends at least to itself, so l > 0
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                   scale: float | None = None,
+                   batch_axes: Sequence[str] = ("dp", "fsdp"),
+                   seq_axis: str = "cp", head_axis: str = "tp"):
+    """Context-parallel attention over global [B, S, H, D] arrays.
+
+    A ``shard_map`` island intended for use inside a jitted model: batch over
+    dp/fsdp, sequence over cp, heads over tp. Axes missing from ``mesh`` (or
+    of size 1) are dropped from the specs automatically.
+    """
+    live = lambda a: a in mesh.shape and mesh.shape[a] > 1
+    b_spec = tuple(a for a in batch_axes if live(a)) or None
+    if isinstance(b_spec, tuple) and len(b_spec) == 1:
+        b_spec = b_spec[0]
+    s_spec = seq_axis if live(seq_axis) else None
+    h_spec = head_axis if live(head_axis) else None
+    spec = P(b_spec, s_spec, h_spec, None)
+
+    if s_spec is None:
+        # no cp axis: plain (still blockwise/online-softmax) local attention
+        fn = functools.partial(_single_chunk, causal=causal, scale=scale)
+    else:
+        fn = functools.partial(ring_attention_local, axis_name=seq_axis,
+                               causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+
+
+def _single_chunk(q, k, v, *, causal, scale):
+    b, s, h, d = q.shape
+    scale = (d ** -0.5) if scale is None else scale
+    m = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s), jnp.float32)
+    o = jnp.zeros((b, s, h, d), jnp.float32)
+    m, l, o = _block_attn(q, k, v, m, l, o, scale, 0, 0, causal)
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)

@@ -1,0 +1,110 @@
+"""Logical-axis sharding rules: names in models, meshes at runtime.
+
+Green-field for the TPU build (the reference delegates all parallelism to the
+user script — SURVEY.md §2.3). Models annotate arrays with *logical* axis
+names ("batch", "embed", "heads", ...); a rule table maps those to mesh axes.
+Swapping DP→FSDP→TP+SP is then a rule-table change, not a model change —
+the same decoupling the scaling-book recipe prescribes: pick a mesh, annotate
+shardings, let XLA insert the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[tuple[str, str | tuple[str, ...] | None]]
+
+# Default rule table for transformer-family models. First matching rule wins;
+# a mesh axis not present in the mesh resolves to replication.
+DEFAULT_RULES: Rules = (
+    ("batch", ("dp", "fsdp")),       # batch over dp and fsdp jointly
+    ("seq", "cp"),                   # context parallelism: sequence split
+    ("embed", "fsdp"),               # FSDP shards params on the embed dim
+    ("heads", "tp"),                 # attention heads over tensor axis
+    ("kv", None),                    # per-head dim: never sharded
+    ("mlp", "tp"),                   # MLP hidden over tensor axis
+    ("vocab", "tp"),                 # embedding/logits vocab over tensor axis
+    ("expert", "ep"),                # MoE experts over expert axis
+    ("stage", "pp"),                 # pipeline stages
+    ("norm", None),
+)
+
+
+def _resolve(logical: str | None, rules: Rules, mesh: Mesh,
+             used: set[str]):
+    if logical is None:
+        return None
+    for name, target in rules:
+        if name == logical:
+            if target is None:
+                return None
+            targets = (target,) if isinstance(target, str) else tuple(target)
+            # a mesh axis may shard at most one array dim: earlier dims win
+            # (e.g. ("batch","embed") on a pure-fsdp mesh → batch gets fsdp,
+            # embed replicates instead of raising DuplicateSpecError)
+            live = tuple(t for t in targets
+                         if t in mesh.shape and mesh.shape[t] > 1
+                         and t not in used)
+            if not live:
+                return None
+            used.update(live)
+            return live if len(live) > 1 else live[0]
+    return None
+
+
+def logical_to_spec(logical_axes: Sequence[str | None], mesh: Mesh,
+                    rules: Rules = DEFAULT_RULES) -> P:
+    """("batch", "embed") → PartitionSpec(("dp","fsdp"), "fsdp") under rules,
+    dropping mesh axes that don't exist, have size 1, or are already used by
+    an earlier dim of the same array."""
+    used: set[str] = set()
+    return P(*(_resolve(ax, rules, mesh, used) for ax in logical_axes))
+
+
+def logical_sharding(logical_axes: Sequence[str | None], mesh: Mesh,
+                     rules: Rules = DEFAULT_RULES) -> NamedSharding:
+    return NamedSharding(mesh, logical_to_spec(logical_axes, mesh, rules))
+
+
+def shard_pytree(tree: Any, logical_tree: Any, mesh: Mesh,
+                 rules: Rules = DEFAULT_RULES) -> Any:
+    """Device-put every leaf of ``tree`` per its logical axes in
+    ``logical_tree`` (same structure, leaves are tuples of axis names)."""
+    return jax.tree.map(
+        lambda x, ax: jax.device_put(x, logical_sharding(ax, mesh, rules)),
+        tree, logical_tree, is_leaf=lambda x: x is None)
+
+
+def constrain(x, logical_axes: Sequence[str | None], mesh: Mesh | None = None,
+              rules: Rules = DEFAULT_RULES):
+    """``with_sharding_constraint`` by logical names. With no explicit mesh,
+    the ambient mesh context (``jax.sharding.set_mesh`` / trace-time abstract
+    mesh) is used; a no-op when neither exists (single-device, plain tests)."""
+    if mesh is not None:
+        return jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, logical_to_spec(logical_axes, mesh, rules)))
+    ambient = jax.sharding.get_abstract_mesh()
+    if ambient is None or ambient.empty:
+        return x
+    return jax.lax.with_sharding_constraint(
+        x, logical_to_spec(logical_axes, ambient, rules))
+
+
+def _is_axes_leaf(x: Any) -> bool:
+    """A leaf of a logical-axes pytree: None, or a tuple of axis names/None.
+    Distinguishes the axes tuple ("stage","embed") from structural tuples
+    like a ((W_axes, b_axes), ...) params container."""
+    return x is None or (isinstance(x, tuple) and all(
+        e is None or isinstance(e, str) for e in x))
+
+
+def param_shardings(logical_tree: Any, mesh: Mesh,
+                    rules: Rules = DEFAULT_RULES) -> Any:
+    """Map a logical-axes pytree → NamedSharding pytree (for jit in_shardings/
+    out_shardings)."""
+    return jax.tree.map(
+        lambda ax: logical_sharding(ax, mesh, rules),
+        logical_tree, is_leaf=_is_axes_leaf)

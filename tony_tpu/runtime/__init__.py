@@ -103,25 +103,9 @@ def mesh(axes: dict[str, int] | None = None,
     ``axes`` defaults to the config-shipped layout; a single axis given as
     -1/0 is inferred from the global device count (so the layout scales with
     the slice). Returns a 1-axis ``("dp",)`` mesh when nothing is configured.
+    Delegates to :func:`tony_tpu.parallel.mesh.make_mesh` — one
+    implementation of axis inference/ordering for the whole framework.
     """
-    import jax
-    import numpy as np
-    from jax.sharding import Mesh
-
-    devices = np.array(jax.devices())
-    n = devices.size
-    axes = dict(axes if axes is not None else mesh_axes())
-    if not axes:
-        axes = {"dp": n}
-    unknown = [k for k, v in axes.items() if v in (-1, 0)]
-    known = int(np.prod([v for v in axes.values() if v not in (-1, 0)]))
-    if len(unknown) == 1:
-        axes[unknown[0]] = n // known
-    elif len(unknown) > 1:
-        raise ValueError(f"at most one inferred (-1) mesh axis: {axes}")
-    total = int(np.prod(list(axes.values())))
-    if total != n:
-        raise ValueError(f"mesh axes {axes} require {total} devices, have {n}")
-    names = tuple(axis_order) if axis_order else tuple(axes)
-    shape = tuple(axes[name] for name in names)
-    return Mesh(devices.reshape(shape), names)
+    from tony_tpu.parallel.mesh import make_mesh
+    return make_mesh(axes if axes is not None else mesh_axes(),
+                     axis_order=axis_order)
