@@ -36,6 +36,34 @@ def fixture_cmd(name, *args):
     return " ".join([PY, os.path.join(FIXTURES, name), *args])
 
 
+def test_stage_src_dir_containing_staging_dir(tmp_path):
+    """Regression: --src_dir pointing at the tree that contains the staging
+    root must not copytree the growing job dir into itself."""
+    src = tmp_path / "project"
+    src.mkdir()
+    (src / "train.py").write_text("print('hi')\n")
+    conf = TonyConfig({"tony.staging.dir": str(src / ".tony")})
+    client = TonyClient(conf, "true", src_dir=str(src))
+    client.stage()   # used to recurse until ENAMETOOLONG
+    staged = os.path.join(client.job_dir, "project")
+    assert os.path.exists(os.path.join(staged, "train.py"))
+    assert not os.path.exists(os.path.join(staged, ".tony"))
+
+
+def test_stage_src_dir_equal_to_staging_dir(tmp_path):
+    """Harder regression: staging dir == src dir — the job dir is then a
+    direct child of the copied tree and must itself be skipped."""
+    src = tmp_path / "everything"
+    src.mkdir()
+    (src / "train.py").write_text("print('hi')\n")
+    conf = TonyConfig({"tony.staging.dir": str(src)})
+    client = TonyClient(conf, "true", src_dir=str(src))
+    client.stage()
+    staged = os.path.join(client.job_dir, "everything")
+    assert os.path.exists(os.path.join(staged, "train.py"))
+    assert not os.path.exists(os.path.join(staged, client.app_id))
+
+
 @pytest.mark.e2e
 class TestE2E:
     def test_single_worker_succeeds(self, tmp_path):
@@ -168,6 +196,48 @@ class TestE2E:
             open(os.path.join(client.job_dir, "logs", "worker-1.stdout")).read()
         assert "2 global devices" in out       # both processes federated
         assert "done:" in out
+
+    def test_slice_preemption_retried_from_own_budget(self, tmp_path):
+        """TEST_PREEMPT_SLICE kills the worker gang once and reports it
+        preempted; with tony.am.retry-count=0 the job must STILL succeed —
+        infrastructure preemption retries come from the separate
+        tony.tpu.preemption-retries budget (SURVEY.md §7 hard part (d))."""
+        client = make_client(
+            tmp_path, fixture_cmd("sleep_briefly.py", "3"),
+            {"tony.worker.instances": "1",
+             "tony.am.retry-count": "0"},
+            shell_env={"TEST_PREEMPT_SLICE": "worker"})
+        assert client.run() == 0
+
+    def test_preemption_budget_exhausted_fails(self, tmp_path):
+        client = make_client(
+            tmp_path, fixture_cmd("sleep_briefly.py", "3"),
+            {"tony.worker.instances": "1",
+             "tony.tpu.preemption-retries": "0"},
+            shell_env={"TEST_PREEMPT_SLICE": "worker"})
+        assert client.run() == 1
+
+    def test_kill_reaps_user_processes(self, tmp_path):
+        """The untracked ps task runs sleep_forever; when the workers finish
+        and the coordinator tears the job down, the actual user process (a
+        grandchild in its own session) must die too — not just its executor
+        (regression: killpg only reached the executor's group)."""
+        marker = f"tony-orphan-{os.getpid()}"
+        client = make_client(
+            tmp_path,
+            f'bash -c "if [ $JOB_NAME = ps ]; then '
+            f'{fixture_cmd("sleep_forever.py")} {marker}; '
+            f'else {fixture_cmd("exit_0.py")}; fi"',
+            {"tony.worker.instances": "1", "tony.ps.instances": "1"})
+        assert client.run() == 0
+        import time as _time
+        for _ in range(50):   # PDEATHSIG/TERM-forwarding needs a beat
+            alive = subprocess.run(["pgrep", "-f", marker],
+                                   capture_output=True).returncode == 0
+            if not alive:
+                break
+            _time.sleep(0.1)
+        assert not alive, "user training process leaked after job teardown"
 
     def test_task_logs_written(self, tmp_path):
         client = make_client(

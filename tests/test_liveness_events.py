@@ -77,6 +77,35 @@ def test_history_file_name_codec():
     assert not is_valid_history_file_name("x-notanumber-user.jhist")
 
 
+def test_history_file_name_hyphenated_user():
+    """Regression: USER=john-doe (or a leading-digit user) must round-trip —
+    the old regex rejected hyphens, making such jobs invisible to the
+    history server."""
+    for user in ("john-doe", "4dmin", "a-b-c"):
+        name = history_file_name("application_1_2", 1000, user,
+                                 completed_ms=2000, status="SUCCEEDED")
+        md = JobMetadata.from_file_name(name)
+        assert md is not None and md.user == user
+        assert (md.app_id, md.started_ms, md.completed_ms, md.status) == \
+            ("application_1_2", 1000, 2000, "SUCCEEDED")
+        inprog = history_file_name("application_1_2", 1000, user,
+                                   in_progress=True)
+        md2 = JobMetadata.from_file_name(inprog)
+        assert md2 is not None and md2.user == user and md2.in_progress
+
+
+def test_history_file_name_digit_leading_user_inprogress():
+    """Regression: USER=007-james in an in-progress name — the regex used to
+    steal the leading digits as completed_ms; completion preceding start is
+    impossible, so the parser must fold them back into the user."""
+    started = 1_700_000_000_000
+    name = history_file_name("application_1_2", started, "007-james",
+                             in_progress=True)
+    md = JobMetadata.from_file_name(name)
+    assert md.user == "007-james" and md.completed_ms is None
+    assert md.started_ms == started and md.in_progress
+
+
 def test_event_handler_roundtrip(tmp_path):
     h = EventHandler(str(tmp_path), "app_9", "bob")
     h.start()

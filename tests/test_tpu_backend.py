@@ -65,6 +65,32 @@ def test_dry_run_gang_provisions_once():
     b.stop()
 
 
+def test_relaunch_after_preemption_reprovisions():
+    """Regression: a retried session must get a FRESH slice — the old one's
+    cached PREEMPTED state was instantly re-failing every relaunched task,
+    and stale _reported entries swallowed the new generation's exits."""
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    spec = LaunchSpec(task_id="worker:0", command="run", env={},
+                      log_dir="/tmp", tpu_topology="4x4")
+    b.launch_task(spec)
+    # simulate the slice being preempted and the task observed as dead
+    b._state_cache["worker"] = "PREEMPTED"
+    b._state_ts["worker"] = float("inf")     # keep the cache "fresh"
+    b._reported.add("worker:0")
+    old_slice = b._slices["worker"]
+    b.launch_task(spec)                      # session retry relaunch
+    assert "worker:0" not in b._reported
+    assert b._state_cache.get("worker") != "PREEMPTED"
+    assert b._slices["worker"] == old_slice  # same name, freshly provisioned
+    assert b.poll_completed() == []          # no instant preempted re-fail
+    b.stop()
+
+
+def test_delete_command_wait_mode():
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    assert "--async" not in b.delete_slice_command("worker", wait=True)
+
+
 def test_slice_name_sanitized_and_bounded():
     n = slice_name("application_1785325254085_2d827d" * 3, "worker")
     assert "_" not in n and len(n) <= 61

@@ -18,6 +18,7 @@ import signal
 import subprocess
 import threading
 
+from tony_tpu import constants
 from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
 from tony_tpu.utils.env import with_framework_path
 
@@ -25,11 +26,15 @@ log = logging.getLogger(__name__)
 
 
 class LocalBackend(SchedulerBackend):
+    KILL_GRACE_S = 2.0
+
     def __init__(self) -> None:
         self._procs: dict[str, subprocess.Popen] = {}
         self._files: dict[str, list] = {}
         self._reported: set[str] = set()
         self._killed: set[str] = set()
+        self._preempted: set[str] = set()
+        self._preemption_simulated = False
         self._lock = threading.Lock()
 
     def launch_task(self, spec: LaunchSpec) -> None:
@@ -61,11 +66,35 @@ class LocalBackend(SchedulerBackend):
             self._files[spec.task_id] = [out, err]
             self._reported.discard(spec.task_id)
             self._killed.discard(spec.task_id)
+            self._preempted.discard(spec.task_id)
         log.info("launched %s as pid %d", spec.task_id, proc.pid)
+
+    def _maybe_simulate_preemption(self) -> None:
+        """TEST_PREEMPT_SLICE=<job_type> chaos: SIGKILL every running task of
+        that job type ONCE and report it preempted — simulates losing a TPU
+        slice wholesale, driving the coordinator's preemption-retry path
+        (the infra-failure analog of the reference's TEST_* hooks)."""
+        job_type = os.environ.get(constants.TEST_PREEMPT_SLICE)
+        if not job_type or self._preemption_simulated:
+            return
+        victims = [(tid, p) for tid, p in self._procs.items()
+                   if tid.partition(":")[0] == job_type
+                   and tid not in self._reported and p.poll() is None]
+        if not victims:
+            return
+        self._preemption_simulated = True
+        for task_id, proc in victims:
+            log.info("chaos: simulating slice preemption of %s", task_id)
+            self._preempted.add(task_id)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
 
     def poll_completed(self) -> list[CompletionEvent]:
         events = []
         with self._lock:
+            self._maybe_simulate_preemption()
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue
@@ -75,21 +104,39 @@ class LocalBackend(SchedulerBackend):
                 self._reported.add(task_id)
                 for f in self._files.pop(task_id, ()):
                     f.close()
-                # Tasks we killed ourselves (session reset / worker
-                # termination chaos) are reported as preempted so the
-                # coordinator can distinguish them from user-code crashes.
+                # Only simulated slice loss is "preempted" (infra failure,
+                # retryable from the preemption budget). Deliberate
+                # coordinator kills (session reset, chaos worker
+                # termination) must look like ordinary task death, as in
+                # the reference where a killed container is just a failed
+                # container.
                 events.append(CompletionEvent(
-                    task_id, code, preempted=task_id in self._killed))
+                    task_id, code, preempted=task_id in self._preempted))
         return events
 
     def _kill_proc(self, task_id: str, proc: subprocess.Popen) -> None:
+        """TERM first — the executor forwards it to the user process group
+        (which lives in its own session, out of killpg's reach) — then
+        escalate to group SIGKILL after a grace period; PDEATHSIG on the
+        user process backstops the SIGKILL path."""
         if proc.poll() is not None:
             return
         self._killed.add(task_id)
         try:
-            os.killpg(proc.pid, signal.SIGKILL)
+            os.killpg(proc.pid, signal.SIGTERM)
         except (ProcessLookupError, PermissionError):
-            pass
+            return
+
+        def _escalate():
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+        t = threading.Timer(self.KILL_GRACE_S, _escalate)
+        t.daemon = True
+        t.start()
 
     def kill_task(self, task_id: str) -> None:
         with self._lock:

@@ -31,6 +31,7 @@ import shlex
 import shutil
 import subprocess
 import threading
+import time
 
 from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
 from tony_tpu.conf import keys as K
@@ -64,6 +65,15 @@ class TpuSliceBackend(SchedulerBackend):
         self._procs: dict[str, subprocess.Popen] = {}
         self._reported: set[str] = set()
         self._lock = threading.Lock()
+        # Slice state is refreshed from the cloud API at most once per
+        # tony.tpu.state-refresh-ms and NEVER under the lock — the monitor
+        # polls 5x/s and a describe call can take seconds; hammering the API
+        # from the hot loop while blocking kill/launch would both exhaust
+        # quota and stall client kills behind network calls.
+        self._state_refresh_s = conf.get_int(K.TPU_STATE_REFRESH_KEY,
+                                             10000) / 1000.0
+        self._state_cache: dict[str, str] = {}
+        self._state_ts: dict[str, float] = {}
         if not dry_run:
             if shutil.which("gcloud") is None:
                 raise TpuProvisioningError(
@@ -115,11 +125,16 @@ class TpuSliceBackend(SchedulerBackend):
                 f"--project={self.project}", f"--zone={self.zone}",
                 "--format=json"]
 
-    def delete_slice_command(self, job_type: str) -> list[str]:
+    def delete_slice_command(self, job_type: str,
+                             wait: bool = False) -> list[str]:
+        """``wait=True`` (synchronous delete) is used on the reprovision
+        path, where a create with the same name must not race the delete."""
         name = slice_name(self.app_id, job_type)
-        return ["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
-                f"--project={self.project}", f"--zone={self.zone}",
-                "--quiet", "--async"]
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
+               f"--project={self.project}", f"--zone={self.zone}", "--quiet"]
+        if not wait:
+            cmd.append("--async")
+        return cmd
 
     # ------------------------------------------------------------------
     # SchedulerBackend surface
@@ -127,6 +142,23 @@ class TpuSliceBackend(SchedulerBackend):
     def launch_task(self, spec: LaunchSpec) -> None:
         job_type, _, idx = spec.task_id.partition(":")
         with self._lock:
+            # Relaunch of the same task id (session retry): forget the old
+            # generation's completion so the new one is observed.
+            self._reported.discard(spec.task_id)
+            if job_type in self._slices and self._state_cache.get(job_type) \
+                    in ("PREEMPTED", "TERMINATED"):
+                # The gang's slice is gone — a retried session must get a
+                # fresh one, not instantly re-fail on the cached dead state.
+                log.info("slice for %s was %s — reprovisioning", job_type,
+                         self._state_cache[job_type])
+                cmd = self.delete_slice_command(job_type, wait=True)
+                if self.dry_run:
+                    log.info("[dry-run] %s", " ".join(cmd))
+                else:
+                    subprocess.run(cmd, capture_output=True, timeout=600)
+                del self._slices[job_type]
+                self._state_cache.pop(job_type, None)
+                self._state_ts.pop(job_type, None)
             if job_type not in self._slices:
                 self._provision(job_type, spec)
             env_prefix = " ".join(
@@ -157,18 +189,34 @@ class TpuSliceBackend(SchedulerBackend):
     def _slice_state(self, job_type: str) -> str:
         if self.dry_run:
             return "READY"
-        res = subprocess.run(self.describe_command(job_type),
-                             capture_output=True, text=True, timeout=60)
+        try:
+            res = subprocess.run(self.describe_command(job_type),
+                                 capture_output=True, text=True, timeout=60)
+        except subprocess.TimeoutExpired:
+            return "UNKNOWN"
         if res.returncode != 0:
             return "UNKNOWN"
         return json.loads(res.stdout).get("state", "UNKNOWN")
 
+    def _refresh_slice_states(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            stale = [jt for jt in self._slices
+                     if now - self._state_ts.get(jt, 0.0)
+                     > self._state_refresh_s]
+        for jt in stale:            # network calls OUTSIDE the lock
+            state = self._slice_state(jt)
+            with self._lock:
+                self._state_cache[jt] = state
+                self._state_ts[jt] = time.monotonic()
+
     def poll_completed(self) -> list[CompletionEvent]:
+        self._refresh_slice_states()
         events = []
         with self._lock:
             preempted_types = {jt for jt in self._slices
-                               if self._slice_state(jt) in ("PREEMPTED",
-                                                            "TERMINATED")}
+                               if self._state_cache.get(jt, "READY")
+                               in ("PREEMPTED", "TERMINATED")}
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue
@@ -183,17 +231,53 @@ class TpuSliceBackend(SchedulerBackend):
                     events.append(CompletionEvent(task_id, code))
         return events
 
+    def remote_kill_command(self, job_type: str, host_index: int) -> list[str]:
+        """Best-effort remote reap: terminating the local ``gcloud ssh``
+        wrapper does NOT stop the executor on the TPU VM — it keeps
+        heartbeating with a stale session id and holds the data ports, so a
+        session retry onto the same slice would hit port conflicts."""
+        return self.ssh_command(
+            job_type, host_index,
+            "pkill -9 -f tony_tpu.cluster.executor || true")
+
+    def _kill_remote(self, task_id: str) -> subprocess.Popen | None:
+        jt, _, idx = task_id.partition(":")
+        cmd = self.remote_kill_command(jt, int(idx))
+        if self.dry_run:
+            log.info("[dry-run] %s", " ".join(cmd))
+            return None
+        return subprocess.Popen(cmd, stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+
     def kill_task(self, task_id: str) -> None:
         with self._lock:
             proc = self._procs.get(task_id)
-            if proc and proc.poll() is None:
+            if proc is not None and proc.poll() is None:
                 proc.terminate()
+        if proc is not None:
+            # A dead local ssh wrapper does NOT imply a dead remote
+            # executor, so the remote reap is unconditional (and
+            # fire-and-forget: a single-task kill is not followed by a
+            # relaunch of the same id, so there is no race to close).
+            self._kill_remote(task_id)
 
     def kill_all(self) -> None:
         with self._lock:
+            task_ids = list(self._procs)
             for proc in self._procs.values():
                 if proc.poll() is None:
                     proc.terminate()
+        # kill_all IS followed by a relaunch (session reset): the remote
+        # pkills run in parallel but are awaited, otherwise a slow ssh could
+        # land its SIGKILL on the NEXT session's executor.
+        reapers = [p for p in (self._kill_remote(t) for t in task_ids)
+                   if p is not None]
+        deadline = time.monotonic() + 120
+        for p in reapers:
+            try:
+                p.wait(timeout=max(1.0, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                p.kill()
 
     def stop(self) -> None:
         self.kill_all()

@@ -70,7 +70,20 @@ class TonyClient:
         if self.src_dir:
             dst = os.path.join(self.job_dir,
                                os.path.basename(os.path.normpath(self.src_dir)))
-            shutil.copytree(self.src_dir, dst, dirs_exist_ok=True)
+            # The staging root usually lives under cwd (./.tony); when
+            # src_dir contains it (tony submit --src_dir .), copytree must
+            # not descend into the tree it is growing (infinite recursion
+            # until ENAMETOOLONG — the reference avoided this by staging to
+            # HDFS, a different filesystem).
+            skip = {os.path.realpath(os.path.dirname(self.job_dir)),
+                    os.path.realpath(self.job_dir)}
+
+            def _skip_staging(dirpath, names):
+                return {n for n in names if os.path.realpath(
+                    os.path.join(dirpath, n)) in skip}
+
+            shutil.copytree(self.src_dir, dst, dirs_exist_ok=True,
+                            ignore=_skip_staging)
         venv = self.conf.get(K.PYTHON_VENV_KEY)
         if venv and os.path.exists(venv):
             shutil.copy(venv, os.path.join(self.job_dir, constants.TONY_VENV_ZIP))

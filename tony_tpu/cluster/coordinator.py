@@ -128,6 +128,13 @@ class Coordinator:
         self.task_missed_hb = threading.Event()
         self._completion_lock = threading.Lock()
         self.retries_left = conf.get_int(K.AM_RETRY_COUNT_KEY, 0)
+        # Slice preemption is infrastructure failure: retried from its own
+        # budget so user-failure retries (tony.am.retry-count) keep their
+        # meaning (SURVEY.md §7 hard part (d)).
+        self.preemption_retries_left = conf.get_int(
+            K.TPU_PREEMPTION_RETRIES_KEY, 3)
+        self._session_preempted = False
+        self._session_real_failure = False
         self.timeout_s = conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) / 1000.0
         self.hb_monitor = HeartbeatMonitor(
             conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000),
@@ -264,6 +271,11 @@ class Coordinator:
             self.session.on_task_completed(job_type, index, exit_code,
                                            session_id=session_id)
             if not already_done and task.completed:
+                if task.exit_code != 0 and self.session.is_tracked(job_type):
+                    if preempted:
+                        self._session_preempted = True
+                    else:
+                        self._session_real_failure = True
                 self.hb_monitor.unregister(task.task_id)
                 self.events.emit(ev.TASK_FINISHED, task=task.task_id,
                                  exit_code=task.exit_code, preempted=preempted,
@@ -323,18 +335,44 @@ class Coordinator:
         status = SessionStatus.FAILED
         while True:
             started = time.monotonic()
-            self.schedule_tasks(user_command)
-            status = self.monitor(started)
-            if status is SessionStatus.SUCCEEDED or self.retries_left <= 0 \
+            try:
+                self.schedule_tasks(user_command)
+                status = self.monitor(started)
+            except Exception as e:  # backend/provisioning failure must still
+                # produce a final status for the client (not an AM "crash"
+                # that gets blindly relaunched retry-count times)
+                log.exception("session %d aborted by backend error",
+                              self.session.session_id)
+                self.failure_message = f"backend error: {e}"
+                self.session.status = SessionStatus.FAILED
+                status = SessionStatus.FAILED
+                break
+            if status is SessionStatus.SUCCEEDED \
                     or self.client_signalled_finish.is_set() \
                     or (self.timeout_s > 0
                         and time.monotonic() - started > self.timeout_s):
                 break
+            # Failure triage: pure infrastructure preemption (every failed
+            # tracked task was preempted, no heartbeat expiry) retries from
+            # the preemption budget; anything else consumes a user retry.
+            infra_only = (self._session_preempted
+                          and not self._session_real_failure
+                          and not self.task_missed_hb.is_set())
+            if infra_only and self.preemption_retries_left > 0:
+                self.preemption_retries_left -= 1
+                log.warning(
+                    "session %d lost to slice preemption — re-running "
+                    "(%d preemption retries left)",
+                    self.session.session_id, self.preemption_retries_left)
+            elif self.retries_left > 0:
+                self.retries_left -= 1
+                log.warning(
+                    "session %d failed (%s) — retrying (%d retries left)",
+                    self.session.session_id, self.session.failure_message,
+                    self.retries_left)
+            else:
+                break
             # reset (reference: reset:570-585): stop everything, new session
-            self.retries_left -= 1
-            log.warning("session %d failed (%s) — retrying (%d retries left)",
-                        self.session.session_id, self.session.failure_message,
-                        self.retries_left)
             self.backend.kill_all()
             # drain completion events from the killed generation so they are
             # not misattributed to the new session
@@ -346,6 +384,8 @@ class Coordinator:
                 time.sleep(0.1)
             self.hb_monitor.reset()
             self.task_missed_hb.clear()
+            self._session_preempted = False
+            self._session_real_failure = False
             self.events.emit(ev.SESSION_RESET,
                              old_session_id=self.session.session_id)
             self.session = next_session(self.session)

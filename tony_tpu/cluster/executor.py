@@ -39,6 +39,17 @@ from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
 
 log = logging.getLogger("tony_tpu.executor")
 
+# Resolved at import time: the preexec hook runs between fork and exec in a
+# process whose Heartbeater thread may hold the import/allocator locks —
+# importing or CDLL-loading there can deadlock the child. Pre-resolving
+# leaves only a plain FFI call in the fork window.
+try:
+    import ctypes
+    _LIBC = ctypes.CDLL("libc.so.6", use_errno=True)
+except Exception:  # non-Linux: PDEATHSIG is best-effort anyway
+    _LIBC = None
+_PR_SET_PDEATHSIG = 1
+
 
 def reserve_port() -> int:
     """Reserve a free port for the task's data plane (the jax.distributed
@@ -174,6 +185,19 @@ class TaskExecutor:
         return env
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def _user_process_preexec() -> None:
+        """Child-side setup: own session (so the executor can group-kill on
+        timeout) + parent-death signal (so the user process dies even when
+        the executor itself is SIGKILLed by the backend — without this, a
+        coordinator kill_all would orphan the actual training processes,
+        which keep the TPU chips and reserved ports busy). Runs in the
+        fork→exec window: only syscall wrappers and the pre-resolved libc
+        handle, no imports/allocations (fork-safety with Heartbeater live)."""
+        os.setsid()
+        if _LIBC is not None:
+            _LIBC.prctl(_PR_SET_PDEATHSIG, signal.SIGKILL)
+
     def run_user_process(self, extra_env: dict[str, str]) -> int:
         """Fork-exec the user command via the shell, stream output, wait.
         (reference: Utils.executeShell:263 — 'bash -c <cmd>' with timeout)."""
@@ -183,7 +207,19 @@ class TaskExecutor:
         timeout_s = self.conf.get_int(K.TASK_EXECUTION_TIMEOUT_KEY, 0) / 1000.0
         log.info("launching user process: %s", self.task_command)
         proc = subprocess.Popen(["bash", "-c", self.task_command], env=env,
-                                start_new_session=True)
+                                preexec_fn=self._user_process_preexec)
+
+        def _forward_kill(signum, frame):
+            # Backend kills send SIGTERM to the executor's group; the user
+            # process lives in its own session, so forward explicitly.
+            log.warning("signal %d — killing user process group", signum)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            os._exit(128 + signum)
+
+        prev = signal.signal(signal.SIGTERM, _forward_kill)
         try:
             return proc.wait(timeout=timeout_s if timeout_s > 0 else None)
         except subprocess.TimeoutExpired:
@@ -191,6 +227,8 @@ class TaskExecutor:
             os.killpg(proc.pid, signal.SIGKILL)
             proc.wait()
             return constants.EXIT_FAILURE
+        finally:
+            signal.signal(signal.SIGTERM, prev)
 
     # ------------------------------------------------------------------
     def apply_chaos_after_training(self) -> None:

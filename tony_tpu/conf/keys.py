@@ -76,6 +76,13 @@ TPU_ACCELERATOR_TYPE_KEY = "tony.tpu.accelerator-type"
 TPU_RUNTIME_VERSION_KEY = "tony.tpu.runtime-version"
 TPU_PREEMPTIBLE_KEY = "tony.tpu.preemptible"
 TPU_PROVISION_TIMEOUT_KEY = "tony.tpu.provision-timeout-ms"
+# Slice preemption is infrastructure, not user failure: retried from a
+# separate budget so tony.am.retry-count keeps meaning "user-failure retries"
+# (SURVEY.md §7 hard part (d): distinguish preemption from user crash).
+TPU_PREEMPTION_RETRIES_KEY = "tony.tpu.preemption-retries"
+# How often the backend refreshes slice state via the cloud API (gcloud
+# describe); completion polling reads the cached state.
+TPU_STATE_REFRESH_KEY = "tony.tpu.state-refresh-ms"
 
 # ---------------------------------------------------------------------------
 # Staging / storage ("tony.staging.*"; HDFS-dir analog)
@@ -130,6 +137,8 @@ DEFAULTS: dict[str, str] = {
     TPU_RUNTIME_VERSION_KEY: "tpu-ubuntu2204-base",
     TPU_PREEMPTIBLE_KEY: "false",
     TPU_PROVISION_TIMEOUT_KEY: "600000",
+    TPU_PREEMPTION_RETRIES_KEY: "3",
+    TPU_STATE_REFRESH_KEY: "10000",
     STAGING_DIR_KEY: "",
     SRC_DIR_KEY: "src",
     PYTHON_VENV_KEY: "",
