@@ -1,0 +1,88 @@
+"""Fused-op kernels vs their dense-jnp oracles (interpret mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.ops import (flash_attention, layer_norm, layer_norm_reference,
+                          reference_attention, rms_norm, rms_norm_reference)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    r = np.random.RandomState(0)
+    shape = (2, 64, 2, 32)   # small: interpret mode is slow
+    return tuple(jnp.asarray(r.randn(*shape), jnp.float32) for _ in range(3))
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_forward_matches_dense(self, qkv, causal):
+        q, k, v = qkv
+        o = flash_attention(q, k, v, causal=causal, block_q=32, block_k=32)
+        ref = reference_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def test_gradients_match_dense(self, qkv):
+        q, k, v = qkv
+        g = jax.grad(lambda *a: flash_attention(
+            *a, block_q=32, block_k=32).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(*a).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_block_clamping_to_short_seq(self, qkv):
+        q, k, v = qkv      # seq 64 < default blocks: must clamp, not raise
+        o = flash_attention(q, k, v)
+        np.testing.assert_allclose(o, reference_attention(q, k, v), atol=2e-5)
+
+    def test_indivisible_seq_raises(self):
+        q = jnp.zeros((1, 65, 2, 32))
+        with pytest.raises(ValueError):
+            flash_attention(q, q, q, block_q=32, block_k=32)
+
+
+class TestNorms:
+    @pytest.fixture(scope="class")
+    def data(self):
+        r = np.random.RandomState(1)
+        x = jnp.asarray(r.randn(4, 16, 64), jnp.float32)
+        w = jnp.asarray(1.0 + 0.1 * r.randn(64), jnp.float32)
+        b = jnp.asarray(0.1 * r.randn(64), jnp.float32)
+        return x, w, b
+
+    def test_rms_forward(self, data):
+        x, w, _ = data
+        np.testing.assert_allclose(rms_norm(x, w), rms_norm_reference(x, w),
+                                   atol=1e-6)
+
+    def test_rms_gradients(self, data):
+        x, w, _ = data
+        loss = lambda f: lambda x, w: (f(x, w) ** 2).sum()
+        g = jax.grad(loss(rms_norm), argnums=(0, 1))(x, w)
+        gr = jax.grad(loss(rms_norm_reference), argnums=(0, 1))(x, w)
+        np.testing.assert_allclose(g[0], gr[0], atol=1e-5)
+        np.testing.assert_allclose(g[1], gr[1], atol=1e-4)
+
+    def test_layer_norm_forward(self, data):
+        x, w, b = data
+        np.testing.assert_allclose(layer_norm(x, w, b),
+                                   layer_norm_reference(x, w, b), atol=1e-6)
+
+    def test_layer_norm_gradients(self, data):
+        x, w, b = data
+        loss = lambda f: lambda x, w, b: (f(x, w, b) ** 2).sum()
+        g = jax.grad(loss(layer_norm), argnums=(0, 1, 2))(x, w, b)
+        gr = jax.grad(loss(layer_norm_reference), argnums=(0, 1, 2))(x, w, b)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_bfloat16_path(self, data):
+        x, w, _ = data
+        xb, wb = x.astype(jnp.bfloat16), w.astype(jnp.bfloat16)
+        out = rms_norm(xb, wb)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            out.astype(jnp.float32), rms_norm_reference(x, w), atol=0.05)

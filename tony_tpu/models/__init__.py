@@ -1,0 +1,30 @@
+"""Model families: the workloads of BASELINE.json's progression configs.
+
+MNIST MLP/CNN (north star), ResNet-50 (8-worker DP), BERT-base (16-worker
+multi-host), and the flagship decoder LM exercising every parallel strategy
+(DP/FSDP/TP/SP/CP/EP). All plain-pytree functional models annotated with the
+logical sharding axes from tony_tpu.parallel.sharding.
+"""
+
+from tony_tpu.models import bert, mnist, resnet, transformer
+from tony_tpu.models.train import (
+    TrainState,
+    batch_sharding,
+    default_optimizer,
+    init_state,
+    make_eval_step,
+    make_train_step,
+)
+
+__all__ = [
+    "TrainState",
+    "batch_sharding",
+    "bert",
+    "default_optimizer",
+    "init_state",
+    "make_eval_step",
+    "make_train_step",
+    "mnist",
+    "resnet",
+    "transformer",
+]

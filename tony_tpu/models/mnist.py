@@ -1,0 +1,105 @@
+"""MNIST models: the north-star workload.
+
+BASELINE.json's target is the reference's ``mnist_distributed.py`` examples
+(tony-examples/mnist-tensorflow, tony-examples/mnist-pytorch) re-done
+TPU-native: same MLP/CNN-scale models, but as pjit data-parallel programs
+instead of PS/worker TF or torch all-reduce. Synthetic-data helpers keep the
+E2E suite hermetic (no dataset download in CI, mirroring the reference's
+use of the bundled MNIST tarball)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NUM_CLASSES = 10
+INPUT_DIM = 784
+
+
+def init_mlp(rng: jax.Array, hidden: int = 512, depth: int = 2,
+             dtype=jnp.float32) -> dict:
+    keys = jax.random.split(rng, depth + 1)
+    dims = [INPUT_DIM] + [hidden] * depth + [NUM_CLASSES]
+    return {
+        f"layer_{i}": {
+            "w": (jax.random.normal(keys[i], (dims[i], dims[i + 1]),
+                                    jnp.float32)
+                  * (dims[i] ** -0.5)).astype(dtype),
+            "b": jnp.zeros((dims[i + 1],), dtype),
+        }
+        for i in range(depth + 1)
+    }
+
+
+def mlp_logical_axes(params: dict) -> dict:
+    return {name: {"w": ("embed", "mlp"), "b": ("mlp",)}
+            for name in params}
+
+
+def mlp_forward(params: dict, x: jax.Array) -> jax.Array:
+    """x: [B, 784] → logits [B, 10]."""
+    n = len(params)
+    for i in range(n):
+        p = params[f"layer_{i}"]
+        x = x @ p["w"] + p["b"]
+        if i < n - 1:
+            x = jax.nn.relu(x)
+    return x.astype(jnp.float32)
+
+
+def init_cnn(rng: jax.Array, dtype=jnp.float32) -> dict:
+    """LeNet-scale convnet (the reference TF example's architecture class)."""
+    k1, k2, k3, k4 = jax.random.split(rng, 4)
+
+    def conv(key, shape):
+        fan_in = shape[0] * shape[1] * shape[2]
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "conv1": {"w": conv(k1, (5, 5, 1, 32)), "b": jnp.zeros((32,), dtype)},
+        "conv2": {"w": conv(k2, (5, 5, 32, 64)), "b": jnp.zeros((64,), dtype)},
+        "fc1": {"w": (jax.random.normal(k3, (7 * 7 * 64, 256), jnp.float32)
+                      * ((7 * 7 * 64) ** -0.5)).astype(dtype),
+                "b": jnp.zeros((256,), dtype)},
+        "fc2": {"w": (jax.random.normal(k4, (256, NUM_CLASSES), jnp.float32)
+                      * (256 ** -0.5)).astype(dtype),
+                "b": jnp.zeros((NUM_CLASSES,), dtype)},
+    }
+
+
+def cnn_forward(params: dict, x: jax.Array) -> jax.Array:
+    """x: [B, 784] or [B, 28, 28, 1] → logits [B, 10]."""
+    if x.ndim == 2:
+        x = x.reshape(-1, 28, 28, 1)
+    for name in ("conv1", "conv2"):
+        p = params[name]
+        x = jax.lax.conv_general_dilated(
+            x, p["w"], window_strides=(1, 1), padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        x = jax.nn.relu(x + p["b"])
+        x = jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, 2, 2, 1), (1, 2, 2, 1), "VALID")
+    x = x.reshape(x.shape[0], -1)
+    x = jax.nn.relu(x @ params["fc1"]["w"] + params["fc1"]["b"])
+    return (x @ params["fc2"]["w"] + params["fc2"]["b"]).astype(jnp.float32)
+
+
+def nll_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.take_along_axis(logp, labels[:, None], axis=-1).mean()
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return (logits.argmax(-1) == labels).mean()
+
+
+def synthetic_batch(rng: jax.Array, batch_size: int) -> dict:
+    """Deterministic, learnable synthetic MNIST: images are class-dependent
+    patterns + noise, so a correct training loop visibly reduces loss."""
+    k1, k2 = jax.random.split(rng)
+    labels = jax.random.randint(k1, (batch_size,), 0, NUM_CLASSES)
+    base = jax.nn.one_hot(labels, NUM_CLASSES)
+    pattern = jnp.tile(base, (1, INPUT_DIM // NUM_CLASSES + 1))[:, :INPUT_DIM]
+    noise = jax.random.normal(k2, (batch_size, INPUT_DIM)) * 0.3
+    return {"image": pattern + noise, "label": labels}

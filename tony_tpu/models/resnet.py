@@ -1,0 +1,153 @@
+"""ResNet-50 (v1.5), functional, for the 8-worker data-parallel config.
+
+BASELINE.json's progression names "ClusterSubmitter ResNet-50/ImageNet
+(8 workers, data-parallel)"; this is that model, TPU-first:
+
+- NHWC layout (TPU conv native), bf16 compute, f32 BN statistics.
+- BatchNorm as explicit state (params vs. batch_stats pytrees). Under pjit
+  with the batch sharded over dp, the mean/var reductions are GLOBAL —
+  XLA inserts the cross-replica psum, giving sync-BN semantics for free
+  (the reference's per-GPU local BN needed explicit sync to match).
+- No flax dependency: plain pytrees keep the logical-axis sharding rules
+  uniform with the transformer family.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+STAGE_SIZES = {50: (3, 4, 6, 3), 101: (3, 4, 23, 3), 152: (3, 8, 36, 3)}
+
+
+def _conv_init(key, shape, dtype):
+    fan_out = shape[0] * shape[1] * shape[3]   # He init, fan-out (torch parity)
+    return (jax.random.normal(key, shape, jnp.float32)
+            * ((2.0 / fan_out) ** 0.5)).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _bn_stats(c):
+    return {"mean": jnp.zeros((c,), jnp.float32),
+            "var": jnp.ones((c,), jnp.float32)}
+
+
+def init_resnet(rng: jax.Array, depth: int = 50, num_classes: int = 1000,
+                dtype=jnp.bfloat16) -> tuple[dict, dict]:
+    """Returns (params, batch_stats)."""
+    if depth not in STAGE_SIZES:
+        raise ValueError(f"unsupported depth {depth}")
+    sizes = STAGE_SIZES[depth]
+    keys = iter(jax.random.split(rng, 200))
+    params: dict = {"stem": {"conv": _conv_init(next(keys), (7, 7, 3, 64),
+                                                dtype),
+                             "bn": _bn_init(64, dtype)}}
+    stats: dict = {"stem": _bn_stats(64)}
+    in_c = 64
+    for si, blocks in enumerate(sizes):
+        width = 64 * (2 ** si)
+        out_c = width * 4
+        for bi in range(blocks):
+            name = f"stage{si}_block{bi}"
+            p = {
+                "conv1": _conv_init(next(keys), (1, 1, in_c, width), dtype),
+                "bn1": _bn_init(width, dtype),
+                "conv2": _conv_init(next(keys), (3, 3, width, width), dtype),
+                "bn2": _bn_init(width, dtype),
+                "conv3": _conv_init(next(keys), (1, 1, width, out_c), dtype),
+                "bn3": _bn_init(out_c, dtype),
+            }
+            s = {"bn1": _bn_stats(width), "bn2": _bn_stats(width),
+                 "bn3": _bn_stats(out_c)}
+            if bi == 0:
+                p["proj"] = _conv_init(next(keys), (1, 1, in_c, out_c), dtype)
+                p["proj_bn"] = _bn_init(out_c, dtype)
+                s["proj_bn"] = _bn_stats(out_c)
+            params[name] = p
+            stats[name] = s
+            in_c = out_c
+    params["head"] = {
+        "w": (jax.random.normal(next(keys), (in_c, num_classes), jnp.float32)
+              * (in_c ** -0.5)).astype(dtype),
+        "b": jnp.zeros((num_classes,), dtype),
+    }
+    return params, stats
+
+
+def _conv(x, w, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def _batch_norm(x, p, s, train: bool, momentum=0.9, eps=1e-5):
+    """Returns (y, new_stats). Reductions over (N,H,W) are global under pjit
+    when N is dp-sharded — sync-BN by construction."""
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = xf.mean(axis=(0, 1, 2))
+        var = xf.var(axis=(0, 1, 2))
+        new_s = {"mean": momentum * s["mean"] + (1 - momentum) * mean,
+                 "var": momentum * s["var"] + (1 - momentum) * var}
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = jax.lax.rsqrt(var + eps)
+    y = (x.astype(jnp.float32) - mean) * inv
+    y = y * p["scale"].astype(jnp.float32) + p["bias"].astype(jnp.float32)
+    return y.astype(x.dtype), new_s
+
+
+def _bottleneck(x, p, s, stride: int, train: bool):
+    new_s = {}
+    shortcut = x
+    if "proj" in p:
+        shortcut = _conv(x, p["proj"], stride)
+        shortcut, new_s["proj_bn"] = _batch_norm(shortcut, p["proj_bn"],
+                                                 s["proj_bn"], train)
+    h = _conv(x, p["conv1"])
+    h, new_s["bn1"] = _batch_norm(h, p["bn1"], s["bn1"], train)
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv2"], stride)        # v1.5: stride on the 3x3
+    h, new_s["bn2"] = _batch_norm(h, p["bn2"], s["bn2"], train)
+    h = jax.nn.relu(h)
+    h = _conv(h, p["conv3"])
+    h, new_s["bn3"] = _batch_norm(h, p["bn3"], s["bn3"], train)
+    return jax.nn.relu(h + shortcut), new_s
+
+
+def forward(params: dict, stats: dict, x: jax.Array, depth: int = 50,
+            train: bool = True) -> tuple[jax.Array, dict]:
+    """x: [B, H, W, 3] → (logits f32, new_batch_stats)."""
+    sizes = STAGE_SIZES[depth]
+    new_stats: dict = {}
+    h = _conv(x, params["stem"]["conv"], stride=2)
+    h, new_stats["stem"] = _batch_norm(h, params["stem"]["bn"], stats["stem"],
+                                       train)
+    h = jax.nn.relu(h)
+    h = jax.lax.reduce_window(h, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for si, blocks in enumerate(sizes):
+        for bi in range(blocks):
+            name = f"stage{si}_block{bi}"
+            stride = 2 if (bi == 0 and si > 0) else 1
+            h, new_stats[name] = _bottleneck(h, params[name], stats[name],
+                                             stride, train)
+    h = h.mean(axis=(1, 2))                 # global average pool
+    logits = (h @ params["head"]["w"] + params["head"]["b"])
+    return logits.astype(jnp.float32), new_stats
+
+
+def classification_loss(params, stats, batch, depth=50):
+    logits, new_stats = forward(params, stats, batch["image"], depth,
+                                train=True)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    loss = -jnp.take_along_axis(logp, batch["label"][:, None],
+                                axis=-1).mean()
+    return loss, new_stats

@@ -1,0 +1,106 @@
+"""Sharded train-step builder: loss → pjit-compiled SPMD update.
+
+The TPU-native replacement for what the reference leaves entirely to user
+TF/PyTorch code (SURVEY.md §2.3: PS/worker and all-reduce DP live in
+tony-examples, not the framework). Here the framework owns the recipe:
+params live device-sharded per logical-axis rules, the batch arrives sharded
+over dp/fsdp, jax.grad + optax run under jit over the global mesh, and XLA
+inserts the gradient psum/reduce-scatter collectives that NCCL all-reduce
+performed in the reference's PyTorch example (tony-examples/mnist-pytorch/
+mnist_distributed.py:113-126).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tony_tpu.parallel.sharding import (DEFAULT_RULES, Rules,
+                                        logical_sharding, param_shardings,
+                                        shard_pytree)
+
+
+# Train state is a plain dict pytree: {"params", "opt_state", "step"}.
+TrainState = dict
+
+
+def init_state(params: Any, optimizer: optax.GradientTransformation,
+               mesh: Mesh | None = None, axes: Any = None,
+               rules: Rules = DEFAULT_RULES) -> TrainState:
+    """Build (and, given a mesh, device-shard) the train state."""
+    if mesh is not None and axes is not None:
+        params = shard_pytree(params, axes, mesh, rules)
+    opt_state = optimizer.init(params)
+    return {"params": params, "opt_state": opt_state,
+            "step": jnp.zeros((), jnp.int32)}
+
+
+def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
+                    optimizer: optax.GradientTransformation,
+                    mesh: Mesh | None = None,
+                    donate: bool = True) -> Callable:
+    """Compile ``state, batch → state, metrics``.
+
+    ``loss_fn(params, batch) -> scalar``. Under a mesh the step runs as one
+    SPMD program; gradients of replicated params are reduced by XLA
+    automatically (no explicit all-reduce anywhere).
+    """
+
+    def step(state: TrainState, batch: Any):
+        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                              state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        gnorm = optax.global_norm(grads)
+        new_state = {"params": params, "opt_state": opt_state,
+                     "step": state["step"] + 1}
+        return new_state, {"loss": loss, "grad_norm": gnorm,
+                           "step": new_state["step"]}
+
+    jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
+    if mesh is None:
+        return jitted
+
+    def sharded_step(state, batch):
+        # set_mesh must wrap the CALL, not the traced body: the ambient mesh
+        # is what lets bare-PartitionSpec sharding constraints resolve.
+        with jax.set_mesh(mesh):
+            return jitted(state, batch)
+
+    return sharded_step
+
+
+def batch_sharding(mesh: Mesh, rules: Rules = DEFAULT_RULES,
+                   logical: tuple = ("batch",)) -> NamedSharding:
+    """Sharding for input batches: batch dim over dp/fsdp, rest replicated
+    (callers append dims, e.g. ("batch", "seq") for token arrays)."""
+    return logical_sharding(logical, mesh, rules)
+
+
+def make_eval_step(loss_fn: Callable[[Any, Any], jax.Array],
+                   mesh: Mesh | None = None) -> Callable:
+    jitted = jax.jit(lambda params, batch: loss_fn(params, batch))
+    if mesh is None:
+        return jitted
+
+    def sharded(params, batch):
+        with jax.set_mesh(mesh):
+            return jitted(params, batch)
+    return sharded
+
+
+def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
+                      warmup_steps: int = 100,
+                      total_steps: int = 10_000) -> optax.GradientTransformation:
+    """AdamW + linear warmup→cosine decay, the standard LM recipe."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
+    return optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(sched, weight_decay=weight_decay),
+    )

@@ -1,0 +1,252 @@
+"""Flagship decoder-only transformer LM, TPU-first.
+
+The model family the framework's parallelism layer is designed around (the
+reference ships only MNIST example scripts — tony-examples/ — because it
+delegates all compute; SURVEY.md §2.3 flags TP/SP/CP/EP as green-field
+obligations for this build). Design choices, each mapped to the hardware:
+
+- **bfloat16 everywhere, f32 where it matters**: params/activations bf16 for
+  MXU throughput; logits, softmax and loss in f32.
+- **Stacked layers + lax.scan**: one compiled block body instead of L copies
+  (compile time, icache); pairs with ``jax.checkpoint`` for remat and with
+  the pipeline layer (same [L, ...] leading-stage layout).
+- **Logical-axis annotations**: every param carries logical axes resolved by
+  tony_tpu.parallel.sharding rules, so DP→FSDP→TP+SP→EP is a mesh/rule
+  change, not a model change.
+- **Flash attention** (tony_tpu.ops) on TPU; ring attention over the ``cp``
+  mesh axis for long context; dense reference elsewhere.
+- **RoPE** positions (no position-embedding table to shard), RMSNorm,
+  SwiGLU MLP — the standard modern decoder block.
+- Optional **MoE** MLP (gshard dispatch over ``ep``) per
+  tony_tpu.parallel.moe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.norms import rms_norm_reference
+from tony_tpu.parallel.moe import moe_ffn
+from tony_tpu.parallel.ring_attention import ring_attention
+from tony_tpu.parallel.sharding import DEFAULT_RULES, constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_layers: int = 8
+    n_heads: int = 8
+    d_ff: int = 2048
+    max_seq: int = 2048
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    # MoE: 0 experts = dense MLP
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    def scaled(self, **overrides) -> "TransformerConfig":
+        return dataclasses.replace(self, **overrides)
+
+
+# Preset sizes (BASELINE.json progression: ... → BERT-base scale → beyond)
+PRESETS = {
+    "tiny": TransformerConfig(d_model=128, n_layers=2, n_heads=4, d_ff=512,
+                              vocab_size=1024, max_seq=256),
+    "small": TransformerConfig(d_model=512, n_layers=8, n_heads=8, d_ff=2048),
+    "base": TransformerConfig(d_model=768, n_layers=12, n_heads=12,
+                              d_ff=3072),
+    "large": TransformerConfig(d_model=1536, n_layers=24, n_heads=16,
+                               d_ff=6144),
+}
+
+
+def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
+    """Initialize the parameter pytree. Layer params are stacked [L, ...]."""
+    k_emb, k_blocks, k_out = jax.random.split(rng, 3)
+    d, h, hd, f, L = (cfg.d_model, cfg.n_heads, cfg.head_dim, cfg.d_ff,
+                      cfg.n_layers)
+    dt = cfg.dtype
+
+    def dense(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dt)
+
+    ks = jax.random.split(k_blocks, 8)
+    block = {
+        "attn_norm": jnp.ones((L, d), dt),
+        "wq": dense(ks[0], (L, d, h, hd), d),
+        "wk": dense(ks[1], (L, d, h, hd), d),
+        "wv": dense(ks[2], (L, d, h, hd), d),
+        "wo": dense(ks[3], (L, h, hd, d), d),
+        "mlp_norm": jnp.ones((L, d), dt),
+    }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        # experts are 2-matrix MLPs (silu): dispatch/combine already cost
+        # two extra einsums, so the gshard path skips the gated "up" branch
+        block.update({
+            "router": dense(ks[4], (L, d, e), d).astype(jnp.float32),
+            "w_gate": dense(ks[5], (L, e, d, f), d),
+            "w_down": dense(ks[7], (L, e, f, d), f),
+        })
+    else:
+        block.update({
+            "w_gate": dense(ks[5], (L, d, f), d),
+            "w_up": dense(ks[6], (L, d, f), d),
+            "w_down": dense(ks[7], (L, f, d), f),
+        })
+    return {
+        "embed": (jax.random.normal(k_emb, (cfg.vocab_size, d), jnp.float32)
+                  * (d ** -0.5)).astype(dt),
+        "blocks": block,
+        "final_norm": jnp.ones((d,), dt),
+        "lm_head": dense(k_out, (d, cfg.vocab_size), d),
+    }
+
+
+def logical_axes(cfg: TransformerConfig) -> dict:
+    """Logical-axis pytree matching init_params (leading axis = "stage" so
+    the same layout drives FSDP sharding and pipeline stage assignment)."""
+    block = {
+        "attn_norm": ("stage", "norm"),
+        "wq": ("stage", "embed", "heads", "kv"),
+        "wk": ("stage", "embed", "heads", "kv"),
+        "wv": ("stage", "embed", "heads", "kv"),
+        "wo": ("stage", "heads", "kv", "embed"),
+        "mlp_norm": ("stage", "norm"),
+    }
+    if cfg.num_experts:
+        block.update({
+            "router": ("stage", "embed", None),
+            "w_gate": ("stage", "expert", "embed", "mlp"),
+            "w_down": ("stage", "expert", "mlp", "embed"),
+        })
+    else:
+        block.update({
+            "w_gate": ("stage", "embed", "mlp"),
+            "w_up": ("stage", "embed", "mlp"),
+            "w_down": ("stage", "mlp", "embed"),
+        })
+    return {
+        "embed": ("vocab", "embed"),
+        "blocks": block,
+        "final_norm": ("norm",),
+        "lm_head": ("embed", "vocab"),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotary embeddings on [B, S, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = jnp.exp(-jnp.arange(0, half, dtype=jnp.float32)
+                    * (jnp.log(10000.0) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs   # [B, S, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
+
+
+def _attention(q, k, v, mesh: Mesh | None):
+    if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
+        return ring_attention(q, k, v, mesh, causal=True)
+    if jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, causal=True)
+    return reference_attention(q, k, v, causal=True)
+
+
+def _block(x, p, cfg: TransformerConfig, mesh, rules):
+    """One decoder block. x: [B, S, D]; p: this layer's params (unstacked)."""
+    b, s, d = x.shape
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    h = rms_norm_reference(x, p["attn_norm"])
+    h = constrain(h, ("batch", "seq", "embed"), mesh, rules)
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+    q, k = _rope(q, positions), _rope(k, positions)
+    q = constrain(q, ("batch", "seq", "heads", "kv"), mesh, rules)
+    k = constrain(k, ("batch", "seq", "heads", "kv"), mesh, rules)
+    v = constrain(v, ("batch", "seq", "heads", "kv"), mesh, rules)
+    o = _attention(q, k, v, mesh)
+    attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+    x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh, rules)
+
+    h = rms_norm_reference(x, p["mlp_norm"])
+    h = constrain(h, ("batch", "seq", "embed"), mesh, rules)
+    if "router" in p:
+        moe_out, metrics = moe_ffn(
+            h, p["router"], p["w_gate"], p["w_down"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            activation=jax.nn.silu)
+        aux = metrics.aux_loss
+        mlp_out = moe_out
+    else:
+        gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
+        inner = jax.nn.silu(gate) * up
+        inner = constrain(inner, ("batch", "seq", "mlp"), mesh, rules)
+        mlp_out = jnp.einsum("bsf,fd->bsd", inner, p["w_down"])
+        aux = jnp.zeros((), jnp.float32)
+    x = x + constrain(mlp_out, ("batch", "seq", "embed"), mesh, rules)
+    return x, aux
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+            mesh: Mesh | None = None, rules=DEFAULT_RULES) -> tuple:
+    """tokens [B, S] int32 → (logits [B, S, V] f32, aux_loss scalar)."""
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    block_fn = functools.partial(_block, cfg=cfg, mesh=mesh, rules=rules)
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def scan_body(x, layer_params):
+        x, aux = block_fn(x, layer_params)
+        return x, aux
+
+    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+    x = rms_norm_reference(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    logits = constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+    return logits, auxes.sum()
+
+
+def lm_loss(params: dict, batch: dict, cfg: TransformerConfig,
+            mesh: Mesh | None = None, rules=DEFAULT_RULES) -> jax.Array:
+    """Next-token cross-entropy. batch: {"tokens": [B, S]} (shift inside) or
+    {"inputs", "targets"}; ignores targets == -1."""
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    logits, aux = forward(params, inputs, cfg, mesh, rules)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    mask = (targets >= 0).astype(jnp.float32)
+    ll = jnp.take_along_axis(
+        logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+    loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return loss + cfg.moe_aux_weight * aux

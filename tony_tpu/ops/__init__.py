@@ -1,0 +1,23 @@
+"""Fused TPU ops (Pallas kernels) with dense-jnp correctness oracles.
+
+The compute-kernel layer the reference never needed (it shipped no compute —
+SURVEY.md §2): flash attention and fused norms sized for MXU/VMEM, running
+in interpret mode on non-TPU backends for tests.
+"""
+
+from tony_tpu.ops.attention import flash_attention, reference_attention
+from tony_tpu.ops.norms import (
+    layer_norm,
+    layer_norm_reference,
+    rms_norm,
+    rms_norm_reference,
+)
+
+__all__ = [
+    "flash_attention",
+    "layer_norm",
+    "layer_norm_reference",
+    "reference_attention",
+    "rms_norm",
+    "rms_norm_reference",
+]
