@@ -1,0 +1,28 @@
+"""Data-feed IO: sharded file-split reading into sharded jax.Arrays.
+
+TPU-native rebuild of the reference's data-feed layer (reference: tony-core/
+src/main/java/com/linkedin/tony/io/HdfsAvroFileSplitReader.java, reached from
+Python over py4j per TaskExecutor.java:281). Components:
+
+  split      — global contiguous byte-range split math (reference :286-297)
+  reader     — FileSplitReader: C++ prefetch/shuffle engine via ctypes
+               (native/datafeed.cc) with a pure-Python fallback
+  jax_feed   — decode to ndarray + assemble global sharded jax.Arrays via
+               jax.make_array_from_process_local_data
+"""
+
+from tony_tpu.io.split import (FileSegment, compute_read_info,
+                               full_records_in_split, split_length,
+                               split_start)
+from tony_tpu.io.reader import DataFeedError, FileSplitReader
+from tony_tpu.io.jax_feed import (array_batches, global_batches,
+                                  record_size_for, records_to_array,
+                                  to_global_array)
+
+__all__ = [
+    "FileSegment", "compute_read_info", "full_records_in_split",
+    "split_start", "split_length",
+    "FileSplitReader", "DataFeedError",
+    "array_batches", "global_batches", "record_size_for", "records_to_array",
+    "to_global_array",
+]

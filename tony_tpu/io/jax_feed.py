@@ -1,0 +1,123 @@
+"""Bridge from the per-task data feed into sharded ``jax.Array``s.
+
+The reference hands batches to user TF/PyTorch code over py4j and stops there
+(reference: HdfsAvroFileSplitReader.java:103-133 — bytes / in-mem file /
+local-spill delivery). On TPU the natural delivery target is a *global*
+``jax.Array``: each process reads only its split (FileSplitReader) and
+``jax.make_array_from_process_local_data`` assembles the global batch over
+the mesh's data axes — the SPMD-native version of "three batch delivery
+modes" (SURVEY.md §7 step 9).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Iterator
+
+import numpy as np
+
+from tony_tpu.io.reader import FileSplitReader
+from tony_tpu.io.split import full_records_in_split
+
+log = logging.getLogger(__name__)
+
+
+def records_to_array(records: list[bytes], dtype,
+                     row_shape: tuple[int, ...]) -> np.ndarray:
+    """Decode fixed-size records into a [batch, *row_shape] ndarray."""
+    if not records:
+        return np.empty((0, *row_shape), dtype=dtype)
+    flat = np.frombuffer(b"".join(records), dtype=dtype)
+    return flat.reshape(len(records), *row_shape)
+
+
+def record_size_for(dtype, row_shape: tuple[int, ...]) -> int:
+    """Bytes per fixed-size record holding one ``dtype``-typed row."""
+    return int(np.dtype(dtype).itemsize * np.prod(row_shape, dtype=np.int64))
+
+
+def array_batches(reader: FileSplitReader, batch_size: int, dtype,
+                  row_shape: tuple[int, ...],
+                  drop_remainder: bool = True) -> Iterator[np.ndarray]:
+    """Iterate the reader's split as fixed-size [batch, *row_shape] arrays.
+
+    Short tail records (a file whose size is not a record multiple) are
+    dropped — they cannot form a full row.
+    """
+    rec_bytes = record_size_for(dtype, row_shape)
+    warned = False
+    while True:
+        records = reader.next_batch(batch_size)
+        while 0 < len(records) < batch_size:
+            more = reader.next_batch(batch_size - len(records))
+            if not more:
+                break
+            records.extend(more)
+        full = [r for r in records if len(r) == rec_bytes]
+        if len(full) < len(records) and not warned:
+            warned = True
+            log.warning("dropping %d short tail record(s) (< %d bytes)",
+                        len(records) - len(full), rec_bytes)
+        if not full:
+            return
+        if len(full) < batch_size and drop_remainder:
+            return
+        yield records_to_array(full, dtype, row_shape)
+
+
+def to_global_array(local_batch: np.ndarray, mesh,
+                    batch_axes: tuple[str, ...] = ("dp",)):
+    """Assemble each process's local batch into one global jax.Array sharded
+    along the mesh's data axes (leading dim), replicated elsewhere."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axes = tuple(a for a in batch_axes if a in mesh.axis_names)
+    if batch_axes and not axes:
+        # Silent fallback to P(None) would REPLICATE per-process-distinct
+        # data — garbage "global" batches on multi-host. Demand an explicit
+        # batch_axes=() for intentional replication.
+        raise ValueError(
+            f"none of batch_axes {batch_axes} exist in mesh axes "
+            f"{mesh.axis_names}; pass batch_axes=() for replication")
+    sharding = NamedSharding(mesh, P(axes if axes else None))
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def global_batches(paths: list[str], batch_size_per_process: int, dtype,
+                   row_shape: tuple[int, ...], mesh,
+                   batch_axes: tuple[str, ...] = ("dp",),
+                   shuffle: bool = False, seed: int = 0,
+                   process_index: int | None = None,
+                   process_count: int | None = None):
+    """End-to-end feed: split files across processes, read + decode locally,
+    assemble global sharded batches. The one-call path a training loop uses::
+
+        for batch in global_batches(paths, 32, np.float32, (28, 28), mesh):
+            state, metrics = train_step(state, batch)
+
+    Every process yields the SAME number of batches — the minimum over all
+    processes' full-batch counts, computed deterministically from file sizes
+    (no communication) — so the jitted-step loop cannot deadlock multi-host
+    when splits land unequal record counts.
+    """
+    import jax
+
+    pid = jax.process_index() if process_index is None else process_index
+    pcount = jax.process_count() if process_count is None else process_count
+    record_size = record_size_for(dtype, row_shape)
+    sizes = [os.path.getsize(p) for p in paths]
+    num_batches = min(
+        full_records_in_split(paths, i, pcount, record_size, sizes=sizes)
+        // batch_size_per_process
+        for i in range(pcount))
+    reader = FileSplitReader(
+        paths, task_index=pid, task_num=pcount, record_size=record_size,
+        shuffle=shuffle, seed=seed, sizes=sizes)
+    try:
+        it = array_batches(reader, batch_size_per_process, dtype, row_shape)
+        for _ in range(num_batches):
+            yield to_global_array(next(it), mesh, batch_axes)
+    finally:
+        reader.close()

@@ -1,0 +1,88 @@
+"""Lazy on-demand build + ctypes load of the native data-feed library.
+
+The reference ships its data-feed engine pre-built inside the fat jar; here
+the C++ core is compiled once per host (g++ -O2 -shared -fPIC -pthread) into
+a cache directory and memoized. Loading is best-effort: callers fall back to
+the pure-Python reader when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+
+log = logging.getLogger(__name__)
+
+_SRC = os.path.join(os.path.dirname(__file__), "datafeed.cc")
+_LIB_NAME = "_tony_datafeed.so"
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _cache_dir() -> str:
+    base = os.environ.get("TONY_NATIVE_CACHE") or os.path.join(
+        os.environ.get("XDG_CACHE_HOME")
+        or os.path.join(os.path.expanduser("~"), ".cache"),
+        "tony_tpu")
+    os.makedirs(base, exist_ok=True)
+    return base
+
+
+def _compile(lib_path: str) -> bool:
+    cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           _SRC, "-o", lib_path]
+    try:
+        # Build into a temp name then rename: atomic against concurrent
+        # executors on the same host racing to build the cache entry.
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=os.path.dirname(lib_path))
+        os.close(fd)
+        cmd[-1] = tmp
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, lib_path)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        log.info("native data-feed build unavailable (%s); using python path", e)
+        return False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    lib.tdf_open.restype = ctypes.c_void_p
+    lib.tdf_open.argtypes = [
+        ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_int32, ctypes.c_int32, ctypes.c_uint64]
+    lib.tdf_next_batch.restype = ctypes.c_int64
+    lib.tdf_next_batch.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64]
+    lib.tdf_error.restype = ctypes.c_char_p
+    lib.tdf_error.argtypes = [ctypes.c_void_p]
+    lib.tdf_close.restype = None
+    lib.tdf_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+def load_native() -> ctypes.CDLL | None:
+    """The memoized native library, or None when it can't be built/loaded."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        lib_path = os.path.join(_cache_dir(), _LIB_NAME)
+        try:
+            if (not os.path.exists(lib_path)
+                    or os.path.getmtime(lib_path) < os.path.getmtime(_SRC)):
+                if not _compile(lib_path):
+                    _load_failed = True
+                    return None
+            _lib = _bind(ctypes.CDLL(lib_path))
+        except OSError as e:
+            log.info("native data-feed load failed (%s); using python path", e)
+            _load_failed = True
+            return None
+        return _lib
