@@ -25,6 +25,7 @@ PY = sys.executable
 def make_client(tmp_path, command, confs=None, shell_env=None, src_dir=None):
     base = {
         "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": str(tmp_path / "tony-history"),
         "tony.application.timeout": "60000",   # safety net for the suite
     }
     base.update(confs or {})
@@ -164,7 +165,7 @@ class TestE2E:
         client = make_client(tmp_path, fixture_cmd("exit_0.py"),
                              {"tony.worker.instances": "1"})
         assert client.run() == 0
-        hist_dir = os.path.join(client.job_dir, "history")
+        hist_dir = client.conf.get("tony.history.location")
         files = find_job_files(hist_dir)
         assert len(files) == 1 and files[0].endswith(".jhist")
         types = [e.event_type for e in parse_events(files[0])]

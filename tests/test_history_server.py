@@ -1,0 +1,236 @@
+"""History server tests (mirrors the reference's Play controller tests in
+tony-history-server/test/controllers/): index listing, intermediate→finished
+migration, per-job events/config pages, JSON API, caching, retention."""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events.events import EventHandler, history_file_name
+from tony_tpu.history import (HistoryDirs, HistoryServer, TTLCache,
+                              migrate_finished, purge_expired)
+from tony_tpu.history.server import config_file_name
+
+
+def _write_job(intermediate: str, app_id: str, status: str = "SUCCEEDED",
+               user: str = "alice", with_config: bool = True) -> str:
+    """Write a complete jhist (+ config) via the real EventHandler."""
+    handler = EventHandler(intermediate, app_id, user)
+    handler.start()
+    handler.emit("APPLICATION_INITED", app_id=app_id, num_tasks=2,
+                 host="localhost")
+    handler.emit("APPLICATION_FINISHED", app_id=app_id,
+                 failed=status != "SUCCEEDED")
+    path = handler.stop(status)
+    if with_config:
+        conf = TonyConfig({"tony.worker.instances": "2",
+                           "tony.application.name": app_id})
+        conf.write_xml(os.path.join(intermediate, config_file_name(app_id)))
+    return path
+
+
+@pytest.fixture
+def dirs(tmp_path):
+    d = HistoryDirs(str(tmp_path / "hist"),
+                    str(tmp_path / "hist" / "intermediate"),
+                    str(tmp_path / "hist" / "finished"))
+    d.ensure()
+    return d
+
+
+@pytest.fixture
+def server(dirs):
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: dirs.location,
+        K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+        K.HISTORY_FINISHED_KEY: dirs.finished,
+    })
+    s = HistoryServer(conf, port=0)
+    s.start()
+    yield s
+    s.stop()
+
+
+def _get(server, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{server.port}{path}", timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def test_migration_moves_finished_to_dated_dirs(dirs):
+    """Reference: JobsMetadataPageController.java:49-72 moves completed jobs
+    intermediate → finished/yyyy/mm/dd; in-progress jobs stay."""
+    _write_job(dirs.intermediate, "application_1_0001")
+    # an in-progress job (no completed ts, .inprogress suffix) must NOT move
+    inprog = os.path.join(
+        dirs.intermediate,
+        history_file_name("application_1_0002", int(time.time() * 1000),
+                          "bob", in_progress=True))
+    with open(inprog, "w", encoding="utf-8"):
+        pass
+    moved = migrate_finished(dirs)
+    assert len(moved) == 1
+    # dated layout finished/yyyy/mm/dd/<name>
+    rel = os.path.relpath(moved[0], dirs.finished)
+    parts = rel.split(os.sep)
+    assert len(parts) == 4 and all(p.isdigit() for p in parts[:3])
+    # config moved alongside
+    assert os.path.exists(os.path.join(
+        os.path.dirname(moved[0]), config_file_name("application_1_0001")))
+    assert os.path.exists(inprog)
+    assert not os.path.exists(os.path.join(
+        dirs.intermediate, os.path.basename(moved[0])))
+
+
+def test_index_lists_jobs_and_migrates(server, dirs):
+    _write_job(dirs.intermediate, "application_2_0001")
+    _write_job(dirs.intermediate, "application_2_0002", status="FAILED",
+               user="bob")
+    status, body = _get(server, "/")
+    assert status == 200
+    assert "application_2_0001" in body and "application_2_0002" in body
+    assert "FAILED" in body and "SUCCEEDED" in body
+    # index load migrated them out of intermediate
+    assert not any(n.endswith(".jhist")
+                   for n in os.listdir(dirs.intermediate))
+
+
+def test_events_page_and_api(server, dirs):
+    _write_job(dirs.intermediate, "application_3_0001")
+    status, body = _get(server, "/jobs/application_3_0001")
+    assert status == 200
+    assert "APPLICATION_INITED" in body and "APPLICATION_FINISHED" in body
+    status, body = _get(server, "/api/jobs/application_3_0001/events")
+    events = json.loads(body)
+    assert [e["event_type"] for e in events] == [
+        "APPLICATION_INITED", "APPLICATION_FINISHED"]
+    assert events[0]["payload"]["num_tasks"] == 2
+
+
+def test_config_page_and_api(server, dirs):
+    _write_job(dirs.intermediate, "application_4_0001")
+    status, body = _get(server, "/config/application_4_0001")
+    assert status == 200 and "tony.worker.instances" in body
+    status, body = _get(server, "/api/jobs/application_4_0001/config")
+    assert json.loads(body)["tony.worker.instances"] == "2"
+
+
+def test_unknown_job_404(server):
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server, "/jobs/no_such_app")
+    assert exc.value.code == 404
+
+
+def test_api_jobs_json(server, dirs):
+    _write_job(dirs.intermediate, "application_5_0001")
+    status, body = _get(server, "/api/jobs")
+    jobs = json.loads(body)
+    assert len(jobs) == 1
+    assert jobs[0]["app_id"] == "application_5_0001"
+    assert jobs[0]["status"] == "SUCCEEDED"
+    assert jobs[0]["user"] == "alice"
+
+
+def test_retention_purges_old_finished(dirs):
+    """Files completed before the retention window are deleted."""
+    old_ms = int((time.time() - 7200) * 1000)
+    name = history_file_name("application_6_0001", old_ms - 1000, "alice",
+                             completed_ms=old_ms, status="SUCCEEDED")
+    dest = os.path.join(dirs.finished, "2020", "01", "01")
+    os.makedirs(dest)
+    with open(os.path.join(dest, name), "w", encoding="utf-8"):
+        pass
+    assert purge_expired(dirs, retention_s=3600) == 1
+    assert not os.path.exists(os.path.join(dest, name))
+    # fresh file survives
+    fresh = history_file_name("application_6_0002",
+                              int(time.time() * 1000) - 1000, "alice",
+                              completed_ms=int(time.time() * 1000),
+                              status="SUCCEEDED")
+    with open(os.path.join(dest, fresh), "w", encoding="utf-8"):
+        pass
+    assert purge_expired(dirs, retention_s=3600) == 0
+    assert os.path.exists(os.path.join(dest, fresh))
+
+
+def test_ttl_cache_memoises_and_expires():
+    calls = []
+    cache = TTLCache(ttl_s=0.2)
+    assert cache.get_or_load("k", lambda: calls.append(1) or "v") == "v"
+    assert cache.get_or_load("k", lambda: calls.append(1) or "v2") == "v"
+    assert len(calls) == 1
+    time.sleep(0.25)
+    assert cache.get_or_load("k", lambda: calls.append(1) or "v2") == "v2"
+    assert len(calls) == 2
+
+
+def test_stale_inprogress_does_not_shadow_completed(server, dirs):
+    """A crashed coordinator attempt leaves <app>.jhist.inprogress; once the
+    retry writes a completed jhist, the completed record must win and the
+    ghost file must be cleaned up."""
+    app = "application_7_0001"
+    stale = os.path.join(
+        dirs.intermediate,
+        history_file_name(app, int(time.time() * 1000) - 5000, "alice",
+                          in_progress=True))
+    with open(stale, "w", encoding="utf-8"):
+        pass
+    _write_job(dirs.intermediate, app)
+    _, body = _get(server, "/api/jobs")
+    jobs = [j for j in json.loads(body) if j["app_id"] == app]
+    assert len(jobs) == 1
+    assert jobs[0]["status"] == "SUCCEEDED"
+    assert not os.path.exists(stale)
+    # events page serves the completed run
+    _, body = _get(server, f"/api/jobs/{app}/events")
+    assert [e["event_type"] for e in json.loads(body)] == [
+        "APPLICATION_INITED", "APPLICATION_FINISHED"]
+
+
+def test_relative_history_conf_frozen_absolute(tmp_path, monkeypatch):
+    """Client must absolutize ALL history dirs (location, intermediate,
+    finished) before freezing the config."""
+    from tony_tpu.client.client import TonyClient
+    monkeypatch.chdir(tmp_path)
+    conf = TonyConfig({"tony.staging.dir": str(tmp_path / "staging"),
+                       "tony.history.intermediate": "my-hist/inter"})
+    client = TonyClient(conf, "true")
+    client.stage()
+    assert conf.get(K.HISTORY_INTERMEDIATE_KEY) == str(
+        tmp_path / "my-hist" / "inter")
+    assert os.path.isabs(conf.get(K.HISTORY_LOCATION_KEY))
+    assert os.path.isabs(conf.get(K.HISTORY_FINISHED_KEY))
+
+
+def test_concurrent_index_loads_race_free(dirs):
+    """Concurrent scans must not 500 when both observe the same pre-migration
+    snapshot (reference behavior: moves happen inside request handling)."""
+    import threading
+    conf = TonyConfig({K.HISTORY_LOCATION_KEY: dirs.location,
+                       K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+                       K.HISTORY_FINISHED_KEY: dirs.finished})
+    s = HistoryServer(conf, port=0)
+    for i in range(20):
+        _write_job(dirs.intermediate, f"application_8_{i:04d}",
+                   with_config=False)
+    errs = []
+
+    def scan():
+        try:
+            # bypass the TTL cache so both threads really scan
+            s._scan_jobs()
+        except Exception as e:  # noqa: BLE001 - recording any failure
+            errs.append(e)
+
+    threads = [threading.Thread(target=scan) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(s.list_jobs()) == 20

@@ -210,24 +210,60 @@ def test_global_batches_count_agrees_across_processes(tmp_path):
         full_records_in_split([str(p)], i, 2, rs) // 8 for i in range(2))
 
 
+def _os_thread_count() -> int:
+    # C++ std::thread producers are invisible to threading.active_count();
+    # count real kernel tasks so a leaked producer pthread fails the test.
+    return len(os.listdir("/proc/self/task"))
+
+
 def test_native_reader_finalizer_closes(tmp_path):
     if load_native() is None:
         pytest.skip("no native toolchain")
     import gc
-    import threading
+    import time
     rs = 8
     path = _write_fixed(tmp_path, "f.bin", 5000, rs)
-    before = threading.active_count()
+    before = _os_thread_count()
     for _ in range(10):
         r = FileSplitReader([path], record_size=rs, capacity=4)
         next(iter(r))      # abandon mid-iteration, no close()
         del r
     gc.collect()
     deadline = 50
-    while threading.active_count() > before and deadline:
+    while _os_thread_count() > before and deadline:
         deadline -= 1
-        import time
         time.sleep(0.05)
-    # Producer threads must not accumulate (they live in C++, but each
-    # blocked Push would pin a pthread forever without the finalizer).
-    assert threading.active_count() <= before + 1
+    # Producer threads must not accumulate (they live in C++; each blocked
+    # Push would pin a pthread forever without the finalizer).
+    assert _os_thread_count() <= before + 1
+
+
+def test_mid_stream_short_tail_does_not_drop_later_files(tmp_path):
+    # Regression: a ragged FIRST file must not end iteration while later
+    # files still hold data, and global_batches' deterministic batch count
+    # must agree with what the iterator actually yields.
+    dtype, row = np.float32, (4,)
+    rs = record_size_for(dtype, row)
+    p1 = tmp_path / "a.bin"
+    p1.write_bytes(np.arange(10 * 4, dtype=dtype).tobytes() + b"\x01\x02\x03")
+    p2 = tmp_path / "b.bin"
+    np.arange(100, 140, dtype=dtype).tofile(p2)   # 10 more full records
+    with FileSplitReader([str(p1), str(p2)], record_size=rs) as r:
+        batches = list(array_batches(r, 4, dtype, row))
+    assert sum(b.shape[0] for b in batches) == 20  # all 20 full records
+    got = np.concatenate(batches)
+    np.testing.assert_array_equal(
+        got.ravel(), np.concatenate([np.arange(40, dtype=dtype),
+                                     np.arange(100, 140, dtype=dtype)]))
+
+
+def test_reader_next_batch_after_close_returns_empty(tmp_path):
+    # Both impls must agree: next_batch on a closed reader is [], not a
+    # crash (the native path used to hand C++ a NULL handle).
+    rs = 8
+    path = _write_fixed(tmp_path, "c.bin", 64, rs)
+    for use_native in ([False, True] if load_native() else [False]):
+        r = FileSplitReader([path], record_size=rs, use_native=use_native)
+        assert r.next_batch(2)
+        r.close()
+        assert r.next_batch(2) == []

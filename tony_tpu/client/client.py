@@ -87,6 +87,15 @@ class TonyClient:
         venv = self.conf.get(K.PYTHON_VENV_KEY)
         if venv and os.path.exists(venv):
             shutil.copy(venv, os.path.join(self.job_dir, constants.TONY_VENV_ZIP))
+        # Freeze the history dirs as ABSOLUTE paths anchored at the submit
+        # cwd: the coordinator runs with cwd=job_dir, and a relative path
+        # frozen as-is would resolve somewhere a stock history server (run
+        # from the submit dir) never looks.
+        from tony_tpu.events import events as ev
+        dirs = ev.HistoryDirs.from_conf(self.conf).absolutized()
+        self.conf.set(K.HISTORY_LOCATION_KEY, dirs.location)
+        self.conf.set(K.HISTORY_INTERMEDIATE_KEY, dirs.intermediate)
+        self.conf.set(K.HISTORY_FINISHED_KEY, dirs.finished)
         self.conf.write_xml(os.path.join(self.job_dir, constants.TONY_FINAL_XML))
 
     def launch_coordinator(self, attempt: int) -> None:

@@ -141,8 +141,7 @@ class Coordinator:
             conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS_KEY, 25),
             self._on_task_dead)
         self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self))
-        history_dir = (conf.get(K.HISTORY_INTERMEDIATE_KEY) or
-                       os.path.join(self.job_dir, "history"))
+        history_dir = ev.HistoryDirs.from_conf(conf).intermediate
         self.events = ev.EventHandler(history_dir, app_id,
                                       os.environ.get("USER", "unknown"))
         self._workers_terminated = False
@@ -311,6 +310,15 @@ class Coordinator:
     # ------------------------------------------------------------------
     def run(self, user_command: str) -> int:
         self.events.start()
+        # Frozen per-job config next to the jhist so the history server's
+        # /config page can render it (reference: TonyApplicationMaster
+        # setupJobDir + writeConfigFile :458-463).
+        try:
+            self.conf.write_xml(os.path.join(
+                self.events.history_dir, ev.config_file_name(self.app_id)))
+        except Exception:
+            # Best-effort convenience file — never fail the job over it.
+            log.warning("could not write history config copy", exc_info=True)
         self.rpc_server.start()
         self.hb_monitor.start()
         addr = f"{socket.gethostname()}:{self.rpc_server.port}"

@@ -1,0 +1,11 @@
+"""Job-history server: web UI + JSON API over the events layer.
+
+Rebuild of the reference's tony-history-server Play application as a
+dependency-free stdlib HTTP server (reference: tony-history-server/app/,
+conf/routes:1-4)."""
+
+from tony_tpu.history.server import (HistoryDirs, HistoryServer, TTLCache,
+                                     migrate_finished, purge_expired)
+
+__all__ = ["HistoryDirs", "HistoryServer", "TTLCache", "migrate_finished",
+           "purge_expired"]

@@ -1,0 +1,434 @@
+"""History server: serves job metadata, per-job config, and event timelines.
+
+Rebuild of the reference's tony-history-server (a Play 2.6 web app) as a
+stdlib ``http.server`` application with the same observable behavior:
+
+- routes ``/`` (jobs index), ``/jobs/<appId>`` (event timeline),
+  ``/config/<appId>`` (frozen job config) — reference:
+  tony-history-server/conf/routes:1-3 — plus a JSON API under ``/api/``
+  for programmatic consumers (the reference exposes only HTML).
+- on every index load, *finished* jobs are migrated from the intermediate
+  dir into ``finished/yyyy/mm/dd`` keyed by completion date (reference:
+  controllers/JobsMetadataPageController.java:49-72,95).
+- parsed metadata / config / events are memoised in TTL caches keyed by
+  app id (reference: cache/CacheWrapper.java — three Guava caches).
+- required directories are created at startup (reference:
+  hadoop/Requirements.java).
+- files older than ``tony.history.retention-seconds`` are purged from the
+  finished dir (retention is this build's addition; the reference leaves
+  old jhist files forever).
+"""
+
+from __future__ import annotations
+
+import argparse
+import html
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig, parse_cli_confs
+from tony_tpu.events import events as ev
+
+log = logging.getLogger(__name__)
+
+
+# Re-exported for callers that think of them as part of the server's
+# contract; the definitions live with the filename codec in the events layer
+# so the coordinator/client share them without importing this HTTP module.
+HistoryDirs = ev.HistoryDirs
+config_file_name = ev.config_file_name
+
+
+# ---------------------------------------------------------------------------
+# Migration: intermediate -> finished/yyyy/mm/dd (reference:
+# JobsMetadataPageController.java:49-72 moveIntermediateToFinished + :95).
+# ---------------------------------------------------------------------------
+def migrate_finished(dirs: HistoryDirs) -> list[str]:
+    """Move completed jhist files (and their sibling config file) out of the
+    intermediate dir into finished/yyyy/mm/dd. Returns the new paths."""
+    moved = []
+    if not os.path.isdir(dirs.intermediate):
+        return moved
+    names = sorted(os.listdir(dirs.intermediate))
+    metas = {n: ev.JobMetadata.from_file_name(n) for n in names}
+    # One pass over the snapshot; per-app ghost lists keep the cleanup O(n).
+    inprogress_by_app: dict[str, list[str]] = {}
+    for n, m in metas.items():
+        if m and m.in_progress:
+            inprogress_by_app.setdefault(m.app_id, []).append(n)
+    for name in names:
+        meta = metas[name]
+        if meta is None or meta.in_progress or meta.completed_ms is None:
+            continue
+        when = datetime.fromtimestamp(meta.completed_ms / 1000, timezone.utc)
+        dest_dir = os.path.join(dirs.finished, f"{when.year:04d}",
+                                f"{when.month:02d}", f"{when.day:02d}")
+        os.makedirs(dest_dir, exist_ok=True)
+        src = os.path.join(dirs.intermediate, name)
+        dest = os.path.join(dest_dir, name)
+        try:
+            shutil.move(src, dest)
+        except FileNotFoundError:
+            continue    # a concurrent migration beat us to this file
+        moved.append(dest)
+        conf_src = os.path.join(dirs.intermediate,
+                                config_file_name(meta.app_id))
+        try:
+            if os.path.exists(conf_src):
+                shutil.move(conf_src, os.path.join(
+                    dest_dir, config_file_name(meta.app_id)))
+        except FileNotFoundError:
+            pass
+        # A crashed earlier coordinator attempt can leave a stale
+        # .jhist.inprogress for the same app id; once a completed jhist
+        # exists it is authoritative — drop the ghost so it can't shadow
+        # the real history.
+        for other in inprogress_by_app.pop(meta.app_id, ()):
+            try:
+                os.remove(os.path.join(dirs.intermediate, other))
+            except FileNotFoundError:
+                pass
+    return moved
+
+
+def purge_expired(dirs: HistoryDirs, retention_s: int) -> int:
+    """Delete finished jhist/config files whose completion is older than the
+    retention window. Returns the number of files removed."""
+    if retention_s <= 0:
+        return 0
+    cutoff_ms = (time.time() - retention_s) * 1000
+    removed = 0
+    for path in ev.find_job_files(dirs.finished):
+        meta = ev.JobMetadata.from_file_name(path)
+        if meta and meta.completed_ms and meta.completed_ms < cutoff_ms:
+            conf_path = os.path.join(os.path.dirname(path),
+                                     config_file_name(meta.app_id))
+            for p in (path, conf_path):
+                if os.path.exists(p):
+                    os.remove(p)
+                    removed += 1
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# Caching (reference: cache/CacheWrapper.java — Guava caches for metadata,
+# config, and events keyed by app id).
+# ---------------------------------------------------------------------------
+class TTLCache:
+    def __init__(self, ttl_s: float = 30.0, max_entries: int = 1024) -> None:
+        self.ttl_s = ttl_s
+        self.max_entries = max_entries
+        self._data: dict[object, tuple[float, object]] = {}
+        self._lock = threading.Lock()
+
+    def get_or_load(self, key, loader):
+        now = time.monotonic()
+        with self._lock:
+            hit = self._data.get(key)
+            if hit and now - hit[0] < self.ttl_s:
+                return hit[1]
+        value = loader()
+        if value is None:
+            # Not-found is not worth remembering: a job appearing a moment
+            # later must not keep 404ing for a full TTL.
+            return None
+        with self._lock:
+            if len(self._data) >= self.max_entries:
+                oldest = min(self._data, key=lambda k: self._data[k][0])
+                del self._data[oldest]
+            self._data[key] = (now, value)
+        return value
+
+    def invalidate_all(self) -> None:
+        with self._lock:
+            self._data.clear()
+
+
+# ---------------------------------------------------------------------------
+# The server.
+# ---------------------------------------------------------------------------
+_PAGE = """<!doctype html><html><head><meta charset="utf-8">
+<title>{title}</title><style>
+body{{font-family:sans-serif;margin:2em;color:#222}}
+table{{border-collapse:collapse;width:100%}}
+th,td{{border:1px solid #ccc;padding:6px 10px;text-align:left;
+font-size:14px}} th{{background:#f0f0f0}}
+.SUCCEEDED{{color:#0a7d00}}.FAILED{{color:#b00020}}.KILLED{{color:#b00020}}
+.RUNNING{{color:#8a6d00}} a{{color:#0645ad;text-decoration:none}}
+h1{{font-size:20px}} pre{{background:#f7f7f7;padding:1em;overflow:auto}}
+</style></head><body><h1>{title}</h1>{body}
+<p><a href="/">&larr; all jobs</a></p></body></html>"""
+
+
+def _fmt_ts(ms: int | None) -> str:
+    if not ms:
+        return "-"
+    return datetime.fromtimestamp(ms / 1000, timezone.utc).strftime(
+        "%Y-%m-%d %H:%M:%S")
+
+
+class HistoryServer:
+    """Threaded HTTP server over the history directory tree.
+
+    Routes (reference: tony-history-server/conf/routes:1-3):
+      GET /                -> jobs-metadata index (triggers migration)
+      GET /jobs/<appId>    -> per-job event timeline
+      GET /config/<appId>  -> per-job frozen config
+      GET /api/jobs, /api/jobs/<id>/events, /api/jobs/<id>/config -> JSON
+    """
+
+    def __init__(self, conf: TonyConfig, port: int | None = None) -> None:
+        self.conf = conf
+        self.dirs = HistoryDirs.from_conf(conf)
+        self.dirs.ensure()
+        self.port = (port if port is not None
+                     else conf.get_int(K.HISTORY_SERVER_PORT_KEY, 0))
+        self.retention_s = conf.get_int(K.HISTORY_RETENTION_SECONDS_KEY, 0)
+        self.metadata_cache = TTLCache(ttl_s=5.0)  # new jobs appear quickly
+        self.events_cache = TTLCache()
+        self.config_cache = TTLCache()
+        # Serializes directory scans: concurrent index loads must not race
+        # migrate_finished's move operations against each other.
+        self._scan_lock = threading.Lock()
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- data access --------------------------------------------------------
+    def list_jobs(self) -> list[dict]:
+        """Cached directory scan — every route funnels through here, so the
+        TTL bounds full-tree walks (reference: CacheWrapper's metadataCache)."""
+        return self.metadata_cache.get_or_load("jobs", self._scan_jobs)
+
+    def _scan_jobs(self) -> list[dict]:
+        """Migrate finished jobs, purge expired, then list every valid jhist
+        across intermediate + finished trees, newest first."""
+        with self._scan_lock:
+            return self._scan_jobs_locked()
+
+    def _scan_jobs_locked(self) -> list[dict]:
+        migrate_finished(self.dirs)
+        purge_expired(self.dirs, self.retention_s)
+        by_app: dict[str, dict] = {}
+        for base in (self.dirs.intermediate, self.dirs.finished):
+            for path in ev.find_job_files(base):
+                meta = ev.JobMetadata.from_file_name(path)
+                if meta is None:
+                    continue
+                job = {
+                    "app_id": meta.app_id, "user": meta.user,
+                    "started_ms": meta.started_ms,
+                    "completed_ms": meta.completed_ms,
+                    "status": meta.status or
+                              ("RUNNING" if meta.in_progress else "UNKNOWN"),
+                    "path": path}
+                prev = by_app.get(meta.app_id)
+                # A completed record is authoritative over a stale
+                # .inprogress left by a crashed coordinator attempt.
+                if prev is None or (prev["completed_ms"] is None
+                                    and meta.completed_ms is not None):
+                    by_app[meta.app_id] = job
+        jobs = sorted(by_app.values(), key=lambda j: j["started_ms"],
+                      reverse=True)
+        return jobs
+
+    def _find_job(self, app_id: str) -> dict | None:
+        for job in self.list_jobs():
+            if job["app_id"] == app_id:
+                return job
+        return None
+
+    def _load_fresh_on_vanish(self, app_id: str, read_job):
+        """Run ``read_job`` on the located job, re-scanning once if the file
+        was migrated between lookup and read (cached paths can go stale the
+        moment migrate_finished moves a file)."""
+        for attempt in range(2):
+            job = self._find_job(app_id)
+            if job is None:
+                return None
+            try:
+                return read_job(job)
+            except FileNotFoundError:
+                if attempt:
+                    raise
+                self.metadata_cache.invalidate_all()
+        return None
+
+    def job_events(self, app_id: str) -> list[ev.Event] | None:
+        # In-progress files keep growing; the short TTL keeps the page fresh.
+        return self.events_cache.get_or_load(
+            app_id, lambda: self._load_fresh_on_vanish(
+                app_id, lambda job: ev.parse_events(job["path"])))
+
+    def job_config(self, app_id: str) -> dict | None:
+        def read_config(job):
+            conf_path = os.path.join(os.path.dirname(job["path"]),
+                                     config_file_name(app_id))
+            if not os.path.exists(conf_path):
+                return {}
+            return TonyConfig.from_file(conf_path).as_dict()
+        return self.config_cache.get_or_load(
+            app_id, lambda: self._load_fresh_on_vanish(app_id, read_config))
+
+    # -- html rendering ------------------------------------------------------
+    def _render_index(self) -> str:
+        rows = []
+        for j in self.list_jobs():
+            aid = html.escape(j["app_id"])
+            rows.append(
+                f"<tr><td><a href='/jobs/{aid}'>{aid}</a></td>"
+                f"<td>{html.escape(j['user'])}</td>"
+                f"<td>{_fmt_ts(j['started_ms'])}</td>"
+                f"<td>{_fmt_ts(j['completed_ms'])}</td>"
+                f"<td class='{j['status']}'>{j['status']}</td>"
+                f"<td><a href='/config/{aid}'>config</a></td></tr>")
+        body = ("<table><tr><th>Job</th><th>User</th><th>Started (UTC)"
+                "</th><th>Completed (UTC)</th><th>Status</th><th></th>"
+                "</tr>" + "".join(rows) + "</table>") if rows else \
+            "<p>No jobs found.</p>"
+        return _PAGE.format(title="TonY-TPU job history", body=body)
+
+    def _render_events(self, app_id: str) -> str | None:
+        events = self.job_events(app_id)
+        if events is None:
+            return None
+        rows = "".join(
+            f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
+            f"<td>{html.escape(e.event_type)}</td>"
+            f"<td><pre>{html.escape(json.dumps(e.payload, indent=1))}</pre>"
+            f"</td></tr>" for e in events)
+        body = ("<table><tr><th>Time (UTC)</th><th>Event</th><th>Payload</th>"
+                "</tr>" + rows + "</table>") if events else "<p>No events.</p>"
+        return _PAGE.format(title=f"Events — {html.escape(app_id)}", body=body)
+
+    def _render_config(self, app_id: str) -> str | None:
+        conf = self.job_config(app_id)
+        if conf is None:
+            return None
+        rows = "".join(
+            f"<tr><td>{html.escape(k)}</td><td>{html.escape(v)}</td></tr>"
+            for k, v in sorted(conf.items()))
+        body = ("<table><tr><th>Key</th><th>Value</th></tr>" + rows +
+                "</table>") if conf else "<p>No config file recorded.</p>"
+        return _PAGE.format(title=f"Config — {html.escape(app_id)}", body=body)
+
+    # -- http plumbing -------------------------------------------------------
+    def _make_handler(self):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt, *args):  # route to logging, not stderr
+                log.debug("http: " + fmt, *args)
+
+            def _send(self, code: int, body: str, ctype: str) -> None:
+                data = body.encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", f"{ctype}; charset=utf-8")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def _json(self, obj, code: int = 200) -> None:
+                self._send(code, json.dumps(obj, indent=1), "application/json")
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                # Match on the path only — '/api/jobs?limit=5' must route.
+                path = self.path.split("?", 1)[0].rstrip("/") or "/"
+                try:
+                    self._route(path)
+                except BrokenPipeError:
+                    pass
+                except Exception:  # pragma: no cover - defensive 500
+                    log.exception("history server error on %s", path)
+                    self._send(500, "internal error", "text/plain")
+
+            def _route(self, path: str) -> None:
+                if path == "/":
+                    self._send(200, server._render_index(), "text/html")
+                elif path.startswith("/jobs/"):
+                    page = server._render_events(path[len("/jobs/"):])
+                    self._not_found() if page is None else \
+                        self._send(200, page, "text/html")
+                elif path.startswith("/config/"):
+                    page = server._render_config(path[len("/config/"):])
+                    self._not_found() if page is None else \
+                        self._send(200, page, "text/html")
+                elif path == "/api/jobs":
+                    self._json(server.list_jobs())
+                elif path.startswith("/api/jobs/") and \
+                        path.endswith("/events"):
+                    app_id = path[len("/api/jobs/"):-len("/events")]
+                    events = server.job_events(app_id)
+                    self._not_found() if events is None else self._json(
+                        [{"event_type": e.event_type, "payload": e.payload,
+                          "timestamp": e.timestamp} for e in events])
+                elif path.startswith("/api/jobs/") and \
+                        path.endswith("/config"):
+                    app_id = path[len("/api/jobs/"):-len("/config")]
+                    conf = server.job_config(app_id)
+                    self._not_found() if conf is None else self._json(conf)
+                elif path == "/healthz":
+                    self._send(200, "ok", "text/plain")
+                else:
+                    self._not_found()
+
+            def _not_found(self) -> None:
+                self._send(404, _PAGE.format(
+                    title="Not found", body="<p>Unknown job or path.</p>"),
+                    "text/html")
+
+        return Handler
+
+    def start(self) -> int:
+        """Bind + serve on a background thread. Returns the bound port."""
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port),
+                                          self._make_handler())
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="history-server", daemon=True)
+        self._thread.start()
+        log.info("history server on http://localhost:%d (intermediate=%s "
+                 "finished=%s)", self.port, self.dirs.intermediate,
+                 self.dirs.finished)
+        return self.port
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Standalone launcher (reference: startTHS.sh reads tony-site.xml and
+    boots the Play app; here: ``python -m tony_tpu.history.server``)."""
+    parser = argparse.ArgumentParser(prog="tony-history-server")
+    parser.add_argument("--conf_file", help="tony.xml / k=v config file")
+    parser.add_argument("--conf", action="append", default=[],
+                        help="config override key=value (repeatable)")
+    parser.add_argument("--port", type=int, default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(name)s %(levelname)s: "
+                               "%(message)s")
+    conf = TonyConfig.load(args.conf_file,
+                           cli_overrides=parse_cli_confs(args.conf))
+    server = HistoryServer(conf, port=args.port)
+    server.start()
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -47,18 +47,23 @@ def array_batches(reader: FileSplitReader, batch_size: int, dtype,
     """
     rec_bytes = record_size_for(dtype, row_shape)
     warned = False
-    while True:
-        records = reader.next_batch(batch_size)
-        while 0 < len(records) < batch_size:
-            more = reader.next_batch(batch_size - len(records))
-            if not more:
+    exhausted = False
+    while not exhausted:
+        # Keep pulling until we hold batch_size FULL records or the reader is
+        # dry — a short tail record filtered mid-stream must not end the
+        # iteration while later files still have data.
+        full: list[bytes] = []
+        while len(full) < batch_size:
+            records = reader.next_batch(batch_size - len(full))
+            if not records:
+                exhausted = True
                 break
-            records.extend(more)
-        full = [r for r in records if len(r) == rec_bytes]
-        if len(full) < len(records) and not warned:
-            warned = True
-            log.warning("dropping %d short tail record(s) (< %d bytes)",
-                        len(records) - len(full), rec_bytes)
+            kept = [r for r in records if len(r) == rec_bytes]
+            if len(kept) < len(records) and not warned:
+                warned = True
+                log.warning("dropping %d short tail record(s) (< %d bytes)",
+                            len(records) - len(kept), rec_bytes)
+            full.extend(kept)
         if not full:
             return
         if len(full) < batch_size and drop_remainder:

@@ -36,6 +36,7 @@ def _cache_dir() -> str:
 def _compile(lib_path: str) -> bool:
     cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
            _SRC, "-o", lib_path]
+    tmp = None
     try:
         # Build into a temp name then rename: atomic against concurrent
         # executors on the same host racing to build the cache entry.
@@ -47,6 +48,11 @@ def _compile(lib_path: str) -> bool:
         return True
     except (subprocess.SubprocessError, OSError) as e:
         log.info("native data-feed build unavailable (%s); using python path", e)
+        if tmp is not None:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
         return False
 
 

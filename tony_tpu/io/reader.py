@@ -69,6 +69,10 @@ class _NativeImpl:
         self._finalizer = weakref.finalize(self, _close_native, lib, self._h)
 
     def next_batch(self, max_records: int) -> list[bytes]:
+        if self._h is None:
+            # Match the Python impl's post-close behavior instead of handing
+            # a NULL handle to C++ (nullptr deref, interpreter crash).
+            return []
         max_records = min(max_records, len(self._lens))
         n = self._lib.tdf_next_batch(self._h, self._buf, _BATCH_BUF_CAP,
                                      self._lens, max_records)
@@ -173,6 +177,9 @@ class _PythonImpl:
     def close(self) -> None:
         self._pool.clear()
         self._exhausted = True
+        # Release the fd held by the suspended generator now, not at GC time
+        # (the native impl guarantees this via its finalizer).
+        self._records.close()
 
 
 class FileSplitReader:

@@ -88,18 +88,11 @@ def full_records_in_split(paths: list[str], idx: int, n: int,
         raise ValueError("full_records_in_split requires fixed-size framing")
     if sizes is None:
         sizes = [os.path.getsize(p) for p in paths]
-    total = sum(sizes)
-    start = split_start(total, idx, n)
-    end = start + split_length(total, idx, n)
+    size_of = dict(zip(paths, sizes))
     count = 0
-    file_start = 0
-    for size in sizes:
-        seg_start = max(start, file_start) - file_start
-        seg_end = min(end, file_start + size) - file_start
-        if seg_start < seg_end:
-            first = -(-seg_start // record_size)
-            end_excl = -(-seg_end // record_size)
-            full_end = min(end_excl, size // record_size)
-            count += max(0, full_end - first)
-        file_start += size
+    for seg in compute_read_info(paths, idx, n, sizes=sizes):
+        first = -(-seg.offset // record_size)
+        end_excl = -(-(seg.offset + seg.length) // record_size)
+        full_end = min(end_excl, size_of[seg.path] // record_size)
+        count += max(0, full_end - first)
     return count
