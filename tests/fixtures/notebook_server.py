@@ -1,0 +1,27 @@
+"""Fixture: a tiny HTTP server on $NOTEBOOK_PORT, exits after first request
+or 15s — stands in for a jupyter process in the notebook-submitter E2E."""
+import os
+import threading
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+port = int(os.environ["NOTEBOOK_PORT"])
+done = threading.Event()
+
+
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b"notebook-ok"
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+        done.set()
+
+    def log_message(self, *a):
+        pass
+
+
+server = HTTPServer(("", port), H)
+threading.Thread(target=server.serve_forever, daemon=True).start()
+done.wait(timeout=15)
+server.shutdown()

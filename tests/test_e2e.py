@@ -248,3 +248,78 @@ class TestE2E:
         log = os.path.join(client.job_dir, "logs", "worker-0.stdout")
         assert os.path.exists(log)
         assert "training-output-marker" in open(log).read()
+
+    def test_security_enabled_job_succeeds(self, tmp_path):
+        """With tony.application.security.enabled, the client mints a per-job
+        secret, the coordinator enforces it on every RPC, and executors
+        authenticate via their launch env — the job still runs end to end."""
+        client = make_client(tmp_path, fixture_cmd("exit_0.py"),
+                             {"tony.worker.instances": "2",
+                              "tony.application.security.enabled": "true"})
+        assert client.secret is not None
+        assert client.run() == 0
+        secret_file = os.path.join(client.job_dir, ".tony-secret")
+        assert os.path.exists(secret_file)
+        assert oct(os.stat(secret_file).st_mode & 0o777) == "0o600"
+        with open(secret_file) as f:
+            assert f.read() == client.secret
+
+    def test_security_rejects_unauthenticated_probe(self, tmp_path):
+        """An RPC probe without the token is refused while the job runs."""
+        import grpc
+        import threading
+        from tony_tpu.rpc.client import ApplicationRpcClient
+
+        client = make_client(tmp_path, fixture_cmd("sleep_briefly.py"),
+                             {"tony.worker.instances": "1",
+                              "tony.application.security.enabled": "true"})
+        result = {}
+
+        def run():
+            result["code"] = client.run()
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            addr = None
+            while addr is None and t.is_alive():
+                addr = client._read_coordinator_addr()
+            if addr:
+                probe = ApplicationRpcClient(addr, secret=None, max_retries=2)
+                with pytest.raises(grpc.RpcError) as ei:
+                    probe.get_task_urls()
+                assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+                probe.close()
+        finally:
+            t.join(timeout=60)
+        assert result.get("code") == 0
+
+    def test_notebook_job_proxied(self, tmp_path):
+        """Notebook flow: single notebook task gets $NOTEBOOK_PORT, registers
+        its endpoint as the tracking URL, the client fires on_tracking_url,
+        and a ProxyServer forwards a local port to it (reference:
+        NotebookSubmitter.java:93-106 + tony-proxy)."""
+        import urllib.request
+        from tony_tpu.proxy import ProxyServer
+
+        conf = TonyConfig({
+            "tony.staging.dir": str(tmp_path / "staging"),
+            "tony.history.location": str(tmp_path / "tony-history"),
+            "tony.application.timeout": "60000",
+            "tony.notebook.instances": "1",
+        })
+        fetched = {}
+
+        def on_url(url):
+            host, _, port = url.split("//")[-1].rstrip("/").rpartition(":")
+            proxy = ProxyServer(host, int(port))
+            local = proxy.start()
+            with urllib.request.urlopen(
+                    f"http://localhost:{local}/", timeout=10) as resp:
+                fetched["body"] = resp.read()
+            proxy.stop()
+
+        client = TonyClient(conf, fixture_cmd("notebook_server.py"),
+                            on_tracking_url=on_url)
+        assert client.run() == 0
+        assert fetched.get("body") == b"notebook-ok"

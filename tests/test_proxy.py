@@ -1,0 +1,88 @@
+"""Tests for the gateway→cluster TCP proxy (tony-proxy analog)."""
+
+import socket
+import socketserver
+import threading
+
+from tony_tpu.proxy import ProxyServer
+
+
+class _Echo(socketserver.BaseRequestHandler):
+    def handle(self):
+        while True:
+            data = self.request.recv(4096)
+            if not data:
+                return
+            self.request.sendall(data.upper())
+
+
+def _start_echo():
+    server = socketserver.ThreadingTCPServer(("127.0.0.1", 0), _Echo)
+    server.daemon_threads = True
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server, server.server_address[1]
+
+
+def test_proxy_pumps_both_directions():
+    echo, echo_port = _start_echo()
+    proxy = ProxyServer("127.0.0.1", echo_port)
+    port = proxy.start()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as c:
+            c.sendall(b"hello tony")
+            assert c.recv(4096) == b"HELLO TONY"
+            c.sendall(b"again")
+            assert c.recv(4096) == b"AGAIN"
+    finally:
+        proxy.stop()
+        echo.shutdown()
+
+
+def test_proxy_concurrent_connections():
+    echo, echo_port = _start_echo()
+    proxy = ProxyServer("127.0.0.1", echo_port)
+    port = proxy.start()
+    errors = []
+
+    def client(i):
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=5) as c:
+                msg = f"msg-{i}".encode()
+                c.sendall(msg)
+                assert c.recv(4096) == msg.upper()
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append(e)
+
+    try:
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10)
+        assert not errors
+    finally:
+        proxy.stop()
+        echo.shutdown()
+
+
+def test_proxy_unreachable_upstream_closes_client():
+    # Port 1 on localhost: connection refused — proxy must close the client
+    # socket instead of hanging.
+    proxy = ProxyServer("127.0.0.1", 1)
+    port = proxy.start()
+    try:
+        with socket.create_connection(("127.0.0.1", port), timeout=5) as c:
+            c.settimeout(5)
+            assert c.recv(4096) == b""   # EOF
+    finally:
+        proxy.stop()
+
+
+def test_proxy_stop_unbinds_port():
+    proxy = ProxyServer("127.0.0.1", 9)
+    port = proxy.start()
+    proxy.stop()
+    # Port is released: a fresh bind to it succeeds.
+    with socket.socket() as s:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("", port))

@@ -143,3 +143,74 @@ def test_singleton_per_address(server):
     b = ApplicationRpcClient.get_instance(f"localhost:{srv.port}")
     assert a is b
     a.close()
+
+
+# ---------------------------------------------------------------------------
+# Control-plane auth (ClientToAMToken analog)
+# ---------------------------------------------------------------------------
+
+class TestRpcAuth:
+    def _server(self, secret):
+        impl = FakeImpl(expected=1)
+        server = ApplicationRpcServer(impl, secret=secret)
+        server.start()
+        return impl, server
+
+    def test_valid_token_accepted(self):
+        impl, server = self._server("s3cret")
+        try:
+            client = ApplicationRpcClient(f"localhost:{server.port}",
+                                          secret="s3cret", max_retries=3)
+            urls = client.get_task_urls()
+            assert urls and urls[0].name == "worker"
+            client.close()
+        finally:
+            server.stop()
+
+    def test_missing_token_rejected(self):
+        import grpc
+        impl, server = self._server("s3cret")
+        try:
+            client = ApplicationRpcClient(f"localhost:{server.port}",
+                                          secret=None, max_retries=3)
+            with pytest.raises(grpc.RpcError) as ei:
+                client.get_task_urls()
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            client.close()
+        finally:
+            server.stop()
+
+    def test_wrong_token_rejected(self):
+        import grpc
+        impl, server = self._server("s3cret")
+        try:
+            client = ApplicationRpcClient(f"localhost:{server.port}",
+                                          secret="wrong", max_retries=3)
+            with pytest.raises(grpc.RpcError) as ei:
+                client.task_executor_heartbeat("worker:0")
+            assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+            client.close()
+        finally:
+            server.stop()
+
+    def test_no_secret_server_accepts_all(self):
+        impl, server = self._server(None)
+        try:
+            client = ApplicationRpcClient(f"localhost:{server.port}",
+                                          secret="anything", max_retries=3)
+            assert client.get_task_urls()
+            client.close()
+        finally:
+            server.stop()
+
+    def test_secret_env_fallback(self, monkeypatch):
+        from tony_tpu import constants
+        impl, server = self._server("envtoken")
+        try:
+            monkeypatch.setenv(constants.TONY_SECRET, "envtoken")
+            client = ApplicationRpcClient(f"localhost:{server.port}",
+                                          max_retries=3)
+            assert client.get_task_urls()
+            client.close()
+        finally:
+            server.stop()

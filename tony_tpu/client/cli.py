@@ -76,9 +76,27 @@ def main(argv: list[str] | None = None) -> int:
     for pair in args.shell_env:
         k, _, v = pair.partition("=")
         shell_env[k] = v
+    on_tracking_url = None
+    if args.command == "notebook":
+        on_tracking_url = _start_notebook_proxy
     client = TonyClient(conf, command, src_dir=args.src_dir,
-                        shell_env=shell_env)
+                        shell_env=shell_env, on_tracking_url=on_tracking_url)
     return client.run()
+
+
+def _start_notebook_proxy(url: str):
+    """Proxy a local gateway port to the notebook host (reference:
+    NotebookSubmitter.java:93-106 + tony-proxy ProxyServer)."""
+    from tony_tpu.proxy import ProxyServer
+    hostport = url.split("//")[-1].rstrip("/")
+    host, _, port = hostport.rpartition(":")
+    proxy = ProxyServer(host, int(port), local_port=0)
+    local_port = proxy.start()
+    logging.getLogger("tony_tpu.client").info(
+        "notebook proxied at http://localhost:%d — from a remote gateway, "
+        "run `ssh -L 18888:localhost:%d <gateway>` and open "
+        "http://localhost:18888", local_port, local_port)
+    return proxy
 
 
 if __name__ == "__main__":

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
+import secrets
 import shutil
 import subprocess
 import sys
@@ -47,7 +48,14 @@ class TonyClient:
 
     def __init__(self, conf: TonyConfig, task_command: str,
                  src_dir: str | None = None,
-                 shell_env: dict[str, str] | None = None) -> None:
+                 shell_env: dict[str, str] | None = None,
+                 on_tracking_url=None) -> None:
+        #: optional callable(url) fired once when the job's tracking URL
+        #: (TensorBoard / notebook endpoint) becomes known — the notebook
+        #: submitter uses it to start a local proxy (reference:
+        #: NotebookSubmitter.java:93-106).
+        self.on_tracking_url = on_tracking_url
+        self._tracking_url_fired = False
         self.conf = conf
         self.task_command = task_command
         self.src_dir = src_dir
@@ -60,6 +68,13 @@ class TonyClient:
         self.am_proc: subprocess.Popen | None = None
         self.rpc: ApplicationRpcClient | None = None
         self._printed_urls = False
+        # Control-plane auth (ClientToAMToken analog): generate a per-job
+        # secret when tony.application.security.enabled is set. It rides to
+        # the coordinator in its launch env, to executors in theirs, and is
+        # persisted (0600) in the job dir for out-of-band tooling.
+        self.secret: str | None = None
+        if conf.get_bool(K.APPLICATION_SECURITY_KEY, False):
+            self.secret = secrets.token_hex(16)
 
     # ------------------------------------------------------------------
     def stage(self) -> None:
@@ -97,6 +112,12 @@ class TonyClient:
         self.conf.set(K.HISTORY_INTERMEDIATE_KEY, dirs.intermediate)
         self.conf.set(K.HISTORY_FINISHED_KEY, dirs.finished)
         self.conf.write_xml(os.path.join(self.job_dir, constants.TONY_FINAL_XML))
+        if self.secret:
+            secret_path = os.path.join(self.job_dir, constants.TONY_SECRET_FILE)
+            fd = os.open(secret_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(self.secret)
 
     def launch_coordinator(self, attempt: int) -> None:
         """Start the coordinator process (the AM launch, reference
@@ -109,6 +130,8 @@ class TonyClient:
         env = with_framework_path(dict(os.environ))
         env.update(self.shell_env)
         env[constants.ATTEMPT_NUMBER] = str(attempt)
+        if self.secret:
+            env[constants.TONY_SECRET] = self.secret
         logs = os.path.join(self.job_dir, constants.TONY_LOG_DIR)
         out = open(os.path.join(logs, "am.stdout"), "ab")
         err = open(os.path.join(logs, "am.stderr"), "ab")
@@ -145,16 +168,28 @@ class TonyClient:
             return json.load(f)
 
     def _print_task_urls(self) -> None:
-        if self._printed_urls or not self.rpc:
+        if (self._printed_urls and self._tracking_url_fired) or not self.rpc:
+            return
+        if self._printed_urls and self.on_tracking_url is None:
             return
         try:
             urls = self.rpc.get_task_urls()
         except Exception:
             return
-        if urls:
+        if urls and not self._printed_urls:
             self._printed_urls = True
             for u in urls:
                 log.info("task %s:%s logs: %s", u.name, u.index, u.url)
+        if self.on_tracking_url is not None and not self._tracking_url_fired:
+            for u in urls:
+                if u.name == constants.TRACKING_URL_TASK_NAME:
+                    self._tracking_url_fired = True
+                    try:
+                        self.on_tracking_url(u.url)
+                    except Exception:
+                        log.warning("on_tracking_url callback failed",
+                                    exc_info=True)
+                    break
 
     # ------------------------------------------------------------------
     def monitor(self) -> int:
@@ -181,7 +216,7 @@ class TonyClient:
             if self.rpc is None:
                 addr = self._read_coordinator_addr()
                 if addr:
-                    self.rpc = ApplicationRpcClient(addr)
+                    self.rpc = ApplicationRpcClient(addr, secret=self.secret)
             self._print_task_urls()
 
     def _handle_am_crash(self) -> int:
@@ -201,6 +236,9 @@ class TonyClient:
                 os.remove(p)
         self.rpc = None
         self._printed_urls = False
+        # The relaunched executors register a fresh tracking URL (new
+        # notebook port) — let the callback re-point the proxy.
+        self._tracking_url_fired = False
         self.launch_coordinator(self._attempt)
         return self.monitor()
 
@@ -210,7 +248,7 @@ class TonyClient:
         if self.rpc is None:
             addr = self._wait_for_coordinator_addr(timeout_s=1)
             if addr:
-                self.rpc = ApplicationRpcClient(addr)
+                self.rpc = ApplicationRpcClient(addr, secret=self.secret)
         if self.rpc:
             try:
                 self.rpc.finish_application()
@@ -238,7 +276,7 @@ class TonyClient:
         self.launch_coordinator(0)
         addr = self._wait_for_coordinator_addr()
         if addr:
-            self.rpc = ApplicationRpcClient(addr)
+            self.rpc = ApplicationRpcClient(addr, secret=self.secret)
             log.info("coordinator up at %s; job dir %s", addr, self.job_dir)
         try:
             return self.monitor()

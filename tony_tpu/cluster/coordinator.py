@@ -71,7 +71,15 @@ class CoordinatorRpc(ApplicationRpc):
         self.co = coordinator
 
     def get_task_urls(self) -> list[TaskUrl]:
-        return [TaskUrl(n, i, u) for n, i, u in self.co.session.task_urls()]
+        urls = [TaskUrl(n, i, u) for n, i, u in self.co.session.task_urls()]
+        if self.co.tensorboard_url:
+            # Surface the tracking URL the way YARN surfaced the AM's
+            # tracking URL in application reports (reference:
+            # TonyApplicationMaster.java:890-906) — the notebook submitter
+            # proxies to it (NotebookSubmitter.java:93-106).
+            urls.append(TaskUrl(constants.TRACKING_URL_TASK_NAME, "0",
+                                self.co.tensorboard_url))
+        return urls
 
     def get_cluster_spec(self, task_id: str) -> str:
         if not self.co.session.barrier_released():
@@ -140,7 +148,12 @@ class Coordinator:
             conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000),
             conf.get_int(K.TASK_MAX_MISSED_HEARTBEATS_KEY, 25),
             self._on_task_dead)
-        self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self))
+        # Per-job auth (ClientToAMToken analog): the client generates the
+        # secret at submission and passes it via env; when set, every RPC
+        # (client and executors) must present it.
+        self.secret = os.environ.get(constants.TONY_SECRET) or None
+        self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self),
+                                               secret=self.secret)
         history_dir = ev.HistoryDirs.from_conf(conf).intermediate
         self.events = ev.EventHandler(history_dir, app_id,
                                       os.environ.get("USER", "unknown"))
@@ -233,6 +246,8 @@ class Coordinator:
                     constants.ATTEMPT_NUMBER: os.environ.get(
                         constants.ATTEMPT_NUMBER, "0"),
                 }
+                if self.secret:
+                    env[constants.TONY_SECRET] = self.secret
                 env.update(request.env)
                 self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
                                  session_id=self.session.session_id)

@@ -113,6 +113,9 @@ class TaskExecutor:
         self.task_id = f"{self.job_name}:{self.task_index}"
         self.data_port = reserve_port()
         self.tb_port = reserve_port()
+        self.notebook_port = (reserve_port()
+                              if self.job_name == constants.NOTEBOOK_JOB_NAME
+                              else 0)
         self.rpc = ApplicationRpcClient.get_instance(am_address)
         self.hb_interval_s = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000) / 1000.0
         self.registration_timeout_s = conf.get_int(
@@ -158,6 +161,8 @@ class TaskExecutor:
             constants.CLUSTER_SPEC: self.bootstrap["cluster_spec"],
             constants.TB_PORT: str(self.tb_port),
         }
+        if self.notebook_port:
+            env[constants.NOTEBOOK_PORT] = str(self.notebook_port)
         framework = (self.conf.get(K.APPLICATION_FRAMEWORK_KEY) or
                      constants.FRAMEWORK_JAX).lower()
         cluster = json.loads(self.bootstrap["cluster_spec"])
@@ -260,6 +265,16 @@ class TaskExecutor:
                 self.rpc.register_tensorboard_url(f"http://{host}:{self.tb_port}")
             except Exception:
                 log.warning("TensorBoard URL registration failed", exc_info=True)
+        elif self.notebook_port:
+            # Notebook jobs register their HTTP endpoint as the tracking URL
+            # so the submitter can proxy to it (reference:
+            # NotebookSubmitter.java:93-106 splits the task URL host:port).
+            try:
+                host = socket.gethostname()
+                self.rpc.register_tensorboard_url(
+                    f"http://{host}:{self.notebook_port}")
+            except Exception:
+                log.warning("notebook URL registration failed", exc_info=True)
         exit_code = self.run_user_process(self.framework_env())
         self.apply_chaos_after_training()
         heartbeater.stop_event.set()

@@ -29,6 +29,23 @@ ATTEMPT_NUMBER = "ATTEMPT_NUMBER"
 CLUSTER_SPEC = "CLUSTER_SPEC"
 IS_CHIEF = "IS_CHIEF"
 
+# Control-plane auth (the ClientToAMToken analog, reference:
+# TFClientSecurityInfo / TonyApplicationMaster.java:442-452): a per-job
+# shared secret generated at submission, carried to the coordinator and every
+# executor via this env var, and attached to every RPC as gRPC metadata.
+TONY_SECRET = "TONY_SECRET"
+AUTH_METADATA_KEY = "tony-auth"
+TONY_SECRET_FILE = ".tony-secret"
+
+# Pseudo job-name under which the coordinator surfaces the tracking
+# (TensorBoard / notebook) URL in get_task_urls — the analog of the YARN
+# application tracking URL the reference sets reflectively
+# (TonyApplicationMaster.java:890-906).
+TRACKING_URL_TASK_NAME = "tracking"
+# Port reserved by the executor for a notebook job's HTTP server; exported
+# so the user command can bind it (e.g. jupyter lab --port=$NOTEBOOK_PORT).
+NOTEBOOK_PORT = "NOTEBOOK_PORT"
+
 # TensorFlow adapter (Constants.java: TF_CONFIG, TB_PORT)
 TF_CONFIG = "TF_CONFIG"
 TB_PORT = "TB_PORT"

@@ -1,0 +1,3 @@
+from tony_tpu.proxy.server import ProxyServer
+
+__all__ = ["ProxyServer"]

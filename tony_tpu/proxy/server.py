@@ -1,0 +1,155 @@
+"""Gateway→cluster TCP proxy.
+
+Analog of the reference's tony-proxy module (reference: tony-proxy/src/main/
+java/com/linkedin/tonyproxy/ProxyServer.java:23-93): a thread-per-connection
+bidirectional byte pump, used by the notebook submitter to expose a notebook
+running on a cluster/TPU host on a local gateway port. Unlike the reference
+(which blocks forever in ``start()``), this one runs its accept loop on a
+daemon thread and supports clean shutdown, so the client can run it alongside
+its monitor loop and tests can start/stop it freely.
+
+Usage::
+
+    proxy = ProxyServer(remote_host, remote_port, local_port=0)
+    port = proxy.start()          # returns the bound local port
+    ...
+    proxy.stop()
+
+Also runnable standalone::
+
+    python -m tony_tpu.proxy.server --remote host:8888 --port 9999
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import socket
+import threading
+
+log = logging.getLogger(__name__)
+
+_BUF = 1 << 16
+
+
+def _pump(src: socket.socket, dst: socket.socket) -> None:
+    """Copy bytes src→dst until EOF, then half-close dst's write side."""
+    try:
+        while True:
+            data = src.recv(_BUF)
+            if not data:
+                break
+            dst.sendall(data)
+    except OSError:
+        pass
+    finally:
+        try:
+            dst.shutdown(socket.SHUT_WR)
+        except OSError:
+            pass
+
+
+class ProxyServer:
+    """Forward connections on a local port to ``remote_host:remote_port``."""
+
+    def __init__(self, remote_host: str, remote_port: int,
+                 local_port: int = 0) -> None:
+        self.remote_host = remote_host
+        self.remote_port = remote_port
+        self.local_port = local_port
+        self._server: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+
+    def start(self) -> int:
+        """Bind and start accepting on a daemon thread; return bound port."""
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind(("", self.local_port))
+        server.listen(16)
+        self.local_port = server.getsockname()[1]
+        self._server = server
+        log.info("proxy for %s:%s listening on local port %s",
+                 self.remote_host, self.remote_port, self.local_port)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tony-proxy-accept", daemon=True)
+        self._accept_thread.start()
+        return self.local_port
+
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._stopping.is_set():
+            try:
+                client, addr = self._server.accept()
+            except OSError:
+                break                      # socket closed by stop()
+            threading.Thread(target=self._handle, args=(client,),
+                             name=f"tony-proxy-{addr[1]}",
+                             daemon=True).start()
+
+    def _handle(self, client: socket.socket) -> None:
+        try:
+            upstream = socket.create_connection(
+                (self.remote_host, self.remote_port), timeout=10)
+        except OSError as e:
+            log.warning("proxy: cannot reach %s:%s: %s",
+                        self.remote_host, self.remote_port, e)
+            client.close()
+            return
+        upstream.settimeout(None)
+        t = threading.Thread(target=_pump, args=(client, upstream),
+                             daemon=True)
+        t.start()
+        _pump(upstream, client)
+        t.join()
+        for s in (client, upstream):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            try:
+                # shutdown() wakes the thread blocked in accept(); close()
+                # alone leaves the fd referenced by the blocked syscall and
+                # the port bound until the join times out.
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """Blocking variant mirroring the reference's ``start()``."""
+        if self._server is None:
+            self.start()
+        try:
+            self._stopping.wait()
+        except KeyboardInterrupt:
+            self.stop()
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tony-proxy",
+        description="TCP proxy from a local gateway port to a cluster host")
+    parser.add_argument("--remote", required=True, metavar="HOST:PORT")
+    parser.add_argument("--port", type=int, default=0,
+                        help="local port (0 = ephemeral)")
+    args = parser.parse_args(argv)
+    host, _, port = args.remote.rpartition(":")
+    logging.basicConfig(level=logging.INFO)
+    proxy = ProxyServer(host, int(port), args.port)
+    print(f"listening on {proxy.start()}", flush=True)
+    proxy.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -10,11 +10,13 @@ RetryProxy with exponential backoff, :80-92)."""
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 
 import grpc
 
+from tony_tpu import constants
 from tony_tpu.rpc import tony_pb2 as pb
 from tony_tpu.rpc.server import SERVICE_NAME
 from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
@@ -34,8 +36,16 @@ class ApplicationRpcClient(ApplicationRpc):
     """gRPC client with retry/backoff implementing ApplicationRpc."""
 
     def __init__(self, address: str, max_retries: int = 30,
-                 base_backoff_s: float = 0.1, max_backoff_s: float = 5.0) -> None:
+                 base_backoff_s: float = 0.1, max_backoff_s: float = 5.0,
+                 secret: str | None = None) -> None:
         self.address = address
+        # Per-job auth token (ClientToAMToken analog). Defaults from the
+        # TONY_SECRET env var so executors — which receive the secret in
+        # their launch environment — authenticate without plumbing.
+        if secret is None:
+            secret = os.environ.get(constants.TONY_SECRET) or None
+        self._metadata = ((constants.AUTH_METADATA_KEY, secret),) if secret \
+            else None
         self.max_retries = max_retries
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
@@ -104,7 +114,7 @@ class ApplicationRpcClient(ApplicationRpc):
         last_err: Exception | None = None
         for _ in range(retries):
             try:
-                return stub(request, timeout=10.0)
+                return stub(request, timeout=10.0, metadata=self._metadata)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
                 retryable = code == grpc.StatusCode.UNAVAILABLE or (

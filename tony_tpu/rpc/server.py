@@ -10,6 +10,7 @@ protoc only generates the messages)."""
 
 from __future__ import annotations
 
+import hmac
 import logging
 import random
 import socket
@@ -48,8 +49,13 @@ class ApplicationRpcServer:
     """Wraps a grpc.Server around an ApplicationRpc implementation."""
 
     def __init__(self, impl: ApplicationRpc, port: int | None = None,
-                 max_workers: int = 32) -> None:
+                 max_workers: int = 32, secret: str | None = None) -> None:
         self.impl = impl
+        #: per-job shared secret; when set, every call must carry it as
+        #: gRPC metadata (the ClientToAMToken + service-ACL analog,
+        #: reference: TFPolicyProvider.java:14-26, ApplicationRpcServer
+        #: secret-manager wiring :56-70).
+        self.secret = secret
         explicit_port = port is not None
         self.port = port if explicit_port else find_free_port()
         self._server = grpc.server(
@@ -120,11 +126,27 @@ class ApplicationRpcServer:
         }
         handlers = {
             name: grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=req_cls.FromString,
+                self._authenticated(fn), request_deserializer=req_cls.FromString,
                 response_serializer=lambda msg: msg.SerializeToString())
             for name, (fn, req_cls) in methods.items()
         }
         return grpc.method_handlers_generic_handler(SERVICE_NAME, handlers)
+
+    def _authenticated(self, fn):
+        """Require the per-job secret as gRPC metadata when auth is on."""
+        if not self.secret:
+            return fn
+        expected = self.secret
+
+        def checked(req, ctx):
+            presented = dict(ctx.invocation_metadata()).get(
+                constants.AUTH_METADATA_KEY, "")
+            if not hmac.compare_digest(presented, expected):
+                ctx.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          "missing or invalid tony auth token")
+            return fn(req, ctx)
+
+        return checked
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
