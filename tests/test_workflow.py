@@ -1,0 +1,122 @@
+"""Tests for the workflow-scheduler jobtype (tony-azkaban analog) and
+version-info injection."""
+
+import os
+import sys
+
+import pytest
+
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.workflow.jobtype import TonyJob, parse_properties
+
+
+def _props(**extra):
+    props = {
+        "executes": "python train.py",
+        "src_dir": "src",
+        "tony.worker.instances": "2",
+        "tony.application.framework": "jax",
+        "worker_env.DATA_DIR": "/data",
+        "worker_env.MODE": "prod",
+        "unrelated.key": "ignored",
+    }
+    props.update(extra)
+    return props
+
+
+class TestTonyJob:
+    def test_conf_file_contains_only_tony_keys(self, tmp_path):
+        job = TonyJob(_props(), job_id="j1", working_dir=str(tmp_path))
+        conf_file = job.write_conf()
+        assert conf_file == str(tmp_path / "_tony-conf-j1" / "tony.xml")
+        conf = TonyConfig.from_file(conf_file, load_defaults=False)
+        assert conf.get("tony.worker.instances") == "2"
+        assert conf.get("tony.application.framework") == "jax"
+        assert "unrelated.key" not in conf
+        assert "executes" not in conf
+
+    def test_main_args_translation(self, tmp_path):
+        job = TonyJob(_props(task_params="--epochs 3",
+                             python_binary_path="python3",
+                             python_venv="venv.zip"),
+                      working_dir=str(tmp_path))
+        args = job.main_args()
+        assert args[0] == "submit"
+        assert "--executes=python train.py" in args
+        assert "--src_dir=src" in args
+        assert "--task_params=--epochs 3" in args
+        assert "--python_binary_path=python3" in args
+        assert "--python_venv=venv.zip" in args
+        # worker_env.* → repeated --shell_env k=v (reference:
+        # TensorFlowJob.getMainArguments:101-105)
+        envs = [a.split("=", 1)[1] for a in args
+                if a.startswith("--shell_env=")]
+        assert envs == ["DATA_DIR=/data", "MODE=prod"]
+
+    def test_main_args_parse_through_cli(self, tmp_path):
+        """The emitted args must survive the submission CLI's argparse —
+        including values that start with a dash (--task_params=--verbose
+        would be eaten as an option in two-token form)."""
+        from tony_tpu.client.cli import build_parser
+        job = TonyJob(_props(task_params="--verbose",
+                             python_binary_path="python3.11"),
+                      working_dir=str(tmp_path))
+        parsed = build_parser().parse_args(job.main_args())
+        assert parsed.executes == "python train.py"
+        assert parsed.task_params == "--verbose"
+        assert parsed.python_binary_path == "python3.11"
+        assert parsed.shell_env == ["DATA_DIR=/data", "MODE=prod"]
+
+    def test_missing_executes_raises(self, tmp_path):
+        props = _props()
+        del props["executes"]
+        with pytest.raises(ValueError, match="executes"):
+            TonyJob(props, working_dir=str(tmp_path)).main_args()
+
+    def test_command_line_is_execable_argv(self, tmp_path):
+        job = TonyJob(_props(), working_dir=str(tmp_path))
+        argv = job.command_line()
+        assert argv[0] == sys.executable
+        assert argv[1:3] == ["-m", "tony_tpu.client.cli"]
+
+    def test_properties_file_parsing(self, tmp_path):
+        p = tmp_path / "job.properties"
+        p.write_text("# a comment\n"
+                     "executes=python t.py\n"
+                     "tony.worker.instances = 3\n"
+                     "\n"
+                     "worker_env.X=1\n"
+                     "malformed-line-no-equals\n")
+        props = parse_properties(str(p))
+        assert props == {"executes": "python t.py",
+                         "tony.worker.instances": "3",
+                         "worker_env.X": "1"}
+
+    def test_end_to_end_submission(self, tmp_path):
+        """The jobtype drives a real local submission to completion."""
+        props = {
+            "executes": "true",
+            "tony.worker.instances": "1",
+            "tony.staging.dir": str(tmp_path / "staging"),
+            "tony.history.location": str(tmp_path / "hist"),
+            "tony.application.timeout": "60000",
+        }
+        job = TonyJob(props, working_dir=str(tmp_path))
+        assert job.run() == 0
+
+
+class TestVersionInfo:
+    def test_fields_resolved(self):
+        from tony_tpu.utils.version import get_version_info
+        info = get_version_info()
+        assert set(info) == {"version", "revision", "branch", "user", "date"}
+        assert info["version"] == "0.1.0"
+        # Running inside the repo: revision resolves from git.
+        assert len(info["revision"]) == 40
+
+    def test_injected_into_conf(self):
+        from tony_tpu.utils.version import inject_version_info
+        conf = TonyConfig()
+        inject_version_info(conf)
+        assert conf.get("tony.version.version") == "0.1.0"
+        assert conf.get("tony.version.revision") != "Unknown"

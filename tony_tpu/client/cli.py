@@ -44,6 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
                        help="config override key=value (repeatable)")
         p.add_argument("--src_dir", help="source tree staged to every task")
         p.add_argument("--python_venv", help="venv zip staged to every task")
+        p.add_argument("--python_binary_path",
+                       help="python used to launch executors")
         p.add_argument("--shell_env", action="append", default=[],
                        help="extra env forwarded to tasks (k=v, repeatable)")
         p.add_argument("--task_params", default="",
@@ -60,6 +62,8 @@ def main(argv: list[str] | None = None) -> int:
     conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
     if args.python_venv:
         conf.set(K.PYTHON_VENV_KEY, args.python_venv)
+    if args.python_binary_path:
+        conf.set(K.PYTHON_BINARY_PATH_KEY, args.python_binary_path)
     if args.command == "local":
         conf.set(K.SCHEDULER_BACKEND_KEY, "local")
     elif args.command == "notebook":
@@ -84,14 +88,23 @@ def main(argv: list[str] | None = None) -> int:
     return client.run()
 
 
+_notebook_proxy = None
+
+
 def _start_notebook_proxy(url: str):
     """Proxy a local gateway port to the notebook host (reference:
-    NotebookSubmitter.java:93-106 + tony-proxy ProxyServer)."""
+    NotebookSubmitter.java:93-106 + tony-proxy ProxyServer). Called again
+    after a coordinator retry (new notebook endpoint): the stale proxy is
+    stopped so it cannot keep forwarding to the dead host."""
+    global _notebook_proxy
     from tony_tpu.proxy import ProxyServer
+    if _notebook_proxy is not None:
+        _notebook_proxy.stop()
     hostport = url.split("//")[-1].rstrip("/")
     host, _, port = hostport.rpartition(":")
     proxy = ProxyServer(host, int(port), local_port=0)
     local_port = proxy.start()
+    _notebook_proxy = proxy
     logging.getLogger("tony_tpu.client").info(
         "notebook proxied at http://localhost:%d — from a remote gateway, "
         "run `ssh -L 18888:localhost:%d <gateway>` and open "

@@ -34,6 +34,7 @@ import json
 
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
 from tony_tpu.utils.env import with_framework_path
+from tony_tpu.utils.version import inject_version_info
 
 log = logging.getLogger("tony_tpu.client")
 
@@ -57,6 +58,9 @@ class TonyClient:
         self.on_tracking_url = on_tracking_url
         self._tracking_url_fired = False
         self.conf = conf
+        # Record which build submitted this job (reference: TonyClient ctor
+        # TonyClient.java:132) — lands in tony-final.xml for the history UI.
+        inject_version_info(conf)
         self.task_command = task_command
         self.src_dir = src_dir
         self.shell_env = shell_env or {}

@@ -145,8 +145,8 @@ class TonyConfig:
         return conf
 
     @classmethod
-    def from_file(cls, path: str) -> "TonyConfig":
-        conf = cls()
+    def from_file(cls, path: str, load_defaults: bool = True) -> "TonyConfig":
+        conf = cls(load_defaults=load_defaults)
         conf.update(read_conf_file(path))
         return conf
 

@@ -1,0 +1,83 @@
+"""Build/version metadata injected into every job's frozen config.
+
+Analog of the reference's ``VersionInfo`` (reference: tony-core/src/main/java/
+com/linkedin/tony/util/VersionInfo.java:22-142 + gradle/version-info.gradle):
+the reference bakes version/revision/branch/user/date into a properties file
+at build time and ``TonyClient`` injects them into the job conf so the
+history server can show which build ran a job. Here the same fields are
+resolved at submission time — from a ``version-info.properties`` file next to
+the package if a build produced one, else live from git — and written under
+``tony.version.*`` keys into tony-final.xml.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import subprocess
+import time
+from functools import lru_cache
+
+from tony_tpu import __version__
+
+_UNKNOWN = "Unknown"
+_PROPS_FILE = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                           "version-info.properties")
+
+
+def _git(*args: str) -> str:
+    try:
+        out = subprocess.run(
+            ["git", *args], capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(_PROPS_FILE))
+        return out.stdout.strip() if out.returncode == 0 else _UNKNOWN
+    except (OSError, subprocess.TimeoutExpired):
+        return _UNKNOWN
+
+
+def _in_own_checkout() -> bool:
+    """True only when the package sits directly in its own git checkout.
+    Without this guard a pip-installed copy inside some unrelated repo
+    (venv under a monorepo) would stamp jobs with that repo's revision."""
+    toplevel = _git("rev-parse", "--show-toplevel")
+    return toplevel != _UNKNOWN and \
+        os.path.realpath(toplevel) == os.path.realpath(
+            os.path.dirname(os.path.dirname(_PROPS_FILE)))
+
+
+@lru_cache(maxsize=1)
+def get_version_info() -> dict[str, str]:
+    """version / revision / branch / user / date, baked-file first."""
+    info = {
+        "version": __version__,
+        "revision": _UNKNOWN,
+        "branch": _UNKNOWN,
+        "user": _UNKNOWN,
+        "date": _UNKNOWN,
+    }
+    if os.path.exists(_PROPS_FILE):
+        with open(_PROPS_FILE, encoding="utf-8") as f:
+            for line in f:
+                k, sep, v = line.strip().partition("=")
+                if sep and k in info:
+                    info[k] = v
+    if _in_own_checkout():
+        if info["revision"] == _UNKNOWN:
+            info["revision"] = _git("rev-parse", "HEAD")
+        if info["branch"] == _UNKNOWN:
+            info["branch"] = _git("rev-parse", "--abbrev-ref", "HEAD")
+    if info["user"] == _UNKNOWN:
+        try:
+            info["user"] = getpass.getuser()
+        except Exception:
+            pass
+    if info["date"] == _UNKNOWN:
+        info["date"] = time.strftime("%Y-%m-%dT%H:%M:%S%z")
+    return info
+
+
+def inject_version_info(conf) -> None:
+    """Record the build in the job conf (reference: TonyClient ctor
+    TonyClient.java:132 calls VersionInfo.injectVersionInfo(conf))."""
+    for key, value in get_version_info().items():
+        conf.set(f"tony.version.{key}", value)

@@ -1,0 +1,3 @@
+from tony_tpu.workflow.jobtype import TonyJob
+
+__all__ = ["TonyJob"]

@@ -1,0 +1,131 @@
+"""Workflow-scheduler jobtype: flat job properties → a tony submission.
+
+Analog of the reference's tony-azkaban module (reference: tony-azkaban/src/
+main/java/com/linkedin/tony/azkaban/TensorFlowJob.java:24-141 and
+TensorFlowJobArg.java): a workflow scheduler (Azkaban, Airflow, Oozie, cron)
+describes a training job as a flat key=value property map —
+
+    executes            = python train.py
+    src_dir             = src
+    python_venv         = venv.zip
+    python_binary_path  = python3.11
+    task_params         = --epochs 3
+    worker_env.FOO      = bar          # forwarded into every task's env
+    tony.worker.instances = 4          # any tony.* key → generated tony.xml
+
+— and this jobtype translates it into (a) a generated ``tony.xml`` holding
+every ``tony.*`` property (the reference writes _tony-conf-<jobid>/tony.xml,
+:129-137) and (b) the main-args list for the submission CLI (:88-126). The
+scheduler then either calls :meth:`TonyJob.run` in-process or executes the
+printed command line.
+
+Scheduler integration is one property file plus::
+
+    python -m tony_tpu.workflow.jobtype --props job.properties
+
+(Airflow: ``PythonOperator(python_callable=TonyJob(props).run)``.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from tony_tpu.conf.config import TonyConfig
+
+log = logging.getLogger(__name__)
+
+WORKER_ENV_PREFIX = "worker_env."
+TONY_CONF_PREFIX = "tony."
+
+#: flat-prop name → CLI flag, in emission order (reference:
+#: TensorFlowJobArg.java — hdfs_classpath is YARN-specific and dropped).
+_SIMPLE_ARGS = ("src_dir", "task_params", "python_binary_path",
+                "python_venv", "executes")
+
+
+def parse_properties(path: str) -> dict[str, str]:
+    """Read a java-style .properties file (k=v, # comments)."""
+    props: dict[str, str] = {}
+    with open(path, encoding="utf-8") as f:
+        for raw in f:
+            line = raw.strip()
+            if not line or line.startswith(("#", "!")):
+                continue
+            k, sep, v = line.partition("=")
+            if not sep:
+                continue
+            props[k.strip()] = v.strip()
+    return props
+
+
+class TonyJob:
+    """Translate a flat property map into a tony CLI invocation."""
+
+    def __init__(self, props: dict[str, str], job_id: str = "job",
+                 working_dir: str | None = None) -> None:
+        self.props = dict(props)
+        self.job_id = job_id
+        self.working_dir = working_dir or os.getcwd()
+        # Generated conf lives in its own subdir like the reference's
+        # _tony-conf-<jobid>/tony.xml (TensorFlowJob.java:34-36).
+        self.conf_dir = os.path.join(self.working_dir,
+                                     f"_tony-conf-{self.job_id}")
+        self.conf_file = os.path.join(self.conf_dir, "tony.xml")
+
+    # ------------------------------------------------------------------
+    def write_conf(self) -> str:
+        """Write every tony.* property into the generated tony.xml
+        (reference: TensorFlowJob.getMainArguments:126-137)."""
+        confs = {k: v for k, v in self.props.items()
+                 if k.startswith(TONY_CONF_PREFIX)}
+        os.makedirs(self.conf_dir, exist_ok=True)
+        TonyConfig(confs, load_defaults=False).write_xml(self.conf_file)
+        return self.conf_file
+
+    def main_args(self) -> list[str]:
+        """The submission-CLI argument list (reference: getMainArguments:88).
+        ``executes`` is required — a workflow job with nothing to execute is
+        a misconfiguration worth failing loudly on."""
+        if "executes" not in self.props:
+            raise ValueError("workflow job needs an 'executes' property")
+        args = ["submit", "--conf_file", self.write_conf()]
+        for name in _SIMPLE_ARGS:
+            if name in self.props:
+                # --flag=value single-token form: a value starting with a
+                # dash (task_params = --verbose) would otherwise be eaten
+                # by argparse as an option.
+                args.append(f"--{name}={self.props[name]}")
+        for key, value in sorted(self.props.items()):
+            if key.startswith(WORKER_ENV_PREFIX):
+                env_name = key[len(WORKER_ENV_PREFIX):]
+                args.append(f"--shell_env={env_name}={value}")
+        return args
+
+    def command_line(self) -> list[str]:
+        """Full argv a scheduler can exec directly."""
+        return [sys.executable, "-m", "tony_tpu.client.cli"] + self.main_args()
+
+    def run(self) -> int:
+        """Submit in-process and return the job's exit code."""
+        from tony_tpu.client import cli
+        args = self.main_args()
+        log.info("workflow jobtype submitting: %s", " ".join(args))
+        return cli.main(args)
+
+
+def main(argv: list[str] | None = None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(prog="tony-workflow-job")
+    parser.add_argument("--props", required=True,
+                        help="path to the job .properties file")
+    parser.add_argument("--job_id", default="job")
+    args = parser.parse_args(argv)
+    job = TonyJob(parse_properties(args.props), job_id=args.job_id)
+    return job.run()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
