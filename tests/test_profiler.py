@@ -1,0 +1,73 @@
+"""Profiler-hook tests: env plumbing, trace capture, step-bounded tracing."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu import constants
+from tony_tpu.runtime import profiler
+
+
+def test_profile_dir_off_by_default(monkeypatch):
+    monkeypatch.delenv(constants.TONY_PROFILE_DIR, raising=False)
+    assert profiler.profile_dir() is None
+
+
+def test_profile_dir_per_task(monkeypatch):
+    monkeypatch.setenv(constants.TONY_PROFILE_DIR, "/tmp/traces")
+    monkeypatch.setenv(constants.JOB_NAME, "worker")
+    monkeypatch.setenv(constants.TASK_INDEX, "3")
+    assert profiler.profile_dir() == "/tmp/traces/worker-3"
+
+
+def test_maybe_start_disabled(monkeypatch):
+    monkeypatch.delenv(constants.TONY_PROFILE_ENABLED, raising=False)
+    assert profiler.maybe_start() is False
+
+
+def test_trace_writes_capture(tmp_path, monkeypatch):
+    logdir = str(tmp_path / "trace")
+    with profiler.trace(logdir):
+        jnp.dot(jnp.ones((64, 64)), jnp.ones((64, 64))).block_until_ready()
+    # xprof capture lands under plugins/profile/<run>/
+    assert glob.glob(os.path.join(logdir, "plugins", "profile", "*", "*"))
+
+
+def test_trace_noop_when_unconfigured(monkeypatch):
+    monkeypatch.delenv(constants.TONY_PROFILE_DIR, raising=False)
+    with profiler.trace():          # must not raise or start anything
+        jnp.ones(4).block_until_ready()
+
+
+def test_step_tracer_bounded_capture(tmp_path):
+    logdir = str(tmp_path / "steps")
+    tracer = profiler.StepTracer(start=2, stop=4, logdir=logdir)
+    x = jnp.ones((32, 32))
+    for step in range(6):
+        tracer.step(step)
+        x = (x @ x).block_until_ready()
+    tracer.close()
+    assert not tracer._active
+    assert glob.glob(os.path.join(logdir, "plugins", "profile", "*", "*"))
+
+
+def test_step_tracer_noop_without_dir(monkeypatch):
+    monkeypatch.delenv(constants.TONY_PROFILE_DIR, raising=False)
+    tracer = profiler.StepTracer(start=0, stop=2)
+    for step in range(3):
+        tracer.step(step)
+    tracer.close()
+
+
+def test_executor_exports_profile_env(monkeypatch):
+    """Conf keys → executor env (without running a real executor)."""
+    from tony_tpu.conf import keys as K
+    from tony_tpu.conf.config import TonyConfig
+
+    conf = TonyConfig({K.TASK_PROFILE_ENABLED_KEY: "true",
+                       K.TASK_PROFILE_DIR_KEY: "/tmp/prof"})
+    assert conf.get_bool(K.TASK_PROFILE_ENABLED_KEY) is True
+    # The executor's framework_env reads these two keys; defaults stay off.
+    assert TonyConfig().get_bool(K.TASK_PROFILE_ENABLED_KEY) is False

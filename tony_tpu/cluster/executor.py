@@ -163,6 +163,11 @@ class TaskExecutor:
         }
         if self.notebook_port:
             env[constants.NOTEBOOK_PORT] = str(self.notebook_port)
+        if self.conf.get_bool(K.TASK_PROFILE_ENABLED_KEY, False):
+            env[constants.TONY_PROFILE_ENABLED] = "true"
+            profile_dir = self.conf.get(K.TASK_PROFILE_DIR_KEY) or ""
+            if profile_dir:
+                env[constants.TONY_PROFILE_DIR] = profile_dir
         framework = (self.conf.get(K.APPLICATION_FRAMEWORK_KEY) or
                      constants.FRAMEWORK_JAX).lower()
         cluster = json.loads(self.bootstrap["cluster_spec"])

@@ -49,6 +49,8 @@ TASK_HEARTBEAT_INTERVAL_KEY = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS_KEY = "tony.task.max-missed-heartbeats"
 TASK_REGISTRATION_TIMEOUT_KEY = "tony.task.registration-timeout-ms"
 TASK_EXECUTION_TIMEOUT_KEY = "tony.task.execution-timeout-ms"
+TASK_PROFILE_ENABLED_KEY = "tony.task.profile.enabled"            # per-host jax.profiler
+TASK_PROFILE_DIR_KEY = "tony.task.profile.dir"                    # trace output root
 
 # ---------------------------------------------------------------------------
 # Chief designation (TonyConfigurationKeys: chief name/index)
@@ -123,6 +125,8 @@ DEFAULTS: dict[str, str] = {
     TASK_MAX_MISSED_HEARTBEATS_KEY: "25",
     TASK_REGISTRATION_TIMEOUT_KEY: "300000",
     TASK_EXECUTION_TIMEOUT_KEY: "0",
+    TASK_PROFILE_ENABLED_KEY: "false",
+    TASK_PROFILE_DIR_KEY: "",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
     HISTORY_LOCATION_KEY: "",

@@ -37,6 +37,13 @@ TONY_SECRET = "TONY_SECRET"
 AUTH_METADATA_KEY = "tony-auth"
 TONY_SECRET_FILE = ".tony-secret"
 
+# Profiling (tony.task.profile.* → executor env → runtime.maybe_start):
+# first-class per-host jax.profiler capture (SURVEY.md §5 calls this out as
+# the TPU-native addition over the reference's TensorBoard-URL-only
+# observability).
+TONY_PROFILE_ENABLED = "TONY_PROFILE_ENABLED"
+TONY_PROFILE_DIR = "TONY_PROFILE_DIR"
+
 # Pseudo job-name under which the coordinator surfaces the tracking
 # (TensorBoard / notebook) URL in get_task_urls — the analog of the YARN
 # application tracking URL the reference sets reflectively

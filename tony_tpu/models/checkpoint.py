@@ -1,0 +1,122 @@
+"""Checkpoint/resume for train state, retry-aware.
+
+The reference delegates checkpointing entirely to the user script
+(reference: tony-examples/mnist-tensorflow/mnist_distributed.py:223-227 uses
+``MonitoredTrainingSession(checkpoint_dir=...)``; SURVEY.md §5 "checkpoint /
+resume: DELEGATED") and restarts training on AM retry from whatever the
+script restores, exposing ``ATTEMPT_NUMBER`` so scripts can detect retries
+(reference: TonyApplicationMaster.java:593). The TPU build keeps that
+division of labor but ships the recipe: an orbax-backed manager that is
+sharding-aware (restores arrays onto the same device mesh layout they were
+saved from — essential when a preempted slice job resumes) and a
+``restore_or_init`` helper that makes user scripts retry-safe in one line:
+
+    mgr = CheckpointManager(ckpt_dir)
+    state = mgr.restore_or_init(lambda: init_state(params, opt))
+    for step in range(mgr.latest_step() or 0, total_steps):
+        state, metrics = train_step(state, batch)
+        mgr.save(step, state)
+    mgr.close()
+
+Multi-host: orbax coordinates distributed save/restore across jax processes
+itself; every process must call save/restore collectively.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Callable
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+def attempt_number() -> int:
+    """Which coordinator attempt this process belongs to (0 = first run).
+    Reference exports the same env var for the same purpose
+    (TonyApplicationMaster.java:593, Constants.java ATTEMPT_NUMBER)."""
+    from tony_tpu import constants
+    return int(os.environ.get(constants.ATTEMPT_NUMBER, "0"))
+
+
+class CheckpointManager:
+    """Thin, typed wrapper over ``orbax.checkpoint.CheckpointManager``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3,
+                 save_interval_steps: int = 1) -> None:
+        import orbax.checkpoint as ocp
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep,
+                save_interval_steps=save_interval_steps,
+                create=True))
+
+    # ------------------------------------------------------------------
+    def save(self, step: int, state: Any, force: bool = False) -> bool:
+        """Save if the step hits the save interval; returns True if saved."""
+        saved = self._mgr.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=force)
+        if saved:
+            log.info("checkpoint saved at step %d → %s", step, self.directory)
+        return saved
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: Any | None = None,
+                step: int | None = None) -> Any:
+        """Restore the given (or latest) step. ``template`` is a matching
+        pytree (abstract or concrete) guiding sharding/dtype placement —
+        pass the freshly-initialized state so arrays land on the same mesh
+        layout they were saved from."""
+        step = self._mgr.latest_step() if step is None else int(step)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if template is None:
+            args = self._ocp.args.StandardRestore()
+        else:
+            abstract = jax.tree.map(_abstractify, template)
+            args = self._ocp.args.StandardRestore(abstract)
+        return self._mgr.restore(step, args=args)
+
+    def restore_or_init(self, init_fn: Callable[[], Any]) -> Any:
+        """The retry-safe bootstrap: restore the latest checkpoint if one
+        exists, else build fresh state. On coordinator retries
+        (ATTEMPT_NUMBER > 0) a missing checkpoint is still fine — the job
+        may have died before the first save."""
+        state = init_fn()
+        step = self._mgr.latest_step()
+        if step is None:
+            if attempt_number() > 0:
+                log.warning(
+                    "attempt %d but no checkpoint in %s — starting fresh",
+                    attempt_number(), self.directory)
+            return state
+        log.info("resuming from checkpoint step %d (attempt %d)",
+                 step, attempt_number())
+        return self.restore(template=state, step=step)
+
+    def wait_until_finished(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self) -> "CheckpointManager":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def _abstractify(x):
+    """Concrete array → ShapeDtypeStruct carrying its sharding."""
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    return x

@@ -53,10 +53,14 @@ class ProxyServer:
     """Forward connections on a local port to ``remote_host:remote_port``."""
 
     def __init__(self, remote_host: str, remote_port: int,
-                 local_port: int = 0) -> None:
+                 local_port: int = 0, bind_host: str = "127.0.0.1") -> None:
         self.remote_host = remote_host
         self.remote_port = remote_port
         self.local_port = local_port
+        # Loopback by default: the proxied service (e.g. a tokenless
+        # notebook) must not be exposed to the whole network just because
+        # the gateway has more interfaces; remote users tunnel via ssh -L.
+        self.bind_host = bind_host
         self._server: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._stopping = threading.Event()
@@ -65,7 +69,7 @@ class ProxyServer:
         """Bind and start accepting on a daemon thread; return bound port."""
         server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        server.bind(("", self.local_port))
+        server.bind((self.bind_host, self.local_port))
         server.listen(16)
         self.local_port = server.getsockname()[1]
         self._server = server
@@ -142,10 +146,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--remote", required=True, metavar="HOST:PORT")
     parser.add_argument("--port", type=int, default=0,
                         help="local port (0 = ephemeral)")
+    parser.add_argument("--bind", default="127.0.0.1",
+                        help="local interface to listen on (default loopback)")
     args = parser.parse_args(argv)
     host, _, port = args.remote.rpartition(":")
     logging.basicConfig(level=logging.INFO)
-    proxy = ProxyServer(host, int(port), args.port)
+    proxy = ProxyServer(host, int(port), args.port, bind_host=args.bind)
     print(f"listening on {proxy.start()}", flush=True)
     proxy.serve_forever()
     return 0

@@ -83,6 +83,8 @@ def initialize() -> TaskInfo:
             coordinator_address=info.coordinator_address,
             num_processes=info.num_processes,
             process_id=info.process_id)
+    from tony_tpu.runtime import profiler
+    profiler.maybe_start()
     _initialized = True
     return info
 

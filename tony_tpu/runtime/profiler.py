@@ -1,0 +1,120 @@
+"""First-class profiling hooks for tony tasks.
+
+The reference's only observability into training is TensorBoard plumbing
+(SURVEY.md §5 "Tracing / profiling: ABSENT"; reference: TaskExecutor.java:
+73-74,124-127 reserves a TB port and registers worker:0's URL as the YARN
+tracking URL). The TPU build keeps that pattern and adds what SURVEY.md §5
+calls for — per-host ``jax.profiler`` / xprof capture as a framework feature:
+
+- ``maybe_start()``: driven by executor-exported env. When profiling is on
+  (``tony.task.profile.enabled``) each host starts the jax profiler server
+  on its reserved TensorBoard port, so xprof / `tensorboard --logdir` can
+  capture live from the registered tracking URL. Programmatic trace files
+  additionally require instrumenting the loop with :class:`StepTracer` or
+  :func:`trace` (both no-ops unless ``tony.task.profile.dir`` is set).
+- ``trace(logdir)``: context manager for explicit capture windows.
+- ``StepTracer``: step-bounded capture — start at step A, stop at step B —
+  the standard way to profile steady-state without the compile noise.
+
+User scripts get all of it through ``tony_tpu.runtime.initialize()``, which
+calls :func:`maybe_start` after the jax.distributed bootstrap.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+
+from tony_tpu import constants
+
+log = logging.getLogger(__name__)
+
+_server_started = False
+
+
+def profile_dir() -> str | None:
+    """Trace output dir for this task, or None when profiling is off.
+    Per-task subdir keeps multi-host captures separate."""
+    base = os.environ.get(constants.TONY_PROFILE_DIR, "")
+    if not base:
+        return None
+    job = os.environ.get(constants.JOB_NAME, "worker")
+    idx = os.environ.get(constants.TASK_INDEX, "0")
+    return os.path.join(base, f"{job}-{idx}")
+
+
+def maybe_start() -> bool:
+    """Start the per-host profiler server (idempotent) when enabled.
+    Returns True if profiling is active for this task."""
+    global _server_started
+    enabled = os.environ.get(constants.TONY_PROFILE_ENABLED, "") == "true"
+    if not enabled:
+        return False
+    if not _server_started:
+        import jax
+        port = int(os.environ.get(constants.TB_PORT, "0"))
+        if port:
+            try:
+                jax.profiler.start_server(port)
+                _server_started = True
+                log.info("jax profiler server on port %d", port)
+            except Exception:
+                log.warning("profiler server failed to start", exc_info=True)
+    return True
+
+
+@contextlib.contextmanager
+def trace(logdir: str | None = None):
+    """Capture a jax trace for the enclosed block (xprof/TensorBoard
+    viewable). Defaults to the config-shipped profile dir."""
+    import jax
+    logdir = logdir or profile_dir()
+    if logdir is None:
+        yield
+        return
+    os.makedirs(logdir, exist_ok=True)
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+        log.info("trace written to %s", logdir)
+
+
+class StepTracer:
+    """Capture steps [start, stop) of a training loop::
+
+        tracer = StepTracer(start=10, stop=13)   # skip compile+warmup
+        for step in range(total):
+            tracer.step(step)
+            state, m = train_step(state, batch)
+        tracer.close()
+    """
+
+    def __init__(self, start: int = 10, stop: int = 13,
+                 logdir: str | None = None) -> None:
+        self.start = start
+        self.stop = stop
+        self.logdir = logdir or profile_dir()
+        self._active = False
+
+    def step(self, step: int) -> None:
+        if self.logdir is None:
+            return
+        import jax
+        if not self._active and self.start <= step < self.stop:
+            os.makedirs(self.logdir, exist_ok=True)
+            jax.profiler.start_trace(self.logdir)
+            self._active = True
+        elif self._active and step >= self.stop:
+            jax.profiler.stop_trace()
+            self._active = False
+            log.info("step trace [%d,%d) written to %s",
+                     self.start, self.stop, self.logdir)
+
+    def close(self) -> None:
+        if self._active:
+            import jax
+            jax.profiler.stop_trace()
+            self._active = False
