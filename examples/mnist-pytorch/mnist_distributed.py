@@ -1,0 +1,105 @@
+"""Distributed MNIST in PyTorch under the tony-tpu orchestrator.
+
+Reference-parity example (reference: tony-examples/mnist-pytorch/
+mnist_distributed.py:113-226): the framework's PyTorch runtime adapter
+exports ``RANK`` / ``WORLD`` / ``INIT_METHOD`` (tcp:// rendezvous at
+worker 0 — tony_tpu/cluster/executor.py framework_env), the script builds a
+``torch.distributed`` gloo process group from them and all-reduces gradients
+by hand, exactly the reference's recipe. This is the CPU/GPU escape hatch —
+the JAX example (examples/mnist/) is the TPU-native path.
+
+Usage:
+    python -m tony_tpu.client.cli submit \
+        --conf tony.application.framework=pytorch \
+        --conf tony.worker.instances=2 \
+        --executes 'python examples/mnist-pytorch/mnist_distributed.py'
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+import torch
+import torch.distributed as dist
+import torch.nn as nn
+import torch.nn.functional as F
+
+
+def synthetic_mnist(n: int, seed: int):
+    rng = np.random.RandomState(seed)
+    templates = np.random.RandomState(0).rand(10, 28, 28).astype(np.float32)
+    labels = rng.randint(0, 10, size=(n,)).astype(np.int64)
+    images = templates[labels] + 0.3 * rng.randn(n, 28, 28).astype(np.float32)
+    return torch.from_numpy(images.reshape(n, -1)), torch.from_numpy(labels)
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x)))
+
+
+def average_gradients(model: nn.Module, world: int) -> None:
+    """Manual sync-DP all-reduce (reference: mnist_distributed.py:113-126)."""
+    for p in model.parameters():
+        if p.grad is not None:
+            dist.all_reduce(p.grad.data, op=dist.ReduceOp.SUM)
+            p.grad.data /= world
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.1)
+    args = parser.parse_args()
+
+    # The executor-exported rendezvous (reference: TaskExecutor.java:142-153).
+    rank = int(os.environ.get("RANK", "0"))
+    world = int(os.environ.get("WORLD", "1"))
+    init_method = os.environ.get("INIT_METHOD", "")
+    if world > 1:
+        dist.init_process_group("gloo", init_method=init_method,
+                                rank=rank, world_size=world)
+        print(f"[rank {rank}/{world}] process group up via {init_method}",
+              flush=True)
+
+    torch.manual_seed(rank)
+    images, labels = synthetic_mnist(512 * args.batch_size, seed=rank)
+    model = Net()
+    if world > 1:   # identical init everywhere: broadcast rank 0's weights
+        for p in model.parameters():
+            dist.broadcast(p.data, src=0)
+    opt = torch.optim.SGD(model.parameters(), lr=args.lr)
+
+    final_loss = None
+    for step in range(args.steps):
+        i = (step * args.batch_size) % (len(images) - args.batch_size)
+        x, y = images[i:i + args.batch_size], labels[i:i + args.batch_size]
+        opt.zero_grad()
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        if world > 1:
+            average_gradients(model, world)
+        opt.step()
+        final_loss = loss.item()
+        if rank == 0 and step % 20 == 0:
+            print(f"step {step} loss {final_loss:.4f}", flush=True)
+
+    if world > 1:
+        dist.barrier()
+        dist.destroy_process_group()
+    if rank == 0:
+        print(f"final loss {final_loss:.4f}", flush=True)
+    return 0 if final_loss is not None and final_loss < 2.5 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

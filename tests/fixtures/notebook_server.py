@@ -23,5 +23,5 @@ class H(BaseHTTPRequestHandler):
 
 server = HTTPServer(("", port), H)
 threading.Thread(target=server.serve_forever, daemon=True).start()
-done.wait(timeout=15)
+done.wait(timeout=45)
 server.shutdown()
