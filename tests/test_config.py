@@ -137,3 +137,23 @@ def test_site_via_env(tmp_path, monkeypatch):
     monkeypatch.setenv("TONY_CONF_DIR", str(site_dir))
     conf = TonyConfig.load(None)
     assert conf.get(K.SCHEDULER_BACKEND_KEY) == "tpu"
+
+
+def test_config_reference_doc_covers_every_key():
+    """docs/configuration.md must document every static key (and every
+    dynamic per-job-type suffix) — the doc-side half of the keys⇄defaults
+    bijection (reference: TestTonyConfigurationFields)."""
+    import os
+    from tony_tpu.conf import keys as K
+
+    doc_path = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                            "configuration.md")
+    doc = open(doc_path, encoding="utf-8").read()
+    # Markdown tables escape the | inside the chief regex default.
+    doc = doc.replace("\\|", "|")
+    missing = [key for key in K.DEFAULTS if key not in doc]
+    assert not missing, f"undocumented config keys: {missing}"
+    for suffix in ("instances", "memory", "vcores", "gpus", "tpus",
+                   "tpu.topology", "resources"):
+        assert f"tony.<job>.{suffix}" in doc, \
+            f"dynamic key tony.<job>.{suffix} undocumented"
