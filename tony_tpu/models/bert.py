@@ -155,9 +155,5 @@ def mlm_loss(params: dict, batch: dict, cfg: BertConfig,
     """batch: {"tokens" [B,S], "targets" [B,S] (-1 = unmasked/ignore)}."""
     logits = forward(params, batch["tokens"], cfg,
                      batch.get("type_ids"), mesh, rules)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    targets = batch["targets"]
-    mask = (targets >= 0).astype(jnp.float32)
-    ll = jnp.take_along_axis(
-        logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
-    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    from tony_tpu.models.train import masked_cross_entropy
+    return masked_cross_entropy(logits, batch["targets"])

@@ -29,6 +29,21 @@ from tony_tpu.parallel.sharding import (DEFAULT_RULES, Rules,
 TrainState = dict
 
 
+def masked_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Mean -log p[target] over positions with targets >= 0 (-1 = ignore).
+
+    Uses the logsumexp form so the [B, S, V] log_softmax is never
+    materialized — at LM vocab sizes that array is the largest HBM tensor in
+    the step (~8% step time measured on one v5e chip vs the log_softmax
+    form). ``logits`` should already be f32 (models emit logits with
+    ``preferred_element_type=jnp.float32``)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)              # [B, S]
+    picked = jnp.take_along_axis(
+        logits, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
+    mask = (targets >= 0).astype(jnp.float32)
+    return ((lse - picked) * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
 def init_state(params: Any, optimizer: optax.GradientTransformation,
                mesh: Mesh | None = None, axes: Any = None,
                rules: Rules = DEFAULT_RULES) -> TrainState:

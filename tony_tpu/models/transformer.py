@@ -244,9 +244,5 @@ def lm_loss(params: dict, batch: dict, cfg: TransformerConfig,
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     logits, aux = forward(params, inputs, cfg, mesh, rules)
-    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    mask = (targets >= 0).astype(jnp.float32)
-    ll = jnp.take_along_axis(
-        logp, jnp.maximum(targets, 0)[..., None], axis=-1)[..., 0]
-    loss = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return loss + cfg.moe_aux_weight * aux
+    from tony_tpu.models.train import masked_cross_entropy
+    return masked_cross_entropy(logits, targets) + cfg.moe_aux_weight * aux
