@@ -1,0 +1,64 @@
+"""Docker-passthrough command construction (reference: TonyClient.java:
+340-349 enables the YARN docker runtime; here the coordinator wraps the
+executor command itself)."""
+
+import shlex
+
+import pytest
+
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.utils.docker import docker_wrap
+
+
+def test_disabled_returns_command_unchanged():
+    conf = TonyConfig({"tony.docker.enabled": "false"})
+    assert docker_wrap("python x.py", conf, "/jobs/a") == "python x.py"
+
+
+def test_enabled_wraps_with_mount_env_and_image():
+    conf = TonyConfig({"tony.docker.enabled": "true",
+                       "tony.docker.image": "ghcr.io/org/train:1.2"})
+    cmd = docker_wrap("python -m tony_tpu.cluster.executor --am_address h:1",
+                      conf, "/jobs/app_1",
+                      env_keys=("JOB_NAME", "TASK_INDEX"),
+                      task_id="worker:0", app_id="app_1")
+    # Kill semantics: a TERM/INT trap docker-kills the named container
+    # (backend kills signal the docker CLIENT, which alone would orphan it).
+    trap_part, _, run_part = cmd.partition("; ")
+    assert trap_part.startswith("trap ")
+    assert "docker kill tony-app_1-worker-0" in trap_part
+    assert run_part.endswith("& wait $!")
+    argv = shlex.split(run_part[:-len("& wait $!")])
+    assert argv[:2] == ["docker", "run"]
+    assert "--network=host" in argv
+    assert argv[argv.index("--name") + 1] == "tony-app_1-worker-0"
+    assert "/jobs/app_1:/jobs/app_1" in argv
+    assert "ghcr.io/org/train:1.2" in argv
+    # env forwarded from the client process environment
+    assert argv[argv.index("-e") + 1] == "JOB_NAME"
+    assert "TASK_INDEX" in argv
+    # the executor command survives quoting intact
+    assert argv[-1] == "python -m tony_tpu.cluster.executor --am_address h:1"
+    assert argv[-2] == "-c" and argv[-3] == "bash"
+
+
+def test_enabled_without_image_raises():
+    conf = TonyConfig({"tony.docker.enabled": "true"})
+    with pytest.raises(ValueError, match="tony.docker.image"):
+        docker_wrap("true", conf, "/jobs/a")
+
+
+def test_coordinator_executor_command_honors_python_opts(tmp_path):
+    """tony.task.executor.python-opts lands between the interpreter and -m
+    (the jvm-opts analog, reference: TonySession.getTaskCommand:72)."""
+    from tony_tpu.conf import keys as K
+    from tony_tpu.cluster.coordinator import Coordinator
+
+    conf = TonyConfig({K.TASK_EXECUTOR_PYTHON_OPTS_KEY: "-O -u",
+                       "tony.worker.instances": "1"})
+    co = Coordinator(conf, "app_test", str(tmp_path))
+    try:
+        cmd = co._executor_command("python train.py")
+        assert " -O -u -m tony_tpu.cluster.executor " in cmd
+    finally:
+        co.rpc_server.stop()

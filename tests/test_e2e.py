@@ -396,3 +396,48 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert out.count("step 0 loss") == 1
         # And the retried session reached the end.
         assert "step 13" in out
+
+    def test_single_node_job_runs_in_coordinator(self, tmp_path):
+        """tony.application.single-node: the user command runs inside the
+        coordinator (no task fleet) and its exit code is the job result
+        (reference: doPreprocessingJob + single-node short-circuit)."""
+        out_file = tmp_path / "single.txt"
+        client = make_client(
+            tmp_path,
+            f'bash -c "echo ran-in-$PREPROCESSING_JOB > {out_file}"',
+            {"tony.application.single-node": "true"})
+        assert client.run() == 0
+        assert out_file.read_text().strip() == "ran-in-true"
+        # No executor logs: nothing was scheduled.
+        logs = os.listdir(os.path.join(client.job_dir, "logs"))
+        assert not any(n.startswith("worker") for n in logs)
+        assert "am-preprocess.stdout" in logs
+
+    def test_single_node_failure_fails_job(self, tmp_path):
+        client = make_client(tmp_path, "false",
+                             {"tony.application.single-node": "true"})
+        assert client.run() == 1
+
+    def test_preprocess_runs_before_workers(self, tmp_path):
+        """tony.application.enable-preprocess: command runs once in the
+        coordinator first, then again in each scheduled worker."""
+        marker = tmp_path / "pre.txt"
+        cmd = (f'bash -c "if [ \\"$PREPROCESSING_JOB\\" = true ]; then '
+               f'echo pre > {marker}; else test -f {marker}; fi"')
+        client = make_client(
+            tmp_path, cmd,
+            {"tony.worker.instances": "2",
+             "tony.application.enable-preprocess": "true"})
+        assert client.run() == 0
+        assert marker.exists()
+
+    def test_preprocess_failure_short_circuits(self, tmp_path):
+        """A failing preprocess fails the job without scheduling workers."""
+        client = make_client(
+            tmp_path,
+            'bash -c "if [ \\"$PREPROCESSING_JOB\\" = true ]; then exit 7; fi"',
+            {"tony.worker.instances": "1",
+             "tony.application.enable-preprocess": "true"})
+        assert client.run() == 1
+        logs = os.listdir(os.path.join(client.job_dir, "logs"))
+        assert not any(n.startswith("worker") for n in logs)

@@ -94,3 +94,14 @@ def test_delete_command_wait_mode():
 def test_slice_name_sanitized_and_bounded():
     n = slice_name("application_1785325254085_2d827d" * 3, "worker")
     assert "_" not in n and len(n) <= 61
+
+
+def test_node_label_attached_to_slice(tmp_path):
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.backend.tpu import TpuSliceBackend
+    conf = TonyConfig({"tony.tpu.project": "p", "tony.tpu.zone": "z",
+                       "tony.tpu.accelerator-type": "v5litepod",
+                       "tony.application.node-label": "batch-pool"})
+    b = TpuSliceBackend(conf, app_id="app1", dry_run=True)
+    cmd = b.create_slice_command("worker", "2x4")
+    assert "--labels=tony-node-label=batch-pool" in cmd

@@ -26,6 +26,7 @@ launch command (TestTonyClient.java:23-31).
 from __future__ import annotations
 
 import json
+import re
 import logging
 import shlex
 import shutil
@@ -61,6 +62,10 @@ class TpuSliceBackend(SchedulerBackend):
         self.accelerator_type = conf.get(K.TPU_ACCELERATOR_TYPE_KEY) or ""
         self.runtime_version = conf.get(K.TPU_RUNTIME_VERSION_KEY) or ""
         self.preemptible = conf.get_bool(K.TPU_PREEMPTIBLE_KEY, False)
+        # Placement label passthrough (the YARN node-label analog,
+        # reference: tony.application.node-label): attached as a GCE label
+        # so reservations/affinity tooling can match slices.
+        self.node_label = conf.get(K.APPLICATION_NODE_LABEL_KEY) or ""
         self._slices: dict[str, str] = {}          # job_type -> slice name
         self._procs: dict[str, subprocess.Popen] = {}
         self._reported: set[str] = set()
@@ -109,6 +114,12 @@ class TpuSliceBackend(SchedulerBackend):
                f"--version={self.runtime_version}", "--quiet"]
         if self.preemptible:
             cmd.append("--preemptible")
+        if self.node_label:
+            # GCE label values: lowercase [a-z0-9_-], <=63 chars. YARN-style
+            # labels ("GPU", "batch.pool") are sanitized rather than failing
+            # the whole job at provision time with a gcloud error.
+            label = re.sub(r"[^a-z0-9_-]", "-", self.node_label.lower())[:63]
+            cmd.append(f"--labels=tony-node-label={label}")
         return cmd
 
     def ssh_command(self, job_type: str, host_index: int,

@@ -45,6 +45,7 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.events import events as ev
 from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.utils.docker import docker_wrap
 from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
                                   WorkerSpecResponse)
 
@@ -126,7 +127,9 @@ class Coordinator:
         self.conf = conf
         self.app_id = app_id
         self.job_dir = os.path.abspath(job_dir)
-        self.log_dir = os.path.join(self.job_dir, constants.TONY_LOG_DIR)
+        self.log_dir = (conf.get(K.CONTAINER_LOG_DIR_KEY) or
+                        os.path.join(self.job_dir, constants.TONY_LOG_DIR))
+        os.makedirs(self.log_dir, exist_ok=True)
         self.session = Session(conf, session_id=0)
         self.backend = make_backend(conf, app_id)
         self.tensorboard_url: str | None = None
@@ -224,7 +227,9 @@ class Coordinator:
         conf_path = os.path.join(self.job_dir, constants.TONY_FINAL_XML)
         addr = f"{socket.gethostname()}:{self.rpc_server.port}"
         python = (self.conf.get(K.PYTHON_BINARY_PATH_KEY) or sys.executable)
-        return (f"{python} -m tony_tpu.cluster.executor "
+        opts = self.conf.get(K.TASK_EXECUTOR_PYTHON_OPTS_KEY) or ""
+        return (f"{python} {opts + ' ' if opts else ''}"
+                f"-m tony_tpu.cluster.executor "
                 f"--am_address {addr} "
                 f"--conf_file {shlex.quote(conf_path)} "
                 f"--task_command {shlex.quote(user_command)}")
@@ -251,9 +256,16 @@ class Coordinator:
                 env.update(request.env)
                 self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
                                  session_id=self.session.session_id)
+                # Docker passthrough (reference: TonyClient.java:340-349):
+                # wrap the executor in `docker run`, forwarding the task's
+                # assigned env into the container.
+                command = docker_wrap(
+                    self._executor_command(user_command), self.conf,
+                    self.job_dir, env_keys=tuple(env),
+                    task_id=task.task_id, app_id=self.app_id)
                 self.backend.launch_task(LaunchSpec(
                     task_id=task.task_id,
-                    command=self._executor_command(user_command),
+                    command=command,
                     env=env,
                     log_dir=self.log_dir,
                     cwd=self.job_dir,
@@ -321,6 +333,54 @@ class Coordinator:
                 return self.session.status
 
     # ------------------------------------------------------------------
+    # Preprocess / single-node (reference: doPreprocessingJob:688-729)
+    # ------------------------------------------------------------------
+    def run_preprocess(self, user_command: str, single_node: bool) -> int:
+        """Run the user command inside the coordinator process. Used for
+        (a) preprocess jobs — shared computation hoisted out of the workers,
+        run before any task is scheduled — and (b) single-node jobs (e.g.
+        notebooks without a task fleet), whose exit code IS the job result."""
+        import subprocess as sp
+        from tony_tpu.cluster.executor import reserve_port
+        env = dict(os.environ)
+        env[constants.PREPROCESSING_JOB] = "true"
+        # Services like jupyter want a writable $HOME (reference :718-722).
+        env["HOME"] = self.job_dir
+        if single_node:
+            tb_port = reserve_port()
+            env[constants.TB_PORT] = str(tb_port)
+            self.tensorboard_url = f"http://{socket.gethostname()}:{tb_port}"
+            log.info("single-node tracking URL: %s", self.tensorboard_url)
+        log.info("running %s job in coordinator: %s",
+                 "single-node" if single_node else "preprocess", user_command)
+        # Same docker passthrough as scheduled tasks — with docker enabled
+        # the preprocess step must see the image's deps, not the bare host.
+        command = docker_wrap(
+            user_command, self.conf, self.job_dir,
+            env_keys=(constants.PREPROCESSING_JOB, constants.TB_PORT, "HOME"),
+            task_id="am-preprocess", app_id=self.app_id)
+        logs = os.path.join(self.log_dir, "am-preprocess")
+        timeout_s = self.conf.get_int(K.TASK_EXECUTION_TIMEOUT_KEY, 0) / 1000.0
+        with open(logs + ".stdout", "ab") as out, \
+                open(logs + ".stderr", "ab") as err:
+            proc = sp.Popen(["bash", "-c", command], env=env,
+                            cwd=self.job_dir, stdout=out, stderr=err,
+                            start_new_session=True)
+            try:
+                exit_code = proc.wait(
+                    timeout=timeout_s if timeout_s > 0 else None)
+            except sp.TimeoutExpired:
+                log.error("preprocess exceeded %.0fs — killing", timeout_s)
+                try:
+                    os.killpg(proc.pid, 9)
+                except (ProcessLookupError, PermissionError):
+                    pass     # exited in the wait→killpg window
+                proc.wait()
+                exit_code = 1
+        log.info("preprocess/single-node job exited with %d", exit_code)
+        return exit_code
+
+    # ------------------------------------------------------------------
     # Lifecycle
     # ------------------------------------------------------------------
     def run(self, user_command: str) -> int:
@@ -354,6 +414,23 @@ class Coordinator:
         if os.environ.get(constants.TEST_AM_CRASH) == "true":
             log.error("chaos: TEST_AM_CRASH set — exiting hard")
             os._exit(3)
+
+        # Preprocess / single-node arm (reference: start:520-546 — preprocess
+        # runs first; single-node jobs short-circuit with its exit code).
+        single_node = self.conf.get_bool(K.APPLICATION_SINGLE_NODE_KEY, False)
+        if single_node or self.conf.get_bool(K.APPLICATION_PREPROCESS_KEY,
+                                             False):
+            exit_code = self.run_preprocess(user_command, single_node)
+            if single_node:
+                if exit_code != 0:
+                    self.failure_message = (
+                        f"single-node job failed with exit code {exit_code}")
+                return self.stop(SessionStatus.SUCCEEDED if exit_code == 0
+                                 else SessionStatus.FAILED)
+            if exit_code != 0:
+                self.failure_message = (
+                    f"preprocess job failed with exit code {exit_code}")
+                return self.stop(SessionStatus.FAILED)
 
         status = SessionStatus.FAILED
         while True:

@@ -29,6 +29,10 @@ ATTEMPT_NUMBER = "ATTEMPT_NUMBER"
 CLUSTER_SPEC = "CLUSTER_SPEC"
 IS_CHIEF = "IS_CHIEF"
 
+# Set in the environment of a preprocess / single-node job run inside the
+# coordinator (reference: Constants.java:39, doPreprocessingJob:717).
+PREPROCESSING_JOB = "PREPROCESSING_JOB"
+
 # Control-plane auth (the ClientToAMToken analog, reference:
 # TFClientSecurityInfo / TonyApplicationMaster.java:442-452): a per-job
 # shared secret generated at submission, carried to the coordinator and every

@@ -19,6 +19,7 @@ from jax.sharding import Mesh
 from tony_tpu.ops.attention import flash_attention, reference_attention
 from tony_tpu.ops.norms import layer_norm_reference
 from tony_tpu.parallel.sharding import DEFAULT_RULES, constrain
+from tony_tpu.models.train import masked_cross_entropy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,5 +156,4 @@ def mlm_loss(params: dict, batch: dict, cfg: BertConfig,
     """batch: {"tokens" [B,S], "targets" [B,S] (-1 = unmasked/ignore)}."""
     logits = forward(params, batch["tokens"], cfg,
                      batch.get("type_ids"), mesh, rules)
-    from tony_tpu.models.train import masked_cross_entropy
     return masked_cross_entropy(logits, batch["targets"])

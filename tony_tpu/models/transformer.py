@@ -36,6 +36,7 @@ from tony_tpu.ops.norms import rms_norm_reference
 from tony_tpu.parallel.moe import moe_ffn
 from tony_tpu.parallel.ring_attention import ring_attention
 from tony_tpu.parallel.sharding import DEFAULT_RULES, constrain
+from tony_tpu.models.train import masked_cross_entropy
 
 
 @dataclasses.dataclass(frozen=True)
@@ -244,5 +245,4 @@ def lm_loss(params: dict, batch: dict, cfg: TransformerConfig,
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     logits, aux = forward(params, inputs, cfg, mesh, rules)
-    from tony_tpu.models.train import masked_cross_entropy
     return masked_cross_entropy(logits, targets) + cfg.moe_aux_weight * aux

@@ -1,0 +1,62 @@
+"""Docker passthrough for task processes.
+
+The reference enables the YARN docker runtime per job via config
+(reference: TonyClient.java:340-349 sets YARN_CONTAINER_RUNTIME_TYPE=docker
++ YARN_CONTAINER_RUNTIME_DOCKER_IMAGE from tony.docker.enabled /
+tony.docker.image). Without a YARN runtime to delegate to, the local backend
+wraps the executor command in ``docker run`` itself: host networking (the
+executor's data-plane/TB/RPC ports must be reachable as registered), the job
+dir bind-mounted read-write at the same path (conf, staged sources, and logs
+keep their absolute paths), and the container removed on exit.
+"""
+
+from __future__ import annotations
+
+import re
+import shlex
+
+from tony_tpu.conf import keys as K
+
+
+def container_name(task_id: str, app_id: str = "app") -> str:
+    """Deterministic, docker-safe container name for a task."""
+    raw = f"tony-{app_id}-{task_id}"
+    return re.sub(r"[^a-zA-Z0-9_.-]", "-", raw)[:128]
+
+
+def docker_wrap(command: str, conf, job_dir: str,
+                env_keys: tuple[str, ...] = (),
+                task_id: str = "task", app_id: str = "app") -> str:
+    """Wrap ``command`` in `docker run` when tony.docker.enabled is set.
+
+    ``env_keys`` are forwarded from the docker-client process environment
+    (bare ``-e KEY``) — the backend sets the task env on that process, so the
+    container sees exactly the vars the coordinator assigned the task.
+
+    Kill semantics: backends kill tasks by signalling the process group of
+    the docker CLIENT, which does not stop the container (SIGKILL detaches
+    the client; the daemon keeps the container running, holding the
+    host-network ports). The wrapper therefore names the container
+    deterministically and traps TERM/INT to issue ``docker kill`` — the
+    backend's SIGTERM-then-SIGKILL escalation reaches the container through
+    the trap on the first (TERM) step. A client SIGKILLed before the trap
+    fires is the residual gap; ``--rm`` plus the deterministic name lets
+    operators sweep strays with ``docker kill $(docker ps -qf name=tony-)``.
+    """
+    if not conf.get_bool(K.DOCKER_ENABLED_KEY, False):
+        return command
+    image = conf.get(K.DOCKER_IMAGE_KEY) or ""
+    if not image:
+        raise ValueError(
+            f"{K.DOCKER_ENABLED_KEY} is set but {K.DOCKER_IMAGE_KEY} is not")
+    name = container_name(task_id, app_id)
+    env_flags = "".join(f"-e {shlex.quote(k)} " for k in env_keys)
+    run = (
+        f"docker run --rm --name {shlex.quote(name)} --network=host "
+        f"{env_flags}"
+        f"-v {shlex.quote(job_dir)}:{shlex.quote(job_dir)} "
+        f"-w {shlex.quote(job_dir)} "
+        f"{shlex.quote(image)} bash -c {shlex.quote(command)}")
+    kill = f"docker kill {shlex.quote(name)} >/dev/null 2>&1"
+    return (f"trap {shlex.quote(kill)} TERM INT; "
+            f"{run} & wait $!")
