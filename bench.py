@@ -39,9 +39,11 @@ def main() -> None:
 
     on_tpu = jax.default_backend() == "tpu"
     if on_tpu:
-        # 512d/8L bf16, seq 1024. remat off: it trades FLOPs for memory,
-        # and this size fits HBM comfortably on one chip (~7% faster).
-        cfg = T.PRESETS["small"].scaled(remat=False)
+        # 512d/8L bf16, seq 1024. remat off (this size fits HBM comfortably
+        # on one chip, ~7% faster) and layers fully unrolled (drops the
+        # scan's activation-stacking DUS ops, ~6% faster; compile cost is
+        # paid once).
+        cfg = T.PRESETS["small"].scaled(remat=False, scan_unroll=8)
         batch, seq, iters = 8, 1024, 20
     else:                                    # CPU smoke fallback
         cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32)

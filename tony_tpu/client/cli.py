@@ -84,13 +84,11 @@ def main(argv: list[str] | None = None) -> int:
     on_tracking_url = None
     if args.command == "notebook":
         on_tracking_url = _start_notebook_proxy
-    src_dir = args.src_dir or conf.get(K.SRC_DIR_KEY)
+    # --src_dir flag, else the (default-empty) conf key — both explicit, so
+    # a missing directory is a loud error, never a silent skip.
+    src_dir = args.src_dir or conf.get(K.SRC_DIR_KEY) or None
     if src_dir and not os.path.isdir(src_dir):
-        # The conf default ("src") often doesn't exist for ad-hoc jobs;
-        # only an explicit flag should fail loudly.
-        if args.src_dir:
-            raise SystemExit(f"--src_dir {src_dir} does not exist")
-        src_dir = None
+        raise SystemExit(f"src_dir {src_dir} does not exist")
     client = TonyClient(conf, command, src_dir=src_dir,
                         shell_env=shell_env, on_tracking_url=on_tracking_url)
     return client.run()

@@ -161,6 +161,7 @@ class Coordinator:
         self.events = ev.EventHandler(history_dir, app_id,
                                       os.environ.get("USER", "unknown"))
         self._workers_terminated = False
+        self._preprocess_proc = None
 
     # ------------------------------------------------------------------
     # RPC-driven hooks
@@ -259,10 +260,14 @@ class Coordinator:
                 # Docker passthrough (reference: TonyClient.java:340-349):
                 # wrap the executor in `docker run`, forwarding the task's
                 # assigned env into the container.
+                # Session id in the container name: a relaunched task of a
+                # retried session must not collide with a straggler (or
+                # still-being---rm'd) container from the old generation.
                 command = docker_wrap(
                     self._executor_command(user_command), self.conf,
                     self.job_dir, env_keys=tuple(env),
-                    task_id=task.task_id, app_id=self.app_id)
+                    task_id=f"{task.task_id}-s{self.session.session_id}",
+                    app_id=self.app_id)
                 self.backend.launch_task(LaunchSpec(
                     task_id=task.task_id,
                     command=command,
@@ -366,19 +371,40 @@ class Coordinator:
             proc = sp.Popen(["bash", "-c", command], env=env,
                             cwd=self.job_dir, stdout=out, stderr=err,
                             start_new_session=True)
+            # Tracked so coordinator kill paths (client timeout, Ctrl-C,
+            # stop()) reap it — it is in no backend kill list.
+            self._preprocess_proc = proc
             try:
                 exit_code = proc.wait(
                     timeout=timeout_s if timeout_s > 0 else None)
             except sp.TimeoutExpired:
                 log.error("preprocess exceeded %.0fs — killing", timeout_s)
-                try:
-                    os.killpg(proc.pid, 9)
-                except (ProcessLookupError, PermissionError):
-                    pass     # exited in the wait→killpg window
+                self._kill_preprocess()
                 proc.wait()
                 exit_code = 1
+            finally:
+                self._preprocess_proc = None
         log.info("preprocess/single-node job exited with %d", exit_code)
         return exit_code
+
+    def _kill_preprocess(self) -> None:
+        """TERM first (lets a docker_wrap trap docker-kill its container),
+        escalate to KILL after a short grace."""
+        proc = self._preprocess_proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, 15)
+        except (ProcessLookupError, PermissionError):
+            return
+        deadline = time.monotonic() + 5
+        while proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if proc.poll() is None:
+            try:
+                os.killpg(proc.pid, 9)
+            except (ProcessLookupError, PermissionError):
+                pass
 
     # ------------------------------------------------------------------
     # Lifecycle
@@ -507,6 +533,7 @@ class Coordinator:
         with open(tmp, "w", encoding="utf-8") as f:
             json.dump(final, f)
         os.replace(tmp, os.path.join(self.job_dir, FINAL_STATUS_FILE))
+        self._kill_preprocess()
         self.backend.kill_all()
         self.backend.stop()
         self.hb_monitor.stop()

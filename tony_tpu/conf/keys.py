@@ -90,7 +90,7 @@ TPU_STATE_REFRESH_KEY = "tony.tpu.state-refresh-ms"
 # Staging / storage ("tony.staging.*"; HDFS-dir analog)
 # ---------------------------------------------------------------------------
 STAGING_DIR_KEY = "tony.staging.dir"
-SRC_DIR_KEY = "tony.application.src-dir"
+SRC_DIR_KEY = "tony.application.src-dir"                          # "" = no implicit staging
 PYTHON_VENV_KEY = "tony.application.python-venv"
 PYTHON_BINARY_PATH_KEY = "tony.application.python-binary-path"
 CONTAINER_LOG_DIR_KEY = "tony.container.log-dir"
@@ -144,7 +144,7 @@ DEFAULTS: dict[str, str] = {
     TPU_PREEMPTION_RETRIES_KEY: "3",
     TPU_STATE_REFRESH_KEY: "10000",
     STAGING_DIR_KEY: "",
-    SRC_DIR_KEY: "src",
+    SRC_DIR_KEY: "",
     PYTHON_VENV_KEY: "",
     PYTHON_BINARY_PATH_KEY: "",
     CONTAINER_LOG_DIR_KEY: "",

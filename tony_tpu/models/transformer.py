@@ -49,6 +49,11 @@ class TransformerConfig:
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # lax.scan unroll factor over layers: 1 = rolled while-loop (fast
+    # compile, the default); n_layers = fully unrolled (removes the scan's
+    # activation-stacking dynamic-update-slices, ~6% faster per step on one
+    # chip, slower compile). Any divisor of n_layers is valid.
+    scan_unroll: int = 1
     # MoE: 0 experts = dense MLP
     num_experts: int = 0
     moe_top_k: int = 2
@@ -228,7 +233,8 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         x, aux = block_fn(x, layer_params)
         return x, aux
 
-    x, auxes = jax.lax.scan(scan_body, x, params["blocks"])
+    x, auxes = jax.lax.scan(scan_body, x, params["blocks"],
+                            unroll=cfg.scan_unroll)
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
