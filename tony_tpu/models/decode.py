@@ -1,0 +1,203 @@
+"""Autoregressive decoding with a KV cache for the flagship transformer.
+
+The inference half of the model stack (the reference delegates all compute,
+so this — like training — is green-field per SURVEY.md §2.3). TPU-first
+choices:
+
+- **Static shapes everywhere**: the cache is a fixed [L, B, max_len, H, D]
+  buffer updated with ``lax.dynamic_update_slice``; the decode loop is a
+  ``lax.scan`` over step index — one compiled program regardless of prompt
+  or generation length.
+- **Prefill/decode split**: the prompt is processed in one batched forward
+  (MXU-friendly big matmuls, flash attention) that also fills the cache;
+  each generated token then runs the cheap single-position path attending
+  over the cache.
+- **Masked cache attention**: positions beyond the current length are
+  masked with -inf rather than sliced (dynamic slices of data-dependent
+  length would break XLA's static shapes).
+
+Sharding: single-program decode. Params may arrive device-sharded and XLA
+will resolve layouts, but this module adds no sharding constraints of its
+own — mesh-parallel (tp/dp) decode is not yet implemented.
+
+Usage::
+
+    out = generate(params, prompt_tokens, cfg, max_new_tokens=64,
+                   rng=jax.random.PRNGKey(0), temperature=0.8)
+    out.tokens      # [B, prompt_len + max_new_tokens]
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models import transformer as T
+from tony_tpu.ops.norms import rms_norm_reference
+
+
+class GenerateOutput(NamedTuple):
+    tokens: jax.Array        # [B, prompt_len + max_new_tokens]
+    logprobs: jax.Array      # [B, max_new_tokens] logprob of each sampled token
+
+
+def init_kv_cache(cfg: T.TransformerConfig, batch: int,
+                  max_len: int) -> dict:
+    """Zeroed cache pytree: k/v of shape [L, B, max_len, H, hd]."""
+    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype),
+            "length": jnp.zeros((), jnp.int32)}
+
+
+def _cached_attention(q, k_cache, v_cache, length):
+    """q: [B, 1, H, hd]; caches: [B, max_len, H, hd]; attend over the first
+    ``length`` cached positions (everything else masked). Operands stay in
+    the cache dtype (bf16 on TPU) with f32 accumulation — casting the whole
+    cache to f32 would double the hot loop's HBM traffic and halve MXU
+    throughput."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    max_len = k_cache.shape[1]
+    mask = jnp.arange(max_len)[None, None, None, :] < length   # [1,1,1,K]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)                    # f32
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                      v_cache,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg):
+    """Single-position decoder block. x: [B, 1, D]; caches [B, max_len, H,
+    hd] already containing this layer's past; returns (x, new_k, new_v)."""
+    p = layer_params
+    b = x.shape[0]
+    positions = jnp.full((b, 1), pos)
+
+    h = rms_norm_reference(x, p["attn_norm"])
+    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
+    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
+    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+    q, k = T._rope(q, positions), T._rope(k, positions)
+    # write this position into the cache
+    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
+    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
+    o = _cached_attention(q, k_cache, v_cache, pos + 1)
+    x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+
+    h = rms_norm_reference(x, p["mlp_norm"])
+    gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
+    up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
+    mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    return x + mlp_out, k_cache, v_cache
+
+
+def decode_step(params: dict, token: jax.Array, cache: dict, pos,
+                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+    """One decode step. token: [B] int32; returns (logits [B, V] f32,
+    updated cache). ``pos`` is the position being written (traced ok)."""
+    if cfg.num_experts:
+        raise NotImplementedError("cached decode supports dense MLP only")
+    x = params["embed"][token][:, None, :].astype(cfg.dtype)   # [B, 1, D]
+
+    def body(carry, inputs):
+        x = carry
+        layer_params, k_cache, v_cache = inputs
+        x, k_cache, v_cache = _decode_block(
+            x, layer_params, k_cache, v_cache, pos, cfg)
+        return x, (k_cache, v_cache)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = rms_norm_reference(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)[:, 0]
+    new_cache = {"k": new_k, "v": new_v, "length": pos + 1}
+    return logits, new_cache
+
+
+def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
+            max_len: int) -> tuple[jax.Array, dict]:
+    """Process the whole prompt in one forward, filling the cache.
+    tokens: [B, S]; returns (last-position logits [B, V], cache)."""
+    if cfg.num_experts:
+        raise NotImplementedError("cached decode supports dense MLP only")
+    b, s = tokens.shape
+    cache = init_kv_cache(cfg, b, max_len)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+
+    def body(x, inputs):
+        p, k_cache, v_cache = inputs
+        h = rms_norm_reference(x, p["attn_norm"])
+        q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
+        k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
+        v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+        q, k = T._rope(q, positions), T._rope(k, positions)
+        o = T._attention(q, k, v, None)
+        x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+        h = rms_norm_reference(x, p["mlp_norm"])
+        gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
+        up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
+        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
+                           p["w_down"])
+        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
+        return x, (k_cache, v_cache)
+
+    x, (k_filled, v_filled) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = rms_norm_reference(x, params["final_norm"])
+    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    return logits, {"k": k_filled, "v": v_filled,
+                    "length": jnp.asarray(s, jnp.int32)}
+
+
+def _sample(logits, rng, temperature: float, top_k: int):
+    """logits [B, V] f32 → (token [B], logprob [B]).
+
+    The returned logprob is the MODEL's log p(token) — computed from the
+    raw logits, before top-k masking or temperature — so it is usable for
+    perplexity / importance weights regardless of sampling settings."""
+    model_logp = jax.nn.log_softmax(logits, axis=-1)
+    if top_k > 0:
+        vals, _ = jax.lax.top_k(logits, top_k)
+        cutoff = vals[:, -1][:, None]
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    if temperature == 0.0:
+        token = jnp.argmax(logits, axis=-1)
+    else:
+        token = jax.random.categorical(rng, logits / temperature, axis=-1)
+    return token, jnp.take_along_axis(model_logp, token[:, None],
+                                      axis=-1)[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
+                                             "temperature", "top_k"))
+def generate(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
+             max_new_tokens: int, rng: jax.Array,
+             temperature: float = 0.0, top_k: int = 0) -> GenerateOutput:
+    """Prefill + scan-decode. prompt: [B, S] int32. Greedy when
+    temperature=0. One compiled program; re-traces only on new static
+    shapes/config."""
+    b, s = prompt.shape
+    max_len = s + max_new_tokens
+    logits, cache = prefill(params, prompt, cfg, max_len)
+
+    def step(carry, step_rng):
+        logits, cache = carry
+        token, logp = _sample(logits, step_rng, temperature, top_k)
+        new_logits, cache = decode_step(params, token, cache,
+                                        cache["length"], cfg)
+        return (new_logits, cache), (token, logp)
+
+    rngs = jax.random.split(rng, max_new_tokens)
+    _, (tokens, logprobs) = jax.lax.scan(step, (logits, cache), rngs)
+    return GenerateOutput(
+        tokens=jnp.concatenate([prompt, tokens.T], axis=1),
+        logprobs=logprobs.T)
