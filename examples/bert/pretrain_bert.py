@@ -1,0 +1,101 @@
+"""BERT MLM pretraining — the 16-worker multi-host progression config.
+
+BASELINE.json's final progression step: "16w BERT-base jax.distributed
+multi-host". The framework boots ``jax.distributed`` across all hosts
+(rt.initialize), every process feeds its shard of the global batch, and the
+MLM loss/optimizer run as one SPMD program over the ``dp`` (or
+``dp×fsdp``) mesh. Synthetic masked-token data (15% masked) keeps the
+example self-contained.
+
+Usage:
+    python -m tony_tpu.client.cli submit \
+        --conf tony.worker.instances=16 \
+        --conf tony.application.mesh=dp=-1 \
+        --executes 'python examples/bert/pretrain_bert.py --steps 200 --config base'
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import tony_tpu.runtime as rt
+from tony_tpu.models import bert as B
+from tony_tpu.models.train import (batch_sharding, default_optimizer,
+                                   global_batch, init_state,
+                                   make_train_step)
+from tony_tpu.parallel import shard_pytree
+
+CONFIGS = {"base": B.BERT_BASE, "tiny": B.BERT_TINY}
+MASK_FRACTION = 0.15
+
+
+def synthetic_mlm_batch(rng, batch, seq, cfg):
+    """Random token ids with 15% positions masked-out as targets (-1 =
+    ignore elsewhere), the MLM shape without a corpus."""
+    kt, km = jax.random.split(rng)
+    tokens = jax.random.randint(kt, (batch, seq), 0, cfg.vocab_size)
+    masked = jax.random.uniform(km, (batch, seq)) < MASK_FRACTION
+    targets = jnp.where(masked, tokens, -1)
+    mask_id = cfg.vocab_size - 1
+    inputs = jnp.where(masked, mask_id, tokens)
+    return {"tokens": inputs, "targets": targets}
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--config", default="tiny", choices=sorted(CONFIGS))
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch_size", type=int, default=16,
+                        help="batch size PER PROCESS (global = this x hosts)")
+    parser.add_argument("--seq_len", type=int, default=128)
+    parser.add_argument("--lr", type=float, default=1e-4)
+    args = parser.parse_args()
+
+    info = rt.initialize()
+    mesh = rt.mesh()
+    print(f"[{info.job_name}:{info.task_index}] "
+          f"{len(jax.devices())} global devices "
+          f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}",
+          flush=True)
+
+    cfg = CONFIGS[args.config]
+    if jax.default_backend() != "tpu":
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    seq = min(args.seq_len, cfg.max_seq)
+
+    params = shard_pytree(B.init_params(jax.random.PRNGKey(0), cfg),
+                          B.logical_axes(cfg), mesh)
+    opt = default_optimizer(lr=args.lr, total_steps=args.steps)
+    state = init_state(params, opt)
+    step = make_train_step(lambda p, b: B.mlm_loss(p, b, cfg, mesh), opt,
+                           mesh)
+
+    sharding = batch_sharding(mesh, logical=("batch", "seq"))
+    rng = jax.random.PRNGKey(1000 + info.task_index)
+    loss = float("nan")
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        rng, key = jax.random.split(rng)
+        # Each process contributes its local shard of the global batch.
+        batch = global_batch(
+            sharding, synthetic_mlm_batch(key, args.batch_size, seq, cfg))
+        state, metrics = step(state, batch)
+        if i % 20 == 0 or i == args.steps - 1:
+            loss = float(metrics["loss"])
+            tok_s = (args.batch_size * info.num_processes * seq * (i + 1)
+                     / (time.perf_counter() - t0))
+            print(f"step {i} mlm loss {loss:.4f} tok/s {tok_s:,.0f}",
+                  flush=True)
+    ok = jnp.isfinite(loss)
+    print(f"done: final loss {loss:.4f}", flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,7 +34,8 @@ import tony_tpu.runtime as rt
 from tony_tpu.models import transformer as T
 from tony_tpu.models.checkpoint import CheckpointManager, attempt_number
 from tony_tpu.models.train import (batch_sharding, default_optimizer,
-                                   init_state, make_train_step)
+                                   global_batch, init_state,
+                                   make_train_step)
 from tony_tpu.parallel import shard_pytree
 from tony_tpu.runtime.profiler import StepTracer
 
@@ -49,7 +50,8 @@ def main() -> int:
     parser.add_argument("--preset", default="tiny",
                         choices=sorted(T.PRESETS))
     parser.add_argument("--steps", type=int, default=100)
-    parser.add_argument("--batch_size", type=int, default=8)
+    parser.add_argument("--batch_size", type=int, default=8,
+                        help="batch size PER PROCESS (global = this x hosts)")
     parser.add_argument("--seq_len", type=int, default=256)
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--ckpt_dir", default="")
@@ -88,15 +90,18 @@ def main() -> int:
     for step in range(start_step, args.steps):
         tracer.step(step)
         rng, key = jax.random.split(rng)
-        batch = jax.device_put(
-            synthetic_batch(key, args.batch_size, args.seq_len,
-                            cfg.vocab_size), b_sharding)
+        # Per-process shard → global array (per-task rng means the data
+        # differs across hosts; device_put would assert value equality).
+        batch = global_batch(
+            b_sharding, synthetic_batch(key, args.batch_size, args.seq_len,
+                                        cfg.vocab_size))
         state, metrics = step_fn(state, batch)
         if mgr:
             mgr.save(step + 1, state)
         if step % 20 == 0 or step == args.steps - 1:
             loss = float(metrics["loss"])
-            tok_s = (args.batch_size * args.seq_len * (step - start_step + 1)
+            tok_s = (args.batch_size * info.num_processes * args.seq_len
+                     * (step - start_step + 1)
                      / (time.perf_counter() - t0))
             print(f"step {step} loss {loss:.4f} tok/s {tok_s:,.0f}",
                   flush=True)

@@ -441,3 +441,42 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert client.run() == 1
         logs = os.listdir(os.path.join(client.job_dir, "logs"))
         assert not any(n.startswith("worker") for n in logs)
+
+    def test_distributed_resnet_dp_trains(self, tmp_path):
+        """Progression config: ResNet DP across 2 processes (the 8w config
+        at test scale — same code path, the instance count is config)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "resnet", "train_resnet.py")
+        client = make_client(
+            tmp_path,
+            f"{PY} {script} --steps 3 --batch_size 4 --image_size 32 "
+            f"--num_classes 10 --lr 0.01",
+            {"tony.worker.instances": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "devices=2" in out
+        assert "done:" in out
+
+    def test_distributed_bert_mlm_trains(self, tmp_path):
+        """Progression config: BERT MLM pretraining, jax.distributed
+        multi-host (2 processes at test scale of the 16w config)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "bert", "pretrain_bert.py")
+        client = make_client(
+            tmp_path,
+            f"{PY} {script} --steps 3 --batch_size 4 --seq_len 64",
+            {"tony.worker.instances": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "2 global devices" in out
+        assert "done:" in out

@@ -97,6 +97,17 @@ def batch_sharding(mesh: Mesh, rules: Rules = DEFAULT_RULES,
     return logical_sharding(logical, mesh, rules)
 
 
+def global_batch(sharding: NamedSharding, local_tree: Any) -> Any:
+    """Assemble each process's LOCAL batch shard into global jax.Arrays —
+    the multi-host feeding recipe (every process calls this with its own,
+    different data; ``jax.device_put`` would instead assert the value is
+    identical everywhere). Leaves may differ in rank; the sharding's spec
+    applies to the leading (batch) dims and replicates the rest."""
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        local_tree)
+
+
 def make_eval_step(loss_fn: Callable[[Any, Any], jax.Array],
                    mesh: Mesh | None = None) -> Callable:
     jitted = jax.jit(lambda params, batch: loss_fn(params, batch))
