@@ -480,3 +480,24 @@ runpy.run_path(r"{script}", run_name="__main__")
                                 "worker-0.stdout")).read()
         assert "2 global devices" in out
         assert "done:" in out
+
+    def test_distributed_context_parallel_lm_trains(self, tmp_path):
+        """Long-context config: the LM trains with the sequence sharded over
+        a 2-process cp mesh axis — ring attention's ppermute collectives run
+        across real process boundaries, not just virtual devices."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "lm", "train_lm.py")
+        client = make_client(
+            tmp_path,
+            f"{PY} {script} --steps 3 --batch_size 2 --seq_len 128 "
+            f"--preset tiny",
+            {"tony.worker.instances": "2",
+             "tony.application.mesh": "cp=2",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "'cp': 2" in out
+        assert "done:" in out

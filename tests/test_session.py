@@ -125,3 +125,55 @@ def test_mesh_spec_in_payload():
     for tid in ("worker:0", "worker:1", "ps:0"):
         payload = s.register_task_spec(tid, "h:1")
     assert json.loads(payload["mesh_spec"]) == {"axes": {"dp": 2, "tp": 1}}
+
+
+def test_uptime_metrics_tracked_fraction():
+    """North-star metric: tracked-task uptime fraction is computed from
+    registration->completion windows (reference's Metric channel was always
+    empty; TonyApplicationMaster.java:408-410)."""
+    import time as _time
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.cluster.session import Session
+
+    conf = TonyConfig({"tony.worker.instances": "2",
+                       "tony.ps.instances": "1"})
+    s = Session(conf)
+    s.register_task_spec("worker:0", "h0:1")
+    s.register_task_spec("worker:1", "h1:1")
+    s.register_task_spec("ps:0", "h2:1")
+    _time.sleep(0.05)
+    s.on_task_completed("worker", 0, 0)
+    s.on_task_completed("worker", 1, 0)
+    m = s.uptime_metrics()
+    assert set(m) == {"session_wall_s", "tracked_window_s", "task_uptime_s",
+                      "tracked_uptime_fraction"}
+    assert set(m["task_uptime_s"]) == {"worker:0", "worker:1", "ps:0"}
+    assert m["task_uptime_s"]["worker:0"] > 0
+    # Registered almost immediately after session start → fraction near 1;
+    # ps is untracked and excluded from the fraction.
+    assert 0.5 < m["tracked_uptime_fraction"] <= 1.0
+
+
+def test_uptime_metrics_unregistered_task_is_zero():
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.cluster.session import Session
+
+    s = Session(TonyConfig({"tony.worker.instances": "1"}))
+    m = s.uptime_metrics()
+    assert m["task_uptime_s"]["worker:0"] == 0.0
+    assert m["tracked_uptime_fraction"] == 0.0
+
+
+def test_uptime_fraction_counts_never_registered_tracked_tasks():
+    """A gang stuck at the barrier because one worker never came up is NOT
+    100% uptime — the missing task zeroes into the denominator."""
+    import time as _time
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.cluster.session import Session
+
+    s = Session(TonyConfig({"tony.worker.instances": "2"}))
+    s.register_task_spec("worker:0", "h0:1")   # worker:1 never registers
+    _time.sleep(0.02)
+    m = s.uptime_metrics()
+    assert m["task_uptime_s"]["worker:1"] == 0.0
+    assert m["tracked_uptime_fraction"] <= 0.51

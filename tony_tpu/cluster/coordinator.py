@@ -162,6 +162,7 @@ class Coordinator:
                                       os.environ.get("USER", "unknown"))
         self._workers_terminated = False
         self._preprocess_proc = None
+        self._session_metrics: list[dict] = []   # prior attempts' uptimes
 
     # ------------------------------------------------------------------
     # RPC-driven hooks
@@ -514,9 +515,28 @@ class Coordinator:
             self._session_real_failure = False
             self.events.emit(ev.SESSION_RESET,
                              old_session_id=self.session.session_id)
+            # Keep the failed attempt's uptime: the north-star fraction must
+            # reflect work lost to preemption/failure, not just the attempt
+            # that finally succeeded.
+            self._session_metrics.append(self.session.uptime_metrics())
             self.session = next_session(self.session)
 
         return self.stop(status)
+
+    def _combined_uptime_metrics(self) -> dict:
+        """Merge uptime across ALL attempts: the tracked fraction is the
+        window-weighted mean over sessions, so time lost to preempted or
+        failed attempts stays visible in the final number."""
+        final = self.session.uptime_metrics()
+        sessions = self._session_metrics + [final]
+        weights = [m["tracked_window_s"] for m in sessions]
+        total_w = sum(weights)
+        if total_w > 0:
+            final["tracked_uptime_fraction"] = round(
+                sum(m["tracked_uptime_fraction"] * w
+                    for m, w in zip(sessions, weights)) / total_w, 4)
+        final["attempts"] = len(sessions)
+        return final
 
     def stop(self, status: SessionStatus) -> int:
         self.final_status = status.value
@@ -542,7 +562,7 @@ class Coordinator:
             status=self.final_status,
             failed_tasks=[t.task_id for t in self.session.all_tasks()
                           if t.status is TaskStatus.FAILED],
-            metrics={})
+            metrics=self._combined_uptime_metrics())
         self.events.stop(self.final_status)
         # Wait briefly for the client's finish signal (reference: stop:669-694
         # polls up to 30s for finishApplication), then stop serving RPC.

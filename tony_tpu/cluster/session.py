@@ -28,6 +28,7 @@ import json
 import logging
 import re
 import threading
+import time
 from dataclasses import dataclass, field
 from enum import Enum
 
@@ -66,6 +67,8 @@ class SessionTask:
     url: str = ""
     process_id: int = -1            # dense JAX process id, assigned at barrier
     allocation_id: int = -1         # backend allocation handle
+    registered_at: float = 0.0      # monotonic time of first registration
+    completed_at: float = 0.0       # monotonic time of completion report
 
     @property
     def task_id(self) -> str:
@@ -87,6 +90,7 @@ class Session:
         self.conf = conf
         self.session_id = session_id
         self.status = SessionStatus.RUNNING
+        self.started_at = time.monotonic()
         self.failure_message: str | None = None
         self._lock = threading.RLock()
         self._chief_regex = re.compile(conf.get(K.CHIEF_REGEX_KEY) or "$^")
@@ -142,6 +146,7 @@ class Session:
             task.spec = spec
             if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
                 task.status = TaskStatus.REGISTERED
+                task.registered_at = time.monotonic()
             if not self.barrier_released():
                 return None
             self._assign_process_ids()
@@ -224,6 +229,7 @@ class Session:
             task.exit_code = exit_code
             task.status = (TaskStatus.SUCCEEDED if exit_code == 0
                            else TaskStatus.FAILED)
+            task.completed_at = time.monotonic()
             if exit_code != 0 and self.is_tracked(job_type):
                 self.status = SessionStatus.FAILED
                 self.failure_message = (
@@ -243,8 +249,49 @@ class Session:
             if not task.completed:
                 task.status = TaskStatus.FAILED
                 task.exit_code = -1
+                task.completed_at = time.monotonic()
             self.status = SessionStatus.FAILED
             self.failure_message = f"task {task_id} missed heartbeats, deemed dead"
+
+    def uptime_metrics(self) -> dict:
+        """Per-task uptime (registration -> completion/now) and the overall
+        tracked-task uptime fraction — the north-star ">90% worker-task
+        uptime" metric. The reference's metrics channel existed but was
+        always written empty (TonyApplicationMaster.java:408-410); here it
+        carries real numbers."""
+        with self._lock:
+            now = time.monotonic()
+            uptimes = {}
+            for t in self.all_tasks():
+                uptimes[t.task_id] = ((t.completed_at or now)
+                                      - t.registered_at
+                                      if t.registered_at else 0.0)
+            # Uptime fraction is measured over the TRAINING window — first
+            # tracked registration to last tracked completion — so scheduler
+            # startup latency does not dilute it (a task that died mid-run
+            # still shows as a gap). Tracked tasks that NEVER registered
+            # count as zero uptime in the denominator: a gang stuck at the
+            # barrier because one worker died is 0% training, not 100%.
+            tracked = [t for t in self.all_tasks()
+                       if self.is_tracked(t.job_type)]
+            registered = [t for t in tracked if t.registered_at]
+            if registered:
+                start = min(t.registered_at for t in registered)
+                end = max((t.completed_at or now) for t in registered)
+                window = max(end - start, 1e-9)
+                fraction = sum(
+                    min(uptimes[t.task_id] / window, 1.0)
+                    for t in tracked) / len(tracked)
+            else:
+                window = 0.0
+                fraction = 0.0
+            return {
+                "session_wall_s": round(now - self.started_at, 3),
+                "tracked_window_s": round(window, 3),
+                "task_uptime_s": {k: round(v, 3)
+                                  for k, v in uptimes.items()},
+                "tracked_uptime_fraction": round(fraction, 4),
+            }
 
     def update_session_status(self) -> SessionStatus:
         """Reduce task states to a final status once all *tracked* tasks are
