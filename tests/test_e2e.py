@@ -501,3 +501,73 @@ runpy.run_path(r"{script}", run_name="__main__")
                                 "worker-0.stdout")).read()
         assert "'cp': 2" in out
         assert "done:" in out
+
+    def test_venv_unzipped_and_on_path(self, tmp_path):
+        """A staged venv.zip is extracted once per host and its bin/ leads
+        PATH in the user process (reference: TaskExecutor.java:96-105)."""
+        import zipfile
+        venv_zip = tmp_path / "venv.zip"
+        with zipfile.ZipFile(venv_zip, "w") as zf:
+            zf.writestr("bin/myvenvtool", "#!/bin/bash\necho venv-tool-ran\n")
+        client = make_client(
+            tmp_path, "myvenvtool",
+            {"tony.worker.instances": "2",
+             "tony.application.python-venv": str(venv_zip)})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "venv-tool-ran" in out
+
+    def test_job_type_resources_localized(self, tmp_path):
+        """tony.<job>.resources files are copied into the job dir before
+        launch (reference: ContainerLauncher.run:1090-1104)."""
+        extra = tmp_path / "vocab.txt"
+        extra.write_text("hello-vocab")
+        client = make_client(
+            tmp_path, 'bash -c "grep -q hello-vocab vocab.txt"',
+            {"tony.worker.instances": "1",
+             "tony.worker.resources": str(extra)})
+        assert client.run() == 0
+
+    def test_missing_resource_fails_job(self, tmp_path):
+        client = make_client(
+            tmp_path, "true",
+            {"tony.worker.instances": "1",
+             "tony.worker.resources": str(tmp_path / "nope.bin")})
+        assert client.run() == 1
+
+    def test_venv_with_symlinks_extracted_correctly(self, tmp_path):
+        """A real pip venv zips bin/python as a symlink; extraction must
+        recreate it as a link (ZipFile.extractall writes the target path as
+        file CONTENT — the classic broken-venv failure)."""
+        import stat
+        import zipfile
+        venv_zip = tmp_path / "venv.zip"
+        with zipfile.ZipFile(venv_zip, "w") as zf:
+            zf.writestr("bin/real-tool",
+                        "#!/bin/bash\necho symlinked-venv-ok\n")
+            link = zipfile.ZipInfo("bin/tool-link")
+            link.external_attr = (stat.S_IFLNK | 0o777) << 16
+            zf.writestr(link, "real-tool")
+        client = make_client(
+            tmp_path, "tool-link",
+            {"tony.worker.instances": "1",
+             "tony.application.python-venv": str(venv_zip)})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "symlinked-venv-ok" in out
+
+    def test_conflicting_resources_fail_loudly(self, tmp_path):
+        """Two job types localizing DIFFERENT files under one basename must
+        error, not silently serve the first file to both."""
+        (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+        (tmp_path / "a" / "config.json").write_text('{"for": "worker"}')
+        (tmp_path / "b" / "config.json").write_text('{"for": "ps"}')
+        client = make_client(
+            tmp_path, "true",
+            {"tony.worker.instances": "1",
+             "tony.ps.instances": "1",
+             "tony.worker.resources": str(tmp_path / "a" / "config.json"),
+             "tony.ps.resources": str(tmp_path / "b" / "config.json")})
+        assert client.run() == 1

@@ -236,11 +236,42 @@ class Coordinator:
                 f"--conf_file {shlex.quote(conf_path)} "
                 f"--task_command {shlex.quote(user_command)}")
 
+    def _localize_resources(self, request) -> None:
+        """Copy per-job-type extra resources (tony.{job}.resources, comma-
+        separated paths) into the job dir — the YARN localization analog
+        (reference: ContainerLauncher.run:1090-1104 localizes job-type +
+        global resources into each container)."""
+        import filecmp
+        import shutil
+        for path in filter(None, (request.resources or "").split(",")):
+            path = path.strip()
+            if not path:
+                continue
+            dst = os.path.join(self.job_dir, os.path.basename(path))
+            if os.path.exists(dst):
+                # Resources are flattened by basename; a silent skip would
+                # hand one job type another's file. Identical content (same
+                # file listed by several job types) is fine.
+                if os.path.isfile(path) and os.path.isfile(dst) and \
+                        filecmp.cmp(path, dst, shallow=False):
+                    continue
+                raise ValueError(
+                    f"{request.job_type}: resource {path!r} collides with an "
+                    f"already-localized different {os.path.basename(path)!r}")
+            if os.path.isdir(path):
+                shutil.copytree(path, dst)
+            elif os.path.exists(path):
+                shutil.copy2(path, dst)
+            else:
+                raise FileNotFoundError(
+                    f"{request.job_type}: resource {path!r} does not exist")
+
     def schedule_tasks(self, user_command: str) -> None:
         """Bind every task to an allocation and launch it (reference:
         scheduleTasks:549 + ContainerLauncher.run:1080)."""
         requests = self.session.requests
         for job_type, request in requests.items():
+            self._localize_resources(request)
             while True:
                 task = self.session.next_allocation(job_type)
                 if task is None:
@@ -529,7 +560,11 @@ class Coordinator:
         failed attempts stays visible in the final number."""
         final = self.session.uptime_metrics()
         sessions = self._session_metrics + [final]
-        weights = [m["tracked_window_s"] for m in sessions]
+        # An attempt whose gang never registered has window 0 but still
+        # burned wall time — floor its weight at the session wall so lost
+        # attempts cannot vanish from the combined fraction.
+        weights = [m["tracked_window_s"] or m["session_wall_s"]
+                   for m in sessions]
         total_w = sum(weights)
         if total_w > 0:
             final["tracked_uptime_fraction"] = round(

@@ -258,6 +258,65 @@ class TaskExecutor:
             log.info("chaos: hanging 20s before exit")
             time.sleep(20)
 
+    def _prepare_venv(self) -> str | None:
+        """Unzip the staged venv once per host (reference: TaskExecutor.java:
+        96-105 unzips venv.zip before exec). All executors of a job share
+        the job dir as cwd, so the extraction is crash-safe by atomic
+        rename: each racer extracts into its own temp dir and renames it
+        into place; losers discard theirs. A winner dying mid-extract leaves
+        only a temp dir — never a wedged lock or a partial venv. Returns the
+        venv bin dir to prepend to PATH, or None."""
+        zip_path = os.path.join(os.getcwd(), constants.TONY_VENV_ZIP)
+        if not os.path.exists(zip_path):
+            return None
+        venv_dir = os.path.join(os.getcwd(), constants.TONY_VENV_DIR)
+        if not os.path.isdir(venv_dir):
+            import shutil
+            tmp = f"{venv_dir}.tmp-{os.getpid()}"
+            log.info("unzipping %s → %s", zip_path, venv_dir)
+            try:
+                self._extract_zip_with_symlinks(zip_path, tmp)
+                # Zips built without unix mode bits (plain archivers) leave
+                # venv binaries non-executable; ensure bin/* are runnable.
+                tmp_bin = os.path.join(tmp, "bin")
+                if os.path.isdir(tmp_bin):
+                    for name in os.listdir(tmp_bin):
+                        p = os.path.join(tmp_bin, name)
+                        if os.path.isfile(p) and not os.path.islink(p):
+                            os.chmod(p, os.stat(p).st_mode | 0o755)
+                os.rename(tmp, venv_dir)
+            except OSError:
+                if not os.path.isdir(venv_dir):
+                    raise      # real extraction failure, not a lost race
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+        bin_dir = os.path.join(venv_dir, "bin")
+        return bin_dir if os.path.isdir(bin_dir) else None
+
+    @staticmethod
+    def _extract_zip_with_symlinks(zip_path: str, dest: str) -> None:
+        """ZipFile.extractall writes symlink entries (a real venv's
+        bin/python) as text files and drops unix mode bits; extract
+        manually, restoring both from external_attr."""
+        import stat
+        import zipfile
+        with zipfile.ZipFile(zip_path) as zf:
+            for zi in zf.infolist():
+                mode = zi.external_attr >> 16
+                target = os.path.join(dest, zi.filename)
+                if not os.path.realpath(target).startswith(
+                        os.path.realpath(dest)):
+                    raise ValueError(f"zip entry escapes dest: {zi.filename}")
+                if zi.is_dir():
+                    os.makedirs(target, exist_ok=True)
+                elif stat.S_ISLNK(mode):
+                    os.makedirs(os.path.dirname(target), exist_ok=True)
+                    os.symlink(zf.read(zi).decode(), target)
+                else:
+                    zf.extract(zi, dest)
+                    if mode:
+                        os.chmod(target, stat.S_IMODE(mode))
+
     def run(self) -> int:
         log.info("task %s registering with coordinator %s",
                  self.task_id, self.am_address)
@@ -280,7 +339,16 @@ class TaskExecutor:
                     f"http://{host}:{self.notebook_port}")
             except Exception:
                 log.warning("notebook URL registration failed", exc_info=True)
-        exit_code = self.run_user_process(self.framework_env())
+        extra_env = self.framework_env()
+        venv_bin = self._prepare_venv()
+        if venv_bin:
+            # venv binaries take precedence; the base PATH must honor a
+            # user-provided --shell_env PATH (it wins over os.environ in
+            # run_user_process's merge).
+            base_path = self.shell_env.get("PATH") or os.environ.get(
+                "PATH", "")
+            extra_env["PATH"] = venv_bin + os.pathsep + base_path
+        exit_code = self.run_user_process(extra_env)
         self.apply_chaos_after_training()
         heartbeater.stop_event.set()
         try:

@@ -89,6 +89,7 @@ TONY_XML = "tony.xml"
 TONY_SITE_XML = "tony-site.xml"
 TONY_SRC_ZIP = "tony_src.zip"
 TONY_VENV_ZIP = "venv.zip"
+TONY_VENV_DIR = "venv"
 TONY_JOB_DIR_PREFIX = ".tony"          # staging dir per-application
 TONY_LOG_DIR = "logs"
 CORE_SITE_CONF = "core-site.xml"
