@@ -571,3 +571,23 @@ runpy.run_path(r"{script}", run_name="__main__")
              "tony.worker.resources": str(tmp_path / "a" / "config.json"),
              "tony.ps.resources": str(tmp_path / "b" / "config.json")})
         assert client.run() == 1
+
+    def test_distributed_tensorflow_example_trains(self, tmp_path):
+        """Progression config: TF2 MultiWorkerMirroredStrategy consumes the
+        exported TF_CONFIG across 2 workers (reference parity for the
+        mnist-tensorflow example)."""
+        pytest.importorskip("tensorflow")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "mnist-tensorflow",
+                              "mnist_distributed.py")
+        client = make_client(
+            tmp_path, f"{PY} {script} --steps 20 --batch_size 32",
+            {"tony.worker.instances": "2",
+             "tony.application.framework": "tensorflow",
+             "tony.application.timeout": "240000"},
+            shell_env={"PYTHONPATH": repo, "CUDA_VISIBLE_DEVICES": "-1"})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "'type': 'worker', 'index': 0" in out
+        assert "final loss" in out
