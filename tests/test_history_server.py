@@ -234,3 +234,30 @@ def test_concurrent_index_loads_race_free(dirs):
         t.join()
     assert not errs
     assert len(s.list_jobs()) == 20
+
+
+def test_index_shows_uptime_column(tmp_path):
+    """The index surfaces the tracked-uptime fraction from the final event."""
+    import json as _json
+    import time as _time
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.events import events as ev
+    from tony_tpu.history.server import HistoryServer
+
+    hist = tmp_path / "hist"
+    handler = ev.EventHandler(str(hist / "intermediate"), "application_9_0001",
+                              "alice")
+    handler.start()
+    handler.emit(ev.APPLICATION_INITED, app_id="application_9_0001",
+                 num_tasks=1, host="h")
+    handler.emit(ev.APPLICATION_FINISHED, app_id="application_9_0001",
+                 status="SUCCEEDED", failed_tasks=[],
+                 metrics={"tracked_uptime_fraction": 0.957,
+                          "task_uptime_s": {"worker:0": 3.2},
+                          "session_wall_s": 3.4, "tracked_window_s": 3.2})
+    handler.stop("SUCCEEDED")
+    conf = TonyConfig({"tony.history.location": str(hist)})
+    server = HistoryServer(conf, port=0)
+    page = server._render_index()
+    assert "<th>Uptime</th>" in page
+    assert "95.7%" in page

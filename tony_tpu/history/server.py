@@ -199,6 +199,8 @@ class HistoryServer:
         self._scan_lock = threading.Lock()
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        # path → uptime display string; finished jhist files are immutable
+        self._uptime_by_path: dict[str, str] = {}
 
     # -- data access --------------------------------------------------------
     def list_jobs(self) -> list[dict]:
@@ -276,6 +278,32 @@ class HistoryServer:
         return self.config_cache.get_or_load(
             app_id, lambda: self._load_fresh_on_vanish(app_id, read_config))
 
+    def job_uptime(self, job: dict) -> str:
+        """Tracked-uptime fraction from the final event, as a display string
+        ('-' while running / when absent). Finished jhist files are
+        immutable, so the value is cached permanently per file path (a
+        migration changes the path → one re-read); running jobs have no
+        final event yet and are never parsed."""
+        if job["completed_ms"] is None:
+            return "-"
+        path = job["path"]
+        cached = self._uptime_by_path.get(path)
+        if cached is not None:
+            return cached
+        result = "-"
+        try:
+            for e in reversed(ev.parse_events(path)):
+                if e.event_type == "APPLICATION_FINISHED":
+                    frac = (e.payload.get("metrics") or {}).get(
+                        "tracked_uptime_fraction")
+                    if frac is not None:
+                        result = f"{float(frac) * 100:.1f}%"
+                    break
+        except Exception:
+            pass       # one malformed log must not 500 the whole index
+        self._uptime_by_path[path] = result
+        return result
+
     # -- html rendering ------------------------------------------------------
     def _render_index(self) -> str:
         rows = []
@@ -287,9 +315,11 @@ class HistoryServer:
                 f"<td>{_fmt_ts(j['started_ms'])}</td>"
                 f"<td>{_fmt_ts(j['completed_ms'])}</td>"
                 f"<td class='{j['status']}'>{j['status']}</td>"
+                f"<td>{html.escape(self.job_uptime(j))}</td>"
                 f"<td><a href='/config/{aid}'>config</a></td></tr>")
         body = ("<table><tr><th>Job</th><th>User</th><th>Started (UTC)"
-                "</th><th>Completed (UTC)</th><th>Status</th><th></th>"
+                "</th><th>Completed (UTC)</th><th>Status</th>"
+                "<th>Uptime</th><th></th>"
                 "</tr>" + "".join(rows) + "</table>") if rows else \
             "<p>No jobs found.</p>"
         return _PAGE.format(title="TonY-TPU job history", body=body)
