@@ -45,6 +45,36 @@ def synthetic_batch(rng: jax.Array, batch: int, seq: int, vocab: int):
     return {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
 
 
+def file_batches(paths, batch: int, seq: int, mesh, steps: int, seed: int):
+    """Token batches from binary files via the data-feed layer: each record
+    is seq+1 int32 token ids; every process reads only its byte-range split
+    (tony_tpu.io) and batches assemble as global sharded arrays. Cycles
+    epochs (reshuffled) until ``steps`` batches are yielded."""
+    import numpy as np
+    from tony_tpu.io.jax_feed import global_batches
+
+    produced = 0
+    epoch = 0
+    while produced < steps:
+        yielded_this_epoch = False
+        # batch axes mirror the train step's ("batch",) logical rule
+        # (dp and fsdp jointly) so file-fed and synthetic batches shard
+        # identically on any mesh.
+        for tokens in global_batches(paths, batch, np.int32, (seq + 1,),
+                                     mesh, batch_axes=("dp", "fsdp"),
+                                     shuffle=True, seed=seed + epoch):
+            yield {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
+            yielded_this_epoch = True
+            produced += 1
+            if produced >= steps:
+                return
+        if not yielded_this_epoch:
+            raise ValueError(
+                f"data files hold fewer than one full batch per process "
+                f"(batch_size={batch}, seq_len={seq}) — nothing to train on")
+        epoch += 1
+
+
 def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--preset", default="tiny",
@@ -56,6 +86,10 @@ def main() -> int:
     parser.add_argument("--lr", type=float, default=3e-4)
     parser.add_argument("--ckpt_dir", default="")
     parser.add_argument("--ckpt_every", type=int, default=50)
+    parser.add_argument("--data_files", nargs="*", default=[],
+                        help="binary token files (records of seq_len+1 "
+                             "int32 ids) fed via the sharded data layer; "
+                             "empty = synthetic data")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -85,16 +119,24 @@ def main() -> int:
     tracer = StepTracer(start=start_step + 5, stop=start_step + 8)
     rng = jax.random.PRNGKey(info.task_index + 1000 * attempt_number())
 
+    data_it = (file_batches(args.data_files, args.batch_size, args.seq_len,
+                            mesh, args.steps - start_step,
+                            seed=attempt_number())
+               if args.data_files else None)
+
     t0 = time.perf_counter()
     loss = float("nan")
     for step in range(start_step, args.steps):
         tracer.step(step)
-        rng, key = jax.random.split(rng)
-        # Per-process shard → global array (per-task rng means the data
-        # differs across hosts; device_put would assert value equality).
-        batch = global_batch(
-            b_sharding, synthetic_batch(key, args.batch_size, args.seq_len,
-                                        cfg.vocab_size))
+        if data_it is not None:
+            batch = next(data_it)
+        else:
+            rng, key = jax.random.split(rng)
+            # Per-process shard → global array (per-task rng means the data
+            # differs across hosts; device_put would assert value equality).
+            batch = global_batch(
+                b_sharding, synthetic_batch(key, args.batch_size,
+                                            args.seq_len, cfg.vocab_size))
         state, metrics = step_fn(state, batch)
         if mgr:
             mgr.save(step + 1, state)

@@ -238,6 +238,11 @@ class HistoryServer:
                     by_app[meta.app_id] = job
         jobs = sorted(by_app.values(), key=lambda j: j["started_ms"],
                       reverse=True)
+        # Evict uptime entries whose files were purged or migrated away so
+        # the permanent cache tracks only live paths.
+        live = {j["path"] for j in jobs}
+        for stale in [p for p in self._uptime_by_path if p not in live]:
+            del self._uptime_by_path[stale]
         return jobs
 
     def _find_job(self, app_id: str) -> dict | None:
@@ -292,13 +297,22 @@ class HistoryServer:
             return cached
         result = "-"
         try:
-            for e in reversed(ev.parse_events(path)):
-                if e.event_type == "APPLICATION_FINISHED":
-                    frac = (e.payload.get("metrics") or {}).get(
-                        "tracked_uptime_fraction")
-                    if frac is not None:
-                        result = f"{float(frac) * 100:.1f}%"
-                    break
+            # jhist is JSON-lines with APPLICATION_FINISHED last: read only
+            # the file tail instead of parsing N full event logs per index.
+            with open(path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - 65536))
+                tail = f.read().decode("utf-8", errors="replace")
+            for line in reversed(tail.splitlines()):
+                if '"APPLICATION_FINISHED"' not in line:
+                    continue
+                payload = json.loads(line).get("payload", {})
+                frac = (payload.get("metrics") or {}).get(
+                    "tracked_uptime_fraction")
+                if frac is not None:
+                    result = f"{float(frac) * 100:.1f}%"
+                break
         except Exception:
             pass       # one malformed log must not 500 the whole index
         self._uptime_by_path[path] = result
