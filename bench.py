@@ -68,7 +68,7 @@ def main() -> None:
     import tony_tpu.models.transformer as tmod
     naive_cfg = cfg.scaled(dtype=jnp.float32, remat=False)
     orig = tmod._attention
-    tmod._attention = lambda q, k, v, mesh: tmod.reference_attention(
+    tmod._attention = lambda q, k, v, *a: tmod.reference_attention(
         q, k, v, causal=True)
     try:
         t_naive = run(naive_cfg)

@@ -54,6 +54,10 @@ class TransformerConfig:
     # activation-stacking dynamic-update-slices, ~6% faster per step on one
     # chip, slower compile). Any divisor of n_layers is valid.
     scan_unroll: int = 1
+    # Context-parallel strategy when the mesh has a cp axis: "ring"
+    # (ppermute K/V rotation, O(S/cp) memory, any head count) or "ulysses"
+    # (two all-to-alls, full-seq attention on H/cp local heads).
+    cp_strategy: str = "ring"
     # MoE: 0 experts = dense MLP
     num_experts: int = 0
     moe_top_k: int = 2
@@ -173,8 +177,16 @@ def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
         [x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1).astype(x.dtype)
 
 
-def _attention(q, k, v, mesh: Mesh | None):
+def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
+    if cp_strategy not in ("ring", "ulysses"):
+        # Silent fallback would make a typo'd strategy benchmark the wrong
+        # collective pattern.
+        raise ValueError(f"unknown cp_strategy {cp_strategy!r}; "
+                         f"expected 'ring' or 'ulysses'")
     if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
+        if cp_strategy == "ulysses":
+            from tony_tpu.parallel.ulysses import ulysses_attention
+            return ulysses_attention(q, k, v, mesh, causal=True)
         return ring_attention(q, k, v, mesh, causal=True)
     if jax.default_backend() == "tpu":
         return flash_attention(q, k, v, causal=True)
@@ -195,7 +207,7 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules):
     q = constrain(q, ("batch", "seq", "heads", "kv"), mesh, rules)
     k = constrain(k, ("batch", "seq", "heads", "kv"), mesh, rules)
     v = constrain(v, ("batch", "seq", "heads", "kv"), mesh, rules)
-    o = _attention(q, k, v, mesh)
+    o = _attention(q, k, v, mesh, cfg.cp_strategy)
     attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh, rules)
 

@@ -19,6 +19,10 @@ from tony_tpu.parallel.ring_attention import (
     ring_attention,
     ring_attention_local,
 )
+from tony_tpu.parallel.ulysses import (
+    ulysses_attention,
+    ulysses_attention_local,
+)
 from tony_tpu.parallel.sharding import (
     DEFAULT_RULES,
     constrain,
@@ -44,5 +48,7 @@ __all__ = [
     "pipeline_apply",
     "ring_attention",
     "ring_attention_local",
+    "ulysses_attention",
+    "ulysses_attention_local",
     "shard_pytree",
 ]

@@ -105,13 +105,8 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     dp/fsdp, sequence over cp, heads over tp. Axes missing from ``mesh`` (or
     of size 1) are dropped from the specs automatically.
     """
-    live = lambda a: a in mesh.shape and mesh.shape[a] > 1
-    b_spec = tuple(a for a in batch_axes if live(a)) or None
-    if isinstance(b_spec, tuple) and len(b_spec) == 1:
-        b_spec = b_spec[0]
-    s_spec = seq_axis if live(seq_axis) else None
-    h_spec = head_axis if live(head_axis) else None
-    spec = P(b_spec, s_spec, h_spec, None)
+    from tony_tpu.parallel.sharding import attention_spec
+    spec, s_spec = attention_spec(mesh, batch_axes, seq_axis, head_axis)
 
     if s_spec is None:
         # no cp axis: plain (still blockwise/online-softmax) local attention

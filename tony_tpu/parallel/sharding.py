@@ -108,3 +108,20 @@ def param_shardings(logical_tree: Any, mesh: Mesh,
     return jax.tree.map(
         lambda ax: logical_sharding(ax, mesh, rules),
         logical_tree, is_leaf=_is_axes_leaf)
+
+
+def attention_spec(mesh: Mesh, batch_axes, seq_axis: str | None,
+                   head_axis: str | None):
+    """PartitionSpec for [B, S, H, D] attention operands under shard_map:
+    batch over the live subset of ``batch_axes``, sequence over ``seq_axis``,
+    heads over ``head_axis``; axes missing from the mesh (or size 1) are
+    dropped. Returns (spec, seq_axis_live: str | None) — shared by the
+    context-parallel attention wrappers (ring / ulysses)."""
+    from jax.sharding import PartitionSpec as P
+    live = lambda a: a is not None and a in mesh.shape and mesh.shape[a] > 1
+    b_spec = tuple(a for a in batch_axes if live(a)) or None
+    if isinstance(b_spec, tuple) and len(b_spec) == 1:
+        b_spec = b_spec[0]
+    s_spec = seq_axis if live(seq_axis) else None
+    h_spec = head_axis if live(head_axis) else None
+    return P(b_spec, s_spec, h_spec, None), s_spec

@@ -1,0 +1,89 @@
+"""Ulysses sequence parallelism: all-to-all context parallelism over ``cp``.
+
+The second of the two long-context strategies SURVEY.md calls for ("ring
+attention or all-to-all sequence/context parallelism" — the reference has
+neither, §2.3). Where ring attention keeps the sequence sharded and rotates
+K/V around the ring (cp × ppermute hops, O(S/cp) memory), Ulysses
+re-shards: one all-to-all converts sequence-sharded [B, S/c, H, D] into
+head-sharded [B, S, H/c, D], attention runs over the FULL sequence with
+H/c local heads (so the un-sharded flash kernel applies directly), and a
+second all-to-all restores sequence sharding.
+
+Trade-offs vs ring (why both exist):
+- Ulysses: 2 all-to-alls total (bandwidth-optimal on switched/ICI tori for
+  moderate cp), full-sequence attention per device → head-count must be
+  divisible by cp, memory O(S) per device for the attention inputs.
+- Ring: cp neighbor hops, O(S/cp) memory, no head-divisibility constraint —
+  the choice for extreme sequence lengths.
+
+Same call shape as :func:`tony_tpu.parallel.ring_attention.ring_attention`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+from jax import lax
+from jax.sharding import Mesh
+
+from tony_tpu.parallel.ring_attention import _single_chunk
+
+
+def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
+                            causal: bool = True,
+                            scale: float | None = None):
+    """Per-shard Ulysses body — call inside ``shard_map`` with the sequence
+    dim sharded over ``axis_name``.
+
+    q, k, v: [B, S_local, H, D]; H must be divisible by the axis size.
+    Returns [B, S_local, H, D].
+    """
+    b, s_loc, h, d = q.shape
+    cp = lax.axis_size(axis_name)
+    if h % cp:
+        raise ValueError(f"n_heads={h} not divisible by {axis_name}={cp}")
+    if cp == 1:
+        return _single_chunk(q, k, v, causal=causal, scale=scale)
+
+    def seq_to_heads(x):
+        # [B, S/c, H, D] → [B, S, H/c, D]: split heads across the axis,
+        # gather the full sequence.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if jax.default_backend() == "tpu":
+        # full sequence is local after the all-to-all → the blockwise
+        # pallas kernel applies directly (O(block) memory, not O(S^2))
+        from tony_tpu.ops.attention import flash_attention
+        o = flash_attention(qh, kh, vh, causal=causal, scale=scale)
+    else:
+        o = _single_chunk(qh, kh, vh, causal=causal, scale=scale)
+    return heads_to_seq(o)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
+                      scale: float | None = None,
+                      batch_axes: Sequence[str] = ("dp", "fsdp"),
+                      seq_axis: str = "cp", head_axis: str = "tp"):
+    """Sequence-parallel attention over global [B, S, H, D] arrays — the
+    all-to-all counterpart of :func:`ring_attention` (same call shape).
+    Batch over dp/fsdp, sequence over cp, heads over tp; axes missing from
+    ``mesh`` (or size 1) are dropped. With tp live, each tp shard runs
+    Ulysses over its own head subset (local heads must still divide cp)."""
+    from tony_tpu.parallel.sharding import attention_spec
+    spec, s_spec = attention_spec(mesh, batch_axes, seq_axis, head_axis)
+
+    if s_spec is None:
+        fn = functools.partial(_single_chunk, causal=causal, scale=scale)
+    else:
+        fn = functools.partial(ulysses_attention_local, axis_name=seq_axis,
+                               causal=causal, scale=scale)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
