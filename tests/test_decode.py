@@ -18,11 +18,11 @@ def params():
     return T.init_params(jax.random.PRNGKey(0), CFG)
 
 
-def full_forward_greedy(params, prompt, steps):
+def full_forward_greedy(params, prompt, steps, cfg=CFG):
     """Reference decode: re-run the full forward for every token."""
     tokens = prompt
     for _ in range(steps):
-        logits, _ = T.forward(params, tokens, CFG)
+        logits, _ = T.forward(params, tokens, cfg)
         nxt = jnp.argmax(logits[:, -1], axis=-1)
         tokens = jnp.concatenate([tokens, nxt[:, None]], axis=1)
     return tokens
@@ -88,7 +88,16 @@ class TestDecode:
                                     CFG.head_dim)
         assert cache["k"].dtype == CFG.dtype
 
-    def test_moe_config_rejected(self, params):
-        moe_cfg = CFG.scaled(num_experts=4)
-        with pytest.raises(NotImplementedError):
-            prefill(params, jnp.zeros((1, 4), jnp.int32), moe_cfg, 8)
+    def test_moe_greedy_generate_matches_full_forward(self):
+        """MoE decode: cached generation equals the full-forward loop (high
+        capacity factor so routing drops cannot differ between the S=1
+        decode dispatch and the growing-S full forward)."""
+        moe_cfg = CFG.scaled(num_experts=2, moe_capacity_factor=4.0)
+        moe_params = T.init_params(jax.random.PRNGKey(5), moe_cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 4), 0,
+                                    moe_cfg.vocab_size)
+        out = generate(moe_params, prompt, moe_cfg, max_new_tokens=4,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        expected = full_forward_greedy(moe_params, prompt, 4, cfg=moe_cfg)
+        np.testing.assert_array_equal(np.asarray(out.tokens),
+                                      np.asarray(expected))

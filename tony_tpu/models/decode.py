@@ -37,6 +37,7 @@ import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
 from tony_tpu.ops.norms import rms_norm_reference
+from tony_tpu.parallel.moe import moe_ffn
 
 
 class GenerateOutput(NamedTuple):
@@ -90,18 +91,35 @@ def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg):
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
+    mlp_out = _mlp(h, p, cfg)
+    return x + mlp_out, k_cache, v_cache
+
+
+def _mlp(h, p, cfg):
+    """Dense SwiGLU or MoE feed-forward on [B, S, D] (same params as the
+    training block, transformer._block).
+
+    MoE caveat: routing capacity scales with the LOCAL sequence length, so
+    single-position decode (S=1, capacity >= top_k) never drops tokens while
+    a full forward at low ``moe_capacity_factor`` may — cached generation
+    can then diverge from the training forward on overflow tokens. This is
+    the standard gshard trade; raise the capacity factor if you need exact
+    equivalence."""
+    if "router" in p:
+        out, _ = moe_ffn(h, p["router"], p["w_gate"], p["w_down"],
+                         top_k=cfg.moe_top_k,
+                         capacity_factor=cfg.moe_capacity_factor,
+                         activation=jax.nn.silu)
+        return out
     gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
     up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
-    mlp_out = jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
-    return x + mlp_out, k_cache, v_cache
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict, pos,
                 cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
     """One decode step. token: [B] int32; returns (logits [B, V] f32,
     updated cache). ``pos`` is the position being written (traced ok)."""
-    if cfg.num_experts:
-        raise NotImplementedError("cached decode supports dense MLP only")
     x = params["embed"][token][:, None, :].astype(cfg.dtype)   # [B, 1, D]
 
     def body(carry, inputs):
@@ -124,8 +142,6 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
             max_len: int) -> tuple[jax.Array, dict]:
     """Process the whole prompt in one forward, filling the cache.
     tokens: [B, S]; returns (last-position logits [B, V], cache)."""
-    if cfg.num_experts:
-        raise NotImplementedError("cached decode supports dense MLP only")
     b, s = tokens.shape
     cache = init_kv_cache(cfg, b, max_len)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -141,10 +157,7 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         o = T._attention(q, k, v, None)
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
-        gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
-        up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
-        x = x + jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up,
-                           p["w_down"])
+        x = x + _mlp(h, p, cfg)
         k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
         v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
         return x, (k_cache, v_cache)
