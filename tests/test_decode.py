@@ -101,3 +101,19 @@ class TestDecode:
         expected = full_forward_greedy(moe_params, prompt, 4, cfg=moe_cfg)
         np.testing.assert_array_equal(np.asarray(out.tokens),
                                       np.asarray(expected))
+
+    def test_tp_sharded_decode_matches_unsharded(self, params):
+        """Tensor-parallel serving: params sharded over tp (heads/mlp dims)
+        decode token-identically via XLA sharding propagation."""
+        from tony_tpu.parallel import make_mesh, shard_pytree
+        prompt = jax.random.randint(jax.random.PRNGKey(9), (2, 8), 0,
+                                    CFG.vocab_size)
+        ref = generate(params, prompt, CFG, max_new_tokens=6,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        mesh = make_mesh({"tp": 4, "dp": 2})
+        sharded = shard_pytree(params, T.logical_axes(CFG), mesh)
+        with jax.set_mesh(mesh):
+            out = generate(sharded, prompt, CFG, max_new_tokens=6,
+                           rng=jax.random.PRNGKey(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(out.tokens))
