@@ -2,7 +2,33 @@
 
 Brand-new rebuild of the capability set of LinkedIn TonY (reference mounted at
 /root/reference) for Cloud TPU pod slices and the JAX/XLA runtime. See
-SURVEY.md for the blueprint.
+SURVEY.md for the blueprint and docs/ for user documentation.
+
+Common entry points (lazily imported so ``import tony_tpu`` stays cheap and
+jax-free for pure-orchestration uses)::
+
+    tony_tpu.runtime              # task-side bootstrap: initialize(), mesh()
+    tony_tpu.TonyClient           # programmatic job submission
+    tony_tpu.TonyConfig           # the tony.* config system
+    tony_tpu.CheckpointManager    # orbax checkpoint/resume helper
 """
 
 __version__ = "0.1.0"
+
+_LAZY = {
+    "TonyClient": ("tony_tpu.client.client", "TonyClient"),
+    "TonyConfig": ("tony_tpu.conf.config", "TonyConfig"),
+    "CheckpointManager": ("tony_tpu.models.checkpoint", "CheckpointManager"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module 'tony_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))
