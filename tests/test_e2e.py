@@ -620,3 +620,66 @@ runpy.run_path(r"{script}", run_name="__main__")
         out = open(os.path.join(client.job_dir, "logs",
                                 "worker-0.stdout")).read()
         assert "done:" in out
+
+    def test_tony_kill_terminates_running_job(self, tmp_path):
+        """`tony kill <job_dir>`: an out-of-band finishApplication while
+        tasks run reduces the job to KILLED and tears everything down."""
+        import threading
+        from tony_tpu.client import cli
+
+        client = make_client(tmp_path, fixture_cmd("sleep_forever.py"),
+                             {"tony.worker.instances": "2",
+                              "tony.application.security.enabled": "true"})
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(code=client.run()))
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while client._read_coordinator_addr() is None:
+                assert time.monotonic() < deadline, "coordinator never up"
+                time.sleep(0.2)
+            # wait for the secret file (written at stage()) and kill
+            assert cli.main(["kill", client.job_dir]) == 0
+        finally:
+            t.join(timeout=60)
+        assert result.get("code") == 1
+        final = client._read_final_status()
+        assert final and final["status"] == "KILLED"
+
+    def test_tony_kill_no_coordinator_errors(self, tmp_path):
+        from tony_tpu.client import cli
+        assert cli.main(["kill", str(tmp_path)]) == 1
+
+    def test_tony_kill_stops_single_node_job(self, tmp_path):
+        """Kill must also interrupt single-node/notebook jobs, which never
+        reach the monitor loop (they block in the preprocess wait)."""
+        import threading
+        from tony_tpu.client import cli
+
+        client = make_client(tmp_path, "sleep 300",
+                             {"tony.application.single-node": "true"})
+        result = {}
+        t = threading.Thread(
+            target=lambda: result.update(code=client.run()))
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while client._read_coordinator_addr() is None:
+                assert time.monotonic() < deadline
+                time.sleep(0.2)
+            time.sleep(0.5)   # let the preprocess proc start
+            assert cli.main(["kill", client.job_dir]) == 0
+        finally:
+            t.join(timeout=60)
+        assert result.get("code") == 1
+        final = client._read_final_status()
+        assert final and final["status"] == "KILLED"
+
+    def test_tony_kill_finished_job_reports_final(self, tmp_path):
+        from tony_tpu.client import cli
+        client = make_client(tmp_path, fixture_cmd("exit_0.py"),
+                             {"tony.worker.instances": "1"})
+        assert client.run() == 0
+        # coordinator.addr remains, but the job is final: no-op success.
+        assert cli.main(["kill", client.job_dir]) == 0

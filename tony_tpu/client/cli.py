@@ -32,6 +32,9 @@ def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="tony", description="TPU-native distributed ML job orchestrator")
     sub = parser.add_subparsers(dest="command", required=True)
+    k = sub.add_parser("kill", help="kill a running job by its job dir")
+    k.add_argument("job_dir", help="the job's staging dir "
+                                   "(<tony.staging.dir>/<app_id>)")
     for name, help_text in (
             ("submit", "submit a job (ClusterSubmitter analog)"),
             ("local", "submit forcing the local subprocess backend"),
@@ -59,6 +62,8 @@ def main(argv: list[str] | None = None) -> int:
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s: %(message)s")
     args = build_parser().parse_args(argv)
+    if args.command == "kill":
+        return kill_job(args.job_dir)
     overrides = parse_cli_confs(args.conf)
     conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
     if args.python_venv:
@@ -92,6 +97,50 @@ def main(argv: list[str] | None = None) -> int:
     client = TonyClient(conf, command, src_dir=src_dir,
                         shell_env=shell_env, on_tracking_url=on_tracking_url)
     return client.run()
+
+
+def kill_job(job_dir: str) -> int:
+    """Signal a running job's coordinator to tear down (the out-of-band
+    kill the reference lacked — its only kills were client timeout/Ctrl-C).
+    Reads the coordinator address (and per-job secret, if security is on)
+    from the job dir and calls finishApplication; a finish with tasks still
+    running reduces to final status KILLED."""
+    import json
+    from tony_tpu.cluster.coordinator import (COORDINATOR_ADDR_FILE,
+                                              FINAL_STATUS_FILE)
+    from tony_tpu.rpc.client import ApplicationRpcClient
+
+    final_path = os.path.join(job_dir, FINAL_STATUS_FILE)
+    if os.path.exists(final_path):
+        # coordinator.addr outlives the job; the final status is what
+        # distinguishes "already finished" from "unreachable".
+        with open(final_path, encoding="utf-8") as f:
+            status = json.load(f).get("status", "?")
+        print(f"job already finished with status {status}; nothing to kill")
+        return 0
+    addr_path = os.path.join(job_dir, COORDINATOR_ADDR_FILE)
+    if not os.path.exists(addr_path):
+        print(f"no running coordinator found under {job_dir}",
+              file=sys.stderr)
+        return 1
+    with open(addr_path, encoding="utf-8") as f:
+        addr = f.read().strip()
+    secret = None
+    secret_path = os.path.join(job_dir, constants.TONY_SECRET_FILE)
+    if os.path.exists(secret_path):
+        with open(secret_path, encoding="utf-8") as f:
+            secret = f.read().strip()
+    rpc = ApplicationRpcClient(addr, secret=secret, max_retries=3)
+    try:
+        rpc.finish_application()
+    except Exception as e:
+        print(f"kill failed: coordinator at {addr} unreachable ({e})",
+              file=sys.stderr)
+        return 1
+    finally:
+        rpc.close()
+    print(f"kill signalled to coordinator at {addr}")
+    return 0
 
 
 _notebook_proxy = None

@@ -255,7 +255,11 @@ class TonyClient:
                 self.rpc = ApplicationRpcClient(addr, secret=self.secret)
         if self.rpc:
             try:
-                self.rpc.finish_application()
+                # Best-effort: the coordinator may already be gone (e.g.
+                # after an out-of-band `tony kill` it exits on its own) —
+                # a long UNAVAILABLE retry loop here would stall the client
+                # for minutes after the job is already final.
+                self.rpc.finish_application(retries=2)
             except Exception:
                 pass
         if self.am_proc:

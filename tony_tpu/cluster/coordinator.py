@@ -363,7 +363,14 @@ class Coordinator:
                 self.session.status = SessionStatus.FAILED
                 return SessionStatus.FAILED
             if self.client_signalled_finish.is_set():
-                return self.session.update_session_status()
+                status = self.session.update_session_status()
+                if status is SessionStatus.RUNNING:
+                    # finish while tasks still run = an explicit client
+                    # kill (the `tony kill` path), not a success.
+                    self.failure_message = "killed by client"
+                    self.session.status = SessionStatus.KILLED
+                    return SessionStatus.KILLED
+                return status
             if self.task_missed_hb.is_set():
                 return SessionStatus.FAILED
             if self.session.training_finished():
@@ -406,14 +413,33 @@ class Coordinator:
             # Tracked so coordinator kill paths (client timeout, Ctrl-C,
             # stop()) reap it — it is in no backend kill list.
             self._preprocess_proc = proc
+            deadline = (time.monotonic() + timeout_s) if timeout_s > 0 \
+                else None
             try:
-                exit_code = proc.wait(
-                    timeout=timeout_s if timeout_s > 0 else None)
-            except sp.TimeoutExpired:
-                log.error("preprocess exceeded %.0fs — killing", timeout_s)
-                self._kill_preprocess()
-                proc.wait()
-                exit_code = 1
+                # Short-interval wait loop instead of one blocking wait:
+                # an out-of-band `tony kill` (finishApplication) must be
+                # able to interrupt single-node/notebook jobs, which never
+                # reach the monitor loop.
+                while True:
+                    try:
+                        exit_code = proc.wait(timeout=0.2)
+                        break
+                    except sp.TimeoutExpired:
+                        if self.client_signalled_finish.is_set():
+                            log.warning("client kill — stopping %s job",
+                                        "single-node" if single_node
+                                        else "preprocess")
+                            self._kill_preprocess()
+                            proc.wait()
+                            exit_code = 143
+                            break
+                        if deadline and time.monotonic() > deadline:
+                            log.error("preprocess exceeded %.0fs — killing",
+                                      timeout_s)
+                            self._kill_preprocess()
+                            proc.wait()
+                            exit_code = 1
+                            break
             finally:
                 self._preprocess_proc = None
         log.info("preprocess/single-node job exited with %d", exit_code)
@@ -479,6 +505,9 @@ class Coordinator:
         if single_node or self.conf.get_bool(K.APPLICATION_PREPROCESS_KEY,
                                              False):
             exit_code = self.run_preprocess(user_command, single_node)
+            if self.client_signalled_finish.is_set() and exit_code != 0:
+                self.failure_message = "killed by client"
+                return self.stop(SessionStatus.KILLED)
             if single_node:
                 if exit_code != 0:
                     self.failure_message = (

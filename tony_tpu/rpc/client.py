@@ -159,8 +159,9 @@ class ApplicationRpcClient(ApplicationRpc):
                           idempotent=False)
         return resp.message
 
-    def finish_application(self) -> str:
-        resp = self._call(self._finish, pb.FinishApplicationRequest())
+    def finish_application(self, retries: int | None = None) -> str:
+        resp = self._call(self._finish, pb.FinishApplicationRequest(),
+                          retries=retries)
         return resp.message
 
     def task_executor_heartbeat(self, task_id: str) -> None:
