@@ -17,6 +17,7 @@ Usage:
         --conf tony.worker.instances=4 \
         --conf tony.application.mesh=dp=-1 \
         --conf tony.am.retry-count=2 \
+        --src_dir examples \
         --executes 'python examples/lm/train_lm.py --steps 200 \
                     --ckpt_dir /tmp/lm-ckpt --preset small'
 """

@@ -12,6 +12,7 @@ Usage:
     python -m tony_tpu.client.cli submit \
         --conf tony.application.framework=pytorch \
         --conf tony.worker.instances=2 \
+        --src_dir examples \
         --executes 'python examples/mnist-pytorch/mnist_distributed.py'
 """
 

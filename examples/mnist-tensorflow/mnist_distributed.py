@@ -16,6 +16,7 @@ Usage:
     python -m tony_tpu.client.cli submit \
         --conf tony.application.framework=tensorflow \
         --conf tony.worker.instances=2 \
+        --src_dir examples \
         --executes 'python examples/mnist-tensorflow/mnist_distributed.py'
 """
 

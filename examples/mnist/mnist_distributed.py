@@ -18,6 +18,7 @@ pass --data_dir with the real IDX files to train on true MNIST.
 Usage (via the framework):
     python -m tony_tpu.client.cli submit \
         --conf tony.worker.instances=2 --conf tony.application.mesh=dp=-1 \
+        --src_dir examples \
         --executes 'python examples/mnist/mnist_distributed.py --steps 100'
 """
 

@@ -11,6 +11,7 @@ Usage:
     python -m tony_tpu.client.cli submit \
         --conf tony.worker.instances=8 \
         --conf tony.application.mesh=dp=-1 \
+        --src_dir examples \
         --executes 'python examples/resnet/train_resnet.py --steps 100'
 """
 
