@@ -117,3 +117,52 @@ class TestDecode:
                            rng=jax.random.PRNGKey(0), temperature=0.0)
         np.testing.assert_array_equal(np.asarray(ref.tokens),
                                       np.asarray(out.tokens))
+
+    def test_extend_step_matches_sequential_decode(self, params):
+        """A K-token chunked extend equals K sequential single steps."""
+        prompt = jax.random.randint(jax.random.PRNGKey(10), (2, 5), 0,
+                                    CFG.vocab_size)
+        chunk = jax.random.randint(jax.random.PRNGKey(11), (2, 3), 0,
+                                   CFG.vocab_size)
+        from tony_tpu.models.decode import extend_step
+        _, cache_a = prefill(params, prompt, CFG, max_len=12)
+        logits_chunk, cache_a = extend_step(params, chunk, cache_a,
+                                            cache_a["length"], CFG)
+        _, cache_b = prefill(params, prompt, CFG, max_len=12)
+        for i in range(3):
+            logits_i, cache_b = decode_step(params, chunk[:, i], cache_b,
+                                            cache_b["length"], CFG)
+            np.testing.assert_allclose(np.asarray(logits_chunk[:, i]),
+                                       np.asarray(logits_i),
+                                       rtol=2e-4, atol=2e-4)
+        assert int(cache_a["length"]) == int(cache_b["length"]) == 8
+
+    @pytest.mark.parametrize("num_spec", [1, 3, 6])
+    def test_speculative_equals_greedy(self, params, num_spec):
+        """Speculative decoding with ANY draft model reproduces the target's
+        greedy output exactly — here the draft IS the target (worst and best
+        case acceptance paths both exercised across num_spec values)."""
+        from tony_tpu.models.decode import speculative_generate
+        prompt = jax.random.randint(jax.random.PRNGKey(12), (1, 5), 0,
+                                    CFG.vocab_size)
+        want = generate(params, prompt, CFG, max_new_tokens=9,
+                        rng=jax.random.PRNGKey(0), temperature=0.0)
+        got = speculative_generate(params, params, prompt, CFG, CFG,
+                                   max_new_tokens=9,
+                                   num_speculative=num_spec)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))
+
+    def test_speculative_with_distinct_draft(self, params):
+        """A DIFFERENT (random) draft still yields the target's exact greedy
+        output — only the speed, not the result, depends on the draft."""
+        from tony_tpu.models.decode import speculative_generate
+        draft_params = T.init_params(jax.random.PRNGKey(99), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(13), (1, 4), 0,
+                                    CFG.vocab_size)
+        want = generate(params, prompt, CFG, max_new_tokens=7,
+                        rng=jax.random.PRNGKey(0), temperature=0.0)
+        got = speculative_generate(params, draft_params, prompt, CFG, CFG,
+                                   max_new_tokens=7, num_speculative=3)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))

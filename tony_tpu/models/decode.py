@@ -57,18 +57,21 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
             "length": jnp.zeros((), jnp.int32)}
 
 
-def _cached_attention(q, k_cache, v_cache, length):
-    """q: [B, 1, H, hd]; caches: [B, max_len, H, hd]; attend over the first
-    ``length`` cached positions (everything else masked). Operands stay in
-    the cache dtype (bf16 on TPU) with f32 accumulation — casting the whole
+def _cached_attention(q, k_cache, v_cache, q_start):
+    """q: [B, K, H, hd] holding positions q_start..q_start+K-1; caches:
+    [B, max_len, H, hd]. Query i attends cache positions <= q_start+i
+    (causal within the chunk, full history before it). Operands stay in the
+    cache dtype (bf16 on TPU) with f32 accumulation — casting the whole
     cache to f32 would double the hot loop's HBM traffic and halve MXU
     throughput."""
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
                         preferred_element_type=jnp.float32) * scale
     max_len = k_cache.shape[1]
-    mask = jnp.arange(max_len)[None, None, None, :] < length   # [1,1,1,K]
-    scores = jnp.where(mask, scores, -jnp.inf)
+    n_q = q.shape[1]
+    q_pos = q_start + jnp.arange(n_q)[None, None, :, None]     # [1,1,Q,1]
+    k_pos = jnp.arange(max_len)[None, None, None, :]           # [1,1,1,K]
+    scores = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)                    # f32
     return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
                       v_cache,
@@ -76,21 +79,22 @@ def _cached_attention(q, k_cache, v_cache, length):
 
 
 def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg):
-    """Single-position decoder block. x: [B, 1, D]; caches [B, max_len, H,
-    hd] already containing this layer's past; returns (x, new_k, new_v)."""
+    """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1; caches
+    [B, max_len, H, hd] already containing this layer's past; returns
+    (x, new_k, new_v)."""
     p = layer_params
-    b = x.shape[0]
-    positions = jnp.full((b, 1), pos)
+    b, n_q, _ = x.shape
+    positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
 
     h = rms_norm_reference(x, p["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
     q, k = T._rope(q, positions), T._rope(k, positions)
-    # write this position into the cache
+    # write this chunk into the cache
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    o = _cached_attention(q, k_cache, v_cache, pos + 1)
+    o = _cached_attention(q, k_cache, v_cache, pos)
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
@@ -119,11 +123,14 @@ def _mlp(h, p, cfg):
     return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
-def decode_step(params: dict, token: jax.Array, cache: dict, pos,
+def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
                 cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
-    """One decode step. token: [B] int32; returns (logits [B, V] f32,
-    updated cache). ``pos`` is the position being written (traced ok)."""
-    x = params["embed"][token][:, None, :].astype(cfg.dtype)   # [B, 1, D]
+    """Extend the cache with a K-token chunk at positions pos..pos+K-1.
+    tokens: [B, K] int32; returns (logits [B, K, V] f32 — logits[:, i] is
+    the next-token distribution AFTER tokens[:, :i+1] — and the updated
+    cache). The chunked verify primitive for speculative decoding; K=1 is
+    the plain decode step."""
+    x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
 
     def body(carry, inputs):
         x = carry
@@ -136,9 +143,18 @@ def decode_step(params: dict, token: jax.Array, cache: dict, pos,
         body, x, (params["blocks"], cache["k"], cache["v"]))
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)[:, 0]
-    new_cache = {"k": new_k, "v": new_v, "length": pos + 1}
+                        preferred_element_type=jnp.float32)
+    new_cache = {"k": new_k, "v": new_v,
+                 "length": pos + tokens.shape[1]}
     return logits, new_cache
+
+
+def decode_step(params: dict, token: jax.Array, cache: dict, pos,
+                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+    """One decode step. token: [B] int32; returns (logits [B, V] f32,
+    updated cache). ``pos`` is the position being written (traced ok)."""
+    logits, new_cache = extend_step(params, token[:, None], cache, pos, cfg)
+    return logits[:, 0], new_cache
 
 
 def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
@@ -191,6 +207,94 @@ def _sample(logits, rng, temperature: float, top_k: int):
         token = jax.random.categorical(rng, logits / temperature, axis=-1)
     return token, jnp.take_along_axis(model_logp, token[:, None],
                                       axis=-1)[:, 0]
+
+
+def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
+                         cfg: T.TransformerConfig,
+                         draft_cfg: T.TransformerConfig,
+                         max_new_tokens: int,
+                         num_speculative: int = 4) -> jax.Array:
+    """Greedy speculative decoding: a cheap draft model proposes
+    ``num_speculative`` tokens per round; the target model verifies the
+    whole chunk in ONE chunked :func:`extend_step` and commits the longest
+    prefix matching its own argmax chain plus one corrected token — output
+    is token-identical to the target model's greedy :func:`generate`, in
+    (accepted+1) tokens per target call instead of 1.
+
+    Batch size must be 1: acceptance length is data-dependent per row and
+    the cache keeps a single scalar length. Returns tokens
+    [1, prompt_len + max_new_tokens].
+
+    Caveats (measured, not theoretical):
+    - Exactness holds when chunked and single-step logits agree — always in
+      f32 (verified on TPU). In bf16 the chunk-vs-step accumulation order
+      can flip argmax on near-ties, so occasional tokens may differ from
+      plain greedy (both are valid greedy decodes of the model).
+    - This is a host-driven reference implementation: each round syncs with
+      the device for the acceptance decision, so wall-clock wins require
+      low host-device latency (it is NOT faster over remote/tunneled
+      device transports, where every sync costs a network round trip).
+    """
+    b, s = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate supports batch size 1")
+    if num_speculative < 1:
+        raise ValueError("num_speculative must be >= 1 (use generate() for "
+                         "plain greedy decoding)")
+    k = num_speculative
+    max_len = s + max_new_tokens + k + 1
+    t_logits, t_cache = prefill(params, prompt, cfg, max_len)
+    _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
+
+    extend_t = jax.jit(extend_step, static_argnames=("cfg",))
+    step_d = jax.jit(decode_step, static_argnames=("cfg",))
+
+    out: list[int] = []
+    # pending = committed token whose K/V is not yet in the target cache
+    pending = int(jnp.argmax(t_logits, axis=-1)[0])
+    pos = s
+    while len(out) < max_new_tokens:
+        # draft proposes k tokens following `pending` from its own cache
+        drafts = []
+        tok = jnp.array([pending])
+        d_pos = pos
+        for _ in range(k):
+            d_logits, d_cache = step_d(draft_params, tok, d_cache, d_pos,
+                                       draft_cfg)
+            tok = jnp.argmax(d_logits, axis=-1)
+            drafts.append(int(tok[0]))
+            d_pos += 1
+        # target verifies [pending, d1..dk] in one chunk
+        chunk = jnp.array([[pending] + drafts])
+        logits, t_cache = extend_t(params, chunk, t_cache, pos, cfg)
+        argmaxes = jnp.argmax(logits[0], axis=-1)      # [k+1]
+        out.append(pending)
+        accepted = 0
+        for i in range(k):
+            if len(out) >= max_new_tokens:
+                break
+            if drafts[i] != int(argmaxes[i]):
+                break
+            out.append(drafts[i])
+            accepted += 1
+        new_pending = int(argmaxes[accepted])
+        if accepted == k:
+            # full acceptance: the draft cache never wrote d_k's K/V (it
+            # produced d_k as output only); backfill so the next round's
+            # history is complete.
+            _, d_cache = step_d(draft_params, jnp.array([drafts[-1]]),
+                                d_cache, pos + k, draft_cfg)
+        # Roll both caches back to the committed frontier. Stale entries
+        # from rejected drafts are NOT masked (attention reads positions
+        # <= each query's own position, not the length field) — they are
+        # safe only because the next round's k+1-wide chunk rewrites the
+        # whole stale region (<= k entries) before any query can reach it.
+        pos += 1 + accepted
+        t_cache = dict(t_cache, length=jnp.asarray(pos, jnp.int32))
+        d_cache = dict(d_cache, length=jnp.asarray(pos, jnp.int32))
+        pending = new_pending
+    tokens = jnp.array([out[:max_new_tokens]], dtype=prompt.dtype)
+    return jnp.concatenate([prompt, tokens], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
