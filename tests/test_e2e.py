@@ -334,6 +334,53 @@ class TestE2E:
         assert client.run() == 0
         assert fetched.get("body") == b"notebook-ok"
 
+    def test_notebook_cli_end_to_end(self, tmp_path):
+        """Drive the REAL `tony notebook` CLI path: single-node mode means
+        no executors ever run, so the coordinator itself must export
+        $NOTEBOOK_PORT (pointing where the tracking URL / proxy points).
+        Regression: only the executor set NOTEBOOK_PORT, so CLI notebooks
+        got an empty port while the proxy pointed at tb_port."""
+        import threading
+        import urllib.request
+        from tony_tpu.client import cli
+
+        result = {}
+
+        def run():
+            result["code"] = cli.main([
+                "notebook",
+                "--executes", fixture_cmd("notebook_server.py"),
+                "--conf", f"tony.staging.dir={tmp_path / 'staging'}",
+                "--conf", f"tony.history.location={tmp_path / 'hist'}",
+                "--conf", "tony.application.timeout=60000",
+            ])
+
+        t = threading.Thread(target=run)
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            body = None
+            while time.monotonic() < deadline and t.is_alive():
+                proxy = cli._notebook_proxy
+                if proxy is None:
+                    time.sleep(0.2)
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            f"http://localhost:{proxy.local_port}/",
+                            timeout=5) as resp:
+                        body = resp.read()
+                    break
+                except OSError:
+                    time.sleep(0.3)
+            assert body == b"notebook-ok"
+        finally:
+            t.join(timeout=60)
+            if cli._notebook_proxy is not None:
+                cli._notebook_proxy.stop()
+                cli._notebook_proxy = None
+        assert result.get("code") == 0
+
     def test_distributed_pytorch_example_trains(self, tmp_path):
         """PyTorch runtime-adapter parity: 2 workers build a gloo process
         group from the exported RANK/WORLD/INIT_METHOD and train with manual
@@ -683,3 +730,22 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert client.run() == 0
         # coordinator.addr remains, but the job is final: no-op success.
         assert cli.main(["kill", client.job_dir]) == 0
+
+
+def test_zip_entry_escaping_to_prefix_sibling_rejected(tmp_path):
+    """A zip entry resolving to a SIBLING dir that shares the dest's path
+    prefix ('<dest>x/evil') must be rejected — a plain startswith() prefix
+    check passes it."""
+    import zipfile
+    from tony_tpu.cluster.executor import TaskExecutor
+
+    dest = tmp_path / "venv"
+    dest.mkdir()
+    sibling = tmp_path / "venvx"       # shares the '<dest>' string prefix
+    sibling.mkdir()
+    evil = tmp_path / "evil.zip"
+    with zipfile.ZipFile(evil, "w") as zf:
+        zf.writestr("../venvx/pwned", "boom")
+    with pytest.raises(ValueError, match="escapes"):
+        TaskExecutor._extract_zip_with_symlinks(str(evil), str(dest))
+    assert not (sibling / "pwned").exists()

@@ -164,6 +164,23 @@ def test_uptime_metrics_unregistered_task_is_zero():
     assert m["tracked_uptime_fraction"] == 0.0
 
 
+def test_uptime_fraction_omitted_when_no_tracked_tasks():
+    """Single-node/notebook sessions schedule no tracked tasks; emitting
+    a 0.0 fraction would render as a misleading '0.0%' uptime for a
+    succeeded job — the metric must be absent instead."""
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.cluster.session import Session
+
+    s = Session(TonyConfig({}))          # no job types at all
+    m = s.uptime_metrics()
+    assert "tracked_uptime_fraction" not in m
+    assert m["task_uptime_s"] == {}
+
+    # only-untracked job types behave the same
+    s2 = Session(TonyConfig({"tony.ps.instances": "1"}))
+    assert "tracked_uptime_fraction" not in s2.uptime_metrics()
+
+
 def test_uptime_fraction_counts_never_registered_tracked_tasks():
     """A gang stuck at the barrier because one worker never came up is NOT
     100% uptime — the missing task zeroes into the denominator."""

@@ -388,12 +388,29 @@ class Coordinator:
         from tony_tpu.cluster.executor import reserve_port
         env = dict(os.environ)
         env[constants.PREPROCESSING_JOB] = "true"
-        # Services like jupyter want a writable $HOME (reference :718-722).
-        env["HOME"] = self.job_dir
         if single_node:
+            # Services like jupyter want a writable $HOME (reference
+            # :718-722). Scoped to single-node: plain preprocess commands
+            # keep the submitting user's real $HOME (gcloud/ssh creds,
+            # pip caches).
+            env["HOME"] = self.job_dir
+            # Two DISTINCT ports, matching executor-mode semantics
+            # (executor.py reserves tb_port and notebook_port separately) —
+            # a command binding both $TB_PORT and $NOTEBOOK_PORT must not
+            # collide. Single-node jobs never launch executors, so the
+            # coordinator itself must export NOTEBOOK_PORT or the
+            # documented `jupyter lab --port=$NOTEBOOK_PORT` gets nothing.
             tb_port = reserve_port()
+            nb_port = reserve_port()
             env[constants.TB_PORT] = str(tb_port)
-            self.tensorboard_url = f"http://{socket.gethostname()}:{tb_port}"
+            env[constants.NOTEBOOK_PORT] = str(nb_port)
+            # Notebook jobs proxy to the notebook endpoint (reference:
+            # NotebookSubmitter.java:93-106); otherwise track TensorBoard.
+            is_notebook = self.conf.get_int(
+                K.instances_key(constants.NOTEBOOK_JOB_NAME), 0) > 0
+            tracked_port = nb_port if is_notebook else tb_port
+            self.tensorboard_url = (
+                f"http://{socket.gethostname()}:{tracked_port}")
             log.info("single-node tracking URL: %s", self.tensorboard_url)
         log.info("running %s job in coordinator: %s",
                  "single-node" if single_node else "preprocess", user_command)
@@ -401,7 +418,8 @@ class Coordinator:
         # the preprocess step must see the image's deps, not the bare host.
         command = docker_wrap(
             user_command, self.conf, self.job_dir,
-            env_keys=(constants.PREPROCESSING_JOB, constants.TB_PORT, "HOME"),
+            env_keys=(constants.PREPROCESSING_JOB, constants.TB_PORT,
+                      constants.NOTEBOOK_PORT, "HOME"),
             task_id="am-preprocess", app_id=self.app_id)
         logs = os.path.join(self.log_dir, "am-preprocess")
         timeout_s = self.conf.get_int(K.TASK_EXECUTION_TIMEOUT_KEY, 0) / 1000.0
@@ -588,7 +606,20 @@ class Coordinator:
         window-weighted mean over sessions, so time lost to preempted or
         failed attempts stays visible in the final number."""
         final = self.session.uptime_metrics()
-        sessions = self._session_metrics + [final]
+        all_sessions = self._session_metrics + [final]
+        # Single-node jobs run in the coordinator and never launch
+        # executors, so their task entries (e.g. notebook:0) can never
+        # register — a 0.0 fraction is an artifact, not an uptime signal.
+        # Stripped from EVERY attempt, or a retried single-node job would
+        # resurrect the artifact from a prior session's metrics.
+        if self.conf.get_bool(K.APPLICATION_SINGLE_NODE_KEY, False):
+            for m in all_sessions:
+                m.pop("tracked_uptime_fraction", None)
+        # Sessions without the fraction (no tracked tasks scheduled, e.g.
+        # single-node/notebook) carry no uptime signal — excluded rather
+        # than counted as zero.
+        sessions = [m for m in all_sessions
+                    if "tracked_uptime_fraction" in m]
         # An attempt whose gang never registered has window 0 but still
         # burned wall time — floor its weight at the session wall so lost
         # attempts cannot vanish from the combined fraction.
@@ -599,7 +630,7 @@ class Coordinator:
             final["tracked_uptime_fraction"] = round(
                 sum(m["tracked_uptime_fraction"] * w
                     for m, w in zip(sessions, weights)) / total_w, 4)
-        final["attempts"] = len(sessions)
+        final["attempts"] = len(all_sessions)
         return final
 
     def stop(self, status: SessionStatus) -> int:

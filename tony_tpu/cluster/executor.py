@@ -300,12 +300,15 @@ class TaskExecutor:
         manually, restoring both from external_attr."""
         import stat
         import zipfile
+        real_dest = os.path.realpath(dest)
         with zipfile.ZipFile(zip_path) as zf:
             for zi in zf.infolist():
                 mode = zi.external_attr >> 16
                 target = os.path.join(dest, zi.filename)
-                if not os.path.realpath(target).startswith(
-                        os.path.realpath(dest)):
+                real_target = os.path.realpath(target)
+                # prefix check alone would pass sibling dirs sharing the
+                # dest prefix ('<dest>x/evil') — require path containment
+                if os.path.commonpath([real_dest, real_target]) != real_dest:
                     raise ValueError(f"zip entry escapes dest: {zi.filename}")
                 if zi.is_dir():
                     os.makedirs(target, exist_ok=True)

@@ -285,13 +285,18 @@ class Session:
             else:
                 window = 0.0
                 fraction = 0.0
-            return {
+            metrics = {
                 "session_wall_s": round(now - self.started_at, 3),
                 "tracked_window_s": round(window, 3),
                 "task_uptime_s": {k: round(v, 3)
                                   for k, v in uptimes.items()},
-                "tracked_uptime_fraction": round(fraction, 4),
             }
+            # Single-node/notebook jobs schedule no tracked tasks; a
+            # fraction of 0.0 would render as a misleading "0.0%" uptime
+            # for a succeeded job, so the metric is omitted entirely.
+            if tracked:
+                metrics["tracked_uptime_fraction"] = round(fraction, 4)
+            return metrics
 
     def update_session_status(self) -> SessionStatus:
         """Reduce task states to a final status once all *tracked* tasks are
