@@ -55,6 +55,7 @@ class TestDecode:
                                    rtol=2e-4, atol=2e-4)
         assert int(cache["length"]) == 6
 
+    @pytest.mark.slow
     def test_greedy_generate_equals_full_forward_loop(self, params):
         prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 4), 0,
                                     CFG.vocab_size)
@@ -88,6 +89,7 @@ class TestDecode:
                                     CFG.head_dim)
         assert cache["k"].dtype == CFG.dtype
 
+    @pytest.mark.slow
     def test_moe_greedy_generate_matches_full_forward(self):
         """MoE decode: cached generation equals the full-forward loop (high
         capacity factor so routing drops cannot differ between the S=1
@@ -137,6 +139,7 @@ class TestDecode:
                                        rtol=2e-4, atol=2e-4)
         assert int(cache_a["length"]) == int(cache_b["length"]) == 8
 
+    @pytest.mark.slow
     @pytest.mark.parametrize("num_spec", [1, 3, 6])
     def test_speculative_equals_greedy(self, params, num_spec):
         """Speculative decoding with ANY draft model reproduces the target's
@@ -153,6 +156,7 @@ class TestDecode:
         np.testing.assert_array_equal(np.asarray(got),
                                       np.asarray(want.tokens))
 
+    @pytest.mark.slow
     def test_speculative_with_distinct_draft(self, params):
         """A DIFFERENT (random) draft still yields the target's exact greedy
         output — only the speed, not the result, depends on the draft."""

@@ -175,6 +175,7 @@ class TestE2E:
         assert types[-1] == "APPLICATION_FINISHED"
         assert "SUCCEEDED" in os.path.basename(files[0])
 
+    @pytest.mark.slow
     def test_distributed_jax_mnist_trains(self, tmp_path):
         """The minimum end-to-end slice (SURVEY.md §7.5): client →
         coordinator → 2 local workers → jax.distributed bootstrap over the
@@ -381,6 +382,7 @@ class TestE2E:
                 cli._notebook_proxy = None
         assert result.get("code") == 0
 
+    @pytest.mark.slow
     def test_distributed_pytorch_example_trains(self, tmp_path):
         """PyTorch runtime-adapter parity: 2 workers build a gloo process
         group from the exported RANK/WORLD/INIT_METHOD and train with manual
@@ -400,6 +402,7 @@ class TestE2E:
         assert "process group up" in out
         assert "final loss" in out
 
+    @pytest.mark.slow
     def test_lm_example_resumes_after_am_retry(self, tmp_path):
         """Checkpoint/resume across coordinator retries: a worker that
         crashes mid-training on attempt 0 resumes from its checkpoint on the
@@ -489,6 +492,7 @@ runpy.run_path(r"{script}", run_name="__main__")
         logs = os.listdir(os.path.join(client.job_dir, "logs"))
         assert not any(n.startswith("worker") for n in logs)
 
+    @pytest.mark.slow
     def test_distributed_resnet_dp_trains(self, tmp_path):
         """Progression config: ResNet DP across 2 processes (the 8w config
         at test scale — same code path, the instance count is config)."""
@@ -509,6 +513,7 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert "devices=2" in out
         assert "done:" in out
 
+    @pytest.mark.slow
     def test_distributed_bert_mlm_trains(self, tmp_path):
         """Progression config: BERT MLM pretraining, jax.distributed
         multi-host (2 processes at test scale of the 16w config)."""
@@ -528,6 +533,7 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert "2 global devices" in out
         assert "done:" in out
 
+    @pytest.mark.slow
     def test_distributed_context_parallel_lm_trains(self, tmp_path):
         """Long-context config: the LM trains with the sequence sharded over
         a 2-process cp mesh axis — ring attention's ppermute collectives run
@@ -619,6 +625,7 @@ runpy.run_path(r"{script}", run_name="__main__")
              "tony.ps.resources": str(tmp_path / "b" / "config.json")})
         assert client.run() == 1
 
+    @pytest.mark.slow
     def test_distributed_tensorflow_example_trains(self, tmp_path):
         """Progression config: TF2 MultiWorkerMirroredStrategy consumes the
         exported TF_CONFIG across 2 workers (reference parity for the
@@ -639,6 +646,7 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert "'type': 'worker', 'index': 0" in out
         assert "final loss" in out
 
+    @pytest.mark.slow
     def test_lm_trains_from_sharded_files(self, tmp_path):
         """Full data path: binary token shards → per-process byte-range
         splits (tony_tpu.io) → global sharded batches → train step, across

@@ -35,6 +35,7 @@ def test_virtual_mesh_env_forces_cpu_and_device_count(monkeypatch):
 
 
 @pytest.mark.e2e
+@pytest.mark.slow
 def test_dryrun_bootstraps_when_devices_insufficient():
     """Caller pinned to ONE device must still pass dryrun_multichip(4)."""
     env = dict(os.environ)

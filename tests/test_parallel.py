@@ -137,6 +137,7 @@ class TestRingAttention:
         expect = _dense_attention(q, k, v, causal)
         np.testing.assert_allclose(out, expect, atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self, qkv):
         q, k, v = qkv
         mesh = make_mesh({"dp": 2, "cp": 4})
@@ -190,6 +191,7 @@ class TestPipeline:
         out = pipeline_apply(self._stage, (W, b), x, mesh, num_microbatches=4)
         np.testing.assert_allclose(out, want, atol=1e-6)
 
+    @pytest.mark.slow
     def test_gradients_match_sequential(self, problem):
         W, b, x, _ = problem
         mesh = make_mesh({"pp": 4, "dp": 2})
@@ -306,6 +308,7 @@ class TestUlyssesAttention:
             ulysses_attention(q, k, v, mesh, causal=True),
             ring_attention(q, k, v, mesh, causal=True), atol=2e-5)
 
+    @pytest.mark.slow
     def test_gradients_match_dense(self, qkv):
         from tony_tpu.parallel import ulysses_attention
         q, k, v = qkv
@@ -334,6 +337,7 @@ class TestUlyssesAttention:
             ulysses_attention(q, k, v, mesh, causal=True)
 
 
+@pytest.mark.slow
 def test_transformer_trains_with_ulysses_cp():
     """cp_strategy="ulysses" drives the model's attention through the
     all-to-all path end to end (loss finite, grads flow)."""

@@ -416,10 +416,17 @@ class Coordinator:
                  "single-node" if single_node else "preprocess", user_command)
         # Same docker passthrough as scheduled tasks — with docker enabled
         # the preprocess step must see the image's deps, not the bare host.
+        # HOME is forwarded into docker only for single-node jobs, where it
+        # points at job_dir (bind-mounted). Forwarding the submitting
+        # user's host HOME would name a path that does not exist in the
+        # container.
+        env_keys = [constants.PREPROCESSING_JOB, constants.TB_PORT,
+                    constants.NOTEBOOK_PORT]
+        if single_node:
+            env_keys.append("HOME")
         command = docker_wrap(
             user_command, self.conf, self.job_dir,
-            env_keys=(constants.PREPROCESSING_JOB, constants.TB_PORT,
-                      constants.NOTEBOOK_PORT, "HOME"),
+            env_keys=tuple(env_keys),
             task_id="am-preprocess", app_id=self.app_id)
         logs = os.path.join(self.log_dir, "am-preprocess")
         timeout_s = self.conf.get_int(K.TASK_EXECUTION_TIMEOUT_KEY, 0) / 1000.0
