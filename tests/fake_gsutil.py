@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Fake ``gsutil`` for tests: maps gs://bucket/path -> $FAKE_GCS_ROOT/bucket/
+path on the local filesystem and implements the subset of verbs GcsStorage
+uses (stat, ls, cat [-r], cp [-|src dst], mv, rm, rsync -r). The MiniDFS
+analog — the real CLI's contract, no cloud."""
+
+import os
+import shutil
+import sys
+
+
+def to_local(uri: str) -> str:
+    assert uri.startswith("gs://"), uri
+    return os.path.join(os.environ["FAKE_GCS_ROOT"], uri[len("gs://"):])
+
+
+def main(argv):
+    # gsutil global flags before the verb (-q, -m)
+    while argv and argv[0] in ("-q", "-m"):
+        argv = argv[1:]
+    verb, args = argv[0], argv[1:]
+
+    if verb == "stat":
+        return 0 if os.path.isfile(to_local(args[0])) else 1
+
+    if verb == "ls":
+        pat = args[0]
+        recursive = pat.endswith("/**")
+        base = to_local(pat[:-3] if recursive else pat.rstrip("/"))
+        if not os.path.isdir(base):
+            return 1
+        prefix = pat[:-3].rstrip("/") if recursive else pat.rstrip("/")
+        if recursive:
+            found = False
+            for root, _, files in os.walk(base):
+                rel = os.path.relpath(root, base)
+                for f in sorted(files):
+                    p = f if rel == "." else f"{rel}/{f}"
+                    print(f"{prefix}/{p}")
+                    found = True
+            return 0 if found else 1
+        entries = sorted(os.listdir(base))
+        if not entries:
+            return 1
+        for e in entries:
+            full = os.path.join(base, e)
+            print(f"{prefix}/{e}" + ("/" if os.path.isdir(full) else ""))
+        return 0
+
+    if verb == "cat":
+        if args[0] == "-r":
+            rng, path = args[1], args[2]
+            n = int(rng.lstrip("-"))
+            with open(to_local(path), "rb") as f:
+                f.seek(0, os.SEEK_END)
+                f.seek(max(0, f.tell() - n))
+                sys.stdout.buffer.write(f.read())
+            return 0
+        with open(to_local(args[0]), "rb") as f:
+            sys.stdout.buffer.write(f.read())
+        return 0
+
+    if verb == "cp":
+        src, dst = args[0], args[1]
+        if src == "-":
+            dest = to_local(dst)
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            with open(dest, "wb") as f:
+                f.write(sys.stdin.buffer.read())
+            return 0
+        s = to_local(src) if src.startswith("gs://") else src
+        d = to_local(dst) if dst.startswith("gs://") else dst
+        if not os.path.isfile(s):
+            return 1
+        os.makedirs(os.path.dirname(d) or ".", exist_ok=True)
+        shutil.copy2(s, d)
+        return 0
+
+    if verb == "mv":
+        s, d = to_local(args[0]), to_local(args[1])
+        if not os.path.exists(s):
+            return 1
+        os.makedirs(os.path.dirname(d), exist_ok=True)
+        os.replace(s, d)
+        return 0
+
+    if verb == "rm":
+        p = to_local(args[-1])
+        if not os.path.exists(p):
+            return 1
+        os.remove(p)
+        return 0
+
+    if verb == "rsync":
+        assert args[0] == "-r", args
+        src, dst = args[1], args[2]
+        s = to_local(src) if src.startswith("gs://") else src
+        d = to_local(dst) if dst.startswith("gs://") else dst
+        if not os.path.isdir(s):
+            return 1
+        shutil.copytree(s, d, dirs_exist_ok=True)
+        return 0
+
+    print(f"fake_gsutil: unknown verb {verb}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

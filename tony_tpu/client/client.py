@@ -32,6 +32,7 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 import json
 
+from tony_tpu import storage
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
 from tony_tpu.utils.env import with_framework_path
 from tony_tpu.utils.version import inject_version_info
@@ -67,7 +68,21 @@ class TonyClient:
         self.app_id = new_app_id()
         staging_root = (conf.get(K.STAGING_DIR_KEY) or
                         os.path.join(os.getcwd(), constants.TONY_JOB_DIR_PREFIX))
-        self.job_dir = os.path.join(staging_root, self.app_id)
+        # A remote staging root (gs://...) is for fleets whose hosts share
+        # no filesystem with the submit host (the reference's HDFS
+        # .tony/<appId> staging, TonyClient.java:163-185): the job dir is
+        # assembled in a local spool, then pushed wholesale; slice hosts
+        # pull it down (the container-localization analog).
+        self.remote_job_dir: str | None = None
+        if storage.is_remote(staging_root):
+            self.remote_job_dir = storage.sjoin(staging_root, self.app_id)
+            # mkdtemp: private (0700) and collision-free on multi-user
+            # hosts. Holds the coordinator/task logs, so it is left on
+            # disk after the run.
+            import tempfile
+            self.job_dir = tempfile.mkdtemp(prefix=f"tony-{self.app_id}-")
+        else:
+            self.job_dir = os.path.join(staging_root, self.app_id)
         self.timeout_s = conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) / 1000.0
         self.am_proc: subprocess.Popen | None = None
         self.rpc: ApplicationRpcClient | None = None
@@ -115,7 +130,19 @@ class TonyClient:
         self.conf.set(K.HISTORY_LOCATION_KEY, dirs.location)
         self.conf.set(K.HISTORY_INTERMEDIATE_KEY, dirs.intermediate)
         self.conf.set(K.HISTORY_FINISHED_KEY, dirs.finished)
+        if self.remote_job_dir:
+            # Frozen into tony-final.xml so every slice host knows where to
+            # pull the job dir from (the localization contract, reference:
+            # TonyApplicationMaster.java:1090-1104).
+            self.conf.set(K.REMOTE_JOB_DIR_KEY, self.remote_job_dir)
         self.conf.write_xml(os.path.join(self.job_dir, constants.TONY_FINAL_XML))
+        if self.remote_job_dir:
+            # Push the assembled job dir in one shot (the HDFS staging
+            # upload, TonyClient.java:163-185). The per-job secret is
+            # written only AFTER the push: it rides to processes via env,
+            # and must never land in a (possibly team-readable) bucket.
+            storage.storage_for(self.remote_job_dir).put_tree(
+                self.job_dir, self.remote_job_dir)
         if self.secret:
             secret_path = os.path.join(self.job_dir, constants.TONY_SECRET_FILE)
             fd = os.open(secret_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,

@@ -498,8 +498,13 @@ class Coordinator:
         # /config page can render it (reference: TonyApplicationMaster
         # setupJobDir + writeConfigFile :458-463).
         try:
-            self.conf.write_xml(os.path.join(
-                self.events.history_dir, ev.config_file_name(self.app_id)))
+            from tony_tpu.storage import sjoin, storage_for
+            dest = sjoin(self.events.history_dir,
+                         ev.config_file_name(self.app_id))
+            tmp_xml = os.path.join(self.job_dir, ".history-config.xml")
+            self.conf.write_xml(tmp_xml)
+            storage_for(dest).put(tmp_xml, dest)
+            os.remove(tmp_xml)
         except Exception:
             # Best-effort convenience file — never fail the job over it.
             log.warning("could not write history config copy", exc_info=True)
@@ -665,7 +670,14 @@ class Coordinator:
             failed_tasks=[t.task_id for t in self.session.all_tasks()
                           if t.status is TaskStatus.FAILED],
             metrics=self._combined_uptime_metrics())
-        self.events.stop(self.final_status)
+        try:
+            self.events.stop(self.final_status)
+        except OSError:
+            # History publish failure (e.g. transient gs:// error renaming
+            # .inprogress) must not abort teardown: the final-status file
+            # is already written, and the client must still get its RPC
+            # finish handshake.
+            log.warning("history event publish failed", exc_info=True)
         # Wait briefly for the client's finish signal (reference: stop:669-694
         # polls up to 30s for finishApplication), then stop serving RPC.
         self.client_signalled_finish.wait(

@@ -150,6 +150,15 @@ class TonyConfig:
         conf.update(read_conf_file(path))
         return conf
 
+    @classmethod
+    def from_xml_bytes(cls, data: bytes,
+                       load_defaults: bool = True) -> "TonyConfig":
+        """Parse configuration XML already in memory (e.g. fetched from
+        remote storage by the history server)."""
+        conf = cls(load_defaults=load_defaults)
+        conf.update(_props_from_root(ET.fromstring(data)))
+        return conf
+
     def write_xml(self, path: str) -> None:
         """Write Hadoop-style configuration XML (the tony-final.xml freeze)."""
         root = ET.Element("configuration")
@@ -222,8 +231,11 @@ def read_conf_file(path: str) -> dict[str, str]:
 
 
 def _read_xml(path: str) -> dict[str, str]:
+    return _props_from_root(ET.parse(path).getroot())
+
+
+def _props_from_root(root) -> dict[str, str]:
     out: dict[str, str] = {}
-    root = ET.parse(path).getroot()
     for prop in root.iter("property"):
         name = prop.findtext("name")
         value = prop.findtext("value")

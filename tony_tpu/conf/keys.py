@@ -90,6 +90,9 @@ TPU_STATE_REFRESH_KEY = "tony.tpu.state-refresh-ms"
 # Staging / storage ("tony.staging.*"; HDFS-dir analog)
 # ---------------------------------------------------------------------------
 STAGING_DIR_KEY = "tony.staging.dir"
+# Set by the client when the staging root is remote (gs://): the full job
+# dir was pushed here and slice hosts localize from it.
+REMOTE_JOB_DIR_KEY = "tony.staging.remote-job-dir"
 SRC_DIR_KEY = "tony.application.src-dir"                          # "" = no implicit staging
 PYTHON_VENV_KEY = "tony.application.python-venv"
 PYTHON_BINARY_PATH_KEY = "tony.application.python-binary-path"
@@ -144,6 +147,7 @@ DEFAULTS: dict[str, str] = {
     TPU_PREEMPTION_RETRIES_KEY: "3",
     TPU_STATE_REFRESH_KEY: "10000",
     STAGING_DIR_KEY: "",
+    REMOTE_JOB_DIR_KEY: "",
     SRC_DIR_KEY: "",
     PYTHON_VENV_KEY: "",
     PYTHON_BINARY_PATH_KEY: "",

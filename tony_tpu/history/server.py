@@ -26,7 +26,6 @@ import html
 import json
 import logging
 import os
-import shutil
 import threading
 import time
 from datetime import datetime, timezone
@@ -35,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig, parse_cli_confs
 from tony_tpu.events import events as ev
+from tony_tpu.storage import (StorageError, sdirname, sjoin, storage_for)
 
 log = logging.getLogger(__name__)
 
@@ -54,9 +54,10 @@ def migrate_finished(dirs: HistoryDirs) -> list[str]:
     """Move completed jhist files (and their sibling config file) out of the
     intermediate dir into finished/yyyy/mm/dd. Returns the new paths."""
     moved = []
-    if not os.path.isdir(dirs.intermediate):
+    store = storage_for(dirs.intermediate)
+    if not store.isdir(dirs.intermediate):
         return moved
-    names = sorted(os.listdir(dirs.intermediate))
+    names = store.listdir(dirs.intermediate)
     metas = {n: ev.JobMetadata.from_file_name(n) for n in names}
     # One pass over the snapshot; per-app ghost lists keep the cleanup O(n).
     inprogress_by_app: dict[str, list[str]] = {}
@@ -68,23 +69,22 @@ def migrate_finished(dirs: HistoryDirs) -> list[str]:
         if meta is None or meta.in_progress or meta.completed_ms is None:
             continue
         when = datetime.fromtimestamp(meta.completed_ms / 1000, timezone.utc)
-        dest_dir = os.path.join(dirs.finished, f"{when.year:04d}",
-                                f"{when.month:02d}", f"{when.day:02d}")
-        os.makedirs(dest_dir, exist_ok=True)
-        src = os.path.join(dirs.intermediate, name)
-        dest = os.path.join(dest_dir, name)
+        dest_dir = sjoin(dirs.finished, f"{when.year:04d}",
+                         f"{when.month:02d}", f"{when.day:02d}")
+        store.makedirs(dest_dir)
+        src = sjoin(dirs.intermediate, name)
+        dest = sjoin(dest_dir, name)
         try:
-            shutil.move(src, dest)
-        except FileNotFoundError:
+            store.move(src, dest)
+        except (FileNotFoundError, StorageError):
             continue    # a concurrent migration beat us to this file
         moved.append(dest)
-        conf_src = os.path.join(dirs.intermediate,
-                                config_file_name(meta.app_id))
+        conf_src = sjoin(dirs.intermediate, config_file_name(meta.app_id))
         try:
-            if os.path.exists(conf_src):
-                shutil.move(conf_src, os.path.join(
-                    dest_dir, config_file_name(meta.app_id)))
-        except FileNotFoundError:
+            if store.exists(conf_src):
+                store.move(conf_src,
+                           sjoin(dest_dir, config_file_name(meta.app_id)))
+        except (FileNotFoundError, StorageError):
             pass
         # A crashed earlier coordinator attempt can leave a stale
         # .jhist.inprogress for the same app id; once a completed jhist
@@ -92,8 +92,8 @@ def migrate_finished(dirs: HistoryDirs) -> list[str]:
         # the real history.
         for other in inprogress_by_app.pop(meta.app_id, ()):
             try:
-                os.remove(os.path.join(dirs.intermediate, other))
-            except FileNotFoundError:
+                store.remove(sjoin(dirs.intermediate, other))
+            except (FileNotFoundError, StorageError):
                 pass
     return moved
 
@@ -103,17 +103,20 @@ def purge_expired(dirs: HistoryDirs, retention_s: int) -> int:
     retention window. Returns the number of files removed."""
     if retention_s <= 0:
         return 0
+    store = storage_for(dirs.finished)
     cutoff_ms = (time.time() - retention_s) * 1000
     removed = 0
     for path in ev.find_job_files(dirs.finished):
         meta = ev.JobMetadata.from_file_name(path)
         if meta and meta.completed_ms and meta.completed_ms < cutoff_ms:
-            conf_path = os.path.join(os.path.dirname(path),
-                                     config_file_name(meta.app_id))
+            conf_path = sjoin(sdirname(path), config_file_name(meta.app_id))
             for p in (path, conf_path):
-                if os.path.exists(p):
-                    os.remove(p)
-                    removed += 1
+                try:
+                    if store.exists(p):
+                        store.remove(p)
+                        removed += 1
+                except (FileNotFoundError, StorageError):
+                    pass    # concurrent purge or transient backend error
     return removed
 
 
@@ -261,7 +264,7 @@ class HistoryServer:
                 return None
             try:
                 return read_job(job)
-            except FileNotFoundError:
+            except (FileNotFoundError, StorageError):
                 if attempt:
                     raise
                 self.metadata_cache.invalidate_all()
@@ -275,11 +278,13 @@ class HistoryServer:
 
     def job_config(self, app_id: str) -> dict | None:
         def read_config(job):
-            conf_path = os.path.join(os.path.dirname(job["path"]),
-                                     config_file_name(app_id))
-            if not os.path.exists(conf_path):
+            conf_path = sjoin(sdirname(job["path"]),
+                              config_file_name(app_id))
+            store = storage_for(conf_path)
+            if not store.exists(conf_path):
                 return {}
-            return TonyConfig.from_file(conf_path).as_dict()
+            return TonyConfig.from_xml_bytes(
+                store.read_bytes(conf_path)).as_dict()
         return self.config_cache.get_or_load(
             app_id, lambda: self._load_fresh_on_vanish(app_id, read_config))
 
@@ -299,11 +304,8 @@ class HistoryServer:
         try:
             # jhist is JSON-lines with APPLICATION_FINISHED last: read only
             # the file tail instead of parsing N full event logs per index.
-            with open(path, "rb") as f:
-                f.seek(0, os.SEEK_END)
-                size = f.tell()
-                f.seek(max(0, size - 65536))
-                tail = f.read().decode("utf-8", errors="replace")
+            tail = storage_for(path).read_tail(path, 65536).decode(
+                "utf-8", errors="replace")
             for line in reversed(tail.splitlines()):
                 if '"APPLICATION_FINISHED"' not in line:
                     continue

@@ -1,0 +1,361 @@
+"""Pluggable storage: one interface over local paths and ``gs://`` URIs.
+
+The reference reaches every durable byte — staging uploads, history
+files, localized resources — through Hadoop's ``FileSystem`` abstraction
+(reference: TonyClient.java:163-192 staging, util/HdfsUtils.java scan/
+read helpers, events/EventHandler.java HDFS writer). The TPU rebuild has
+no HDFS; its two substrates are the local filesystem (laptop runs, the
+local fake-cluster backend) and GCS (real TPU fleets, where slice hosts
+share no filesystem with the submit host). This module is the one seam:
+callers hold plain path strings (``/x/y`` or ``gs://bucket/x/y``) and the
+scheme picks the implementation.
+
+GCS is driven through the ``gsutil`` CLI rather than a client library —
+the library is not in the image, the CLI is on every TPU VM, and a
+subprocess boundary lets the test suite substitute a fake ``gsutil`` on
+PATH (the same trick the reference's MiniDFS plays for HDFS). Override
+the binary with ``TONY_GSUTIL``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import re
+import shutil
+import subprocess
+import threading
+
+__all__ = [
+    "Storage", "LocalStorage", "GcsStorage", "StorageError",
+    "storage_for", "register_storage", "scheme_of",
+    "sjoin", "sdirname", "sbasename", "is_remote",
+]
+
+_SCHEME_RE = re.compile(r"^([a-z][a-z0-9+.-]*)://")
+
+
+class StorageError(OSError):
+    """Backend (gsutil/...) operation failure. Subclasses OSError so
+    callers guarding filesystem IO naturally cover remote storage too."""
+
+
+def scheme_of(path: str) -> str:
+    """'' for local paths, 'gs' for gs://... etc."""
+    m = _SCHEME_RE.match(path)
+    return m.group(1) if m else ""
+
+
+def is_remote(path: str) -> bool:
+    return bool(scheme_of(path))
+
+
+def sjoin(base: str, *parts: str) -> str:
+    """Path join that keeps URI schemes intact (os.path.join would treat
+    'gs://b' fine on posix, but be explicit and platform-independent)."""
+    if is_remote(base):
+        out = base.rstrip("/")
+        for p in parts:
+            out += "/" + p.strip("/")
+        return out
+    return os.path.join(base, *parts)
+
+
+def sdirname(path: str) -> str:
+    if is_remote(path):
+        scheme, _, rest = path.partition("://")
+        head, _, _ = rest.rstrip("/").rpartition("/")
+        return f"{scheme}://{head}"
+    return os.path.dirname(path)
+
+
+def sbasename(path: str) -> str:
+    if is_remote(path):
+        return path.rstrip("/").rpartition("/")[2]
+    return os.path.basename(path)
+
+
+class Storage:
+    """Operations every substrate must provide. Paths are scheme-qualified
+    strings; directory semantics are emulated where the substrate has none
+    (GCS: a 'directory' exists iff some object lives under it)."""
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def isdir(self, path: str) -> bool:
+        raise NotImplementedError
+
+    def listdir(self, path: str) -> list[str]:
+        """Immediate child names (files and dirs)."""
+        raise NotImplementedError
+
+    def makedirs(self, path: str) -> None:
+        raise NotImplementedError
+
+    def walk_files(self, path: str):
+        """Yield (dirpath, [filenames]) over the whole tree, like os.walk
+        restricted to files (reference: HdfsUtils.getJobFolders:123)."""
+        raise NotImplementedError
+
+    def read_bytes(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def read_tail(self, path: str, n: int) -> bytes:
+        """Last n bytes (history server reads only jhist tails)."""
+        raise NotImplementedError
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def open_append(self, path: str):
+        """Text-mode append stream. flush() makes the bytes visible to
+        readers (possibly by re-uploading the object on GCS)."""
+        raise NotImplementedError
+
+    def move(self, src: str, dst: str) -> None:
+        """Rename within this storage (the .inprogress -> final publish)."""
+        raise NotImplementedError
+
+    def remove(self, path: str) -> None:
+        raise NotImplementedError
+
+    def put(self, local_path: str, path: str) -> None:
+        """Upload one local file."""
+        raise NotImplementedError
+
+    def get(self, path: str, local_path: str) -> None:
+        """Download one file to a local path."""
+        raise NotImplementedError
+
+    def put_tree(self, local_dir: str, path: str) -> None:
+        """Upload a local directory tree (client staging)."""
+        raise NotImplementedError
+
+    def get_tree(self, path: str, local_dir: str) -> None:
+        """Download a tree (executor-side localization)."""
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+class LocalStorage(Storage):
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def isdir(self, path: str) -> bool:
+        return os.path.isdir(path)
+
+    def listdir(self, path: str) -> list[str]:
+        return sorted(os.listdir(path))
+
+    def makedirs(self, path: str) -> None:
+        os.makedirs(path, exist_ok=True)
+
+    def walk_files(self, path: str):
+        for root, _, files in os.walk(path):
+            yield root, files
+
+    def read_bytes(self, path: str) -> bytes:
+        with open(path, "rb") as f:
+            return f.read()
+
+    def read_tail(self, path: str, n: int) -> bytes:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return f.read()
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "wb") as f:
+            f.write(data)
+
+    def open_append(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        return open(path, "a", encoding="utf-8")
+
+    def move(self, src: str, dst: str) -> None:
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        os.replace(src, dst)
+
+    def remove(self, path: str) -> None:
+        os.remove(path)
+
+    def put(self, local_path: str, path: str) -> None:
+        if os.path.abspath(local_path) != os.path.abspath(path):
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            shutil.copy2(local_path, path)
+
+    def get(self, path: str, local_path: str) -> None:
+        self.put(path, local_path)
+
+    def put_tree(self, local_dir: str, path: str) -> None:
+        if os.path.abspath(local_dir) != os.path.abspath(path):
+            shutil.copytree(local_dir, path, dirs_exist_ok=True)
+
+    def get_tree(self, path: str, local_dir: str) -> None:
+        self.put_tree(path, local_dir)
+
+
+# ---------------------------------------------------------------------------
+class _GcsAppendStream(io.TextIOBase):
+    """GCS objects are immutable — append is emulated by buffering the whole
+    stream and re-uploading on flush. Event traffic is control-plane-rate
+    (a handful of task lifecycle records per job), so whole-object rewrite
+    per flush is cheap and keeps .inprogress files live-readable, matching
+    the reference's HDFS append visibility."""
+
+    def __init__(self, storage: "GcsStorage", path: str) -> None:
+        super().__init__()
+        self._storage = storage
+        self._path = path
+        self._buf: list[str] = []
+        self._lock = threading.Lock()
+        if storage.exists(path):
+            self._buf.append(storage.read_bytes(path).decode("utf-8"))
+
+    def write(self, s: str) -> int:
+        with self._lock:
+            self._buf.append(s)
+        return len(s)
+
+    def flush(self) -> None:
+        with self._lock:
+            data = "".join(self._buf).encode("utf-8")
+        self._storage.write_bytes(self._path, data)
+
+    def close(self) -> None:
+        if not self.closed:
+            self.flush()
+        super().close()
+
+
+class GcsStorage(Storage):
+    """``gs://`` via the gsutil CLI (override binary with $TONY_GSUTIL)."""
+
+    def __init__(self, gsutil: str | None = None) -> None:
+        self.gsutil = gsutil or os.environ.get("TONY_GSUTIL") or "gsutil"
+
+    # -- plumbing ----------------------------------------------------------
+    def _run(self, *args: str, input_bytes: bytes | None = None,
+             ok_codes: tuple[int, ...] = (0,)) -> bytes:
+        proc = subprocess.run(
+            [self.gsutil, "-q", *args], input=input_bytes,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        if proc.returncode not in ok_codes:
+            raise StorageError(
+                f"{self.gsutil} {' '.join(args)} failed rc={proc.returncode}: "
+                f"{proc.stderr.decode('utf-8', 'replace').strip()}")
+        return proc.stdout
+
+    def _try(self, *args: str) -> bool:
+        proc = subprocess.run(
+            [self.gsutil, "-q", *args],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        return proc.returncode == 0
+
+    def _ls(self, pattern: str) -> list[str]:
+        proc = subprocess.run(
+            [self.gsutil, "-q", "ls", pattern],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        if proc.returncode != 0:
+            return []
+        return [l.strip() for l in proc.stdout.decode().splitlines()
+                if l.strip()]
+
+    # -- interface ---------------------------------------------------------
+    def exists(self, path: str) -> bool:
+        # stat matches objects; a trailing-slash ls matches "directories"
+        return self._try("stat", path) or bool(
+            self._ls(path.rstrip("/") + "/"))
+
+    def isdir(self, path: str) -> bool:
+        return bool(self._ls(path.rstrip("/") + "/"))
+
+    def listdir(self, path: str) -> list[str]:
+        names = set()
+        for entry in self._ls(path.rstrip("/") + "/"):
+            name = entry[len(path.rstrip("/")) + 1:] if entry.startswith(
+                path.rstrip("/")) else sbasename(entry)
+            names.add(name.strip("/").split("/")[0] if name else "")
+        names.discard("")
+        return sorted(names)
+
+    def makedirs(self, path: str) -> None:
+        pass    # GCS has no directories; objects create their prefixes
+
+    def walk_files(self, path: str):
+        root = path.rstrip("/")
+        by_dir: dict[str, list[str]] = {}
+        for entry in self._ls(root + "/**"):
+            if entry.endswith("/"):
+                continue
+            by_dir.setdefault(sdirname(entry), []).append(sbasename(entry))
+        for d in sorted(by_dir):
+            yield d, sorted(by_dir[d])
+
+    def read_bytes(self, path: str) -> bytes:
+        return self._run("cat", path)
+
+    def read_tail(self, path: str, n: int) -> bytes:
+        return self._run("cat", "-r", f"-{n}", path)
+
+    def write_bytes(self, path: str, data: bytes) -> None:
+        self._run("cp", "-", path, input_bytes=data)
+
+    def open_append(self, path: str):
+        return _GcsAppendStream(self, path)
+
+    def move(self, src: str, dst: str) -> None:
+        self._run("mv", src, dst)
+
+    def remove(self, path: str) -> None:
+        self._run("rm", path)
+
+    def put(self, local_path: str, path: str) -> None:
+        self._run("cp", local_path, path)
+
+    def get(self, path: str, local_path: str) -> None:
+        os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
+        self._run("cp", path, local_path)
+
+    def put_tree(self, local_dir: str, path: str) -> None:
+        # rsync -r preserves relative layout on repeated stagings
+        self._run("rsync", "-r", local_dir.rstrip("/"), path.rstrip("/"))
+
+    def get_tree(self, path: str, local_dir: str) -> None:
+        os.makedirs(local_dir, exist_ok=True)
+        self._run("rsync", "-r", path.rstrip("/"), local_dir.rstrip("/"))
+
+
+# ---------------------------------------------------------------------------
+_registry: dict[str, Storage] = {}
+_registry_lock = threading.Lock()
+
+
+def register_storage(scheme: str, storage: Storage | None) -> None:
+    """Override an implementation (tests register tmpdir-backed fakes);
+    None clears the override so the default is rebuilt on next use."""
+    with _registry_lock:
+        if storage is None:
+            _registry.pop(scheme, None)
+        else:
+            _registry[scheme] = storage
+
+
+def storage_for(path: str) -> Storage:
+    scheme = scheme_of(path)
+    with _registry_lock:
+        inst = _registry.get(scheme)
+        if inst is None:
+            if scheme == "":
+                inst = LocalStorage()
+            elif scheme == "gs":
+                inst = GcsStorage()
+            else:
+                raise StorageError(
+                    f"no storage registered for scheme '{scheme}://' "
+                    f"({path})")
+            _registry[scheme] = inst
+    return inst
