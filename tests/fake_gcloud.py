@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Fake ``gcloud compute tpus tpu-vm`` for tests — the MiniYARN analog.
+
+A "slice" is a directory under $FAKE_GCLOUD_ROOT; each host is a
+``worker<i>/`` subdir used as that host's $HOME, and ``ssh --command``
+runs the command as a LOCAL process with HOME pointed there. That makes
+the TPU backend's full provision → stage → launch → preempt →
+reprovision flow executable end-to-end on one machine: staged executors
+really start, import tony_tpu from the staged framework copy, and talk
+to the real coordinator over RPC.
+
+Verbs: create / describe / delete / ssh [--worker=i|all] / scp.
+State: ``$slice/state`` (tests flip it to PREEMPTED); host count comes
+from $FAKE_NUM_WORKERS at create time. Every invocation is appended to
+$FAKE_GCLOUD_ROOT/calls.log for assertions. One fake-ism: hosts share
+this machine's /tmp, so the staging path /tmp/tony-stage.tgz is rewritten
+to a per-worker location in both scp and ssh commands.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+
+def root() -> str:
+    return os.environ["FAKE_GCLOUD_ROOT"]
+
+
+def log_call(argv):
+    with open(os.path.join(root(), "calls.log"), "a") as f:
+        f.write(" ".join(argv) + "\n")
+
+
+def slice_dir(name: str) -> str:
+    return os.path.join(root(), name)
+
+
+def worker_home(name: str, i: int) -> str:
+    home = os.path.join(slice_dir(name), f"worker{i}")
+    os.makedirs(home, exist_ok=True)
+    return home
+
+
+def num_workers(name: str) -> int:
+    try:
+        with open(os.path.join(slice_dir(name), "num_workers")) as f:
+            return int(f.read().strip())
+    except OSError:
+        return 1
+
+
+def rewrite_tmp(cmd: str, home: str) -> str:
+    # per-host /tmp emulation for the one path the backend uses there
+    return cmd.replace("/tmp/tony-stage.tgz",
+                       os.path.join(home, ".tony-stage.tgz"))
+
+
+def main(argv):
+    assert argv[:3] == ["compute", "tpus", "tpu-vm"], argv
+    verb, name = argv[3], argv[4]
+    flags = argv[5:]
+    log_call(argv)
+
+    def flag(prefix):
+        for f in flags:
+            if f.startswith(prefix):
+                return f[len(prefix):]
+        return None
+
+    if verb == "create":
+        d = slice_dir(name)
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, "state"), "w") as f:
+            f.write("READY")
+        with open(os.path.join(d, "num_workers"), "w") as f:
+            f.write(os.environ.get("FAKE_NUM_WORKERS", "1"))
+        return 0
+
+    if verb == "describe":
+        state_path = os.path.join(slice_dir(name), "state")
+        if not os.path.exists(state_path):
+            print("NOT_FOUND", file=sys.stderr)
+            return 1
+        with open(state_path) as f:
+            print('{"state": "%s"}' % f.read().strip())
+        return 0
+
+    if verb == "delete":
+        if not os.path.isdir(slice_dir(name)):
+            return 1
+        shutil.rmtree(slice_dir(name))
+        return 0
+
+    if verb == "ssh":
+        command = flag("--command=")
+        worker = flag("--worker=") or "0"
+        if not os.path.isdir(slice_dir(name)):
+            print(f"ssh: slice {name} does not exist", file=sys.stderr)
+            return 1
+        idx_list = (range(num_workers(name)) if worker == "all"
+                    else [int(worker)])
+        for i in idx_list:
+            home = worker_home(name, i)
+            env = dict(os.environ)
+            env["HOME"] = home
+            rc = subprocess.run(
+                ["bash", "-c", rewrite_tmp(command, home)],
+                env=env, cwd=home).returncode
+            if rc != 0:
+                return rc
+        return 0
+
+    if verb == "scp":
+        # argv: scp LOCAL NAME:REMOTE --worker=all ... (name var holds LOCAL)
+        local = name
+        target = argv[5]
+        slice_name, _, remote = target.partition(":")
+        if not os.path.isdir(slice_dir(slice_name)):
+            print(f"scp: slice {slice_name} does not exist", file=sys.stderr)
+            return 1
+        for i in range(num_workers(slice_name)):
+            home = worker_home(slice_name, i)
+            dest = rewrite_tmp(remote, home)
+            if dest.startswith("~/"):
+                dest = os.path.join(home, dest[2:])
+            os.makedirs(os.path.dirname(dest), exist_ok=True)
+            shutil.copy2(local, dest)
+        return 0
+
+    print(f"fake_gcloud: unknown verb {verb}", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -19,6 +19,17 @@ from tony_tpu.storage import (GcsStorage, LocalStorage, StorageError,
 FAKE_GSUTIL = os.path.join(os.path.dirname(__file__), "fake_gsutil.py")
 
 
+def make_fake_gsutil(tmp_path, monkeypatch) -> str:
+    """Write a gsutil shim mapping gs:// to tmp_path/gcs; returns its path."""
+    monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
+    (tmp_path / "gcs").mkdir(exist_ok=True)
+    gsutil = tmp_path / "gsutil"
+    gsutil.write_text(
+        f"#!/bin/bash\nexec {sys.executable} {FAKE_GSUTIL} \"$@\"\n")
+    gsutil.chmod(0o755)
+    return str(gsutil)
+
+
 # ---------------------------------------------------------------------------
 def test_uri_helpers():
     assert scheme_of("gs://b/x") == "gs"
@@ -55,13 +66,8 @@ def store_and_root(request, tmp_path, monkeypatch):
     if request.param == "local":
         yield LocalStorage(), str(tmp_path / "data")
     else:
-        monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
-        (tmp_path / "gcs").mkdir()
-        gsutil = tmp_path / "gsutil"
-        gsutil.write_text(
-            f"#!/bin/bash\nexec {sys.executable} {FAKE_GSUTIL} \"$@\"\n")
-        gsutil.chmod(0o755)
-        yield GcsStorage(gsutil=str(gsutil)), "gs://bucket/data"
+        gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+        yield GcsStorage(gsutil=gsutil), "gs://bucket/data"
 
 
 class TestStorageContract:
@@ -157,14 +163,9 @@ class TestStorageContract:
 @pytest.fixture
 def gcs(tmp_path, monkeypatch):
     """gs:// end-to-end: register a fake-gsutil-backed GcsStorage."""
-    monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
-    (tmp_path / "gcs").mkdir()
-    gsutil = tmp_path / "gsutil"
-    gsutil.write_text(
-        f"#!/bin/bash\nexec {sys.executable} {FAKE_GSUTIL} \"$@\"\n")
-    gsutil.chmod(0o755)
-    register_storage("gs", GcsStorage(gsutil=str(gsutil)))
-    yield str(gsutil)
+    gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+    register_storage("gs", GcsStorage(gsutil=gsutil))
+    yield gsutil
     register_storage("gs", None)
 
 

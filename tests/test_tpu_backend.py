@@ -16,8 +16,8 @@ def tpu_conf(**extra):
         "tony.tpu.project": "my-proj",
         "tony.tpu.zone": "us-central2-b",
         "tony.tpu.accelerator-type": "v5litepod",
-        "tony.worker.instances": "4",
-        "tony.worker.tpus": "4",
+        "tony.worker.instances": "2",
+        "tony.worker.tpus": "8",
         "tony.worker.tpu.topology": "4x4",
     }
     base.update(extra)
@@ -105,3 +105,48 @@ def test_node_label_attached_to_slice(tmp_path):
     b = TpuSliceBackend(conf, app_id="app1", dry_run=True)
     cmd = b.create_slice_command("worker", "2x4")
     assert "--labels=tony-node-label=batch-pool" in cmd
+
+
+def test_stage_commands_scp_mode():
+    """Default transport: tarball over scp to every worker, strict unpack."""
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    cmds = b.stage_commands("worker", "/jobs/app1")
+    assert len(cmds) == 2
+    scp, unpack = cmds
+    assert scp[4] == "scp" and scp[5] == "/jobs/app1/.tony-stage.tgz"
+    assert scp[6].endswith(":/tmp/tony-stage.tgz")
+    assert "--worker=all" in scp
+    unpack_cmd = unpack[-1]
+    assert unpack_cmd.startswith("--command=")
+    assert "tar -xzf /tmp/tony-stage.tgz -C ~/tony-job" in unpack_cmd
+    assert "mkdir -p ~/tony-job" in unpack_cmd
+
+
+def test_stage_commands_gs_pull_mode():
+    """When the client staged to gs://, hosts pull with gsutil rsync."""
+    conf = tpu_conf()
+    conf.set("tony.staging.remote-job-dir", "gs://bkt/staging/app1")
+    b = TpuSliceBackend(conf, app_id="app1", dry_run=True)
+    cmds = b.stage_commands("worker", "/spool/app1")
+    assert len(cmds) == 1
+    (pull,) = cmds
+    assert "--worker=all" in pull
+    assert "gsutil -m rsync -r gs://bkt/staging/app1 ~/tony-job" in pull[-1]
+
+
+def test_launch_command_runs_in_remote_job_dir(caplog):
+    """The remote command must cd into the staged job dir (strictly) and
+    lead PYTHONPATH with the staged framework copy."""
+    import logging
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    spec = LaunchSpec(task_id="worker:0", command="python3 -m x",
+                      env={"JOB_NAME": "worker"}, log_dir="/tmp",
+                      cwd="", tpu_topology="2x4")
+    with caplog.at_level(logging.INFO, logger="tony_tpu.backend.tpu"):
+        b.launch_task(spec)
+    launches = [r.getMessage() for r in caplog.records
+                if "--command=" in r.getMessage()
+                and "cd ~/tony-job" in r.getMessage()]
+    assert launches, [r.getMessage() for r in caplog.records]
+    assert "cd ~/tony-job &&" in launches[-1]
+    assert "export PYTHONPATH=~/tony-job/.tony-framework" in launches[-1]

@@ -11,26 +11,36 @@ as a gang, and is released as a gang.
 Mechanics: one TPU VM (slice) per *job type* that requests TPUs, created via
 the ``gcloud compute tpus tpu-vm`` CLI (the only dependency-free path — the
 Cloud TPU REST API would need google-api-python-client, which is not baked
-in). Each host of the slice runs one task executor, started over
-``gcloud ... ssh --worker=<i>``; host 0's executor address file doubles as
-liveness. Completion is observed by polling the ssh-launched processes, and
-slice preemption (state=PREEMPTED) is reported with ``preempted=True`` so the
-coordinator can retry the session rather than fail it.
+in). After provisioning, the job dir (tony-final.xml, staged sources, venv
+zip, and a ``.tony-framework/`` copy of this package) is localized onto every
+slice host at ``~/tony-job`` — the container-localization analog (reference:
+TonyClient.java:163-192 uploads src/venv/conf to HDFS staging and
+TonyApplicationMaster.java:1090-1104 localizes them into each container).
+Two transports: a tarball over ``gcloud ... scp`` (default), or a
+``gsutil rsync`` pull when the client staged to gs://
+(tony.staging.remote-job-dir). Each host then runs one task executor over
+``gcloud ... ssh --worker=<i>`` with cwd ``~/tony-job``. Completion is
+observed by polling the ssh-launched processes, and slice preemption
+(state=PREEMPTED) is reported with ``preempted=True`` so the coordinator can
+retry the session rather than fail it.
 
 This backend requires GCP credentials and egress; in the development image it
-is constructible only for command-plan inspection (``dry_run=True``), and its
-command construction is unit-tested the way the reference unit-tests its AM
-launch command (TestTonyClient.java:23-31).
+is exercised end-to-end against a fake ``gcloud`` on PATH
+(tests/test_tpu_backend_e2e.py) that runs ssh commands as local processes —
+the MiniYARN trick — plus command-plan unit tests in the reference's style
+(TestTonyClient.java:23-31).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import re
 import logging
 import shlex
 import shutil
 import subprocess
+import tarfile
 import threading
 import time
 
@@ -39,6 +49,13 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 
 log = logging.getLogger(__name__)
+
+#: job-dir location on every slice host
+REMOTE_JOB_DIR = "~/tony-job"
+#: subdir (inside the job dir) carrying the tony_tpu package itself, so
+#: slice hosts need no pip install — the fat-jar-on-HDFS analog
+#: (reference: cli/ClusterSubmitter.java:37-61 ships tony's own jar)
+FRAMEWORK_DIR = ".tony-framework"
 
 
 class TpuProvisioningError(RuntimeError):
@@ -79,6 +96,7 @@ class TpuSliceBackend(SchedulerBackend):
                                              10000) / 1000.0
         self._state_cache: dict[str, str] = {}
         self._state_ts: dict[str, float] = {}
+        self._artifacts_ready = False
         if not dry_run:
             if shutil.which("gcloud") is None:
                 raise TpuProvisioningError(
@@ -122,13 +140,43 @@ class TpuSliceBackend(SchedulerBackend):
             cmd.append(f"--labels=tony-node-label={label}")
         return cmd
 
-    def ssh_command(self, job_type: str, host_index: int,
+    def ssh_command(self, job_type: str, host_index: int | str,
                     remote_command: str) -> list[str]:
+        """``host_index`` is a host number or ``"all"`` (staging runs the
+        same command on every host)."""
         name = slice_name(self.app_id, job_type)
         return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
                 f"--project={self.project}", f"--zone={self.zone}",
                 f"--worker={host_index}", "--quiet",
                 f"--command={remote_command}"]
+
+    def scp_command(self, job_type: str, local_path: str,
+                    remote_path: str) -> list[str]:
+        name = slice_name(self.app_id, job_type)
+        return ["gcloud", "compute", "tpus", "tpu-vm", "scp", local_path,
+                f"{name}:{remote_path}",
+                f"--project={self.project}", f"--zone={self.zone}",
+                "--worker=all", "--quiet"]
+
+    def stage_commands(self, job_type: str,
+                       job_dir: str) -> list[list[str]]:
+        """Command plan localizing the job dir onto every slice host
+        (reference: TonyApplicationMaster.java:1090-1104). gs:// pull when
+        the client staged remotely, tarball-over-scp otherwise."""
+        remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
+        if remote_staging:
+            pull = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
+                    f"&& gsutil -m rsync -r {shlex.quote(remote_staging)} "
+                    f"{REMOTE_JOB_DIR}")
+            return [self.ssh_command(job_type, "all", pull)]
+        tarball = os.path.join(job_dir, ".tony-stage.tgz")
+        unpack = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} && "
+                  f"tar -xzf /tmp/tony-stage.tgz -C {REMOTE_JOB_DIR} && "
+                  f"rm -f /tmp/tony-stage.tgz")
+        return [
+            self.scp_command(job_type, tarball, "/tmp/tony-stage.tgz"),
+            self.ssh_command(job_type, "all", unpack),
+        ]
 
     def describe_command(self, job_type: str) -> list[str]:
         name = slice_name(self.app_id, job_type)
@@ -174,7 +222,14 @@ class TpuSliceBackend(SchedulerBackend):
                 self._provision(job_type, spec)
             env_prefix = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in spec.env.items())
-            remote = f"cd ~/tony-job 2>/dev/null; {env_prefix} {spec.command}"
+            # Strict cd: staging guarantees the job dir; a missing one is a
+            # loud failure, not a task running in $HOME. The staged
+            # framework copy leads PYTHONPATH so `python3 -m
+            # tony_tpu.cluster.executor` resolves without any install.
+            remote = (f"cd {REMOTE_JOB_DIR} && "
+                      f"export PYTHONPATH={REMOTE_JOB_DIR}/{FRAMEWORK_DIR}"
+                      f"${{PYTHONPATH:+:$PYTHONPATH}} && "
+                      f"{env_prefix} {spec.command}")
             cmd = self.ssh_command(job_type, int(idx), remote)
             if self.dry_run:
                 log.info("[dry-run] %s", " ".join(cmd))
@@ -186,16 +241,75 @@ class TpuSliceBackend(SchedulerBackend):
     def _provision(self, job_type: str, spec: LaunchSpec) -> None:
         cmd = self.create_slice_command(job_type, spec.tpu_topology)
         self._slices[job_type] = slice_name(self.app_id, job_type)
+        timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
         if self.dry_run:
             log.info("[dry-run] %s", " ".join(cmd))
+        else:
+            log.info("provisioning slice for %s: %s", job_type, " ".join(cmd))
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=timeout_s)
+            if res.returncode != 0:
+                raise TpuProvisioningError(
+                    f"slice provisioning failed for {job_type}: {res.stderr}")
+        self._stage(job_type, spec, timeout_s)
+
+    # ------------------------------------------------------------------
+    # Staging / localization
+    # ------------------------------------------------------------------
+    def _prepare_stage_artifacts(self, job_dir: str) -> None:
+        """Make the job dir self-sufficient for a bare slice host: add a
+        copy of the tony_tpu package under .tony-framework/ (executors run
+        with PYTHONPATH pointing there — no pip install on hosts, like the
+        reference shipping its own fat jar, ClusterSubmitter.java:37-61),
+        and build the transport tarball. Logs and the per-job auth secret
+        (env-delivered) are excluded."""
+        if self._artifacts_ready:
+            return    # job-scoped, not job-type-scoped: build/upload once
+        self._artifacts_ready = True
+        import tony_tpu
+        pkg_src = os.path.dirname(os.path.abspath(tony_tpu.__file__))
+        fw_dst = os.path.join(job_dir, FRAMEWORK_DIR, "tony_tpu")
+        if not os.path.isdir(fw_dst):
+            shutil.copytree(
+                pkg_src, fw_dst,
+                ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+        exclude = {"logs", ".tony-secret", ".tony-stage.tgz"}
+        remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
+        if remote_staging:
+            # gs:// mode: the client already pushed the job dir; add the
+            # framework so hosts pull ONE complete tree.
+            from tony_tpu.storage import sjoin, storage_for
+            storage_for(remote_staging).put_tree(
+                os.path.join(job_dir, FRAMEWORK_DIR),
+                sjoin(remote_staging, FRAMEWORK_DIR))
             return
-        timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
-        log.info("provisioning slice for %s: %s", job_type, " ".join(cmd))
-        res = subprocess.run(cmd, capture_output=True, text=True,
-                             timeout=timeout_s)
-        if res.returncode != 0:
-            raise TpuProvisioningError(
-                f"slice provisioning failed for {job_type}: {res.stderr}")
+        tarball = os.path.join(job_dir, ".tony-stage.tgz")
+        with tarfile.open(tarball, "w:gz") as tf:
+            for name in sorted(os.listdir(job_dir)):
+                if name in exclude:
+                    continue
+                tf.add(os.path.join(job_dir, name), arcname=name)
+
+    def _stage(self, job_type: str, spec: LaunchSpec,
+               timeout_s: float) -> None:
+        job_dir = spec.cwd
+        if not job_dir:
+            if not self.dry_run:
+                raise TpuProvisioningError(
+                    f"cannot stage {job_type}: launch spec has no job dir")
+            job_dir = "<job-dir>"    # command-plan inspection only
+        if not self.dry_run:
+            self._prepare_stage_artifacts(job_dir)
+        for cmd in self.stage_commands(job_type, job_dir):
+            if self.dry_run:
+                log.info("[dry-run] %s", " ".join(cmd))
+                continue
+            log.info("staging %s: %s", job_type, " ".join(cmd))
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=timeout_s)
+            if res.returncode != 0:
+                raise TpuProvisioningError(
+                    f"staging failed for {job_type}: {res.stderr}")
 
     def _slice_state(self, job_type: str) -> str:
         if self.dry_run:

@@ -225,15 +225,24 @@ class Coordinator:
     def _executor_command(self, user_command: str) -> str:
         """Build the executor launch command (reference: TonySession.
         getTaskCommand:72 builds 'java ... TaskExecutor --am_address ...
-        --task_command ...')."""
-        conf_path = os.path.join(self.job_dir, constants.TONY_FINAL_XML)
+        --task_command ...').
+
+        The conf path is RELATIVE to the task working dir: every backend
+        runs executors with cwd = the (local or remote) job dir, so the
+        same command works on this host and on a staged slice host whose
+        job dir lives somewhere else entirely."""
         addr = f"{socket.gethostname()}:{self.rpc_server.port}"
-        python = (self.conf.get(K.PYTHON_BINARY_PATH_KEY) or sys.executable)
+        # Slice hosts run the TPU VM image's python3, not the submit
+        # host's interpreter path.
+        remote_backend = (self.conf.get(K.SCHEDULER_BACKEND_KEY) or
+                          "local").lower() == "tpu"
+        python = (self.conf.get(K.PYTHON_BINARY_PATH_KEY) or
+                  ("python3" if remote_backend else sys.executable))
         opts = self.conf.get(K.TASK_EXECUTOR_PYTHON_OPTS_KEY) or ""
         return (f"{python} {opts + ' ' if opts else ''}"
                 f"-m tony_tpu.cluster.executor "
                 f"--am_address {addr} "
-                f"--conf_file {shlex.quote(conf_path)} "
+                f"--conf_file {constants.TONY_FINAL_XML} "
                 f"--task_command {shlex.quote(user_command)}")
 
     def _localize_resources(self, request) -> None:

@@ -38,6 +38,29 @@ def parse_memory_string(value: str) -> int:
     return 1 if mib == 0 and amount > 0 else mib
 
 
+# Chips per slice host by TPU generation: v2/v3/v4/v5p boards carry 4 chips
+# per host VM; v5e (v5litepod) and v6e carry 8. A topology's host count is
+# ceil(chips / chips_per_host) — sub-host slices (e.g. v5e 2x2) still get
+# one full host VM.
+_CHIPS_PER_HOST = {"v2": 4, "v3": 4, "v4": 4, "v5p": 4,
+                   "v5litepod": 8, "v5e": 8, "v6e": 8}
+
+
+def tpu_hosts_for(accelerator_type: str, topology: str) -> int | None:
+    """Host-VM count of a slice, or None when it cannot be derived
+    (unknown generation / unparseable topology)."""
+    gen = accelerator_type.split("-")[0].lower()
+    per_host = _CHIPS_PER_HOST.get(gen)
+    if per_host is None or not topology:
+        return None
+    chips = 1
+    for dim in topology.lower().split("x"):
+        if not dim.isdigit():
+            return None
+        chips *= int(dim)
+    return max(1, -(-chips // per_host))
+
+
 @dataclass
 class TaskRequest:
     """Per-job-type resource ask. Analog of TensorFlowContainerRequest
@@ -175,6 +198,24 @@ class TonyConfig:
     def job_types(self) -> list[str]:
         return K.discover_job_types(self._values)
 
+    def _validate_topology(self, jt: str, instances: int,
+                           topology: str) -> None:
+        """Fail at parse time when tony.{job}.instances cannot match the
+        slice's host count: the TPU backend launches exactly one executor
+        per slice host (``ssh --worker=<i>``), so a mismatch would surface
+        much later as an opaque ssh error (the reference's analog is
+        truncating bad resource asks up front, TonyClient.java:145-157)."""
+        accel = self.get(K.TPU_ACCELERATOR_TYPE_KEY) or ""
+        hosts = tpu_hosts_for(accel, topology)
+        if hosts is None:
+            return            # unknown generation or no topology: skip
+        if instances != hosts:
+            raise ValueError(
+                f"tony.{jt}.instances={instances} does not match "
+                f"accelerator {accel!r} topology {topology!r}, which has "
+                f"{hosts} host{'s' if hosts != 1 else ''} (one executor "
+                f"runs per slice host). Set tony.{jt}.instances={hosts}.")
+
     def task_requests(self) -> dict[str, TaskRequest]:
         """Build per-job-type resource asks from config.
 
@@ -192,6 +233,9 @@ class TonyConfig:
                 if "=" in pair:
                     k, _, v = pair.partition("=")
                     env[k] = v
+            topology = self.get(K.tpu_topology_key(jt), "") or ""
+            if topology:
+                self._validate_topology(jt, instances, topology)
             requests[jt] = TaskRequest(
                 job_type=jt,
                 instances=instances,
@@ -200,7 +244,7 @@ class TonyConfig:
                 vcores=self.get_int(K.vcores_key(jt), int(K.JOB_TYPE_DEFAULTS["vcores"])),
                 gpus=self.get_int(K.gpus_key(jt), 0),
                 tpus=self.get_int(K.tpus_key(jt), 0),
-                tpu_topology=self.get(K.tpu_topology_key(jt), "") or "",
+                tpu_topology=topology,
                 resources=self.get(K.resources_key(jt), "") or "",
                 env=env,
                 priority=priority,

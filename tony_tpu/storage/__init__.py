@@ -234,15 +234,29 @@ class _GcsAppendStream(io.TextIOBase):
 class GcsStorage(Storage):
     """``gs://`` via the gsutil CLI (override binary with $TONY_GSUTIL)."""
 
-    def __init__(self, gsutil: str | None = None) -> None:
+    #: every gsutil call is bounded — a network blackhole must never hang
+    #: coordinator teardown or a history-server request forever (override
+    #: with $TONY_GSUTIL_TIMEOUT, seconds)
+    DEFAULT_TIMEOUT_S = 600.0
+
+    def __init__(self, gsutil: str | None = None,
+                 timeout_s: float | None = None) -> None:
         self.gsutil = gsutil or os.environ.get("TONY_GSUTIL") or "gsutil"
+        self.timeout_s = timeout_s if timeout_s is not None else float(
+            os.environ.get("TONY_GSUTIL_TIMEOUT", self.DEFAULT_TIMEOUT_S))
 
     # -- plumbing ----------------------------------------------------------
     def _run(self, *args: str, input_bytes: bytes | None = None,
              ok_codes: tuple[int, ...] = (0,)) -> bytes:
-        proc = subprocess.run(
-            [self.gsutil, "-q", *args], input=input_bytes,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        try:
+            proc = subprocess.run(
+                [self.gsutil, "-q", *args], input=input_bytes,
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                timeout=self.timeout_s)
+        except subprocess.TimeoutExpired as e:
+            raise StorageError(
+                f"{self.gsutil} {' '.join(args)} timed out after "
+                f"{self.timeout_s:.0f}s") from e
         if proc.returncode not in ok_codes:
             raise StorageError(
                 f"{self.gsutil} {' '.join(args)} failed rc={proc.returncode}: "
@@ -250,15 +264,23 @@ class GcsStorage(Storage):
         return proc.stdout
 
     def _try(self, *args: str) -> bool:
-        proc = subprocess.run(
-            [self.gsutil, "-q", *args],
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            proc = subprocess.run(
+                [self.gsutil, "-q", *args],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                timeout=self.timeout_s)
+        except subprocess.TimeoutExpired:
+            return False
         return proc.returncode == 0
 
     def _ls(self, pattern: str) -> list[str]:
-        proc = subprocess.run(
-            [self.gsutil, "-q", "ls", pattern],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        try:
+            proc = subprocess.run(
+                [self.gsutil, "-q", "ls", pattern],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                timeout=self.timeout_s)
+        except subprocess.TimeoutExpired:
+            return []
         if proc.returncode != 0:
             return []
         return [l.strip() for l in proc.stdout.decode().splitlines()
