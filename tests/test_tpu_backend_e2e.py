@@ -150,9 +150,31 @@ class TestTpuBackendE2E:
 
     def test_topology_instances_mismatch_rejected_at_submit(self, tmp_path):
         """VERDICT #6: instances=4 on a v5e 2x2 slice (1 host) must fail
-        at config-parse time with an actionable message, not as a late
-        opaque ssh error."""
+        in the SUBMITTING process with an actionable message — before any
+        coordinator launch, not as a late opaque ssh error."""
         conf = tpu_conf(tmp_path, {"tony.worker.instances": "4",
                                    "tony.worker.tpu.topology": "2x2"})
+        client = TonyClient(conf, "true")
         with pytest.raises(ValueError, match="1 host"):
-            conf.task_requests()
+            client.stage()
+        # nothing was staged or launched
+        assert not os.path.exists(
+            os.path.join(client.job_dir, "tony-final.xml"))
+
+    def test_secret_via_file_never_in_ssh_argv(self, fake_gcloud, tmp_path):
+        """Security on: executors must authenticate (job succeeds) while
+        the secret travels as a chmod-600 staged file — absent from every
+        gcloud argv (visible in ps) and from the stage tarball."""
+        client = TonyClient(
+            tpu_conf(tmp_path,
+                     {"tony.application.security.enabled": "true"}),
+            "true")
+        assert client.run() == 0
+        secret = client.secret
+        assert secret
+        for line in calls(fake_gcloud):
+            assert secret not in line
+        # the scp plan shipped the secret file + chmod'ed it
+        joined = "\n".join(calls(fake_gcloud))
+        assert ".tony-secret" in joined
+        assert "chmod 600 ~/tony-job/.tony-secret" in joined

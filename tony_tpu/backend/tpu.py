@@ -162,21 +162,32 @@ class TpuSliceBackend(SchedulerBackend):
                        job_dir: str) -> list[list[str]]:
         """Command plan localizing the job dir onto every slice host
         (reference: TonyApplicationMaster.java:1090-1104). gs:// pull when
-        the client staged remotely, tarball-over-scp otherwise."""
+        the client staged remotely, tarball-over-scp otherwise. The per-job
+        auth secret travels ONLY as a chmod-600 scp'd file — never in the
+        tarball (user-readable paths), the bucket, or any command argv."""
         remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
         if remote_staging:
             pull = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
                     f"&& gsutil -m rsync -r {shlex.quote(remote_staging)} "
                     f"{REMOTE_JOB_DIR}")
-            return [self.ssh_command(job_type, "all", pull)]
-        tarball = os.path.join(job_dir, ".tony-stage.tgz")
-        unpack = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} && "
-                  f"tar -xzf /tmp/tony-stage.tgz -C {REMOTE_JOB_DIR} && "
-                  f"rm -f /tmp/tony-stage.tgz")
-        return [
-            self.scp_command(job_type, tarball, "/tmp/tony-stage.tgz"),
-            self.ssh_command(job_type, "all", unpack),
-        ]
+            cmds = [self.ssh_command(job_type, "all", pull)]
+        else:
+            tarball = os.path.join(job_dir, ".tony-stage.tgz")
+            unpack = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
+                      f"&& tar -xzf /tmp/tony-stage.tgz -C {REMOTE_JOB_DIR} "
+                      f"&& rm -f /tmp/tony-stage.tgz")
+            cmds = [
+                self.scp_command(job_type, tarball, "/tmp/tony-stage.tgz"),
+                self.ssh_command(job_type, "all", unpack),
+            ]
+        secret_path = os.path.join(job_dir, ".tony-secret")
+        if os.path.exists(secret_path):
+            cmds.append(self.scp_command(
+                job_type, secret_path, f"{REMOTE_JOB_DIR}/.tony-secret"))
+            cmds.append(self.ssh_command(
+                job_type, "all",
+                f"chmod 600 {REMOTE_JOB_DIR}/.tony-secret"))
+        return cmds
 
     def describe_command(self, job_type: str) -> list[str]:
         name = slice_name(self.app_id, job_type)
@@ -220,8 +231,14 @@ class TpuSliceBackend(SchedulerBackend):
                 self._state_ts.pop(job_type, None)
             if job_type not in self._slices:
                 self._provision(job_type, spec)
+            # The auth secret must NOT ride the ssh argv (visible in ps /
+            # /proc); the host reads it from the chmod-600 staged file.
             env_prefix = " ".join(
-                f"{k}={shlex.quote(v)}" for k, v in spec.env.items())
+                f"{k}={shlex.quote(v)}" for k, v in spec.env.items()
+                if k != "TONY_SECRET")
+            secret_src = (
+                f"[ -f {REMOTE_JOB_DIR}/.tony-secret ] && "
+                f"export TONY_SECRET=$(cat {REMOTE_JOB_DIR}/.tony-secret); ")
             # Strict cd: staging guarantees the job dir; a missing one is a
             # loud failure, not a task running in $HOME. The staged
             # framework copy leads PYTHONPATH so `python3 -m
@@ -229,6 +246,7 @@ class TpuSliceBackend(SchedulerBackend):
             remote = (f"cd {REMOTE_JOB_DIR} && "
                       f"export PYTHONPATH={REMOTE_JOB_DIR}/{FRAMEWORK_DIR}"
                       f"${{PYTHONPATH:+:$PYTHONPATH}} && "
+                      f"{secret_src}"
                       f"{env_prefix} {spec.command}")
             cmd = self.ssh_command(job_type, int(idx), remote)
             if self.dry_run:
@@ -265,14 +283,16 @@ class TpuSliceBackend(SchedulerBackend):
         (env-delivered) are excluded."""
         if self._artifacts_ready:
             return    # job-scoped, not job-type-scoped: build/upload once
-        self._artifacts_ready = True
         import tony_tpu
         pkg_src = os.path.dirname(os.path.abspath(tony_tpu.__file__))
         fw_dst = os.path.join(job_dir, FRAMEWORK_DIR, "tony_tpu")
-        if not os.path.isdir(fw_dst):
-            shutil.copytree(
-                pkg_src, fw_dst,
-                ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
+        # A half-written tree from an aborted earlier attempt must not be
+        # shipped as-is: rebuild from scratch.
+        if os.path.isdir(fw_dst):
+            shutil.rmtree(fw_dst)
+        shutil.copytree(
+            pkg_src, fw_dst,
+            ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
         exclude = {"logs", ".tony-secret", ".tony-stage.tgz"}
         remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
         if remote_staging:
@@ -282,6 +302,7 @@ class TpuSliceBackend(SchedulerBackend):
             storage_for(remote_staging).put_tree(
                 os.path.join(job_dir, FRAMEWORK_DIR),
                 sjoin(remote_staging, FRAMEWORK_DIR))
+            self._artifacts_ready = True    # only after the work succeeded
             return
         tarball = os.path.join(job_dir, ".tony-stage.tgz")
         with tarfile.open(tarball, "w:gz") as tf:
@@ -289,6 +310,7 @@ class TpuSliceBackend(SchedulerBackend):
                 if name in exclude:
                     continue
                 tf.add(os.path.join(job_dir, name), arcname=name)
+        self._artifacts_ready = True        # only after the work succeeded
 
     def _stage(self, job_type: str, spec: LaunchSpec,
                timeout_s: float) -> None:

@@ -94,9 +94,15 @@ def main(argv: list[str] | None = None) -> int:
     src_dir = args.src_dir or conf.get(K.SRC_DIR_KEY) or None
     if src_dir and not os.path.isdir(src_dir):
         raise SystemExit(f"src_dir {src_dir} does not exist")
-    client = TonyClient(conf, command, src_dir=src_dir,
-                        shell_env=shell_env, on_tracking_url=on_tracking_url)
-    return client.run()
+    try:
+        client = TonyClient(conf, command, src_dir=src_dir,
+                            shell_env=shell_env,
+                            on_tracking_url=on_tracking_url)
+        return client.run()
+    except ValueError as e:
+        # Config validation failures (bad resource asks, topology vs
+        # instances) are user errors: one actionable line, no traceback.
+        raise SystemExit(f"tony: {e}")
 
 
 def kill_job(job_dir: str) -> int:

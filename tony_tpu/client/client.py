@@ -98,6 +98,11 @@ class TonyClient:
     # ------------------------------------------------------------------
     def stage(self) -> None:
         """Create the job dir and localize sources (reference :163-192)."""
+        # Fail fast in THIS process on malformed resource asks (e.g.
+        # instances vs slice-topology host count) — the actionable message
+        # must reach the submitting user, not die in coordinator stderr
+        # (the reference's early ask-truncation, TonyClient.java:145-157).
+        self.conf.task_requests()
         os.makedirs(self.job_dir, exist_ok=True)
         os.makedirs(os.path.join(self.job_dir, constants.TONY_LOG_DIR),
                     exist_ok=True)

@@ -101,7 +101,7 @@ class CoordinatorRpc(ApplicationRpc):
         # (reference: RpcForClient.registerExecutionResult + container
         # completion both feed onTaskCompleted).
         self.co.record_completion(
-            job_name, job_index, exit_code,
+            job_name, job_index, exit_code, via_rpc=True,
             session_id=int(session_id) if session_id else None)
         return "RECEIVED"
 
@@ -326,7 +326,8 @@ class Coordinator:
     # ------------------------------------------------------------------
     def record_completion(self, job_type: str, index: int | str,
                           exit_code: int, preempted: bool = False,
-                          session_id: int | None = None) -> None:
+                          session_id: int | None = None,
+                          via_rpc: bool = False) -> None:
         """Single funnel for task completion from BOTH sources — the
         executor's RPC result and the backend's process-exit observation —
         so state transition and the TASK_FINISHED event happen exactly once
@@ -341,7 +342,8 @@ class Coordinator:
                 return
             already_done = task.completed
             self.session.on_task_completed(job_type, index, exit_code,
-                                           session_id=session_id)
+                                           session_id=session_id,
+                                           via_rpc=via_rpc)
             if not already_done and task.completed:
                 if task.exit_code != 0 and self.session.is_tracked(job_type):
                     if preempted:
@@ -676,6 +678,9 @@ class Coordinator:
         self.events.emit(
             ev.APPLICATION_FINISHED, app_id=self.app_id,
             status=self.final_status,
+            # triage cause in the history UI (e.g. "lost contact with the
+            # coordinator" vs a user-code exit)
+            message=self.failure_message or "",
             failed_tasks=[t.task_id for t in self.session.all_tasks()
                           if t.status is TaskStatus.FAILED],
             metrics=self._combined_uptime_metrics())

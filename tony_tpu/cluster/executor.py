@@ -95,8 +95,9 @@ class Heartbeater(threading.Thread):
                 log.warning("heartbeat send failure %d/%d", self._failures,
                             self.MAX_CONSECUTIVE_FAILURES)
                 if self._failures >= self.MAX_CONSECUTIVE_FAILURES:
-                    log.error("too many heartbeat failures — exiting")
-                    os._exit(constants.EXIT_FAILURE & 0xFF)
+                    log.error("too many heartbeat failures — lost the "
+                              "coordinator, exiting")
+                    os._exit(constants.EXIT_LOST_COORDINATOR)
 
 
 class TaskExecutor:

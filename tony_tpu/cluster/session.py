@@ -212,11 +212,17 @@ class Session:
     # Completion reduction
     # ------------------------------------------------------------------
     def on_task_completed(self, job_type: str, index: int | str,
-                          exit_code: int, session_id: int | None = None) -> None:
+                          exit_code: int, session_id: int | None = None,
+                          via_rpc: bool = False) -> None:
         """Record a task exit. Mirrors TonySession.onTaskCompleted:252-276:
         - events from a stale session (previous attempt) are ignored
         - first failure of a *tracked* task fails the whole session
         - chief completion short-circuits the session with the chief's status
+
+        ``via_rpc`` disambiguates the lost-coordinator exit code: a result
+        DELIVERED over RPC proves executor->coordinator connectivity, so
+        exit 75 from a user process that happens to use EX_TEMPFAIL is not
+        mislabeled as a heartbeat loss.
         """
         with self._lock:
             if session_id is not None and session_id != self.session_id:
@@ -232,8 +238,19 @@ class Session:
             task.completed_at = time.monotonic()
             if exit_code != 0 and self.is_tracked(job_type):
                 self.status = SessionStatus.FAILED
-                self.failure_message = (
-                    f"task {task.task_id} failed with exit code {exit_code}")
+                if (exit_code == constants.EXIT_LOST_COORDINATOR
+                        and not via_rpc):
+                    # Distinct triage cause: the executor suicided because
+                    # heartbeat sends kept failing — infrastructure between
+                    # host and coordinator, not the user's training code.
+                    self.failure_message = (
+                        f"task {task.task_id} lost contact with the "
+                        f"coordinator (heartbeat send failures; exit code "
+                        f"{exit_code})")
+                else:
+                    self.failure_message = (
+                        f"task {task.task_id} failed with exit code "
+                        f"{exit_code}")
             if self.is_chief(job_type, index):
                 # Chief done ⇒ job done, with the chief's status
                 # (reference :266-271).

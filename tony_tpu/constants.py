@@ -115,6 +115,12 @@ TEST_PREEMPT_SLICE = "TEST_PREEMPT_SLICE"                    # TPU-only: simulat
 # ---------------------------------------------------------------------------
 EXIT_SUCCESS = 0
 EXIT_FAILURE = -1
+# Executor suicide after sustained heartbeat-send failures (75 = BSD
+# EX_TEMPFAIL; the reference loses this by exiting -1, TaskExecutor.java:
+# 264-268). A user process could also exit 75, so triage additionally
+# checks delivery channel: a result that ARRIVED over RPC proves
+# executor->coordinator connectivity and is never labeled a loss.
+EXIT_LOST_COORDINATOR = 75
 COORDINATOR_RPC_PORT_RANGE = (10000, 15000)  # ApplicationRpcServer.java:36
 
 # Framework adapters (MLFramework enum, TonyConfigurationKeys.java:8-11,

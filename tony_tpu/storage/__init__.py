@@ -264,23 +264,31 @@ class GcsStorage(Storage):
         return proc.stdout
 
     def _try(self, *args: str) -> bool:
+        """False means the probed object genuinely is not there; a timeout
+        is a backend failure and raises — silently reading a blackhole as
+        'does not exist' could make callers overwrite live data."""
         try:
             proc = subprocess.run(
                 [self.gsutil, "-q", *args],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
                 timeout=self.timeout_s)
-        except subprocess.TimeoutExpired:
-            return False
+        except subprocess.TimeoutExpired as e:
+            raise StorageError(
+                f"{self.gsutil} {' '.join(args)} timed out after "
+                f"{self.timeout_s:.0f}s") from e
         return proc.returncode == 0
 
     def _ls(self, pattern: str) -> list[str]:
+        """[] means nothing matches; a timeout raises (see _try)."""
         try:
             proc = subprocess.run(
                 [self.gsutil, "-q", "ls", pattern],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
                 timeout=self.timeout_s)
-        except subprocess.TimeoutExpired:
-            return []
+        except subprocess.TimeoutExpired as e:
+            raise StorageError(
+                f"{self.gsutil} ls {pattern} timed out after "
+                f"{self.timeout_s:.0f}s") from e
         if proc.returncode != 0:
             return []
         return [l.strip() for l in proc.stdout.decode().splitlines()
