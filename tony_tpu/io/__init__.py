@@ -5,8 +5,12 @@ src/main/java/com/linkedin/tony/io/HdfsAvroFileSplitReader.java, reached from
 Python over py4j per TaskExecutor.java:281). Components:
 
   split      — global contiguous byte-range split math (reference :286-297)
+  framed     — TONY1 self-describing splittable record format: schema
+               header + sync-marked blocks (the Avro container analog,
+               reference :242 block sync, :446 schema channel)
   reader     — FileSplitReader: C++ prefetch/shuffle engine via ctypes
-               (native/datafeed.cc) with a pure-Python fallback
+               (native/datafeed.cc) with a pure-Python fallback; byte,
+               ndarray, and local-spill delivery modes
   jax_feed   — decode to ndarray + assemble global sharded jax.Arrays via
                jax.make_array_from_process_local_data
 """
@@ -14,6 +18,9 @@ Python over py4j per TaskExecutor.java:281). Components:
 from tony_tpu.io.split import (FileSegment, compute_read_info,
                                full_records_in_split, split_length,
                                split_start)
+from tony_tpu.io.framed import (FramedFormatError, FramedWriter,
+                                is_framed_file, iter_file_records,
+                                read_path_header)
 from tony_tpu.io.reader import DataFeedError, FileSplitReader
 from tony_tpu.io.jax_feed import (array_batches, global_batches,
                                   record_size_for, records_to_array,
@@ -22,6 +29,8 @@ from tony_tpu.io.jax_feed import (array_batches, global_batches,
 __all__ = [
     "FileSegment", "compute_read_info", "full_records_in_split",
     "split_start", "split_length",
+    "FramedWriter", "FramedFormatError", "is_framed_file",
+    "iter_file_records", "read_path_header",
     "FileSplitReader", "DataFeedError",
     "array_batches", "global_batches", "record_size_for", "records_to_array",
     "to_global_array",

@@ -16,6 +16,11 @@
 //                      offset is mid-record syncs forward past the next '\n'
 //                      (the straddling record belongs to the previous split,
 //                      which reads past its end to finish it).
+//   record_size == -1 — TONY1 framed blocks (self-describing, variable-
+//                      length; see tony_tpu/io/framed.py): the file header
+//                      carries a 16-byte sync marker and a JSON schema; a
+//                      block belongs to the split where its sync STARTS
+//                      (the Avro block-sync convention, reference :242).
 //
 // Concurrency: one producer thread fills a bounded pool; consumers pop under
 // a mutex. In shuffle mode the pop picks a uniformly random pool slot
@@ -155,9 +160,121 @@ class Reader {
       Fail("cannot open " + seg.path);
       return false;
     }
-    bool ok = record_size_ > 0 ? ProduceFixed(seg, f) : ProduceLines(seg, f);
+    bool ok = record_size_ > 0   ? ProduceFixed(seg, f)
+              : record_size_ == 0 ? ProduceLines(seg, f)
+                                  : ProduceFramed(seg, f);
     std::fclose(f);
     return ok;
+  }
+
+  // --- TONY1 framed blocks (framed.py is the format's reference impl) ----
+  static constexpr int64_t kSyncLen = 16;
+  static constexpr uint32_t kMaxBlockRecords = 1u << 24;
+  static constexpr uint32_t kMaxBlockBytes = 1u << 30;
+
+  static uint32_t ReadU32(const unsigned char* p) {
+    return static_cast<uint32_t>(p[0]) | (static_cast<uint32_t>(p[1]) << 8) |
+           (static_cast<uint32_t>(p[2]) << 16) |
+           (static_cast<uint32_t>(p[3]) << 24);
+  }
+
+  // First sync position >= start and < limit, or -1.
+  static int64_t FindSync(FILE* f, const std::string& sync, int64_t start,
+                          int64_t limit) {
+    if (std::fseek(f, start, SEEK_SET) != 0) return -1;
+    std::string buf;
+    int64_t base = start;  // file position of buf[0]
+    char chunk[1 << 16];
+    while (base < limit) {
+      size_t got = std::fread(chunk, 1, sizeof(chunk), f);
+      if (got == 0) return -1;
+      buf.append(chunk, got);
+      size_t idx = buf.find(sync);
+      if (idx != std::string::npos) {
+        int64_t found = base + static_cast<int64_t>(idx);
+        return found < limit ? found : -1;
+      }
+      size_t keep = sync.size() - 1;
+      if (buf.size() > keep) {
+        base += static_cast<int64_t>(buf.size() - keep);
+        buf.erase(0, buf.size() - keep);
+      }
+    }
+    return -1;
+  }
+
+  bool ProduceFramed(const Segment& seg, FILE* f) {
+    // header: magic(6) + sync(16) + schema_len(4) + schema
+    unsigned char head[6 + kSyncLen + 4];
+    if (std::fread(head, 1, sizeof(head), f) != sizeof(head) ||
+        std::memcmp(head, "TONY1\0", 6) != 0) {
+      Fail("not a TONY1 framed file: " + seg.path);
+      return false;
+    }
+    std::string sync(reinterpret_cast<char*>(head + 6), kSyncLen);
+    uint32_t schema_len = ReadU32(head + 6 + kSyncLen);
+    int64_t data_start = static_cast<int64_t>(sizeof(head)) + schema_len;
+    // A corrupt schema_len must fail loudly (framed.py raises 'truncated
+    // schema header'), not silently report an empty split.
+    if (std::fseek(f, 0, SEEK_END) != 0) {
+      Fail("seek failed in " + seg.path);
+      return false;
+    }
+    int64_t file_size = std::ftell(f);
+    if (data_start > file_size) {
+      Fail("truncated schema header in " + seg.path);
+      return false;
+    }
+    int64_t end = seg.offset + seg.length;
+    int64_t pos = seg.offset > data_start ? seg.offset : data_start;
+    if (pos >= end) return true;
+    pos = FindSync(f, sync, pos, end);
+    std::vector<char> payload;
+    while (pos != -1 && pos < end) {
+      if (std::fseek(f, pos, SEEK_SET) != 0) {
+        Fail("seek failed in " + seg.path);
+        return false;
+      }
+      unsigned char bh[kSyncLen + 8];
+      size_t got = std::fread(bh, 1, sizeof(bh), f);
+      if (got == 0) break;  // clean EOF after the previous block
+      if (got != sizeof(bh) ||
+          std::memcmp(bh, sync.data(), kSyncLen) != 0) {
+        Fail("corrupt block header in " + seg.path);
+        return false;
+      }
+      uint32_t count = ReadU32(bh + kSyncLen);
+      uint32_t size = ReadU32(bh + kSyncLen + 4);
+      if (count > kMaxBlockRecords || size > kMaxBlockBytes) {
+        Fail("implausible block in " + seg.path);
+        return false;
+      }
+      payload.resize(size);
+      if (size > 0 && std::fread(payload.data(), 1, size, f) != size) {
+        Fail("truncated block in " + seg.path);
+        return false;
+      }
+      size_t p = 0;
+      for (uint32_t i = 0; i < count; ++i) {
+        if (p + 4 > size) {
+          Fail("corrupt block payload in " + seg.path);
+          return false;
+        }
+        uint32_t rlen =
+            ReadU32(reinterpret_cast<unsigned char*>(payload.data()) + p);
+        p += 4;
+        if (p + rlen > size) {
+          Fail("corrupt record length in " + seg.path);
+          return false;
+        }
+        Record rec;
+        rec.data.assign(payload.data() + p, payload.data() + p + rlen);
+        p += rlen;
+        if (!Push(std::move(rec))) return false;
+      }
+      pos += static_cast<int64_t>(sizeof(bh)) + size;  // blocks back-to-back
+    }
+    return true;
   }
 
   bool ProduceFixed(const Segment& seg, FILE* f) {

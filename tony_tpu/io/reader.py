@@ -11,14 +11,22 @@ Semantics kept from the reference:
   * contiguous global byte-range split across tasks
     (``split.compute_read_info``, reference :286-297)
   * record-boundary sync at split starts (reference :242 Avro block sync;
-    here fixed-size or newline framing)
+    here fixed-size, newline, or TONY1 framed-block framing)
+  * a schema channel: framed files carry a JSON schema in their header,
+    surfaced via :meth:`FileSplitReader.schema_json` (the analog of
+    ``getSchemaJson`` :446)
   * bounded prefetch buffer, optionally shuffling — a streaming shuffle
     whose window is the buffer capacity (reference InternalBuffer :678)
+  * three delivery modes: packed byte batches (``next_batch``), ndarray
+    batches (jax_feed), and local-disk spill for batches larger than
+    memory (``next_batch_spill``, the analog of
+    ``nextBatchFileLocalSpill`` :525)
 
 Usage::
 
     reader = FileSplitReader(paths, task_index=i, task_num=n,
-                             record_size=rs, shuffle=True, seed=epoch)
+                             shuffle=True, seed=epoch)   # framing auto
+    print(reader.schema_json)   # "" unless the files are TONY1 framed
     for rec in reader:          # bytes objects
         ...
     reader.close()
@@ -33,6 +41,7 @@ import random
 import weakref
 from typing import Iterator
 
+from tony_tpu.io import framed as _framed
 from tony_tpu.io.split import FileSegment, compute_read_info
 from tony_tpu.io.native.build import load_native
 
@@ -122,6 +131,10 @@ class _PythonImpl:
     def _generate(segments: list[FileSegment],
                   record_size: int) -> Iterator[bytes]:
         for seg in segments:
+            if record_size == -1:           # TONY1 framed blocks
+                yield from _framed.iter_segment_records(
+                    seg.path, seg.offset, seg.length)
+                continue
             with open(seg.path, "rb") as f:
                 if record_size > 0:
                     first = -(-seg.offset // record_size)
@@ -191,15 +204,44 @@ class FileSplitReader:
     """
 
     def __init__(self, paths: list[str], task_index: int = 0,
-                 task_num: int = 1, record_size: int = 0,
+                 task_num: int = 1, record_size: int | None = None,
                  shuffle: bool = False, seed: int = 0,
                  capacity: int = _DEFAULT_CAPACITY,
                  use_native: bool | None = None,
                  sizes: list[int] | None = None) -> None:
-        if record_size < 0:
-            raise ValueError("record_size must be >= 0")
+        #: schema channel (reference getSchemaJson:446): the JSON schema
+        #: from the first framed file's header, "" for unframed data.
+        self.schema_json = ""
+        header0 = None
+        if paths and record_size in (None, -1):
+            try:
+                header0 = _framed.read_path_header(paths[0])
+            except _framed.FramedFormatError:
+                if record_size == -1:
+                    raise
+        # record_size None = auto: TONY1 framed when the files carry the
+        # magic, newline-delimited otherwise. -1 forces framed. A MIXED
+        # list under auto is rejected — parsing a framed file as lines
+        # would silently yield garbage records.
+        if record_size is None:
+            flags = [header0 is not None] + [
+                _framed.is_framed_file(p) for p in paths[1:]]
+            if any(flags) and not all(flags):
+                mixed = [p for p, fr in zip(paths, flags) if not fr]
+                raise ValueError(
+                    f"mixed framings: {mixed[0]} is not TONY1 framed but "
+                    f"other inputs are; pass record_size explicitly")
+            record_size = -1 if paths and flags[0] else 0
+        if record_size < -1:
+            raise ValueError("record_size must be -1 (framed), 0 (lines), "
+                             "or a positive fixed size")
+        self.record_size = record_size
+        if header0 is not None and record_size == -1:
+            self.schema_json = header0.schema_json
         self.segments = compute_read_info(paths, task_index, task_num,
                                           sizes=sizes)
+        #: records pulled past a spill-call budget, served before new pulls
+        self._spill_carry: list[bytes] = []
         lib = load_native() if use_native in (None, True) else None
         if use_native is True and lib is None:
             raise DataFeedError("native data-feed requested but unavailable")
@@ -212,10 +254,59 @@ class FileSplitReader:
                                      shuffle, seed)
             self.is_native = False
 
+    def schema(self) -> dict:
+        """Parsed schema from the framed-file header ({} when absent)."""
+        import json
+        return json.loads(self.schema_json) if self.schema_json else {}
+
     def next_batch(self, max_records: int = 256) -> list[bytes]:
         """Up to ``max_records`` records; [] at end of split (the analog of
         the reference's nextBatchBytes :598)."""
+        if self._spill_carry:
+            # records pulled past a spill-call budget are served first so
+            # mixing delivery modes never skips data
+            out = self._spill_carry[:max_records]
+            self._spill_carry = self._spill_carry[max_records:]
+            return out
         return self._impl.next_batch(max_records)
+
+    def next_batch_spill(self, spill_dir: str, max_records: int = 1 << 62,
+                         max_bytes: int = 1 << 62) -> str | None:
+        """Local-spill delivery (reference nextBatchFileLocalSpill:525):
+        stream up to ``max_records``/``max_bytes`` of records into a TONY1
+        framed file under ``spill_dir`` and return its path — for batches
+        too large to hold in memory. Returns None at end of split. Read
+        back with :func:`tony_tpu.io.framed.iter_file_records`; the
+        caller owns deletion."""
+        import os
+        import uuid
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir, f"spill-{uuid.uuid4().hex}.tony1")
+        wrote = 0
+        # Records pulled past a previous call's budget carry over — a pull
+        # batch must never be dropped on the floor at a budget boundary.
+        carry = self._spill_carry
+        with _framed.FramedWriter(path, schema=self.schema_json or {}) as w:
+            # budget applies only once a record is in: a header larger than
+            # max_bytes must not masquerade as end-of-split (None)
+            while wrote < max_records and (wrote == 0
+                                           or w.total_bytes < max_bytes):
+                batch = carry or self._impl.next_batch(
+                    min(256, max_records - wrote))
+                carry = []
+                if not batch:
+                    break
+                for i, rec in enumerate(batch):
+                    w.append(rec)
+                    wrote += 1
+                    if wrote >= max_records or w.total_bytes >= max_bytes:
+                        carry = batch[i + 1:]
+                        break
+        self._spill_carry = carry
+        if wrote == 0:
+            os.remove(path)
+            return None
+        return path
 
     def __iter__(self) -> Iterator[bytes]:
         while True:
