@@ -439,6 +439,30 @@ def test_framed_mixed_inputs_rejected(tmp_path):
         FileSplitReader([path, str(plain)])
 
 
+def test_framed_missing_path_raises_file_not_found(tmp_path):
+    """A typo'd path must surface as the OS error, not be misdiagnosed as a
+    framing mismatch by auto-detection."""
+    path = _write_framed(tmp_path, "ok.tony1", [b"x"])
+    with pytest.raises(FileNotFoundError):
+        FileSplitReader([path, str(tmp_path / "nope.tony1")])
+
+
+def test_framed_truncated_trailing_sync_raises_both_engines(tmp_path):
+    """Engine parity: a writer that died mid-sync-marker (1..15 trailing
+    bytes) raises in BOTH engines instead of silently ending the split."""
+    from tony_tpu.io.framed import FramedFormatError
+    from tony_tpu.io.native.build import load_native
+    path = _write_framed(tmp_path, "t.tony1", [b"A" * 10, b"B" * 10],
+                         block_bytes=1 << 20)
+    with open(path, "ab") as f:
+        f.write(b"\x01\x02\x03\x04\x05")     # 5-byte torn marker
+    with pytest.raises(FramedFormatError):
+        list(FileSplitReader([path], use_native=False))
+    if load_native() is not None:
+        with pytest.raises(Exception):
+            list(FileSplitReader([path], use_native=True))
+
+
 def test_spill_header_larger_than_budget_still_progresses(tmp_path):
     """A schema header bigger than max_bytes must not fake end-of-split:
     every call delivers at least one record until truly drained."""

@@ -33,6 +33,47 @@ class TestFlashAttention:
         for got, want in zip(g, gr):
             np.testing.assert_allclose(got, want, atol=5e-5)
 
+    def test_gradients_two_pass_long_seq(self):
+        # seq/block_q = 8 > _FUSED_MAX_NQ routes through the two-pass
+        # dq/dkv kernels (the long-sequence fallback); keep them covered.
+        r = np.random.RandomState(2)
+        q, k, v = (jnp.asarray(r.randn(1, 256, 2, 32), jnp.float32)
+                   for _ in range(3))
+        g = jax.grad(lambda *a: flash_attention(
+            *a, block_q=32, block_k=32).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(*a).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_gradients_bfloat16_within_tolerance(self, qkv):
+        # the fused backward stores per-q-block dK/dV partials at input
+        # precision (see _flash_backward_fused) — bf16 grads must stay
+        # within bf16 rounding of the f32 dense oracle
+        q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+        g = jax.grad(lambda *a: flash_attention(
+            *a, block_q=32, block_k=32).astype(jnp.float32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(*a).sum(),
+                      argnums=(0, 1, 2))(*qkv)
+        for got, want in zip(g, gr):
+            scale = float(jnp.abs(want).max())
+            np.testing.assert_allclose(got.astype(jnp.float32), want,
+                                       atol=0.02 * scale)
+
+    def test_unpadded_head_count(self, qkv):
+        # batch·heads = 4 (not a multiple of 8): exercises the zero-head
+        # padding path
+        q, k, v = (x[:1] for x in qkv)   # [1, 64, 2, 32] → bh = 2
+        o = flash_attention(q, k, v, block_q=32, block_k=32)
+        np.testing.assert_allclose(
+            o, reference_attention(q, k, v), atol=2e-5)
+        g = jax.grad(lambda *a: flash_attention(
+            *a, block_q=32, block_k=32).sum(), argnums=(0,))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(*a).sum(),
+                      argnums=(0,))(q, k, v)
+        np.testing.assert_allclose(g[0], gr[0], atol=5e-5)
+
     def test_block_clamping_to_short_seq(self, qkv):
         q, k, v = qkv      # seq 64 < default blocks: must clamp, not raise
         o = flash_attention(q, k, v)

@@ -63,11 +63,11 @@ class FileHeader:
 
 
 def is_framed_file(path: str) -> bool:
-    try:
-        with open(path, "rb") as f:
-            return f.read(len(MAGIC)) == MAGIC
-    except OSError:
-        return False
+    """True when ``path`` starts with the TONY1 magic. A missing/unreadable
+    file raises OSError — swallowing it here would misreport a typo'd path
+    as "not framed" and send callers down a framing-mismatch rabbit hole."""
+    with open(path, "rb") as f:
+        return f.read(len(MAGIC)) == MAGIC
 
 
 def read_header(f: BinaryIO) -> FileHeader:
@@ -221,8 +221,13 @@ def iter_segment_records(path: str, offset: int,
                 break      # bytes past the split end belong to a later split
             # within our split, the next marker must start exactly here
             probe = f.read(SYNC_LEN)
+            if not probe:
+                break              # clean EOF after the previous block
             if len(probe) < SYNC_LEN:
-                break
+                # a 1..15-byte tail is a writer that died mid-marker (or
+                # mid-block) — fail loudly, exactly like the native engine
+                raise FramedFormatError(
+                    f"truncated sync marker at {path}:{pos}")
             if probe != header.sync:
                 raise FramedFormatError(
                     f"lost sync after block at {path}:{pos}")

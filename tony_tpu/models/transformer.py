@@ -254,6 +254,24 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     return logits, auxes.sum()
 
 
+def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
+    """Model FLOPs per trained token: forward matmul FLOPs × 3 (backward ≈ 2×
+    forward for matmul-dominated graphs). Counts *model* FLOPs only — remat
+    recompute is excluded, so this yields MFU (not HFU) when divided by
+    wall-clock achieved FLOPs. Attention is counted causal (half of the full
+    S² score/value matmuls), matching what the flash kernel actually executes.
+    """
+    d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
+    proj = 8 * d * d                      # wq + wk + wv + wo
+    attn = 2 * seq * d                    # QK^T + AV, causal half of 4·S·d
+    if cfg.num_experts:
+        mlp = 2 * d * cfg.num_experts + cfg.moe_top_k * 4 * d * f
+    else:
+        mlp = 6 * d * f                   # gate + up + down
+    fwd = L * (proj + attn + mlp) + 2 * d * v   # + lm_head
+    return 3.0 * fwd
+
+
 def lm_loss(params: dict, batch: dict, cfg: TransformerConfig,
             mesh: Mesh | None = None, rules=DEFAULT_RULES) -> jax.Array:
     """Next-token cross-entropy. batch: {"tokens": [B, S]} (shift inside) or

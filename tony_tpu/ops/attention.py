@@ -8,16 +8,34 @@ materialize in HBM, the online softmax keeps f32 running max/sum in VMEM
 scratch across the innermost (kv) grid dimension, and the MXU sees only
 [block_q, d] × [d, block_k] matmuls with ``preferred_element_type=f32``.
 
-Backward is the standard two-kernel split (recompute, no O(S²) residuals):
-one pass gridded over q-blocks accumulating dQ, one over kv-blocks
-accumulating dK/dV, both reusing the forward's logsumexp and the
-delta = rowsum(dO·O) precomputation. Wired together with ``jax.custom_vjp``.
+Two measured-on-v5e refinements over the textbook kernel (the per-grid-step
+cost on this hardware is ~2-4µs, so step count matters as much as FLOPs):
+
+- **Head grouping** (``block_h``): each grid step processes ``block_h``
+  batch-heads (an in-kernel unrolled loop of 2-D matmuls), cutting the grid
+  from ``b·h × nq × nk`` to ``b·h/block_h × nq × nk`` steps. At LM shapes
+  (head_dim 64, seq 1k) the per-head blocks are far below MXU-saturating
+  sizes, so amortizing the fixed step cost dominates.
+- **Shared causal mask**: the block's position mask is an iota+compare
+  computed once per grid step and reused by every head in the group, and
+  kv-blocks entirely above the diagonal are skipped, so the VPU cost of
+  masking amortizes to ~1 op/element instead of ~4.
+
+Backward recomputes scores (no O(S²) residuals) in a single fused pass by
+default: dQ accumulates in VMEM over the kv grid dimension while per-q-block
+dK/dV partials ([nq, b·h, S, D] f32) are reduced by XLA outside — one
+score/exp recompute instead of the classic two-pass split's two, which is
+what matters in this VPU-bound regime. Long sequences (nq > _FUSED_MAX_NQ,
+where the partials' HBM footprint scales with nq) fall back to the two-pass
+split: one pass gridded over q-blocks accumulating dQ, one over kv-blocks
+accumulating dK/dV. Wired together with ``jax.custom_vjp``.
 
 On non-TPU backends (the 8-device CPU test mesh) the same kernels run in
 Pallas interpret mode — bit-accurate, slow — or callers use
-:func:`reference_attention`. Layouts are [batch, heads, seq, head_dim]
-(attention-major), the layout :mod:`tony_tpu.parallel.ring_attention` chunks
-over ``cp``; this kernel is the intra-chunk compute.
+:func:`reference_attention`. Layouts are [batch, seq, heads, head_dim] at
+the API, [batch·heads, seq, head_dim] inside; the layout
+:mod:`tony_tpu.parallel.ring_attention` chunks over ``cp`` — this kernel is
+the intra-chunk compute.
 """
 
 from __future__ import annotations
@@ -42,44 +60,75 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _pick_group(bh: int, block_h: int) -> int:
+    """Heads-per-grid-step. Must divide batch·heads, and — because the 2-D
+    [g, bq] lse blocks hit Mosaic's (8, 128)-divisibility rule on the
+    second-minor dim — must be a multiple of 8. Callers pad bh to a
+    multiple of 8 first (:func:`flash_attention`), so a multiple-of-8
+    divisor always exists."""
+    best = 8
+    for g in range(8, bh + 1, 8):
+        if bh % g == 0 and g <= max(block_h, 8):
+            best = g
+    return best
+
+
+def _causal_mask(qi, ki, bq: int, bk: int):
+    """[bq, bk] bool mask for the (qi, ki) block — computed once per grid
+    step and shared by all heads in the group."""
+    qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    return qpos >= kpos
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, bq: int, bk: int, nk: int):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
+                *, scale: float, causal: bool, g: int, bq: int, bk: int,
+                nk: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
+    # ml_scr packs the running max (lane 0) and running sum (lane 1) into
+    # one [g, bq, _LANES] buffer — each lives in its own 128-lane tile
+    # anyway, so separate buffers would double the VMEM footprint.
 
     @pl.when(ki == 0)
     def _init():
-        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
-        l_scr[:] = jnp.zeros_like(l_scr)
-        acc_scr[:] = jnp.zeros_like(acc_scr)
+        ml_scr[:] = jnp.full_like(ml_scr, _NEG_INF)
 
     def _accumulate():
-        q = q_ref[0]                                   # [bq, d]
-        k = k_ref[0]                                   # [bk, d]
-        v = v_ref[0]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        m_prev = m_scr[:, :1]                          # [bq, 1]
-        m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new)                         # [bq, bk]
-        corr = jnp.exp(m_prev - m_new)                 # [bq, 1]
-        l_new = l_scr[:, :1] * corr + p.sum(axis=-1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * corr + jax.lax.dot(
-            p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
-        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+        for gi in range(g):
+            q = q_ref[gi]                              # [bq, d]
+            k = k_ref[gi]                              # [bk, d]
+            v = v_ref[gi]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            if causal:
+                s = jnp.where(mask, s, _NEG_INF)
+            m_prev = ml_scr[gi, :, 0:1]                # [bq, 1]
+            l_prev = ml_scr[gi, :, 1:2]
+            first = m_prev <= _NEG_INF                 # nothing seen yet
+            m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)                     # [bq, bk]
+            corr = jnp.where(first, 0.0, jnp.exp(m_prev - m_new))  # [bq, 1]
+            l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
+            if nk == 1 and not (causal and bq < bk):
+                # single kv block: the accumulator rescale is dead code
+                acc_scr[gi] = jax.lax.dot(
+                    p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            else:
+                acc = jnp.where(first, 0.0, acc_scr[gi])
+                acc_scr[gi] = acc * corr + jax.lax.dot(
+                    p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+            ml_scr[gi, :, 0:1] = m_new
+            ml_scr[gi, :, 1:2] = l_new
 
     if causal:
-        # skip fully-masked kv blocks (everything strictly above the diag)
+        # skip kv blocks entirely above the diagonal
         @pl.when((qi + 1) * bq > ki * bk)
         def _():
             _accumulate()
@@ -88,53 +137,184 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        l = l_scr[:, :1]
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
-        lse_ref[0] = jnp.broadcast_to(
-            m_scr[:, :1] + jnp.log(jnp.maximum(l, 1e-30)), lse_ref.shape[1:])
+        for gi in range(g):
+            m = ml_scr[gi, :, 0:1]
+            l = ml_scr[gi, :, 1:2]
+            o_ref[gi] = (acc_scr[gi] / jnp.maximum(l, 1e-30)).astype(
+                o_ref.dtype)
+            lse_ref[gi] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_forward(q, k, v, *, scale, causal, bq, bk):
+def _flash_forward(q, k, v, *, scale, causal, g, bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nk=nk)
+                               g=g, bq=bq, bk=bk, nk=nk)
     o, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
+        grid=(bh // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, sq, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((bh, sq), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bq, _LANES), jnp.float32),   # running max
-            pltpu.VMEM((bq, _LANES), jnp.float32),   # running sum
-            pltpu.VMEM((bq, d), jnp.float32),        # output accumulator
+            pltpu.VMEM((g, bq, _LANES), jnp.float32),   # max (l0) + sum (l1)
+            pltpu.VMEM((g, bq, d), jnp.float32),        # output accumulator
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
-    return o, lse[:, :, 0]
+    return o, lse
 
 
 # ---------------------------------------------------------------------------
-# Backward: dQ pass (grid over q blocks, inner loop over kv blocks)
+# Backward, fused single pass (default): grid (bh/g, nq, nk). dQ accumulates
+# in VMEM scratch over the inner kv dimension; the dK/dV contribution of each
+# (q-block, kv-block) pair is written to per-q-block partial outputs
+# [nq, bh, sk, d] and reduced by XLA outside. This recomputes scores/exp ONCE
+# per backward instead of twice (the classic two-pass split), which matters
+# because the kernel is VPU-bound (softmax ops, not MXU FLOPs, set the
+# wall-clock at LM head dims). delta = rowsum(dO·O) is computed in-kernel
+# from the resident dO/O blocks, so no [.., _LANES] broadcasts ever touch
+# HBM. Partial dK/dV memory is nq × the tensor size, so long sequences
+# (nq > _FUSED_MAX_NQ) fall back to the two-pass kernels below.
+# ---------------------------------------------------------------------------
+
+_FUSED_MAX_NQ = 4
+
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
+                      dq_ref, dkp_ref, dvp_ref, dq_scr, *, scale: float,
+                      causal: bool, g: int, bq: int, bk: int, nk: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    work = ((qi + 1) * bq > ki * bk) if causal else None
+
+    def _accumulate():
+        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+        for gi in range(g):
+            q = q_ref[gi]                               # [bq, d]
+            k = k_ref[gi]                               # [bk, d]
+            v = v_ref[gi]
+            do = do_ref[gi]
+            o = o_ref[gi]
+            lse = lse_ref[gi][:, None]                  # [bq, 1]
+            delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                            axis=-1, keepdims=True)     # [bq, 1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            if causal:
+                s = jnp.where(mask, s, _NEG_INF)
+            p = jnp.exp(s - lse)                        # [bq, bk]
+            dvp_ref[0, gi] = jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [bq, bk]
+            ds = p * (dp - delta) * scale               # [bq, bk]
+            dkp_ref[0, gi] = jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).astype(dkp_ref.dtype)
+            dq_scr[gi] += jax.lax.dot(ds.astype(k.dtype), k,
+                                      preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(work)
+        def _():
+            _accumulate()
+
+        # blocks above the diagonal contribute nothing, but their partial
+        # output blocks still exist and must be zeroed
+        @pl.when(jnp.logical_not(work))
+        def _zero():
+            dkp_ref[:] = jnp.zeros_like(dkp_ref)
+            dvp_ref[:] = jnp.zeros_like(dvp_ref)
+    else:
+        _accumulate()
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    # The fused kernel holds 5 input blocks + dq + 2 partial outputs + 4
+    # [bq, bk] f32 intermediates per step; kv blocks of 256 keep that under
+    # the ~16 MB VMEM budget at g=8, d=64 (512-wide kv blocks blow it).
+    # Only clamp when 256 still tiles the kv length — otherwise the last
+    # block would read out-of-bounds padding, which nothing masks in the
+    # non-causal case.
+    if bk > 256 and sk % 256 == 0:
+        bk = 256
+    nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
+    dq, dkp, dvp = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          g=g, bq=bq, bk=bk, nk=nk),
+        grid=(bh // g, nq, nk),
+        in_specs=[
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, g, bk, d), lambda b, i, j: (i, b, j, 0)),
+            pl.BlockSpec((1, g, bk, d), lambda b, i, j: (i, b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            # Partials are stored at input precision, not f32: each element
+            # is a complete f32 MXU accumulation over the q-block rows
+            # rounded ONCE, and the ≤ _FUSED_MAX_NQ partials are summed in
+            # f32 below — error ~ √nq · eps, the same order as the two-pass
+            # path's single output rounding, for half the partial HBM
+            # traffic (f32 partials also push the kernel past 16 MB VMEM).
+            jax.ShapeDtypeStruct((nq, bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((nq, bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(q, k, v, do, o, lse)
+    if nq == 1:
+        return dq, dkp[0], dvp[0]
+    dk = dkp.astype(jnp.float32).sum(0).astype(k.dtype)
+    dv = dvp.astype(jnp.float32).sum(0).astype(v.dtype)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Backward, two-pass fallback for long sequences: dQ pass (grid over q
+# blocks, inner loop over kv blocks)
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale: float, causal: bool, bq: int, bk: int,
-               nk: int):
+               dq_scr, *, scale: float, causal: bool, g: int, bq: int,
+               bk: int, nk: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -143,26 +323,26 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _accumulate():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]                                  # [bq, d]
-        lse = lse_ref[0][:, :1]                         # [bq, 1]
-        delta = delta_ref[0][:, :1]                     # [bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # [bq, bk]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [bq, bk]
-        ds = p * (dp - delta) * scale
-        dq_scr[:] += jax.lax.dot(ds.astype(k.dtype), k,
-                                 preferred_element_type=jnp.float32)
+        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+        for gi in range(g):
+            q = q_ref[gi]
+            k = k_ref[gi]
+            v = v_ref[gi]
+            do = do_ref[gi]                             # [bq, d]
+            lse = lse_ref[gi][:, :1]                    # [bq, 1]
+            delta = delta_ref[gi][:, :1]                # [bq, 1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale
+            if causal:
+                s = jnp.where(mask, s, _NEG_INF)
+            p = jnp.exp(s - lse)                        # [bq, bk]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [bq, bk]
+            ds = p * (dp - delta) * scale
+            dq_scr[gi] += jax.lax.dot(ds.astype(k.dtype), k,
+                                      preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when((qi + 1) * bq > ki * bk)
@@ -173,7 +353,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -182,7 +362,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                causal: bool, bq: int, bk: int, nq: int):
+                causal: bool, g: int, bq: int, bk: int, nq: int):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
 
@@ -192,30 +372,30 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _accumulate():
-        q = q_ref[0]                                    # [bq, d]
-        k = k_ref[0]                                    # [bk, d]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
-        delta = delta_ref[0][:, :1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale  # [bq, bk]
-        if causal:
-            qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, _NEG_INF)
-        p = jnp.exp(s - lse)                            # [bq, bk]
-        dv_scr[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [bk, d]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [bq, bk]
-        ds = p * (dp - delta) * scale                   # [bq, bk]
-        dk_scr[:] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)         # [bk, d]
+        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+        for gi in range(g):
+            q = q_ref[gi]                               # [bq, d]
+            k = k_ref[gi]                               # [bk, d]
+            v = v_ref[gi]
+            do = do_ref[gi]
+            lse = lse_ref[gi][:, :1]
+            delta = delta_ref[gi][:, :1]
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+            if causal:
+                s = jnp.where(mask, s, _NEG_INF)
+            p = jnp.exp(s - lse)                        # [bq, bk]
+            dv_scr[gi] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [bk, d]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [bq, bk]
+            ds = p * (dp - delta) * scale               # [bq, bk]
+            dk_scr[gi] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)     # [bk, d]
 
     if causal:
         @pl.when((qi + 1) * bq > ki * bk)
@@ -226,62 +406,65 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, *, scale, causal, bq, bk):
+def _flash_backward(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
+    if nq <= _FUSED_MAX_NQ:
+        return _flash_backward_fused(q, k, v, o, lse, do, scale=scale,
+                                     causal=causal, g=g, bq=bq, bk=bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                            # [bh, sq]
     lse_l = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
     delta_l = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nk=nk),
-        grid=(bh, nq, nk),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, g=g,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(bh // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq, _LANES), lambda b, i, j: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i, j: (b, i, 0)),
+        out_specs=pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v, do, lse_l, delta_l)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq,
-                          bk=bk, nq=nq),
-        grid=(bh, nk, nq),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, g=g,
+                          bq=bq, bk=bk, nq=nq),
+        grid=(bh // g, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bq, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bq, _LANES), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
         scratch_shapes=[
-            pltpu.VMEM((bk, d), jnp.float32),
-            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((g, bk, d), jnp.float32),
+            pltpu.VMEM((g, bk, d), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
@@ -294,21 +477,23 @@ def _flash_backward(q, k, v, o, lse, do, *, scale, causal, bq, bk):
 # Public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, scale, causal, bq, bk):
-    o, _ = _flash_forward(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, scale, causal, g, bq, bk):
+    o, _ = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+                          bk=bk)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, bq, bk):
-    o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, bq=bq, bk=bk)
+def _flash_fwd_rule(q, k, v, scale, causal, g, bq, bk):
+    o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+                            bk=bk)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, bq, bk, residuals, g):
+def _flash_bwd_rule(scale, causal, g, bq, bk, residuals, grad):
     q, k, v, o, lse = residuals
-    return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
-                           bq=bq, bk=bk)
+    return _flash_backward(q, k, v, o, lse, grad, scale=scale, causal=causal,
+                           g=g, bq=bq, bk=bk)
 
 
 _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -316,14 +501,18 @@ _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 512, block_k: int = 1024):
+                    block_q: int = 512, block_k: int = 512,
+                    block_h: int = 4):
     """Fused attention over [batch, seq, heads, head_dim] inputs.
 
-    Block sizes are clamped to the sequence lengths (tiny test shapes).
-    Defaults were swept on a v5e chip: 512×1024 runs ~2000× faster than
-    128×128 (grid-step overhead dominates small blocks) and beats the XLA
-    dense-softmax fusion at S=1024. Differentiable via the flash backward
-    kernels.
+    Block sizes are clamped to the input shapes (tiny test shapes).
+    Defaults were swept on a v5e chip at LM shapes (seq 1-2k, head_dim 64).
+    ``block_h`` is a hint for heads-per-grid-step, resolved by
+    :func:`_pick_group` (a multiple of 8 dividing batch·heads, or all of
+    them); grouping amortizes the fixed ~2-4 µs per-grid-step cost, bounded
+    by VMEM (the fused backward holds 5 input blocks + 3 output blocks + 4
+    [block_q, block_k] f32 intermediates per step). Differentiable via the
+    fused flash backward (two-pass kernels for long sequences).
     """
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -333,9 +522,18 @@ def flash_attention(q, k, v, *, causal: bool = True,
     bk = min(block_k, sk)
     scale = (d ** -0.5) if scale is None else scale
     to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    o = _flash_attention_bhsd(to_bhsd(q), to_bhsd(k), to_bhsd(v),
-                              scale, causal, bq, bk)
-    return o.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    bh = b * h
+    if bh % 8:
+        # Mosaic needs the batch·head block dim divisible by 8 (2-D lse
+        # blocks). Pad with zero heads: zero scores → uniform softmax over
+        # zero values → o = 0, finite lse, zero grads; sliced off below.
+        pad = 8 * _cdiv(bh, 8) - bh
+        qf, kf, vf = (jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
+                      for x in (qf, kf, vf))
+    g = _pick_group(qf.shape[0], block_h)
+    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
+    return o[:bh].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
