@@ -1,0 +1,13 @@
+"""Asserts the multi-slice identity env (tony.{job}.slices > 1)."""
+import json, os, sys
+
+slice_id = int(os.environ["TONY_SLICE_ID"])
+num_slices = int(os.environ["TONY_NUM_SLICES"])
+idx = int(os.environ["TASK_INDEX"])
+spec = json.loads(os.environ["TONY_MESH_SPEC"])
+mine = spec["slice_spec"][os.environ["JOB_NAME"]]
+assert num_slices == mine["slices"]
+assert slice_id == idx // mine["hosts_per_slice"], (slice_id, idx, mine)
+assert 0 <= slice_id < num_slices
+assert spec["dcn_axes"] == {"dp": 2}, spec
+sys.exit(0)

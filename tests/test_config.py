@@ -75,6 +75,54 @@ def test_zero_instance_job_types_skipped():
     assert conf.task_requests() == {}
 
 
+def test_multi_slice_topology_validation():
+    base = {
+        "tony.worker.slices": "2",
+        "tony.worker.tpu.topology": "4x4",   # v5e: 16 chips = 2 hosts
+        "tony.tpu.accelerator-type": "v5litepod",
+    }
+    ok = TonyConfig({**base, "tony.worker.instances": "4"})
+    w = ok.task_requests()["worker"]
+    assert (w.instances, w.slices) == (4, 2)
+
+    bad = TonyConfig({**base, "tony.worker.instances": "2"})
+    with pytest.raises(ValueError, match="tony.worker.instances=4"):
+        bad.task_requests()
+
+
+def test_slices_must_divide_instances():
+    conf = TonyConfig({"tony.worker.instances": "3",
+                       "tony.worker.slices": "2"})
+    with pytest.raises(ValueError, match="not divisible"):
+        conf.task_requests()
+    conf = TonyConfig({"tony.worker.instances": "2",
+                       "tony.worker.slices": "0"})
+    with pytest.raises(ValueError, match="slices must be"):
+        conf.task_requests()
+
+
+def test_mesh_dcn_axes():
+    conf = TonyConfig({"tony.application.mesh.dcn": "dp=2"})
+    assert conf.mesh_dcn_axes() == {"dp": 2}
+    assert TonyConfig().mesh_dcn_axes() == {}
+
+
+def test_dcn_validated_at_parse_time():
+    """Bad DCN configs fail the submit, not every task host later."""
+    base = {"tony.worker.instances": "4", "tony.worker.slices": "2"}
+    with pytest.raises(ValueError, match="explicit positive"):
+        TonyConfig({**base, "tony.application.mesh.dcn": "dp=-1"}
+                   ).task_requests()
+    with pytest.raises(ValueError, match="must equal the slice count"):
+        TonyConfig({**base, "tony.application.mesh.dcn": "dp=4"}
+                   ).task_requests()
+    with pytest.raises(ValueError, match="no job type"):
+        TonyConfig({"tony.worker.instances": "2",
+                    "tony.application.mesh.dcn": "dp=2"}).task_requests()
+    # the matching config passes
+    TonyConfig({**base, "tony.application.mesh.dcn": "dp=2"}).task_requests()
+
+
 def test_untracked_job_types_default_ps():
     conf = TonyConfig()
     assert not conf.is_job_type_tracked("ps")

@@ -101,6 +101,16 @@ class TestE2E:
                               "tony.application.mesh": "dp=2"})
         assert client.run() == 0
 
+    def test_multi_slice_env(self, tmp_path):
+        """tony.worker.slices=2: every task learns its gang (TONY_SLICE_ID /
+        TONY_NUM_SLICES) and the DCN mesh layout rides mesh_spec."""
+        client = make_client(tmp_path, fixture_cmd("check_slice_env.py"),
+                             {"tony.worker.instances": "4",
+                              "tony.worker.slices": "2",
+                              "tony.application.mesh": "tp=-1",
+                              "tony.application.mesh.dcn": "dp=2"})
+        assert client.run() == 0
+
     def test_pytorch_runtime_env(self, tmp_path):
         client = make_client(tmp_path, fixture_cmd("check_pytorch_env.py"),
                              {"tony.worker.instances": "2",

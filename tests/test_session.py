@@ -124,7 +124,26 @@ def test_mesh_spec_in_payload():
     s = Session(make_conf(**{"tony.application.mesh": "dp=2,tp=1"}))
     for tid in ("worker:0", "worker:1", "ps:0"):
         payload = s.register_task_spec(tid, "h:1")
-    assert json.loads(payload["mesh_spec"]) == {"axes": {"dp": 2, "tp": 1}}
+    assert json.loads(payload["mesh_spec"]) == {
+        "axes": {"dp": 2, "tp": 1}, "dcn_axes": {}}
+
+
+def test_mesh_spec_multi_slice():
+    """tony.{job}.slices=N ships slice metadata + DCN axes in mesh_spec."""
+    s = Session(make_conf(**{
+        "tony.worker.instances": "4",
+        "tony.worker.slices": "2",
+        "tony.application.mesh": "tp=-1",
+        "tony.application.mesh.dcn": "dp=2",
+    }))
+    for tid in ("worker:0", "worker:1", "worker:2", "worker:3", "ps:0"):
+        payload = s.register_task_spec(tid, "h:1")
+    spec = json.loads(payload["mesh_spec"])
+    assert spec["axes"] == {"tp": -1}
+    assert spec["dcn_axes"] == {"dp": 2}
+    # worker spans 2 slices of 2 hosts; ps (slices=1) carries no entry
+    assert spec["slice_spec"] == {
+        "worker": {"slices": 2, "hosts_per_slice": 2}}
 
 
 def test_uptime_metrics_tracked_fraction():

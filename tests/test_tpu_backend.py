@@ -65,6 +65,33 @@ def test_dry_run_gang_provisions_once():
     b.stop()
 
 
+def test_multi_slice_gangs():
+    """tony.worker.slices=2: two gangs, each its own TPU VM; task index i →
+    slice i // hosts_per_slice, ssh --worker = i % hosts_per_slice."""
+    conf = tpu_conf(**{"tony.worker.instances": "4",
+                       "tony.worker.slices": "2"})
+    b = TpuSliceBackend(conf, app_id="app1", dry_run=True)
+    assert b._gang_of("worker:0") == ("worker", 0, 0)
+    assert b._gang_of("worker:1") == ("worker", 0, 1)
+    assert b._gang_of("worker:2") == ("worker", 1, 0)
+    assert b._gang_of("worker:3") == ("worker", 1, 1)
+    for i in range(4):
+        b.launch_task(LaunchSpec(task_id=f"worker:{i}", command="run",
+                                 env={}, log_dir="/tmp", tpu_topology="4x4"))
+    assert sorted(b._slices) == ["worker/s0", "worker/s1"]
+    assert b._slices["worker/s0"] == "tony-app1-worker-s0"
+    assert b._slices["worker/s1"] == "tony-app1-worker-s1"
+    # per-gang commands address the right VM and in-slice host
+    ssh = b.ssh_command("worker", 1, "echo hi", slice_idx=1)
+    assert "tony-app1-worker-s1" in " ".join(ssh) and "--worker=1" in ssh
+    b.stop()
+
+
+def test_single_slice_names_unsuffixed():
+    assert slice_name("a", "worker", 0, 1) == "tony-a-worker"
+    assert slice_name("a", "worker", 1, 2) == "tony-a-worker-s1"
+
+
 def test_relaunch_after_preemption_reprovisions():
     """Regression: a retried session must get a FRESH slice — the old one's
     cached PREEMPTED state was instantly re-failing every relaunched task,

@@ -8,10 +8,12 @@ the key impedance mismatch called out in SURVEY.md §7: a slice arrives as a
 gang (all hosts at once, one allocation = N worker processes), is preempted
 as a gang, and is released as a gang.
 
-Mechanics: one TPU VM (slice) per *job type* that requests TPUs, created via
+Mechanics: one TPU VM (slice) per *gang* — a job type that requests TPUs gets
+``tony.{job}.slices`` gangs (default 1), each a whole pod slice — created via
 the ``gcloud compute tpus tpu-vm`` CLI (the only dependency-free path — the
 Cloud TPU REST API would need google-api-python-client, which is not baked
-in). After provisioning, the job dir (tony-final.xml, staged sources, venv
+in). Task index i of an S-slice job type maps to slice i // hosts_per_slice,
+host i % hosts_per_slice; preemption is detected and reprovisioned per gang. After provisioning, the job dir (tony-final.xml, staged sources, venv
 zip, and a ``.tony-framework/`` copy of this package) is localized onto every
 slice host at ``~/tony-job`` — the container-localization analog (reference:
 TonyClient.java:163-192 uploads src/venv/conf to HDFS staging and
@@ -62,8 +64,15 @@ class TpuProvisioningError(RuntimeError):
     pass
 
 
-def slice_name(app_id: str, job_type: str) -> str:
-    return f"tony-{app_id.replace('_', '-')}-{job_type}"[:61]
+def slice_name(app_id: str, job_type: str, slice_idx: int = 0,
+               num_slices: int = 1) -> str:
+    """One TPU VM name per gang. Multi-slice job types (tony.{job}.slices=N)
+    get an -s<i> suffix on every gang; single-slice names stay unsuffixed so
+    they match what operators see for the common case."""
+    base = f"tony-{app_id.replace('_', '-')}-{job_type}"
+    if num_slices > 1:
+        return f"{base[:56]}-s{slice_idx}"[:61]
+    return base[:61]
 
 
 class TpuSliceBackend(SchedulerBackend):
@@ -83,7 +92,12 @@ class TpuSliceBackend(SchedulerBackend):
         # reference: tony.application.node-label): attached as a GCE label
         # so reservations/affinity tooling can match slices.
         self.node_label = conf.get(K.APPLICATION_NODE_LABEL_KEY) or ""
-        self._slices: dict[str, str] = {}          # job_type -> slice name
+        # gang key ("worker" or "worker/s1" for multi-slice) -> slice name
+        self._slices: dict[str, str] = {}
+        # gang key -> Event set once the gang is provisioned AND staged;
+        # launchers of other hosts in the gang wait on it OUTSIDE the lock
+        self._gang_ready: dict[str, threading.Event] = {}
+        self._artifacts_lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
         self._reported: set[str] = set()
         self._lock = threading.Lock()
@@ -109,14 +123,43 @@ class TpuSliceBackend(SchedulerBackend):
                     "tony.tpu.zone and tony.tpu.accelerator-type to be set.")
 
     # ------------------------------------------------------------------
+    # Multi-slice gang arithmetic (tony.{job}.slices = N gangs per job type;
+    # task index i lives in gang i // hosts_per_slice at host i % hosts)
+    # ------------------------------------------------------------------
+    def _num_slices(self, job_type: str) -> int:
+        return max(1, self.conf.get_int(K.slices_key(job_type), 1))
+
+    def _hosts_per_slice(self, job_type: str) -> int:
+        instances = self.conf.get_int(K.instances_key(job_type), 1)
+        return max(1, instances // self._num_slices(job_type))
+
+    def _gang_of(self, task_id: str) -> tuple[str, int, int]:
+        """task id → (job_type, slice index, host index within the slice)."""
+        job_type, _, idx = task_id.partition(":")
+        n = self._num_slices(job_type)
+        if n == 1:
+            return job_type, 0, int(idx)
+        hosts = self._hosts_per_slice(job_type)
+        return job_type, int(idx) // hosts, int(idx) % hosts
+
+    def _gang_key(self, job_type: str, slice_idx: int) -> str:
+        return (job_type if self._num_slices(job_type) == 1
+                else f"{job_type}/s{slice_idx}")
+
+    def _slice_name(self, job_type: str, slice_idx: int = 0) -> str:
+        return slice_name(self.app_id, job_type, slice_idx,
+                          self._num_slices(job_type))
+
+    # ------------------------------------------------------------------
     # Command plans (unit-tested; executed via subprocess when not dry_run)
     # ------------------------------------------------------------------
-    def create_slice_command(self, job_type: str, topology: str) -> list[str]:
+    def create_slice_command(self, job_type: str, topology: str,
+                             slice_idx: int = 0) -> list[str]:
         """``gcloud compute tpus tpu-vm create`` for one gang allocation.
         ``topology`` (tony.{job}.tpu.topology) picks the accelerator shape:
         the slice IS the resource ask — there is no per-container request
         (contrast Utils.setCapabilityGPU:167 requesting yarn.io/gpu units)."""
-        name = slice_name(self.app_id, job_type)
+        name = self._slice_name(job_type, slice_idx)
         if topology and "-" not in self.accelerator_type:
             # "v5litepod" + topology "4x4" → "v5litepod-16" (chip count is
             # the product of the topology dims)
@@ -141,25 +184,25 @@ class TpuSliceBackend(SchedulerBackend):
         return cmd
 
     def ssh_command(self, job_type: str, host_index: int | str,
-                    remote_command: str) -> list[str]:
-        """``host_index`` is a host number or ``"all"`` (staging runs the
-        same command on every host)."""
-        name = slice_name(self.app_id, job_type)
+                    remote_command: str, slice_idx: int = 0) -> list[str]:
+        """``host_index`` is a host number WITHIN the slice or ``"all"``
+        (staging runs the same command on every host of the gang)."""
+        name = self._slice_name(job_type, slice_idx)
         return ["gcloud", "compute", "tpus", "tpu-vm", "ssh", name,
                 f"--project={self.project}", f"--zone={self.zone}",
                 f"--worker={host_index}", "--quiet",
                 f"--command={remote_command}"]
 
     def scp_command(self, job_type: str, local_path: str,
-                    remote_path: str) -> list[str]:
-        name = slice_name(self.app_id, job_type)
+                    remote_path: str, slice_idx: int = 0) -> list[str]:
+        name = self._slice_name(job_type, slice_idx)
         return ["gcloud", "compute", "tpus", "tpu-vm", "scp", local_path,
                 f"{name}:{remote_path}",
                 f"--project={self.project}", f"--zone={self.zone}",
                 "--worker=all", "--quiet"]
 
-    def stage_commands(self, job_type: str,
-                       job_dir: str) -> list[list[str]]:
+    def stage_commands(self, job_type: str, job_dir: str,
+                       slice_idx: int = 0) -> list[list[str]]:
         """Command plan localizing the job dir onto every slice host
         (reference: TonyApplicationMaster.java:1090-1104). gs:// pull when
         the client staged remotely, tarball-over-scp otherwise. The per-job
@@ -170,36 +213,39 @@ class TpuSliceBackend(SchedulerBackend):
             pull = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
                     f"&& gsutil -m rsync -r {shlex.quote(remote_staging)} "
                     f"{REMOTE_JOB_DIR}")
-            cmds = [self.ssh_command(job_type, "all", pull)]
+            cmds = [self.ssh_command(job_type, "all", pull, slice_idx)]
         else:
             tarball = os.path.join(job_dir, ".tony-stage.tgz")
             unpack = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
                       f"&& tar -xzf /tmp/tony-stage.tgz -C {REMOTE_JOB_DIR} "
                       f"&& rm -f /tmp/tony-stage.tgz")
             cmds = [
-                self.scp_command(job_type, tarball, "/tmp/tony-stage.tgz"),
-                self.ssh_command(job_type, "all", unpack),
+                self.scp_command(job_type, tarball, "/tmp/tony-stage.tgz",
+                                 slice_idx),
+                self.ssh_command(job_type, "all", unpack, slice_idx),
             ]
         secret_path = os.path.join(job_dir, ".tony-secret")
         if os.path.exists(secret_path):
             cmds.append(self.scp_command(
-                job_type, secret_path, f"{REMOTE_JOB_DIR}/.tony-secret"))
+                job_type, secret_path, f"{REMOTE_JOB_DIR}/.tony-secret",
+                slice_idx))
             cmds.append(self.ssh_command(
                 job_type, "all",
-                f"chmod 600 {REMOTE_JOB_DIR}/.tony-secret"))
+                f"chmod 600 {REMOTE_JOB_DIR}/.tony-secret", slice_idx))
         return cmds
 
-    def describe_command(self, job_type: str) -> list[str]:
-        name = slice_name(self.app_id, job_type)
+    def describe_command(self, job_type: str,
+                         slice_idx: int = 0) -> list[str]:
+        name = self._slice_name(job_type, slice_idx)
         return ["gcloud", "compute", "tpus", "tpu-vm", "describe", name,
                 f"--project={self.project}", f"--zone={self.zone}",
                 "--format=json"]
 
-    def delete_slice_command(self, job_type: str,
-                             wait: bool = False) -> list[str]:
+    def delete_slice_command(self, job_type: str, wait: bool = False,
+                             slice_idx: int = 0) -> list[str]:
         """``wait=True`` (synchronous delete) is used on the reprovision
         path, where a create with the same name must not race the delete."""
-        name = slice_name(self.app_id, job_type)
+        name = self._slice_name(job_type, slice_idx)
         cmd = ["gcloud", "compute", "tpus", "tpu-vm", "delete", name,
                f"--project={self.project}", f"--zone={self.zone}", "--quiet"]
         if not wait:
@@ -210,27 +256,61 @@ class TpuSliceBackend(SchedulerBackend):
     # SchedulerBackend surface
     # ------------------------------------------------------------------
     def launch_task(self, spec: LaunchSpec) -> None:
-        job_type, _, idx = spec.task_id.partition(":")
+        job_type, slice_idx, host_idx = self._gang_of(spec.task_id)
+        gang = self._gang_key(job_type, slice_idx)
+        timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY,
+                                      600000) / 1000
+        # Claim-or-wait under the lock; the slow work (gcloud delete/create,
+        # staging — minutes) runs OUTSIDE it so poll_completed/kill paths
+        # never stall behind provisioning, and independent gangs can
+        # provision concurrently.
         with self._lock:
             # Relaunch of the same task id (session retry): forget the old
             # generation's completion so the new one is observed.
             self._reported.discard(spec.task_id)
-            if job_type in self._slices and self._state_cache.get(job_type) \
-                    in ("PREEMPTED", "TERMINATED"):
+            dead = gang in self._slices and self._state_cache.get(gang) \
+                in ("PREEMPTED", "TERMINATED")
+            if dead:
                 # The gang's slice is gone — a retried session must get a
                 # fresh one, not instantly re-fail on the cached dead state.
-                log.info("slice for %s was %s — reprovisioning", job_type,
-                         self._state_cache[job_type])
-                cmd = self.delete_slice_command(job_type, wait=True)
-                if self.dry_run:
-                    log.info("[dry-run] %s", " ".join(cmd))
-                else:
-                    subprocess.run(cmd, capture_output=True, timeout=600)
-                del self._slices[job_type]
-                self._state_cache.pop(job_type, None)
-                self._state_ts.pop(job_type, None)
-            if job_type not in self._slices:
-                self._provision(job_type, spec)
+                log.info("slice for %s was %s — reprovisioning", gang,
+                         self._state_cache[gang])
+                del self._slices[gang]
+                self._gang_ready.pop(gang, None)
+                self._state_cache.pop(gang, None)
+                self._state_ts.pop(gang, None)
+            if gang not in self._slices:
+                self._slices[gang] = self._slice_name(job_type, slice_idx)
+                ready = self._gang_ready[gang] = threading.Event()
+                is_provisioner = True
+            else:
+                ready = self._gang_ready[gang]
+                is_provisioner = False
+        if is_provisioner:
+            try:
+                if dead:
+                    cmd = self.delete_slice_command(job_type, wait=True,
+                                                    slice_idx=slice_idx)
+                    if self.dry_run:
+                        log.info("[dry-run] %s", " ".join(cmd))
+                    else:
+                        subprocess.run(cmd, capture_output=True, timeout=600)
+                self._provision(job_type, slice_idx, spec)
+            except BaseException:
+                with self._lock:
+                    self._slices.pop(gang, None)
+                ready.set()     # wake waiters; they see the gang vanished
+                raise
+            ready.set()
+        elif not ready.is_set():
+            if not ready.wait(timeout=timeout_s):
+                raise TpuProvisioningError(
+                    f"timed out waiting for gang {gang} to provision")
+            with self._lock:
+                if gang not in self._slices:
+                    raise TpuProvisioningError(
+                        f"gang {gang} failed to provision")
+        with self._lock:
             # The auth secret must NOT ride the ssh argv (visible in ps /
             # /proc); the host reads it from the chmod-600 staged file.
             env_prefix = " ".join(
@@ -248,7 +328,7 @@ class TpuSliceBackend(SchedulerBackend):
                       f"${{PYTHONPATH:+:$PYTHONPATH}} && "
                       f"{secret_src}"
                       f"{env_prefix} {spec.command}")
-            cmd = self.ssh_command(job_type, int(idx), remote)
+            cmd = self.ssh_command(job_type, host_idx, remote, slice_idx)
             if self.dry_run:
                 log.info("[dry-run] %s", " ".join(cmd))
                 return
@@ -256,20 +336,24 @@ class TpuSliceBackend(SchedulerBackend):
                 cmd, stdout=open(f"{spec.log_dir}/{spec.task_id.replace(':', '-')}.stdout", "ab"),
                 stderr=subprocess.STDOUT)
 
-    def _provision(self, job_type: str, spec: LaunchSpec) -> None:
-        cmd = self.create_slice_command(job_type, spec.tpu_topology)
-        self._slices[job_type] = slice_name(self.app_id, job_type)
+    def _provision(self, job_type: str, slice_idx: int,
+                   spec: LaunchSpec) -> None:
+        """Create + stage one gang. Runs WITHOUT self._lock (launch_task
+        claimed the gang first); touches no shared state."""
+        gang = self._gang_key(job_type, slice_idx)
+        cmd = self.create_slice_command(job_type, spec.tpu_topology,
+                                        slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
         if self.dry_run:
             log.info("[dry-run] %s", " ".join(cmd))
         else:
-            log.info("provisioning slice for %s: %s", job_type, " ".join(cmd))
+            log.info("provisioning slice for %s: %s", gang, " ".join(cmd))
             res = subprocess.run(cmd, capture_output=True, text=True,
                                  timeout=timeout_s)
             if res.returncode != 0:
                 raise TpuProvisioningError(
-                    f"slice provisioning failed for {job_type}: {res.stderr}")
-        self._stage(job_type, spec, timeout_s)
+                    f"slice provisioning failed for {gang}: {res.stderr}")
+        self._stage(job_type, slice_idx, spec, timeout_s)
 
     # ------------------------------------------------------------------
     # Staging / localization
@@ -281,8 +365,12 @@ class TpuSliceBackend(SchedulerBackend):
         reference shipping its own fat jar, ClusterSubmitter.java:37-61),
         and build the transport tarball. Logs and the per-job auth secret
         (env-delivered) are excluded."""
+        with self._artifacts_lock:
+            self._prepare_stage_artifacts_locked(job_dir)
+
+    def _prepare_stage_artifacts_locked(self, job_dir: str) -> None:
         if self._artifacts_ready:
-            return    # job-scoped, not job-type-scoped: build/upload once
+            return    # job-scoped, not gang-scoped: build/upload once
         import tony_tpu
         pkg_src = os.path.dirname(os.path.abspath(tony_tpu.__file__))
         fw_dst = os.path.join(job_dir, FRAMEWORK_DIR, "tony_tpu")
@@ -312,7 +400,7 @@ class TpuSliceBackend(SchedulerBackend):
                 tf.add(os.path.join(job_dir, name), arcname=name)
         self._artifacts_ready = True        # only after the work succeeded
 
-    def _stage(self, job_type: str, spec: LaunchSpec,
+    def _stage(self, job_type: str, slice_idx: int, spec: LaunchSpec,
                timeout_s: float) -> None:
         job_dir = spec.cwd
         if not job_dir:
@@ -322,7 +410,7 @@ class TpuSliceBackend(SchedulerBackend):
             job_dir = "<job-dir>"    # command-plan inspection only
         if not self.dry_run:
             self._prepare_stage_artifacts(job_dir)
-        for cmd in self.stage_commands(job_type, job_dir):
+        for cmd in self.stage_commands(job_type, job_dir, slice_idx):
             if self.dry_run:
                 log.info("[dry-run] %s", " ".join(cmd))
                 continue
@@ -333,12 +421,18 @@ class TpuSliceBackend(SchedulerBackend):
                 raise TpuProvisioningError(
                     f"staging failed for {job_type}: {res.stderr}")
 
-    def _slice_state(self, job_type: str) -> str:
+    def _gang_parts(self, gang: str) -> tuple[str, int]:
+        job_type, _, s = gang.partition("/s")
+        return job_type, int(s) if s else 0
+
+    def _slice_state(self, gang: str) -> str:
         if self.dry_run:
             return "READY"
+        job_type, slice_idx = self._gang_parts(gang)
         try:
-            res = subprocess.run(self.describe_command(job_type),
-                                 capture_output=True, text=True, timeout=60)
+            res = subprocess.run(
+                self.describe_command(job_type, slice_idx),
+                capture_output=True, text=True, timeout=60)
         except subprocess.TimeoutExpired:
             return "UNKNOWN"
         if res.returncode != 0:
@@ -348,27 +442,29 @@ class TpuSliceBackend(SchedulerBackend):
     def _refresh_slice_states(self) -> None:
         now = time.monotonic()
         with self._lock:
-            stale = [jt for jt in self._slices
-                     if now - self._state_ts.get(jt, 0.0)
+            stale = [g for g in self._slices
+                     if now - self._state_ts.get(g, 0.0)
                      > self._state_refresh_s]
-        for jt in stale:            # network calls OUTSIDE the lock
-            state = self._slice_state(jt)
+        for g in stale:             # network calls OUTSIDE the lock
+            state = self._slice_state(g)
             with self._lock:
-                self._state_cache[jt] = state
-                self._state_ts[jt] = time.monotonic()
+                self._state_cache[g] = state
+                self._state_ts[g] = time.monotonic()
 
     def poll_completed(self) -> list[CompletionEvent]:
         self._refresh_slice_states()
         events = []
         with self._lock:
-            preempted_types = {jt for jt in self._slices
-                               if self._state_cache.get(jt, "READY")
+            preempted_gangs = {g for g in self._slices
+                               if self._state_cache.get(g, "READY")
                                in ("PREEMPTED", "TERMINATED")}
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue
-                jt = task_id.partition(":")[0]
-                if jt in preempted_types:
+                jt, slice_idx, _ = self._gang_of(task_id)
+                if self._gang_key(jt, slice_idx) in preempted_gangs:
+                    # preemption kills one gang; the whole session retries
+                    # (gang semantics), but only this gang reprovisions
                     self._reported.add(task_id)
                     events.append(CompletionEvent(task_id, -1, preempted=True))
                     continue
@@ -378,18 +474,19 @@ class TpuSliceBackend(SchedulerBackend):
                     events.append(CompletionEvent(task_id, code))
         return events
 
-    def remote_kill_command(self, job_type: str, host_index: int) -> list[str]:
+    def remote_kill_command(self, job_type: str, host_index: int,
+                            slice_idx: int = 0) -> list[str]:
         """Best-effort remote reap: terminating the local ``gcloud ssh``
         wrapper does NOT stop the executor on the TPU VM — it keeps
         heartbeating with a stale session id and holds the data ports, so a
         session retry onto the same slice would hit port conflicts."""
         return self.ssh_command(
             job_type, host_index,
-            "pkill -9 -f tony_tpu.cluster.executor || true")
+            "pkill -9 -f tony_tpu.cluster.executor || true", slice_idx)
 
     def _kill_remote(self, task_id: str) -> subprocess.Popen | None:
-        jt, _, idx = task_id.partition(":")
-        cmd = self.remote_kill_command(jt, int(idx))
+        jt, slice_idx, host_idx = self._gang_of(task_id)
+        cmd = self.remote_kill_command(jt, host_idx, slice_idx)
         if self.dry_run:
             log.info("[dry-run] %s", " ".join(cmd))
             return None
@@ -429,8 +526,9 @@ class TpuSliceBackend(SchedulerBackend):
     def stop(self) -> None:
         self.kill_all()
         with self._lock:
-            for jt in list(self._slices):
-                cmd = self.delete_slice_command(jt)
+            for gang in list(self._slices):
+                jt, slice_idx = self._gang_parts(gang)
+                cmd = self.delete_slice_command(jt, slice_idx=slice_idx)
                 if self.dry_run:
                     log.info("[dry-run] %s", " ".join(cmd))
                     continue

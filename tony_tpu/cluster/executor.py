@@ -164,6 +164,15 @@ class TaskExecutor:
         }
         if self.notebook_port:
             env[constants.NOTEBOOK_PORT] = str(self.notebook_port)
+        # Multi-slice identity: which gang of the job type this host is in
+        # (tony.{job}.slices > 1). Index order is slice-major (session.py).
+        slice_spec = json.loads(
+            self.bootstrap["mesh_spec"] or "{}").get("slice_spec", {})
+        mine = slice_spec.get(self.job_name)
+        if mine:
+            env[constants.SLICE_ID] = str(
+                self.task_index // int(mine["hosts_per_slice"]))
+            env[constants.NUM_SLICES] = str(mine["slices"])
         if self.conf.get_bool(K.TASK_PROFILE_ENABLED_KEY, False):
             env[constants.TONY_PROFILE_ENABLED] = "true"
             profile_dir = self.conf.get(K.TASK_PROFILE_DIR_KEY) or ""

@@ -101,7 +101,23 @@ class Session:
             jt: [SessionTask(jt, i, session_id) for i in range(req.instances)]
             for jt, req in self.requests.items()
         }
-        self._mesh_spec = json.dumps({"axes": conf.mesh_axes()})
+        # Mesh layout + multi-slice topology, shipped opaquely to every task
+        # (mesh_spec is a JSON string end to end, so slice metadata rides
+        # the existing RPC field). Task index i of a job type with S slices
+        # of H hosts each belongs to slice i // H — index order is
+        # slice-major, matching the dense process-id assignment below, so
+        # in-slice processes are contiguous and ICI-minor mesh axes land on
+        # ICI neighbors.
+        slice_spec = {
+            jt: {"slices": req.slices,
+                 "hosts_per_slice": req.instances // req.slices}
+            for jt, req in self.requests.items() if req.slices > 1
+        }
+        self._mesh_spec = json.dumps({
+            "axes": conf.mesh_axes(),
+            "dcn_axes": conf.mesh_dcn_axes(),
+            **({"slice_spec": slice_spec} if slice_spec else {}),
+        })
         # allocation-id → task binding (getAndInitMatchingTask:209 analog)
         self._next_allocation_id = 0
 

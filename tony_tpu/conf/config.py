@@ -73,6 +73,7 @@ class TaskRequest:
     gpus: int = 0
     tpus: int = 0                 # TPU chips per task (tony.{job}.tpus)
     tpu_topology: str = ""        # pod-slice topology, e.g. "2x4" (tony.{job}.tpu.topology)
+    slices: int = 1               # pod slices (gangs) backing this job type (tony.{job}.slices)
     resources: str = ""           # extra localized resources (comma-sep paths)
     env: dict[str, str] = field(default_factory=dict)
     priority: int = 0             # unique per job type (Utils.java:330-336, YARN-7631)
@@ -199,22 +200,27 @@ class TonyConfig:
         return K.discover_job_types(self._values)
 
     def _validate_topology(self, jt: str, instances: int,
-                           topology: str) -> None:
+                           topology: str, slices: int) -> None:
         """Fail at parse time when tony.{job}.instances cannot match the
-        slice's host count: the TPU backend launches exactly one executor
+        gang's host count: the TPU backend launches exactly one executor
         per slice host (``ssh --worker=<i>``), so a mismatch would surface
         much later as an opaque ssh error (the reference's analog is
-        truncating bad resource asks up front, TonyClient.java:145-157)."""
+        truncating bad resource asks up front, TonyClient.java:145-157).
+        With tony.{job}.slices=N, instances spans all N gangs."""
         accel = self.get(K.TPU_ACCELERATOR_TYPE_KEY) or ""
         hosts = tpu_hosts_for(accel, topology)
         if hosts is None:
             return            # unknown generation or no topology: skip
-        if instances != hosts:
+        if instances != hosts * slices:
+            per_slice = (f"{hosts} host{'s' if hosts != 1 else ''}"
+                         f" per slice × {slices} slice"
+                         f"{'s' if slices != 1 else ''}")
             raise ValueError(
                 f"tony.{jt}.instances={instances} does not match "
-                f"accelerator {accel!r} topology {topology!r}, which has "
-                f"{hosts} host{'s' if hosts != 1 else ''} (one executor "
-                f"runs per slice host). Set tony.{jt}.instances={hosts}.")
+                f"accelerator {accel!r} topology {topology!r} with "
+                f"tony.{jt}.slices={slices}: that is {per_slice} (one "
+                f"executor runs per slice host). Set "
+                f"tony.{jt}.instances={hosts * slices}.")
 
     def task_requests(self) -> dict[str, TaskRequest]:
         """Build per-job-type resource asks from config.
@@ -233,9 +239,19 @@ class TonyConfig:
                 if "=" in pair:
                     k, _, v = pair.partition("=")
                     env[k] = v
+            slices = self.get_int(K.slices_key(jt),
+                                  int(K.JOB_TYPE_DEFAULTS["slices"]))
+            if slices < 1:
+                raise ValueError(f"tony.{jt}.slices must be >= 1, "
+                                 f"got {slices}")
+            if instances % slices:
+                raise ValueError(
+                    f"tony.{jt}.instances={instances} is not divisible by "
+                    f"tony.{jt}.slices={slices}; every slice gang has the "
+                    f"same host count")
             topology = self.get(K.tpu_topology_key(jt), "") or ""
             if topology:
-                self._validate_topology(jt, instances, topology)
+                self._validate_topology(jt, instances, topology, slices)
             requests[jt] = TaskRequest(
                 job_type=jt,
                 instances=instances,
@@ -245,11 +261,39 @@ class TonyConfig:
                 gpus=self.get_int(K.gpus_key(jt), 0),
                 tpus=self.get_int(K.tpus_key(jt), 0),
                 tpu_topology=topology,
+                slices=slices,
                 resources=self.get(K.resources_key(jt), "") or "",
                 env=env,
                 priority=priority,
             )
+        self._validate_dcn(requests)
         return requests
+
+    def _validate_dcn(self, requests: dict[str, TaskRequest]) -> None:
+        """Fail at parse time when tony.application.mesh.dcn cannot build a
+        hybrid mesh: every task would otherwise provision real slices, stage,
+        and only then die in runtime.mesh() (the fail-fast contract of
+        _validate_topology)."""
+        import math
+        dcn = self.mesh_dcn_axes()
+        if not dcn:
+            return
+        if any(v < 1 for v in dcn.values()):
+            raise ValueError(
+                f"tony.application.mesh.dcn sizes must be explicit positive "
+                f"integers (no -1 inference): {dcn}")
+        product = math.prod(dcn.values())
+        multi = {jt: r.slices for jt, r in requests.items() if r.slices > 1}
+        if not multi:
+            raise ValueError(
+                f"tony.application.mesh.dcn={dcn} is set but no job type "
+                f"has tony.{{job}}.slices > 1 — dcn axes span slices")
+        for jt, slices in multi.items():
+            if slices != product:
+                raise ValueError(
+                    f"tony.application.mesh.dcn={dcn} spans {product} "
+                    f"slices but tony.{jt}.slices={slices}; the dcn axis "
+                    f"product must equal the slice count")
 
     def untracked_job_types(self) -> set[str]:
         """Job types excluded from completion counting (reference:
@@ -265,6 +309,13 @@ class TonyConfig:
         surfacing as a bad mesh inside every task."""
         from tony_tpu.parallel.mesh import parse_mesh_string
         return parse_mesh_string(self.get(K.APPLICATION_MESH_KEY, "") or "")
+
+    def mesh_dcn_axes(self) -> dict[str, int]:
+        """Parse tony.application.mesh.dcn — the axes laid out ACROSS slices
+        (data-center network) for multi-slice jobs; {} for single-slice."""
+        from tony_tpu.parallel.mesh import parse_mesh_string
+        return parse_mesh_string(
+            self.get(K.APPLICATION_MESH_DCN_KEY, "") or "")
 
 
 def read_conf_file(path: str) -> dict[str, str]:

@@ -31,6 +31,10 @@ APPLICATION_NODE_LABEL_KEY = "tony.application.node-label"
 APPLICATION_PREPROCESS_KEY = "tony.application.enable-preprocess"
 APPLICATION_SECURITY_KEY = "tony.application.security.enabled"
 APPLICATION_MESH_KEY = "tony.application.mesh"                    # e.g. "dp=2,tp=4" (TPU-native)
+# DCN (cross-slice) mesh axes for multi-slice jobs, e.g. "dp=2": these axes
+# are laid out ACROSS slices (slow network), tony.application.mesh axes
+# within a slice (ICI). Only meaningful when some tony.{job}.slices > 1.
+APPLICATION_MESH_DCN_KEY = "tony.application.mesh.dcn"
 APPLICATION_UNTRACKED_KEY = "tony.application.untracked.jobtypes" # e.g. "ps"
 
 # ---------------------------------------------------------------------------
@@ -118,6 +122,7 @@ DEFAULTS: dict[str, str] = {
     APPLICATION_PREPROCESS_KEY: "false",
     APPLICATION_SECURITY_KEY: "false",
     APPLICATION_MESH_KEY: "",
+    APPLICATION_MESH_DCN_KEY: "",
     APPLICATION_UNTRACKED_KEY: "ps",
     AM_RETRY_COUNT_KEY: "0",
     AM_MEMORY_KEY: "2g",
@@ -195,6 +200,15 @@ def tpu_topology_key(job_type: str) -> str:
     return f"tony.{job_type}.tpu.topology"
 
 
+def slices_key(job_type: str) -> str:
+    """Multi-slice scale-out: number of pod slices (gangs) backing this job
+    type. tony.{job}.instances spans ALL slices (instances = slices ×
+    hosts-per-slice); collectives ride ICI within a slice and DCN across
+    (the per-job-type scaling analog of Utils.parseContainerRequests:314-340,
+    where the unit of scaling was one container instead of one gang)."""
+    return f"tony.{job_type}.slices"
+
+
 def resources_key(job_type: str) -> str:
     return f"tony.{job_type}.resources"
 
@@ -212,6 +226,7 @@ JOB_TYPE_DEFAULTS: dict[str, str] = {
     "gpus": "0",
     "tpus": "0",
     "tpu.topology": "",
+    "slices": "1",
     "resources": "",
     "env": "",
 }

@@ -74,8 +74,9 @@ JAX_PROCESS_ID = "TONY_JAX_PROCESS_ID"
 JAX_NUM_PROCESSES = "TONY_JAX_NUM_PROCESSES"
 TPU_TOPOLOGY = "TONY_TPU_TOPOLOGY"
 TPU_CHIPS_PER_HOST = "TONY_TPU_CHIPS_PER_HOST"
-MESH_SPEC = "TONY_MESH_SPEC"           # JSON: {"axes": {"dp": 2, "tp": 4, ...}}
-SLICE_ID = "TONY_SLICE_ID"
+MESH_SPEC = "TONY_MESH_SPEC"           # JSON: {"axes": {...}, "dcn_axes": {...}, "slice_spec": {...}}
+SLICE_ID = "TONY_SLICE_ID"             # this host's gang index within its job type
+NUM_SLICES = "TONY_NUM_SLICES"         # gangs backing this job type (tony.{job}.slices)
 
 # Data-feed handshake (replaces the reference's PY4J_GATEWAY_PORT,
 # Constants.java / TaskExecutor.java:87 — pure-Python executor needs no py4j).

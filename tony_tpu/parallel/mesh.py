@@ -72,6 +72,85 @@ def make_mesh(axes: dict[str, int] | None = None,
     return Mesh(devs.reshape(shape), names)
 
 
+def make_hybrid_mesh(ici_axes: dict[str, int], dcn_axes: dict[str, int],
+                     devices=None):
+    """Build a mesh spanning multiple pod slices: ``dcn_axes`` are laid out
+    ACROSS slices (data-center network — slow, so keep them to low-traffic
+    collectives like DP gradient reduction), ``ici_axes`` within each slice.
+
+    Uses ``jax.experimental.mesh_utils.create_hybrid_device_mesh`` when the
+    devices carry real slice indices (TPU multi-slice). On backends without
+    ``slice_index`` (the 8-device virtual CPU mesh used in tests and the
+    driver dryrun) it falls back to a contiguous reshape: the session
+    assigns dense process ids in slice-major order (cluster/session.py), so
+    contiguous device ranges ARE slices and the reshape places dcn axes
+    major / ici axes minor exactly like the real thing.
+
+    ``-1`` inference is supported on at most one ICI axis (the per-slice
+    device count divides it); dcn axes must be explicit — their product is
+    the slice count.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devs = list(jax.devices() if devices is None else devices)
+    n = len(devs)
+    dcn_axes = {k: v for k, v in dcn_axes.items()}
+    if not dcn_axes:
+        return make_mesh(ici_axes, devices=devs)
+    num_slices = math.prod(dcn_axes.values())
+    if any(v in (-1, 0) for v in dcn_axes.values()):
+        raise ValueError(f"dcn axes must be explicit (no -1): {dcn_axes}")
+    if n % num_slices:
+        raise ValueError(f"{n} devices do not split into {num_slices} "
+                         f"slices (dcn axes {dcn_axes})")
+    per_slice = n // num_slices
+    ici_axes = dict(ici_axes or {})
+    if not ici_axes:
+        # default axis name must not collide with a dcn axis ("dp" across
+        # slices + unset tony.application.mesh is the documented common case)
+        name = next(a for a in ("dp", "fsdp", "ici") if a not in dcn_axes)
+        ici_axes = {name: per_slice}
+    unknown = [k for k, v in ici_axes.items() if v in (-1, 0)]
+    known = math.prod(v for v in ici_axes.values() if v not in (-1, 0))
+    if len(unknown) == 1:
+        if per_slice % known:
+            raise ValueError(f"cannot infer {unknown[0]}: {per_slice} "
+                             f"per-slice devices not divisible by {known}")
+        ici_axes[unknown[0]] = per_slice // known
+    elif len(unknown) > 1:
+        raise ValueError(f"at most one inferred (-1) ici axis: {ici_axes}")
+    if math.prod(ici_axes.values()) != per_slice:
+        raise ValueError(f"ici axes {ici_axes} require "
+                         f"{math.prod(ici_axes.values())} devices per "
+                         f"slice, have {per_slice}")
+
+    # dcn major, then ici axes in canonical order
+    dcn_names = tuple(a for a in AXIS_ORDER if a in dcn_axes)
+    dcn_names += tuple(a for a in dcn_axes if a not in dcn_names)
+    ici_names = tuple(a for a in AXIS_ORDER if a in ici_axes)
+    ici_names += tuple(a for a in ici_axes if a not in ici_names)
+    overlap = set(dcn_names) & set(ici_names)
+    if overlap:
+        raise ValueError(f"axes {sorted(overlap)} appear in both the ici "
+                         f"and dcn layouts")
+    names = dcn_names + ici_names
+
+    if all(getattr(d, "slice_index", None) is not None for d in devs) \
+            and len({getattr(d, "slice_index") for d in devs}) > 1:
+        from jax.experimental import mesh_utils
+        # create_hybrid_device_mesh multiplies the two shapes elementwise,
+        # so pad with 1s to keep dcn axes (major) disjoint from ici axes
+        mesh_arr = mesh_utils.create_hybrid_device_mesh(
+            (1,) * len(dcn_names) + tuple(ici_axes[a] for a in ici_names),
+            tuple(dcn_axes[a] for a in dcn_names) + (1,) * len(ici_names),
+            devices=devs)
+        return Mesh(mesh_arr, names)
+    shape = tuple(dcn_axes[a] for a in dcn_names) + \
+        tuple(ici_axes[a] for a in ici_names)
+    return Mesh(np.array(devs).reshape(shape), names)
+
+
 def parse_mesh_string(spec: str) -> dict[str, int]:
     """Parse the ``tony.application.mesh`` config value: "dp=2,tp=4" →
     {"dp": 2, "tp": 4}. "-1" sizes are allowed (inferred at mesh build)."""

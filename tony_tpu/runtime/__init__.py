@@ -98,16 +98,45 @@ def mesh_axes() -> dict[str, int]:
     return json.loads(raw).get("axes", {})
 
 
+def mesh_dcn_axes() -> dict[str, int]:
+    """Cross-slice (DCN) mesh layout (tony.application.mesh.dcn), or {}
+    for single-slice jobs."""
+    raw = os.environ.get(constants.MESH_SPEC, "")
+    if not raw:
+        return {}
+    return json.loads(raw).get("dcn_axes", {})
+
+
+def slice_info() -> tuple[int, int]:
+    """(slice_id, num_slices) of this host's gang — (0, 1) when the job
+    type is single-slice (tony.{job}.slices unset or 1)."""
+    return (int(os.environ.get(constants.SLICE_ID, "0")),
+            int(os.environ.get(constants.NUM_SLICES, "1")))
+
+
 def mesh(axes: dict[str, int] | None = None,
-         axis_order: tuple[str, ...] | None = None):
+         axis_order: tuple[str, ...] | None = None,
+         dcn_axes: dict[str, int] | None = None):
     """Build a ``jax.sharding.Mesh`` over ALL devices (all processes).
 
     ``axes`` defaults to the config-shipped layout; a single axis given as
     -1/0 is inferred from the global device count (so the layout scales with
     the slice). Returns a 1-axis ``("dp",)`` mesh when nothing is configured.
-    Delegates to :func:`tony_tpu.parallel.mesh.make_mesh` — one
-    implementation of axis inference/ordering for the whole framework.
+    When the job is multi-slice and DCN axes are configured
+    (tony.application.mesh.dcn), the mesh is hybrid: dcn axes span slices,
+    ici axes stay within a slice. Delegates to
+    :mod:`tony_tpu.parallel.mesh` — one implementation of axis
+    inference/ordering for the whole framework.
     """
-    from tony_tpu.parallel.mesh import make_mesh
-    return make_mesh(axes if axes is not None else mesh_axes(),
-                     axis_order=axis_order)
+    from tony_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+    axes = axes if axes is not None else mesh_axes()
+    dcn = dcn_axes if dcn_axes is not None else mesh_dcn_axes()
+    if dcn:
+        if axis_order is not None:
+            # silently dropping the caller's order would remap their
+            # PartitionSpecs onto the wrong axes
+            raise ValueError("axis_order is not supported for hybrid "
+                             "(multi-slice) meshes: the order is fixed to "
+                             "dcn-major/ici-minor")
+        return make_hybrid_mesh(axes, dcn)
+    return make_mesh(axes, axis_order=axis_order)
