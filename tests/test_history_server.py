@@ -5,6 +5,7 @@ migration, per-job events/config pages, JSON API, caching, retention."""
 import json
 import os
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -261,3 +262,58 @@ def test_index_shows_uptime_column(tmp_path):
     page = server._render_index()
     assert "<th>Uptime</th>" in page
     assert "95.7%" in page
+
+
+def test_bearer_token_auth(dirs, tmp_path):
+    """With a token configured, every route except /healthz needs
+    `Authorization: Bearer <token>`; wrong/missing tokens get 401."""
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: dirs.location,
+        K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+        K.HISTORY_FINISHED_KEY: dirs.finished,
+        K.HISTORY_SERVER_TOKEN_KEY: "s3cret",
+    })
+    s = HistoryServer(conf, port=0)
+    s.start()
+    try:
+        def status(path, token=None):
+            req = urllib.request.Request(
+                f"http://localhost:{s.port}{path}")
+            if token:
+                req.add_header("Authorization", f"Bearer {token}")
+            try:
+                with urllib.request.urlopen(req, timeout=10) as r:
+                    return r.status
+            except urllib.error.HTTPError as e:
+                return e.code
+        assert status("/") == 401
+        assert status("/api/jobs") == 401
+        assert status("/api/jobs", token="wrong") == 401
+        assert status("/healthz") == 200          # probes stay open
+        assert status("/", token="s3cret") == 200
+        assert status("/api/jobs", token="s3cret") == 200
+    finally:
+        s.stop()
+
+
+def test_token_file_and_bind_default(dirs, tmp_path):
+    tf = tmp_path / "token"
+    tf.write_text("from-file\n")
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: dirs.location,
+        K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+        K.HISTORY_FINISHED_KEY: dirs.finished,
+        K.HISTORY_SERVER_TOKEN_FILE_KEY: str(tf),
+    })
+    s = HistoryServer(conf, port=0)
+    assert s.token == "from-file"       # file wins, whitespace stripped
+    assert s.bind == "127.0.0.1"        # loopback unless configured
+    with pytest.raises(ValueError, match="empty"):
+        empty = tmp_path / "empty"
+        empty.write_text("")
+        HistoryServer(TonyConfig({
+            K.HISTORY_LOCATION_KEY: dirs.location,
+            K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+            K.HISTORY_FINISHED_KEY: dirs.finished,
+            K.HISTORY_SERVER_TOKEN_FILE_KEY: str(empty),
+        }), port=0)

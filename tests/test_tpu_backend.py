@@ -60,7 +60,7 @@ def test_dry_run_gang_provisions_once():
         b.launch_task(LaunchSpec(task_id=f"worker:{i}", command="run",
                                  env={}, log_dir="/tmp", tpu_topology="4x4"))
     # one slice (gang) for all 4 hosts of the job type
-    assert list(b._slices) == ["worker"]
+    assert list(b._gangs) == [("worker", 0)]
     assert b.poll_completed() == []
     b.stop()
 
@@ -78,9 +78,9 @@ def test_multi_slice_gangs():
     for i in range(4):
         b.launch_task(LaunchSpec(task_id=f"worker:{i}", command="run",
                                  env={}, log_dir="/tmp", tpu_topology="4x4"))
-    assert sorted(b._slices) == ["worker/s0", "worker/s1"]
-    assert b._slices["worker/s0"] == "tony-app1-worker-s0"
-    assert b._slices["worker/s1"] == "tony-app1-worker-s1"
+    assert sorted(b._gangs) == [("worker", 0), ("worker", 1)]
+    assert b._gangs[("worker", 0)]["name"] == "tony-app1-worker-s0"
+    assert b._gangs[("worker", 1)]["name"] == "tony-app1-worker-s1"
     # per-gang commands address the right VM and in-slice host
     ssh = b.ssh_command("worker", 1, "echo hi", slice_idx=1)
     assert "tony-app1-worker-s1" in " ".join(ssh) and "--worker=1" in ssh
@@ -101,14 +101,14 @@ def test_relaunch_after_preemption_reprovisions():
                       log_dir="/tmp", tpu_topology="4x4")
     b.launch_task(spec)
     # simulate the slice being preempted and the task observed as dead
-    b._state_cache["worker"] = "PREEMPTED"
-    b._state_ts["worker"] = float("inf")     # keep the cache "fresh"
+    b._state_cache[("worker", 0)] = "PREEMPTED"
+    b._state_ts[("worker", 0)] = float("inf")   # keep the cache "fresh"
     b._reported.add("worker:0")
-    old_slice = b._slices["worker"]
+    old_slice = b._gangs[("worker", 0)]["name"]
     b.launch_task(spec)                      # session retry relaunch
     assert "worker:0" not in b._reported
-    assert b._state_cache.get("worker") != "PREEMPTED"
-    assert b._slices["worker"] == old_slice  # same name, freshly provisioned
+    assert b._state_cache.get(("worker", 0)) != "PREEMPTED"
+    assert b._gangs[("worker", 0)]["name"] == old_slice  # same name, freshly provisioned
     assert b.poll_completed() == []          # no instant preempted re-fail
     b.stop()
 

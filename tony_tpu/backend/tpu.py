@@ -92,11 +92,12 @@ class TpuSliceBackend(SchedulerBackend):
         # reference: tony.application.node-label): attached as a GCE label
         # so reservations/affinity tooling can match slices.
         self.node_label = conf.get(K.APPLICATION_NODE_LABEL_KEY) or ""
-        # gang key ("worker" or "worker/s1" for multi-slice) -> slice name
-        self._slices: dict[str, str] = {}
-        # gang key -> Event set once the gang is provisioned AND staged;
-        # launchers of other hosts in the gang wait on it OUTSIDE the lock
-        self._gang_ready: dict[str, threading.Event] = {}
+        # gang key (job_type, slice_idx) -> {"name": VM name, "ready":
+        # Event set once the gang is provisioned AND staged}. One entry per
+        # provisioning GENERATION: a failed/reprovisioned gang gets a fresh
+        # entry with a fresh event, so waiters can detect staleness by
+        # re-fetching the entry after their event fires.
+        self._gangs: dict[tuple[str, int], dict] = {}
         self._artifacts_lock = threading.Lock()
         self._procs: dict[str, subprocess.Popen] = {}
         self._reported: set[str] = set()
@@ -142,9 +143,11 @@ class TpuSliceBackend(SchedulerBackend):
         hosts = self._hosts_per_slice(job_type)
         return job_type, int(idx) // hosts, int(idx) % hosts
 
-    def _gang_key(self, job_type: str, slice_idx: int) -> str:
-        return (job_type if self._num_slices(job_type) == 1
-                else f"{job_type}/s{slice_idx}")
+    @staticmethod
+    def _gang_label(gang: tuple[str, int]) -> str:
+        """Human-readable form of a (job_type, slice_idx) gang key, for
+        logs/errors only — state dicts use the tuple."""
+        return f"{gang[0]}/s{gang[1]}"
 
     def _slice_name(self, job_type: str, slice_idx: int = 0) -> str:
         return slice_name(self.app_id, job_type, slice_idx,
@@ -257,7 +260,7 @@ class TpuSliceBackend(SchedulerBackend):
     # ------------------------------------------------------------------
     def launch_task(self, spec: LaunchSpec) -> None:
         job_type, slice_idx, host_idx = self._gang_of(spec.task_id)
-        gang = self._gang_key(job_type, slice_idx)
+        gang = (job_type, slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY,
                                       600000) / 1000
         # Claim-or-wait under the lock; the slow work (gcloud delete/create,
@@ -268,23 +271,23 @@ class TpuSliceBackend(SchedulerBackend):
             # Relaunch of the same task id (session retry): forget the old
             # generation's completion so the new one is observed.
             self._reported.discard(spec.task_id)
-            dead = gang in self._slices and self._state_cache.get(gang) \
+            dead = gang in self._gangs and self._state_cache.get(gang) \
                 in ("PREEMPTED", "TERMINATED")
             if dead:
                 # The gang's slice is gone — a retried session must get a
                 # fresh one, not instantly re-fail on the cached dead state.
-                log.info("slice for %s was %s — reprovisioning", gang,
-                         self._state_cache[gang])
-                del self._slices[gang]
-                self._gang_ready.pop(gang, None)
+                log.info("slice for %s was %s — reprovisioning",
+                         self._gang_label(gang), self._state_cache[gang])
+                del self._gangs[gang]
                 self._state_cache.pop(gang, None)
                 self._state_ts.pop(gang, None)
-            if gang not in self._slices:
-                self._slices[gang] = self._slice_name(job_type, slice_idx)
-                ready = self._gang_ready[gang] = threading.Event()
+            if gang not in self._gangs:
+                entry = {"name": self._slice_name(job_type, slice_idx),
+                         "ready": threading.Event()}
+                self._gangs[gang] = entry
                 is_provisioner = True
             else:
-                ready = self._gang_ready[gang]
+                entry = self._gangs[gang]
                 is_provisioner = False
         if is_provisioner:
             try:
@@ -298,18 +301,15 @@ class TpuSliceBackend(SchedulerBackend):
                 self._provision(job_type, slice_idx, spec)
             except BaseException:
                 with self._lock:
-                    self._slices.pop(gang, None)
-                ready.set()     # wake waiters; they see the gang vanished
+                    # Only retract OUR generation — a concurrent retry may
+                    # already have re-claimed the gang with a fresh entry.
+                    if self._gangs.get(gang) is entry:
+                        del self._gangs[gang]
+                entry["ready"].set()  # wake waiters; they re-check below
                 raise
-            ready.set()
-        elif not ready.is_set():
-            if not ready.wait(timeout=timeout_s):
-                raise TpuProvisioningError(
-                    f"timed out waiting for gang {gang} to provision")
-            with self._lock:
-                if gang not in self._slices:
-                    raise TpuProvisioningError(
-                        f"gang {gang} failed to provision")
+            entry["ready"].set()
+        else:
+            self._await_gang(gang, timeout_s)
         with self._lock:
             # The auth secret must NOT ride the ssh argv (visible in ps /
             # /proc); the host reads it from the chmod-600 staged file.
@@ -336,11 +336,36 @@ class TpuSliceBackend(SchedulerBackend):
                 cmd, stdout=open(f"{spec.log_dir}/{spec.task_id.replace(':', '-')}.stdout", "ab"),
                 stderr=subprocess.STDOUT)
 
+    def _await_gang(self, gang: tuple[str, int], timeout_s: float) -> None:
+        """Wait until the gang is provisioned+staged. The deadline covers
+        the provisioner's WHOLE pipeline — delete (reprovision path) +
+        create + staging commands, each individually bounded by timeout_s —
+        not a single interval, so a slow-but-succeeding provision does not
+        fail its co-gang tasks. Re-fetches the entry after every wake: a
+        failed generation's event is set as it is retracted, and a retry
+        may have re-claimed the gang with a fresh entry (and fresh event)
+        that must be waited on instead."""
+        deadline = time.monotonic() + 4 * timeout_s
+        while True:
+            with self._lock:
+                current = self._gangs.get(gang)
+                if current is None:
+                    raise TpuProvisioningError(
+                        f"gang {self._gang_label(gang)} failed to provision")
+                if current["ready"].is_set():
+                    return
+                ready = current["ready"]
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not ready.wait(timeout=remaining):
+                raise TpuProvisioningError(
+                    f"timed out waiting for gang {self._gang_label(gang)} "
+                    f"to provision")
+
     def _provision(self, job_type: str, slice_idx: int,
                    spec: LaunchSpec) -> None:
         """Create + stage one gang. Runs WITHOUT self._lock (launch_task
         claimed the gang first); touches no shared state."""
-        gang = self._gang_key(job_type, slice_idx)
+        gang = self._gang_label((job_type, slice_idx))
         cmd = self.create_slice_command(job_type, spec.tpu_topology,
                                         slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
@@ -421,17 +446,12 @@ class TpuSliceBackend(SchedulerBackend):
                 raise TpuProvisioningError(
                     f"staging failed for {job_type}: {res.stderr}")
 
-    def _gang_parts(self, gang: str) -> tuple[str, int]:
-        job_type, _, s = gang.partition("/s")
-        return job_type, int(s) if s else 0
-
-    def _slice_state(self, gang: str) -> str:
+    def _slice_state(self, gang: tuple[str, int]) -> str:
         if self.dry_run:
             return "READY"
-        job_type, slice_idx = self._gang_parts(gang)
         try:
             res = subprocess.run(
-                self.describe_command(job_type, slice_idx),
+                self.describe_command(gang[0], gang[1]),
                 capture_output=True, text=True, timeout=60)
         except subprocess.TimeoutExpired:
             return "UNKNOWN"
@@ -442,12 +462,19 @@ class TpuSliceBackend(SchedulerBackend):
     def _refresh_slice_states(self) -> None:
         now = time.monotonic()
         with self._lock:
-            stale = [g for g in self._slices
+            stale = [g for g in self._gangs
                      if now - self._state_ts.get(g, 0.0)
                      > self._state_refresh_s]
-        for g in stale:             # network calls OUTSIDE the lock
-            state = self._slice_state(g)
-            with self._lock:
+        if not stale:
+            return
+        # Describes run OUTSIDE the lock and concurrently: gangs are
+        # independent VMs, and serial 60s-timeout calls would stall
+        # completion/preemption reporting by minutes on a wide job.
+        from concurrent.futures import ThreadPoolExecutor
+        with ThreadPoolExecutor(max_workers=min(8, len(stale))) as pool:
+            states = list(pool.map(self._slice_state, stale))
+        with self._lock:
+            for g, state in zip(stale, states):
                 self._state_cache[g] = state
                 self._state_ts[g] = time.monotonic()
 
@@ -455,14 +482,14 @@ class TpuSliceBackend(SchedulerBackend):
         self._refresh_slice_states()
         events = []
         with self._lock:
-            preempted_gangs = {g for g in self._slices
+            preempted_gangs = {g for g in self._gangs
                                if self._state_cache.get(g, "READY")
                                in ("PREEMPTED", "TERMINATED")}
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue
                 jt, slice_idx, _ = self._gang_of(task_id)
-                if self._gang_key(jt, slice_idx) in preempted_gangs:
+                if (jt, slice_idx) in preempted_gangs:
                     # preemption kills one gang; the whole session retries
                     # (gang semantics), but only this gang reprovisions
                     self._reported.add(task_id)
@@ -526,11 +553,10 @@ class TpuSliceBackend(SchedulerBackend):
     def stop(self) -> None:
         self.kill_all()
         with self._lock:
-            for gang in list(self._slices):
-                jt, slice_idx = self._gang_parts(gang)
+            for jt, slice_idx in list(self._gangs):
                 cmd = self.delete_slice_command(jt, slice_idx=slice_idx)
                 if self.dry_run:
                     log.info("[dry-run] %s", " ".join(cmd))
                     continue
                 subprocess.run(cmd, capture_output=True, timeout=120)
-            self._slices.clear()
+            self._gangs.clear()

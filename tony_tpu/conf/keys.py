@@ -70,6 +70,15 @@ HISTORY_INTERMEDIATE_KEY = "tony.history.intermediate"
 HISTORY_FINISHED_KEY = "tony.history.finished"
 HISTORY_RETENTION_SECONDS_KEY = "tony.history.retention-seconds"
 HISTORY_SERVER_PORT_KEY = "tony.history.server.port"
+# Bind address: loopback by default — job configs can embed env/paths, so
+# exposing the server beyond the host is an explicit operator decision
+# (the reference's analog is its keytab login, tony-history-server/app/
+# hadoop/Security.java).
+HISTORY_SERVER_BIND_KEY = "tony.history.server.bind"
+# Bearer token required on every route except /healthz when set (directly
+# or via a chmod-600 file; the file wins).
+HISTORY_SERVER_TOKEN_KEY = "tony.history.server.token"
+HISTORY_SERVER_TOKEN_FILE_KEY = "tony.history.server.token-file"
 
 # ---------------------------------------------------------------------------
 # Backend / scheduler ("tony.scheduler.*" — new layer; the reference hardwires
@@ -142,6 +151,9 @@ DEFAULTS: dict[str, str] = {
     HISTORY_FINISHED_KEY: "",
     HISTORY_RETENTION_SECONDS_KEY: "2592000",
     HISTORY_SERVER_PORT_KEY: "19886",
+    HISTORY_SERVER_BIND_KEY: "127.0.0.1",
+    HISTORY_SERVER_TOKEN_KEY: "",
+    HISTORY_SERVER_TOKEN_FILE_KEY: "",
     SCHEDULER_BACKEND_KEY: "local",
     TPU_PROJECT_KEY: "",
     TPU_ZONE_KEY: "",

@@ -193,6 +193,20 @@ class HistoryServer:
         self.dirs.ensure()
         self.port = (port if port is not None
                      else conf.get_int(K.HISTORY_SERVER_PORT_KEY, 0))
+        # Loopback by default: served job configs can embed env values and
+        # paths. Exposing beyond the host (bind=0.0.0.0) is an explicit
+        # choice, and pairs with bearer-token auth below (the reference's
+        # auth analog is its keytab login, hadoop/Security.java).
+        self.bind = conf.get(K.HISTORY_SERVER_BIND_KEY) or "127.0.0.1"
+        token_file = conf.get(K.HISTORY_SERVER_TOKEN_FILE_KEY) or ""
+        if token_file:
+            with open(token_file, encoding="utf-8") as f:
+                self.token = f.read().strip()
+            if not self.token:
+                raise ValueError(
+                    f"history token file {token_file} is empty")
+        else:
+            self.token = conf.get(K.HISTORY_SERVER_TOKEN_KEY) or ""
         self.retention_s = conf.get_int(K.HISTORY_RETENTION_SECONDS_KEY, 0)
         self.metadata_cache = TTLCache(ttl_s=5.0)  # new jobs appear quickly
         self.events_cache = TTLCache()
@@ -383,10 +397,28 @@ class HistoryServer:
             def _json(self, obj, code: int = 200) -> None:
                 self._send(code, json.dumps(obj, indent=1), "application/json")
 
+            def _authorized(self) -> bool:
+                """Bearer-token check (constant-time). /healthz stays open
+                so load balancers can probe without the secret."""
+                if not server.token:
+                    return True
+                import hmac
+                header = self.headers.get("Authorization", "")
+                scheme, _, presented = header.partition(" ")
+                return (scheme.lower() == "bearer"
+                        and hmac.compare_digest(presented.strip(),
+                                                server.token))
+
             def do_GET(self):  # noqa: N802 (stdlib API name)
                 # Match on the path only — '/api/jobs?limit=5' must route.
                 path = self.path.split("?", 1)[0].rstrip("/") or "/"
                 try:
+                    if path != "/healthz" and not self._authorized():
+                        self.send_response(401)
+                        self.send_header("WWW-Authenticate", "Bearer")
+                        self.send_header("Content-Length", "0")
+                        self.end_headers()
+                        return
                     self._route(path)
                 except BrokenPipeError:
                     pass
@@ -433,14 +465,21 @@ class HistoryServer:
 
     def start(self) -> int:
         """Bind + serve on a background thread. Returns the bound port."""
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port),
+        if self.bind not in ("127.0.0.1", "localhost", "::1") \
+                and not self.token:
+            log.warning(
+                "history server binding %s WITHOUT auth — job configs may "
+                "embed env/paths; set %s (or .token-file) to require a "
+                "bearer token", self.bind, K.HISTORY_SERVER_TOKEN_KEY)
+        self._httpd = ThreadingHTTPServer((self.bind, self.port),
                                           self._make_handler())
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="history-server", daemon=True)
         self._thread.start()
-        log.info("history server on http://localhost:%d (intermediate=%s "
-                 "finished=%s)", self.port, self.dirs.intermediate,
+        log.info("history server on http://%s:%d (auth=%s intermediate=%s "
+                 "finished=%s)", self.bind, self.port,
+                 "bearer" if self.token else "off", self.dirs.intermediate,
                  self.dirs.finished)
         return self.port
 
