@@ -126,10 +126,12 @@ def _mlp(h, p, cfg):
 def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
                 cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
     """Extend the cache with a K-token chunk at positions pos..pos+K-1.
-    tokens: [B, K] int32; returns (logits [B, K, V] f32 — logits[:, i] is
-    the next-token distribution AFTER tokens[:, :i+1] — and the updated
-    cache). The chunked verify primitive for speculative decoding; K=1 is
-    the plain decode step."""
+    tokens: [B, K] int32; returns (logits [B, K, V] in
+    cfg.logits_storage_dtype — logits[:, i] is the next-token distribution
+    AFTER tokens[:, :i+1] — and the updated cache), rounded EXACTLY like
+    the training forward so greedy decode agrees with it token for token.
+    The chunked verify primitive for speculative decoding; K=1 is the
+    plain decode step."""
     x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
 
     def body(carry, inputs):
@@ -144,6 +146,7 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
+    logits = logits.astype(cfg.logits_storage_dtype)
     new_cache = {"k": new_k, "v": new_v,
                  "length": pos + tokens.shape[1]}
     return logits, new_cache
@@ -151,8 +154,9 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
 
 def decode_step(params: dict, token: jax.Array, cache: dict, pos,
                 cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
-    """One decode step. token: [B] int32; returns (logits [B, V] f32,
-    updated cache). ``pos`` is the position being written (traced ok)."""
+    """One decode step. token: [B] int32; returns (logits [B, V] in
+    cfg.logits_storage_dtype, updated cache). ``pos`` is the position
+    being written (traced ok)."""
     logits, new_cache = extend_step(params, token[:, None], cache, pos, cfg)
     return logits[:, 0], new_cache
 
@@ -160,7 +164,8 @@ def decode_step(params: dict, token: jax.Array, cache: dict, pos,
 def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
             max_len: int) -> tuple[jax.Array, dict]:
     """Process the whole prompt in one forward, filling the cache.
-    tokens: [B, S]; returns (last-position logits [B, V], cache)."""
+    tokens: [B, S]; returns (last-position logits [B, V] in
+    cfg.logits_storage_dtype, cache)."""
     b, s = tokens.shape
     cache = init_kv_cache(cfg, b, max_len)
     x = params["embed"][tokens].astype(cfg.dtype)
@@ -186,16 +191,19 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"],
                         preferred_element_type=jnp.float32)
+    logits = logits.astype(cfg.logits_storage_dtype)
     return logits, {"k": k_filled, "v": v_filled,
                     "length": jnp.asarray(s, jnp.int32)}
 
 
 def _sample(logits, rng, temperature: float, top_k: int):
-    """logits [B, V] f32 → (token [B], logprob [B]).
+    """logits [B, V] → (token [B], logprob [B]). Math in f32 whatever the
+    storage dtype.
 
     The returned logprob is the MODEL's log p(token) — computed from the
     raw logits, before top-k masking or temperature — so it is usable for
     perplexity / importance weights regardless of sampling settings."""
+    logits = logits.astype(jnp.float32)
     model_logp = jax.nn.log_softmax(logits, axis=-1)
     if top_k > 0:
         vals, _ = jax.lax.top_k(logits, top_k)

@@ -521,8 +521,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     scale = (d ** -0.5) if scale is None else scale
-    to_bhsd = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    qf, kf, vf = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    qf, kf, vf = to_flat(q), to_flat(k), to_flat(v)
     bh = b * h
     if bh % 8:
         # Mosaic needs the batch·head block dim divisible by 8 (2-D lse
