@@ -2,6 +2,7 @@
 offsets tile the byte range exactly (:42-60), multi-file read correctness
 (:66+), and shuffle mode — plus native-vs-python parity and the jax feed."""
 
+import json
 import os
 
 import numpy as np
@@ -478,3 +479,78 @@ def test_spill_header_larger_than_budget_still_progresses(tmp_path):
                 break
             got.extend(iter_file_records(spill))
     assert got == recs
+
+
+def test_convert_jsonl_roundtrip(tmp_path):
+    """tony convert: jsonl → TONY1; records and schema survive the round
+    trip through the real reader."""
+    from tony_tpu.client import cli
+    src = tmp_path / "corpus.jsonl"
+    recs = [{"text": f"doc {i}", "id": i} for i in range(100)]
+    src.write_text("\n".join(json.dumps(r) for r in recs) + "\n")
+    rc = cli.main(["convert", str(src), "--out-dir", str(tmp_path / "f")])
+    assert rc == 0
+    out = tmp_path / "f" / "corpus.tony1"
+    with FileSplitReader([str(out)], use_native=False) as r:
+        got = [json.loads(b) for b in r]
+    assert got == recs
+    with FileSplitReader([str(out)], use_native=False) as r:
+        assert json.loads(r.schema_json) == {"format": "jsonl"}
+
+
+def test_convert_fixed_records_and_short_tail(tmp_path):
+    from tony_tpu.io.convert import convert_file
+    src = tmp_path / "d.bin"
+    src.write_bytes(bytes(range(40)))
+    dest = str(tmp_path / "d.tony1")
+    n = convert_file(str(src), dest, "fixed", {"rs": 8}, record_size=8)
+    assert n == 5
+    with FileSplitReader([dest], use_native=False) as r:
+        assert list(r)[0] == bytes(range(8))
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(bytes(42))
+    with pytest.raises(ValueError, match="trailing"):
+        convert_file(str(bad), str(tmp_path / "x"), "fixed", {},
+                     record_size=8)
+    assert not os.path.exists(tmp_path / "x")   # no half-framed leftovers
+
+
+def test_convert_rejects_bad_jsonl(tmp_path):
+    from tony_tpu.io.convert import convert_file
+    src = tmp_path / "bad.jsonl"
+    src.write_text('{"ok": 1}\nnot-json\n')
+    with pytest.raises(json.JSONDecodeError):
+        convert_file(str(src), str(tmp_path / "o"), "jsonl", {})
+
+
+def test_convert_stem_collision_rejected(tmp_path):
+    from tony_tpu.client import cli
+    (tmp_path / "a").mkdir(); (tmp_path / "b").mkdir()
+    (tmp_path / "a" / "c.jsonl").write_text('{"x":1}\n')
+    (tmp_path / "b" / "c.jsonl").write_text('{"x":2}\n')
+    with pytest.raises(SystemExit):
+        cli.main(["convert", str(tmp_path / "a" / "c.jsonl"),
+                  str(tmp_path / "b" / "c.jsonl"),
+                  "--out-dir", str(tmp_path / "o")])
+
+
+def test_convert_option_first_and_tmp_cleanup(tmp_path):
+    from tony_tpu.client import cli
+    src = tmp_path / "x.txt"
+    src.write_text("one\ntwo\n")
+    # leading option must reach the converter's parser
+    rc = cli.main(["convert", "--format", "lines", str(src),
+                   "--out-dir", str(tmp_path / "o")])
+    assert rc == 0
+    with FileSplitReader([str(tmp_path / "o" / "x.tony1")],
+                         use_native=False) as r:
+        assert list(r) == [b"one", b"two"]
+    # a failing conversion leaves neither dest nor dest.tmp behind
+    from tony_tpu.io.convert import convert_file
+    bad = tmp_path / "bad.bin"
+    bad.write_bytes(bytes(42))
+    with pytest.raises(ValueError):
+        convert_file(str(bad), str(tmp_path / "y"), "fixed", {},
+                     record_size=8)
+    assert not os.path.exists(tmp_path / "y")
+    assert not os.path.exists(tmp_path / "y.tmp")

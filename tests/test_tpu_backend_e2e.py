@@ -93,6 +93,25 @@ class TestTpuBackendE2E:
             assert body.splitlines()[0].endswith("tony-job")
             assert "tony-final.xml" in body
 
+    def test_multi_slice_two_gangs(self, fake_gcloud, tmp_path):
+        """tony.worker.slices=2: TWO slices are provisioned and staged,
+        each gang's executors run with in-slice --worker indices, and every
+        task sees its gang identity (TONY_SLICE_ID / TONY_NUM_SLICES)."""
+        proof = tmp_path / "gang"
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.worker.instances": "4",
+                                "tony.worker.slices": "2"}),
+            f'bash -c "echo $TONY_SLICE_ID/$TONY_NUM_SLICES '
+            f'> {proof}-$TASK_INDEX"')
+        assert client.run() == 0
+        ops = [c.split()[3] for c in calls(fake_gcloud)]
+        assert ops.count("create") == 2          # one VM per gang
+        creates = [c.split()[4] for c in calls(fake_gcloud)
+                   if c.split()[3] == "create"]
+        assert {n[-3:] for n in creates} == {"-s0", "-s1"}
+        for idx, want in ((0, "0/2"), (1, "0/2"), (2, "1/2"), (3, "1/2")):
+            assert open(f"{proof}-{idx}").read().strip() == want
+
     def test_staged_framework_is_importable(self, fake_gcloud, tmp_path):
         """Executors must run from the STAGED tony_tpu copy (no install on
         hosts): the user task prints tony_tpu.__file__ and it must resolve

@@ -35,6 +35,11 @@ def build_parser() -> argparse.ArgumentParser:
     k = sub.add_parser("kill", help="kill a running job by its job dir")
     k.add_argument("job_dir", help="the job's staging dir "
                                    "(<tony.staging.dir>/<app_id>)")
+    c = sub.add_parser(
+        "convert", add_help=False,
+        help="convert data files to TONY1 framed records "
+             "(see python -m tony_tpu.io.convert --help)")
+    c.add_argument("convert_args", nargs=argparse.REMAINDER)
     for name, help_text in (
             ("submit", "submit a job (ClusterSubmitter analog)"),
             ("local", "submit forcing the local subprocess backend"),
@@ -61,7 +66,14 @@ def main(argv: list[str] | None = None) -> int:
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s: %(message)s")
-    args = build_parser().parse_args(argv)
+    raw = sys.argv[1:] if argv is None else list(argv)
+    if raw[:1] == ["convert"]:
+        # Forward EVERYTHING (including a leading --option or --help) to
+        # the converter's own parser — argparse.REMAINDER on a subparser
+        # refuses option-first argument lists.
+        from tony_tpu.io.convert import main as convert_main
+        return convert_main(raw[1:])
+    args = build_parser().parse_args(raw)
     if args.command == "kill":
         return kill_job(args.job_dir)
     overrides = parse_cli_confs(args.conf)

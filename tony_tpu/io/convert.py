@@ -1,0 +1,144 @@
+"""Convert line- or fixed-record data files into TONY1 framed files.
+
+The on-ramp to the framed data feed (tony_tpu/io/framed.py — the
+DataFileWriter analog of the reference's Avro pipeline,
+HdfsAvroFileSplitReader.java): training corpora usually arrive as JSONL /
+text / fixed-size binary records, and framing them buys block-level split
+sync, a schema channel, and variable-length records across multi-host
+splits.
+
+    python -m tony_tpu.io.convert corpus-*.jsonl --out-dir framed/
+    tony convert corpus.txt --format lines --schema '{"field": "text"}'
+
+One output file per input (``<name>.tony1`` beside it or under
+``--out-dir``), so the converted corpus shards exactly like the original
+file list.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Iterator
+
+from tony_tpu.io.framed import DEFAULT_BLOCK_BYTES, FramedWriter
+
+
+def iter_records(path: str, fmt: str, record_size: int) -> Iterator[bytes]:
+    """Yield raw record payloads from an input file.
+
+    jsonl/lines: one record per newline-terminated line (the newline is
+    NOT part of the record — framing replaces it as the delimiter).
+    fixed: consecutive ``record_size``-byte records; a short tail raises
+    (silent truncation would drop data the caller believes was converted).
+    """
+    if fmt in ("jsonl", "lines"):
+        with open(path, "rb") as f:
+            for line in f:
+                line = line.rstrip(b"\n")
+                if not line and fmt == "jsonl":
+                    continue          # blank lines are not JSON records
+                if fmt == "jsonl":
+                    json.loads(line)  # validate now, not mid-training
+                yield line
+    elif fmt == "fixed":
+        if record_size <= 0:
+            raise ValueError("--record-size is required for --format fixed")
+        with open(path, "rb") as f:
+            while True:
+                rec = f.read(record_size)
+                if not rec:
+                    break
+                if len(rec) < record_size:
+                    raise ValueError(
+                        f"{path}: trailing {len(rec)} bytes do not form a "
+                        f"{record_size}-byte record")
+                yield rec
+    else:
+        raise ValueError(f"unknown format {fmt!r}")
+
+
+def convert_file(src: str, dest: str, fmt: str, schema: dict | str,
+                 record_size: int = 0,
+                 block_bytes: int = DEFAULT_BLOCK_BYTES) -> int:
+    """Convert one file; returns the number of records written. Writes to
+    ``dest + '.tmp'`` and renames, so an interrupted run never leaves a
+    half-framed file that readers would reject."""
+    tmp = dest + ".tmp"
+    try:
+        with FramedWriter(tmp, schema=schema, block_bytes=block_bytes) as w:
+            for rec in iter_records(src, fmt, record_size):
+                w.append(rec)
+            count = w.records_written
+        os.replace(tmp, dest)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return count
+
+
+def default_schema(fmt: str, record_size: int) -> dict:
+    if fmt == "jsonl":
+        return {"format": "jsonl"}
+    if fmt == "lines":
+        return {"format": "text-lines"}
+    return {"format": "fixed", "record_size": record_size}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="tony-convert",
+        description="Convert data files to the TONY1 framed record format")
+    parser.add_argument("inputs", nargs="+", help="input data files")
+    parser.add_argument("--format", default="jsonl",
+                        choices=("jsonl", "lines", "fixed"),
+                        help="input record framing (default jsonl)")
+    parser.add_argument("--record-size", type=int, default=0,
+                        help="record byte size for --format fixed")
+    parser.add_argument("--schema", default="",
+                        help="JSON schema string stored in the file header "
+                             "(default: derived from --format)")
+    parser.add_argument("--out-dir", default="",
+                        help="write <name>.tony1 here (default: beside "
+                             "each input)")
+    parser.add_argument("--block-bytes", type=int,
+                        default=DEFAULT_BLOCK_BYTES,
+                        help="target framed block size")
+    args = parser.parse_args(argv)
+
+    schema = (json.loads(args.schema) if args.schema
+              else default_schema(args.format, args.record_size))
+    if args.out_dir:
+        os.makedirs(args.out_dir, exist_ok=True)
+    dests = []
+    for src in args.inputs:
+        base = os.path.basename(src)
+        stem = base.rsplit(".", 1)[0] if "." in base else base
+        out_dir = args.out_dir or os.path.dirname(os.path.abspath(src))
+        dests.append(os.path.join(out_dir, stem + ".tony1"))
+    # Same-stem inputs (a/corpus.jsonl + b/corpus.jsonl with --out-dir, or
+    # a.jsonl + a.txt) would silently overwrite each other's output.
+    seen: dict[str, str] = {}
+    for src, dest in zip(args.inputs, dests):
+        if dest in seen:
+            parser.error(f"{src} and {seen[dest]} both convert to {dest}; "
+                         f"rename an input or convert them separately")
+        seen[dest] = src
+    total = 0
+    for src, dest in zip(args.inputs, dests):
+        n = convert_file(src, dest, args.format, schema,
+                         record_size=args.record_size,
+                         block_bytes=args.block_bytes)
+        total += n
+        print(f"{src} -> {dest}: {n} records")
+    print(f"converted {total} records from {len(args.inputs)} file(s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
