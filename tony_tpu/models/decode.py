@@ -78,19 +78,20 @@ def _cached_attention(q, k_cache, v_cache, q_start):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg):
+def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg, rope):
     """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1; caches
-    [B, max_len, H, hd] already containing this layer's past; returns
+    [B, max_len, H, hd] already containing this layer's past; ``rope``:
+    (cos, sin) tables precomputed once per chunk (position-only, so
+    layer-invariant — same hoisting as the training forward); returns
     (x, new_k, new_v)."""
     p = layer_params
-    b, n_q, _ = x.shape
-    positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
+    cos, sin = rope
 
     h = rms_norm_reference(x, p["attn_norm"])
     q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
-    q, k = T._rope(q, positions), T._rope(k, positions)
+    q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
     # write this chunk into the cache
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
@@ -133,12 +134,15 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
     The chunked verify primitive for speculative decoding; K=1 is the
     plain decode step."""
     x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
+    b, n_q = tokens.shape
+    positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
+    rope = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
     def body(carry, inputs):
         x = carry
         layer_params, k_cache, v_cache = inputs
         x, k_cache, v_cache = _decode_block(
-            x, layer_params, k_cache, v_cache, pos, cfg)
+            x, layer_params, k_cache, v_cache, pos, cfg, rope)
         return x, (k_cache, v_cache)
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -170,6 +174,7 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     cache = init_kv_cache(cfg, b, max_len)
     x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    cos, sin = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
     def body(x, inputs):
         p, k_cache, v_cache = inputs
@@ -177,7 +182,7 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
         k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
         v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
-        q, k = T._rope(q, positions), T._rope(k, positions)
+        q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
         o = T._attention(q, k, v, None)
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
