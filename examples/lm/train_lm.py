@@ -91,6 +91,11 @@ def main() -> int:
                         help="binary token files (records of seq_len+1 "
                              "int32 ids) fed via the sharded data layer; "
                              "empty = synthetic data")
+    parser.add_argument("--cp_strategy", default="ring",
+                        choices=("ring", "ulysses"),
+                        help="context-parallel attention when the mesh has "
+                             "a cp axis: ring (ppermute K/V rotation) or "
+                             "ulysses (all-to-all head resharding)")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -101,7 +106,8 @@ def main() -> int:
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        cp_strategy=args.cp_strategy)
 
     params = shard_pytree(T.init_params(jax.random.PRNGKey(0), cfg),
                           T.logical_axes(cfg), mesh)

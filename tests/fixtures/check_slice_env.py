@@ -10,4 +10,8 @@ assert num_slices == mine["slices"]
 assert slice_id == idx // mine["hosts_per_slice"], (slice_id, idx, mine)
 assert 0 <= slice_id < num_slices
 assert spec["dcn_axes"] == {"dp": 2}, spec
+# libtpu multi-slice contract rides along
+assert os.environ["MEGASCALE_NUM_SLICES"] == os.environ["TONY_NUM_SLICES"]
+assert os.environ["MEGASCALE_SLICE_ID"] == os.environ["TONY_SLICE_ID"]
+assert os.environ["MEGASCALE_COORDINATOR_ADDRESS"]
 sys.exit(0)

@@ -544,16 +544,18 @@ runpy.run_path(r"{script}", run_name="__main__")
         assert "done:" in out
 
     @pytest.mark.slow
-    def test_distributed_context_parallel_lm_trains(self, tmp_path):
+    @pytest.mark.parametrize("strategy", ["ring", "ulysses"])
+    def test_distributed_context_parallel_lm_trains(self, tmp_path, strategy):
         """Long-context config: the LM trains with the sequence sharded over
-        a 2-process cp mesh axis — ring attention's ppermute collectives run
-        across real process boundaries, not just virtual devices."""
+        a 2-process cp mesh axis — ring attention's ppermute (or Ulysses'
+        all-to-all) collectives run across real process boundaries, not
+        just virtual devices."""
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         script = os.path.join(repo, "examples", "lm", "train_lm.py")
         client = make_client(
             tmp_path,
             f"{PY} {script} --steps 3 --batch_size 2 --seq_len 128 "
-            f"--preset tiny",
+            f"--preset tiny --cp_strategy {strategy}",
             {"tony.worker.instances": "2",
              "tony.application.mesh": "cp=2",
              "tony.application.timeout": "180000"},

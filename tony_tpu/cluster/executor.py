@@ -164,6 +164,7 @@ class TaskExecutor:
         }
         if self.notebook_port:
             env[constants.NOTEBOOK_PORT] = str(self.notebook_port)
+        cluster = json.loads(self.bootstrap["cluster_spec"])
         # Multi-slice identity: which gang of the job type this host is in
         # (tony.{job}.slices > 1). Index order is slice-major (session.py).
         slice_spec = json.loads(
@@ -180,12 +181,23 @@ class TaskExecutor:
                 env[constants.TONY_PROFILE_DIR] = profile_dir
         framework = (self.conf.get(K.APPLICATION_FRAMEWORK_KEY) or
                      constants.FRAMEWORK_JAX).lower()
-        cluster = json.loads(self.bootstrap["cluster_spec"])
         if framework == constants.FRAMEWORK_JAX:
             env[constants.JAX_COORDINATOR_ADDRESS] = self.bootstrap["coordinator_address"]
             env[constants.JAX_PROCESS_ID] = str(self.bootstrap["process_id"])
             env[constants.JAX_NUM_PROCESSES] = str(self.bootstrap["num_processes"])
             env[constants.MESH_SPEC] = self.bootstrap["mesh_spec"]
+            if mine:
+                # libtpu's DCN-transport contract (what GKE /
+                # queued-resources multislice injects): coordinator =
+                # slice 0's first host. JAX-only — libtpu reads these at
+                # init regardless of framework, and a TF/PT job has no
+                # megascale coordinator to point at.
+                hosts = cluster.get(self.job_name) or []
+                if hosts:
+                    env[constants.MEGASCALE_COORDINATOR_ADDRESS] = \
+                        hosts[0].rsplit(":", 1)[0]
+                env[constants.MEGASCALE_NUM_SLICES] = str(mine["slices"])
+                env[constants.MEGASCALE_SLICE_ID] = env[constants.SLICE_ID]
         elif framework == constants.FRAMEWORK_TENSORFLOW:
             # TF_CONFIG assembly (reference: Utils.constructTFConfig:383)
             env[constants.TF_CONFIG] = json.dumps({

@@ -77,6 +77,13 @@ TPU_CHIPS_PER_HOST = "TONY_TPU_CHIPS_PER_HOST"
 MESH_SPEC = "TONY_MESH_SPEC"           # JSON: {"axes": {...}, "dcn_axes": {...}, "slice_spec": {...}}
 SLICE_ID = "TONY_SLICE_ID"             # this host's gang index within its job type
 NUM_SLICES = "TONY_NUM_SLICES"         # gangs backing this job type (tony.{job}.slices)
+# libtpu's multi-slice (DCN collectives) contract, exported alongside the
+# TONY_* pair for JAX-framework multi-slice job types: libtpu reads these to
+# set up the cross-slice transport (the same env GKE/queued-resources
+# multislice deployments inject).
+MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
+MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
+MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 
 # Data-feed handshake (replaces the reference's PY4J_GATEWAY_PORT,
 # Constants.java / TaskExecutor.java:87 — pure-Python executor needs no py4j).
