@@ -62,9 +62,10 @@ def main() -> None:
         # 512d/8L bf16, seq 1024. remat off (this size fits HBM comfortably
         # on one chip, ~7% faster), layers fully unrolled (drops the
         # scan's activation-stacking DUS ops, ~6% faster; compile cost is
-        # paid once), batch 16 (batch 8 leaves the MXU ~5% under-fed).
+        # paid once), batch 32 (+12% over 16 in interleaved A/B once bf16
+        # logits storage freed the headroom).
         cfg = T.PRESETS["small"].scaled(remat=False, scan_unroll=8)
-        batch, seq, iters = 16, 1024, 20
+        batch, seq, iters = 32, 1024, 20
     else:                                    # CPU smoke fallback
         cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32)
         batch, seq, iters = 2, 128, 3

@@ -81,6 +81,30 @@ def _causal_mask(qi, ki, bq: int, bk: int):
     return qpos >= kpos
 
 
+def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None):
+    """Causal block triage, shared by every kernel: blocks entirely above
+    the diagonal are skipped (``on_skip`` runs if given — e.g. zeroing
+    partial outputs), blocks entirely below it run ``accumulate(False)``
+    (no per-element compare/select — measurable in these VPU-bound
+    kernels, increasingly so at long sequence where such blocks dominate),
+    and diagonal-crossing blocks run ``accumulate(True)``."""
+    work = (qi + 1) * bq > ki * bk
+    unmasked = qi * bq >= (ki + 1) * bk - 1
+
+    @pl.when(jnp.logical_and(work, unmasked))
+    def _():
+        accumulate(False)
+
+    @pl.when(jnp.logical_and(work, jnp.logical_not(unmasked)))
+    def _():
+        accumulate(True)
+
+    if on_skip is not None:
+        @pl.when(jnp.logical_not(work))
+        def _():
+            on_skip()
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -98,8 +122,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
     def _init():
         ml_scr[:] = jnp.full_like(ml_scr, _NEG_INF)
 
-    def _accumulate():
-        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+    def _accumulate(masked: bool):
+        mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
             q = q_ref[gi]                              # [bq, d]
             k = k_ref[gi]                              # [bk, d]
@@ -107,7 +131,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
-            if causal:
+            if masked:
                 s = jnp.where(mask, s, _NEG_INF)
             m_prev = ml_scr[gi, :, 0:1]                # [bq, 1]
             l_prev = ml_scr[gi, :, 1:2]
@@ -128,12 +152,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             ml_scr[gi, :, 1:2] = l_new
 
     if causal:
-        # skip kv blocks entirely above the diagonal
-        @pl.when((qi + 1) * bq > ki * bk)
-        def _():
-            _accumulate()
+        _causal_dispatch(qi, ki, bq, bk, _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -204,10 +225,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    work = ((qi + 1) * bq > ki * bk) if causal else None
-
-    def _accumulate():
-        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+    def _accumulate(masked: bool):
+        mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
             q = q_ref[gi]                               # [bq, d]
             k = k_ref[gi]                               # [bk, d]
@@ -220,7 +239,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
-            if causal:
+            if masked:
                 s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)                        # [bq, bk]
             dvp_ref[0, gi] = jax.lax.dot_general(
@@ -236,19 +255,16 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             dq_scr[gi] += jax.lax.dot(ds.astype(k.dtype), k,
                                       preferred_element_type=jnp.float32)
 
-    if causal:
-        @pl.when(work)
-        def _():
-            _accumulate()
-
+    def _zero():
         # blocks above the diagonal contribute nothing, but their partial
         # output blocks still exist and must be zeroed
-        @pl.when(jnp.logical_not(work))
-        def _zero():
-            dkp_ref[:] = jnp.zeros_like(dkp_ref)
-            dvp_ref[:] = jnp.zeros_like(dvp_ref)
+        dkp_ref[:] = jnp.zeros_like(dkp_ref)
+        dvp_ref[:] = jnp.zeros_like(dvp_ref)
+
+    if causal:
+        _causal_dispatch(qi, ki, bq, bk, _accumulate, on_skip=_zero)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -322,8 +338,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
-    def _accumulate():
-        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+    def _accumulate(masked: bool):
+        mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
             q = q_ref[gi]
             k = k_ref[gi]
@@ -334,7 +350,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale
-            if causal:
+            if masked:
                 s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)                        # [bq, bk]
             dp = jax.lax.dot_general(
@@ -345,11 +361,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                       preferred_element_type=jnp.float32)
 
     if causal:
-        @pl.when((qi + 1) * bq > ki * bk)
-        def _():
-            _accumulate()
+        _causal_dispatch(qi, ki, bq, bk, _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(ki == nk - 1)
     def _finalize():
@@ -371,8 +385,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _accumulate():
-        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+    def _accumulate(masked: bool):
+        mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
             q = q_ref[gi]                               # [bq, d]
             k = k_ref[gi]                               # [bk, d]
@@ -383,7 +397,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
-            if causal:
+            if masked:
                 s = jnp.where(mask, s, _NEG_INF)
             p = jnp.exp(s - lse)                        # [bq, bk]
             dv_scr[gi] += jax.lax.dot_general(
@@ -398,11 +412,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)     # [bk, d]
 
     if causal:
-        @pl.when((qi + 1) * bq > ki * bk)
-        def _():
-            _accumulate()
+        _causal_dispatch(qi, ki, bq, bk, _accumulate)
     else:
-        _accumulate()
+        _accumulate(False)
 
     @pl.when(qi == nq - 1)
     def _finalize():
@@ -417,6 +429,16 @@ def _flash_backward(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     if nq <= _FUSED_MAX_NQ:
         return _flash_backward_fused(q, k, v, o, lse, do, scale=scale,
                                      causal=causal, g=g, bq=bq, bk=bk)
+    # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
+    # [bq, bk] f32 intermediates count twice; 256-wide blocks keep the
+    # two-pass kernels inside the ~16 MB VMEM budget (long sequences have
+    # hundreds of grid steps either way).
+    if bq > 256 and sq % 256 == 0:
+        bq = 256
+        nq = _cdiv(sq, bq)
+    if bk > 256 and sk % 256 == 0:
+        bk = 256
+        nk = _cdiv(sk, bk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                            # [bh, sq]
     lse_l = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
