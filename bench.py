@@ -152,6 +152,16 @@ def main() -> None:
                 base_tps * T.train_flops_per_token(base, b_seq) / peak, 4)
         out["decode_tokens_per_s"] = decode_tps
 
+        # Secondary: long context (seq 8192) — exercises the flash kernels
+        # in the regime where attention dominates layer FLOPs.
+        l_batch, l_seq = 2, 8192
+        l_tokens = jax.random.randint(jax.random.PRNGKey(6),
+                                      (l_batch, l_seq + 1), 0,
+                                      cfg.vocab_size)
+        l_data = {"inputs": l_tokens[:, :l_seq], "targets": l_tokens[:, 1:]}
+        out["seq8k_tokens_per_s"] = round(
+            l_batch * l_seq / run(cfg, l_data, 10), 1)
+
     print(json.dumps(out))
 
 

@@ -25,10 +25,11 @@ Backward recomputes scores (no O(S²) residuals) in a single fused pass by
 default: dQ accumulates in VMEM over the kv grid dimension while per-q-block
 dK/dV partials ([nq, b·h, S, D] f32) are reduced by XLA outside — one
 score/exp recompute instead of the classic two-pass split's two, which is
-what matters in this VPU-bound regime. Long sequences (nq > _FUSED_MAX_NQ,
-where the partials' HBM footprint scales with nq) fall back to the two-pass
-split: one pass gridded over q-blocks accumulating dQ, one over kv-blocks
-accumulating dK/dV. Wired together with ``jax.custom_vjp``.
+what matters in this VPU-bound regime. When the partials would exceed the
+``_FUSED_PARTIALS_BYTES`` budget (their HBM footprint scales with nq), the
+backward falls back to the two-pass split: one pass gridded over q-blocks
+accumulating dQ, one over kv-blocks accumulating dK/dV. Wired together
+with ``jax.custom_vjp``.
 
 On non-TPU backends (the 8-device CPU test mesh) the same kernels run in
 Pallas interpret mode — bit-accurate, slow — or callers use
@@ -208,11 +209,14 @@ def _flash_forward(q, k, v, *, scale, causal, g, bq, bk):
 # because the kernel is VPU-bound (softmax ops, not MXU FLOPs, set the
 # wall-clock at LM head dims). delta = rowsum(dO·O) is computed in-kernel
 # from the resident dO/O blocks, so no [.., _LANES] broadcasts ever touch
-# HBM. Partial dK/dV memory is nq × the tensor size, so long sequences
-# (nq > _FUSED_MAX_NQ) fall back to the two-pass kernels below.
+# HBM. Partial dK/dV memory is nq × the tensor size, so the fused path is
+# used while the partials stay under _FUSED_PARTIALS_BYTES each (fused
+# measured 32% faster than two-pass at seq 8192 on one v5e — the saved
+# recompute beats the partial traffic by a wide margin); truly huge
+# seq × batch·head products fall back to the two-pass kernels below.
 # ---------------------------------------------------------------------------
 
-_FUSED_MAX_NQ = 4
+_FUSED_PARTIALS_BYTES = 512 * 1024 * 1024   # per partial tensor (there are 2)
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
@@ -274,12 +278,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
 def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    # The fused kernel holds 5 input blocks + dq + 2 partial outputs + 4
-    # [bq, bk] f32 intermediates per step; kv blocks of 256 keep that under
-    # the ~16 MB VMEM budget at g=8, d=64 (512-wide kv blocks blow it).
-    # Only clamp when 256 still tiles the kv length — otherwise the last
-    # block would read out-of-bounds padding, which nothing masks in the
-    # non-causal case.
+    # The fused kernel holds 5 input blocks + dq + 2 partial outputs plus
+    # the [bq, bk] f32 intermediates — 4 per compiled body, and Mosaic
+    # allocates stack for BOTH _causal_dispatch bodies, so 8 count toward
+    # the budget; kv blocks of 256 keep that under the ~16 MB VMEM limit
+    # at g=8, d=64 (512-wide kv blocks blow it). Only clamp when 256
+    # still tiles the kv length — otherwise the last block would read
+    # out-of-bounds padding, which nothing masks in the non-causal case.
     if bk > 256 and sk % 256 == 0:
         bk = 256
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
@@ -304,9 +309,10 @@ def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
             jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
             # Partials are stored at input precision, not f32: each element
             # is a complete f32 MXU accumulation over the q-block rows
-            # rounded ONCE, and the ≤ _FUSED_MAX_NQ partials are summed in
-            # f32 below — error ~ √nq · eps, the same order as the two-pass
-            # path's single output rounding, for half the partial HBM
+            # rounded ONCE, and the partials are summed in f32 below.
+            # Worst-case error ~ √nq · eps_bf16 (≤ ~2% at the budget's
+            # nq ≈ 22; measured ≤ 0.7% at nq = 16, covered by
+            # test_gradients_bfloat16_long_seq) — for half the partial HBM
             # traffic (f32 partials also push the kernel past 16 MB VMEM).
             jax.ShapeDtypeStruct((nq, bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((nq, bh, sk, d), v.dtype),
@@ -426,7 +432,8 @@ def _flash_backward(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
-    if nq <= _FUSED_MAX_NQ:
+    partial_bytes = nq * bh * sk * d * q.dtype.itemsize
+    if partial_bytes <= _FUSED_PARTIALS_BYTES:
         return _flash_backward_fused(q, k, v, o, lse, do, scale=scale,
                                      causal=causal, g=g, bq=bq, bk=bk)
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
