@@ -718,6 +718,34 @@ runpy.run_path(r"{script}", run_name="__main__")
         from tony_tpu.client import cli
         assert cli.main(["kill", str(tmp_path)]) == 1
 
+    def test_tony_status_running_and_finished(self, tmp_path, capsys):
+        """`tony status <job_dir>`: live coordinator status + task URLs
+        while running, final-status.json afterwards, error for unknown."""
+        import threading
+        from tony_tpu.client import cli
+
+        client = make_client(tmp_path, fixture_cmd("sleep_forever.py"),
+                             {"tony.worker.instances": "1",
+                              "tony.application.security.enabled": "true"})
+        result = {}
+        t = threading.Thread(target=lambda: result.update(code=client.run()))
+        t.start()
+        try:
+            deadline = time.monotonic() + 30
+            while client._read_coordinator_addr() is None:
+                assert time.monotonic() < deadline, "coordinator never up"
+                time.sleep(0.2)
+            assert cli.main(["status", client.job_dir]) == 0
+            out = capsys.readouterr().out
+            assert "status: RUNNING" in out
+            assert cli.main(["kill", client.job_dir]) == 0
+        finally:
+            t.join(timeout=60)
+        assert cli.main(["status", client.job_dir]) == 0
+        out = capsys.readouterr().out
+        assert "status: KILLED (finished)" in out
+        assert cli.main(["status", str(tmp_path / "nope")]) == 1
+
     def test_tony_kill_stops_single_node_job(self, tmp_path):
         """Kill must also interrupt single-node/notebook jobs, which never
         reach the monitor loop (they block in the preprocess wait)."""

@@ -11,6 +11,7 @@ jax-free for pure-orchestration uses)::
     tony_tpu.TonyClient           # programmatic job submission
     tony_tpu.TonyConfig           # the tony.* config system
     tony_tpu.CheckpointManager    # orbax checkpoint/resume helper
+    tony_tpu.FileSplitReader      # sharded data feed (TONY1 / lines / fixed)
 """
 
 __version__ = "0.1.0"
@@ -19,6 +20,7 @@ _LAZY = {
     "TonyClient": ("tony_tpu.client.client", "TonyClient"),
     "TonyConfig": ("tony_tpu.conf.config", "TonyConfig"),
     "CheckpointManager": ("tony_tpu.models.checkpoint", "CheckpointManager"),
+    "FileSplitReader": ("tony_tpu.io.reader", "FileSplitReader"),
 }
 
 

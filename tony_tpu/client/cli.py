@@ -35,6 +35,10 @@ def build_parser() -> argparse.ArgumentParser:
     k = sub.add_parser("kill", help="kill a running job by its job dir")
     k.add_argument("job_dir", help="the job's staging dir "
                                    "(<tony.staging.dir>/<app_id>)")
+    st = sub.add_parser("status",
+                        help="show a job's status and task URLs by job dir")
+    st.add_argument("job_dir", help="the job's staging dir "
+                                    "(<tony.staging.dir>/<app_id>)")
     c = sub.add_parser(
         "convert", add_help=False,
         help="convert data files to TONY1 framed records "
@@ -76,6 +80,8 @@ def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(raw)
     if args.command == "kill":
         return kill_job(args.job_dir)
+    if args.command == "status":
+        return job_status(args.job_dir)
     overrides = parse_cli_confs(args.conf)
     conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
     if args.python_venv:
@@ -117,6 +123,66 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit(f"tony: {e}")
 
 
+def _coordinator_rpc(job_dir: str):
+    """RPC client for the job's coordinator, or None when no coordinator
+    address has been written (job never started / dir wrong). Reads the
+    per-job secret if security is on — same handshake as `tony kill`."""
+    from tony_tpu.cluster.coordinator import COORDINATOR_ADDR_FILE
+    from tony_tpu.rpc.client import ApplicationRpcClient
+
+    addr_path = os.path.join(job_dir, COORDINATOR_ADDR_FILE)
+    if not os.path.exists(addr_path):
+        return None
+    with open(addr_path, encoding="utf-8") as f:
+        addr = f.read().strip()
+    secret = None
+    secret_path = os.path.join(job_dir, constants.TONY_SECRET_FILE)
+    if os.path.exists(secret_path):
+        with open(secret_path, encoding="utf-8") as f:
+            secret = f.read().strip()
+    return ApplicationRpcClient(addr, secret=secret, max_retries=3)
+
+
+def job_status(job_dir: str) -> int:
+    """Out-of-band status: final-status.json for finished jobs, a live
+    getApplicationStatus + task-URL listing for running ones (the
+    reference exposes status only through the polling client /
+    `yarn application -status`; this is the job-dir-keyed analog)."""
+    import json
+
+    from tony_tpu.cluster.coordinator import FINAL_STATUS_FILE
+
+    final_path = os.path.join(job_dir, FINAL_STATUS_FILE)
+    if os.path.exists(final_path):
+        with open(final_path, encoding="utf-8") as f:
+            final = json.load(f)
+        print(f"status: {final.get('status', '?')} (finished)")
+        # the keys Coordinator.stop() actually records
+        for key in ("app_id", "message", "tensorboard_url"):
+            if final.get(key) not in (None, ""):
+                print(f"{key}: {final[key]}")
+        return 0
+    rpc = _coordinator_rpc(job_dir)
+    if rpc is None:
+        print(f"no job found under {job_dir}", file=sys.stderr)
+        return 1
+    try:
+        st = rpc.get_application_status()
+        print(f"status: {st.status} (session {st.session_id})")
+        if st.message:
+            print(f"message: {st.message}")
+        for url in rpc.get_task_urls():
+            print(f"  {url.name}:{url.index}  {url.url}")
+    except Exception as e:
+        print(f"coordinator at {rpc.address} unreachable ({e}) — job may "
+              f"have been killed without writing a final status",
+              file=sys.stderr)
+        return 1
+    finally:
+        rpc.close()
+    return 0
+
+
 def kill_job(job_dir: str) -> int:
     """Signal a running job's coordinator to tear down (the out-of-band
     kill the reference lacked — its only kills were client timeout/Ctrl-C).
@@ -124,9 +190,7 @@ def kill_job(job_dir: str) -> int:
     from the job dir and calls finishApplication; a finish with tasks still
     running reduces to final status KILLED."""
     import json
-    from tony_tpu.cluster.coordinator import (COORDINATOR_ADDR_FILE,
-                                              FINAL_STATUS_FILE)
-    from tony_tpu.rpc.client import ApplicationRpcClient
+    from tony_tpu.cluster.coordinator import FINAL_STATUS_FILE
 
     final_path = os.path.join(job_dir, FINAL_STATUS_FILE)
     if os.path.exists(final_path):
@@ -136,28 +200,20 @@ def kill_job(job_dir: str) -> int:
             status = json.load(f).get("status", "?")
         print(f"job already finished with status {status}; nothing to kill")
         return 0
-    addr_path = os.path.join(job_dir, COORDINATOR_ADDR_FILE)
-    if not os.path.exists(addr_path):
+    rpc = _coordinator_rpc(job_dir)
+    if rpc is None:
         print(f"no running coordinator found under {job_dir}",
               file=sys.stderr)
         return 1
-    with open(addr_path, encoding="utf-8") as f:
-        addr = f.read().strip()
-    secret = None
-    secret_path = os.path.join(job_dir, constants.TONY_SECRET_FILE)
-    if os.path.exists(secret_path):
-        with open(secret_path, encoding="utf-8") as f:
-            secret = f.read().strip()
-    rpc = ApplicationRpcClient(addr, secret=secret, max_retries=3)
     try:
         rpc.finish_application()
     except Exception as e:
-        print(f"kill failed: coordinator at {addr} unreachable ({e})",
+        print(f"kill failed: coordinator at {rpc.address} unreachable ({e})",
               file=sys.stderr)
         return 1
     finally:
         rpc.close()
-    print(f"kill signalled to coordinator at {addr}")
+    print(f"kill signalled to coordinator at {rpc.address}")
     return 0
 
 
