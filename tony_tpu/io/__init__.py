@@ -22,9 +22,12 @@ from tony_tpu.io.framed import (FramedFormatError, FramedWriter,
                                 is_framed_file, iter_file_records,
                                 read_path_header)
 from tony_tpu.io.reader import DataFeedError, FileSplitReader
-from tony_tpu.io.jax_feed import (array_batches, global_batches,
-                                  record_size_for, records_to_array,
-                                  to_global_array)
+
+# jax_feed re-exports are lazy: it imports numpy (and jax inside its
+# functions), which orchestration-only installs — submit hosts, `tony
+# convert` — do not carry (pyproject's "compute" extra).
+_LAZY_JAX_FEED = ("array_batches", "global_batches", "record_size_for",
+                  "records_to_array", "to_global_array")
 
 __all__ = [
     "FileSegment", "compute_read_info", "full_records_in_split",
@@ -32,6 +35,12 @@ __all__ = [
     "FramedWriter", "FramedFormatError", "is_framed_file",
     "iter_file_records", "read_path_header",
     "FileSplitReader", "DataFeedError",
-    "array_batches", "global_batches", "record_size_for", "records_to_array",
-    "to_global_array",
+    *_LAZY_JAX_FEED,
 ]
+
+
+def __getattr__(name: str):
+    if name in _LAZY_JAX_FEED:
+        import importlib
+        return getattr(importlib.import_module("tony_tpu.io.jax_feed"), name)
+    raise AttributeError(f"module 'tony_tpu.io' has no attribute {name!r}")

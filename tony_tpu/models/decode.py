@@ -78,12 +78,16 @@ def _cached_attention(q, k_cache, v_cache, q_start):
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
-def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg, rope):
-    """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1; caches
-    [B, max_len, H, hd] already containing this layer's past; ``rope``:
-    (cos, sin) tables precomputed once per chunk (position-only, so
-    layer-invariant — same hoisting as the training forward); returns
-    (x, new_k, new_v)."""
+def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
+    """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1;
+    k_all/v_all: the FULL stacked caches [L, B, max_len, H, hd]; ``li``:
+    this layer's static index; ``rope``: (cos, sin) tables precomputed once
+    per chunk (position-only, so layer-invariant — same hoisting as the
+    training forward). Writes only the K-token slice into the stacked
+    cache (a layer-scan carrying the caches as xs/ys instead forced XLA to
+    COPY the whole cache every decode step — the xs and ys buffers of a
+    scan cannot alias — which dominated decode wall-clock). Returns
+    (x, k_all, v_all)."""
     p = layer_params
     cos, sin = rope
 
@@ -92,15 +96,16 @@ def _decode_block(x, layer_params, k_cache, v_cache, pos, cfg, rope):
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
     q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
-    # write this chunk into the cache
-    k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
-    v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    o = _cached_attention(q, k_cache, v_cache, pos)
+    # write this chunk into the stacked cache (in place under jit: the
+    # pre-update buffer has no later consumer)
+    k_all = jax.lax.dynamic_update_slice(k_all, k[None], (li, 0, pos, 0, 0))
+    v_all = jax.lax.dynamic_update_slice(v_all, v[None], (li, 0, pos, 0, 0))
+    o = _cached_attention(q, k_all[li], v_all[li], pos)
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
     mlp_out = _mlp(h, p, cfg)
-    return x + mlp_out, k_cache, v_cache
+    return x + mlp_out, k_all, v_all
 
 
 def _mlp(h, p, cfg):
@@ -138,15 +143,14 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
     positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
     rope = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
-    def body(carry, inputs):
-        x = carry
-        layer_params, k_cache, v_cache = inputs
-        x, k_cache, v_cache = _decode_block(
-            x, layer_params, k_cache, v_cache, pos, cfg, rope)
-        return x, (k_cache, v_cache)
-
-    x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"]))
+    # Unrolled layer loop with static per-layer indices — NOT a lax.scan
+    # with the caches as xs/ys (see _decode_block: scan forces whole-cache
+    # copies every step)
+    new_k, new_v = cache["k"], cache["v"]
+    for li in range(cfg.n_layers):
+        layer_params = jax.tree.map(lambda a: a[li], params["blocks"])
+        x, new_k, new_v = _decode_block(
+            x, layer_params, new_k, new_v, li, pos, cfg, rope)
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
@@ -176,8 +180,11 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     positions = jnp.broadcast_to(jnp.arange(s), (b, s))
     cos, sin = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
-    def body(x, inputs):
-        p, k_cache, v_cache = inputs
+    # Unrolled layers, prompt K/V written straight into the stacked cache
+    # (same no-scan rationale as extend_step)
+    k_filled, v_filled = cache["k"], cache["v"]
+    for li in range(cfg.n_layers):
+        p = jax.tree.map(lambda a: a[li], params["blocks"])
         h = rms_norm_reference(x, p["attn_norm"])
         q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
         k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
@@ -187,12 +194,10 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)
-        k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, 0, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, 0, 0, 0))
-        return x, (k_cache, v_cache)
-
-    x, (k_filled, v_filled) = jax.lax.scan(
-        body, x, (params["blocks"], cache["k"], cache["v"]))
+        k_filled = jax.lax.dynamic_update_slice(
+            k_filled, k[None], (li, 0, 0, 0, 0))
+        v_filled = jax.lax.dynamic_update_slice(
+            v_filled, v[None], (li, 0, 0, 0, 0))
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"],
                         preferred_element_type=jnp.float32)
@@ -259,8 +264,15 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
     _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
 
-    extend_t = jax.jit(extend_step, static_argnames=("cfg",))
-    step_d = jax.jit(decode_step, static_argnames=("cfg",))
+    # Donate the cache: a non-donated jit input cannot alias its output, so
+    # without this every call would copy the full stacked K/V buffers —
+    # exactly the whole-cache-copy cost the unrolled layer loop removed
+    # inside generate()'s single jit. Each round threads the returned cache
+    # forward and never touches the donated input again.
+    extend_t = jax.jit(extend_step, static_argnames=("cfg",),
+                       donate_argnames=("cache",))
+    step_d = jax.jit(decode_step, static_argnames=("cfg",),
+                     donate_argnames=("cache",))
 
     out: list[int] = []
     # pending = committed token whose K/V is not yet in the target cache
