@@ -718,6 +718,28 @@ runpy.run_path(r"{script}", run_name="__main__")
         from tony_tpu.client import cli
         assert cli.main(["kill", str(tmp_path)]) == 1
 
+    def test_cli_local_submit_end_to_end(self, tmp_path):
+        """The `tony local` entry point itself (the ClusterSubmitter-analog
+        coverage of TestClusterSubmitter.java:17-26, but against the real
+        stack, not a stubbed client)."""
+        from tony_tpu.client import cli
+        rc = cli.main([
+            "local", "--executes", fixture_cmd("exit_0.py"),
+            "--conf", f"tony.staging.dir={tmp_path / 'staging'}",
+            "--conf", f"tony.history.location={tmp_path / 'hist'}",
+            "--conf", "tony.worker.instances=2",
+            "--conf", "tony.application.timeout=60000",
+        ])
+        assert rc == 0
+        rc = cli.main([
+            "local", "--executes", fixture_cmd("exit_1.py"),
+            "--conf", f"tony.staging.dir={tmp_path / 'staging'}",
+            "--conf", f"tony.history.location={tmp_path / 'hist'}",
+            "--conf", "tony.worker.instances=1",
+            "--conf", "tony.application.timeout=60000",
+        ])
+        assert rc != 0                      # failure propagates as exit code
+
     def test_tony_status_running_and_finished(self, tmp_path, capsys):
         """`tony status <job_dir>`: live coordinator status + task URLs
         while running, final-status.json afterwards, error for unknown."""
