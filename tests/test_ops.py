@@ -7,6 +7,21 @@ import pytest
 
 from tony_tpu.ops import (flash_attention, layer_norm, layer_norm_reference,
                           reference_attention, rms_norm, rms_norm_reference)
+from tony_tpu.ops.attention import flash_attention_with_lse
+
+
+def dense_o_lse(q, k, v, causal=True):
+    """Dense (o, lse) oracle for the with-lse entry point."""
+    import jax.numpy as jnp
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (d ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)        # [B, H, Sq]
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return o, lse
 
 
 @pytest.fixture(scope="module")
@@ -92,6 +107,49 @@ class TestFlashAttention:
         gr = jax.grad(lambda *a: reference_attention(*a).sum(),
                       argnums=(0,))(q, k, v)
         np.testing.assert_allclose(g[0], gr[0], atol=5e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_with_lse_matches_dense(self, qkv, causal):
+        q, k, v = qkv
+        o, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                          block_q=32, block_k=32)
+        oref, lref = dense_o_lse(q, k, v, causal=causal)
+        np.testing.assert_allclose(o, oref, atol=2e-5)
+        np.testing.assert_allclose(lse, lref, atol=2e-5)
+
+    def test_with_lse_gradients_include_dlse(self, qkv):
+        # mixed loss touching BOTH outputs: d(lse) must flow through the
+        # kernels' delta adjustment, not be silently dropped
+        q, k, v = qkv
+
+        def loss(f):
+            def fn(q, k, v):
+                o, lse = f(q, k, v)
+                return (o ** 2).sum() + (jnp.sin(lse) * 1.7).sum()
+            return fn
+        g = jax.grad(loss(lambda *a: flash_attention_with_lse(
+            *a, block_q=32, block_k=32)), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(dense_o_lse), argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_with_lse_gradients_two_pass(self, monkeypatch):
+        import tony_tpu.ops.attention as A
+        monkeypatch.setattr(A, "_FUSED_PARTIALS_BYTES", 0)
+        r = np.random.RandomState(5)
+        q, k, v = (jnp.asarray(r.randn(1, 128, 2, 32), jnp.float32)
+                   for _ in range(3))
+
+        def loss(f):
+            def fn(q, k, v):
+                o, lse = f(q, k, v)
+                return (o ** 2).sum() + (jnp.cos(lse) * 0.9).sum()
+            return fn
+        g = jax.grad(loss(lambda *a: flash_attention_with_lse(
+            *a, block_q=32, block_k=32)), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss(dense_o_lse), argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
 
     def test_block_clamping_to_short_seq(self, qkv):
         q, k, v = qkv      # seq 64 < default blocks: must clamp, not raise

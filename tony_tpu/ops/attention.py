@@ -219,9 +219,17 @@ def _flash_forward(q, k, v, *, scale, causal, g, bq, bk):
 _FUSED_PARTIALS_BYTES = 512 * 1024 * 1024   # per partial tensor (there are 2)
 
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
-                      dq_ref, dkp_ref, dvp_ref, dq_scr, *, scale: float,
-                      causal: bool, g: int, bq: int, bk: int, nk: int):
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
+                      scale: float, causal: bool, g: int, bq: int, bk: int,
+                      nk: int, has_dlse: bool):
+    # refs = ([dlse_ref,] dq_ref, dkp_ref, dvp_ref, dq_scr): the dlse input
+    # exists only for the with-lse entry point, so the hot plain-attention
+    # path compiles the exact same kernel as before.
+    if has_dlse:
+        dlse_ref, dq_ref, dkp_ref, dvp_ref, dq_scr = refs
+    else:
+        dlse_ref = None
+        dq_ref, dkp_ref, dvp_ref, dq_scr = refs
     qi = pl.program_id(1)
     ki = pl.program_id(2)
 
@@ -238,8 +246,13 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
             do = do_ref[gi]
             o = o_ref[gi]
             lse = lse_ref[gi][:, None]                  # [bq, 1]
-            delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+            # d(lse) enters the score gradient additively:
+            # ds = p · (dp - delta + dlse); delta_eff folds it in
+            delta = jnp.sum(do.astype(jnp.float32)
+                            * o.astype(jnp.float32),
                             axis=-1, keepdims=True)     # [bq, 1]
+            if has_dlse:
+                delta = delta - dlse_ref[gi][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * scale  # [bq, bk]
@@ -275,9 +288,11 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref,
         dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
+def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
+                          bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
+    has_dlse = dlse is not None
     # The fused kernel holds 5 input blocks + dq + 2 partial outputs plus
     # the [bq, bk] f32 intermediates — 4 per compiled body, and Mosaic
     # allocates stack for BOTH _causal_dispatch bodies, so 8 count toward
@@ -288,18 +303,23 @@ def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     if bk > 256 and sk % 256 == 0:
         bk = 256
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
+    in_specs = [
+        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
+    ]
+    operands = [q, k, v, do, o, lse]
+    if has_dlse:
+        in_specs.append(pl.BlockSpec((g, bq), lambda b, i, j: (b, i)))
+        operands.append(dlse)
     dq, dkp, dvp = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          g=g, bq=bq, bk=bk, nk=nk),
+                          g=g, bq=bq, bk=bk, nk=nk, has_dlse=has_dlse),
         grid=(bh // g, nq, nk),
-        in_specs=[
-            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, g, bk, d), lambda b, i, j: (i, b, j, 0)),
@@ -321,7 +341,7 @@ def _flash_backward_fused(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, o, lse)
+    )(*operands)
     if nq == 1:
         return dq, dkp[0], dvp[0]
     dk = dkp.astype(jnp.float32).sum(0).astype(k.dtype)
@@ -428,13 +448,14 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
+def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
+                    bq, bk):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
     partial_bytes = nq * bh * sk * d * q.dtype.itemsize
     if partial_bytes <= _FUSED_PARTIALS_BYTES:
-        return _flash_backward_fused(q, k, v, o, lse, do, scale=scale,
+        return _flash_backward_fused(q, k, v, o, lse, do, dlse, scale=scale,
                                      causal=causal, g=g, bq=bq, bk=bk)
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
     # [bq, bk] f32 intermediates count twice; 256-wide blocks keep the
@@ -446,8 +467,11 @@ def _flash_backward(q, k, v, o, lse, do, *, scale, causal, g, bq, bk):
     if bk > 256 and sk % 256 == 0:
         bk = 256
         nk = _cdiv(sk, bk)
+    # ds = p · (dp - delta + dlse): fold the lse cotangent into delta
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                            # [bh, sq]
+    if dlse is not None:
+        delta = delta - dlse
     lse_l = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
     delta_l = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
 
@@ -528,6 +552,33 @@ def _flash_bwd_rule(scale, causal, g, bq, bk, residuals, grad):
 _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse_bhsd(q, k, v, scale, causal, g, bq, bk):
+    """(o, lse) variant with lse as a DIFFERENTIATED output — what
+    cross-chunk softmax merging (ring attention) needs: the merge weights
+    are exp(lse_chunk - lse_total), so d(lse) must flow back into the
+    score gradient (ds gains a +p·dlse term, folded into delta)."""
+    return _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+                          bk=bk)
+
+
+def _flash_lse_fwd_rule(q, k, v, scale, causal, g, bq, bk):
+    o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+                            bk=bk)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd_rule(scale, causal, g, bq, bk, residuals, grads):
+    q, k, v, o, lse = residuals
+    do, dlse = grads
+    return _flash_backward(q, k, v, o, lse, do,
+                           dlse.astype(jnp.float32), scale=scale,
+                           causal=causal, g=g, bq=bq, bk=bk)
+
+
+_flash_attention_lse_bhsd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
                     block_q: int = 512, block_k: int = 512,
@@ -543,6 +594,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
     [block_q, block_k] f32 intermediates per step). Differentiable via the
     fused flash backward (two-pass kernels for long sequences).
     """
+    qf, kf, vf, scale, g, bq, bk = _prep_flat(q, k, v, scale, block_q,
+                                              block_k, block_h)
+    b, sq, h, d = q.shape
+    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
+    return o[:b * h].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+
+
+def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
+    """Shared entry prep: validate blocks, flatten [B,S,H,D] →
+    [B·H, S, D], pad batch·heads to a multiple of 8 (Mosaic needs the 2-D
+    lse block's leading dim divisible by 8; zero heads give zero scores →
+    uniform softmax over zero values → o = 0, finite lse, zero grads —
+    callers slice the padding off), and resolve the head group."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     if sq % min(block_q, sq) or sk % min(block_k, sk):
@@ -554,15 +618,28 @@ def flash_attention(q, k, v, *, causal: bool = True,
     qf, kf, vf = to_flat(q), to_flat(k), to_flat(v)
     bh = b * h
     if bh % 8:
-        # Mosaic needs the batch·head block dim divisible by 8 (2-D lse
-        # blocks). Pad with zero heads: zero scores → uniform softmax over
-        # zero values → o = 0, finite lse, zero grads; sliced off below.
         pad = 8 * _cdiv(bh, 8) - bh
         qf, kf, vf = (jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
                       for x in (qf, kf, vf))
     g = _pick_group(qf.shape[0], block_h)
-    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
-    return o[:bh].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return qf, kf, vf, scale, g, bq, bk
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             scale: float | None = None,
+                             block_q: int = 512, block_k: int = 512,
+                             block_h: int = 4):
+    """Like :func:`flash_attention` but also returns the row logsumexp
+    ([batch, heads, seq], f32) as a DIFFERENTIATED output — the primitive
+    for cross-chunk online-softmax merging (ring attention): merged
+    results are ``o = Σ_c o_c · exp(lse_c - logaddexp_c lse_c)``, and the
+    lse cotangent flows back into the score gradients."""
+    qf, kf, vf, scale, g, bq, bk = _prep_flat(q, k, v, scale, block_q,
+                                              block_k, block_h)
+    b, sq, h, d = q.shape
+    o, lse = _flash_attention_lse_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
+    return (o[:b * h].reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+            lse[:b * h].reshape(b, h, sq))
 
 
 def reference_attention(q, k, v, *, causal: bool = True,

@@ -53,6 +53,19 @@ def _block_attn(q, k, v, m, l, o, scale, q_off, kv_off, causal):
     return m_new, l_new, o_new
 
 
+# Per-chunk attention engine: None = auto (flash kernels on TPU, dense
+# online-softmax elsewhere); tests force True to run the flash arm in
+# interpret mode.
+_USE_FLASH_CHUNKS: bool | None = None
+
+
+def _flash_chunks() -> bool:
+    if _USE_FLASH_CHUNKS is not None:
+        return _USE_FLASH_CHUNKS
+    import jax
+    return jax.default_backend() == "tpu"
+
+
 def ring_attention_local(q, k, v, *, axis_name: str = "cp",
                          causal: bool = True, scale: float | None = None):
     """Per-shard ring attention body — call inside ``shard_map`` (or any
@@ -60,12 +73,20 @@ def ring_attention_local(q, k, v, *, axis_name: str = "cp",
     shard axis).
 
     q, k, v: [B, S_local, H, D] local chunks. Returns [B, S_local, H, D].
+    On TPU each hop's chunk runs the flash kernels
+    (:func:`tony_tpu.ops.attention.flash_attention_with_lse`) and hops are
+    merged by logsumexp — O(S_local) memory per chunk instead of the dense
+    [B, H, S_local, S_local] score tensor.
     """
     b, s_loc, h, d = q.shape
     scale = (d ** -0.5) if scale is None else scale
     cp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     q_off = idx * s_loc
+
+    if _flash_chunks() and _flash_block(s_loc) is not None:
+        return _ring_flash(q, k, v, axis_name=axis_name, causal=causal,
+                           scale=scale, cp=cp, q_off=q_off)
 
     q32 = q.astype(jnp.float32) if q.dtype == jnp.float64 else q
     m0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
@@ -93,6 +114,84 @@ def ring_attention_local(q, k, v, *, axis_name: str = "cp",
                                   jnp.arange(cp, dtype=jnp.int32))
     # causal + f32: every query attends at least to itself, so l > 0
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
+
+
+def _flash_block(s_loc: int) -> int | None:
+    """Largest flash block size tiling the local chunk, or None when no
+    usable block exists (odd chunk lengths fall back to the dense arm,
+    which has no divisibility requirement)."""
+    for b in (512, 256, 128, 64, 32, 16, 8):
+        if s_loc % b == 0:
+            return b
+    return None
+
+
+def _ring_flash(q, k, v, *, axis_name, causal, scale, cp, q_off):
+    """Ring body with flash-kernel chunks merged by logsumexp.
+
+    Each hop's chunk falls into one of three causal cases, selected at
+    runtime (the kv offset rotates with the hop): entirely in the past
+    (full attention, no mask), the diagonal chunk (causal flash), or
+    entirely in the future (skipped — contributes o = 0, lse = -1e30,
+    which the finite-arithmetic logaddexp merge weights to exactly zero).
+    The lse outputs are DIFFERENTIATED (flash_attention_with_lse), so
+    JAX AD through the merge + scan yields the transposed ring backward
+    with flash backward kernels per chunk."""
+    from tony_tpu.ops.attention import flash_attention_with_lse
+
+    out_dtype = q.dtype
+    if q.dtype == jnp.float64:      # pallas kernels have no f64 path
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    b, s_loc, h, d = q.shape
+    blk = _flash_block(s_loc)
+
+    def full_chunk(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=False, scale=scale,
+                                          block_q=blk, block_k=blk)
+        return o.astype(jnp.float32), lse
+
+    def diag_chunk(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True, scale=scale,
+                                          block_q=blk, block_k=blk)
+        return o.astype(jnp.float32), lse
+
+    def future_chunk(q, k, v):
+        return (jnp.zeros((b, s_loc, h, d), jnp.float32),
+                jnp.full((b, h, s_loc), _NEG_INF, jnp.float32))
+
+    o0 = jnp.zeros((b, s_loc, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s_loc), _NEG_INF, jnp.float32)
+
+    if cp == 1:
+        o, lse = (diag_chunk if causal else full_chunk)(q, k, v)
+        return o.astype(out_dtype)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    idx = lax.axis_index(axis_name)
+
+    def step(carry, hop):
+        k_cur, v_cur, o_acc, lse_acc = carry
+        kv_off = ((idx - hop) % cp) * s_loc
+        if causal:
+            case = jnp.where(kv_off > q_off, 2,
+                             jnp.where(kv_off == q_off, 1, 0))
+            o_c, lse_c = lax.switch(
+                case, (full_chunk, diag_chunk, future_chunk), q, k_cur, v_cur)
+        else:
+            # every hop is a full chunk — no switch, no dead branches
+            o_c, lse_c = full_chunk(q, k_cur, v_cur)
+        lse_new = jnp.logaddexp(lse_acc, lse_c)         # [B, H, S]
+        w_acc = jnp.exp(lse_acc - lse_new)
+        w_c = jnp.exp(lse_c - lse_new)
+        to_bshd = lambda w: w.transpose(0, 2, 1)[..., None]
+        o_acc = o_acc * to_bshd(w_acc) + o_c * to_bshd(w_c)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (k_nxt, v_nxt, o_acc, lse_new), None
+
+    (_, _, o, _), _ = lax.scan(step, (k, v, o0, lse0),
+                               jnp.arange(cp, dtype=jnp.int32))
+    return o.astype(out_dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
