@@ -249,6 +249,17 @@ class TestRingAttention:
         np.testing.assert_allclose(out, _dense_attention(q, k, v, True),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_no_cp_axis_flash_engine(self, qkv, causal, monkeypatch):
+        import importlib
+        R = importlib.import_module("tony_tpu.parallel.ring_attention")
+        monkeypatch.setattr(R, "_USE_FLASH_CHUNKS", True)
+        q, k, v = qkv
+        mesh = make_mesh({"dp": 2, "tp": 4})
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, _dense_attention(q, k, v, causal),
+                                   atol=2e-5)
+
     def test_heads_over_tp(self, qkv):
         q, k, v = qkv
         mesh = make_mesh({"cp": 4, "tp": 2})

@@ -220,6 +220,15 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
 def _single_chunk(q, k, v, *, causal, scale):
     b, s, h, d = q.shape
     scale = (d ** -0.5) if scale is None else scale
+    blk = _flash_block(s)
+    if _flash_chunks() and blk is not None:
+        # same engine selection (and f64→f32 cast) as the ring hops
+        from tony_tpu.ops.attention import flash_attention
+        out_dtype = q.dtype
+        if q.dtype == jnp.float64:
+            q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+        return flash_attention(q, k, v, causal=causal, scale=scale,
+                               block_q=blk, block_k=blk).astype(out_dtype)
     m = jnp.full((b, h, s), _NEG_INF, jnp.float32)
     l = jnp.zeros((b, h, s), jnp.float32)
     o = jnp.zeros((b, s, h, d), jnp.float32)

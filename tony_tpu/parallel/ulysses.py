@@ -58,13 +58,10 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
                               tiled=True)
 
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    if jax.default_backend() == "tpu":
-        # full sequence is local after the all-to-all → the blockwise
-        # pallas kernel applies directly (O(block) memory, not O(S^2))
-        from tony_tpu.ops.attention import flash_attention
-        o = flash_attention(qh, kh, vh, causal=causal, scale=scale)
-    else:
-        o = _single_chunk(qh, kh, vh, causal=causal, scale=scale)
+    # full sequence is local after the all-to-all; _single_chunk picks the
+    # engine (flash pallas kernel on TPU with a tiling block, dense
+    # otherwise) — one selection policy shared with the ring path
+    o = _single_chunk(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(o)
 
 
