@@ -137,31 +137,32 @@ def main() -> None:
         decode_tps = round(d_batch * d_new / t_dec, 1)
         del params, prompt, dec, gen   # free HBM before the tight base run
 
-        # Secondary: "base" preset (768d/12L, BERT-base scale) at seq 2048 —
-        # stresses framework overheads the small preset doesn't. remat off
-        # fits at batch 8 on 16G HBM and is ~25% faster than remat at b=4.
-        base = T.PRESETS["base"].scaled(remat=False, scan_unroll=12)
-        b_batch, b_seq = 8, 2048
-        b_tokens = jax.random.randint(jax.random.PRNGKey(2),
-                                      (b_batch, b_seq + 1), 0, base.vocab_size)
-        b_data = {"inputs": b_tokens[:, :b_seq], "targets": b_tokens[:, 1:]}
-        base_tps = b_batch * b_seq / run(base, b_data, 10)
-        out["base_tokens_per_s"] = round(base_tps, 1)
-        if peak is not None:
-            out["base_mfu"] = round(
-                base_tps * T.train_flops_per_token(base, b_seq) / peak, 4)
-        out["decode_tokens_per_s"] = decode_tps
+        def secondary(name, config, s_batch, s_seq, s_iters, key,
+                      with_mfu=True):
+            toks = jax.random.randint(jax.random.PRNGKey(key),
+                                      (s_batch, s_seq + 1), 0,
+                                      config.vocab_size)
+            s_data = {"inputs": toks[:, :s_seq], "targets": toks[:, 1:]}
+            tps = s_batch * s_seq / run(config, s_data, s_iters)
+            out[f"{name}_tokens_per_s"] = round(tps, 1)
+            if with_mfu and peak is not None:
+                out[f"{name}_mfu"] = round(
+                    tps * T.train_flops_per_token(config, s_seq) / peak, 4)
 
-        # Secondary: long context (seq 8192) — exercises the flash kernels
-        # in the regime where attention dominates layer FLOPs. Batch 4 is
-        # ~4% over 2 (interleaved A/B) and still fits.
-        l_batch, l_seq = 4, 8192
-        l_tokens = jax.random.randint(jax.random.PRNGKey(6),
-                                      (l_batch, l_seq + 1), 0,
-                                      cfg.vocab_size)
-        l_data = {"inputs": l_tokens[:, :l_seq], "targets": l_tokens[:, 1:]}
-        out["seq8k_tokens_per_s"] = round(
-            l_batch * l_seq / run(cfg, l_data, 10), 1)
+        # "base" preset (768d/12L, BERT-base scale) at seq 2048 — stresses
+        # framework overheads the small preset doesn't. remat off fits at
+        # batch 8 on 16G HBM and is ~25% faster than remat at b=4.
+        secondary("base", T.PRESETS["base"].scaled(remat=False,
+                                                   scan_unroll=12),
+                  8, 2048, 10, key=2)
+        out["decode_tokens_per_s"] = decode_tps
+        # "large" preset (1536d/24L, 1.0B params) — remat on (the optimizer
+        # state already takes ~8 GB of HBM); the bigger matmuls give the
+        # best MFU of any preset.
+        secondary("large", T.PRESETS["large"], 4, 1024, 8, key=7)
+        # long context (seq 8192) — the regime where attention dominates
+        # layer FLOPs. Batch 4 is ~4% over 2 (interleaved A/B) and fits.
+        secondary("seq8k", cfg, 4, 8192, 10, key=6, with_mfu=False)
 
     print(json.dumps(out))
 

@@ -404,6 +404,35 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(out, _dense_attention(q, k, v, causal),
                                    atol=2e-5)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_flash_engine_matches_dense(self, qkv, causal, monkeypatch):
+        """The TPU arm: post-all-to-all [B, S_full, H/cp, D] chunks run the
+        flash kernels (forced on, interpret mode)."""
+        import importlib
+        R = importlib.import_module("tony_tpu.parallel.ring_attention")
+        monkeypatch.setattr(R, "_USE_FLASH_CHUNKS", True)
+        from tony_tpu.parallel import ulysses_attention
+        q, k, v = qkv
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        out = ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, _dense_attention(q, k, v, causal),
+                                   atol=2e-5)
+
+    def test_flash_engine_rejects_untileable_seq(self, monkeypatch):
+        """With the flash engine on, a full sequence that tiles no flash
+        block must fail actionably, not silently go dense O(S²)."""
+        import importlib
+        R = importlib.import_module("tony_tpu.parallel.ring_attention")
+        monkeypatch.setattr(R, "_USE_FLASH_CHUNKS", True)
+        from tony_tpu.parallel import ulysses_attention
+        r = np.random.RandomState(2)
+        # S_full = 36: local 9 over cp=4, tiles no block (36 % 8 != 0)
+        q, k, v = (jnp.asarray(r.randn(2, 36, 4, 16), jnp.float32)
+                   for _ in range(3))
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        with pytest.raises(ValueError, match="pad the sequence"):
+            ulysses_attention(q, k, v, mesh, causal=True)
+
     def test_matches_ring(self, qkv):
         """Both context-parallel strategies compute the same attention."""
         from tony_tpu.parallel import ring_attention, ulysses_attention

@@ -40,10 +40,20 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
     q, k, v: [B, S_local, H, D]; H must be divisible by the axis size.
     Returns [B, S_local, H, D].
     """
+    from tony_tpu.parallel.ring_attention import _flash_block, _flash_chunks
+
     b, s_loc, h, d = q.shape
     cp = lax.axis_size(axis_name)
     if h % cp:
         raise ValueError(f"n_heads={h} not divisible by {axis_name}={cp}")
+    if _flash_chunks() and _flash_block(s_loc * cp) is None:
+        # Unlike ring chunks (S_local each), ulysses attends the FULL
+        # gathered sequence per device — a silent dense fallback there
+        # would materialize the O(S²) score tensor the strategy exists to
+        # avoid. Fail with the remedy instead.
+        raise ValueError(
+            f"ulysses full sequence {s_loc * cp} does not tile any flash "
+            f"block; pad the sequence to a multiple of 8")
     if cp == 1:
         return _single_chunk(q, k, v, causal=causal, scale=scale)
 
