@@ -127,38 +127,49 @@ class TestTpuBackendE2E:
         where = open(proof).read().strip()
         assert "tony-job/.tony-framework/tony_tpu" in where
 
+    @staticmethod
+    def _preemption_command(tmp_path, marker):
+        """User command for preemption choreography: announce this task
+        started (a sentinel the test waits on — ssh launch lines hit
+        calls.log BEFORE the executor process runs, so polling those
+        races task startup), then exit 0 on the retry attempt or hang."""
+        return (f'bash -c "touch {tmp_path}/started-$JOB_NAME-$TASK_INDEX; '
+                f'if [ -f {marker} ]; then exit 0; else sleep 60; fi"')
+
+    @staticmethod
+    def _wait_tasks_started(tmp_path, n, timeout_s=60):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            started = [f for f in os.listdir(tmp_path)
+                       if f.startswith("started-")]
+            if len(started) >= n:
+                return
+            time.sleep(0.2)
+        raise AssertionError("first-generation tasks never started")
+
+    @staticmethod
+    def _preempt(fleet, slice_name):
+        with open(os.path.join(fleet, slice_name, "state"), "w") as f:
+            f.write("PREEMPTED")
+
     def test_preemption_reprovisions_and_restages(self, fake_gcloud,
                                                   tmp_path):
         """Slice goes PREEMPTED mid-run: the coordinator retries from the
         preemption budget and the backend deletes + recreates + RESTAGES
         the slice; the relaunched attempt succeeds."""
         marker = tmp_path / "attempt2.marker"
-        client = TonyClient(
-            tpu_conf(tmp_path),
-            f'bash -c "if [ -f {marker} ]; then exit 0; '
-            f'else sleep 60; fi"')
+        client = TonyClient(tpu_conf(tmp_path),
+                            self._preemption_command(tmp_path, marker))
         result = {}
         t = threading.Thread(target=lambda: result.update(
             code=client.run()))
         t.start()
         try:
-            # wait until both executors are up (first generation launched)
-            deadline = time.monotonic() + 45
-            slice_name = None
-            while time.monotonic() < deadline:
-                ssh_launches = [c for c in calls(fake_gcloud)
-                                if c.split()[3:4] == ["ssh"]
-                                and "executor" in c]
-                if len(ssh_launches) >= 2:
-                    slice_name = ssh_launches[0].split()[4]
-                    break
-                time.sleep(0.2)
-            assert slice_name, "executors never launched"
-            time.sleep(1.0)
+            self._wait_tasks_started(tmp_path, 2)
             marker.write_text("go")
-            with open(os.path.join(fake_gcloud, slice_name, "state"),
-                      "w") as f:
-                f.write("PREEMPTED")
+            slice_name = [d for d in os.listdir(fake_gcloud)
+                          if d.startswith("tony-")][0]
+            self._preempt(fake_gcloud, slice_name)
         finally:
             t.join(timeout=120)
         assert result.get("code") == 0
@@ -166,6 +177,41 @@ class TestTpuBackendE2E:
         assert ops.count("create") == 2      # reprovisioned
         assert ops.count("scp") == 2         # re-staged
         assert ops.count("delete") >= 2      # dead slice + final teardown
+
+    def test_multi_slice_preemption_reprovisions_only_that_gang(
+            self, fake_gcloud, tmp_path):
+        """2 gangs; one goes PREEMPTED mid-run. The session retries, the
+        dead gang is deleted + recreated + restaged, and the surviving
+        gang's VM is NOT reprovisioned."""
+        marker = tmp_path / "attempt2.marker"
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.worker.instances": "4",
+                                "tony.worker.slices": "2"}),
+            self._preemption_command(tmp_path, marker))
+        result = {}
+        t = threading.Thread(target=lambda: result.update(
+            code=client.run()))
+        t.start()
+        try:
+            self._wait_tasks_started(tmp_path, 4)
+            marker.write_text("go")
+            victim = [d for d in os.listdir(fake_gcloud)
+                      if d.endswith("-s1")][0]
+            self._preempt(fake_gcloud, victim)
+        finally:
+            t.join(timeout=120)
+        assert result.get("code") == 0
+
+        def gang_ops(op, suffix):
+            return sum(1 for c in calls(fake_gcloud)
+                       if c.split()[3] == op
+                       and (c.split()[4].endswith(suffix) if op != "scp"
+                            else suffix in c.split()[5]))
+        # gang s1: deleted, recreated, RE-STAGED; gang s0 untouched
+        assert gang_ops("create", "-s1") == 2
+        assert gang_ops("delete", "-s1") >= 1
+        assert gang_ops("scp", "-s1") >= 2      # initial + restage
+        assert gang_ops("create", "-s0") == 1
 
     def test_topology_instances_mismatch_rejected_at_submit(self, tmp_path):
         """VERDICT #6: instances=4 on a v5e 2x2 slice (1 host) must fail
