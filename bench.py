@@ -39,17 +39,25 @@ def _peak_flops() -> float | None:
     return None
 
 
-def _bench_step(step, state, batch, iters: int) -> float:
+def _bench_step(step, state, batch, iters: int, reps: int = 3) -> float:
+    """Median-of-windows step time. The shared/tunneled chip's effective
+    speed drifts ±15% across seconds (docs/performance.md measurement
+    hygiene); a single window can record a bad minute as the framework's
+    throughput, so each config is timed over ``reps`` windows and the
+    median wins. Host value fetch, not block_until_ready: on tunneled
+    platforms the latter can return before execution finishes, faking
+    microsecond steps."""
     state, m = step(state, batch)            # compile + warm
     float(m["loss"])
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, m = step(state, batch)
-    # Host fetch, not block_until_ready: on tunneled/remote platforms
-    # block_until_ready can return before execution finishes, faking
-    # microsecond steps; a device->host value read cannot.
-    float(m["loss"])
-    return (time.perf_counter() - t0) / iters
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+        float(m["loss"])
+        times.append((time.perf_counter() - t0) / iters)
+    times.sort()
+    return times[len(times) // 2]
 
 
 def main() -> None:
