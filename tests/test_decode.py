@@ -170,3 +170,63 @@ class TestDecode:
                                    max_new_tokens=7, num_speculative=3)
         np.testing.assert_array_equal(np.asarray(got),
                                       np.asarray(want.tokens))
+
+
+class TestGQA:
+    """Grouped-query attention: n_kv_heads < n_heads."""
+    GCFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False,
+                                    n_kv_heads=2)    # 4 q heads, 2 kv heads
+
+    def test_forward_equals_mha_with_repeated_kv_weights(self):
+        """A GQA model must compute exactly what an MHA model with each
+        K/V head repeated across its query group computes."""
+        gparams = T.init_params(jax.random.PRNGKey(0), self.GCFG)
+        mha_cfg = self.GCFG.scaled(n_kv_heads=None)
+        rep = self.GCFG.n_heads // self.GCFG.kv_heads
+        mparams = jax.tree.map(lambda x: x, gparams)
+        mparams["blocks"] = dict(
+            gparams["blocks"],
+            wk=jnp.repeat(gparams["blocks"]["wk"], rep, axis=2),
+            wv=jnp.repeat(gparams["blocks"]["wv"], rep, axis=2))
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                    self.GCFG.vocab_size)
+        lg, _ = T.forward(gparams, tokens, self.GCFG)
+        lm, _ = T.forward(mparams, tokens, mha_cfg)
+        np.testing.assert_allclose(np.asarray(lg), np.asarray(lm),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cache_stores_kv_heads_only(self):
+        cache = init_kv_cache(self.GCFG, batch=2, max_len=32)
+        assert cache["k"].shape == (self.GCFG.n_layers, 2, 32, 2,
+                                    self.GCFG.head_dim)
+
+    def test_greedy_generate_equals_full_forward(self):
+        gparams = T.init_params(jax.random.PRNGKey(4), self.GCFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0,
+                                    self.GCFG.vocab_size)
+        out = generate(gparams, prompt, self.GCFG, max_new_tokens=5,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        expected = full_forward_greedy(gparams, prompt, 5, cfg=self.GCFG)
+        np.testing.assert_array_equal(np.asarray(out.tokens),
+                                      np.asarray(expected))
+
+    def test_indivisible_head_groups_rejected(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            T.PRESETS["tiny"].scaled(n_kv_heads=3).kv_heads
+
+    def test_tp_sharded_gqa_decode(self):
+        """GQA params shard on a tp mesh larger than n_kv_heads (K/V
+        replicate — the Llama-style TP layout) and decode token-identically."""
+        from tony_tpu.parallel import make_mesh, shard_pytree
+        gparams = T.init_params(jax.random.PRNGKey(6), self.GCFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 8), 0,
+                                    self.GCFG.vocab_size)
+        ref = generate(gparams, prompt, self.GCFG, max_new_tokens=5,
+                       rng=jax.random.PRNGKey(0), temperature=0.0)
+        mesh = make_mesh({"tp": 4, "dp": 2})   # tp > n_kv_heads=2
+        sharded = shard_pytree(gparams, T.logical_axes(self.GCFG), mesh)
+        with jax.set_mesh(mesh):
+            out = generate(sharded, prompt, self.GCFG, max_new_tokens=5,
+                           rng=jax.random.PRNGKey(0), temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(out.tokens))

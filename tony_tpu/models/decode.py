@@ -4,10 +4,11 @@ The inference half of the model stack (the reference delegates all compute,
 so this — like training — is green-field per SURVEY.md §2.3). TPU-first
 choices:
 
-- **Static shapes everywhere**: the cache is a fixed [L, B, max_len, H, D]
-  buffer updated with ``lax.dynamic_update_slice``; the decode loop is a
-  ``lax.scan`` over step index — one compiled program regardless of prompt
-  or generation length.
+- **Static shapes everywhere**: the cache is a fixed [L, B, max_len, KV, D]
+  buffer (KV = cfg.kv_heads — n_heads/n_kv_heads× smaller under
+  grouped-query attention) updated with ``lax.dynamic_update_slice``; the
+  decode loop is a ``lax.scan`` over step index — one compiled program
+  regardless of prompt or generation length.
 - **Prefill/decode split**: the prompt is processed in one batched forward
   (MXU-friendly big matmuls, flash attention) that also fills the cache;
   each generated token then runs the cheap single-position path attending
@@ -50,8 +51,10 @@ class GenerateOutput(NamedTuple):
 
 def init_kv_cache(cfg: T.TransformerConfig, batch: int,
                   max_len: int) -> dict:
-    """Zeroed cache pytree: k/v of shape [L, B, max_len, H, hd]."""
-    shape = (cfg.n_layers, batch, max_len, cfg.n_heads, cfg.head_dim)
+    """Zeroed cache pytree: k/v of shape [L, B, max_len, KV, hd] — KV is
+    cfg.kv_heads, so grouped-query configs carry an n_heads/n_kv_heads×
+    smaller cache (the main GQA payoff at long max_len)."""
+    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
             "length": jnp.zeros((), jnp.int32)}
@@ -59,28 +62,42 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
 
 def _cached_attention(q, k_cache, v_cache, q_start):
     """q: [B, K, H, hd] holding positions q_start..q_start+K-1; caches:
-    [B, max_len, H, hd]. Query i attends cache positions <= q_start+i
-    (causal within the chunk, full history before it). Operands stay in the
-    cache dtype (bf16 on TPU) with f32 accumulation — casting the whole
-    cache to f32 would double the hot loop's HBM traffic and halve MXU
-    throughput."""
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
-                        preferred_element_type=jnp.float32) * scale
+    [B, max_len, KV, hd] (KV = H for MHA; KV < H for grouped-query, where
+    each query group reads its shared K/V head WITHOUT materializing a
+    repeated cache — the bandwidth saving is the point of GQA decode).
+    Query i attends cache positions <= q_start+i (causal within the chunk,
+    full history before it). Operands stay in the cache dtype (bf16 on
+    TPU) with f32 accumulation — casting the whole cache to f32 would
+    double the hot loop's HBM traffic and halve MXU throughput."""
+    b, n_q, h, d = q.shape
+    kv = k_cache.shape[2]
+    scale = d ** -0.5
     max_len = k_cache.shape[1]
-    n_q = q.shape[1]
-    q_pos = q_start + jnp.arange(n_q)[None, None, :, None]     # [1,1,Q,1]
-    k_pos = jnp.arange(max_len)[None, None, None, :]           # [1,1,1,K]
-    scores = jnp.where(k_pos <= q_pos, scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)                    # f32
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
-                      v_cache,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    q_pos = q_start + jnp.arange(n_q)                           # [Q]
+    k_pos = jnp.arange(max_len)                                 # [S]
+    mask = k_pos[None, :] <= q_pos[:, None]                     # [Q, S]
+    if kv == h:
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                            preferred_element_type=jnp.float32) * scale
+        scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)                 # f32
+        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
+                          v_cache,
+                          preferred_element_type=jnp.float32).astype(q.dtype)
+    group = h // kv
+    qg = q.reshape(b, n_q, kv, group, d)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    o = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype),
+                   v_cache, preferred_element_type=jnp.float32)
+    return o.reshape(b, n_q, h, d).astype(q.dtype)
 
 
 def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
     """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1;
-    k_all/v_all: the FULL stacked caches [L, B, max_len, H, hd]; ``li``:
+    k_all/v_all: the FULL stacked caches [L, B, max_len, KV, hd]; ``li``:
     this layer's static index; ``rope``: (cos, sin) tables precomputed once
     per chunk (position-only, so layer-invariant — same hoisting as the
     training forward). Writes only the K-token slice into the stacked
@@ -190,7 +207,10 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
         v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
         q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
-        o = T._attention(q, k, v, None)
+        # the cache stores KV heads; compute wants full heads (GQA no-op
+        # for MHA)
+        kh, vh = T.repeat_kv(k, v, cfg)
+        o = T._attention(q, kh, vh, None)
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)

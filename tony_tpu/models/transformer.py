@@ -45,6 +45,10 @@ class TransformerConfig:
     d_model: int = 512
     n_layers: int = 8
     n_heads: int = 8
+    # Grouped-query attention: number of K/V heads (None = n_heads, plain
+    # MHA). Shrinks K/V projections and — the real win — the decode cache
+    # by n_heads/n_kv_heads; query heads attend their group's shared K/V.
+    n_kv_heads: int | None = None
     d_ff: int = 2048
     max_seq: int = 2048
     dtype: Any = jnp.bfloat16
@@ -72,9 +76,21 @@ class TransformerConfig:
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
 
+    def __post_init__(self):
+        # fail where the config was written, not at first trace
+        kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
+        if self.n_heads % kv:
+            raise ValueError(f"n_heads={self.n_heads} not divisible by "
+                             f"n_kv_heads={kv}")
+
     @property
     def head_dim(self) -> int:
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        return (self.n_kv_heads if self.n_kv_heads is not None
+                else self.n_heads)
 
     @property
     def logits_storage_dtype(self):
@@ -110,11 +126,12 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
                 * (fan_in ** -0.5)).astype(dt)
 
     ks = jax.random.split(k_blocks, 8)
+    kv = cfg.kv_heads
     block = {
         "attn_norm": jnp.ones((L, d), dt),
         "wq": dense(ks[0], (L, d, h, hd), d),
-        "wk": dense(ks[1], (L, d, h, hd), d),
-        "wv": dense(ks[2], (L, d, h, hd), d),
+        "wk": dense(ks[1], (L, d, kv, hd), d),
+        "wv": dense(ks[2], (L, d, kv, hd), d),
         "wo": dense(ks[3], (L, h, hd, d), d),
         "mlp_norm": jnp.ones((L, d), dt),
     }
@@ -145,11 +162,16 @@ def init_params(rng: jax.Array, cfg: TransformerConfig) -> dict:
 def logical_axes(cfg: TransformerConfig) -> dict:
     """Logical-axis pytree matching init_params (leading axis = "stage" so
     the same layout drives FSDP sharding and pipeline stage assignment)."""
+    # Under GQA the K/V head count can be smaller than any tp axis, so
+    # those params replicate instead of claiming the "heads" rule (they are
+    # n_heads/n_kv_heads× smaller than MHA's to begin with; Llama-style TP
+    # replicates KV heads the same way).
+    kv_head_axis = "heads" if cfg.kv_heads == cfg.n_heads else None
     block = {
         "attn_norm": ("stage", "norm"),
         "wq": ("stage", "embed", "heads", "kv"),
-        "wk": ("stage", "embed", "heads", "kv"),
-        "wv": ("stage", "embed", "heads", "kv"),
+        "wk": ("stage", "embed", kv_head_axis, "kv"),
+        "wv": ("stage", "embed", kv_head_axis, "kv"),
         "wo": ("stage", "heads", "kv", "embed"),
         "mlp_norm": ("stage", "norm"),
     }
@@ -203,6 +225,18 @@ def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
     return apply_rope(x, cos, sin)
 
 
+def repeat_kv(k: jax.Array, v: jax.Array,
+              cfg: TransformerConfig) -> tuple[jax.Array, jax.Array]:
+    """GQA → full heads: broadcast each K/V head across its query group
+    (blocked layout: query head h reads kv head h // (H/KV)). The single
+    definition of the group layout — training, prefill, and the grouped
+    cache read must agree or cached decode silently diverges."""
+    if cfg.kv_heads == cfg.n_heads:
+        return k, v
+    rep = cfg.n_heads // cfg.kv_heads
+    return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
+
+
 def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
     if cp_strategy not in ("ring", "ulysses"):
         # Silent fallback would make a typo'd strategy benchmark the wrong
@@ -235,6 +269,10 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+    # GQA: broadcast each K/V head to its query group for the kernels
+    # (training activations match MHA; the param + decode-cache savings
+    # are the point — see decode.py for the non-materializing read)
+    k, v = repeat_kv(k, v, cfg)
     q = constrain(q, ("batch", "seq", "heads", "kv"), mesh, rules)
     k = constrain(k, ("batch", "seq", "heads", "kv"), mesh, rules)
     v = constrain(v, ("batch", "seq", "heads", "kv"), mesh, rules)
@@ -304,7 +342,8 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
     S² score/value matmuls), matching what the flash kernel actually executes.
     """
     d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
-    proj = 8 * d * d                      # wq + wk + wv + wo
+    kv_width = cfg.kv_heads * cfg.head_dim     # == d for MHA
+    proj = 4 * d * d + 4 * d * kv_width   # wq + wo, + wk + wv (GQA-aware)
     attn = 2 * seq * d                    # QK^T + AV, causal half of 4·S·d
     if cfg.num_experts:
         mlp = 2 * d * cfg.num_experts + cfg.moe_top_k * 4 * d * f
