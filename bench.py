@@ -61,6 +61,14 @@ def _bench_step(step, state, batch, iters: int, reps: int = 3) -> float:
 
 
 def main() -> None:
+    import os
+    # ~2/3 of a cold bench run is XLA compilation (6 jitted programs); the
+    # persistent cache makes repeat runs start measuring immediately.
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+
     from tony_tpu.models import transformer as T
     from tony_tpu.models.train import (default_optimizer, init_state,
                                        make_train_step)
@@ -82,13 +90,13 @@ def main() -> None:
                                 cfg.vocab_size)
     data = {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
 
-    def run(config, run_data, run_iters) -> float:
+    def run(config, run_data, run_iters, reps=3) -> float:
         params = T.init_params(jax.random.PRNGKey(0), config)
         opt = default_optimizer(lr=1e-3)
         state = init_state(params, opt)
         step = make_train_step(
             lambda p, b: T.lm_loss(p, b, config), opt)
-        return _bench_step(step, state, run_data, run_iters)
+        return _bench_step(step, state, run_data, run_iters, reps=reps)
 
     t_framework = run(cfg, data, iters)
 
@@ -105,7 +113,8 @@ def main() -> None:
     tmod._attention = lambda q, k, v, *a: tmod.reference_attention(
         q, k, v, causal=True)
     try:
-        t_naive = run(naive_cfg, n_data, iters)
+        # 2 windows: the RATIO tolerates drift better than absolute numbers
+        t_naive = run(naive_cfg, n_data, iters, reps=2)
     finally:
         tmod._attention = orig
 
@@ -151,7 +160,7 @@ def main() -> None:
                                       (s_batch, s_seq + 1), 0,
                                       config.vocab_size)
             s_data = {"inputs": toks[:, :s_seq], "targets": toks[:, 1:]}
-            tps = s_batch * s_seq / run(config, s_data, s_iters)
+            tps = s_batch * s_seq / run(config, s_data, s_iters, reps=2)
             out[f"{name}_tokens_per_s"] = round(tps, 1)
             if with_mfu and peak is not None:
                 out[f"{name}_mfu"] = round(

@@ -211,8 +211,13 @@ class TestGQA:
                                       np.asarray(expected))
 
     def test_indivisible_head_groups_rejected(self):
-        with pytest.raises(ValueError, match="not divisible"):
-            T.PRESETS["tiny"].scaled(n_kv_heads=3).kv_heads
+        # fails at CONSTRUCTION, not first trace
+        with pytest.raises(ValueError, match="positive divisor"):
+            T.PRESETS["tiny"].scaled(n_kv_heads=3)
+        with pytest.raises(ValueError, match="positive divisor"):
+            T.PRESETS["tiny"].scaled(n_kv_heads=0)
+        with pytest.raises(ValueError, match="positive divisor"):
+            T.PRESETS["tiny"].scaled(n_kv_heads=-2)
 
     def test_tp_sharded_gqa_decode(self):
         """GQA params shard on a tp mesh larger than n_kv_heads (K/V

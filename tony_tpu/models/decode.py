@@ -71,25 +71,17 @@ def _cached_attention(q, k_cache, v_cache, q_start):
     double the hot loop's HBM traffic and halve MXU throughput."""
     b, n_q, h, d = q.shape
     kv = k_cache.shape[2]
+    group = h // kv                                  # 1 = plain MHA
     scale = d ** -0.5
     max_len = k_cache.shape[1]
     q_pos = q_start + jnp.arange(n_q)                           # [Q]
     k_pos = jnp.arange(max_len)                                 # [S]
     mask = k_pos[None, :] <= q_pos[:, None]                     # [Q, S]
-    if kv == h:
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
-                            preferred_element_type=jnp.float32) * scale
-        scores = jnp.where(mask[None, None], scores, -jnp.inf)
-        probs = jax.nn.softmax(scores, axis=-1)                 # f32
-        return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype),
-                          v_cache,
-                          preferred_element_type=jnp.float32).astype(q.dtype)
-    group = h // kv
     qg = q.reshape(b, n_q, kv, group, d)
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
     scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
-    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jax.nn.softmax(scores, axis=-1)                     # f32
     o = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype),
                    v_cache, preferred_element_type=jnp.float32)
     return o.reshape(b, n_q, h, d).astype(q.dtype)

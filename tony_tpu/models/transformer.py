@@ -79,9 +79,9 @@ class TransformerConfig:
     def __post_init__(self):
         # fail where the config was written, not at first trace
         kv = self.n_kv_heads if self.n_kv_heads is not None else self.n_heads
-        if self.n_heads % kv:
-            raise ValueError(f"n_heads={self.n_heads} not divisible by "
-                             f"n_kv_heads={kv}")
+        if kv <= 0 or self.n_heads % kv:
+            raise ValueError(f"n_kv_heads={kv} must be a positive divisor "
+                             f"of n_heads={self.n_heads}")
 
     @property
     def head_dim(self) -> int:
