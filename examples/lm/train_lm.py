@@ -34,9 +34,9 @@ import jax.numpy as jnp
 import tony_tpu.runtime as rt
 from tony_tpu.models import transformer as T
 from tony_tpu.models.checkpoint import CheckpointManager, attempt_number
-from tony_tpu.models.train import (batch_sharding, default_optimizer,
-                                   global_batch, init_state,
-                                   make_train_step)
+from tony_tpu.models.train import (batch_sharding, data_parallel_rank,
+                                   default_optimizer, global_batch,
+                                   init_state, make_train_step)
 from tony_tpu.parallel import shard_pytree
 from tony_tpu.runtime.profiler import StepTracer
 
@@ -124,7 +124,10 @@ def main() -> int:
 
     b_sharding = batch_sharding(mesh, logical=("batch", "seq"))
     tracer = StepTracer(start=start_step + 5, stop=start_step + 8)
-    rng = jax.random.PRNGKey(info.task_index + 1000 * attempt_number())
+    # seed by dp-rank, not task index: on meshes where the batch replicates
+    # across processes (pure pp/tp) every process must feed identical data
+    rng = jax.random.PRNGKey(data_parallel_rank(mesh)
+                             + 1000 * attempt_number())
 
     data_it = (file_batches(args.data_files, args.batch_size, args.seq_len,
                             mesh, args.steps - start_step,
@@ -149,8 +152,10 @@ def main() -> int:
             mgr.save(step + 1, state)
         if step % 20 == 0 or step == args.steps - 1:
             loss = float(metrics["loss"])
-            tok_s = (args.batch_size * info.num_processes * args.seq_len
-                     * (step - start_step + 1)
+            # global tokens/step from the assembled batch itself (batch may
+            # shard over processes — dp — or replicate — pure pp/tp)
+            gb = batch["inputs"].shape[0]
+            tok_s = (gb * args.seq_len * (step - start_step + 1)
                      / (time.perf_counter() - t0))
             print(f"step {step} loss {loss:.4f} tok/s {tok_s:,.0f}",
                   flush=True)

@@ -210,6 +210,31 @@ class TestE2E:
         assert "2 global devices" in out       # both processes federated
         assert "done:" in out
 
+    @pytest.mark.slow
+    def test_distributed_pipeline_parallel_lm_trains(self, tmp_path):
+        """Pipeline parallelism across PROCESSES: 2 workers × 1 CPU device,
+        mesh pp=2 — each process holds one stage of the flagship LM and
+        activations hop stage→stage over the gloo collective backend (the
+        same ppermute pattern that rides DCN between slices on real TPU).
+        The batch is replicated over pp, so both processes must feed
+        identical data (train.data_parallel_rank seeding)."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "lm", "train_lm.py")
+        client = make_client(
+            tmp_path, f"{PY} {script} --steps 12 --batch_size 8 "
+                      f"--seq_len 64 --preset tiny",
+            {"tony.worker.instances": "2",
+             "tony.application.mesh": "pp=2,dp=-1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read() + \
+            open(os.path.join(client.job_dir, "logs", "worker-1.stdout")).read()
+        assert "'pp': 2" in out       # train_lm prints the resolved mesh
+        assert "done:" in out
+
     def test_slice_preemption_retried_from_own_budget(self, tmp_path):
         """TEST_PREEMPT_SLICE kills the worker gang once and reports it
         preempted; with tony.am.retry-count=0 the job must STILL succeed —

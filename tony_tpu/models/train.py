@@ -105,6 +105,29 @@ def batch_sharding(mesh: Mesh, rules: Rules = DEFAULT_RULES,
     return logical_sharding(logical, mesh, rules)
 
 
+def data_parallel_rank(mesh: Mesh, axes: tuple[str, ...] = ("dp", "fsdp"),
+                       ) -> int:
+    """This process's rank along the data-parallel mesh axes — the value to
+    seed per-process data generation with. Processes at the same dp/fsdp
+    coordinate (e.g. pure-pp or pure-tp meshes, where the batch is
+    REPLICATED across processes) get the same rank and must feed identical
+    data; seeding by task index there would hand ``global_batch`` divergent
+    "replicas" that silently disagree across devices."""
+    import numpy as np
+    local = set(jax.local_devices())
+    coords = np.argwhere(
+        np.vectorize(lambda d: d in local)(mesh.devices))
+    if coords.size == 0:    # process owns no mesh device (untracked types)
+        return 0
+    first = coords[0]
+    rank = 0
+    for ax in axes:
+        if ax in mesh.axis_names:
+            i = mesh.axis_names.index(ax)
+            rank = rank * mesh.devices.shape[i] + int(first[i])
+    return rank
+
+
 def global_batch(sharding: NamedSharding, local_tree: Any) -> Any:
     """Assemble each process's LOCAL batch shard into global jax.Arrays —
     the multi-host feeding recipe (every process calls this with its own,

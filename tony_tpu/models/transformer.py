@@ -70,6 +70,11 @@ class TransformerConfig:
     # (ppermute K/V rotation, O(S/cp) memory, any head count) or "ulysses"
     # (two all-to-alls, full-seq attention on H/cp local heads).
     cp_strategy: str = "ring"
+    # GPipe microbatch count when the mesh has a pp axis > 1 (forward routes
+    # through parallel/pipeline.py automatically). 0 = auto: 2·pp if it
+    # divides the batch (bubble (pp-1)/(pp+1)), else pp. Must divide the
+    # global batch; the per-microbatch batch must divide the dp axis.
+    pp_microbatches: int = 0
     # MoE: 0 experts = dense MLP
     num_experts: int = 0
     moe_top_k: int = 2
@@ -300,11 +305,91 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
     return x, aux
 
 
+def _lm_head(params: dict, x: jax.Array, cfg: TransformerConfig,
+             mesh, rules) -> jax.Array:
+    """final_norm + lm_head on block output x [B, S, D] → logits."""
+    x = rms_norm_reference(x, params["final_norm"])
+    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
+                        preferred_element_type=jnp.float32)
+    # The cast fuses into the matmul epilogue, so with bf16 logits_dtype
+    # the f32 array never reaches HBM (see TransformerConfig.logits_dtype).
+    logits = logits.astype(cfg.logits_storage_dtype)
+    return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
+
+
+def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
+                mesh: Mesh, rules) -> tuple:
+    """Pipeline-parallel forward: blocks run as GPipe stages over the mesh's
+    ``pp`` axis (parallel/pipeline.py), embed and lm_head replicated over pp.
+
+    The stacked [L, ...] block layout reshapes to [pp, L/pp, ...] — under the
+    "stage"→"pp" sharding rule the leading dim is already split into
+    contiguous layer groups per pp rank, so the reshape is shard-local.
+    Activations hop stage→stage via ppermute (point-to-point), which is why
+    pp is the axis that tolerates DCN (mesh.py AXIS_ORDER).
+    """
+    from tony_tpu.parallel.pipeline import pipeline_apply
+
+    pp = mesh.shape["pp"]
+    if cfg.n_layers % pp:
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible into "
+                         f"{pp} pipeline stages")
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "MoE + pipeline parallelism is not supported: the gshard "
+            "dispatch needs the ep axis inside the stage body")
+    b, s = tokens.shape
+    m = cfg.pp_microbatches
+    if not m:
+        # auto: the microbatch dim stays sharded over dp/fsdp inside the
+        # pipeline's shard_map, so M must divide b AND leave b/M divisible
+        # by the live batch axes — i.e. M | b/dp. Aim for 2·pp (bubble
+        # (pp-1)/(3·pp-1)), settle for the largest divisor below it.
+        dp_total = 1
+        for a in ("dp", "fsdp"):
+            dp_total *= mesh.shape.get(a, 1)
+        per = max(b // max(dp_total, 1), 1)
+        m = next(k for k in range(min(2 * pp, per), 0, -1) if per % k == 0)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
+    blocks = jax.tree.map(
+        lambda a: a.reshape((pp, a.shape[0] // pp) + a.shape[1:]),
+        params["blocks"])
+
+    def stage_fn(stage_params, h):
+        # runs under shard_map: constrain() inside _block resolves Manual
+        # axes to replication (sharding._auto_axes), so the block body is
+        # reused verbatim
+        hb, hs = h.shape[0], h.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(hs), (hb, hs))
+        rope = rope_tables(positions, cfg.head_dim)
+        block_fn = functools.partial(_block, cfg=cfg, mesh=None, rules=rules)
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn)
+
+        def body(h, p):
+            h, _ = block_fn(h, p, rope=rope)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, stage_params, unroll=cfg.scan_unroll)
+        return h
+
+    x = pipeline_apply(stage_fn, blocks, x, mesh, num_microbatches=m)
+    logits = _lm_head(params, x, cfg, mesh, rules)
+    return logits, jnp.zeros((), jnp.float32)
+
+
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
             mesh: Mesh | None = None, rules=DEFAULT_RULES) -> tuple:
     """tokens [B, S] int32 → (logits [B, S, V] in
     cfg.logits_storage_dtype — f32 accumulation, storage-rounded once;
-    see TransformerConfig.logits_dtype — and the aux_loss scalar)."""
+    see TransformerConfig.logits_dtype — and the aux_loss scalar).
+
+    With a mesh whose ``pp`` axis is >1 the blocks run as a GPipe pipeline
+    (:func:`_forward_pp`) — pipelining is a mesh change, not a model change.
+    """
+    if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        return _forward_pp(params, tokens, cfg, mesh, rules)
     x = params["embed"][tokens].astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
     b, s = tokens.shape
@@ -324,14 +409,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
 
     x, auxes = jax.lax.scan(scan_body, x, params["blocks"],
                             unroll=cfg.scan_unroll)
-    x = rms_norm_reference(x, params["final_norm"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)
-    # The cast fuses into the matmul epilogue, so with bf16 logits_dtype
-    # the f32 array never reaches HBM (see TransformerConfig.logits_dtype).
-    logits = logits.astype(cfg.logits_storage_dtype)
-    logits = constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
-    return logits, auxes.sum()
+    return _lm_head(params, x, cfg, mesh, rules), auxes.sum()
 
 
 def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:

@@ -33,8 +33,22 @@ DEFAULT_RULES: Rules = (
 )
 
 
+def _auto_axes(mesh) -> set[str]:
+    """Mesh axes that sharding constraints may refer to. Inside ``shard_map``
+    the ambient AbstractMesh marks its axes Manual and
+    ``with_sharding_constraint`` rejects specs naming them — the collective
+    layout there is the shard_map's business, so :func:`constrain` must
+    resolve those axes to replication (e.g. model code reused as a pipeline
+    stage body — parallel/pipeline.py runs blocks under shard_map)."""
+    types = getattr(mesh, "axis_types", None)
+    if types is None:
+        return set(mesh.shape)
+    return {name for name, t in zip(mesh.axis_names, types)
+            if "Manual" not in str(t)}
+
+
 def _resolve(logical: str | None, rules: Rules, mesh: Mesh,
-             used: set[str]):
+             used: set[str], auto: set[str]):
     if logical is None:
         return None
     for name, target in rules:
@@ -47,7 +61,7 @@ def _resolve(logical: str | None, rules: Rules, mesh: Mesh,
             # embed replicates instead of raising DuplicateSpecError)
             live = tuple(t for t in targets
                          if t in mesh.shape and mesh.shape[t] > 1
-                         and t not in used)
+                         and t not in used and t in auto)
             if not live:
                 return None
             used.update(live)
@@ -58,10 +72,11 @@ def _resolve(logical: str | None, rules: Rules, mesh: Mesh,
 def logical_to_spec(logical_axes: Sequence[str | None], mesh: Mesh,
                     rules: Rules = DEFAULT_RULES) -> P:
     """("batch", "embed") → PartitionSpec(("dp","fsdp"), "fsdp") under rules,
-    dropping mesh axes that don't exist, have size 1, or are already used by
-    an earlier dim of the same array."""
+    dropping mesh axes that don't exist, have size 1, are already used by
+    an earlier dim of the same array, or are Manual (inside shard_map)."""
     used: set[str] = set()
-    return P(*(_resolve(ax, rules, mesh, used) for ax in logical_axes))
+    auto = _auto_axes(mesh)
+    return P(*(_resolve(ax, rules, mesh, used, auto) for ax in logical_axes))
 
 
 def logical_sharding(logical_axes: Sequence[str | None], mesh: Mesh,
