@@ -8,6 +8,9 @@ Python over py4j per TaskExecutor.java:281). Components:
   framed     — TONY1 self-describing splittable record format: schema
                header + sync-marked blocks (the Avro container analog,
                reference :242 block sync, :446 schema channel)
+  avro       — direct Avro object-container ingestion (existing datasets
+               read in place, no conversion): spec binary codec, null +
+               deflate codecs, sync-scan split tiling (reference :242)
   reader     — FileSplitReader: C++ prefetch/shuffle engine via ctypes
                (native/datafeed.cc) with a pure-Python fallback; byte,
                ndarray, and local-spill delivery modes
@@ -21,6 +24,8 @@ from tony_tpu.io.split import (FileSegment, compute_read_info,
 from tony_tpu.io.framed import (FramedFormatError, FramedWriter,
                                 is_framed_file, iter_file_records,
                                 read_path_header)
+from tony_tpu.io.avro import (AvroFormatError, AvroWriter, is_avro_file,
+                              read_datum, write_datum)
 from tony_tpu.io.reader import DataFeedError, FileSplitReader
 
 # jax_feed re-exports are lazy: it imports numpy (and jax inside its
@@ -34,6 +39,8 @@ __all__ = [
     "split_start", "split_length",
     "FramedWriter", "FramedFormatError", "is_framed_file",
     "iter_file_records", "read_path_header",
+    "AvroWriter", "AvroFormatError", "is_avro_file",
+    "read_datum", "write_datum",
     "FileSplitReader", "DataFeedError",
     *_LAZY_JAX_FEED,
 ]

@@ -1,0 +1,619 @@
+"""Avro object-container ingestion: read existing Avro datasets in place.
+
+The reference's data feed is Avro-native (reference: tony-core/src/main/java/
+com/linkedin/tony/io/HdfsAvroFileSplitReader.java): users point the job at
+Avro files and every task reads its byte-range split, scanning forward to the
+block sync marker (:242) and serving the schema over a side channel (:446).
+This module gives the TPU build the same in-place capability — no ``tony
+convert`` step — with a self-contained implementation of the Avro spec's
+binary encoding and object-container format (https://avro.apache.org/docs/
+current/specification/): no avro/fastavro dependency.
+
+Container layout::
+
+    magic      4 bytes   b"Obj\\x01"
+    metadata   map<string, bytes>  (avro.schema json, avro.codec)
+    sync       16 bytes  random per file
+    blocks, repeating until EOF:
+        count  zigzag varlong   records in this block
+        size   zigzag varlong   serialized (possibly compressed) byte count
+        data   size bytes
+        sync   16 bytes
+
+Split semantics (the convention the reference inherits from Avro's
+DataFileReader.sync/pastSync): a reader seeks to its split offset, scans
+forward to the next sync marker, and consumes blocks whose first data byte
+lies at or before the split end — so every block belongs to exactly one
+split and a block straddling the boundary goes to the split where it starts.
+
+Codecs: ``null`` and ``deflate`` (raw zlib, RFC 1951 — the two the spec
+requires; snappy is optional per spec and absent here by design: fail loudly
+rather than mis-read).
+
+Record boundaries inside a block are schema-driven (Avro records carry no
+length prefix), so :func:`skip_datum` walks the schema to slice per-record
+bytes — the unit the FileSplitReader contract serves. :func:`read_datum`
+decodes to Python values for consumers that want structured rows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import secrets
+import struct
+import zlib
+from typing import Any, BinaryIO, Iterator
+
+# chunked scan-with-overlap marker search — both formats use 16-byte random
+# sync markers, so the framed implementation is reused verbatim
+from tony_tpu.io.framed import _find_sync
+
+MAGIC = b"Obj\x01"
+SYNC_LEN = 16
+_PRIMITIVES = frozenset(
+    ("null", "boolean", "int", "long", "float", "double", "bytes", "string"))
+
+
+class AvroFormatError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# zigzag varints (the long/int wire format)
+# ---------------------------------------------------------------------------
+
+def _read_long(buf: memoryview, pos: int) -> tuple[int, int]:
+    shift, acc = 0, 0
+    while True:
+        if pos >= len(buf):
+            raise AvroFormatError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 70:
+            raise AvroFormatError("varint too long")
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+def _write_long(n: int) -> bytes:
+    n = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _read_long_io(f: BinaryIO) -> int:
+    shift, acc = 0, 0
+    while True:
+        c = f.read(1)
+        if not c:
+            raise AvroFormatError("truncated varint")
+        b = c[0]
+        acc |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 70:
+            raise AvroFormatError("varint too long")
+    return (acc >> 1) ^ -(acc & 1)
+
+
+# ---------------------------------------------------------------------------
+# schema resolution (names registry for record/enum/fixed back-references)
+# ---------------------------------------------------------------------------
+
+def _fullname(schema: dict, namespace: str | None) -> str:
+    name = schema["name"]
+    if "." in name:
+        return name
+    ns = schema.get("namespace", namespace)
+    return f"{ns}.{name}" if ns else name
+
+
+def resolve_schema(schema: Any, names: dict[str, Any] | None = None,
+                   namespace: str | None = None) -> Any:
+    """Normalize a parsed-JSON schema: register named types so later
+    string references ("com.x.Rec") resolve, and sanity-check structure.
+    Returns the schema with named types registered in ``names``."""
+    if names is None:
+        names = {}
+    if isinstance(schema, str):
+        if schema in _PRIMITIVES:
+            return schema
+        full = (schema if "." in schema or not namespace
+                else f"{namespace}.{schema}")
+        if full in names:
+            return names[full]
+        if schema in names:
+            return names[schema]
+        raise AvroFormatError(f"unknown type reference {schema!r}")
+    if isinstance(schema, list):                      # union
+        return [resolve_schema(s, names, namespace) for s in schema]
+    if not isinstance(schema, dict) or "type" not in schema:
+        raise AvroFormatError(f"malformed schema node: {schema!r}")
+    t = schema["type"]
+    if t in _PRIMITIVES and len(schema) == 1:
+        return t
+    if t in ("record", "error"):
+        full = _fullname(schema, namespace)
+        names[full] = schema
+        names.setdefault(schema["name"], schema)
+        ns = schema.get("namespace", namespace)
+        for field in schema.get("fields", ()):
+            field["type"] = resolve_schema(field["type"], names, ns)
+        return schema
+    if t in ("enum", "fixed"):
+        full = _fullname(schema, namespace)
+        names[full] = schema
+        names.setdefault(schema["name"], schema)
+        if t == "fixed" and not (isinstance(schema.get("size"), int)
+                                 and schema["size"] >= 0):
+            raise AvroFormatError(f"fixed type needs a non-negative "
+                                  f"integer size: {schema!r}")
+        return schema
+    if t == "array":
+        schema["items"] = resolve_schema(schema["items"], names, namespace)
+        return schema
+    if t == "map":
+        schema["values"] = resolve_schema(schema["values"], names, namespace)
+        return schema
+    if t in _PRIMITIVES:                              # {"type": "string"}
+        return t
+    if isinstance(t, (dict, list)):                   # nested/union type
+        return resolve_schema(t, names, namespace)
+    raise AvroFormatError(f"unsupported schema type {t!r}")
+
+
+def parse_schema(schema_json: str) -> Any:
+    return resolve_schema(json.loads(schema_json))
+
+
+# ---------------------------------------------------------------------------
+# datum walk: skip (boundary find), read (decode), write (encode)
+# ---------------------------------------------------------------------------
+
+def _type_of(schema: Any) -> str:
+    if isinstance(schema, str):
+        return schema
+    if isinstance(schema, list):
+        return "union"
+    return schema["type"]
+
+
+def skip_datum(schema: Any, buf: memoryview, pos: int) -> int:
+    """Advance ``pos`` past one datum of ``schema`` — the record-boundary
+    finder that lets a block of back-to-back records be sliced without full
+    decoding of leaf values."""
+    t = _type_of(schema)
+    if t == "null":
+        return pos
+    if t == "boolean":
+        return pos + 1
+    if t in ("int", "long"):
+        _, pos = _read_long(buf, pos)
+        return pos
+    if t == "float":
+        return pos + 4
+    if t == "double":
+        return pos + 8
+    if t in ("bytes", "string"):
+        n, pos = _read_long(buf, pos)
+        if n < 0 or pos + n > len(buf):
+            raise AvroFormatError(f"bad {t} length {n}")
+        return pos + n
+    if t == "fixed":
+        return pos + schema["size"]
+    if t == "enum":
+        _, pos = _read_long(buf, pos)
+        return pos
+    if t == "union":
+        idx, pos = _read_long(buf, pos)
+        if not 0 <= idx < len(schema):
+            raise AvroFormatError(f"union index {idx} out of range")
+        return skip_datum(schema[idx], buf, pos)
+    if t == "record" or t == "error":
+        for field in schema["fields"]:
+            pos = skip_datum(field["type"], buf, pos)
+        return pos
+    if t == "array" or t == "map":
+        while True:
+            count, pos = _read_long(buf, pos)
+            if count == 0:
+                return pos
+            if count < 0:       # block with explicit byte size: skip whole
+                size, pos = _read_long(buf, pos)
+                if size < 0 or pos + size > len(buf):
+                    raise AvroFormatError("bad array/map block size")
+                pos += size
+                continue
+            for _ in range(count):
+                if t == "array":
+                    pos = skip_datum(schema["items"], buf, pos)
+                else:
+                    n, pos = _read_long(buf, pos)       # key (string)
+                    if n < 0 or pos + n > len(buf):
+                        raise AvroFormatError(f"bad map key length {n}")
+                    pos += n
+                    pos = skip_datum(schema["values"], buf, pos)
+    raise AvroFormatError(f"unsupported type {t!r}")
+
+
+def read_datum(schema: Any, buf: memoryview, pos: int) -> tuple[Any, int]:
+    """Decode one datum → (python value, new position)."""
+    t = _type_of(schema)
+    if t == "null":
+        return None, pos
+    if t == "boolean":
+        return buf[pos] != 0, pos + 1
+    if t in ("int", "long"):
+        return _read_long(buf, pos)
+    if t == "float":
+        return struct.unpack_from("<f", buf, pos)[0], pos + 4
+    if t == "double":
+        return struct.unpack_from("<d", buf, pos)[0], pos + 8
+    if t in ("bytes", "string"):
+        n, pos = _read_long(buf, pos)
+        if n < 0 or pos + n > len(buf):
+            raise AvroFormatError(f"bad {t} length {n}")
+        raw = bytes(buf[pos:pos + n])
+        return (raw.decode("utf-8") if t == "string" else raw), pos + n
+    if t == "fixed":
+        n = schema["size"]
+        return bytes(buf[pos:pos + n]), pos + n
+    if t == "enum":
+        idx, pos = _read_long(buf, pos)
+        symbols = schema["symbols"]
+        if not 0 <= idx < len(symbols):
+            raise AvroFormatError(f"enum index {idx} out of range")
+        return symbols[idx], pos
+    if t == "union":
+        idx, pos = _read_long(buf, pos)
+        if not 0 <= idx < len(schema):
+            raise AvroFormatError(f"union index {idx} out of range")
+        return read_datum(schema[idx], buf, pos)
+    if t == "record" or t == "error":
+        out = {}
+        for field in schema["fields"]:
+            out[field["name"]], pos = read_datum(field["type"], buf, pos)
+        return out, pos
+    if t == "array":
+        items = []
+        while True:
+            count, pos = _read_long(buf, pos)
+            if count == 0:
+                return items, pos
+            if count < 0:
+                count = -count
+                _, pos = _read_long(buf, pos)      # byte size: unused here
+            for _ in range(count):
+                v, pos = read_datum(schema["items"], buf, pos)
+                items.append(v)
+    if t == "map":
+        out = {}
+        while True:
+            count, pos = _read_long(buf, pos)
+            if count == 0:
+                return out, pos
+            if count < 0:
+                count = -count
+                _, pos = _read_long(buf, pos)
+            for _ in range(count):
+                n, pos = _read_long(buf, pos)
+                if n < 0 or pos + n > len(buf):
+                    raise AvroFormatError(f"bad map key length {n}")
+                key = bytes(buf[pos:pos + n]).decode("utf-8")
+                pos += n
+                out[key], pos = read_datum(schema["values"], buf, pos)
+    raise AvroFormatError(f"unsupported type {t!r}")
+
+
+def write_datum(schema: Any, value: Any, out: bytearray) -> None:
+    """Encode one datum (the fixture/convert writer — exact inverse of
+    :func:`read_datum`)."""
+    t = _type_of(schema)
+    if t == "null":
+        return
+    if t == "boolean":
+        out.append(1 if value else 0)
+        return
+    if t in ("int", "long"):
+        out += _write_long(int(value))
+        return
+    if t == "float":
+        out += struct.pack("<f", value)
+        return
+    if t == "double":
+        out += struct.pack("<d", value)
+        return
+    if t in ("bytes", "string"):
+        raw = value.encode("utf-8") if t == "string" else bytes(value)
+        out += _write_long(len(raw)) + raw
+        return
+    if t == "fixed":
+        raw = bytes(value)
+        if len(raw) != schema["size"]:
+            raise AvroFormatError(
+                f"fixed value of {len(raw)} bytes != size {schema['size']}")
+        out += raw
+        return
+    if t == "enum":
+        out += _write_long(schema["symbols"].index(value))
+        return
+    if t == "union":
+        for i, branch in enumerate(schema):
+            if _matches(branch, value):
+                out += _write_long(i)
+                write_datum(branch, value, out)
+                return
+        raise AvroFormatError(f"value {value!r} matches no union branch")
+    if t == "record" or t == "error":
+        for field in schema["fields"]:
+            write_datum(field["type"], value[field["name"]], out)
+        return
+    if t == "array":
+        if value:
+            out += _write_long(len(value))
+            for v in value:
+                write_datum(schema["items"], v, out)
+        out += _write_long(0)
+        return
+    if t == "map":
+        if value:
+            out += _write_long(len(value))
+            for k, v in value.items():
+                write_datum("string", k, out)
+                write_datum(schema["values"], v, out)
+        out += _write_long(0)
+        return
+    raise AvroFormatError(f"unsupported type {t!r}")
+
+
+def _matches(schema: Any, value: Any) -> bool:
+    t = _type_of(schema)
+    if t == "null":
+        return value is None
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, float)
+    if t == "string":
+        return isinstance(value, str)
+    if t in ("bytes", "fixed"):
+        return isinstance(value, (bytes, bytearray))
+    if t == "enum":
+        return isinstance(value, str) and value in schema["symbols"]
+    if t in ("record", "error", "map"):
+        return isinstance(value, dict)
+    if t == "array":
+        return isinstance(value, list)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# object container file: header, writer, split-aware block iteration
+# ---------------------------------------------------------------------------
+
+class AvroHeader:
+    __slots__ = ("sync", "schema_json", "codec", "data_start", "schema")
+
+    def __init__(self, sync: bytes, schema_json: str, codec: str,
+                 data_start: int):
+        self.sync = sync
+        self.schema_json = schema_json
+        self.codec = codec
+        self.data_start = data_start
+        self.schema = parse_schema(schema_json)
+
+
+def is_avro_file(path: str) -> bool:
+    """True when ``path`` starts with the Avro container magic (missing
+    files raise OSError — same loud-typo policy as framed.is_framed_file)."""
+    with open(path, "rb") as f:
+        return f.read(len(MAGIC)) == MAGIC
+
+
+def read_header(f: BinaryIO) -> AvroHeader:
+    f.seek(0)
+    if f.read(len(MAGIC)) != MAGIC:
+        raise AvroFormatError("not an Avro object container file")
+    meta: dict[str, bytes] = {}
+    while True:                                   # metadata map blocks
+        count = _read_long_io(f)
+        if count == 0:
+            break
+        if count < 0:
+            count = -count
+            _read_long_io(f)                      # block byte size
+        for _ in range(count):
+            klen = _read_long_io(f)
+            key = f.read(klen).decode("utf-8")
+            vlen = _read_long_io(f)
+            meta[key] = f.read(vlen)
+    sync = f.read(SYNC_LEN)
+    if len(sync) != SYNC_LEN:
+        raise AvroFormatError("truncated container header")
+    codec = meta.get("avro.codec", b"null").decode("utf-8")
+    if codec not in ("null", "deflate"):
+        raise AvroFormatError(
+            f"unsupported avro codec {codec!r} (null and deflate — the "
+            f"spec-required codecs — are supported)")
+    schema_json = meta.get("avro.schema", b"").decode("utf-8")
+    if not schema_json:
+        raise AvroFormatError("container missing avro.schema metadata")
+    return AvroHeader(sync, schema_json, codec, f.tell())
+
+
+def read_path_header(path: str) -> AvroHeader:
+    with open(path, "rb") as f:
+        return read_header(f)
+
+
+class AvroWriter:
+    """Container writer (DataFileWriter analog) — fixtures, ``tony
+    convert --to avro``, and round-trip tests. Spec-conformant output:
+    readable by any Avro implementation."""
+
+    def __init__(self, path_or_file, schema: dict | str,
+                 codec: str = "null", block_records: int = 1024,
+                 sync: bytes | None = None) -> None:
+        if isinstance(path_or_file, (str, os.PathLike)):
+            self._f: BinaryIO = open(path_or_file, "wb")
+            self._owns = True
+        else:
+            self._f = path_or_file
+            self._owns = False
+        if codec not in ("null", "deflate"):
+            raise AvroFormatError(f"unsupported codec {codec!r}")
+        self._codec = codec
+        schema_json = (schema if isinstance(schema, str)
+                       else json.dumps(schema))
+        self.schema = parse_schema(schema_json)
+        self.sync = sync if sync is not None else secrets.token_bytes(SYNC_LEN)
+        if len(self.sync) != SYNC_LEN:
+            raise ValueError(f"sync marker must be {SYNC_LEN} bytes")
+        meta = {"avro.schema": schema_json.encode("utf-8"),
+                "avro.codec": codec.encode("utf-8")}
+        self._f.write(MAGIC)
+        self._f.write(_write_long(len(meta)))
+        for k, v in meta.items():
+            kb = k.encode("utf-8")
+            self._f.write(_write_long(len(kb)) + kb
+                          + _write_long(len(v)) + v)
+        self._f.write(_write_long(0) + self.sync)
+        self._buf = bytearray()
+        self._count = 0
+        self._block_records = max(1, block_records)
+        self.records_written = 0
+
+    def append(self, value: Any) -> None:
+        write_datum(self.schema, value, self._buf)
+        self._count += 1
+        self.records_written += 1
+        if self._count >= self._block_records:
+            self._flush_block()
+
+    def append_encoded(self, raw: bytes) -> None:
+        """Append an already-encoded datum (split/merge tooling)."""
+        self._buf += raw
+        self._count += 1
+        self.records_written += 1
+        if self._count >= self._block_records:
+            self._flush_block()
+
+    def _flush_block(self) -> None:
+        if not self._count:
+            return
+        data = bytes(self._buf)
+        if self._codec == "deflate":
+            data = zlib.compress(data)[2:-4]      # raw RFC-1951, per spec
+        self._f.write(_write_long(self._count) + _write_long(len(data))
+                      + data + self.sync)
+        self._buf.clear()
+        self._count = 0
+
+    def close(self) -> None:
+        self._flush_block()
+        self._f.flush()
+        if self._owns:
+            self._f.close()
+
+    def __enter__(self) -> "AvroWriter":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def iter_segment_blocks(path: str, offset: int, length: int,
+                        header: AvroHeader | None = None,
+                        ) -> Iterator[tuple[int, bytes]]:
+    """(count, decompressed block bytes) for every block of the split —
+    the reference's sync→pastSync walk (HdfsAvroFileSplitReader.java:242
+    seeks the marker, then consumes blocks until the split end).
+
+    Tiling rule: every block is preceded by a sync marker (the container
+    header ends with one, and each block is followed by one), and a block
+    belongs to the split in which its preceding marker STARTS — the same
+    invariant as framed.py, so adjacent splits tile exactly: no record is
+    read twice or skipped for any split geometry."""
+    with open(path, "rb") as f:
+        if header is None:
+            header = read_header(f)
+        end = offset + length
+        # never scan inside the header (schema bytes aren't data); the
+        # header's trailing sync at data_start-16 is block 1's marker
+        scan_from = max(offset, header.data_start - SYNC_LEN)
+        at = _find_sync(f, header.sync, scan_from, end)
+        if at == -1:
+            return
+        pos = at + SYNC_LEN                   # first owned block's start
+        while True:
+            f.seek(pos)
+            probe = f.read(1)
+            if not probe:
+                return                        # clean EOF after final sync
+            f.seek(pos)
+            count = _read_long_io(f)
+            size = _read_long_io(f)
+            if count < 0 or size < 0 or size > (1 << 31):
+                raise AvroFormatError(
+                    f"implausible block at {path}:{pos} "
+                    f"(count={count}, size={size})")
+            data = f.read(size)
+            if len(data) < size:
+                raise AvroFormatError(f"truncated block at {path}:{pos}")
+            marker = f.read(SYNC_LEN)
+            if len(marker) < SYNC_LEN or marker != header.sync:
+                raise AvroFormatError(f"lost sync after block at {path}:{pos}")
+            if header.codec == "deflate":
+                data = zlib.decompress(data, -15)
+            yield count, data
+            pos = f.tell()                    # next block start
+            if pos - SYNC_LEN >= end:
+                return     # its marker starts in a later split — not ours
+
+
+def iter_segment_records(path: str, offset: int,
+                         length: int) -> Iterator[bytes]:
+    """Raw encoded datum bytes of every record in the split's blocks — the
+    FileSplitReader record contract (decode with read_datum + the schema
+    from the reader's schema channel)."""
+    header = read_path_header(path)
+    for count, data in iter_segment_blocks(path, offset, length, header):
+        view = memoryview(data)
+        pos = 0
+        for _ in range(count):
+            new = skip_datum(header.schema, view, pos)
+            if new > len(view):
+                raise AvroFormatError(
+                    f"record overruns block in {path} (pos {pos})")
+            yield bytes(view[pos:new])
+            pos = new
+        if pos != len(view):
+            raise AvroFormatError(
+                f"block in {path} has {len(view) - pos} trailing bytes "
+                f"after {count} records")
+
+
+def iter_file_records(path: str) -> Iterator[bytes]:
+    yield from iter_segment_records(path, 0, os.path.getsize(path))
+
+
+def iter_file_values(path: str) -> Iterator[Any]:
+    """Decoded Python values for every record (convenience consumption)."""
+    header = read_path_header(path)
+    for raw in iter_file_records(path):
+        value, _ = read_datum(header.schema, memoryview(raw), 0)
+        yield value

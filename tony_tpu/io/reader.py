@@ -41,6 +41,7 @@ import random
 import weakref
 from typing import Iterator
 
+from tony_tpu.io import avro as _avro
 from tony_tpu.io import framed as _framed
 from tony_tpu.io.split import FileSegment, compute_read_info
 from tony_tpu.io.native.build import load_native
@@ -135,6 +136,10 @@ class _PythonImpl:
                 yield from _framed.iter_segment_records(
                     seg.path, seg.offset, seg.length)
                 continue
+            if record_size == -2:           # Avro object container
+                yield from _avro.iter_segment_records(
+                    seg.path, seg.offset, seg.length)
+                continue
             with open(seg.path, "rb") as f:
                 if record_size > 0:
                     first = -(-seg.offset // record_size)
@@ -210,38 +215,50 @@ class FileSplitReader:
                  use_native: bool | None = None,
                  sizes: list[int] | None = None) -> None:
         #: schema channel (reference getSchemaJson:446): the JSON schema
-        #: from the first framed file's header, "" for unframed data.
+        #: from the first framed/Avro file's header, "" for unframed data.
         self.schema_json = ""
-        header0 = None
-        if paths and record_size in (None, -1):
-            try:
-                header0 = _framed.read_path_header(paths[0])
-            except _framed.FramedFormatError:
-                if record_size == -1:
-                    raise
-        # record_size None = auto: TONY1 framed when the files carry the
-        # magic, newline-delimited otherwise. -1 forces framed. A MIXED
-        # list under auto is rejected — parsing a framed file as lines
-        # would silently yield garbage records.
+        # record_size None = auto: every path is classified (TONY1 framed /
+        # Avro container / unframed) and the kinds must AGREE — parsing a
+        # framed or Avro file as lines would silently yield garbage, so a
+        # mixed list is rejected whatever the ordering. -1 forces framed,
+        # -2 forces Avro (header read below raises on a mismatched file).
         if record_size is None:
-            flags = [header0 is not None] + [
-                _framed.is_framed_file(p) for p in paths[1:]]
-            if any(flags) and not all(flags):
-                mixed = [p for p, fr in zip(paths, flags) if not fr]
-                raise ValueError(
-                    f"mixed framings: {mixed[0]} is not TONY1 framed but "
-                    f"other inputs are; pass record_size explicitly")
-            record_size = -1 if paths and flags[0] else 0
-        if record_size < -1:
-            raise ValueError("record_size must be -1 (framed), 0 (lines), "
-                             "or a positive fixed size")
+            if paths:
+                def _kind(p: str) -> int:
+                    if _framed.is_framed_file(p):
+                        return -1
+                    return -2 if _avro.is_avro_file(p) else 0
+                kinds = [_kind(p) for p in paths]
+                if len(set(kinds)) > 1:
+                    names = {-1: "TONY1 framed", -2: "Avro", 0: "unframed"}
+                    detail = ", ".join(
+                        f"{p} is {names[k]}" for p, k in zip(paths, kinds))
+                    raise ValueError(f"mixed framings ({detail}); pass "
+                                     f"record_size explicitly")
+                record_size = kinds[0]
+            else:
+                record_size = 0
+        if record_size < -2:
+            raise ValueError("record_size must be -2 (avro), -1 (framed), "
+                             "0 (lines), or a positive fixed size")
         self.record_size = record_size
-        if header0 is not None and record_size == -1:
-            self.schema_json = header0.schema_json
+        if paths and record_size == -1:
+            self.schema_json = _framed.read_path_header(paths[0]).schema_json
+        elif paths and record_size == -2:
+            self.schema_json = _avro.read_path_header(paths[0]).schema_json
         self.segments = compute_read_info(paths, task_index, task_num,
                                           sizes=sizes)
         #: records pulled past a spill-call budget, served before new pulls
         self._spill_carry: list[bytes] = []
+        # Avro record boundaries are schema-driven (skip_datum walks the
+        # schema), so the Avro arm runs on the Python engine; the C++
+        # engine speaks the byte-framed formats (fixed/lines/TONY1).
+        if record_size == -2:
+            if use_native is True:
+                raise DataFeedError(
+                    "the native engine does not decode Avro (record "
+                    "boundaries are schema-driven); omit use_native")
+            use_native = False
         lib = load_native() if use_native in (None, True) else None
         if use_native is True and lib is None:
             raise DataFeedError("native data-feed requested but unavailable")
