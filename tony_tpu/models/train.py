@@ -74,12 +74,26 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
     automatically (no explicit all-reduce anywhere).
     """
 
+    fused = hasattr(optimizer, "fused_apply")
+    if fused and mesh is not None:
+        # fail where the step is built, not with an opaque SPMD lowering
+        # error: a pallas_call does not partition under pjit, so sharded
+        # params need the optax formulation (default_optimizer docstring)
+        raise ValueError("fused optimizers are single-chip only — use "
+                         "default_optimizer(fused=False) with a mesh")
+
     def step(state: TrainState, batch: Any):
         loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
-        updates, opt_state = optimizer.update(grads, state["opt_state"],
-                                              state["params"])
-        params = optax.apply_updates(state["params"], updates)
-        gnorm = optax.global_norm(grads)
+        if fused:
+            # single-pass update (ops/optim.py): params change inside the
+            # kernel, no separate apply_updates traversal
+            params, opt_state, gnorm = optimizer.fused_apply(
+                grads, state["opt_state"], state["params"])
+        else:
+            updates, opt_state = optimizer.update(grads, state["opt_state"],
+                                                  state["params"])
+            params = optax.apply_updates(state["params"], updates)
+            gnorm = optax.global_norm(grads)
         new_state = {"params": params, "opt_state": opt_state,
                      "step": state["step"] + 1}
         return new_state, {"loss": loss, "grad_norm": gnorm,
@@ -153,10 +167,25 @@ def make_eval_step(loss_fn: Callable[[Any, Any], jax.Array],
 
 def default_optimizer(lr: float = 3e-4, weight_decay: float = 0.01,
                       warmup_steps: int = 100,
-                      total_steps: int = 10_000) -> optax.GradientTransformation:
-    """AdamW + linear warmup→cosine decay, the standard LM recipe."""
+                      total_steps: int = 10_000,
+                      fused: bool = False):
+    """AdamW + linear warmup→cosine decay, the standard LM recipe.
+
+    ``fused=True`` selects the single-pass Pallas update (ops/optim.py)
+    with f32 moments — a NUMERICS upgrade for bf16 models (optax silently
+    inherits bf16 moments from bf16 grads), at a measured ~1 ms/step cost
+    at 66 M params on one v5e. It is not a throughput win: XLA fuses the
+    optax chain into the backward epilogue (grads are consumed in
+    registers, never re-read from HBM), which a custom call cannot match
+    — see docs/performance.md "What didn't help". The optax chain is
+    the default and the only multi-chip path (a pallas_call does not
+    partition under pjit). Both match to fp tolerance (tests/test_ops.py).
+    """
     sched = optax.warmup_cosine_decay_schedule(
         0.0, lr, warmup_steps, max(total_steps, warmup_steps + 1))
+    if fused:
+        from tony_tpu.ops.optim import FusedAdamW
+        return FusedAdamW(sched, weight_decay=weight_decay, clip_norm=1.0)
     return optax.chain(
         optax.clip_by_global_norm(1.0),
         optax.adamw(sched, weight_decay=weight_decay),

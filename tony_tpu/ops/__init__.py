@@ -12,9 +12,11 @@ from tony_tpu.ops.norms import (
     rms_norm,
     rms_norm_reference,
 )
+from tony_tpu.ops.optim import FusedAdamW
 
 __all__ = [
     "flash_attention",
+    "FusedAdamW",
     "layer_norm",
     "layer_norm_reference",
     "reference_attention",
