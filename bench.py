@@ -152,6 +152,23 @@ def main() -> None:
         int(dec.tokens[0, 0])
         t_dec = (time.perf_counter() - t0) / 3
         decode_tps = round(d_batch * d_new / t_dec, 1)
+        # GQA decode (n_kv_heads=2): the grouped cache read + GQA-native
+        # prefill kernels cut the decode-roofline HBM traffic — recorded
+        # as its own arm since the model differs from the MHA flagship.
+        gqa_cfg = cfg.scaled(n_kv_heads=2)
+        gqa_params = T.init_params(jax.random.PRNGKey(0), gqa_cfg)
+        gqa_gen = functools.partial(generate, cfg=gqa_cfg,
+                                    max_new_tokens=d_new, temperature=0.0)
+        dec = gqa_gen(gqa_params, prompt, rng=jax.random.PRNGKey(4))
+        int(dec.tokens[0, 0])                    # compile + warm
+        t0 = time.perf_counter()
+        for i in range(3):
+            dec = gqa_gen(gqa_params, prompt, rng=jax.random.PRNGKey(9 + i))
+        int(dec.tokens[0, 0])
+        decode_gqa_tps = round(d_batch * d_new * 3
+                               / (time.perf_counter() - t0), 1)
+        out["decode_gqa_tokens_per_s"] = decode_gqa_tps
+        del gqa_params, gqa_gen
         del params, prompt, dec, gen   # free HBM before the tight base run
 
         def secondary(name, config, s_batch, s_seq, s_iters, key,
@@ -179,7 +196,10 @@ def main() -> None:
         secondary("large", T.PRESETS["large"], 4, 1024, 8, key=7)
         # long context (seq 8192) — the regime where attention dominates
         # layer FLOPs. Batch 4 is ~4% over 2 (interleaved A/B) and fits.
-        secondary("seq8k", cfg, 4, 8192, 10, key=6, with_mfu=False)
+        # MFU recorded so the fused-vs-two-pass backward budget decision
+        # (ops/attention.py _FUSED_PARTIALS_BYTES) has an efficiency
+        # number to regress against.
+        secondary("seq8k", cfg, 4, 8192, 10, key=6)
 
     print(json.dumps(out))
 

@@ -536,7 +536,7 @@ class TestUlyssesAttention:
         q, k, v = (jnp.asarray(r.randn(2, 36, 4, 16), jnp.float32)
                    for _ in range(3))
         mesh = make_mesh({"dp": 2, "cp": 4})
-        with pytest.raises(ValueError, match="pad the sequence"):
+        with pytest.raises(ValueError, match="pad the per-device"):
             ulysses_attention(q, k, v, mesh, causal=True)
 
     def test_matches_ring(self, qkv):

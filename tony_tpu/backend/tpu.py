@@ -297,7 +297,14 @@ class TpuSliceBackend(SchedulerBackend):
                     if self.dry_run:
                         log.info("[dry-run] %s", " ".join(cmd))
                     else:
-                        subprocess.run(cmd, capture_output=True, timeout=600)
+                        # bounded by the SAME per-command timeout the
+                        # _await_gang deadline is derived from (7× it) —
+                        # a hardcoded bound here would let the pipeline
+                        # outrun the co-gang waiters' deadline
+                        delete_timeout = self.conf.get_int(
+                            K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
+                        subprocess.run(cmd, capture_output=True,
+                                       timeout=delete_timeout)
                 self._provision(job_type, slice_idx, spec)
             except BaseException:
                 with self._lock:
@@ -345,7 +352,11 @@ class TpuSliceBackend(SchedulerBackend):
         failed generation's event is set as it is retracted, and a retry
         may have re-claimed the gang with a fresh entry (and fresh event)
         that must be waited on instead."""
-        deadline = time.monotonic() + 4 * timeout_s
+        # Worst case: delete (reprovision path) + create + 4 staging
+        # commands (scp tarball, unpack, scp secret, chmod) = 6 commands,
+        # each bounded by timeout_s; +1 for scheduling slack so a co-gang
+        # waiter never times out while the provisioner is still succeeding.
+        deadline = time.monotonic() + 7 * timeout_s
         while True:
             with self._lock:
                 current = self._gangs.get(gang)

@@ -199,10 +199,9 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
         v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
         q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
-        # the cache stores KV heads; compute wants full heads (GQA no-op
-        # for MHA)
-        kh, vh = T.repeat_kv(k, v, cfg)
-        o = T._attention(q, kh, vh, None)
+        # GQA K/V go to the kernels unexpanded (flash/reference consume
+        # kv_heads-wide K/V natively; no-op distinction for MHA)
+        o = T._attention(q, k, v, None)
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)

@@ -230,15 +230,19 @@ def _rope(x: jax.Array, positions: jax.Array) -> jax.Array:
     return apply_rope(x, cos, sin)
 
 
-def repeat_kv(k: jax.Array, v: jax.Array,
-              cfg: TransformerConfig) -> tuple[jax.Array, jax.Array]:
+def expand_kv(q: jax.Array, k: jax.Array,
+              v: jax.Array) -> tuple[jax.Array, jax.Array]:
     """GQA → full heads: broadcast each K/V head across its query group
-    (blocked layout: query head h reads kv head h // (H/KV)). The single
-    definition of the group layout — training, prefill, and the grouped
-    cache read must agree or cached decode silently diverges."""
-    if cfg.kv_heads == cfg.n_heads:
+    (blocked layout: query head h reads kv head h // (H/KV) — the same
+    layout the flash kernels and the grouped cache read use natively).
+    Only the context-parallel wrappers need the expansion; the
+    flash/reference arms consume GQA K/V unexpanded."""
+    h, hk = q.shape[2], k.shape[2]
+    if h == hk:
         return k, v
-    rep = cfg.n_heads // cfg.kv_heads
+    if hk <= 0 or h % hk:
+        raise ValueError(f"kv heads ({hk}) must divide heads ({h})")
+    rep = h // hk
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
@@ -249,10 +253,15 @@ def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
         raise ValueError(f"unknown cp_strategy {cp_strategy!r}; "
                          f"expected 'ring' or 'ulysses'")
     if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
+        # ring/ulysses shard by heads/sequence and need matching head
+        # counts — expand GQA K/V here (the cp regime's traffic is
+        # dominated by the collectives, not the local K/V read)
+        k, v = expand_kv(q, k, v)
         if cp_strategy == "ulysses":
             from tony_tpu.parallel.ulysses import ulysses_attention
             return ulysses_attention(q, k, v, mesh, causal=True)
         return ring_attention(q, k, v, mesh, causal=True)
+    # flash and reference both consume GQA K/V natively (fewer kv heads)
     if jax.default_backend() == "tpu":
         return flash_attention(q, k, v, causal=True)
     return reference_attention(q, k, v, causal=True)
@@ -274,13 +283,14 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
     k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
     v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
     q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-    # GQA: broadcast each K/V head to its query group for the kernels
-    # (training activations match MHA; the param + decode-cache savings
-    # are the point — see decode.py for the non-materializing read)
-    k, v = repeat_kv(k, v, cfg)
+    # GQA K/V stay at kv_heads width — the flash kernels read each shared
+    # head once per query group in-kernel (ops/attention.py "GQA-native");
+    # only the cp wrappers expand (inside _attention). KV heads replicate
+    # under TP when h_kv < h (Llama-style), mirroring logical_axes.
+    kv_head_axis = "heads" if cfg.kv_heads == cfg.n_heads else None
     q = constrain(q, ("batch", "seq", "heads", "kv"), mesh, rules)
-    k = constrain(k, ("batch", "seq", "heads", "kv"), mesh, rules)
-    v = constrain(v, ("batch", "seq", "heads", "kv"), mesh, rules)
+    k = constrain(k, ("batch", "seq", kv_head_axis, "kv"), mesh, rules)
+    v = constrain(v, ("batch", "seq", kv_head_axis, "kv"), mesh, rules)
     o = _attention(q, k, v, mesh, cfg.cp_strategy)
     attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh, rules)

@@ -16,6 +16,14 @@ cost on this hardware is ~2-4µs, so step count matters as much as FLOPs):
   from ``b·h × nq × nk`` to ``b·h/block_h × nq × nk`` steps. At LM shapes
   (head_dim 64, seq 1k) the per-head blocks are far below MXU-saturating
   sizes, so amortizing the fixed step cost dominates.
+- **GQA-native K/V** (round 3): when K/V carry fewer heads than Q
+  (grouped-query attention), the kernels take them UNEXPANDED. Queries are
+  laid out ``[b·h_kv, rep·sq, d]`` — each kv head's ``rep`` query heads
+  form contiguous row bands sharing that head's K/V blocks in-kernel — and
+  the causal mask uses the position within the band (``qi mod sq/bq``).
+  K/V HBM traffic drops by h/h_kv and the ``jnp.repeat`` materialization
+  disappears; dK/dV need no extra handling (the per-q-block partial sum
+  already reduces across the bands).
 - **Shared causal mask**: the block's position mask is an iota+compare
   computed once per grid step and reused by every head in the group, and
   kv-blocks entirely above the diagonal are skipped, so the VPU cost of
@@ -76,7 +84,9 @@ def _pick_group(bh: int, block_h: int) -> int:
 
 def _causal_mask(qi, ki, bq: int, bk: int):
     """[bq, bk] bool mask for the (qi, ki) block — computed once per grid
-    step and shared by all heads in the group."""
+    step and shared by all heads in the group. ``qi`` is the BAND-relative
+    q-block index (callers take program_id(..) mod blocks-per-band; for
+    plain MHA the band is the whole sequence and the mod is identity)."""
     qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     return qpos >= kpos
@@ -112,8 +122,8 @@ def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
                 *, scale: float, causal: bool, g: int, bq: int, bk: int,
-                nk: int):
-    qi = pl.program_id(1)
+                nk: int, band_nq: int):
+    qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
     # ml_scr packs the running max (lane 0) and running sum (lane 1) into
     # one [g, bq, _LANES] buffer — each lives in its own 128-lane tile
@@ -167,12 +177,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             lse_ref[gi] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
-def _flash_forward(q, k, v, *, scale, causal, g, bq, bk):
-    bh, sq, d = q.shape
+def _flash_forward(q, k, v, *, scale, causal, g, bq, bk, band):
+    bh, sq, d = q.shape                 # sq = rep·band under GQA
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               g=g, bq=bq, bk=bk, nk=nk)
+                               g=g, bq=bq, bk=bk, nk=nk,
+                               band_nq=_cdiv(band, bq))
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh // g, nq, nk),
@@ -216,12 +227,24 @@ def _flash_forward(q, k, v, *, scale, causal, g, bq, bk):
 # seq × batch·head products fall back to the two-pass kernels below.
 # ---------------------------------------------------------------------------
 
-_FUSED_PARTIALS_BYTES = 512 * 1024 * 1024   # per partial tensor (there are 2)
+# Per-partial-tensor budget (there are 2) gating the fused backward.
+# Overridable: TONY_FLASH_FUSED_PARTIALS_MB. Measured on one v5e (bf16,
+# 8 heads, d64, interleaved A/B with host-value barriers): fused is ~18%
+# faster than two-pass at BOTH seq 8k (b=4, partials at the 512 MB
+# boundary) and seq 16k (b=2, 1.07 GB partials, forced past the budget) —
+# raise the knob when HBM has headroom. Set 0 to force two-pass: the
+# fused path stores dK/dV partials in bf16 (error ~ √nq·eps_bf16, ≤0.7%
+# measured at nq=16 but growing with seq/block_q), while two-pass
+# accumulates in f32 VMEM — the knob is the precision escape hatch.
+import os as _os
+
+_FUSED_PARTIALS_BYTES = int(_os.environ.get(
+    "TONY_FLASH_FUSED_PARTIALS_MB", "512")) * 1024 * 1024
 
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
                       scale: float, causal: bool, g: int, bq: int, bk: int,
-                      nk: int, has_dlse: bool):
+                      nk: int, has_dlse: bool, band_nq: int):
     # refs = ([dlse_ref,] dq_ref, dkp_ref, dvp_ref, dq_scr): the dlse input
     # exists only for the with-lse entry point, so the hot plain-attention
     # path compiles the exact same kernel as before.
@@ -230,7 +253,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
     else:
         dlse_ref = None
         dq_ref, dkp_ref, dvp_ref, dq_scr = refs
-    qi = pl.program_id(1)
+    qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -289,7 +312,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
 
 
 def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
-                          bq, bk):
+                          bq, bk, band):
     bh, sq, d = q.shape
     sk = k.shape[1]
     has_dlse = dlse is not None
@@ -317,7 +340,8 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
         operands.append(dlse)
     dq, dkp, dvp = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          g=g, bq=bq, bk=bk, nk=nk, has_dlse=has_dlse),
+                          g=g, bq=bq, bk=bk, nk=nk, has_dlse=has_dlse,
+                          band_nq=_cdiv(band, bq)),
         grid=(bh // g, nq, nk),
         in_specs=in_specs,
         out_specs=[
@@ -356,8 +380,8 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scr, *, scale: float, causal: bool, g: int, bq: int,
-               bk: int, nk: int):
-    qi = pl.program_id(1)
+               bk: int, nk: int, band_nq: int):
+    qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
 
     @pl.when(ki == 0)
@@ -402,11 +426,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
-                causal: bool, g: int, bq: int, bk: int, nq: int):
+                causal: bool, g: int, bq: int, bk: int, nq: int,
+                band_nq: int):
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
+    qi_g = pl.program_id(2)             # global: init/finalize sequencing
+    qi = qi_g % band_nq                 # GQA band-relative: causal triage
 
-    @pl.when(qi == 0)
+    @pl.when(qi_g == 0)
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
@@ -442,26 +468,27 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _accumulate(False)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(qi_g == nq - 1)
     def _finalize():
         dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
-                    bq, bk):
+                    bq, bk, band):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
     partial_bytes = nq * bh * sk * d * q.dtype.itemsize
     if partial_bytes <= _FUSED_PARTIALS_BYTES:
         return _flash_backward_fused(q, k, v, o, lse, do, dlse, scale=scale,
-                                     causal=causal, g=g, bq=bq, bk=bk)
+                                     causal=causal, g=g, bq=bq, bk=bk,
+                                     band=band)
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
     # [bq, bk] f32 intermediates count twice; 256-wide blocks keep the
     # two-pass kernels inside the ~16 MB VMEM budget (long sequences have
     # hundreds of grid steps either way).
-    if bq > 256 and sq % 256 == 0:
+    if bq > 256 and sq % 256 == 0 and band % 256 == 0:
         bq = 256
         nq = _cdiv(sq, bq)
     if bk > 256 and sk % 256 == 0:
@@ -477,7 +504,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, g=g,
-                          bq=bq, bk=bk, nk=nk),
+                          bq=bq, bk=bk, nk=nk, band_nq=_cdiv(band, bq)),
         grid=(bh // g, nq, nk),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
@@ -497,7 +524,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, g=g,
-                          bq=bq, bk=bk, nq=nq),
+                          bq=bq, bk=bk, nq=nq, band_nq=_cdiv(band, bq)),
         grid=(bh // g, nk, nq),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda b, j, i: (b, i, 0)),
@@ -530,50 +557,50 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
 # Public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhsd(q, k, v, scale, causal, g, bq, bk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_bhsd(q, k, v, scale, causal, g, bq, bk, band):
     o, _ = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
-                          bk=bk)
+                          bk=bk, band=band)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, g, bq, bk):
+def _flash_fwd_rule(q, k, v, scale, causal, g, bq, bk, band):
     o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
-                            bk=bk)
+                            bk=bk, band=band)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, g, bq, bk, residuals, grad):
+def _flash_bwd_rule(scale, causal, g, bq, bk, band, residuals, grad):
     q, k, v, o, lse = residuals
     return _flash_backward(q, k, v, o, lse, grad, scale=scale, causal=causal,
-                           g=g, bq=bq, bk=bk)
+                           g=g, bq=bq, bk=bk, band=band)
 
 
 _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_lse_bhsd(q, k, v, scale, causal, g, bq, bk):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_lse_bhsd(q, k, v, scale, causal, g, bq, bk, band):
     """(o, lse) variant with lse as a DIFFERENTIATED output — what
     cross-chunk softmax merging (ring attention) needs: the merge weights
     are exp(lse_chunk - lse_total), so d(lse) must flow back into the
     score gradient (ds gains a +p·dlse term, folded into delta)."""
     return _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
-                          bk=bk)
+                          bk=bk, band=band)
 
 
-def _flash_lse_fwd_rule(q, k, v, scale, causal, g, bq, bk):
+def _flash_lse_fwd_rule(q, k, v, scale, causal, g, bq, bk, band):
     o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
-                            bk=bk)
+                            bk=bk, band=band)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd_rule(scale, causal, g, bq, bk, residuals, grads):
+def _flash_lse_bwd_rule(scale, causal, g, bq, bk, band, residuals, grads):
     q, k, v, o, lse = residuals
     do, dlse = grads
     return _flash_backward(q, k, v, o, lse, do,
                            dlse.astype(jnp.float32), scale=scale,
-                           causal=causal, g=g, bq=bq, bk=bk)
+                           causal=causal, g=g, bq=bq, bk=bk, band=band)
 
 
 _flash_attention_lse_bhsd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
@@ -585,6 +612,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_h: int = 4):
     """Fused attention over [batch, seq, heads, head_dim] inputs.
 
+    K/V may carry FEWER heads than Q (grouped-query attention, h_kv | h):
+    they are consumed unexpanded — query head i reads kv head
+    i // (h/h_kv), the same blocked layout as
+    ``models.transformer.expand_kv`` — so GQA cuts the kernels' K/V HBM
+    traffic by h/h_kv instead of materializing a repeated tensor.
+
     Block sizes are clamped to the input shapes (tiny test shapes).
     Defaults were swept on a v5e chip at LM shapes (seq 1-2k, head_dim 64).
     ``block_h`` is a hint for heads-per-grid-step, resolved by
@@ -594,35 +627,58 @@ def flash_attention(q, k, v, *, causal: bool = True,
     [block_q, block_k] f32 intermediates per step). Differentiable via the
     fused flash backward (two-pass kernels for long sequences).
     """
-    qf, kf, vf, scale, g, bq, bk = _prep_flat(q, k, v, scale, block_q,
-                                              block_k, block_h)
+    if _sub_tile(q, block_q):
+        return reference_attention(q, k, v, causal=causal, scale=scale)
+    qf, kf, vf, scale, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
+                                                    block_k, block_h)
     b, sq, h, d = q.shape
-    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
-    return o[:b * h].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    hk = k.shape[2]
+    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk, band)
+    return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3))
+
+
+def _sub_tile(q, block_q: int) -> bool:
+    """True when the resolved q-block would be below the 128-lane tile on
+    a REAL TPU — the 2-D [g, bq] lse layout makes bq the lane dim, and
+    sub-128 lanes are an untested Mosaic regime (interpret mode — the CPU
+    test path — keeps small blocks so the kernels stay bit-testable).
+    Callers fall back to the dense arm, which has no tiling demands."""
+    if _interpret():
+        return False
+    return min(block_q, q.shape[1]) % _LANES != 0
 
 
 def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
     """Shared entry prep: validate blocks, flatten [B,S,H,D] →
-    [B·H, S, D], pad batch·heads to a multiple of 8 (Mosaic needs the 2-D
-    lse block's leading dim divisible by 8; zero heads give zero scores →
-    uniform softmax over zero values → o = 0, finite lse, zero grads —
-    callers slice the padding off), and resolve the head group."""
+    [B·H_kv, (H/H_kv)·S, D] — under GQA each kv head's queries form
+    contiguous row BANDS of length S sharing that head's K/V; plain MHA is
+    the 1-band case — pad batch·kv-heads to a multiple of 8 (Mosaic needs
+    the 2-D lse block's leading dim divisible by 8; zero heads give zero
+    scores → uniform softmax over zero values → o = 0, finite lse, zero
+    grads — callers slice the padding off), and resolve the head group.
+    Returns the flat operands plus the band length S."""
     b, sq, h, d = q.shape
-    sk = k.shape[1]
+    sk, hk = k.shape[1], k.shape[2]
+    if hk <= 0 or h % hk:
+        raise ValueError(f"kv heads ({hk}) must divide query heads ({h})")
+    rep = h // hk
     if sq % min(block_q, sq) or sk % min(block_k, sk):
         raise ValueError(f"seq lengths ({sq}, {sk}) must divide into blocks")
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     scale = (d ** -0.5) if scale is None else scale
-    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    qf, kf, vf = to_flat(q), to_flat(k), to_flat(v)
-    bh = b * h
+    # [B,S,H,D] → [B,H,S,D] → group rep query heads per kv head into one
+    # row dim (blocked head order: query head i ↔ kv head i // rep)
+    qf = q.transpose(0, 2, 1, 3).reshape(b * hk, rep * sq, d)
+    to_flat = lambda x: x.transpose(0, 2, 1, 3).reshape(b * hk, x.shape[1], d)
+    kf, vf = to_flat(k), to_flat(v)
+    bh = b * hk
     if bh % 8:
         pad = 8 * _cdiv(bh, 8) - bh
         qf, kf, vf = (jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
                       for x in (qf, kf, vf))
     g = _pick_group(qf.shape[0], block_h)
-    return qf, kf, vf, scale, g, bq, bk
+    return qf, kf, vf, scale, g, bq, bk, sq
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
@@ -633,25 +689,49 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     ([batch, heads, seq], f32) as a DIFFERENTIATED output — the primitive
     for cross-chunk online-softmax merging (ring attention): merged
     results are ``o = Σ_c o_c · exp(lse_c - logaddexp_c lse_c)``, and the
-    lse cotangent flows back into the score gradients."""
-    qf, kf, vf, scale, g, bq, bk = _prep_flat(q, k, v, scale, block_q,
-                                              block_k, block_h)
+    lse cotangent flows back into the score gradients. GQA K/V (fewer
+    heads than Q) is supported exactly as in :func:`flash_attention`."""
+    if _sub_tile(q, block_q):
+        return _dense_with_lse(q, k, v, causal=causal, scale=scale)
+    qf, kf, vf, scale, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
+                                                    block_k, block_h)
     b, sq, h, d = q.shape
-    o, lse = _flash_attention_lse_bhsd(qf, kf, vf, scale, causal, g, bq, bk)
-    return (o[:b * h].reshape(b, h, sq, d).transpose(0, 2, 1, 3),
-            lse[:b * h].reshape(b, h, sq))
+    hk = k.shape[2]
+    o, lse = _flash_attention_lse_bhsd(qf, kf, vf, scale, causal, g, bq, bk,
+                                       band)
+    return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3),
+            lse[:b * hk].reshape(b, h, sq))
 
 
-def reference_attention(q, k, v, *, causal: bool = True,
-                        scale: float | None = None):
-    """Dense O(S²) attention in plain jnp — the correctness oracle for the
-    kernels and the fallback for odd shapes."""
+def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None):
+    """Dense (o, lse): the sub-tile fallback for the with-lse entry and
+    the body of :func:`reference_attention` (plain jnp, so AD provides
+    the dlse flow for free). GQA K/V (fewer heads than Q) is expanded —
+    this is the oracle/CPU arm, where clarity beats the bandwidth saving
+    the kernels exist for."""
     d = q.shape[-1]
+    h, hk = q.shape[2], k.shape[2]
+    if h != hk:
+        if hk <= 0 or h % hk:
+            raise ValueError(f"kv heads ({hk}) must divide heads ({h})")
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
     scale = (d ** -0.5) if scale is None else scale
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
         s = jnp.where(mask[None, None], s, _NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    lse = jax.scipy.special.logsumexp(s, axis=-1)
+    p = jnp.exp(s - lse[..., None])
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+    return o.astype(q.dtype), lse
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        scale: float | None = None):
+    """Dense O(S²) attention in plain jnp — the correctness oracle for
+    the kernels and the fallback for odd shapes (GQA-aware; see
+    :func:`_dense_with_lse`, whose output this is)."""
+    o, _ = _dense_with_lse(q, k, v, causal=causal, scale=scale)
+    return o

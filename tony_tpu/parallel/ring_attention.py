@@ -118,9 +118,15 @@ def ring_attention_local(q, k, v, *, axis_name: str = "cp",
 
 def _flash_block(s_loc: int) -> int | None:
     """Largest flash block size tiling the local chunk, or None when no
-    usable block exists (odd chunk lengths fall back to the dense arm,
-    which has no divisibility requirement)."""
-    for b in (512, 256, 128, 64, 32, 16, 8):
+    usable block exists. On REAL TPU the floor is 128: below the
+    128-lane tile the Mosaic lowering of the 2-D lse layout is untested,
+    and per-grid-step overhead makes flash slower than the dense arm
+    there anyway. Interpret mode (the CPU test path) keeps the small
+    blocks so the flash-chunk arm stays bit-testable at tiny shapes."""
+    import jax
+    blocks = ((512, 256, 128) if jax.default_backend() == "tpu"
+              else (512, 256, 128, 64, 32, 16, 8))
+    for b in blocks:
         if s_loc % b == 0:
             return b
     return None

@@ -53,7 +53,8 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
         # avoid. Fail with the remedy instead.
         raise ValueError(
             f"ulysses full sequence {s_loc * cp} does not tile any flash "
-            f"block; pad the sequence to a multiple of 8")
+            f"block; pad the per-device full sequence to a multiple of "
+            f"128 on TPU (8 in interpret mode)")
     if cp == 1:
         return _single_chunk(q, k, v, causal=causal, scale=scale)
 
