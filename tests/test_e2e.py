@@ -301,6 +301,52 @@ class TestE2E:
         with open(secret_file) as f:
             assert f.read() == client.secret
 
+    def test_tls_job_succeeds_and_rejects_plaintext(self, tmp_path):
+        """tony.tls.enabled: the coordinator serves gRPC over the per-job
+        cert, executors pin their channels via the staged cert path, and
+        the whole job succeeds; a plaintext probe against the live
+        coordinator fails its handshake."""
+        from tony_tpu.rpc.client import ApplicationRpcClient
+        import threading
+        client = make_client(tmp_path, fixture_cmd("sleep_briefly.py", "3"),
+                             {"tony.worker.instances": "2",
+                              "tony.application.security.enabled": "true",
+                              "tony.tls.enabled": "true"})
+        probe_result = {}
+
+        def probe():
+            # wait for the coordinator address, then poke it WITHOUT TLS
+            for _ in range(100):
+                addr_file = os.path.join(client.job_dir, "coordinator.addr")
+                if os.path.exists(addr_file):
+                    break
+                time.sleep(0.1)
+            else:
+                probe_result["error"] = "no coordinator addr"
+                return
+            with open(addr_file) as f:
+                addr = f.read().strip()
+            c = ApplicationRpcClient(addr, max_retries=2,
+                                     base_backoff_s=0.05, tls_cert=None)
+            try:
+                c.get_application_status()
+                probe_result["plaintext_accepted"] = True
+            except Exception:
+                probe_result["plaintext_accepted"] = False
+            finally:
+                c.close()
+
+        t = threading.Thread(target=probe)
+        t.start()
+        rc = client.run()
+        t.join(timeout=30)
+        assert rc == 0
+        assert probe_result.get("plaintext_accepted") is False, probe_result
+        key_file = os.path.join(client.job_dir, ".tony-tls.key")
+        cert_file = os.path.join(client.job_dir, ".tony-tls.crt")
+        assert os.path.exists(key_file) and os.path.exists(cert_file)
+        assert oct(os.stat(key_file).st_mode & 0o777) == "0o600"
+
     def test_security_rejects_unauthenticated_probe(self, tmp_path):
         """An RPC probe without the token is refused while the job runs."""
         import grpc

@@ -317,3 +317,47 @@ def test_token_file_and_bind_default(dirs, tmp_path):
             K.HISTORY_FINISHED_KEY: dirs.finished,
             K.HISTORY_SERVER_TOKEN_FILE_KEY: str(empty),
         }), port=0)
+
+
+def test_https_serves_and_rejects_plaintext(dirs, tmp_path):
+    """tony.history.server.tls-cert/key → HTTPS (the reference's
+    tony.https.* keystore analog): https with the pinned cert works,
+    plain-http requests fail the handshake."""
+    import ssl
+    from tony_tpu.rpc.tls import generate_self_signed
+    key, cert = generate_self_signed(str(tmp_path))
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: dirs.location,
+        K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+        K.HISTORY_FINISHED_KEY: dirs.finished,
+        K.HISTORY_SERVER_TLS_CERT_KEY: cert,
+        K.HISTORY_SERVER_TLS_KEY_KEY: key,
+    })
+    s = HistoryServer(conf, port=0)
+    s.start()
+    try:
+        ctx = ssl.create_default_context(cafile=cert)
+        ctx.check_hostname = False     # per-job cert names tony-coordinator
+        with urllib.request.urlopen(
+                f"https://localhost:{s.port}/healthz", timeout=10,
+                context=ctx) as r:
+            assert r.status == 200
+        with pytest.raises(Exception):
+            urllib.request.urlopen(
+                f"http://localhost:{s.port}/healthz", timeout=5)
+    finally:
+        s.stop()
+
+
+def test_https_requires_both_cert_and_key(dirs, tmp_path):
+    from tony_tpu.rpc.tls import generate_self_signed
+    _, cert = generate_self_signed(str(tmp_path))
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: dirs.location,
+        K.HISTORY_INTERMEDIATE_KEY: dirs.intermediate,
+        K.HISTORY_FINISHED_KEY: dirs.finished,
+        K.HISTORY_SERVER_TLS_CERT_KEY: cert,
+    })
+    s = HistoryServer(conf, port=0)
+    with pytest.raises(ValueError, match="BOTH"):
+        s.start()

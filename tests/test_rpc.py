@@ -214,3 +214,82 @@ class TestRpcAuth:
             client.close()
         finally:
             server.stop()
+
+
+class TestRpcTls:
+    """Per-job TLS (rpc/tls.py): coordinator serves over TLS, clients pin
+    to the job cert; plaintext and wrong-cert clients are rejected."""
+
+    @pytest.fixture(scope="class")
+    def certs(self, tmp_path_factory):
+        from tony_tpu.rpc.tls import generate_self_signed
+        d = tmp_path_factory.mktemp("tls")
+        key, cert = generate_self_signed(str(d))
+        return key, cert
+
+    def test_key_file_is_private(self, certs):
+        import os
+        key, cert = certs
+        assert (os.stat(key).st_mode & 0o777) == 0o600
+
+    def test_tls_roundtrip_with_auth(self, certs):
+        key, cert = certs
+        impl = FakeImpl(expected=1)
+        server = ApplicationRpcServer(impl, secret="s3cret",
+                                      tls=(key, cert))
+        server.start()
+        try:
+            c = ApplicationRpcClient(f"localhost:{server.port}",
+                                     secret="s3cret", tls_cert=cert,
+                                     max_retries=3, base_backoff_s=0.05)
+            r = c.register_worker_spec("worker:0", "h0:1")
+            assert r.num_processes == 1
+            assert c.get_application_status().status == "RUNNING"
+            c.close()
+        finally:
+            server.stop()
+
+    def test_plaintext_client_rejected(self, certs):
+        key, cert = certs
+        server = ApplicationRpcServer(FakeImpl(), tls=(key, cert))
+        server.start()
+        try:
+            c = ApplicationRpcClient(f"localhost:{server.port}",
+                                     max_retries=2, base_backoff_s=0.05)
+            with pytest.raises(Exception):   # handshake failure → retries
+                c.get_application_status()   # exhausted → RpcRetryError
+            c.close()
+        finally:
+            server.stop()
+
+    def test_wrong_cert_rejected(self, certs, tmp_path):
+        from tony_tpu.rpc.tls import generate_self_signed
+        key, cert = certs
+        _, other_cert = generate_self_signed(str(tmp_path))
+        server = ApplicationRpcServer(FakeImpl(), tls=(key, cert))
+        server.start()
+        try:
+            c = ApplicationRpcClient(f"localhost:{server.port}",
+                                     tls_cert=other_cert,
+                                     max_retries=2, base_backoff_s=0.05)
+            with pytest.raises(Exception):
+                c.get_application_status()
+            c.close()
+        finally:
+            server.stop()
+
+    def test_env_cert_pickup(self, certs, monkeypatch):
+        """Executors get the cert path via TONY_TLS_CERT — the client must
+        use it without explicit plumbing (like TONY_SECRET)."""
+        from tony_tpu import constants
+        key, cert = certs
+        monkeypatch.setenv(constants.TONY_TLS_CERT, cert)
+        server = ApplicationRpcServer(FakeImpl(expected=1), tls=(key, cert))
+        server.start()
+        try:
+            c = ApplicationRpcClient(f"localhost:{server.port}",
+                                     max_retries=3, base_backoff_s=0.05)
+            assert c.get_application_status().status == "RUNNING"
+            c.close()
+        finally:
+            server.stop()

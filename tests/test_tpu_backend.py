@@ -177,3 +177,38 @@ def test_launch_command_runs_in_remote_job_dir(caplog):
     assert launches, [r.getMessage() for r in caplog.records]
     assert "cd ~/tony-job &&" in launches[-1]
     assert "export PYTHONPATH=~/tony-job/.tony-framework" in launches[-1]
+
+
+def test_stage_commands_ship_tls_cert_not_key(tmp_path):
+    """With TLS on, the PUBLIC cert is scp'd to hosts; the private key
+    must never appear in the staging plan (it stays with the
+    coordinator)."""
+    from tony_tpu.rpc.tls import generate_self_signed
+    job_dir = str(tmp_path)
+    generate_self_signed(job_dir)
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    cmds = b.stage_commands("worker", job_dir)
+    flat = " ".join(" ".join(c) for c in cmds)
+    assert ".tony-tls.crt" in flat
+    assert ".tony-tls.key" not in flat
+
+
+def test_launch_exports_tls_cert_path(caplog):
+    """The remote launch wrapper must export TONY_TLS_CERT from the
+    staged cert — and the coordinator-LOCAL path in spec.env must NOT
+    ride the command as a K=V prefix (it would override the export with
+    a path that does not exist on the slice host)."""
+    import logging
+    b = TpuSliceBackend(tpu_conf(), app_id="app1", dry_run=True)
+    spec = LaunchSpec(task_id="worker:0", command="python3 -m x",
+                      env={"JOB_NAME": "worker",
+                           "TONY_TLS_CERT": "/submit/host/.tony-tls.crt"},
+                      log_dir="/tmp", cwd="", tpu_topology="2x4")
+    with caplog.at_level(logging.INFO, logger="tony_tpu.backend.tpu"):
+        b.launch_task(spec)
+    launches = [r.getMessage() for r in caplog.records
+                if "--command=" in r.getMessage()]
+    assert launches
+    assert ("[ -f ~/tony-job/.tony-tls.crt ] && "
+            "export TONY_TLS_CERT=~/tony-job/.tony-tls.crt" in launches[-1])
+    assert "/submit/host/.tony-tls.crt" not in launches[-1]

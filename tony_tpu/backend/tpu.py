@@ -235,6 +235,14 @@ class TpuSliceBackend(SchedulerBackend):
             cmds.append(self.ssh_command(
                 job_type, "all",
                 f"chmod 600 {REMOTE_JOB_DIR}/.tony-secret", slice_idx))
+        # Per-job TLS cert (rpc/tls.py): executors need only the PUBLIC
+        # cert to pin their channels — the private key never leaves the
+        # coordinator host.
+        cert_path = os.path.join(job_dir, ".tony-tls.crt")
+        if os.path.exists(cert_path):
+            cmds.append(self.scp_command(
+                job_type, cert_path, f"{REMOTE_JOB_DIR}/.tony-tls.crt",
+                slice_idx))
         return cmds
 
     def describe_command(self, job_type: str,
@@ -320,12 +328,18 @@ class TpuSliceBackend(SchedulerBackend):
         with self._lock:
             # The auth secret must NOT ride the ssh argv (visible in ps /
             # /proc); the host reads it from the chmod-600 staged file.
+            # TONY_TLS_CERT is a coordinator-LOCAL path in spec.env — the
+            # remote export above points at the staged copy, and a K=V
+            # prefix here would override it with a path that does not
+            # exist on the slice host.
             env_prefix = " ".join(
                 f"{k}={shlex.quote(v)}" for k, v in spec.env.items()
-                if k != "TONY_SECRET")
+                if k not in ("TONY_SECRET", "TONY_TLS_CERT"))
             secret_src = (
                 f"[ -f {REMOTE_JOB_DIR}/.tony-secret ] && "
-                f"export TONY_SECRET=$(cat {REMOTE_JOB_DIR}/.tony-secret); ")
+                f"export TONY_SECRET=$(cat {REMOTE_JOB_DIR}/.tony-secret); "
+                f"[ -f {REMOTE_JOB_DIR}/.tony-tls.crt ] && "
+                f"export TONY_TLS_CERT={REMOTE_JOB_DIR}/.tony-tls.crt; ")
             # Strict cd: staging guarantees the job dir; a missing one is a
             # loud failure, not a task running in $HOME. The staged
             # framework copy leads PYTHONPATH so `python3 -m
@@ -352,11 +366,12 @@ class TpuSliceBackend(SchedulerBackend):
         failed generation's event is set as it is retracted, and a retry
         may have re-claimed the gang with a fresh entry (and fresh event)
         that must be waited on instead."""
-        # Worst case: delete (reprovision path) + create + 4 staging
-        # commands (scp tarball, unpack, scp secret, chmod) = 6 commands,
-        # each bounded by timeout_s; +1 for scheduling slack so a co-gang
-        # waiter never times out while the provisioner is still succeeding.
-        deadline = time.monotonic() + 7 * timeout_s
+        # Worst case: delete (reprovision path) + create + 5 staging
+        # commands (scp tarball, unpack, scp secret, chmod, scp TLS cert)
+        # = 7 commands, each bounded by timeout_s; +1 for scheduling slack
+        # so a co-gang waiter never times out while the provisioner is
+        # still succeeding.
+        deadline = time.monotonic() + 8 * timeout_s
         while True:
             with self._lock:
                 current = self._gangs.get(gang)

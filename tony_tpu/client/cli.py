@@ -140,7 +140,12 @@ def _coordinator_rpc(job_dir: str):
     if os.path.exists(secret_path):
         with open(secret_path, encoding="utf-8") as f:
             secret = f.read().strip()
-    return ApplicationRpcClient(addr, secret=secret, max_retries=3)
+    # TLS jobs: pin to the job cert staged next to the secret — a
+    # plaintext channel would fail the coordinator's TLS handshake.
+    cert_path = os.path.join(job_dir, constants.TONY_TLS_CERT_FILE)
+    tls_cert = cert_path if os.path.exists(cert_path) else None
+    return ApplicationRpcClient(addr, secret=secret, max_retries=3,
+                                tls_cert=tls_cert)
 
 
 def job_status(job_dir: str) -> int:

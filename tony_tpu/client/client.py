@@ -94,6 +94,11 @@ class TonyClient:
         self.secret: str | None = None
         if conf.get_bool(K.APPLICATION_SECURITY_KEY, False):
             self.secret = secrets.token_hex(16)
+        # Per-job TLS (rpc/tls.py): cert generated in stage(), paths set
+        # once the files exist.
+        self.tls_enabled = conf.get_bool(K.TLS_ENABLED_KEY, False)
+        self.tls_key_path: str | None = None
+        self.tls_cert_path: str | None = None
 
     # ------------------------------------------------------------------
     def stage(self) -> None:
@@ -154,6 +159,13 @@ class TonyClient:
                          0o600)
             with os.fdopen(fd, "w") as f:
                 f.write(self.secret)
+        if self.tls_enabled:
+            # Generated AFTER any remote push, like the secret: the key
+            # must never land in a (possibly team-readable) bucket — it
+            # travels only over scp (backend staging) with mode 0600.
+            from tony_tpu.rpc import tls as _tls
+            self.tls_key_path, self.tls_cert_path = _tls.generate_self_signed(
+                self.job_dir)
 
     def launch_coordinator(self, attempt: int) -> None:
         """Start the coordinator process (the AM launch, reference
@@ -168,6 +180,9 @@ class TonyClient:
         env[constants.ATTEMPT_NUMBER] = str(attempt)
         if self.secret:
             env[constants.TONY_SECRET] = self.secret
+        if self.tls_cert_path:
+            env[constants.TONY_TLS_CERT] = self.tls_cert_path
+            env[constants.TONY_TLS_KEY] = self.tls_key_path
         logs = os.path.join(self.job_dir, constants.TONY_LOG_DIR)
         out = open(os.path.join(logs, "am.stdout"), "ab")
         err = open(os.path.join(logs, "am.stderr"), "ab")
@@ -227,6 +242,12 @@ class TonyClient:
                                     exc_info=True)
                     break
 
+    def _connect(self, addr: str) -> ApplicationRpcClient:
+        """Coordinator channel with this job's auth secret and TLS cert
+        (one definition for the three connect sites)."""
+        return ApplicationRpcClient(addr, secret=self.secret,
+                                    tls_cert=self.tls_cert_path)
+
     # ------------------------------------------------------------------
     def monitor(self) -> int:
         """Poll until the job finishes (reference: monitorApplication:572).
@@ -252,7 +273,7 @@ class TonyClient:
             if self.rpc is None:
                 addr = self._read_coordinator_addr()
                 if addr:
-                    self.rpc = ApplicationRpcClient(addr, secret=self.secret)
+                    self.rpc = self._connect(addr)
             self._print_task_urls()
 
     def _handle_am_crash(self) -> int:
@@ -284,7 +305,7 @@ class TonyClient:
         if self.rpc is None:
             addr = self._wait_for_coordinator_addr(timeout_s=1)
             if addr:
-                self.rpc = ApplicationRpcClient(addr, secret=self.secret)
+                self.rpc = self._connect(addr)
         if self.rpc:
             try:
                 # Best-effort: the coordinator may already be gone (e.g.
@@ -316,7 +337,7 @@ class TonyClient:
         self.launch_coordinator(0)
         addr = self._wait_for_coordinator_addr()
         if addr:
-            self.rpc = ApplicationRpcClient(addr, secret=self.secret)
+            self.rpc = self._connect(addr)
             log.info("coordinator up at %s; job dir %s", addr, self.job_dir)
         try:
             return self.monitor()

@@ -155,8 +155,15 @@ class Coordinator:
         # secret at submission and passes it via env; when set, every RPC
         # (client and executors) must present it.
         self.secret = os.environ.get(constants.TONY_SECRET) or None
+        # Per-job TLS (rpc/tls.py): the client generated key+cert at
+        # submission and passes the staged paths via env; the server side
+        # needs both, executors get the cert only.
+        self.tls_cert = os.environ.get(constants.TONY_TLS_CERT) or None
+        self.tls_key = os.environ.get(constants.TONY_TLS_KEY) or None
+        tls = (self.tls_key, self.tls_cert) \
+            if self.tls_cert and self.tls_key else None
         self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self),
-                                               secret=self.secret)
+                                               secret=self.secret, tls=tls)
         history_dir = ev.HistoryDirs.from_conf(conf).intermediate
         self.events = ev.EventHandler(history_dir, app_id,
                                       os.environ.get("USER", "unknown"))
@@ -295,6 +302,8 @@ class Coordinator:
                 }
                 if self.secret:
                     env[constants.TONY_SECRET] = self.secret
+                if self.tls_cert:
+                    env[constants.TONY_TLS_CERT] = self.tls_cert
                 env.update(request.env)
                 self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
                                  session_id=self.session.session_id)

@@ -30,6 +30,10 @@ APPLICATION_TIMEOUT_KEY = "tony.application.timeout"              # ms; 0 = none
 APPLICATION_NODE_LABEL_KEY = "tony.application.node-label"
 APPLICATION_PREPROCESS_KEY = "tony.application.enable-preprocess"
 APPLICATION_SECURITY_KEY = "tony.application.security.enabled"
+# Control-plane TLS: per-job self-signed cert at submit, gRPC over TLS,
+# clients pinned to the job cert (rpc/tls.py; the reference's
+# HTTPS-keystore/kerberos analog — TonyConfigurationKeys.java:55-68).
+TLS_ENABLED_KEY = "tony.tls.enabled"
 APPLICATION_MESH_KEY = "tony.application.mesh"                    # e.g. "dp=2,tp=4" (TPU-native)
 # DCN (cross-slice) mesh axes for multi-slice jobs, e.g. "dp=2": these axes
 # are laid out ACROSS slices (slow network), tony.application.mesh axes
@@ -79,6 +83,10 @@ HISTORY_SERVER_BIND_KEY = "tony.history.server.bind"
 # or via a chmod-600 file; the file wins).
 HISTORY_SERVER_TOKEN_KEY = "tony.history.server.token"
 HISTORY_SERVER_TOKEN_FILE_KEY = "tony.history.server.token-file"
+# HTTPS for the history server (reference: tony.https.* keystore keys,
+# TonyConfigurationKeys.java:55-68): PEM cert + key paths; both set = TLS.
+HISTORY_SERVER_TLS_CERT_KEY = "tony.history.server.tls-cert"
+HISTORY_SERVER_TLS_KEY_KEY = "tony.history.server.tls-key"
 
 # ---------------------------------------------------------------------------
 # Backend / scheduler ("tony.scheduler.*" — new layer; the reference hardwires
@@ -130,6 +138,7 @@ DEFAULTS: dict[str, str] = {
     APPLICATION_NODE_LABEL_KEY: "",
     APPLICATION_PREPROCESS_KEY: "false",
     APPLICATION_SECURITY_KEY: "false",
+    TLS_ENABLED_KEY: "false",
     APPLICATION_MESH_KEY: "",
     APPLICATION_MESH_DCN_KEY: "",
     APPLICATION_UNTRACKED_KEY: "ps",
@@ -154,6 +163,8 @@ DEFAULTS: dict[str, str] = {
     HISTORY_SERVER_BIND_KEY: "127.0.0.1",
     HISTORY_SERVER_TOKEN_KEY: "",
     HISTORY_SERVER_TOKEN_FILE_KEY: "",
+    HISTORY_SERVER_TLS_CERT_KEY: "",
+    HISTORY_SERVER_TLS_KEY_KEY: "",
     SCHEDULER_BACKEND_KEY: "local",
     TPU_PROJECT_KEY: "",
     TPU_ZONE_KEY: "",

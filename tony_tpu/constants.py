@@ -41,6 +41,15 @@ TONY_SECRET = "TONY_SECRET"
 AUTH_METADATA_KEY = "tony-auth"
 TONY_SECRET_FILE = ".tony-secret"
 
+# Control-plane TLS (the HTTPS-keystore/kerberos analog, reference:
+# TonyConfigurationKeys.java:55-68): per-job self-signed cert generated at
+# submission (rpc/tls.py), staged like the secret; env vars carry the staged
+# file PATHS to the coordinator (key + cert) and executors (cert only).
+TONY_TLS_CERT = "TONY_TLS_CERT"
+TONY_TLS_KEY = "TONY_TLS_KEY"
+TONY_TLS_CERT_FILE = ".tony-tls.crt"
+TONY_TLS_KEY_FILE = ".tony-tls.key"
+
 # Profiling (tony.task.profile.* → executor env → runtime.maybe_start):
 # first-class per-host jax.profiler capture (SURVEY.md §5 calls this out as
 # the TPU-native addition over the reference's TensorBoard-URL-only

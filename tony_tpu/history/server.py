@@ -471,14 +471,36 @@ class HistoryServer:
                 "history server binding %s WITHOUT auth — job configs may "
                 "embed env/paths; set %s (or .token-file) to require a "
                 "bearer token", self.bind, K.HISTORY_SERVER_TOKEN_KEY)
+        # HTTPS (the reference's tony.https.* keystore analog,
+        # TonyConfigurationKeys.java:55-68): PEM cert + key paths → wrap
+        # the listening socket; plaintext requests fail the handshake.
+        # Validation and context construction happen BEFORE the server
+        # binds — a config error must not leak a bound socket whose
+        # stop() would then hang in shutdown().
+        scheme = "http"
+        ctx = None
+        cert = self.conf.get(K.HISTORY_SERVER_TLS_CERT_KEY) or ""
+        key = self.conf.get(K.HISTORY_SERVER_TLS_KEY_KEY) or ""
+        if bool(cert) != bool(key):
+            raise ValueError(
+                "history server TLS needs BOTH tls-cert and tls-key "
+                f"(got cert={cert!r}, key={key!r})")
+        if cert:
+            import ssl
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(certfile=cert, keyfile=key)
+            scheme = "https"
         self._httpd = ThreadingHTTPServer((self.bind, self.port),
                                           self._make_handler())
+        if ctx is not None:
+            self._httpd.socket = ctx.wrap_socket(self._httpd.socket,
+                                                 server_side=True)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         name="history-server", daemon=True)
         self._thread.start()
-        log.info("history server on http://%s:%d (auth=%s intermediate=%s "
-                 "finished=%s)", self.bind, self.port,
+        log.info("history server on %s://%s:%d (auth=%s intermediate=%s "
+                 "finished=%s)", scheme, self.bind, self.port,
                  "bearer" if self.token else "off", self.dirs.intermediate,
                  self.dirs.finished)
         return self.port

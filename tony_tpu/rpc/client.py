@@ -37,7 +37,8 @@ class ApplicationRpcClient(ApplicationRpc):
 
     def __init__(self, address: str, max_retries: int = 30,
                  base_backoff_s: float = 0.1, max_backoff_s: float = 5.0,
-                 secret: str | None = None) -> None:
+                 secret: str | None = None,
+                 tls_cert: str | None = None) -> None:
         self.address = address
         # Per-job auth token (ClientToAMToken analog). Defaults from the
         # TONY_SECRET env var so executors — which receive the secret in
@@ -49,7 +50,18 @@ class ApplicationRpcClient(ApplicationRpc):
         self.max_retries = max_retries
         self.base_backoff_s = base_backoff_s
         self.max_backoff_s = max_backoff_s
-        self._channel = grpc.insecure_channel(address)
+        # Per-job TLS (rpc/tls.py): pin the channel to the staged job cert.
+        # Defaults from TONY_TLS_CERT (a path) so executors pick it up from
+        # their launch environment exactly like the secret.
+        from tony_tpu.rpc import tls as _tls
+        if tls_cert is None:
+            tls_cert = _tls.env_cert_path()
+        if tls_cert:
+            creds, options = _tls.channel_credentials(tls_cert)
+            self._channel = grpc.secure_channel(address, creds,
+                                                options=options)
+        else:
+            self._channel = grpc.insecure_channel(address)
         m = f"/{SERVICE_NAME}/"
         self._get_task_urls = self._channel.unary_unary(
             m + "GetTaskUrls",

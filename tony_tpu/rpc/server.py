@@ -49,28 +49,38 @@ class ApplicationRpcServer:
     """Wraps a grpc.Server around an ApplicationRpc implementation."""
 
     def __init__(self, impl: ApplicationRpc, port: int | None = None,
-                 max_workers: int = 32, secret: str | None = None) -> None:
+                 max_workers: int = 32, secret: str | None = None,
+                 tls: tuple[str, str] | None = None) -> None:
         self.impl = impl
         #: per-job shared secret; when set, every call must carry it as
         #: gRPC metadata (the ClientToAMToken + service-ACL analog,
         #: reference: TFPolicyProvider.java:14-26, ApplicationRpcServer
         #: secret-manager wiring :56-70).
         self.secret = secret
+        #: (key_path, cert_path) — serve over TLS with the per-job cert
+        #: (rpc/tls.py; the HTTPS-keystore analog). Plaintext clients are
+        #: rejected at the handshake.
+        self.tls = tls
         explicit_port = port is not None
         self.port = port if explicit_port else find_free_port()
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=max_workers),
             options=[("grpc.so_reuseport", 0)])
         self._server.add_generic_rpc_handlers((self._make_handler(),))
-        bound = self._server.add_insecure_port(f"[::]:{self.port}")
-        if bound == 0:
+        if tls is not None:
+            from tony_tpu.rpc import tls as _tls
+            creds = _tls.server_credentials(*tls)
+            bind = lambda p: self._server.add_secure_port(f"[::]:{p}", creds)
+        else:
+            bind = lambda p: self._server.add_insecure_port(f"[::]:{p}")
+        if bind(self.port) == 0:
             if explicit_port:
                 # The caller advertised this port; silently moving would
                 # strand every client. Fail loudly instead.
                 raise OSError(f"could not bind RPC server on requested port {self.port}")
             # Race on our self-chosen port — re-pick and retry once.
             self.port = find_free_port((20000, 30000))
-            if self._server.add_insecure_port(f"[::]:{self.port}") == 0:
+            if bind(self.port) == 0:
                 raise OSError("could not bind RPC server port")
 
     # -- handler table ------------------------------------------------------

@@ -1,0 +1,110 @@
+"""Per-job TLS for the control plane (and the history server's HTTPS).
+
+The reference ships transport security as HTTPS keystore config for its
+history server (reference: tony-core/src/main/java/com/linkedin/tony/
+TonyConfigurationKeys.java:55-68) and Hadoop-managed kerberos/token auth on
+the IPC plane (TonyClient.java:509 delegation tokens). The TPU-native
+equivalent has no Hadoop security substrate, so the framework carries its
+own: a per-job self-signed certificate generated at submission, staged next
+to ``.tony-secret`` (same chmod-600 discipline, backend/tpu.py), with
+
+  * the coordinator's gRPC server on TLS (``ssl_server_credentials``),
+  * every client channel pinned to exactly that certificate
+    (``root_certificates=`` the job cert — a private per-job CA of one),
+  * hostname checks satisfied by a fixed target-name override: the
+    coordinator's real hostname is unknowable at submission (any VM/slice
+    host), so the cert names ``tony-coordinator`` and clients set
+    ``grpc.ssl_target_name_override`` — pinning to the per-job cert is what
+    authenticates, not a public-CA hostname chain.
+
+Key material never crosses the network in the clear: the key/cert files
+travel over scp like the secret, and the shared-secret auth metadata now
+rides inside the encrypted channel.
+"""
+
+from __future__ import annotations
+
+import datetime
+import os
+
+from tony_tpu import constants
+
+#: CN/SAN on every per-job cert; clients override the gRPC target name to
+#: this, because the coordinator's hostname is unknown at cert time.
+TLS_TARGET_NAME = "tony-coordinator"
+
+
+def generate_self_signed(out_dir: str, days: int = 397) -> tuple[str, str]:
+    """Generate a per-job EC key + self-signed cert into ``out_dir``.
+
+    Returns (key_path, cert_path). The key file is 0600 (same discipline
+    as ``.tony-secret``); the cert is public. Requires the ``cryptography``
+    package (present in the baked image); raises a clear error otherwise.
+
+    The default validity (397 days, the public-CA maximum) deliberately
+    outlives any plausible job: the cert is per-job and pinned, so a
+    short lifetime buys nothing — but an expiry DURING a long run would
+    brick relaunch channels (AM-crash recovery, late ``tony kill``)."""
+    try:
+        from cryptography import x509
+        from cryptography.hazmat.primitives import hashes, serialization
+        from cryptography.hazmat.primitives.asymmetric import ec
+        from cryptography.x509.oid import NameOID
+    except ImportError as e:     # pragma: no cover - baked image has it
+        raise RuntimeError(
+            "tony.tls.enabled requires the 'cryptography' package to "
+            "generate the per-job certificate") from e
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name(
+        [x509.NameAttribute(NameOID.COMMON_NAME, TLS_TARGET_NAME)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name)
+            .issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.SubjectAlternativeName(
+                [x509.DNSName(TLS_TARGET_NAME)]), critical=False)
+            .sign(key, hashes.SHA256()))
+
+    key_path = os.path.join(out_dir, constants.TONY_TLS_KEY_FILE)
+    cert_path = os.path.join(out_dir, constants.TONY_TLS_CERT_FILE)
+    fd = os.open(key_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+    with os.fdopen(fd, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption()))
+    with open(cert_path, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    return key_path, cert_path
+
+
+def server_credentials(key_path: str, cert_path: str):
+    """gRPC server credentials from the per-job key/cert files."""
+    import grpc
+    with open(key_path, "rb") as f:
+        key = f.read()
+    with open(cert_path, "rb") as f:
+        cert = f.read()
+    return grpc.ssl_server_credentials([(key, cert)])
+
+
+def channel_credentials(cert_path: str):
+    """(credentials, channel options) pinning a client channel to the
+    per-job cert. The options set the target-name override that makes the
+    fixed-CN cert verify against any coordinator address."""
+    import grpc
+    with open(cert_path, "rb") as f:
+        cert = f.read()
+    return (grpc.ssl_channel_credentials(root_certificates=cert),
+            (("grpc.ssl_target_name_override", TLS_TARGET_NAME),))
+
+
+def env_cert_path() -> str | None:
+    """The staged cert path from the launch environment (executors and
+    in-job clients), or None when TLS is off for this job."""
+    return os.environ.get(constants.TONY_TLS_CERT) or None
