@@ -1,0 +1,24 @@
+"""NON-CHIEF tasks (index > 0) exit 1 on their first run, 0 afterwards —
+drives the in-session per-task restart e2e (worker:0 is the implicit
+chief, whose exit is the job's verdict and is never restarted). The
+marker lives in the cwd (the job dir), which restarted executors
+share."""
+import os
+import sys
+
+idx = os.environ.get("TASK_INDEX", "0")
+if idx == "0" and os.environ.get("FAIL_ONCE_INCLUDE_CHIEF") != "1":
+    # outlive the non-chief blip: chief completion is the job's verdict
+    # (session chief short-circuit), so exiting before the restarted
+    # workers finish would race the restart
+    import time
+    time.sleep(4)
+    print("chief: succeeding")
+    sys.exit(0)
+marker = f".fail-once-{os.environ.get('JOB_NAME', 'x')}-{idx}"
+if os.path.exists(marker):
+    print("second run: succeeding")
+    sys.exit(0)
+open(marker, "w").close()
+print("first run: failing once")
+sys.exit(1)

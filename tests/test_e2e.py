@@ -235,6 +235,59 @@ class TestE2E:
         assert "'pp': 2" in out       # train_lm prints the resolved mesh
         assert "done:" in out
 
+    def test_per_task_restart_within_session(self, tmp_path):
+        """tony.task.restart-count: one worker fails once, is relaunched
+        IN-SESSION (no whole-job reset — the reference kills the job and
+        marks per-task restart TODO, TonyApplicationMaster.java:1158-1159),
+        and the job succeeds. The jhist shows TASK_RESTARTED and no
+        SESSION_RESET; uptime metrics carry the restart count."""
+        client = make_client(
+            tmp_path, fixture_cmd("fail_once.py"),
+            {"tony.worker.instances": "2",
+             "tony.task.restart-count": "1",
+             "tony.am.retry-count": "0"})       # no session retries: the
+        assert client.run() == 0                # restart must carry it
+        hist_dir = client.conf.get("tony.history.location")
+        files = find_job_files(hist_dir)
+        events = list(parse_events(files[0]))
+        types = [e.event_type for e in events]
+        assert "TASK_RESTARTED" in types
+        assert "SESSION_RESET" not in types
+        restarted = [e for e in events if e.event_type == "TASK_RESTARTED"]
+        assert restarted[0].payload["restarts"] == 1
+        finished = [e for e in events
+                    if e.event_type == "APPLICATION_FINISHED"][-1]
+        assert finished.payload["metrics"]["task_restarts"] == {
+            restarted[0].payload["task"]: 1}
+
+    def test_per_task_restart_budget_exhausted_fails(self, tmp_path):
+        """Failures beyond the restart budget still fail the session: the
+        non-chief worker ALWAYS fails, so restart 1 is consumed and the
+        second failure lands as a session failure (the chief sleeps so its
+        verdict cannot pre-empt the sequence)."""
+        client = make_client(
+            tmp_path,
+            f'bash -c "if [ $TASK_INDEX != 0 ]; then exit 1; '
+            f'else {fixture_cmd("sleep_briefly.py", "10")}; fi"',
+            {"tony.worker.instances": "2",
+             "tony.task.restart-count": "1",
+             "tony.am.retry-count": "0"})
+        assert client.run() == 1
+        hist_dir = client.conf.get("tony.history.location")
+        files = find_job_files(hist_dir)
+        types = [e.event_type for e in parse_events(files[0])]
+        assert types.count("TASK_RESTARTED") == 1    # budget spent once
+
+    def test_chief_failure_not_restarted(self, tmp_path):
+        """The chief's exit is the job's verdict — never restarted."""
+        client = make_client(
+            tmp_path, fixture_cmd("fail_once.py"),
+            {"tony.worker.instances": "1",      # worker:0 is implicit chief
+             "tony.task.restart-count": "3",
+             "tony.am.retry-count": "0"},
+            shell_env={"FAIL_ONCE_INCLUDE_CHIEF": "1"})
+        assert client.run() == 1
+
     def test_slice_preemption_retried_from_own_budget(self, tmp_path):
         """TEST_PREEMPT_SLICE kills the worker gang once and reports it
         preempted; with tony.am.retry-count=0 the job must STILL succeed —

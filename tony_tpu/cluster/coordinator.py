@@ -144,6 +144,17 @@ class Coordinator:
         # meaning (SURVEY.md §7 hard part (d)).
         self.preemption_retries_left = conf.get_int(
             K.TPU_PREEMPTION_RETRIES_KEY, 3)
+        # In-session single-task relaunch budget (tony.task.restart-count):
+        # the capability the reference marks TODO and answers with a
+        # whole-job kill (TonyApplicationMaster.java:1158-1159).
+        self.task_restarts_left = conf.get_int(K.TASK_RESTART_COUNT_KEY, 0)
+        #: task_id → (exit code, via_rpc) of a restart-consumed failure:
+        #: completions arrive from TWO channels (executor RPC + backend
+        #: process exit), and the restart path bypasses the completed-flag
+        #: dedupe, so the twin report — same code, the OTHER channel —
+        #: must be swallowed once (see record_completion).
+        self._restart_dup: dict[str, tuple[int, bool]] = {}
+        self._user_command: str = ""
         self._session_preempted = False
         self._session_real_failure = False
         self.timeout_s = conf.get_int(K.APPLICATION_TIMEOUT_KEY, 0) / 1000.0
@@ -181,6 +192,12 @@ class Coordinator:
             log.warning("registration from unknown task %r ignored", worker)
             return WorkerSpecResponse()
         first_registration = not task.registered
+        # The relaunched generation is registering: its predecessor's twin
+        # report either arrived already or was discarded by the backend on
+        # relaunch — retire the marker so it can never swallow THIS
+        # generation's own failure report.
+        with self._completion_lock:
+            self._restart_dup.pop(worker, None)
         payload = self.session.register_task_spec(worker, spec)
         if not first_registration:
             # Barrier re-polls count as liveness: an executor waiting at the
@@ -285,6 +302,7 @@ class Coordinator:
     def schedule_tasks(self, user_command: str) -> None:
         """Bind every task to an allocation and launch it (reference:
         scheduleTasks:549 + ContainerLauncher.run:1080)."""
+        self._user_command = user_command   # per-task restarts rebuild specs
         requests = self.session.requests
         for job_type, request in requests.items():
             self._localize_resources(request)
@@ -292,43 +310,51 @@ class Coordinator:
                 task = self.session.next_allocation(job_type)
                 if task is None:
                     break
-                env = {
-                    constants.JOB_NAME: task.job_type,
-                    constants.TASK_INDEX: str(task.index),
-                    constants.TASK_NUM: str(request.instances),
-                    constants.SESSION_ID: str(self.session.session_id),
-                    constants.ATTEMPT_NUMBER: os.environ.get(
-                        constants.ATTEMPT_NUMBER, "0"),
-                }
-                if self.secret:
-                    env[constants.TONY_SECRET] = self.secret
-                if self.tls_cert:
-                    env[constants.TONY_TLS_CERT] = self.tls_cert
-                env.update(request.env)
-                self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
-                                 session_id=self.session.session_id)
-                # Docker passthrough (reference: TonyClient.java:340-349):
-                # wrap the executor in `docker run`, forwarding the task's
-                # assigned env into the container.
-                # Session id in the container name: a relaunched task of a
-                # retried session must not collide with a straggler (or
-                # still-being---rm'd) container from the old generation.
-                command = docker_wrap(
-                    self._executor_command(user_command), self.conf,
-                    self.job_dir, env_keys=tuple(env),
-                    task_id=f"{task.task_id}-s{self.session.session_id}",
-                    app_id=self.app_id)
-                self.backend.launch_task(LaunchSpec(
-                    task_id=task.task_id,
-                    command=command,
-                    env=env,
-                    log_dir=self.log_dir,
-                    cwd=self.job_dir,
-                    memory_mb=request.memory_mb,
-                    vcores=request.vcores,
-                    gpus=request.gpus,
-                    tpus=request.tpus,
-                    tpu_topology=request.tpu_topology))
+                self._launch_task(task, request, user_command)
+
+    def _launch_task(self, task, request, user_command: str) -> None:
+        """Launch one bound task (shared by initial scheduling and
+        in-session per-task restart)."""
+        env = {
+            constants.JOB_NAME: task.job_type,
+            constants.TASK_INDEX: str(task.index),
+            constants.TASK_NUM: str(request.instances),
+            constants.SESSION_ID: str(self.session.session_id),
+            constants.ATTEMPT_NUMBER: os.environ.get(
+                constants.ATTEMPT_NUMBER, "0"),
+        }
+        if self.secret:
+            env[constants.TONY_SECRET] = self.secret
+        if self.tls_cert:
+            env[constants.TONY_TLS_CERT] = self.tls_cert
+        env.update(request.env)
+        self.events.emit(ev.TASK_SCHEDULED, task=task.task_id,
+                         session_id=self.session.session_id)
+        # Docker passthrough (reference: TonyClient.java:340-349):
+        # wrap the executor in `docker run`, forwarding the task's
+        # assigned env into the container.
+        # Session id AND restart count in the container name: a relaunched
+        # task (of a retried session or an in-session restart) must not
+        # collide with a straggler (or still-being---rm'd) container from
+        # the old generation.
+        suffix = (f"-s{self.session.session_id}"
+                  + (f"-r{task.restarts}" if task.restarts else ""))
+        command = docker_wrap(
+            self._executor_command(user_command), self.conf,
+            self.job_dir, env_keys=tuple(env),
+            task_id=f"{task.task_id}{suffix}",
+            app_id=self.app_id)
+        self.backend.launch_task(LaunchSpec(
+            task_id=task.task_id,
+            command=command,
+            env=env,
+            log_dir=self.log_dir,
+            cwd=self.job_dir,
+            memory_mb=request.memory_mb,
+            vcores=request.vcores,
+            gpus=request.gpus,
+            tpus=request.tpus,
+            tpu_topology=request.tpu_topology))
 
     # ------------------------------------------------------------------
     # Monitor loop
@@ -341,7 +367,18 @@ class Coordinator:
         executor's RPC result and the backend's process-exit observation —
         so state transition and the TASK_FINISHED event happen exactly once
         whichever arrives first. The check-then-act is serialized by
-        ``_completion_lock`` (RPC threads race the monitor thread here)."""
+        ``_completion_lock`` (RPC threads race the monitor thread here).
+
+        Exit codes are canonicalized to what the OS reports for the
+        executor process (signal-killed → 128+sig as the executor's own
+        ``code & 0xFF`` mapping, executor.py exit path): the RPC channel
+        carries the raw (possibly negative) user returncode while the
+        backend observes the executor's mapped exit, and the restart
+        twin-dedupe below compares codes across the two channels."""
+        if exit_code < 0:
+            exit_code = exit_code & 0xFF
+        elif exit_code > 255:
+            exit_code = 255
         with self._completion_lock:
             try:
                 task = self.session.get_task(job_type, index)
@@ -349,20 +386,92 @@ class Coordinator:
                 return
             if session_id is not None and session_id != self.session.session_id:
                 return
-            already_done = task.completed
-            self.session.on_task_completed(job_type, index, exit_code,
-                                           session_id=session_id,
-                                           via_rpc=via_rpc)
-            if not already_done and task.completed:
-                if task.exit_code != 0 and self.session.is_tracked(job_type):
-                    if preempted:
-                        self._session_preempted = True
-                    else:
-                        self._session_real_failure = True
+            # Twin report of a restart-consumed failure: the SAME process
+            # exit reaches us twice (executor RPC + backend process exit),
+            # so after a restart the matching-code report from the OTHER
+            # channel is swallowed exactly once. The marker retires when
+            # the relaunched generation REGISTERS (on_register_worker_spec)
+            # — the backend discards the old generation's exit event on
+            # relaunch, so a marker that outlived registration could
+            # otherwise swallow the new generation's own failure. Residual
+            # corner: a relaunch that dies pre-registration with the same
+            # code on the opposite channel consumes the marker — its other
+            # report still surfaces the failure.
+            dup = self._restart_dup.get(task.task_id)
+            if (dup is not None and dup[0] == exit_code
+                    and dup[1] != via_rpc and not task.completed):
+                del self._restart_dup[task.task_id]
+                return
+            relaunch = None
+            if self._restartable(task, exit_code, preempted):
+                self.task_restarts_left -= 1
                 self.hb_monitor.unregister(task.task_id)
-                self.events.emit(ev.TASK_FINISHED, task=task.task_id,
-                                 exit_code=task.exit_code, preempted=preempted,
+                self._restart_dup[task.task_id] = (exit_code, via_rpc)
+                t = self.session.reset_task_for_restart(job_type, index)
+                log.warning(
+                    "task %s failed with exit code %d — in-session restart "
+                    "%d (%d restarts left)", task.task_id, exit_code,
+                    t.restarts, self.task_restarts_left)
+                self.events.emit(ev.TASK_RESTARTED, task=task.task_id,
+                                 exit_code=exit_code, restarts=t.restarts,
                                  session_id=self.session.session_id)
+                relaunch = t
+            else:
+                already_done = task.completed
+                self.session.on_task_completed(job_type, index, exit_code,
+                                               session_id=session_id,
+                                               via_rpc=via_rpc)
+                if not already_done and task.completed:
+                    if task.exit_code != 0 \
+                            and self.session.is_tracked(job_type):
+                        if preempted:
+                            self._session_preempted = True
+                        else:
+                            self._session_real_failure = True
+                    self.hb_monitor.unregister(task.task_id)
+                    self.events.emit(ev.TASK_FINISHED, task=task.task_id,
+                                     exit_code=task.exit_code,
+                                     preempted=preempted,
+                                     session_id=self.session.session_id)
+        # Launch OUTSIDE the completion lock: backend.launch_task can block
+        # for seconds (old-process kill-and-wait, docker wrap, ssh), and
+        # holding the lock would stall every other completion report.
+        if relaunch is not None:
+            with self._completion_lock:
+                # Re-check liveness at launch time: the session verdict (or
+                # a reset to a NEW session) may have landed between the
+                # restart decision and here — launching then would inject a
+                # zombie into the kill sweep / the next session's gang.
+                live = (relaunch.session_id == self.session.session_id
+                        and self.session.status is SessionStatus.RUNNING
+                        and self.final_status is None
+                        and not self.client_signalled_finish.is_set())
+            if live:
+                self._launch_task(relaunch, self.session.requests[job_type],
+                                  self._user_command)
+            else:
+                log.info("skipping restart launch of %s — session verdict "
+                         "landed first", relaunch.task_id)
+
+    def _restartable(self, task, exit_code: int, preempted: bool) -> bool:
+        """Eligibility for an in-session single-task relaunch: a failed,
+        tracked, NON-CHIEF task (chief completion is the job's verdict —
+        session.on_task_completed:266-271), with budget left, while the
+        job is still live. Slice preemption keeps its own gang-level
+        budget (the whole gang reprovisions, not one process)."""
+        return (exit_code != 0 and not preempted
+                and not task.completed
+                and self.task_restarts_left > 0
+                and self.session.is_tracked(task.job_type)
+                and not self.session.is_chief(task.job_type, task.index)
+                # the session verdict may land before stop() sets
+                # final_status (chief short-circuit, heartbeat expiry) —
+                # restarting after it is decided burns budget on a doomed
+                # process that stop() immediately kills
+                and self.session.status is SessionStatus.RUNNING
+                and not self.task_missed_hb.is_set()
+                and self.final_status is None
+                and not self.client_signalled_finish.is_set())
 
     def _apply_completions(self, completions: list[CompletionEvent]) -> None:
         for c in completions:
@@ -623,6 +732,10 @@ class Coordinator:
             self.task_missed_hb.clear()
             self._session_preempted = False
             self._session_real_failure = False
+            # stale twin-report markers must not swallow the new session's
+            # completions (session-id filtering already drops cross-session
+            # RPC reports, but process-exit reports carry no session id)
+            self._restart_dup.clear()
             self.events.emit(ev.SESSION_RESET,
                              old_session_id=self.session.session_id)
             # Keep the failed attempt's uptime: the north-star fraction must

@@ -69,6 +69,8 @@ class SessionTask:
     allocation_id: int = -1         # backend allocation handle
     registered_at: float = 0.0      # monotonic time of first registration
     completed_at: float = 0.0       # monotonic time of completion report
+    restarts: int = 0               # in-session single-task relaunches
+    prior_uptime_s: float = 0.0     # uptime accumulated before restarts
 
     @property
     def task_id(self) -> str:
@@ -274,6 +276,33 @@ class Session:
                     self.status = (SessionStatus.SUCCEEDED if exit_code == 0
                                    else SessionStatus.FAILED)
 
+    def reset_task_for_restart(self, job_type: str,
+                               index: int | str) -> SessionTask:
+        """Arm a single failed task for an IN-SESSION relaunch — the
+        capability the reference marks TODO and answers with a whole-job
+        kill (TonyApplicationMaster.java:1158-1159 'so we just kill the
+        job'). The task rebinds to a fresh allocation DIRECTLY (SCHEDULED
+        — routing through next_allocation could hand the slot to a
+        different NEW task), its spec clears so the gang barrier holds new
+        registrants until it re-registers, and its finished uptime
+        accumulates into prior_uptime_s so the blip stays visible in
+        uptime_metrics. The caller (coordinator) owns the budget and the
+        non-chief guard."""
+        with self._lock:
+            t = self.get_task(job_type, index)
+            if t.registered_at:
+                t.prior_uptime_s += ((t.completed_at or time.monotonic())
+                                     - t.registered_at)
+            t.restarts += 1
+            t.status = TaskStatus.SCHEDULED
+            t.allocation_id = self._next_allocation_id
+            self._next_allocation_id += 1
+            t.spec = ""
+            t.exit_code = None
+            t.registered_at = 0.0
+            t.completed_at = 0.0
+            return t
+
     def on_task_deemed_dead(self, task_id: str) -> None:
         """Missed-heartbeat expiry fails the task and thus the session
         (reference: onTaskDeemedDead:1155-1165 — 'we just kill the job')."""
@@ -296,9 +325,11 @@ class Session:
             now = time.monotonic()
             uptimes = {}
             for t in self.all_tasks():
-                uptimes[t.task_id] = ((t.completed_at or now)
-                                      - t.registered_at
-                                      if t.registered_at else 0.0)
+                # prior_uptime_s: runs before an in-session restart — the
+                # dead gap between them shows up as a fraction below 1.0
+                uptimes[t.task_id] = t.prior_uptime_s + (
+                    (t.completed_at or now) - t.registered_at
+                    if t.registered_at else 0.0)
             # Uptime fraction is measured over the TRAINING window — first
             # tracked registration to last tracked completion — so scheduler
             # startup latency does not dilute it (a task that died mid-run
@@ -324,6 +355,10 @@ class Session:
                 "task_uptime_s": {k: round(v, 3)
                                   for k, v in uptimes.items()},
             }
+            restarts = {t.task_id: t.restarts for t in self.all_tasks()
+                        if t.restarts}
+            if restarts:
+                metrics["task_restarts"] = restarts
             # Single-node/notebook jobs schedule no tracked tasks; a
             # fraction of 0.0 would render as a misleading "0.0%" uptime
             # for a succeeded job, so the metric is omitted entirely.

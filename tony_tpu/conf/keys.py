@@ -55,6 +55,12 @@ AM_GPUS_KEY = "tony.am.gpus"
 TASK_EXECUTOR_PYTHON_OPTS_KEY = "tony.task.executor.python-opts"  # jvm-opts analog
 TASK_HEARTBEAT_INTERVAL_KEY = "tony.task.heartbeat-interval-ms"
 TASK_MAX_MISSED_HEARTBEATS_KEY = "tony.task.max-missed-heartbeats"
+# In-session single-task relaunch budget for failed NON-CHIEF tracked
+# tasks (the reference kills the whole job and marks per-task restart
+# TODO — TonyApplicationMaster.java:1158-1159). Suited to loosely-coupled
+# jobs (independent workers, PS/worker TF): a jax.distributed collective
+# gang cannot absorb a single-process restart mid-run.
+TASK_RESTART_COUNT_KEY = "tony.task.restart-count"
 TASK_REGISTRATION_TIMEOUT_KEY = "tony.task.registration-timeout-ms"
 TASK_EXECUTION_TIMEOUT_KEY = "tony.task.execution-timeout-ms"
 TASK_PROFILE_ENABLED_KEY = "tony.task.profile.enabled"            # per-host jax.profiler
@@ -106,6 +112,12 @@ TPU_PREEMPTION_RETRIES_KEY = "tony.tpu.preemption-retries"
 # How often the backend refreshes slice state via the cloud API (gcloud
 # describe); completion polling reads the cached state.
 TPU_STATE_REFRESH_KEY = "tony.tpu.state-refresh-ms"
+# Transient-infrastructure retries inside ONE provisioning attempt (quota
+# backoff on create, dropped ssh during staging) — distinct from the
+# gang-level preemption budget, which reprovisions a LOST slice.
+TPU_CREATE_RETRIES_KEY = "tony.tpu.create-retries"
+TPU_STAGE_RETRIES_KEY = "tony.tpu.stage-retries"
+TPU_RETRY_BACKOFF_KEY = "tony.tpu.retry-backoff-ms"
 
 # ---------------------------------------------------------------------------
 # Staging / storage ("tony.staging.*"; HDFS-dir analog)
@@ -149,6 +161,7 @@ DEFAULTS: dict[str, str] = {
     TASK_EXECUTOR_PYTHON_OPTS_KEY: "",
     TASK_HEARTBEAT_INTERVAL_KEY: "1000",
     TASK_MAX_MISSED_HEARTBEATS_KEY: "25",
+    TASK_RESTART_COUNT_KEY: "0",
     TASK_REGISTRATION_TIMEOUT_KEY: "300000",
     TASK_EXECUTION_TIMEOUT_KEY: "0",
     TASK_PROFILE_ENABLED_KEY: "false",
@@ -174,6 +187,9 @@ DEFAULTS: dict[str, str] = {
     TPU_PROVISION_TIMEOUT_KEY: "600000",
     TPU_PREEMPTION_RETRIES_KEY: "3",
     TPU_STATE_REFRESH_KEY: "10000",
+    TPU_CREATE_RETRIES_KEY: "3",
+    TPU_STAGE_RETRIES_KEY: "2",
+    TPU_RETRY_BACKOFF_KEY: "5000",
     STAGING_DIR_KEY: "",
     REMOTE_JOB_DIR_KEY: "",
     SRC_DIR_KEY: "",
