@@ -15,6 +15,13 @@ from $FAKE_NUM_WORKERS at create time. Every invocation is appended to
 $FAKE_GCLOUD_ROOT/calls.log for assertions. One fake-ism: hosts share
 this machine's /tmp, so the staging path /tmp/tony-stage.tgz is rewritten
 to a per-worker location in both scp and ssh commands.
+
+Scripted failures (the MiniYARN-style failure repertoire — file-backed
+counters so they work across fake invocations):
+  FAKE_FAIL_CREATE_N=k    first k creates exit 1 with RESOURCE_EXHAUSTED
+  FAKE_FAIL_UNPACK_N=k    first k staging-unpack ssh commands drop
+                          ("Connection reset by peer")
+  FAKE_FAIL_DESCRIBE_N=k  first k describes exit 1 (API flakiness)
 """
 
 import os
@@ -25,6 +32,21 @@ import sys
 
 def root() -> str:
     return os.environ["FAKE_GCLOUD_ROOT"]
+
+
+def scripted_failure(kind: str) -> bool:
+    """Consume one scripted failure of ``kind`` if budget remains. The
+    counter file initializes from $FAKE_FAIL_<KIND>_N on first use."""
+    budget = os.environ.get(f"FAKE_FAIL_{kind}_N")
+    if not budget:
+        return False
+    path = os.path.join(root(), f"fail_{kind.lower()}_left")
+    left = int(open(path).read()) if os.path.exists(path) else int(budget)
+    if left <= 0:
+        return False
+    with open(path, "w") as f:
+        f.write(str(left - 1))
+    return True
 
 
 def log_call(argv):
@@ -69,6 +91,11 @@ def main(argv):
         return None
 
     if verb == "create":
+        if scripted_failure("CREATE"):
+            print("ERROR: (gcloud.compute.tpus.tpu-vm.create) "
+                  "RESOURCE_EXHAUSTED: quota exceeded for "
+                  "TPUV5sLitepodPerProjectPerZone", file=sys.stderr)
+            return 1
         d = slice_dir(name)
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, "state"), "w") as f:
@@ -78,6 +105,9 @@ def main(argv):
         return 0
 
     if verb == "describe":
+        if scripted_failure("DESCRIBE"):
+            print("ERROR: backend error: please retry", file=sys.stderr)
+            return 1
         state_path = os.path.join(slice_dir(name), "state")
         if not os.path.exists(state_path):
             print("NOT_FOUND", file=sys.stderr)
@@ -98,6 +128,11 @@ def main(argv):
         if not os.path.isdir(slice_dir(name)):
             print(f"ssh: slice {name} does not exist", file=sys.stderr)
             return 1
+        # mid-staging connection drop: target the unpack command so the
+        # failure lands between the tarball scp and the secret scp
+        if "tar -xzf" in (command or "") and scripted_failure("UNPACK"):
+            print("ssh: Connection reset by peer", file=sys.stderr)
+            return 255
         idx_list = (range(num_workers(name)) if worker == "all"
                     else [int(worker)])
         for i in idx_list:

@@ -243,3 +243,66 @@ class TestTpuBackendE2E:
         joined = "\n".join(calls(fake_gcloud))
         assert ".tony-secret" in joined
         assert "chmod 600 ~/tony-job/.tony-secret" in joined
+
+    def test_quota_exhausted_create_retries_with_backoff(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        """The first two creates fail RESOURCE_EXHAUSTED (quota); the
+        backend retries with backoff inside the SAME provisioning attempt
+        and the job succeeds. No preemption budget is consumed — quota
+        wait is not a lost slice."""
+        monkeypatch.setenv("FAKE_FAIL_CREATE_N", "2")
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.tpu.retry-backoff-ms": "50",
+                                "tony.tpu.preemption-retries": "0"}),
+            'bash -c "exit 0"')
+        assert client.run() == 0
+        ops = [c.split()[3] for c in calls(fake_gcloud)]
+        assert ops.count("create") == 3        # 2 failures + 1 success
+
+    def test_quota_budget_exhausted_fails_actionably(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        monkeypatch.setenv("FAKE_FAIL_CREATE_N", "99")
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.tpu.retry-backoff-ms": "20",
+                                "tony.tpu.create-retries": "1"}),
+            'bash -c "exit 0"')
+        assert client.run() == 1
+        ops = [c.split()[3] for c in calls(fake_gcloud)]
+        assert ops.count("create") == 2        # initial + 1 retry
+
+    def test_ssh_drop_mid_staging_restages_idempotently(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        """The staging unpack drops once ('Connection reset by peer');
+        the backend re-runs the WHOLE staging sequence (idempotent: rm -rf
+        + untar, scp overwrites) and the job succeeds with a complete,
+        uncorrupted job dir on every host."""
+        monkeypatch.setenv("FAKE_FAIL_UNPACK_N", "1")
+        proof = tmp_path / "proof"
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.application.security.enabled":
+                                "true"}),
+            f'bash -c "ls tony-final.xml >> {proof}-$TASK_INDEX; '
+            f'cat $PWD/.tony-secret >> {proof}-$TASK_INDEX"')
+        assert client.run() == 0
+        # the unpack ran twice (drop + re-stage) and the secret still
+        # arrived AFTER the successful unpack
+        unpacks = [c for c in calls(fake_gcloud)
+                   if "tar -xzf" in c and c.split()[3] == "ssh"]
+        assert len(unpacks) == 2
+        for idx in (0, 1):
+            body = open(f"{proof}-{idx}").read()
+            assert "tony-final.xml" in body
+            assert client.secret in body
+
+    def test_describe_flakiness_does_not_fail_job(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        """Transient describe failures map to state UNKNOWN — tasks keep
+        running, nothing is treated as preempted, the job succeeds."""
+        monkeypatch.setenv("FAKE_FAIL_DESCRIBE_N", "50")
+        client = TonyClient(
+            tpu_conf(tmp_path, {"tony.tpu.state-refresh-ms": "100",
+                                "tony.tpu.preemption-retries": "0"}),
+            'bash -c "sleep 2; exit 0"')
+        assert client.run() == 0
+        ops = [c.split()[3] for c in calls(fake_gcloud)]
+        assert ops.count("describe") >= 2      # the poller really polled

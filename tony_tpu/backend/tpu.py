@@ -64,6 +64,20 @@ class TpuProvisioningError(RuntimeError):
     pass
 
 
+#: stderr markers that make a failed `gcloud create` worth retrying with
+#: backoff — capacity and transient API conditions. Anything else (bad
+#: accelerator type/topology, auth/permission) is a configuration error
+#: whose actionable message must surface immediately.
+_RETRYABLE_CREATE = ("RESOURCE_EXHAUSTED", "QUOTA", "quota",
+                     "UNAVAILABLE", "RATE_LIMIT", "rate limit",
+                     "INTERNAL", "try again", "DEADLINE_EXCEEDED",
+                     "ABORTED", "stockout", "no more capacity")
+
+
+def _retryable_create_error(stderr: str) -> bool:
+    return any(m in stderr for m in _RETRYABLE_CREATE)
+
+
 def slice_name(app_id: str, job_type: str, slice_idx: int = 0,
                num_slices: int = 1) -> str:
     """One TPU VM name per gang. Multi-slice job types (tony.{job}.slices=N)
@@ -271,6 +285,27 @@ class TpuSliceBackend(SchedulerBackend):
         gang = (job_type, slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY,
                                       600000) / 1000
+        # Relaunch of a task id whose predecessor wrapper is STILL ALIVE
+        # (possible on the in-session restart path): reap it locally AND
+        # remotely, and WAIT for the remote reap before launching — its
+        # pkill pattern would race the new executor into the grave. A
+        # dead wrapper needs nothing: ssh returns when the remote command
+        # exits, so the remote executor is already gone (and kill_all
+        # handles whole-session teardown before session retries).
+        with self._lock:
+            old = self._procs.pop(spec.task_id, None)
+        if old is not None and not self.dry_run and old.poll() is None:
+            old.terminate()
+            reaper = self._kill_remote(spec.task_id)
+            try:
+                old.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                old.kill()
+            if reaper is not None:
+                try:
+                    reaper.wait(timeout=timeout_s)
+                except subprocess.TimeoutExpired:
+                    reaper.kill()
         # Claim-or-wait under the lock; the slow work (gcloud delete/create,
         # staging — minutes) runs OUTSIDE it so poll_completed/kill paths
         # never stall behind provisioning, and independent gangs can
@@ -366,12 +401,19 @@ class TpuSliceBackend(SchedulerBackend):
         failed generation's event is set as it is retracted, and a retry
         may have re-claimed the gang with a fresh entry (and fresh event)
         that must be waited on instead."""
-        # Worst case: delete (reprovision path) + create + 5 staging
-        # commands (scp tarball, unpack, scp secret, chmod, scp TLS cert)
-        # = 7 commands, each bounded by timeout_s; +1 for scheduling slack
-        # so a co-gang waiter never times out while the provisioner is
-        # still succeeding.
-        deadline = time.monotonic() + 8 * timeout_s
+        # Worst case: delete (reprovision path) + (1 + create-retries)
+        # creates + their backoff sleeps + (1 + stage-retries) passes over
+        # the 5 staging commands (scp tarball, unpack, scp secret, chmod,
+        # scp TLS cert), each command bounded by timeout_s; +1 command of
+        # scheduling slack so a co-gang waiter never times out while the
+        # provisioner is still succeeding.
+        create_r = self.conf.get_int(K.TPU_CREATE_RETRIES_KEY, 3)
+        stage_r = self.conf.get_int(K.TPU_STAGE_RETRIES_KEY, 2)
+        backoff = self.conf.get_int(K.TPU_RETRY_BACKOFF_KEY, 5000) / 1000
+        backoff_total = sum(min(backoff * 2 ** i, 60.0)
+                            for i in range(create_r))
+        worst_cmds = 1 + (1 + create_r) + 5 * (1 + stage_r) + 1
+        deadline = time.monotonic() + worst_cmds * timeout_s + backoff_total
         while True:
             with self._lock:
                 current = self._gangs.get(gang)
@@ -395,16 +437,62 @@ class TpuSliceBackend(SchedulerBackend):
         cmd = self.create_slice_command(job_type, spec.tpu_topology,
                                         slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
+        backoff_s = self.conf.get_int(K.TPU_RETRY_BACKOFF_KEY, 5000) / 1000
         if self.dry_run:
             log.info("[dry-run] %s", " ".join(cmd))
         else:
-            log.info("provisioning slice for %s: %s", gang, " ".join(cmd))
-            res = subprocess.run(cmd, capture_output=True, text=True,
-                                 timeout=timeout_s)
-            if res.returncode != 0:
-                raise TpuProvisioningError(
-                    f"slice provisioning failed for {gang}: {res.stderr}")
-        self._stage(job_type, slice_idx, spec, timeout_s)
+            # Quota-exhausted/transient create failures retry with
+            # exponential backoff (capacity frees up as other jobs finish
+            # — the fleet-level reality the reference delegated to YARN's
+            # allocation loop). The budget bounds ONE provisioning
+            # attempt; a lost slice afterwards is the preemption budget's
+            # business.
+            creates_left = self.conf.get_int(K.TPU_CREATE_RETRIES_KEY, 3)
+            while True:
+                log.info("provisioning slice for %s: %s", gang,
+                         " ".join(cmd))
+                try:
+                    res = subprocess.run(cmd, capture_output=True,
+                                         text=True, timeout=timeout_s)
+                    stderr = res.stderr or ""
+                    ok = res.returncode == 0
+                    # Permanent errors (bad topology/type, auth) fail
+                    # fast with the actionable message — only capacity/
+                    # transient API failures are worth the backoff.
+                    retryable = _retryable_create_error(stderr)
+                except subprocess.TimeoutExpired:
+                    ok, stderr, retryable = False, "create timed out", True
+                if ok:
+                    break
+                if creates_left <= 0 or not retryable:
+                    raise TpuProvisioningError(
+                        f"slice provisioning failed for {gang}: {stderr}")
+                creates_left -= 1
+                log.warning(
+                    "create failed for %s (%s) — retrying in %.1fs "
+                    "(%d create retries left)", gang,
+                    stderr.strip().splitlines()[-1:],
+                    backoff_s, creates_left)
+                time.sleep(backoff_s)
+                backoff_s = min(backoff_s * 2, 60.0)
+        # Staging re-runs from the top on a dropped connection: the
+        # command sequence is idempotent (rm -rf + mkdir + untar; scp
+        # overwrites), so a mid-sequence ssh/scp failure — or a HUNG one
+        # (TimeoutExpired) — re-stages clean.
+        stages_left = self.conf.get_int(K.TPU_STAGE_RETRIES_KEY, 2)
+        while True:
+            try:
+                self._stage(job_type, slice_idx, spec, timeout_s)
+                return
+            except (TpuProvisioningError, subprocess.TimeoutExpired) as e:
+                if stages_left <= 0:
+                    if isinstance(e, subprocess.TimeoutExpired):
+                        raise TpuProvisioningError(
+                            f"staging timed out for {gang}: {e}") from e
+                    raise
+                stages_left -= 1
+                log.warning("staging failed for %s (%s) — re-staging "
+                            "(%d stage retries left)", gang, e, stages_left)
 
     # ------------------------------------------------------------------
     # Staging / localization
