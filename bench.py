@@ -183,6 +183,10 @@ def main() -> None:
                 out[f"{name}_mfu"] = round(
                     tps * T.train_flops_per_token(config, s_seq) / peak, 4)
 
+        # GQA flagship (n_kv_heads=2): the grouped-query training win the
+        # GQA-native kernels buy (K/V projections + attention K/V reads
+        # ÷4). MFU accounting is GQA-aware (train_flops_per_token).
+        secondary("gqa", cfg.scaled(n_kv_heads=2), batch, seq, 15, key=8)
         # "base" preset (768d/12L, BERT-base scale) at seq 2048 — stresses
         # framework overheads the small preset doesn't. remat off fits at
         # batch 8 on 16G HBM and is ~25% faster than remat at b=4.

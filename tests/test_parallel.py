@@ -623,3 +623,30 @@ def test_unknown_cp_strategy_rejected():
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     with _pytest.raises(ValueError, match="cp_strategy"):
         T.forward(params, tok, cfg)
+
+
+class TestRematPolicy:
+    """remat_policy: full recompute vs dots (save MXU outputs, recompute
+    VPU) — same math, different memory/FLOP trade."""
+
+    def test_policies_agree_and_bogus_rejected(self):
+        from tony_tpu.models import transformer as T
+        cfg_full = T.PRESETS["tiny"].scaled(dtype=jnp.float32)
+        cfg_dots = cfg_full.scaled(remat_policy="dots")
+        params = T.init_params(jax.random.PRNGKey(0), cfg_full)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                  cfg_full.vocab_size)
+        batch = {"inputs": toks[:, :32], "targets": toks[:, 1:]}
+        l_full = float(T.lm_loss(params, batch, cfg_full))
+        l_dots = float(T.lm_loss(params, batch, cfg_dots))
+        np.testing.assert_allclose(l_dots, l_full, rtol=1e-6)
+        g_full = jax.grad(lambda p: T.lm_loss(p, batch, cfg_full))(params)
+        g_dots = jax.grad(lambda p: T.lm_loss(p, batch, cfg_dots))(params)
+        for a, b in zip(jax.tree.leaves(g_full), jax.tree.leaves(g_dots)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-6)
+        # invalid policy fails at CONFIG time, even with remat off
+        with pytest.raises(ValueError, match="remat_policy"):
+            cfg_full.scaled(remat_policy="bogus")
+        with pytest.raises(ValueError, match="remat_policy"):
+            cfg_full.scaled(remat=False, remat_policy="bogus")

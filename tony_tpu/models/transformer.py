@@ -61,6 +61,14 @@ class TransformerConfig:
     # to pin it.
     logits_dtype: Any = None
     remat: bool = True
+    # Rematerialization policy when remat=True: "full" recomputes the
+    # whole block in backward (minimum memory); "dots" saves the
+    # NON-BATCHED matmul outputs — projections and MLP; the batched
+    # attention QK^T/AV dots are still recomputed
+    # (jax.checkpoint_policies.dots_with_no_batch_dims_saveable) — more
+    # activation memory, but the backward stops re-paying the projection/
+    # MLP FLOPs that dominate the recompute bill.
+    remat_policy: str = "full"
     # lax.scan unroll factor over layers: 1 = rolled while-loop (fast
     # compile, the default); n_layers = fully unrolled (removes the scan's
     # activation-stacking dynamic-update-slices, ~6% faster per step on one
@@ -87,6 +95,10 @@ class TransformerConfig:
         if kv <= 0 or self.n_heads % kv:
             raise ValueError(f"n_kv_heads={kv} must be a positive divisor "
                              f"of n_heads={self.n_heads}")
+        if self.remat_policy not in ("full", "dots"):
+            raise ValueError(f"unknown remat_policy "
+                             f"{self.remat_policy!r}; expected 'full' or "
+                             f"'dots'")
 
     @property
     def head_dim(self) -> int:
@@ -267,6 +279,16 @@ def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
     return reference_attention(q, k, v, causal=True)
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """jax.checkpoint policy for cfg.remat_policy (None = save nothing)."""
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
+                     f"expected 'full' or 'dots'")
+
+
 def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
     """One decoder block. x: [B, S, D]; p: this layer's params (unstacked);
     ``rope``: precomputed (cos, sin) tables (derived from positions here
@@ -375,7 +397,7 @@ def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         rope = rope_tables(positions, cfg.head_dim)
         block_fn = functools.partial(_block, cfg=cfg, mesh=None, rules=rules)
         if cfg.remat:
-            block_fn = jax.checkpoint(block_fn)
+            block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg))
 
         def body(h, p):
             h, _ = block_fn(h, p, rope=rope)
@@ -411,7 +433,7 @@ def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,
         # rope tables ride the non-differentiated argument slot; marking
         # them static would re-run the trig in every layer's rematerialized
         # forward, which is exactly what hoisting avoids
-        block_fn = jax.checkpoint(block_fn)
+        block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg))
 
     def scan_body(x, layer_params):
         x, aux = block_fn(x, layer_params, rope=rope)
