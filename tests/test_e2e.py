@@ -943,3 +943,34 @@ def test_zip_entry_escaping_to_prefix_sibling_rejected(tmp_path):
     with pytest.raises(ValueError, match="escapes"):
         TaskExecutor._extract_zip_with_symlinks(str(evil), str(dest))
     assert not (sibling / "pwned").exists()
+
+
+def test_cli_logs_command(tmp_path):
+    """`tony logs <job_dir>` prints task logs (the `yarn logs` analog):
+    all tasks, a single --task filter, and --tail."""
+    import io
+    from contextlib import redirect_stdout
+    from tony_tpu.client import cli
+    client = make_client(
+        tmp_path, 'bash -c "echo line-$TASK_INDEX-a; echo line-$TASK_INDEX-b"',
+        {"tony.worker.instances": "2"})
+    assert client.run() == 0
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["logs", client.job_dir]) == 0
+    out = buf.getvalue()
+    assert "==== worker-0.stdout ====" in out and "line-0-a" in out
+    assert "==== worker-1.stdout ====" in out and "line-1-b" in out
+    assert "==== am.stderr ====" in out          # coordinator stream too
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert cli.main(["logs", client.job_dir, "--task", "worker:1",
+                         "--tail", "1"]) == 0
+    out = buf.getvalue()
+    assert "worker-1.stdout" in out and "line-1-b" in out
+    assert "worker-0" not in out and "line-1-a" not in out
+
+    assert cli.main(["logs", client.job_dir, "--task", "nosuch:9"]) == 1
+    assert cli.main(["logs", str(tmp_path / "nowhere")]) == 1

@@ -52,7 +52,7 @@ class LocalBackend(SchedulerBackend):
                     log.warning("previous %s did not die in 5s", spec.task_id)
             for f in self._files.pop(spec.task_id, ()):
                 f.close()
-        safe = spec.task_id.replace(":", "-")
+        safe = constants.task_log_stem(spec.task_id)
         out = open(os.path.join(spec.log_dir, f"{safe}.stdout"), "ab")
         err = open(os.path.join(spec.log_dir, f"{safe}.stderr"), "ab")
         env = with_framework_path(dict(os.environ))

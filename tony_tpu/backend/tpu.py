@@ -46,6 +46,7 @@ import tarfile
 import threading
 import time
 
+from tony_tpu import constants
 from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
@@ -389,7 +390,10 @@ class TpuSliceBackend(SchedulerBackend):
                 log.info("[dry-run] %s", " ".join(cmd))
                 return
             self._procs[spec.task_id] = subprocess.Popen(
-                cmd, stdout=open(f"{spec.log_dir}/{spec.task_id.replace(':', '-')}.stdout", "ab"),
+                cmd, stdout=open(os.path.join(
+                    spec.log_dir,
+                    f"{constants.task_log_stem(spec.task_id)}.stdout"),
+                    "ab"),
                 stderr=subprocess.STDOUT)
 
     def _await_gang(self, gang: tuple[str, int], timeout_s: float) -> None:

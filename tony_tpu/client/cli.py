@@ -39,6 +39,15 @@ def build_parser() -> argparse.ArgumentParser:
                         help="show a job's status and task URLs by job dir")
     st.add_argument("job_dir", help="the job's staging dir "
                                     "(<tony.staging.dir>/<app_id>)")
+    lg = sub.add_parser(
+        "logs", help="print task logs from a job dir (the `yarn logs "
+                     "-applicationId` analog)")
+    lg.add_argument("job_dir", help="the job's staging dir "
+                                    "(<tony.staging.dir>/<app_id>)")
+    lg.add_argument("--task", default="",
+                    help="only this task, e.g. worker:0 (default: all)")
+    lg.add_argument("--tail", type=int, default=0, metavar="N",
+                    help="last N lines of each log (default: everything)")
     c = sub.add_parser(
         "convert", add_help=False,
         help="convert data files to TONY1 framed records "
@@ -82,6 +91,8 @@ def main(argv: list[str] | None = None) -> int:
         return kill_job(args.job_dir)
     if args.command == "status":
         return job_status(args.job_dir)
+    if args.command == "logs":
+        return job_logs(args.job_dir, task=args.task, tail=args.tail)
     overrides = parse_cli_confs(args.conf)
     conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
     if args.python_venv:
@@ -185,6 +196,52 @@ def job_status(job_dir: str) -> int:
         return 1
     finally:
         rpc.close()
+    return 0
+
+
+def job_logs(job_dir: str, task: str = "", tail: int = 0) -> int:
+    """Print task logs from a job dir — the ``yarn logs -applicationId``
+    analog. Task logs live where the coordinator wrote them: the
+    ``tony.container.log-dir`` override from the job's frozen
+    tony-final.xml when set, else ``<job_dir>/logs`` (which always holds
+    the coordinator's own am.stdout/stderr)."""
+    import collections
+    dirs = [os.path.join(job_dir, constants.TONY_LOG_DIR)]
+    final_xml = os.path.join(job_dir, constants.TONY_FINAL_XML)
+    if os.path.exists(final_xml):
+        override = TonyConfig.load(final_xml).get(
+            K.CONTAINER_LOG_DIR_KEY) or ""
+        if override and os.path.abspath(override) != os.path.abspath(dirs[0]):
+            dirs.append(override)
+    if not any(os.path.isdir(d) for d in dirs):
+        print(f"tony: no logs directory under {job_dir}", file=sys.stderr)
+        return 1
+    want_stem = constants.task_log_stem(task) if task else ""
+    printed = 0
+    for log_dir in dirs:
+        if not os.path.isdir(log_dir):
+            continue
+        for name in sorted(os.listdir(log_dir)):
+            stem = name.rsplit(".", 1)[0]
+            if want_stem and stem != want_stem:
+                continue
+            path = os.path.join(log_dir, name)
+            if not os.path.isfile(path):
+                continue
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                # bounded: --tail on a multi-GB training log must not
+                # materialize the whole file
+                lines = (list(collections.deque(f, maxlen=tail)) if tail > 0
+                         else f.readlines())
+            print(f"==== {name} ====")
+            sys.stdout.writelines(lines)
+            if lines and not lines[-1].endswith("\n"):
+                print()
+            printed += 1
+    if not printed:
+        print(f"tony: no logs matching {task!r} under "
+              f"{', '.join(dirs)}", file=sys.stderr)
+        return 1
     return 0
 
 

@@ -211,7 +211,8 @@ class Coordinator:
             self.session.set_task_url(
                 task.job_type, task.index,
                 "file://" + os.path.join(
-                    self.log_dir, f"{worker.replace(':', '-')}.stdout"))
+                    self.log_dir,
+                    f"{constants.task_log_stem(worker)}.stdout"))
             # Chaos: kill the non-chief workers once the chief registers
             # (reference: TonyApplicationMaster.java:1169-1180) — simulates
             # losing part of the gang.

@@ -109,6 +109,13 @@ TONY_VENV_ZIP = "venv.zip"
 TONY_VENV_DIR = "venv"
 TONY_JOB_DIR_PREFIX = ".tony"          # staging dir per-application
 TONY_LOG_DIR = "logs"
+
+
+def task_log_stem(task_id: str) -> str:
+    """Log-file stem for a task id ("worker:0" → "worker-0") — the ONE
+    definition shared by every writer (backends, coordinator task URLs)
+    and reader (`tony logs`)."""
+    return task_id.replace(":", "-")
 CORE_SITE_CONF = "core-site.xml"
 
 # History file suffixes (HistoryFileUtils.java:11-32)
