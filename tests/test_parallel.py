@@ -438,6 +438,18 @@ class TestPipelineTransformer:
         with pytest.raises(NotImplementedError, match="MoE"):
             T.lm_loss(mparams, batch, mcfg, mesh)
 
+    def test_pp_with_gqa(self, setup):
+        """Pipeline stages run the GQA-native attention path (kv heads <
+        heads) — the two features must compose."""
+        T, shard_pytree, cfg, params, batch, _ = setup
+        gcfg = cfg.scaled(n_kv_heads=2)
+        gparams = T.init_params(jax.random.PRNGKey(7), gcfg)
+        ref = float(T.lm_loss(gparams, batch, gcfg, None))
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        sp = shard_pytree(gparams, T.logical_axes(gcfg), mesh)
+        loss = jax.jit(lambda p, b: T.lm_loss(p, b, gcfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
     def test_pp_explicit_microbatches(self, setup):
         T, shard_pytree, cfg, params, batch, ref_loss = setup
         mesh = make_mesh({"pp": 2, "dp": 2, "tp": 2})
