@@ -213,6 +213,73 @@ class TestRingAttention:
         for got, want in zip(g, gr):
             np.testing.assert_allclose(got, want, atol=2e-5)
 
+    @pytest.fixture(scope="class")
+    def qkv_gqa(self):
+        r = np.random.RandomState(4)
+        q = jnp.asarray(r.randn(2, 32, 4, 16), jnp.float32)
+        k = jnp.asarray(r.randn(2, 32, 2, 16), jnp.float32)
+        v = jnp.asarray(r.randn(2, 32, 2, 16), jnp.float32)
+        return q, k, v
+
+    def _dense_gqa(self, q, k, v, causal):
+        return _dense_attention(q, jnp.repeat(k, 2, 2),
+                                jnp.repeat(v, 2, 2), causal)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gqa_rides_ring_unexpanded(self, qkv_gqa, causal):
+        """GQA K/V rotate unexpanded (the ppermute payload is the ring's
+        whole inter-chip cost) and expand locally per hop."""
+        q, k, v = qkv_gqa
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        out = ring_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, self._dense_gqa(q, k, v, causal),
+                                   atol=2e-5)
+
+    def test_gqa_flash_arm(self, qkv_gqa, monkeypatch):
+        import importlib
+        R = importlib.import_module("tony_tpu.parallel.ring_attention")
+        monkeypatch.setattr(R, "_USE_FLASH_CHUNKS", True)
+        q, k, v = qkv_gqa
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        out = ring_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(out, self._dense_gqa(q, k, v, True),
+                                   atol=2e-5)
+
+    @pytest.mark.parametrize("kv_heads", [2, 1])
+    def test_gqa_with_tp_sharded_heads(self, kv_heads):
+        """GQA + a LIVE tp axis: q heads are tp-sharded, so kv heads must
+        shard over the same axis (kv_heads % tp == 0) or expand — local
+        j // rep pairing on replicated kv heads computes WRONG attention
+        (regression for the mis-pairing bug)."""
+        r = np.random.RandomState(6)
+        q = jnp.asarray(r.randn(2, 32, 4, 16), jnp.float32)
+        k = jnp.asarray(r.randn(2, 32, kv_heads, 16), jnp.float32)
+        v = jnp.asarray(r.randn(2, 32, kv_heads, 16), jnp.float32)
+        mesh = make_mesh({"dp": 2, "cp": 2, "tp": 2})
+        out = ring_attention(q, k, v, mesh, causal=True)
+        rep = 4 // kv_heads
+        want = _dense_attention(q, jnp.repeat(k, rep, 2),
+                                jnp.repeat(v, rep, 2), True)
+        np.testing.assert_allclose(out, want, atol=2e-5)
+
+    def test_gqa_indivisible_heads_raises(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        with pytest.raises(ValueError, match="divide"):
+            ring_attention(q, k[:, :, :1].repeat(3, 2)[:, :, :3], v, mesh)
+
+    @pytest.mark.slow
+    def test_gqa_gradients(self, qkv_gqa):
+        q, k, v = qkv_gqa
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        g = jax.grad(lambda *a: ring_attention(*a, mesh).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: self._dense_gqa(*a, True).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            assert got.shape == want.shape    # dK/dV stay kv_heads-wide
+            np.testing.assert_allclose(got, want, atol=3e-5)
+
     @pytest.mark.parametrize("causal", [True, False])
     def test_flash_chunk_arm_matches_dense(self, qkv, causal, monkeypatch):
         """The TPU arm (flash kernels per hop + logsumexp merge), forced on

@@ -265,13 +265,14 @@ def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
         raise ValueError(f"unknown cp_strategy {cp_strategy!r}; "
                          f"expected 'ring' or 'ulysses'")
     if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
-        # ring/ulysses shard by heads/sequence and need matching head
-        # counts — expand GQA K/V here (the cp regime's traffic is
-        # dominated by the collectives, not the local K/V read)
-        k, v = expand_kv(q, k, v)
         if cp_strategy == "ulysses":
+            # ulysses all-to-alls split the HEAD dim over cp, which GQA's
+            # few kv heads generally cannot satisfy — expand first
             from tony_tpu.parallel.ulysses import ulysses_attention
+            k, v = expand_kv(q, k, v)
             return ulysses_attention(q, k, v, mesh, causal=True)
+        # ring rides GQA K/V unexpanded: the rotation payload (the ring's
+        # whole inter-chip cost) shrinks by n_heads/n_kv_heads
         return ring_attention(q, k, v, mesh, causal=True)
     # flash and reference both consume GQA K/V natively (fewer kv heads)
     if jax.default_backend() == "tpu":

@@ -31,12 +31,27 @@ _NEG_INF = -1.0e30
 def _block_attn(q, k, v, m, l, o, scale, q_off, kv_off, causal):
     """One blockwise-attention accumulation step (online softmax).
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; m/l: [B, H, Sq]; o: [B, Sq, H, D].
-    q_off/kv_off are the global sequence offsets of the chunks (for causal
-    masking across ring hops).
+    q: [B, Sq, H, D]; k/v: [B, Sk, H_kv, D] (H_kv | H — GQA chunks stay
+    unexpanded on the ring so the ppermute payload is H/H_kv× smaller;
+    grouped einsums read the shared head directly, no materialized
+    expansion); m/l: [B, H, Sq]; o: [B, Sq, H, D]. q_off/kv_off are the
+    global sequence offsets of the chunks (for causal masking across
+    ring hops).
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * scale
+    b, sq, h, d = q.shape
+    hk = k.shape[2]
+    if hk != h:
+        # blocked grouping (query head j ↔ kv head j // rep), same layout
+        # as the flash kernels; [b, hk, rep, q, k] reshapes to the
+        # contiguous [b, h, q, k]
+        rep = h // hk
+        qg = q.reshape(b, sq, hk, rep, d)
+        s = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k,
+                       preferred_element_type=jnp.float32)
+        s = s.reshape(b, h, sq, k.shape[1]) * scale
+    else:
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
     if causal:
         q_pos = q_off + lax.broadcasted_iota(jnp.int32, s.shape, 2)
         kv_pos = kv_off + lax.broadcasted_iota(jnp.int32, s.shape, 3)
@@ -47,8 +62,14 @@ def _block_attn(q, k, v, m, l, o, scale, q_off, kv_off, causal):
     p = jnp.exp(s - m_new[..., None])
     corr = jnp.exp(m - m_new)
     l_new = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
-                    preferred_element_type=jnp.float32)
+    if hk != h:
+        pg = p.reshape(b, hk, h // hk, sq, k.shape[1])
+        pv = jnp.einsum("bhrqk,bkhd->bqhrd", pg.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
+        pv = pv.reshape(b, sq, h, d)
+    else:
+        pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                        preferred_element_type=jnp.float32)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -162,6 +183,7 @@ def _ring_flash(q, k, v, *, axis_name, causal, scale, cp, q_off):
         return o.astype(jnp.float32), lse
 
     def future_chunk(q, k, v):
+        # output/lse are q-shaped: unaffected by GQA K/V widths
         return (jnp.zeros((b, s_loc, h, d), jnp.float32),
                 jnp.full((b, h, s_loc), _NEG_INF, jnp.float32))
 
@@ -209,9 +231,27 @@ def ring_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     A ``shard_map`` island intended for use inside a jitted model: batch over
     dp/fsdp, sequence over cp, heads over tp. Axes missing from ``mesh`` (or
     of size 1) are dropped from the specs automatically.
+
+    GQA K/V (fewer heads than Q) ride the ring UNEXPANDED — the ring's
+    inter-chip traffic IS the K/V rotation, so grouped heads cut the
+    ppermute payload by H/H_kv. Head-sharding discipline: the local
+    arms pair local query head j with local kv head j // rep, which is
+    only the GLOBAL pairing when K/V heads shard over the SAME axis as
+    Q's (or none is live). So kv heads shard over ``head_axis`` when
+    they divide it; otherwise (H_kv < tp) K/V expand to full width
+    first — correctness over the payload saving.
     """
     from tony_tpu.parallel.sharding import attention_spec
     spec, s_spec = attention_spec(mesh, batch_axes, seq_axis, head_axis)
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h and (hk <= 0 or h % hk):
+        raise ValueError(f"kv heads ({hk}) must divide heads ({h})")
+    if hk != h:
+        tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+        if hk % max(tp, 1):
+            rep = h // hk
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
     if s_spec is None:
         # no cp axis: plain (still blockwise/online-softmax) local attention
