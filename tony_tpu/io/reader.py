@@ -111,13 +111,60 @@ def _close_native(lib, handle) -> None:
     lib.tdf_close(handle)
 
 
+_SENTINEL = object()
+
+
+def _prefetch_producer(records, q, stop, error_box) -> None:
+    """Prefetch producer body (module-level: must not close over the
+    reader). Decodes ahead of the training loop; a decode error lands in
+    ``error_box`` and is re-raised by the consumer — never swallowed in a
+    daemon thread. The trailing sentinel is best-effort with a bounded
+    loop: consumers use timeout-gets that re-check ``stop``, so a missing
+    sentinel cannot deadlock them."""
+    import queue
+    try:
+        for rec in records:
+            while not stop.is_set():
+                try:
+                    q.put(rec, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                return
+    except BaseException as e:
+        error_box.append(e)
+    finally:
+        for _ in range(50):
+            try:
+                q.put(_SENTINEL, timeout=0.1)
+                break
+            except queue.Full:
+                if stop.is_set():
+                    break
+
+
+def _stop_producer(stop, q) -> None:
+    """Finalizer for dropped readers: release the producer thread (it
+    exits its put loop once ``stop`` is set and the queue has room)."""
+    stop.set()
+    try:
+        q.get_nowait()
+    except Exception:
+        pass
+
+
 class _PythonImpl:
-    """Pure-Python fallback: same framing, sync, and windowed-shuffle
-    semantics, synchronous (no background thread — the native path is the
-    production engine; this keeps toolchain-less hosts working)."""
+    """Pure-Python engine: same framing, sync, and windowed-shuffle
+    semantics as the C++ engine, with an optional background PREFETCH
+    thread (``prefetch=True``) that decodes ahead into a bounded queue —
+    the DataFetcher-thread property (reference InternalBuffer:678) for
+    the formats only this engine speaks (Avro). Without it the impl is
+    fully synchronous (toolchain-less hosts, deterministic tests)."""
 
     def __init__(self, segments: list[FileSegment], record_size: int,
-                 capacity: int, shuffle: bool, seed: int) -> None:
+                 capacity: int, shuffle: bool, seed: int,
+                 prefetch: bool = False) -> None:
         self._records = self._generate(segments, record_size)
         # list for shuffle (O(1) swap-remove at a random slot), deque for
         # FIFO (O(1) popleft; list.pop(0) would shift the whole window).
@@ -127,6 +174,34 @@ class _PythonImpl:
         self._shuffle = shuffle
         self._rng = random.Random(seed)
         self._exhausted = False
+        self._queue = None
+        self._producer = None
+        #: one-slot box the producer stores a decode error into (read and
+        #: re-raised by the consumer in _fill)
+        self._error_box: list = []
+        if prefetch:
+            import queue
+            import threading
+            import weakref
+            # queue depth is capacity/4, ON TOP of the capacity-sized
+            # shuffle pool: enough decode-ahead overlap without silently
+            # doubling the documented buffer residency
+            self._queue = queue.Queue(maxsize=max(8, self._capacity // 4))
+            self._stop = threading.Event()
+            # The producer must NOT hold a reference to self (it would pin
+            # the reader and the finalizer below could never fire): it
+            # gets the generator/queue/flag directly.
+            self._producer = threading.Thread(
+                target=_prefetch_producer,
+                args=(self._records, self._queue, self._stop,
+                      self._error_box),
+                name="tony-datafeed-prefetch", daemon=True)
+            self._producer.start()
+            # A reader dropped without close() must not leave the producer
+            # spinning on a full queue forever (the native impl guards the
+            # same hazard with its own finalizer).
+            self._finalizer = weakref.finalize(
+                self, _stop_producer, self._stop, self._queue)
 
     @staticmethod
     def _generate(segments: list[FileSegment],
@@ -171,6 +246,24 @@ class _PythonImpl:
                         yield line.rstrip(b"\n")
 
     def _fill(self) -> None:
+        if self._queue is not None:
+            import queue
+            while not self._exhausted and len(self._pool) < self._capacity:
+                try:
+                    # timeout + stop re-check: a cross-thread close() may
+                    # retire the producer before its sentinel lands
+                    item = self._queue.get(timeout=0.2)
+                except queue.Empty:
+                    if self._stop.is_set():
+                        self._exhausted = True
+                    continue
+                if item is _SENTINEL:
+                    self._exhausted = True
+                    if self._error_box:
+                        raise self._error_box.pop()
+                else:
+                    self._pool.append(item)
+            return
         while not self._exhausted and len(self._pool) < self._capacity:
             try:
                 self._pool.append(next(self._records))
@@ -195,6 +288,23 @@ class _PythonImpl:
     def close(self) -> None:
         self._pool.clear()
         self._exhausted = True
+        if self._producer is not None:
+            # stop the producer FIRST: gen.close() on a generator another
+            # thread is executing raises ValueError
+            self._stop.set()
+            while True:       # unblock a put() stuck on a full queue
+                try:
+                    self._queue.get_nowait()
+                except Exception:
+                    break
+            self._producer.join(timeout=5)
+            if self._producer.is_alive():
+                # stuck inside the generator (hung IO): leave the daemon
+                # thread to die with the process rather than raise from
+                # closing a generator another thread is executing
+                log.warning("datafeed prefetch thread did not exit; "
+                            "leaving generator to the daemon thread")
+                return
         # Release the fd held by the suspended generator now, not at GC time
         # (the native impl guarantees this via its finalizer).
         self._records.close()
@@ -267,8 +377,14 @@ class FileSplitReader:
                 self.segments, record_size, capacity, shuffle, seed, lib)
             self.is_native = True
         else:
+            # Avro is production-served by the Python engine, so it gets
+            # the background prefetch thread (the C++ engine's DataFetcher
+            # property); the plain fallback stays synchronous. Window
+            # contents are identical either way (single FIFO producer), so
+            # shuffle determinism is unchanged.
             self._impl = _PythonImpl(self.segments, record_size, capacity,
-                                     shuffle, seed)
+                                     shuffle, seed,
+                                     prefetch=(record_size == -2))
             self.is_native = False
 
     def schema(self) -> dict:
