@@ -951,8 +951,13 @@ def test_cli_logs_command(tmp_path):
     import io
     from contextlib import redirect_stdout
     from tony_tpu.client import cli
+    # the chief (worker:0) sleeps after echoing: its completion is the
+    # session verdict and would otherwise race worker:1's output (the
+    # teardown kill can land before worker:1 echoes)
     client = make_client(
-        tmp_path, 'bash -c "echo line-$TASK_INDEX-a; echo line-$TASK_INDEX-b"',
+        tmp_path,
+        'bash -c "echo line-$TASK_INDEX-a; echo line-$TASK_INDEX-b; '
+        'if [ $TASK_INDEX = 0 ]; then sleep 3; fi"',
         {"tony.worker.instances": "2"})
     assert client.run() == 0
 
