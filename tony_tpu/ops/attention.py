@@ -29,15 +29,31 @@ cost on this hardware is ~2-4µs, so step count matters as much as FLOPs):
   kv-blocks entirely above the diagonal are skipped, so the VPU cost of
   masking amortizes to ~1 op/element instead of ~4.
 
+Round-4 refinements, each measured on one v5e with xprof device time:
+
+- **Base-2 online softmax**: ``scale·log2e`` folds into Q once outside the
+  kernels; the kernels call ``exp2`` (VPU ``exp`` is exp2 plus a
+  multiply) and convert lse to natural log only at finalize. Backward
+  picks up a single ln2 on the [*, d]-shaped outputs.
+- **Skip-block DMA elision**: causal index maps clamp the K/V (or q-side)
+  block coordinate for above/below-diagonal skipped steps, so the
+  pipeline never fetches blocks the kernel won't read.
+- **Narrow-q × wide-kv blocks** (256×1024 fwd, 128×512 bwd): the
+  [block_q, block_k] f32 score intermediates are the kernel-stack VMEM
+  budget; shrinking block_q 4× is what affords kv blocks past 256 and
+  with them fewer grid steps and less K/V re-fetch.
+
 Backward recomputes scores (no O(S²) residuals) in a single fused pass by
-default: dQ accumulates in VMEM over the kv grid dimension while per-q-block
-dK/dV partials ([nq, b·h, S, D] f32) are reduced by XLA outside — one
-score/exp recompute instead of the classic two-pass split's two, which is
-what matters in this VPU-bound regime. When the partials would exceed the
-``_FUSED_PARTIALS_BYTES`` budget (their HBM footprint scales with nq), the
-backward falls back to the two-pass split: one pass gridded over q-blocks
-accumulating dQ, one over kv-blocks accumulating dK/dV. Wired together
-with ``jax.custom_vjp``.
+default, on a KV-MAJOR grid: dK/dV accumulate in f32 VMEM scratch across
+the inner q sweep (written once per kv block — no partials), and only the
+per-kv-block dQ contributions ([nk, b·h, S, D], input dtype) are summed
+by XLA outside — one score/exp recompute instead of the classic two-pass
+split's two, which is what matters in this VPU-bound regime, and half the
+partial-tensor traffic of the previous q-major layout. When the partials
+would exceed the ``_FUSED_PARTIALS_BYTES`` budget (their HBM footprint
+scales with nk), the backward falls back to the two-pass split: one pass
+gridded over q-blocks accumulating dQ, one over kv-blocks accumulating
+dK/dV. Wired together with ``jax.custom_vjp``.
 
 On non-TPU backends (the 8-device CPU test mesh) the same kernels run in
 Pallas interpret mode — bit-accurate, slow — or callers use
@@ -59,6 +75,16 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1.0e30
 _LANES = 128
+# The online softmax runs in BASE-2 (flash-2-style transcendental
+# thinning): `scale · log2(e)` is folded into Q once outside the kernels
+# (a [*, d] multiply amortized over every kv block, instead of the
+# per-block [bq, bk] `s * scale`), the kernels call `exp2` directly
+# (VPU `exp` is exp2 plus an x·log2e multiply — dropped), and lse
+# converts back to natural log only at finalize. Backward picks up a
+# single ln2 factor on the score gradient (∂2^x/∂x = ln2·2^x), applied
+# to the [*, d]-shaped dq/dk outputs rather than the score matrix.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 
 def _cdiv(a: int, b: int) -> int:
@@ -121,8 +147,12 @@ def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None):
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
-                *, scale: float, causal: bool, g: int, bq: int, bk: int,
+                *, causal: bool, g: int, bq: int, bk: int,
                 nk: int, band_nq: int):
+    # Q arrives PRE-SCALED by scale·log2e (:func:`_prep_flat`), so the
+    # raw MXU dot is already the base-2 score and the kernel never
+    # touches a [bq, bk] scale multiply; all max/sum bookkeeping below
+    # is in the exp2 domain, converted to natural lse only at finalize.
     qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
     # ml_scr packs the running max (lane 0) and running sum (lane 1) into
@@ -136,20 +166,20 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
     def _accumulate(masked: bool):
         mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
-            q = q_ref[gi]                              # [bq, d]
+            q = q_ref[gi]                              # [bq, d], pre-scaled
             k = k_ref[gi]                              # [bk, d]
             v = v_ref[gi]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+                preferred_element_type=jnp.float32)    # [bq, bk], base-2
             if masked:
                 s = jnp.where(mask, s, _NEG_INF)
             m_prev = ml_scr[gi, :, 0:1]                # [bq, 1]
             l_prev = ml_scr[gi, :, 1:2]
             first = m_prev <= _NEG_INF                 # nothing seen yet
             m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
-            p = jnp.exp(s - m_new)                     # [bq, bk]
-            corr = jnp.where(first, 0.0, jnp.exp(m_prev - m_new))  # [bq, 1]
+            p = jnp.exp2(s - m_new)                    # [bq, bk]
+            corr = jnp.where(first, 0.0, jnp.exp2(m_prev - m_new))  # [bq, 1]
             l_new = l_prev * corr + p.sum(axis=-1, keepdims=True)
             if nk == 1 and not (causal and bq < bk):
                 # single kv block: the accumulator rescale is dead code
@@ -174,23 +204,43 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             l = ml_scr[gi, :, 1:2]
             o_ref[gi] = (acc_scr[gi] / jnp.maximum(l, 1e-30)).astype(
                 o_ref.dtype)
-            lse_ref[gi] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+            # natural-log lse: ln(2^m · l) = ln2 · (m + log2 l)
+            lse_ref[gi] = (_LN2 * (m + jnp.log2(jnp.maximum(l, 1e-30))))[:, 0]
 
 
-def _flash_forward(q, k, v, *, scale, causal, g, bq, bk, band):
+def _kv_index_map(causal: bool, bq: int, bk: int, band_nq: int):
+    """K/V block index map for q-major grids ``(b, qi, ki)``. For causal
+    kernels the ki coordinate is CLAMPED to the last diagonal-touching
+    block of the (band-relative) q row: skipped above-diagonal steps then
+    repeat the previous step's block index, and the Pallas pipeline elides
+    the HBM→VMEM copy for an unchanged index — at long sequence nearly
+    half the K/V DMA traffic was being fetched for blocks the kernel
+    never reads."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def _map(b, i, j):
+        last = ((i % band_nq + 1) * bq - 1) // bk
+        return (b, jnp.minimum(j, last), 0)
+
+    return _map
+
+
+def _flash_forward(q, k, v, *, causal, g, bq, bk, band):
     bh, sq, d = q.shape                 # sq = rep·band under GQA
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
-    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+    kernel = functools.partial(_fwd_kernel, causal=causal,
                                g=g, bq=bq, bk=bk, nk=nk,
                                band_nq=_cdiv(band, bq))
+    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq))
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh // g, nq, nk),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), kv_map),
+            pl.BlockSpec((g, bk, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
@@ -212,164 +262,213 @@ def _flash_forward(q, k, v, *, scale, causal, g, bq, bk, band):
 
 
 # ---------------------------------------------------------------------------
-# Backward, fused single pass (default): grid (bh/g, nq, nk). dQ accumulates
-# in VMEM scratch over the inner kv dimension; the dK/dV contribution of each
-# (q-block, kv-block) pair is written to per-q-block partial outputs
-# [nq, bh, sk, d] and reduced by XLA outside. This recomputes scores/exp ONCE
-# per backward instead of twice (the classic two-pass split), which matters
+# Backward, fused single pass (default): KV-MAJOR grid (bh/g, nk, nq) —
+# ki outer, qi inner. dK/dV accumulate in f32 VMEM scratch across the qi
+# sweep and are written ONCE per kv block (no dK/dV partials at all); the
+# only partial tensor is per-kv-block dQ contributions [nk, bh, sq, d],
+# summed by XLA outside. Compared to the round-2/3 q-major layout (which
+# wrote TWO partial tensors, dK and dV), this halves partial HBM traffic
+# and replaces two XLA reduces with one. One compiled body (mask applied
+# on every active block — measured free next to exp2) keeps Mosaic's
+# kernel stack small enough for 512-wide kv blocks; 128-row q blocks
+# shrink the [bq, bk] f32 intermediates 4×, which is what buys the wide
+# kv blocks under the ~16 MB VMEM limit. Measured (device-time via xprof,
+# one v5e, seq 8k b4): 14.2 ms vs 17.1 ms for the q-major layout (1.21×);
+# seq 1k b32: 3.32 vs 3.78 ms (1.14×). This recomputes scores/exp ONCE
+# per backward instead of the two-pass split's twice, which matters
 # because the kernel is VPU-bound (softmax ops, not MXU FLOPs, set the
-# wall-clock at LM head dims). delta = rowsum(dO·O) is computed in-kernel
-# from the resident dO/O blocks, so no [.., _LANES] broadcasts ever touch
-# HBM. Partial dK/dV memory is nq × the tensor size, so the fused path is
-# used while the partials stay under _FUSED_PARTIALS_BYTES each (fused
-# measured 32% faster than two-pass at seq 8192 on one v5e — the saved
-# recompute beats the partial traffic by a wide margin); truly huge
-# seq × batch·head products fall back to the two-pass kernels below.
+# wall-clock at LM head dims). delta = rowsum(dO·O) is one fused XLA
+# pass outside, fed (like lse) as 2-D [g, bq] blocks — no [.., _LANES]
+# broadcasts ever touch HBM.
 # ---------------------------------------------------------------------------
 
-# Per-partial-tensor budget (there are 2) gating the fused backward.
-# Overridable: TONY_FLASH_FUSED_PARTIALS_MB. Measured on one v5e (bf16,
-# 8 heads, d64, interleaved A/B with host-value barriers): fused is ~18%
-# faster than two-pass at BOTH seq 8k (b=4, partials at the 512 MB
-# boundary) and seq 16k (b=2, 1.07 GB partials, forced past the budget) —
-# raise the knob when HBM has headroom. Set 0 to force two-pass: the
-# fused path stores dK/dV partials in bf16 (error ~ √nq·eps_bf16, ≤0.7%
-# measured at nq=16 but growing with seq/block_q), while two-pass
-# accumulates in f32 VMEM — the knob is the precision escape hatch.
+# Partial-tensor budget gating the fused backward (the dQ partials are
+# nk × the q tensor size). Overridable: TONY_FLASH_FUSED_PARTIALS_MB.
+# Measured on one v5e (bf16, 8 heads, d64, interleaved A/B with
+# host-value barriers): fused is ~18% faster than two-pass at BOTH seq
+# 8k (b=4, partials at the 512 MB boundary) and seq 16k (b=2, forced
+# past the budget) — raise the knob when HBM has headroom. Set 0 to
+# force two-pass: the fused path stores dQ partials in bf16 (error
+# ~ √nk·eps_bf16), while two-pass accumulates dQ in f32 VMEM — the
+# knob is the precision escape hatch.
 import os as _os
 
 _FUSED_PARTIALS_BYTES = int(_os.environ.get(
     "TONY_FLASH_FUSED_PARTIALS_MB", "512")) * 1024 * 1024
 
+# Backward block shape on real TPUs (interpret mode keeps caller blocks
+# so tiny CPU test shapes stay bit-testable): 128-row q blocks × 512-wide
+# kv blocks won the v5e sweep — [128, 512] f32 stack intermediates are
+# small enough for the single-body kernel to fit VMEM with headroom.
+_BWD_BQ = 128
+_BWD_BK = 512
 
-def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, o_ref, lse_ref, *refs,
-                      scale: float, causal: bool, g: int, bq: int, bk: int,
-                      nk: int, has_dlse: bool, band_nq: int):
-    # refs = ([dlse_ref,] dq_ref, dkp_ref, dvp_ref, dq_scr): the dlse input
-    # exists only for the with-lse entry point, so the hot plain-attention
-    # path compiles the exact same kernel as before.
+
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      *refs, causal: bool, g: int, bq: int, bk: int,
+                      nq: int, has_dlse: bool, band_nq: int):
+    # refs = ([dlse_ref,] dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr): the
+    # dlse input exists only for the with-lse entry point, so the hot
+    # plain-attention path compiles the exact same kernel.
     if has_dlse:
-        dlse_ref, dq_ref, dkp_ref, dvp_ref, dq_scr = refs
+        dlse_ref, dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
     else:
         dlse_ref = None
-        dq_ref, dkp_ref, dvp_ref, dq_scr = refs
-    qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
-    ki = pl.program_id(2)
+        dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr = refs
+    ki = pl.program_id(1)
+    qi_g = pl.program_id(2)             # inner: restarts per kv block
+    qi = qi_g % band_nq                 # GQA band-relative (identity: MHA)
 
-    @pl.when(ki == 0)
+    @pl.when(qi_g == 0)
     def _init():
-        dq_scr[:] = jnp.zeros_like(dq_scr)
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    def _accumulate(masked: bool):
-        mask = _causal_mask(qi, ki, bq, bk) if masked else None
+    def _accumulate():
+        # single body: the causal mask runs on every active block (its
+        # iota+compare is in the noise next to exp2), which keeps one
+        # copy of the [bq, bk] f32 intermediates on the kernel stack —
+        # the VMEM room that pays for 512-wide kv blocks.
+        mask = _causal_mask(qi, ki, bq, bk) if causal else None
         for gi in range(g):
-            q = q_ref[gi]                               # [bq, d]
+            q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]                               # [bk, d]
             v = v_ref[gi]
             do = do_ref[gi]
-            o = o_ref[gi]
-            lse = lse_ref[gi][:, None]                  # [bq, 1]
+            lse2 = lse_ref[gi][:, None]                 # [bq, 1], base-2
             # d(lse) enters the score gradient additively:
             # ds = p · (dp - delta + dlse); delta_eff folds it in
-            delta = jnp.sum(do.astype(jnp.float32)
-                            * o.astype(jnp.float32),
-                            axis=-1, keepdims=True)     # [bq, 1]
+            delta = delta_ref[gi][:, None]              # [bq, 1]
             if has_dlse:
                 delta = delta - dlse_ref[gi][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
-            if masked:
+                preferred_element_type=jnp.float32)     # [bq, bk], base-2
+            if causal:
                 s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse)                        # [bq, bk]
-            dvp_ref[0, gi] = jax.lax.dot_general(
+            p = jnp.exp2(s - lse2)                      # [bq, bk]
+            dv_scr[gi] += jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(dvp_ref.dtype)
+                preferred_element_type=jnp.float32)     # [bk, d]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [bq, bk]
-            ds = p * (dp - delta) * scale               # [bq, bk]
-            dkp_ref[0, gi] = jax.lax.dot_general(
+            # base-2 score grad is ln2·p·(dp - delta); the ln2 lands on
+            # the [*, d]-shaped dk/dq outputs, never the score matrix
+            ds = p * (dp - delta)                       # [bq, bk]
+            dk_scr[gi] += jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32).astype(dkp_ref.dtype)
-            dq_scr[gi] += jax.lax.dot(ds.astype(k.dtype), k,
-                                      preferred_element_type=jnp.float32)
-
-    def _zero():
-        # blocks above the diagonal contribute nothing, but their partial
-        # output blocks still exist and must be zeroed
-        dkp_ref[:] = jnp.zeros_like(dkp_ref)
-        dvp_ref[:] = jnp.zeros_like(dvp_ref)
+                preferred_element_type=jnp.float32)     # [bk, d]
+            dqp_ref[0, gi] = (_LN2 * jax.lax.dot(
+                ds.astype(k.dtype), k,
+                preferred_element_type=jnp.float32)).astype(dqp_ref.dtype)
 
     if causal:
-        _causal_dispatch(qi, ki, bq, bk, _accumulate, on_skip=_zero)
+        work = (qi + 1) * bq > ki * bk
+
+        @pl.when(work)
+        def _():
+            _accumulate()
+
+        @pl.when(jnp.logical_not(work))
+        def _():
+            # blocks above the diagonal contribute nothing, but their dq
+            # partial blocks still exist and must be zeroed
+            dqp_ref[:] = jnp.zeros_like(dqp_ref)
     else:
-        _accumulate(False)
+        _accumulate()
 
-    @pl.when(ki == nk - 1)
+    @pl.when(qi_g == nq - 1)
     def _finalize():
-        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+        dk_ref[:] = (_LN2 * dk_scr[:]).astype(dk_ref.dtype)
+        dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
+def _bwd_q_maps(causal: bool, bq: int, bk: int, band_nq: int):
+    """Index maps for q-side operands on the kv-major grid ``(b, ki, qi)``.
+    For causal kernels the leading (band-relative) q blocks of each kv
+    sweep sit above the diagonal and are skipped — clamp them to the
+    first diagonal-touching block so the pipeline doesn't DMA blocks the
+    kernel never reads (mirror of :func:`_kv_index_map`)."""
+    if not causal:
+        return (lambda b, j, i: (b, i, 0)), (lambda b, j, i: (b, i))
+
+    def _clamp(j, i):
+        rel = i % band_nq
+        first = (j * bk) // bq
+        return i - rel + jnp.maximum(rel, first)
+
+    return (lambda b, j, i: (b, _clamp(j, i), 0),
+            lambda b, j, i: (b, _clamp(j, i)))
+
+
+def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
                           bq, bk, band):
     bh, sq, d = q.shape
     sk = k.shape[1]
     has_dlse = dlse is not None
-    # The fused kernel holds 5 input blocks + dq + 2 partial outputs plus
-    # the [bq, bk] f32 intermediates — 4 per compiled body, and Mosaic
-    # allocates stack for BOTH _causal_dispatch bodies, so 8 count toward
-    # the budget; kv blocks of 256 keep that under the ~16 MB VMEM limit
-    # at g=8, d=64 (512-wide kv blocks blow it). Only clamp when 256
-    # still tiles the kv length — otherwise the last block would read
-    # out-of-bounds padding, which nothing masks in the non-causal case.
-    if bk > 256 and sk % 256 == 0:
-        bk = 256
+    # Swap to the measured-best backward blocks when they tile the
+    # shapes (always true at the power-of-two LM lengths); interpret
+    # mode keeps caller blocks so tiny CPU test shapes exercise the
+    # same kernel.
+    if not _interpret():
+        if sq % _BWD_BQ == 0 and band % _BWD_BQ == 0:
+            bq = _BWD_BQ
+        if sk % _BWD_BK == 0:
+            bk = _BWD_BK
+        elif bk > 256 and sk % 256 == 0:
+            bk = 256
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
+    band_nq = _cdiv(band, bq)
+    # ds = p · (dp - delta + dlse): delta = rowsum(dO·O) is one fused XLA
+    # elementwise+reduce pass; base-2 lse feeds the exp2-domain kernel.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                            # [bh, sq]
+    lse2 = lse * _LOG2E
+    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq)
     in_specs = [
-        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
+        pl.BlockSpec((g, bq, d), q_map),
+        pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((g, bq, d), q_map),
+        pl.BlockSpec((g, bq), q_map2),
+        pl.BlockSpec((g, bq), q_map2),
     ]
-    operands = [q, k, v, do, o, lse]
+    operands = [q, k, v, do, lse2, delta]
     if has_dlse:
-        in_specs.append(pl.BlockSpec((g, bq), lambda b, i, j: (b, i)))
+        in_specs.append(pl.BlockSpec((g, bq), q_map2))
         operands.append(dlse)
-    dq, dkp, dvp = pl.pallas_call(
-        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
-                          g=g, bq=bq, bk=bk, nk=nk, has_dlse=has_dlse,
-                          band_nq=_cdiv(band, bq)),
-        grid=(bh // g, nq, nk),
+    dqp, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, causal=causal,
+                          g=g, bq=bq, bk=bk, nq=nq, has_dlse=has_dlse,
+                          band_nq=band_nq),
+        grid=(bh // g, nk, nq),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, g, bk, d), lambda b, i, j: (i, b, j, 0)),
-            pl.BlockSpec((1, g, bk, d), lambda b, i, j: (i, b, j, 0)),
+            pl.BlockSpec((1, g, bq, d), lambda b, j, i: (j, b, i, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-            # Partials are stored at input precision, not f32: each element
-            # is a complete f32 MXU accumulation over the q-block rows
-            # rounded ONCE, and the partials are summed in f32 below.
-            # Worst-case error ~ √nq · eps_bf16 (≤ ~2% at the budget's
-            # nq ≈ 22; measured ≤ 0.7% at nq = 16, covered by
-            # test_gradients_bfloat16_long_seq) — for half the partial HBM
-            # traffic (f32 partials also push the kernel past 16 MB VMEM).
-            jax.ShapeDtypeStruct((nq, bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((nq, bh, sk, d), v.dtype),
+            # dQ partials are stored at input precision, not f32: each
+            # element is a complete f32 MXU accumulation over the kv-block
+            # columns rounded ONCE, and the partials are summed in f32
+            # below. Worst-case error ~ √nk · eps_bf16 (covered by
+            # test_gradients_bfloat16_long_seq) — for half the partial
+            # HBM traffic.
+            jax.ShapeDtypeStruct((nk, bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
         ],
-        scratch_shapes=[pltpu.VMEM((g, bq, d), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((g, bk, d), jnp.float32),
+                        pltpu.VMEM((g, bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(*operands)
-    if nq == 1:
-        return dq, dkp[0], dvp[0]
-    dk = dkp.astype(jnp.float32).sum(0).astype(k.dtype)
-    dv = dvp.astype(jnp.float32).sum(0).astype(v.dtype)
+    if nk == 1:
+        return dqp[0], dk, dv
+    dq = dqp.astype(jnp.float32).sum(0).astype(q.dtype)
     return dq, dk, dv
 
 
@@ -379,7 +478,7 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, scale, causal, g,
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, scale: float, causal: bool, g: int, bq: int,
+               dq_scr, *, causal: bool, g: int, bq: int,
                bk: int, nk: int, band_nq: int):
     qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
@@ -391,22 +490,22 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _accumulate(masked: bool):
         mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
-            q = q_ref[gi]
+            q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]
             v = v_ref[gi]
             do = do_ref[gi]                             # [bq, d]
-            lse = lse_ref[gi][:, :1]                    # [bq, 1]
-            delta = delta_ref[gi][:, :1]                # [bq, 1]
+            lse2 = lse_ref[gi][:, None]                 # [bq, 1], base-2
+            delta = delta_ref[gi][:, None]              # [bq, 1]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale
+                preferred_element_type=jnp.float32)     # base-2
             if masked:
                 s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse)                        # [bq, bk]
+            p = jnp.exp2(s - lse2)                      # [bq, bk]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [bq, bk]
-            ds = p * (dp - delta) * scale
+            ds = p * (dp - delta)
             dq_scr[gi] += jax.lax.dot(ds.astype(k.dtype), k,
                                       preferred_element_type=jnp.float32)
 
@@ -417,7 +516,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[:] = dq_scr[:].astype(dq_ref.dtype)
+        dq_ref[:] = (_LN2 * dq_scr[:]).astype(dq_ref.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -425,7 +524,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 # ---------------------------------------------------------------------------
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float,
+                dk_ref, dv_ref, dk_scr, dv_scr, *,
                 causal: bool, g: int, bq: int, bk: int, nq: int,
                 band_nq: int):
     ki = pl.program_id(1)
@@ -440,25 +539,25 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _accumulate(masked: bool):
         mask = _causal_mask(qi, ki, bq, bk) if masked else None
         for gi in range(g):
-            q = q_ref[gi]                               # [bq, d]
+            q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]                               # [bk, d]
             v = v_ref[gi]
             do = do_ref[gi]
-            lse = lse_ref[gi][:, :1]
-            delta = delta_ref[gi][:, :1]
+            lse2 = lse_ref[gi][:, None]                 # base-2
+            delta = delta_ref[gi][:, None]
             s = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+                preferred_element_type=jnp.float32)     # [bq, bk], base-2
             if masked:
                 s = jnp.where(mask, s, _NEG_INF)
-            p = jnp.exp(s - lse)                        # [bq, bk]
+            p = jnp.exp2(s - lse2)                      # [bq, bk]
             dv_scr[gi] += jax.lax.dot_general(
                 p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [bk, d]
             dp = jax.lax.dot_general(
                 do, v, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [bq, bk]
-            ds = p * (dp - delta) * scale               # [bq, bk]
+            ds = p * (dp - delta)                       # [bq, bk]
             dk_scr[gi] += jax.lax.dot_general(
                 ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)     # [bk, d]
@@ -470,18 +569,26 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(qi_g == nq - 1)
     def _finalize():
-        dk_ref[:] = dk_scr[:].astype(dk_ref.dtype)
+        dk_ref[:] = (_LN2 * dk_scr[:]).astype(dk_ref.dtype)
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
+def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
                     bq, bk, band):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
-    partial_bytes = nq * bh * sk * d * q.dtype.itemsize
+    # dQ partials are [nk, bh, sq, d] at the blocks the fused path will
+    # actually pick — mirror its clamp chain exactly.
+    bk_eff = bk
+    if not _interpret():
+        if sk % _BWD_BK == 0:
+            bk_eff = _BWD_BK
+        elif bk > 256 and sk % 256 == 0:
+            bk_eff = 256
+    partial_bytes = _cdiv(sk, bk_eff) * bh * sq * d * q.dtype.itemsize
     if partial_bytes <= _FUSED_PARTIALS_BYTES:
-        return _flash_backward_fused(q, k, v, o, lse, do, dlse, scale=scale,
+        return _flash_backward_fused(q, k, v, o, lse, do, dlse,
                                      causal=causal, g=g, bq=bq, bk=bk,
                                      band=band)
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
@@ -494,25 +601,27 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
     if bk > 256 and sk % 256 == 0:
         bk = 256
         nk = _cdiv(sk, bk)
-    # ds = p · (dp - delta + dlse): fold the lse cotangent into delta
+    # ds = p · (dp - delta + dlse): fold the lse cotangent into delta;
+    # base-2 lse for the exp2-domain kernels. Both ride as 2-D [g, bq]
+    # blocks — no [.., _LANES] HBM broadcasts.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                            # [bh, sq]
     if dlse is not None:
         delta = delta - dlse
-    lse_l = jnp.broadcast_to(lse[..., None], (bh, sq, _LANES))
-    delta_l = jnp.broadcast_to(delta[..., None], (bh, sq, _LANES))
+    lse2 = lse * _LOG2E
+    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq))
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, g=g,
+        functools.partial(_dq_kernel, causal=causal, g=g,
                           bq=bq, bk=bk, nk=nk, band_nq=_cdiv(band, bq)),
         grid=(bh // g, nq, nk),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((g, bk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((g, bk, d), kv_map),
+            pl.BlockSpec((g, bk, d), kv_map),
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bq, _LANES), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((g, bq, _LANES), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
+            pl.BlockSpec((g, bq), lambda b, i, j: (b, i)),
         ],
         out_specs=pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
@@ -520,19 +629,21 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, lse2, delta)
 
+    band_nq = _cdiv(band, bq)
+    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq)
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, g=g,
-                          bq=bq, bk=bk, nq=nq, band_nq=_cdiv(band, bq)),
+        functools.partial(_dkv_kernel, causal=causal, g=g,
+                          bq=bq, bk=bk, nq=nq, band_nq=band_nq),
         grid=(bh // g, nk, nq),
         in_specs=[
-            pl.BlockSpec((g, bq, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), q_map),
             pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((g, bq, d), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((g, bq, _LANES), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((g, bq, _LANES), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((g, bq, d), q_map),
+            pl.BlockSpec((g, bq), q_map2),
+            pl.BlockSpec((g, bq), q_map2),
         ],
         out_specs=[
             pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
@@ -549,7 +660,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
-    )(q, k, v, do, lse_l, delta_l)
+    )(q, k, v, do, lse2, delta)
     return dq, dk, dv
 
 
@@ -557,49 +668,52 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, scale, causal, g,
 # Public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_attention_bhsd(q, k, v, scale, causal, g, bq, bk, band):
-    o, _ = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, causal, g, bq, bk, band):
+    # q arrives pre-scaled by scale·log2e (:func:`_prep_flat`); the fold
+    # sits OUTSIDE this custom_vjp boundary, so plain AD of the multiply
+    # routes the scale factor into dq for free.
+    o, _ = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                           bk=bk, band=band)
     return o
 
 
-def _flash_fwd_rule(q, k, v, scale, causal, g, bq, bk, band):
-    o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+def _flash_fwd_rule(q, k, v, causal, g, bq, bk, band):
+    o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                             bk=bk, band=band)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(scale, causal, g, bq, bk, band, residuals, grad):
+def _flash_bwd_rule(causal, g, bq, bk, band, residuals, grad):
     q, k, v, o, lse = residuals
-    return _flash_backward(q, k, v, o, lse, grad, scale=scale, causal=causal,
+    return _flash_backward(q, k, v, o, lse, grad, causal=causal,
                            g=g, bq=bq, bk=bk, band=band)
 
 
 _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_attention_lse_bhsd(q, k, v, scale, causal, g, bq, bk, band):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_lse_bhsd(q, k, v, causal, g, bq, bk, band):
     """(o, lse) variant with lse as a DIFFERENTIATED output — what
     cross-chunk softmax merging (ring attention) needs: the merge weights
     are exp(lse_chunk - lse_total), so d(lse) must flow back into the
     score gradient (ds gains a +p·dlse term, folded into delta)."""
-    return _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+    return _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                           bk=bk, band=band)
 
 
-def _flash_lse_fwd_rule(q, k, v, scale, causal, g, bq, bk, band):
-    o, lse = _flash_forward(q, k, v, scale=scale, causal=causal, g=g, bq=bq,
+def _flash_lse_fwd_rule(q, k, v, causal, g, bq, bk, band):
+    o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                             bk=bk, band=band)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd_rule(scale, causal, g, bq, bk, band, residuals, grads):
+def _flash_lse_bwd_rule(causal, g, bq, bk, band, residuals, grads):
     q, k, v, o, lse = residuals
     do, dlse = grads
     return _flash_backward(q, k, v, o, lse, do,
-                           dlse.astype(jnp.float32), scale=scale,
+                           dlse.astype(jnp.float32),
                            causal=causal, g=g, bq=bq, bk=bk, band=band)
 
 
@@ -608,7 +722,7 @@ _flash_attention_lse_bhsd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
-                    block_q: int = 512, block_k: int = 512,
+                    block_q: int = 256, block_k: int = 1024,
                     block_h: int = 4):
     """Fused attention over [batch, seq, heads, head_dim] inputs.
 
@@ -619,21 +733,26 @@ def flash_attention(q, k, v, *, causal: bool = True,
     traffic by h/h_kv instead of materializing a repeated tensor.
 
     Block sizes are clamped to the input shapes (tiny test shapes).
-    Defaults were swept on a v5e chip at LM shapes (seq 1-2k, head_dim 64).
+    Defaults were swept on a v5e chip at LM shapes (seq 1k-8k, head_dim
+    64): narrow q blocks × wide kv blocks (256×1024 forward, 128×512
+    backward) won — the [block_q, block_k] f32 score intermediates are
+    the VMEM budget, and shrinking block_q is what affords wide kv
+    blocks, fewer grid steps, and less K/V re-fetch per output row.
     ``block_h`` is a hint for heads-per-grid-step, resolved by
     :func:`_pick_group` (a multiple of 8 dividing batch·heads, or all of
-    them); grouping amortizes the fixed ~2-4 µs per-grid-step cost, bounded
-    by VMEM (the fused backward holds 5 input blocks + 3 output blocks + 4
-    [block_q, block_k] f32 intermediates per step). Differentiable via the
-    fused flash backward (two-pass kernels for long sequences).
+    them); grouping amortizes the fixed ~2-4 µs per-grid-step cost,
+    bounded by VMEM — the binding term is the single compiled body's
+    [block_q, block_k] f32 score intermediates times the g-scaled
+    input/output/scratch blocks. Differentiable via the fused kv-major
+    flash backward (two-pass kernels for long sequences).
     """
     if _sub_tile(q, block_q):
         return reference_attention(q, k, v, causal=causal, scale=scale)
-    qf, kf, vf, scale, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
-                                                    block_k, block_h)
+    qf, kf, vf, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
+                                             block_k, block_h)
     b, sq, h, d = q.shape
     hk = k.shape[2]
-    o = _flash_attention_bhsd(qf, kf, vf, scale, causal, g, bq, bk, band)
+    o = _flash_attention_bhsd(qf, kf, vf, causal, g, bq, bk, band)
     return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3))
 
 
@@ -656,6 +775,10 @@ def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
     the 2-D lse block's leading dim divisible by 8; zero heads give zero
     scores → uniform softmax over zero values → o = 0, finite lse, zero
     grads — callers slice the padding off), and resolve the head group.
+    Q is scaled by ``scale · log2(e)`` HERE — one [*, d] multiply XLA
+    fuses into the layout change — so the kernels' raw MXU dot is the
+    base-2 score and no [bq, bk] scale multiply ever runs; the fold sits
+    outside the custom_vjp, so AD routes the factor into dq.
     Returns the flat operands plus the band length S."""
     b, sq, h, d = q.shape
     sk, hk = k.shape[1], k.shape[2]
@@ -666,7 +789,16 @@ def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
         raise ValueError(f"seq lengths ({sq}, {sk}) must divide into blocks")
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    if not _interpret() and bk == sk and bq < bk and sk % 256 == 0 \
+            and sk >= 1024:
+        # single-kv-block grids at wide bk lose the revolving-buffer
+        # VMEM reuse and blow the ~16 MB budget by a hair (measured:
+        # [256, 1024] at nk=1 is 68 KB over); two kv blocks fit.
+        bk = sk // 2
     scale = (d ** -0.5) if scale is None else scale
+    # fold in f32 and round ONCE: casting the constant itself to bf16
+    # would bake a systematic ~0.2% temperature error into every logit
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     # [B,S,H,D] → [B,H,S,D] → group rep query heads per kv head into one
     # row dim (blocked head order: query head i ↔ kv head i // rep)
     qf = q.transpose(0, 2, 1, 3).reshape(b * hk, rep * sq, d)
@@ -678,12 +810,12 @@ def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
         qf, kf, vf = (jnp.pad(x, ((0, pad), (0, 0), (0, 0)))
                       for x in (qf, kf, vf))
     g = _pick_group(qf.shape[0], block_h)
-    return qf, kf, vf, scale, g, bq, bk, sq
+    return qf, kf, vf, g, bq, bk, sq
 
 
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              scale: float | None = None,
-                             block_q: int = 512, block_k: int = 512,
+                             block_q: int = 256, block_k: int = 1024,
                              block_h: int = 4):
     """Like :func:`flash_attention` but also returns the row logsumexp
     ([batch, heads, seq], f32) as a DIFFERENTIATED output — the primitive
@@ -693,11 +825,11 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     heads than Q) is supported exactly as in :func:`flash_attention`."""
     if _sub_tile(q, block_q):
         return _dense_with_lse(q, k, v, causal=causal, scale=scale)
-    qf, kf, vf, scale, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
-                                                    block_k, block_h)
+    qf, kf, vf, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
+                                             block_k, block_h)
     b, sq, h, d = q.shape
     hk = k.shape[2]
-    o, lse = _flash_attention_lse_bhsd(qf, kf, vf, scale, causal, g, bq, bk,
+    o, lse = _flash_attention_lse_bhsd(qf, kf, vf, causal, g, bq, bk,
                                        band)
     return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3),
             lse[:b * hk].reshape(b, h, sq))
