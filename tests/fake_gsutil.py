@@ -20,6 +20,15 @@ def main(argv):
         argv = argv[1:]
     verb, args = argv[0], argv[1:]
 
+    # auth observability for the credential-scoping tests: record which
+    # identity each call ran under (CLOUDSDK_AUTH_ACCESS_TOKEN is how the
+    # real gcloud suite receives an explicit access token)
+    auth_log = os.environ.get("FAKE_GSUTIL_AUTH_LOG")
+    if auth_log:
+        with open(auth_log, "a") as f:
+            tok = os.environ.get("CLOUDSDK_AUTH_ACCESS_TOKEN", "AMBIENT")
+            f.write(f"{verb} {tok}\n")
+
     if verb == "stat":
         return 0 if os.path.isfile(to_local(args[0])) else 1
 
@@ -50,14 +59,30 @@ def main(argv):
     if verb == "cat":
         if args[0] == "-r":
             rng, path = args[1], args[2]
-            n = int(rng.lstrip("-"))
             with open(to_local(path), "rb") as f:
-                f.seek(0, os.SEEK_END)
-                f.seek(max(0, f.tell() - n))
-                sys.stdout.buffer.write(f.read())
+                if rng.startswith("-"):              # tail: last N bytes
+                    n = int(rng[1:])
+                    f.seek(0, os.SEEK_END)
+                    f.seek(max(0, f.tell() - n))
+                    sys.stdout.buffer.write(f.read())
+                else:                                # inclusive a-b range
+                    a, b = rng.split("-")
+                    start = int(a)
+                    f.seek(start)
+                    if b:
+                        sys.stdout.buffer.write(f.read(int(b) - start + 1))
+                    else:                            # open-ended "a-"
+                        sys.stdout.buffer.write(f.read())
             return 0
         with open(to_local(args[0]), "rb") as f:
             sys.stdout.buffer.write(f.read())
+        return 0
+
+    if verb == "du":
+        p = to_local(args[0])
+        if not os.path.isfile(p):
+            return 1
+        print(f"{os.path.getsize(p)}  {args[0]}")
         return 0
 
     if verb == "cp":

@@ -270,3 +270,56 @@ class TestClientRemoteStaging:
         # is still written locally for out-of-band tooling (tony kill)
         assert not store.exists(sjoin(client.remote_job_dir, ".tony-secret"))
         assert os.path.exists(os.path.join(client.job_dir, ".tony-secret"))
+
+
+class TestRangedReads:
+    """read_range / size / open_read — the data feed's storage primitives
+    (reference: HdfsAvroFileSplitReader.java:201 fs.open + positioned
+    reads; ctors :301-317 take a FileSystem)."""
+
+    def test_contract_both_substrates(self, store_and_root):
+        store, root = store_and_root
+        path = sjoin(root, "blob.bin")
+        payload = bytes(range(256)) * 40                # 10240 bytes
+        store.write_bytes(path, payload)
+        assert store.size(path) == len(payload)
+        assert store.read_range(path, 0, 16) == payload[:16]
+        assert store.read_range(path, 1000, 24) == payload[1000:1024]
+        # short read at EOF, empty past EOF, zero-length
+        assert store.read_range(path, len(payload) - 5, 100) == payload[-5:]
+        assert store.read_range(path, len(payload) + 10, 4) == b""
+        assert store.read_range(path, 3, 0) == b""
+
+    def test_open_read_is_seekable_stream(self, store_and_root):
+        store, root = store_and_root
+        path = sjoin(root, "stream.bin")
+        payload = b"".join(f"line-{i:05d}\n".encode() for i in range(2000))
+        store.write_bytes(path, payload)
+        with store.open_read(path) as f:
+            assert f.read(10) == payload[:10]
+            f.seek(0, os.SEEK_END)
+            assert f.tell() == len(payload)
+            f.seek(len(payload) // 2)
+            rest = f.read()
+            assert rest == payload[len(payload) // 2:]
+            f.seek(11)                       # second line start
+            assert f.readline() == b"line-00001\n"
+
+    def test_sopen_ssize_dispatch(self, tmp_path, monkeypatch):
+        from tony_tpu.storage import register_storage, sopen, ssize
+
+        gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+        register_storage("gs", GcsStorage(gsutil=gsutil))
+        try:
+            GcsStorage(gsutil=gsutil).write_bytes("gs://bucket/x.bin",
+                                                  b"remote-bytes")
+            local = tmp_path / "x.bin"
+            local.write_bytes(b"local-bytes")
+            assert ssize(str(local)) == 11
+            assert ssize("gs://bucket/x.bin") == 12
+            with sopen(str(local)) as f:
+                assert f.read() == b"local-bytes"
+            with sopen("gs://bucket/x.bin") as f:
+                assert f.read() == b"remote-bytes"
+        finally:
+            register_storage("gs", None)

@@ -10,7 +10,8 @@ Python over py4j per TaskExecutor.java:281). Components:
                reference :242 block sync, :446 schema channel)
   avro       — direct Avro object-container ingestion (existing datasets
                read in place, no conversion): spec binary codec, null +
-               deflate codecs, sync-scan split tiling (reference :242)
+               deflate + snappy codecs (pure-Python snappy in snappy.py),
+               sync-scan split tiling (reference :242)
   reader     — FileSplitReader: C++ prefetch/shuffle engine via ctypes
                (native/datafeed.cc) with a pure-Python fallback; byte,
                ndarray, and local-spill delivery modes

@@ -33,6 +33,8 @@ import json
 import os
 import secrets
 import struct
+
+from tony_tpu.storage import sopen, ssize
 from typing import BinaryIO, Iterator
 
 MAGIC = b"TONY1\0"
@@ -66,8 +68,10 @@ def is_framed_file(path: str) -> bool:
     """True when ``path`` starts with the TONY1 magic. A missing/unreadable
     file raises OSError — swallowing it here would misreport a typo'd path
     as "not framed" and send callers down a framing-mismatch rabbit hole."""
-    with open(path, "rb") as f:
-        return f.read(len(MAGIC)) == MAGIC
+    # magic probe via a ranged read: a scan-sized buffered stream would
+    # fetch MBs of a remote object to look at 6 bytes
+    from tony_tpu.storage import storage_for
+    return storage_for(path).read_range(path, 0, len(MAGIC)) == MAGIC
 
 
 def read_header(f: BinaryIO) -> FileHeader:
@@ -85,7 +89,7 @@ def read_header(f: BinaryIO) -> FileHeader:
 
 
 def read_path_header(path: str) -> FileHeader:
-    with open(path, "rb") as f:
+    with sopen(path, buffer_size=1 << 16) as f:   # header-sized probe
         return read_header(f)
 
 
@@ -180,7 +184,7 @@ def iter_segment_records(path: str, offset: int,
                          length: int) -> Iterator[bytes]:
     """Records of every block whose sync starts inside [offset, offset+len)
     — the Python engine's framed arm (the C++ engine mirrors this)."""
-    with open(path, "rb") as f:
+    with sopen(path) as f:
         header = read_header(f)
         end = offset + length
         pos = max(offset, header.data_start)
@@ -235,5 +239,5 @@ def iter_segment_records(path: str, offset: int,
 
 def iter_file_records(path: str) -> Iterator[bytes]:
     """All records of a framed file (spill-file consumption)."""
-    size = os.path.getsize(path)
+    size = ssize(path)
     yield from iter_segment_records(path, 0, size)

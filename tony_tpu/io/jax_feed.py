@@ -12,12 +12,12 @@ modes" (SURVEY.md §7 step 9).
 from __future__ import annotations
 
 import logging
-import os
 from typing import Iterator
 
 import numpy as np
 
 from tony_tpu.io.reader import FileSplitReader
+from tony_tpu.storage import ssize
 from tony_tpu.io.split import full_records_in_split
 
 log = logging.getLogger(__name__)
@@ -112,7 +112,7 @@ def global_batches(paths: list[str], batch_size_per_process: int, dtype,
     pid = jax.process_index() if process_index is None else process_index
     pcount = jax.process_count() if process_count is None else process_count
     record_size = record_size_for(dtype, row_shape)
-    sizes = [os.path.getsize(p) for p in paths]
+    sizes = [ssize(p) for p in paths]
     num_batches = min(
         full_records_in_split(paths, i, pcount, record_size, sizes=sizes)
         // batch_size_per_process

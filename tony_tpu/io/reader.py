@@ -41,6 +41,7 @@ import random
 import weakref
 from typing import Iterator
 
+from tony_tpu.storage import is_remote, sopen
 from tony_tpu.io import avro as _avro
 from tony_tpu.io import framed as _framed
 from tony_tpu.io.split import FileSegment, compute_read_info
@@ -215,7 +216,7 @@ class _PythonImpl:
                 yield from _avro.iter_segment_records(
                     seg.path, seg.offset, seg.length)
                 continue
-            with open(seg.path, "rb") as f:
+            with sopen(seg.path) as f:
                 if record_size > 0:
                     first = -(-seg.offset // record_size)
                     end_excl = -(-(seg.offset + seg.length) // record_size)
@@ -369,6 +370,14 @@ class FileSplitReader:
                     "the native engine does not decode Avro (record "
                     "boundaries are schema-driven); omit use_native")
             use_native = False
+        # Remote (gs://) inputs stream through the storage seam's ranged
+        # reader — the C++ engine only speaks local fds.
+        if any(is_remote(p) for p in paths):
+            if use_native is True:
+                raise DataFeedError(
+                    "the native engine reads local files only; remote "
+                    "(gs://) inputs use the Python engine — omit use_native")
+            use_native = False
         lib = load_native() if use_native in (None, True) else None
         if use_native is True and lib is None:
             raise DataFeedError("native data-feed requested but unavailable")
@@ -377,14 +386,17 @@ class FileSplitReader:
                 self.segments, record_size, capacity, shuffle, seed, lib)
             self.is_native = True
         else:
-            # Avro is production-served by the Python engine, so it gets
-            # the background prefetch thread (the C++ engine's DataFetcher
-            # property); the plain fallback stays synchronous. Window
-            # contents are identical either way (single FIFO producer), so
-            # shuffle determinism is unchanged.
-            self._impl = _PythonImpl(self.segments, record_size, capacity,
-                                     shuffle, seed,
-                                     prefetch=(record_size == -2))
+            # Avro and remote (gs://) inputs are production-served by the
+            # Python engine, so they get the background prefetch thread
+            # (the C++ engine's DataFetcher property) — for remote inputs
+            # it overlaps ranged fetches with training; the plain local
+            # fallback stays synchronous. Window contents are identical
+            # either way (single FIFO producer), so shuffle determinism
+            # is unchanged.
+            self._impl = _PythonImpl(
+                self.segments, record_size, capacity, shuffle, seed,
+                prefetch=(record_size == -2
+                          or any(is_remote(p) for p in paths)))
             self.is_native = False
 
     def schema(self) -> dict:

@@ -17,7 +17,8 @@ same with Avro block sync markers, ``:242``).
 
 from __future__ import annotations
 
-import os
+
+from tony_tpu.storage import ssize
 from dataclasses import dataclass
 
 
@@ -50,10 +51,11 @@ def compute_read_info(paths: list[str], idx: int, n: int,
     """Map the global split of task ``idx``/``n`` onto per-file segments.
 
     ``sizes`` may be passed to avoid re-statting (e.g. remote listings);
-    otherwise each path is ``os.path.getsize``d.
+    otherwise each path is statted through the storage seam (``ssize``),
+    so ``gs://`` inputs split exactly like local ones.
     """
     if sizes is None:
-        sizes = [os.path.getsize(p) for p in paths]
+        sizes = [ssize(p) for p in paths]
     if len(sizes) != len(paths):
         raise ValueError("paths and sizes length mismatch")
     total = sum(sizes)
@@ -87,7 +89,7 @@ def full_records_in_split(paths: list[str], idx: int, n: int,
     if record_size <= 0:
         raise ValueError("full_records_in_split requires fixed-size framing")
     if sizes is None:
-        sizes = [os.path.getsize(p) for p in paths]
+        sizes = [ssize(p) for p in paths]
     size_of = dict(zip(paths, sizes))
     count = 0
     for seg in compute_read_info(paths, idx, n, sizes=sizes):
