@@ -79,6 +79,24 @@ def rewrite_tmp(cmd: str, home: str) -> str:
 
 
 def main(argv):
+    if argv[:2] == ["auth", "print-access-token"]:
+        # per-job scoped identity mint (tony.gcs.service-account)
+        log_call(argv)
+        sa = ""
+        for f in argv[2:]:
+            if f.startswith("--impersonate-service-account="):
+                sa = f.split("=", 1)[1]
+        if not sa:
+            print("ERROR: expected --impersonate-service-account",
+                  file=sys.stderr)
+            return 1
+        # distinct token per mint so renewal tests can observe rotation
+        counter = os.path.join(os.environ["FAKE_GCLOUD_ROOT"], ".mint-count")
+        n = int(open(counter).read()) + 1 if os.path.exists(counter) else 1
+        with open(counter, "w") as f:
+            f.write(str(n))
+        print(f"fake-token-for-{sa}#{n}")
+        return 0
     assert argv[:3] == ["compute", "tpus", "tpu-vm"], argv
     verb, name = argv[3], argv[4]
     flags = argv[5:]

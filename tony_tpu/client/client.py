@@ -40,6 +40,33 @@ from tony_tpu.utils.version import inject_version_info
 log = logging.getLogger("tony_tpu.client")
 
 
+def _mint_gcs_token(service_account: str) -> str:
+    """Short-lived access token via gcloud impersonation — the client's
+    delegation-token fetch (reference TonyClient.java:509). Requires the
+    submitter to hold roles/iam.serviceAccountTokenCreator on the target
+    account; failure is a submit-time error, not a mid-job surprise.
+    ``$TONY_GCLOUD`` overrides the binary (tests substitute a fake)."""
+    gcloud = os.environ.get("TONY_GCLOUD", "gcloud")
+    try:
+        proc = subprocess.run(
+            [gcloud, "auth", "print-access-token",
+             f"--impersonate-service-account={service_account}"],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, timeout=60)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        raise RuntimeError(
+            f"cannot mint GCS token for {service_account}: {e}") from e
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"gcloud token mint for {service_account} failed "
+            f"rc={proc.returncode}: "
+            f"{proc.stderr.decode('utf-8', 'replace').strip()}")
+    token = proc.stdout.decode("utf-8").strip()
+    if not token:
+        raise RuntimeError(
+            f"gcloud returned an empty token for {service_account}")
+    return token
+
+
 def new_app_id() -> str:
     """application_<ts>_<rand> — shaped like a YARN application id."""
     return f"application_{int(time.time() * 1000)}_{uuid.uuid4().hex[:6]}"
@@ -94,6 +121,18 @@ class TonyClient:
         self.secret: str | None = None
         if conf.get_bool(K.APPLICATION_SECURITY_KEY, False):
             self.secret = secrets.token_hex(16)
+        # Per-job GCS identity (tony.gcs.service-account — the delegation-
+        # token analog, reference TonyClient.java:509 getTokens): mint a
+        # short-lived access token for the scoped service account NOW, so
+        # the client's own staging push and every downstream process run
+        # under the job identity, never ambient host credentials. Rides
+        # env only (like the secret), persisted 0600 for tooling.
+        self.gcs_token: str | None = None
+        gcs_sa = conf.get(K.GCS_SERVICE_ACCOUNT_KEY)
+        if gcs_sa:
+            self.gcs_token = _mint_gcs_token(gcs_sa)
+            storage.register_storage(
+                "gs", storage.GcsStorage(token=self.gcs_token))
         # Per-job TLS (rpc/tls.py): cert generated in stage(), paths set
         # once the files exist.
         self.tls_enabled = conf.get_bool(K.TLS_ENABLED_KEY, False)
@@ -159,6 +198,14 @@ class TonyClient:
                          0o600)
             with os.fdopen(fd, "w") as f:
                 f.write(self.secret)
+        if self.gcs_token:
+            # like the secret: written AFTER the remote push so the job
+            # credential never lands in the bucket it scopes
+            tok_path = os.path.join(self.job_dir, ".gcs-token")
+            fd = os.open(tok_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                         0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(self.gcs_token)
         if self.tls_enabled:
             # Generated AFTER any remote push, like the secret: the key
             # must never land in a (possibly team-readable) bucket — it
@@ -180,6 +227,8 @@ class TonyClient:
         env[constants.ATTEMPT_NUMBER] = str(attempt)
         if self.secret:
             env[constants.TONY_SECRET] = self.secret
+        if self.gcs_token:
+            env[constants.TONY_GCS_TOKEN] = self.gcs_token
         if self.tls_cert_path:
             env[constants.TONY_TLS_CERT] = self.tls_cert_path
             env[constants.TONY_TLS_KEY] = self.tls_key_path
@@ -253,8 +302,18 @@ class TonyClient:
         """Poll until the job finishes (reference: monitorApplication:572).
         Returns the process-style exit code (0 success)."""
         started = time.monotonic()
+        renew_s = self.conf.get_int(K.GCS_TOKEN_RENEW_MS_KEY,
+                                    2_700_000) / 1000.0
+        next_renew = started + renew_s
         while True:
             time.sleep(self.POLL_PERIOD_S)
+            if (self.gcs_token and self.rpc is not None
+                    and time.monotonic() >= next_renew):
+                # a failed mint/push retries in a minute, not a full
+                # period — the next full period would land past the
+                # current token's ~1h expiry
+                ok = self._renew_gcs_token()
+                next_renew = time.monotonic() + (renew_s if ok else 60.0)
             final = self._read_final_status()
             if final is not None:
                 status = final["status"]
@@ -275,6 +334,32 @@ class TonyClient:
                 if addr:
                     self.rpc = self._connect(addr)
             self._print_task_urls()
+
+    def _renew_gcs_token(self) -> bool:
+        """Re-mint the scoped token and push it to the coordinator (the
+        delegation-token renewal the reference delegates to the RM): the
+        heartbeat channel fans it out to executors, which republish to
+        the token file user processes re-read per storage call. Renewal
+        failure is non-fatal here — the current token stays valid until
+        its own expiry, and the caller retries on a short fuse."""
+        sa = self.conf.get(K.GCS_SERVICE_ACCOUNT_KEY)
+        try:
+            token = _mint_gcs_token(sa)
+            self.rpc.renew_gcs_token(token)
+        except Exception:
+            log.warning("GCS token renewal failed (will retry shortly)",
+                        exc_info=True)
+            return False
+        self.gcs_token = token
+        os.environ[constants.TONY_GCS_TOKEN] = token
+        storage.register_storage(
+            "gs", storage.GcsStorage(token=token))
+        tok_path = os.path.join(self.job_dir, ".gcs-token")
+        fd = os.open(tok_path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(token)
+        log.info("per-job GCS token renewed and pushed to coordinator")
+        return True
 
     def _handle_am_crash(self) -> int:
         """Coordinator crash → relaunch with attempt+1 if retries remain (the

@@ -109,8 +109,19 @@ class CoordinatorRpc(ApplicationRpc):
         self.co.client_signalled_finish.set()
         return self.co.final_status or "RUNNING"
 
-    def task_executor_heartbeat(self, task_id: str) -> None:
+    def task_executor_heartbeat(self, task_id: str) -> str:
         self.co.hb_monitor.ping(task_id)
+        return os.environ.get(constants.TONY_GCS_TOKEN, "")
+
+    def renew_gcs_token(self, token: str) -> None:
+        # Client-pushed replacement for the expiring impersonation token:
+        # landing it in this process's env refreshes the coordinator's own
+        # storage calls, future executor launches, AND the value served on
+        # every heartbeat response (executors pick it up within one
+        # heartbeat interval).
+        if token:
+            os.environ[constants.TONY_GCS_TOKEN] = token
+            log.info("per-job GCS token renewed by client")
 
     def get_application_status(self) -> ApplicationStatus:
         if self.co.final_status:
@@ -326,6 +337,12 @@ class Coordinator:
         }
         if self.secret:
             env[constants.TONY_SECRET] = self.secret
+        gcs_token = os.environ.get(constants.TONY_GCS_TOKEN)
+        if gcs_token:
+            # the job's scoped GCS identity (tony.gcs.service-account),
+            # re-exported explicitly so executors inherit it even when a
+            # backend strips the coordinator environment
+            env[constants.TONY_GCS_TOKEN] = gcs_token
         if self.tls_cert:
             env[constants.TONY_TLS_CERT] = self.tls_cert
         env.update(request.env)

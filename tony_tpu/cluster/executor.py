@@ -70,7 +70,7 @@ class Heartbeater(threading.Thread):
     MAX_CONSECUTIVE_FAILURES = 5
 
     def __init__(self, rpc: ApplicationRpcClient, task_id: str,
-                 interval_s: float) -> None:
+                 interval_s: float, gcs_token_file: str | None = None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
@@ -79,6 +79,26 @@ class Heartbeater(threading.Thread):
         self.skip_remaining = int(
             os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
         self._failures = 0
+        #: heartbeat responses carry the job's current GCS token (client-
+        #: pushed renewals); a change is republished to this local file,
+        #: which the user process's storage layer re-reads per call —
+        #: env can't reach an already-forked child, a file can
+        self.gcs_token_file = gcs_token_file
+        self._last_token = os.environ.get(constants.TONY_GCS_TOKEN, "")
+
+    def _republish_token(self, token: str) -> None:
+        if not token or token == self._last_token:
+            return
+        self._last_token = token
+        os.environ[constants.TONY_GCS_TOKEN] = token
+        if self.gcs_token_file:
+            tmp = self.gcs_token_file + ".tmp"
+            fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+            with os.fdopen(fd, "w") as f:
+                f.write(token)
+            os.replace(tmp, self.gcs_token_file)    # atomic for readers
+            log.info("renewed GCS token republished to %s",
+                     self.gcs_token_file)
 
     def run(self) -> None:
         while not self.stop_event.wait(self.interval_s):
@@ -88,8 +108,9 @@ class Heartbeater(threading.Thread):
                          self.skip_remaining)
                 continue
             try:
-                self.rpc.task_executor_heartbeat(self.task_id)
+                tok = self.rpc.task_executor_heartbeat(self.task_id)
                 self._failures = 0
+                self._republish_token(tok)
             except Exception:  # any send failure counts
                 self._failures += 1
                 log.warning("heartbeat send failure %d/%d", self._failures,
@@ -149,6 +170,17 @@ class TaskExecutor:
             time.sleep(backoff)
             backoff = min(backoff * 1.5, 2.0)
 
+    def _publish_gcs_token(self) -> str:
+        """Write the current GCS token to this task's local token file
+        (0600) and return its path; the heartbeater atomically rewrites
+        it when the client pushes a renewal."""
+        path = os.path.join(os.getcwd(), f".gcs-token-{self.task_index}")
+        fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
+        with os.fdopen(fd, "w") as f:
+            f.write(os.environ.get(constants.TONY_GCS_TOKEN, ""))
+        self._gcs_token_file = path
+        return path
+
     # ------------------------------------------------------------------
     def framework_env(self) -> dict[str, str]:
         """The runtime adapter switch (reference: TaskExecutor.java:131-154),
@@ -164,6 +196,12 @@ class TaskExecutor:
         }
         if self.notebook_port:
             env[constants.NOTEBOOK_PORT] = str(self.notebook_port)
+        if getattr(self, "_gcs_token_file", None):
+            # scoped GCS identity: the user process reads the token from a
+            # FILE the heartbeater refreshes on client-pushed renewals —
+            # env alone would freeze the submit-time token into a child
+            # that may outlive it
+            env[constants.TONY_GCS_TOKEN_FILE] = self._gcs_token_file
         cluster = json.loads(self.bootstrap["cluster_spec"])
         # Multi-slice identity: which gang of the job type this host is in
         # (tony.{job}.slices > 1). Index order is slice-major (session.py).
@@ -346,7 +384,10 @@ class TaskExecutor:
         log.info("task %s registering with coordinator %s",
                  self.task_id, self.am_address)
         self.register_and_get_cluster_spec()
-        heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s)
+        token_file = (self._publish_gcs_token()
+                      if os.environ.get(constants.TONY_GCS_TOKEN) else None)
+        heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s,
+                                  gcs_token_file=token_file)
         heartbeater.start()
         if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
             try:

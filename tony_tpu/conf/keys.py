@@ -126,6 +126,17 @@ STAGING_DIR_KEY = "tony.staging.dir"
 # Set by the client when the staging root is remote (gs://): the full job
 # dir was pushed here and slice hosts localize from it.
 REMOTE_JOB_DIR_KEY = "tony.staging.remote-job-dir"
+# Per-job GCS identity (the analog of the reference's per-filesystem
+# delegation tokens — tony.other.namenodes, TonyConfigurationKeys.java:29,
+# fetched in TonyClient.java:509): the client mints a short-lived access
+# token for this service account (gcloud impersonation) and every gsutil
+# call in the job — client staging, coordinator history writes, executor
+# data reads — runs under it instead of ambient host credentials.
+GCS_SERVICE_ACCOUNT_KEY = "tony.gcs.service-account"
+# Renewal period for the scoped token (impersonation tokens expire ~1h):
+# the client re-mints on this cadence and pushes via renewGcsToken; the
+# coordinator fans the replacement out on heartbeat responses.
+GCS_TOKEN_RENEW_MS_KEY = "tony.gcs.token-renew-ms"
 SRC_DIR_KEY = "tony.application.src-dir"                          # "" = no implicit staging
 PYTHON_VENV_KEY = "tony.application.python-venv"
 PYTHON_BINARY_PATH_KEY = "tony.application.python-binary-path"
@@ -192,6 +203,8 @@ DEFAULTS: dict[str, str] = {
     TPU_RETRY_BACKOFF_KEY: "5000",
     STAGING_DIR_KEY: "",
     REMOTE_JOB_DIR_KEY: "",
+    GCS_SERVICE_ACCOUNT_KEY: "",
+    GCS_TOKEN_RENEW_MS_KEY: "2700000",
     SRC_DIR_KEY: "",
     PYTHON_VENV_KEY: "",
     PYTHON_BINARY_PATH_KEY: "",

@@ -38,6 +38,14 @@ PREPROCESSING_JOB = "PREPROCESSING_JOB"
 # shared secret generated at submission, carried to the coordinator and every
 # executor via this env var, and attached to every RPC as gRPC metadata.
 TONY_SECRET = "TONY_SECRET"
+# Short-lived GCS access token for the job's scoped service account
+# (tony.gcs.service-account): rides env only, honored by every GcsStorage
+# subprocess as CLOUDSDK_AUTH_ACCESS_TOKEN.
+TONY_GCS_TOKEN = "TONY_GCS_TOKEN"
+# Path to a file holding the CURRENT token — re-read per storage call, so
+# client-pushed renewals reach user processes that forked before the
+# renewal (env can't change after fork; a file can).
+TONY_GCS_TOKEN_FILE = "TONY_GCS_TOKEN_FILE"
 AUTH_METADATA_KEY = "tony-auth"
 TONY_SECRET_FILE = ".tony-secret"
 

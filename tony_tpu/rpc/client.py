@@ -91,6 +91,10 @@ class ApplicationRpcClient(ApplicationRpc):
             m + "TaskExecutorHeartbeat",
             request_serializer=pb.HeartbeatRequest.SerializeToString,
             response_deserializer=pb.HeartbeatResponse.FromString)
+        self._renew_gcs_token = self._channel.unary_unary(
+            m + "RenewGcsToken",
+            request_serializer=pb.RenewGcsTokenRequest.SerializeToString,
+            response_deserializer=pb.RenewGcsTokenResponse.FromString)
         self._get_status = self._channel.unary_unary(
             m + "GetApplicationStatus",
             request_serializer=pb.GetApplicationStatusRequest.SerializeToString,
@@ -176,12 +180,18 @@ class ApplicationRpcClient(ApplicationRpc):
                           retries=retries)
         return resp.message
 
-    def task_executor_heartbeat(self, task_id: str) -> None:
+    def task_executor_heartbeat(self, task_id: str) -> str:
         # Heartbeats get a tight retry budget: the executor-side heartbeater
         # counts consecutive failures itself (reference: TaskExecutor.java:
-        # 264-268 dies after 5 failed sends).
-        self._call(self._heartbeat, pb.HeartbeatRequest(task_id=task_id),
-                   retries=2)
+        # 264-268 dies after 5 failed sends). Returns the job's current
+        # GCS token ("" when scoping is off) — the renewal fan-out.
+        resp = self._call(self._heartbeat,
+                          pb.HeartbeatRequest(task_id=task_id), retries=2)
+        return resp.gcs_token
+
+    def renew_gcs_token(self, token: str) -> None:
+        self._call(self._renew_gcs_token,
+                   pb.RenewGcsTokenRequest(token=token))
 
     def get_application_status(self) -> ApplicationStatus:
         resp = self._call(self._get_status, pb.GetApplicationStatusRequest())

@@ -116,8 +116,12 @@ class ApplicationRpcServer:
             return pb.FinishApplicationResponse(message=impl.finish_application())
 
         def _heartbeat(req, ctx):
-            impl.task_executor_heartbeat(req.task_id)
-            return pb.HeartbeatResponse()
+            tok = impl.task_executor_heartbeat(req.task_id)
+            return pb.HeartbeatResponse(gcs_token=tok or "")
+
+        def _renew_gcs_token(req, ctx):
+            impl.renew_gcs_token(req.token)
+            return pb.RenewGcsTokenResponse()
 
         def _get_status(req, ctx):
             s = impl.get_application_status()
@@ -132,6 +136,7 @@ class ApplicationRpcServer:
             "RegisterExecutionResult": (_register_result, pb.RegisterExecutionResultRequest),
             "FinishApplication": (_finish, pb.FinishApplicationRequest),
             "TaskExecutorHeartbeat": (_heartbeat, pb.HeartbeatRequest),
+            "RenewGcsToken": (_renew_gcs_token, pb.RenewGcsTokenRequest),
             "GetApplicationStatus": (_get_status, pb.GetApplicationStatusRequest),
         }
         handlers = {

@@ -75,7 +75,16 @@ class ApplicationRpc(abc.ABC):
     def finish_application(self) -> str: ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str) -> None: ...
+    def task_executor_heartbeat(self, task_id: str) -> str:
+        """Record the ping; returns the job's CURRENT GCS access token
+        ("" when credential scoping is off) — the heartbeat doubles as
+        the token-renewal fan-out channel."""
+        ...
+
+    def renew_gcs_token(self, token: str) -> None:
+        """Replace the job's scoped GCS token (client-pushed renewal;
+        impersonation tokens expire ~hourly). Default: ignore — only
+        the coordinator holds job credentials."""
 
     @abc.abstractmethod
     def get_application_status(self) -> ApplicationStatus: ...
