@@ -290,10 +290,13 @@ def _remat_policy(cfg: TransformerConfig):
                      f"expected 'full' or 'dots'")
 
 
-def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
+def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None,
+           ep_axis: str | None = None):
     """One decoder block. x: [B, S, D]; p: this layer's params (unstacked);
     ``rope``: precomputed (cos, sin) tables (derived from positions here
-    when absent)."""
+    when absent). ``ep_axis``: set inside shard_map bodies (the pipeline
+    stage) to run the MoE arm with explicit ep collectives —
+    ``p["w_gate"]/p["w_down"]`` then hold only this rank's experts."""
     b, s, d = x.shape
     if rope is None:
         positions = jnp.broadcast_to(jnp.arange(s), (b, s))
@@ -321,10 +324,20 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None):
     h = rms_norm_reference(x, p["mlp_norm"])
     h = constrain(h, ("batch", "seq", "embed"), mesh, rules)
     if "router" in p:
-        moe_out, metrics = moe_ffn(
-            h, p["router"], p["w_gate"], p["w_down"],
-            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
-            activation=jax.nn.silu)
+        if ep_axis is not None:
+            from tony_tpu.parallel.moe import moe_ffn_manual
+            moe_out, metrics = moe_ffn_manual(
+                h, p["router"], p["w_gate"], p["w_down"],
+                axis_name=ep_axis, num_experts=cfg.num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                activation=jax.nn.silu)
+        else:
+            moe_out, metrics = moe_ffn(
+                h, p["router"], p["w_gate"], p["w_down"],
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                activation=jax.nn.silu)
         aux = metrics.aux_loss
         mlp_out = moe_out
     else:
@@ -367,10 +380,11 @@ def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     if cfg.n_layers % pp:
         raise ValueError(f"n_layers={cfg.n_layers} not divisible into "
                          f"{pp} pipeline stages")
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "MoE + pipeline parallelism is not supported: the gshard "
-            "dispatch needs the ep axis inside the stage body")
+    ep = mesh.shape.get("ep", 1)
+    ep_axis = "ep" if (cfg.num_experts and ep > 1) else None
+    if ep_axis and cfg.num_experts % ep:
+        raise ValueError(f"num_experts={cfg.num_experts} not divisible "
+                         f"over ep={ep}")
     b, s = tokens.shape
     m = cfg.pp_microbatches
     if not m:
@@ -392,24 +406,41 @@ def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     def stage_fn(stage_params, h):
         # runs under shard_map: constrain() inside _block resolves Manual
         # axes to replication (sharding._auto_axes), so the block body is
-        # reused verbatim
+        # reused verbatim; the MoE arm switches to explicit ep collectives
+        # (moe_ffn_manual) because sharding constraints can't reach Manual
+        # axes — expert weights arrive pre-sliced via param_specs below
         hb, hs = h.shape[0], h.shape[1]
         positions = jnp.broadcast_to(jnp.arange(hs), (hb, hs))
         rope = rope_tables(positions, cfg.head_dim)
-        block_fn = functools.partial(_block, cfg=cfg, mesh=None, rules=rules)
+        block_fn = functools.partial(_block, cfg=cfg, mesh=None, rules=rules,
+                                     ep_axis=ep_axis)
         if cfg.remat:
             block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg))
 
-        def body(h, p):
-            h, _ = block_fn(h, p, rope=rope)
-            return h, None
+        def body(carry, p):
+            h, acc = carry
+            h, aux = block_fn(h, p, rope=rope)
+            return (h, acc + aux), None
 
-        h, _ = jax.lax.scan(body, h, stage_params, unroll=cfg.scan_unroll)
-        return h
+        (h, aux), _ = jax.lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                                   stage_params, unroll=cfg.scan_unroll)
+        return h, aux
 
-    x = pipeline_apply(stage_fn, blocks, x, mesh, num_microbatches=m)
+    param_specs = None
+    if ep_axis:
+        # expert-leading leaves ([pp, L/pp, E, ...]) additionally shard E
+        # over ep; everything else stays stage-sharded only. The router
+        # stays replicated — every rank routes identically.
+        from jax.sharding import PartitionSpec as _P
+        param_specs = {
+            k: (_P("pp", None, "ep") if k in ("w_gate", "w_down")
+                else _P("pp"))
+            for k in blocks
+        }
+    x, aux = pipeline_apply(stage_fn, blocks, x, mesh, num_microbatches=m,
+                            with_aux=True, param_specs=param_specs)
     logits = _lm_head(params, x, cfg, mesh, rules)
-    return logits, jnp.zeros((), jnp.float32)
+    return logits, aux
 
 
 def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig,

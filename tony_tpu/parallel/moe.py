@@ -100,3 +100,46 @@ def moe_ffn(x: jax.Array, router_w: jax.Array, w_in: jax.Array,
     out = jnp.einsum("bsec,ebcd->bsd", combine, expert_out)
     out = constrain(out, ("batch", "seq", "embed"))
     return out, metrics
+
+
+def moe_ffn_manual(x: jax.Array, router_w: jax.Array, w_in_local: jax.Array,
+                   w_out_local: jax.Array, *, axis_name: str,
+                   num_experts: int, top_k: int = 2,
+                   capacity_factor: float = 1.25,
+                   activation=jax.nn.gelu) -> tuple[jax.Array, MoEMetrics]:
+    """Expert-parallel MoE with EXPLICIT collectives — the arm for Manual
+    (``shard_map``) contexts, where :func:`moe_ffn`'s sharding constraints
+    can't reach the ``ep`` axis. This is what lets MoE compose with
+    pipeline parallelism: the GPipe stage body runs under shard_map, so
+    the dispatch must speak the bound axis name directly.
+
+    Layout: activations are REPLICATED along ``axis_name`` (the pipeline
+    shards its microbatch over dp only); each rank holds
+    ``num_experts / ep`` experts' weights (``w_in_local`` leads with the
+    local expert count). Routing is computed identically on every rank
+    from the replicated activations, each rank slices its experts'
+    dispatch/combine columns, runs its experts, and the partial combines
+    ``psum`` into the full output — one collective per block. Gradients
+    flow through slice + psum by plain AD (the transposed collective is
+    the identity broadcast).
+    """
+    b, s, d = x.shape
+    e = num_experts
+    e_loc = w_in_local.shape[0]
+    capacity = default_capacity(s, e, top_k, capacity_factor)
+
+    logits = jnp.einsum("bsd,de->bse", x, router_w,
+                        preferred_element_type=jnp.float32)
+    dispatch, combine, metrics = router_dispatch(
+        logits, e, top_k=top_k, capacity=capacity)
+    dispatch = dispatch.astype(x.dtype)
+    combine = combine.astype(x.dtype)
+    rank = lax.axis_index(axis_name)
+    d_loc = lax.dynamic_slice_in_dim(dispatch, rank * e_loc, e_loc, axis=2)
+    c_loc = lax.dynamic_slice_in_dim(combine, rank * e_loc, e_loc, axis=2)
+    expert_in = jnp.einsum("bsec,bsd->ebcd", d_loc, x)
+    h = activation(jnp.einsum("ebcd,edh->ebch", expert_in, w_in_local))
+    expert_out = jnp.einsum("ebch,ehd->ebcd", h, w_out_local)
+    out = lax.psum(jnp.einsum("bsec,ebcd->bsd", c_loc, expert_out),
+                   axis_name)
+    return out, metrics

@@ -26,13 +26,18 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
-                    stage_fn: Callable[[Any, jax.Array], jax.Array],
-                    axis_name: str) -> jax.Array:
+                    stage_fn: Callable[[Any, jax.Array], Any],
+                    axis_name: str, with_aux: bool,
+                    batch_axes: tuple[str, ...]) -> Any:
     """Per-device pipeline body (inside shard_map over ``axis_name``).
 
     stage_params: this stage's params (leading [1, ...] shard dim squeezed).
     microbatches: [M, mb, ...] — replicated input; stage 0 consumes it.
-    Returns [M, mb, ...] final-stage outputs, replicated via psum.
+    Returns [M, mb, ...] final-stage outputs, replicated via psum; with
+    ``with_aux`` the stage_fn returns (out, scalar) and the scalar is
+    accumulated over VALID ticks only (warmup/drain ticks run the stage on
+    garbage state whose aux must not count), summed over stages, and
+    averaged over the batch axes.
     """
     s = lax.axis_size(axis_name)
     stage = lax.axis_index(axis_name)
@@ -40,14 +45,20 @@ def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
     m = microbatches.shape[0]
     state = jnp.zeros_like(microbatches[0])
     outputs = jnp.zeros_like(microbatches)
+    aux0 = jnp.zeros((), jnp.float32)
     shift = [(i, (i + 1) % s) for i in range(s)]
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         # stage 0 ingests microbatch t while t < M; later stages use the
         # activation that arrived from the previous stage last tick
         inp = jnp.where(stage == 0, microbatches[jnp.minimum(t, m - 1)], state)
-        out = stage_fn(params, inp)
+        res = stage_fn(params, inp)
+        out, aux = res if with_aux else (res, aux0)
+        # stage s processes microbatch t-s at tick t; anything else is
+        # pipeline bubble running on zeros/garbage
+        valid = jnp.logical_and(t - stage >= 0, t - stage < m)
+        aux_acc = aux_acc + jnp.where(valid, aux, 0.0)
         # the final stage finishes microbatch t-(S-1) at tick t
         widx = t - (s - 1)
         take = jnp.logical_and(stage == s - 1, widx >= 0)
@@ -55,20 +66,30 @@ def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
         outputs = outputs.at[slot].set(
             jnp.where(take, out, outputs[slot]))
         state = lax.ppermute(out, axis_name, shift)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
-    (_, outputs), _ = lax.scan(tick, (state, outputs),
-                               jnp.arange(m + s - 1, dtype=jnp.int32))
+    (_, outputs, aux_acc), _ = lax.scan(
+        tick, (state, outputs, aux0), jnp.arange(m + s - 1, dtype=jnp.int32))
     # only the last stage holds real outputs; broadcast around the ring so
     # the result is replicated over pp (out_spec P() below)
     mask = (stage == s - 1).astype(outputs.dtype)
-    return lax.psum(outputs * mask, axis_name)
+    outputs = lax.psum(outputs * mask, axis_name)
+    if not with_aux:
+        return outputs
+    # stages sum (each holds different layers), microbatches average (the
+    # /m outside), batch shards average — replicated on every device
+    aux_acc = lax.psum(aux_acc, axis_name)
+    for a in batch_axes:
+        aux_acc = lax.pmean(aux_acc, a)
+    return outputs, aux_acc
 
 
-def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
+def pipeline_apply(stage_fn: Callable[[Any, jax.Array], Any],
                    stacked_params: Any, x: jax.Array, mesh: Mesh, *,
                    num_microbatches: int, axis_name: str = "pp",
-                   batch_axes: tuple[str, ...] = ("dp", "fsdp")) -> jax.Array:
+                   batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+                   with_aux: bool = False,
+                   param_specs: Any = None):
     """Run x through S pipeline stages of ``stage_fn``.
 
     stacked_params: pytree whose leaves lead with the stage axis [S, ...];
@@ -76,6 +97,13 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     x: [B, ...] global batch; must divide into ``num_microbatches``; the
     microbatch dim stays sharded over the live batch axes (dp/fsdp).
     Returns [B, ...] outputs (replicated over pp).
+
+    ``with_aux``: stage_fn returns (out, scalar); the scalars from valid
+    (non-bubble) ticks sum over stages and average over microbatches and
+    batch shards — the MoE load-balance loss channel; returns (out, aux).
+    ``param_specs``: override the default P(pp) per-leaf placement — how
+    MoE expert weights additionally shard over ``ep`` inside the stage
+    (leaves then arrive in the body already sliced to the rank's experts).
     """
     b = x.shape[0]
     if b % num_microbatches:
@@ -85,6 +113,15 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
         # degenerate: no pp axis — run stages sequentially via scan
+        if with_aux:
+            def body_aux(carry, p):
+                h, acc = carry
+                h, aux = stage_fn(p, h)
+                return (h, acc + aux), None
+            (out, aux), _ = lax.scan(
+                body_aux, (x, jnp.zeros((), jnp.float32)), stacked_params)
+            return out, aux
+
         def body(h, p):
             return stage_fn(p, h), None
         out, _ = lax.scan(body, x, stacked_params)
@@ -100,12 +137,18 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], jax.Array],
     live = tuple(a for a in batch_axes
                  if a in mesh.shape and mesh.shape[a] > 1)
     data_spec = P(None, live if len(live) > 1 else (live[0] if live else None))
-    param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     fn = functools.partial(_pipeline_local, stage_fn=stage_fn,
-                           axis_name=axis_name)
+                           axis_name=axis_name, with_aux=with_aux,
+                           batch_axes=live)
     out = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, data_spec),
-        out_specs=data_spec,
+        out_specs=(data_spec, P()) if with_aux else data_spec,
         check_vma=False)(stacked_params, xs)
+    if with_aux:
+        out, aux = out
+        # microbatches average: each tick's aux is a per-microbatch mean
+        return out.reshape((b,) + out.shape[2:]), aux / num_microbatches
     return out.reshape((b,) + out.shape[2:])
