@@ -49,3 +49,34 @@ def test_dryrun_bootstraps_when_devices_insufficient():
         cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr
     assert "BOOTSTRAP_OK" in proc.stdout
+
+
+@pytest.mark.slow
+def test_dryrun_is_self_verifying_against_broken_collective(monkeypatch):
+    """A deliberately wrong shard_map body (a ring that never rotates —
+    each chunk attends only to its local K/V, the canonical missing-
+    collective bug GSPMD can't catch because the result is finite and
+    well-shaped) must FAIL the dryrun's sharded-vs-unsharded comparison,
+    not sail through a finiteness check."""
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    import importlib
+
+    R = importlib.import_module("tony_tpu.parallel.ring_attention")
+
+    def corrupted(q, k, v, axis_name="cp", causal=True, scale=None):
+        # local-only attention: the ppermute hops are "forgotten"
+        return R._single_chunk(q, k, v, causal=causal, scale=scale)
+
+    monkeypatch.setattr(R, "ring_attention_local", corrupted)
+    with pytest.raises(AssertionError, match="loss|grad norm"):
+        graft._dryrun_body(8)
+
+
+@pytest.mark.slow
+def test_dryrun_self_verification_passes_in_process():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+    graft._dryrun_body(8)
