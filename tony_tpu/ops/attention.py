@@ -410,8 +410,12 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
     # Swap to the measured-best backward blocks when they tile the
     # shapes (always true at the power-of-two LM lengths); interpret
     # mode keeps caller blocks so tiny CPU test shapes exercise the
-    # same kernel.
+    # same kernel. The head group is clamped independently of the
+    # forward's: the backward holds 2× f32 kv-block scratch per head,
+    # so the forward's g=16 short-kv choice blows its VMEM (any g=16
+    # implies 8 | bh, so the clamp always divides).
     if not _interpret():
+        g = min(g, 8)
         if sq % _BWD_BQ == 0 and band % _BWD_BQ == 0:
             bq = _BWD_BQ
         if sk % _BWD_BK == 0:
@@ -594,7 +598,10 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
     # [bq, bk] f32 intermediates count twice; 256-wide blocks keep the
     # two-pass kernels inside the ~16 MB VMEM budget (long sequences have
-    # hundreds of grid steps either way).
+    # hundreds of grid steps either way). Same independent head-group
+    # clamp as the fused path (the forward may have picked g=16).
+    if not _interpret():
+        g = min(g, 8)
     if bq > 256 and sq % 256 == 0 and band % 256 == 0:
         bq = 256
         nq = _cdiv(sq, bq)
@@ -795,6 +802,14 @@ def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
         # VMEM reuse and blow the ~16 MB budget by a hair (measured:
         # [256, 1024] at nk=1 is 68 KB over); two kv blocks fit.
         bk = sk // 2
+    if not _interpret() and bk <= 512 and bq > 128 and sq % 128 == 0:
+        # short-kv regime (the wide-kv choice above didn't engage): the
+        # v5e sweep at seq 1k picked 128-row q blocks with a DOUBLE head
+        # group (2.00 ms vs 2.44 for 256×512 g8, vs 2.08 for the old
+        # 512×512 g8) — the narrow stack buys the bigger g, and g is
+        # what amortizes per-step cost when kv blocks can't widen.
+        bq = 128
+        block_h = max(block_h, 16)
     scale = (d ** -0.5) if scale is None else scale
     # fold in f32 and round ONCE: casting the constant itself to bf16
     # would bake a systematic ~0.2% temperature error into every logit

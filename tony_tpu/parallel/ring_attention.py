@@ -171,15 +171,21 @@ def _ring_flash(q, k, v, *, axis_name, causal, scale, cp, q_off):
         q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
     b, s_loc, h, d = q.shape
     blk = _flash_block(s_loc)
+    # narrow-q × wide-kv is the kernels' measured sweet spot (see
+    # ops/attention.py); fall back to the square tiling block for chunk
+    # lengths the preferred shapes don't divide
+    bq = min(256, blk) if s_loc % min(256, blk) == 0 else blk
+    bk = next((w for w in (1024, 512, 256) if w >= blk and s_loc % w == 0),
+              blk)
 
     def full_chunk(q, k, v):
         o, lse = flash_attention_with_lse(q, k, v, causal=False, scale=scale,
-                                          block_q=blk, block_k=blk)
+                                          block_q=bq, block_k=bk)
         return o.astype(jnp.float32), lse
 
     def diag_chunk(q, k, v):
         o, lse = flash_attention_with_lse(q, k, v, causal=True, scale=scale,
-                                          block_q=blk, block_k=blk)
+                                          block_q=bq, block_k=bk)
         return o.astype(jnp.float32), lse
 
     def future_chunk(q, k, v):
