@@ -327,10 +327,29 @@ def _speculative_arm(new: int = 256, k: int = 10):
         ts_s.append((time.perf_counter() - t0) / 3)
     tg = sorted(ts_g)[len(ts_g) // 2]
     tsp = sorted(ts_s)[len(ts_s) // 2]
-    return {"spec_decode_tokens_per_s": round(new / tsp, 1),
-            "greedy_b1_tokens_per_s": round(new / tg, 1),
-            "spec_vs_greedy": round(tg / tsp, 2),
-            "spec_token_match": round(match, 3)}
+    out = {"spec_decode_tokens_per_s": round(new / tsp, 1),
+           "greedy_b1_tokens_per_s": round(new / tg, 1),
+           "spec_vs_greedy": round(tg / tsp, 2),
+           "spec_token_match": round(match, 3)}
+    # batch>1 (min-commit): tokens/round decays toward 1 as per-row
+    # acceptances diverge — recorded so the latency-vs-throughput
+    # trade is measured, not asserted. DISTINCT prompts per row: tiling
+    # one prompt would sync the rows' acceptances and flatter the ratio.
+    b8 = make_data(jax.random.PRNGKey(8), 8, 64)["inputs"]
+    o = spec(p_t, p_d, b8); int(o[0, -1])
+    og = greedy(p_t, b8, rng=jax.random.PRNGKey(0)); int(og.tokens[0, -1])
+    t0 = time.perf_counter()
+    for _ in range(3):
+        o = spec(p_t, p_d, b8)
+    int(o[0, -1])
+    t_s8 = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for i in range(3):
+        og = greedy(p_t, b8, rng=jax.random.PRNGKey(i))
+    int(og.tokens[0, -1])
+    t_g8 = (time.perf_counter() - t0) / 3
+    out["spec_b8_vs_greedy"] = round(t_g8 / t_s8, 2)
+    return out
 
 
 if __name__ == "__main__":

@@ -272,3 +272,27 @@ class TestGQA:
                            rng=jax.random.PRNGKey(0), temperature=0.0)
         np.testing.assert_array_equal(np.asarray(ref.tokens),
                                       np.asarray(out.tokens))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("batch,num_spec", [(4, 3), (3, 2)])
+def test_speculative_device_batched_equals_greedy(batch, num_spec):
+    """Batch > 1 speculation (min-commit: every round commits the
+    smallest per-row acceptance uniformly, so the scalar cache frontier
+    survives) stays token-identical to batched greedy — including rows
+    whose acceptances diverge (distinct random draft forces rejections
+    at different per-row lengths)."""
+    from tony_tpu.models.decode import speculative_generate_device
+
+    params = T.init_params(jax.random.PRNGKey(0), CFG)
+    draft_params = T.init_params(jax.random.PRNGKey(99), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (batch, 6), 0,
+                                CFG.vocab_size)
+    want = generate(params, prompt, CFG, max_new_tokens=9,
+                    rng=jax.random.PRNGKey(0), temperature=0.0)
+    for draft in (params, draft_params):    # self-draft + rejecting draft
+        got = speculative_generate_device(params, draft, prompt, CFG, CFG,
+                                          max_new_tokens=9,
+                                          num_speculative=num_spec)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))

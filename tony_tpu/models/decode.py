@@ -264,10 +264,14 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
     [1, prompt_len + max_new_tokens].
 
     Caveats (measured, not theoretical):
-    - Exactness holds when chunked and single-step logits agree — always in
-      f32 (verified on TPU). In bf16 the chunk-vs-step accumulation order
-      can flip argmax on near-ties, so occasional tokens may differ from
-      plain greedy (both are valid greedy decodes of the model).
+    - Exactness holds when chunked and single-step logits agree exactly.
+      That is true where matmul accumulation is shape-independent (the
+      CPU test path — bit-identical). On TPU, XLA's default matmul
+      precision runs even f32 models through bf16 passes, so chunk vs
+      single-step logits differ by ~1e-2 and a near-tie argmax can flip
+      regardless of dtype: occasional tokens (and everything after the
+      first flip) may differ from plain greedy — both are valid greedy
+      decodes of the model within matmul noise.
     - This is a host-driven reference implementation: each round syncs with
       the device for the acceptance decision, so wall-clock wins require
       low host-device latency (it is NOT faster over remote/tunneled
@@ -360,8 +364,10 @@ def speculative_generate_device(params: dict, draft_params: dict,
     verifies the k+1 chunk in one :func:`extend_step`, and the accepted
     prefix length is a cumulative-product reduction — no host in the
     loop. Output is token-identical to the target model's greedy
-    :func:`generate` (same chunk-vs-step caveat in bf16 as the host
-    version). Batch size 1 (acceptance length is data-dependent per row).
+    :func:`generate` wherever chunked and single-step logits agree
+    (bit-exact on CPU; on TPU the default matmul precision can flip
+    near-tie argmaxes in any dtype — see :func:`speculative_generate`'s
+    caveats). Any batch size — see the min-commit paragraph below.
 
     Measured on one v5e behind a network tunnel (small preset, 256 new
     tokens): this program decodes at ~1.6k tok/s while the host-driven
@@ -370,7 +376,17 @@ def speculative_generate_device(params: dict, draft_params: dict,
     removes. Wall-clock wins over plain :func:`generate` additionally
     require a draft that actually predicts the target (tokens/round ≈
     1 + acceptance·k); with a random draft this is a correctness
-    demonstration, not a speedup.
+    demonstration, not a speedup. ``bench.py``'s arm trains a real
+    draft and records 2.8-2.9× over batch-1 greedy.
+
+    Batch > 1 uses MIN-COMMIT: acceptance length is data-dependent per
+    row, but each round commits ``min_r(acc_r) + 1`` tokens UNIFORMLY —
+    every committed token is still that row's exact target-greedy token
+    (a row's first min+1 tokens are a prefix of its accepted chunk), and
+    the single scalar cache frontier survives unchanged. Rows that
+    accepted more simply re-verify the surplus next round, so expected
+    tokens/round decays toward 1 as batch grows — speculation is a
+    LATENCY tool; batched decode is already throughput-efficient.
 
     Cache discipline (static shapes throughout): the target's stale
     entries from rejected drafts are overwritten by the next round's
@@ -383,8 +399,6 @@ def speculative_generate_device(params: dict, draft_params: dict,
     slice removes.
     """
     b, s = prompt.shape
-    if b != 1:
-        raise ValueError("speculative_generate_device supports batch size 1")
     k = num_speculative
     if k < 1:
         raise ValueError("num_speculative must be >= 1")
@@ -393,41 +407,41 @@ def speculative_generate_device(params: dict, draft_params: dict,
     _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
 
     # new tokens land here; k+1 slack for the final round's overshoot
-    buf0 = jnp.zeros((1, max_new_tokens + k + 1), prompt.dtype)
-    pending0 = jnp.argmax(t_logits, axis=-1)[0].astype(prompt.dtype)
+    buf0 = jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)
+    pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)   # [B]
 
     def round_body(state):
         t_cache, d_cache, buf, n_gen, pending, pos = state
 
-        # draft proposes k tokens; the LAST proposal's K/V is then written
-        # eagerly through the head-free block body (no full-acceptance
-        # backfill branch, and no wasted lm_head projection)
+        # draft proposes k tokens per row; the LAST proposal's K/V is
+        # written eagerly through the head-free block body (no
+        # full-acceptance backfill branch, no wasted lm_head projection)
         def d_step(carry, i):
             tok, cache = carry
-            logits, cache = decode_step(draft_params, tok[None], cache,
+            logits, cache = decode_step(draft_params, tok, cache,
                                         pos + i, draft_cfg)
-            nxt = jnp.argmax(logits, axis=-1)[0].astype(prompt.dtype)
+            nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
             return (nxt, cache), tok
         (last, d_cache), fed = jax.lax.scan(
             d_step, (pending, d_cache), jnp.arange(k))
-        _, d_cache = _blocks_forward(draft_params, last[None, None],
+        _, d_cache = _blocks_forward(draft_params, last[:, None],
                                      d_cache, pos + k, draft_cfg)
-        proposed = jnp.concatenate([fed, last[None]])           # [k+1]
+        proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
         # proposed[0] == pending; drafts are proposed[1:]
-        drafts = proposed[1:]                                   # [k]
+        drafts = proposed[1:]                                   # [k, B]
 
-        chunk = proposed[None, :]                               # [1, k+1]
+        chunk = proposed.T                                      # [B, k+1]
         logits, t_cache = extend_step(params, chunk, t_cache, pos, cfg)
-        argmaxes = jnp.argmax(logits[0], axis=-1).astype(prompt.dtype)
-        # accepted = longest prefix where the draft matched the target
-        matches = (drafts == argmaxes[:k]).astype(jnp.int32)
-        acc = jnp.cumprod(matches).sum()                        # 0..k
-        # committed this round: pending, then the accepted drafts — the
-        # correction token argmaxes[acc] becomes the next round's pending
-        commit = proposed                                       # [k+1]
-        buf = jax.lax.dynamic_update_slice(buf, commit[None], (0, n_gen))
-        new_pending = argmaxes[acc]
-        count = acc + 1
+        argmaxes = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        # per-row accepted = longest prefix where draft matched target
+        matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
+        acc = jnp.cumprod(matches, axis=1).sum(axis=1)          # [B], 0..k
+        # uniform commit: min over rows keeps one scalar cache frontier;
+        # each row's correction token at that length is its next pending
+        count = jnp.min(acc) + 1
+        buf = jax.lax.dynamic_update_slice(buf, chunk, (0, n_gen))
+        new_pending = jax.lax.dynamic_slice_in_dim(
+            argmaxes, count - 1, 1, axis=1)[:, 0]
         n_gen = n_gen + count
         pos = pos + count
         # rollback: stale cache entries past pos are rewritten by the

@@ -284,17 +284,17 @@ def _flash_forward(q, k, v, *, causal, g, bq, bk, band):
 
 # Partial-tensor budget gating the fused backward (the dQ partials are
 # nk × the q tensor size). Overridable: TONY_FLASH_FUSED_PARTIALS_MB.
-# Measured on one v5e (bf16, 8 heads, d64, interleaved A/B with
-# host-value barriers): fused is ~18% faster than two-pass at BOTH seq
-# 8k (b=4, partials at the 512 MB boundary) and seq 16k (b=2, forced
-# past the budget) — raise the knob when HBM has headroom. Set 0 to
-# force two-pass: the fused path stores dQ partials in bf16 (error
+# Measured on one v5e (bf16, 8 heads, d64, xprof device time): with the
+# kv-major layout fused beats two-pass 14.2 vs 17.1 ms at seq 8k b4
+# (512 MB partials) and 26.6 vs 32.6 ms at seq 16k b2 (1 GB partials) —
+# the default covers both; raise further when HBM has headroom. Set 0
+# to force two-pass: the fused path stores dQ partials in bf16 (error
 # ~ √nk·eps_bf16), while two-pass accumulates dQ in f32 VMEM — the
 # knob is the precision escape hatch.
 import os as _os
 
 _FUSED_PARTIALS_BYTES = int(_os.environ.get(
-    "TONY_FLASH_FUSED_PARTIALS_MB", "512")) * 1024 * 1024
+    "TONY_FLASH_FUSED_PARTIALS_MB", "1024")) * 1024 * 1024
 
 # Backward block shape on real TPUs (interpret mode keeps caller blocks
 # so tiny CPU test shapes stay bit-testable): 128-row q blocks × 512-wide
