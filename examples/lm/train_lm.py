@@ -96,6 +96,10 @@ def main() -> int:
                         help="context-parallel attention when the mesh has "
                              "a cp axis: ring (ppermute K/V rotation) or "
                              "ulysses (all-to-all head resharding)")
+    parser.add_argument("--num_experts", type=int, default=0,
+                        help="mixture-of-experts FFN with this many experts "
+                             "(0 = dense); experts shard over the mesh's ep "
+                             "axis, composing with dp/tp/cp/pp")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -107,7 +111,8 @@ def main() -> int:
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        cp_strategy=args.cp_strategy)
+        cp_strategy=args.cp_strategy,
+        num_experts=args.num_experts)
 
     params = shard_pytree(T.init_params(jax.random.PRNGKey(0), cfg),
                           T.logical_axes(cfg), mesh)

@@ -1,18 +1,24 @@
-"""Convert line- or fixed-record data files into TONY1 framed files.
+"""Convert line- or fixed-record data files into splittable record files.
 
-The on-ramp to the framed data feed (tony_tpu/io/framed.py — the
-DataFileWriter analog of the reference's Avro pipeline,
-HdfsAvroFileSplitReader.java): training corpora usually arrive as JSONL /
-text / fixed-size binary records, and framing them buys block-level split
-sync, a schema channel, and variable-length records across multi-host
-splits.
+The on-ramp to the sharded data feed: training corpora usually arrive as
+JSONL / text / fixed-size binary records, and re-framing them buys
+block-level split sync, a schema channel, and variable-length records
+across multi-host splits. Two output containers:
+
+- ``--to framed`` (default): TONY1 (tony_tpu/io/framed.py).
+- ``--to avro``: a spec-conformant Avro object container
+  (tony_tpu/io/avro.py — the DataFileWriter analog of the reference's
+  pipeline, HdfsAvroFileSplitReader.java) holding each record as one
+  ``"bytes"`` datum, with ``--codec null|deflate|snappy`` — readable by
+  any Avro implementation, payload-identical to the input records.
 
     python -m tony_tpu.io.convert corpus-*.jsonl --out-dir framed/
     tony convert corpus.txt --format lines --schema '{"field": "text"}'
+    tony convert corpus.jsonl --to avro --codec snappy
 
-One output file per input (``<name>.tony1`` beside it or under
-``--out-dir``), so the converted corpus shards exactly like the original
-file list.
+One output file per input (``<name>.tony1`` / ``<name>.avro`` beside it
+or under ``--out-dir``), so the converted corpus shards exactly like the
+original file list.
 """
 
 from __future__ import annotations
@@ -23,6 +29,7 @@ import os
 import sys
 from typing import Iterator
 
+from tony_tpu.io.avro import AvroWriter
 from tony_tpu.io.framed import DEFAULT_BLOCK_BYTES, FramedWriter
 
 
@@ -62,13 +69,20 @@ def iter_records(path: str, fmt: str, record_size: int) -> Iterator[bytes]:
 
 def convert_file(src: str, dest: str, fmt: str, schema: dict | str,
                  record_size: int = 0,
-                 block_bytes: int = DEFAULT_BLOCK_BYTES) -> int:
+                 block_bytes: int = DEFAULT_BLOCK_BYTES,
+                 to: str = "framed", codec: str = "null") -> int:
     """Convert one file; returns the number of records written. Writes to
     ``dest + '.tmp'`` and renames, so an interrupted run never leaves a
     half-framed file that readers would reject."""
     tmp = dest + ".tmp"
     try:
-        with FramedWriter(tmp, schema=schema, block_bytes=block_bytes) as w:
+        # avro: each input record rides as one "bytes" datum —
+        # payload-preserving and readable by any Avro implementation
+        writer = (AvroWriter(tmp, "\"bytes\"", codec=codec)
+                  if to == "avro"
+                  else FramedWriter(tmp, schema=schema,
+                                    block_bytes=block_bytes))
+        with writer as w:
             for rec in iter_records(src, fmt, record_size):
                 w.append(rec)
             count = w.records_written
@@ -93,7 +107,8 @@ def default_schema(fmt: str, record_size: int) -> dict:
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="tony-convert",
-        description="Convert data files to the TONY1 framed record format")
+        description="Convert data files to a splittable record container "
+                    "(TONY1 framed, or an Avro object container)")
     parser.add_argument("inputs", nargs="+", help="input data files")
     parser.add_argument("--format", default="jsonl",
                         choices=("jsonl", "lines", "fixed"),
@@ -104,23 +119,41 @@ def main(argv: list[str] | None = None) -> int:
                         help="JSON schema string stored in the file header "
                              "(default: derived from --format)")
     parser.add_argument("--out-dir", default="",
-                        help="write <name>.tony1 here (default: beside "
-                             "each input)")
+                        help="write <name>.tony1 / <name>.avro here "
+                             "(default: beside each input)")
     parser.add_argument("--block-bytes", type=int,
                         default=DEFAULT_BLOCK_BYTES,
                         help="target framed block size")
+    parser.add_argument("--to", default="framed",
+                        choices=("framed", "avro"),
+                        help="output container (default TONY1 framed; avro "
+                             "stores records as 'bytes' datums)")
+    parser.add_argument("--codec", default="null",
+                        choices=("null", "deflate", "snappy"),
+                        help="avro block codec (--to avro only)")
     args = parser.parse_args(argv)
+    if args.codec != "null" and args.to != "avro":
+        parser.error("--codec applies only to --to avro")
+    if args.to == "avro" and args.schema:
+        # the avro container's schema is always '"bytes"' (payload
+        # preservation); silently dropping a user schema would lie
+        parser.error("--schema applies only to --to framed (avro output "
+                     "stores records as 'bytes' datums)")
+    if args.to == "avro" and args.block_bytes != DEFAULT_BLOCK_BYTES:
+        parser.error("--block-bytes applies only to --to framed (the avro "
+                     "writer blocks by record count)")
 
     schema = (json.loads(args.schema) if args.schema
               else default_schema(args.format, args.record_size))
     if args.out_dir:
         os.makedirs(args.out_dir, exist_ok=True)
+    ext = ".avro" if args.to == "avro" else ".tony1"
     dests = []
     for src in args.inputs:
         base = os.path.basename(src)
         stem = base.rsplit(".", 1)[0] if "." in base else base
         out_dir = args.out_dir or os.path.dirname(os.path.abspath(src))
-        dests.append(os.path.join(out_dir, stem + ".tony1"))
+        dests.append(os.path.join(out_dir, stem + ext))
     # Same-stem inputs (a/corpus.jsonl + b/corpus.jsonl with --out-dir, or
     # a.jsonl + a.txt) would silently overwrite each other's output.
     seen: dict[str, str] = {}
@@ -133,7 +166,8 @@ def main(argv: list[str] | None = None) -> int:
     for src, dest in zip(args.inputs, dests):
         n = convert_file(src, dest, args.format, schema,
                          record_size=args.record_size,
-                         block_bytes=args.block_bytes)
+                         block_bytes=args.block_bytes,
+                         to=args.to, codec=args.codec)
         total += n
         print(f"{src} -> {dest}: {n} records")
     print(f"converted {total} records from {len(args.inputs)} file(s)")
