@@ -209,6 +209,97 @@ class TestDecode:
         np.testing.assert_array_equal(np.asarray(got), np.asarray(host))
 
 
+class TestBlockwiseCachedAttention:
+    """Length-aware decode attention: caches >= _BLOCKWISE_MIN_LEN take a
+    block-wise online-softmax path whose executed cost follows the live
+    length, not the padded max_len. It must agree with the dense einsum."""
+
+    def _rand(self, key, b, max_len, kv, h, d, n_q):
+        ks = jax.random.split(jax.random.PRNGKey(key), 3)
+        q = jax.random.normal(ks[0], (b, n_q, h, d), jnp.float32)
+        k_cache = jax.random.normal(ks[1], (b, max_len, kv, d), jnp.float32)
+        v_cache = jax.random.normal(ks[2], (b, max_len, kv, d), jnp.float32)
+        return q, k_cache, v_cache
+
+    @pytest.mark.parametrize("q_start,n_q", [(0, 1), (5, 1), (255, 1),
+                                             (256, 1), (300, 4), (635, 4)])
+    def test_matches_dense(self, q_start, n_q):
+        from tony_tpu.models import decode as D
+        # max_len=640 is NOT a block multiple: the last slice start clamps
+        # and the >= i*block mask must discard the re-read rows
+        q, k_cache, v_cache = self._rand(q_start, 2, 640, 4, 4, 16, n_q)
+        if q_start + n_q > 640:
+            pytest.skip("positions exceed cache")
+        got = D._cached_attention_blockwise(q, k_cache[None], v_cache[None],
+                                            0, jnp.asarray(q_start))
+        b, nq, h, d = q.shape
+        kv = k_cache.shape[2]
+        group = h // kv
+        q_pos = q_start + jnp.arange(nq)
+        k_pos = jnp.arange(640)
+        mask = k_pos[None, :] <= q_pos[:, None]
+        qg = q.reshape(b, nq, kv, group, d)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache) * d ** -0.5
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        want = jnp.einsum("bkgqs,bskd->bqkgd", probs,
+                          v_cache).reshape(b, nq, h, d)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gqa_matches_dense(self):
+        from tony_tpu.models import decode as D
+        q, k_cache, v_cache = self._rand(7, 2, 768, 2, 8, 16, 3)  # group=4
+        got = D._cached_attention_blockwise(q, k_cache[None], v_cache[None],
+                                            0, jnp.asarray(500))
+        b, nq, h, d = q.shape
+        kv, group = 2, 4
+        q_pos = 500 + jnp.arange(nq)
+        mask = jnp.arange(768)[None, :] <= q_pos[:, None]
+        qg = q.reshape(b, nq, kv, group, d)
+        scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache) * d ** -0.5
+        scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        want = jnp.einsum("bkgqs,bskd->bqkgd", probs,
+                          v_cache).reshape(b, nq, h, d)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_decode_step_long_cache_matches_full_forward(self, params):
+        """End to end through the dispatch: a max_len >= 512 cache (block-
+        wise path) still reproduces the training forward's logits."""
+        prompt = jax.random.randint(jax.random.PRNGKey(30), (2, 5), 0,
+                                    CFG.vocab_size)
+        _, cache = prefill(params, prompt, CFG, max_len=600)
+        nxt = jnp.array([3, 7])
+        logits_cached, cache = decode_step(params, nxt, cache,
+                                           cache["length"], CFG)
+        extended = jnp.concatenate([prompt, nxt[:, None]], axis=1)
+        logits_full, _ = T.forward(params, extended, CFG)
+        np.testing.assert_allclose(np.asarray(logits_cached),
+                                   np.asarray(logits_full[:, -1]),
+                                   rtol=3e-4, atol=3e-4)
+
+    @pytest.mark.slow
+    def test_tp_sharded_long_cache_decode(self, params):
+        """The fori_loop + dynamic_slice path must stay correct under tp
+        sharding propagation (cache sharded on the KV-head axis)."""
+        from tony_tpu.parallel import make_mesh, shard_pytree
+        prompt = jax.random.randint(jax.random.PRNGKey(31), (2, 6), 0,
+                                    CFG.vocab_size)
+        _, cache_ref = prefill(params, prompt, CFG, max_len=600)
+        nxt = jnp.array([1, 2])
+        ref, _ = decode_step(params, nxt, cache_ref, cache_ref["length"],
+                             CFG)
+        mesh = make_mesh({"tp": 4, "dp": 2})
+        sharded = shard_pytree(params, T.logical_axes(CFG), mesh)
+        with jax.set_mesh(mesh):
+            _, cache = prefill(sharded, prompt, CFG, max_len=600)
+            got, _ = decode_step(sharded, nxt, cache, cache["length"], CFG)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=3e-4, atol=3e-4)
+
+
 class TestGQA:
     """Grouped-query attention: n_kv_heads < n_heads."""
     GCFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False,
@@ -277,9 +368,9 @@ class TestGQA:
 @pytest.mark.slow
 @pytest.mark.parametrize("batch,num_spec", [(4, 3), (3, 2)])
 def test_speculative_device_batched_equals_greedy(batch, num_spec):
-    """Batch > 1 speculation (min-commit: every round commits the
-    smallest per-row acceptance uniformly, so the scalar cache frontier
-    survives) stays token-identical to batched greedy — including rows
+    """Batch > 1 speculation (per-row cache frontiers: every row commits
+    its OWN acceptance each round; RoPE/mask/K-V writes take [B] position
+    vectors) stays token-identical to batched greedy — including rows
     whose acceptances diverge (distinct random draft forces rejections
     at different per-row lengths)."""
     from tony_tpu.models.decode import speculative_generate_device
@@ -296,3 +387,34 @@ def test_speculative_device_batched_equals_greedy(batch, num_spec):
                                           num_speculative=num_spec)
         np.testing.assert_array_equal(np.asarray(got),
                                       np.asarray(want.tokens))
+
+
+@pytest.mark.slow
+def test_speculative_commit_policies_and_rounds():
+    """Both commit schedules are token-identical to greedy; per-row
+    commits never need MORE rounds than min-commit (self-draft makes the
+    round counts deterministic; a rejecting draft makes them diverge)."""
+    from tony_tpu.models.decode import speculative_generate_device
+
+    params = T.init_params(jax.random.PRNGKey(0), CFG)
+    draft_params = T.init_params(jax.random.PRNGKey(99), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(22), (3, 5), 0,
+                                CFG.vocab_size)
+    want = generate(params, prompt, CFG, max_new_tokens=8,
+                    rng=jax.random.PRNGKey(0), temperature=0.0)
+    for draft in (params, draft_params):
+        toks_pr, rounds_pr = speculative_generate_device(
+            params, draft, prompt, CFG, CFG, max_new_tokens=8,
+            num_speculative=3, commit="per_row", return_rounds=True)
+        toks_mc, rounds_mc = speculative_generate_device(
+            params, draft, prompt, CFG, CFG, max_new_tokens=8,
+            num_speculative=3, commit="min", return_rounds=True)
+        np.testing.assert_array_equal(np.asarray(toks_pr),
+                                      np.asarray(want.tokens))
+        np.testing.assert_array_equal(np.asarray(toks_mc),
+                                      np.asarray(want.tokens))
+        assert int(rounds_pr) <= int(rounds_mc)
+    with pytest.raises(ValueError, match="commit policy"):
+        speculative_generate_device(params, params, prompt, CFG, CFG,
+                                    max_new_tokens=8, num_speculative=3,
+                                    commit="bogus")

@@ -60,27 +60,129 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
             "length": jnp.zeros((), jnp.int32)}
 
 
-def _cached_attention(q, k_cache, v_cache, q_start):
+# Length-aware decode attention: caches at or above this many positions
+# take the block-wise path whose cost scales with the LIVE length
+# (ceil(length/block) blocks) instead of the padded max_len. Below it the
+# dense einsum is both cheaper (no while_loop overhead) and bit-exact
+# against the training forward, which the CPU equivalence tests rely on.
+DECODE_BLOCK = 256
+_BLOCKWISE_MIN_LEN = 2 * DECODE_BLOCK
+
+
+def _q_positions(q_start, b, n_q):
+    """[B, Q] absolute positions for a decode chunk. ``q_start`` may be a
+    scalar (all rows at the same frontier — plain generate) or a [B]
+    vector (per-row frontiers — batched speculative decoding, where each
+    row commits its own acceptance length)."""
+    q_start = jnp.asarray(q_start)
+    if q_start.ndim == 0:
+        q_start = jnp.broadcast_to(q_start, (b,))
+    return q_start[:, None] + jnp.arange(n_q)[None, :]
+
+
+def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
+                                block: int = DECODE_BLOCK):
+    """Online-softmax cached attention reading only the ACTIVE cache
+    blocks. The dense path reads all max_len rows every step — cost
+    scales with the padded buffer, not the tokens generated, which at
+    serving max_len (2k-32k) dominates decode wall-clock. Here a
+    ``fori_loop`` with a traced trip count ``ceil((q_start+K)/block)``
+    walks only blocks that can hold unmasked positions, carrying the
+    standard (running max, normalizer, weighted-value) flash state; the
+    compiled program is static-shape (one [block]-row slice per step)
+    while the executed cost follows the live length.
+
+    Takes the STACKED caches [L, B, max_len, KV, hd] plus this layer's
+    static index ``li`` and slices each block 5-D directly — slicing the
+    layer first (``k_all[li]``) reads loop-invariant in the fori_loop, so
+    XLA hoists and MATERIALIZES the full padded per-layer cache before
+    the loop, re-paying exactly the O(max_len) traffic this path exists
+    to avoid (measured: 5x decode slowdown at max_len 8192).
+
+    Same contract as the dense path: q [B, K, H, hd] at positions
+    q_start..q_start+K-1 (GQA reads its shared K/V head unexpanded),
+    query i attends positions <= q_start+i. Cache-dtype operands with f32
+    accumulation. Numerics are flash-style (running max/rescale) rather
+    than one global softmax, so logits agree with the dense path to
+    normal flash tolerance, not bitwise.
+
+    Trailing partial blocks: ``max_len`` need not divide by ``block`` —
+    the last slice start is clamped (dynamic_slice semantics) and a
+    position-range mask discards the re-read rows."""
+    b, n_q, h, d = q.shape
+    max_len = k_all.shape[2]
+    kv = k_all.shape[3]
+    group = h // kv
+    scale = d ** -0.5
+    q_pos = _q_positions(q_start, b, n_q)                       # [B, Q]
+    qg = q.reshape(b, n_q, kv, group, d)
+    n_active = (jnp.max(q_pos) + block) // block                # traced
+
+    m0 = jnp.full((b, kv, group, n_q), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, kv, group, n_q), jnp.float32)
+    acc0 = jnp.zeros((b, kv, group, n_q, d), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        start = jnp.minimum(i * block, max_len - block)
+        kb = jax.lax.dynamic_slice(
+            k_all, (li, 0, start, 0, 0), (1, b, block, kv, d))[0]
+        vb = jax.lax.dynamic_slice(
+            v_all, (li, 0, start, 0, 0), (1, b, block, kv, d))[0]
+        k_pos = start + jnp.arange(block)                       # [S]
+        # >= i*block drops rows re-read by a clamped trailing slice
+        mask = ((k_pos[None, None, :] >= i * block)
+                & (k_pos[None, None, :] <= q_pos[:, :, None]))  # [B, Q, S]
+        s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kb,
+                       preferred_element_type=jnp.float32) * scale
+        s = jnp.where(mask[:, None, None], s, -jnp.inf)
+        new_m = jnp.maximum(m, s.max(axis=-1))
+        # all-masked (query, block) pairs keep m=-inf; subtract 0 there so
+        # exp(-inf - 0) = 0 instead of exp(nan)
+        safe_m = jnp.where(jnp.isneginf(new_m), 0.0, new_m)
+        alpha = jnp.exp(m - safe_m)                             # -inf -> 0
+        p = jnp.exp(s - safe_m[..., None])
+        l = l * alpha + p.sum(axis=-1)
+        pv = jnp.einsum("bkgqs,bskd->bkgqd", p.astype(v_all.dtype), vb,
+                        preferred_element_type=jnp.float32)
+        acc = acc * alpha[..., None] + pv
+        return new_m, l, acc
+
+    m, l, acc = jax.lax.fori_loop(0, n_active, body, (m0, l0, acc0))
+    o = acc / l[..., None]                  # every query sees position 0
+    o = o.transpose(0, 3, 1, 2, 4).reshape(b, n_q, h, d)
+    return o.astype(q.dtype)
+
+
+def _cached_attention(q, k_all, v_all, li, q_start):
     """q: [B, K, H, hd] holding positions q_start..q_start+K-1; caches:
-    [B, max_len, KV, hd] (KV = H for MHA; KV < H for grouped-query, where
-    each query group reads its shared K/V head WITHOUT materializing a
-    repeated cache — the bandwidth saving is the point of GQA decode).
-    Query i attends cache positions <= q_start+i (causal within the chunk,
-    full history before it). Operands stay in the cache dtype (bf16 on
-    TPU) with f32 accumulation — casting the whole cache to f32 would
-    double the hot loop's HBM traffic and halve MXU throughput."""
+    stacked [L, B, max_len, KV, hd] with ``li`` this layer's static index
+    (KV = H for MHA; KV < H for grouped-query, where each query group
+    reads its shared K/V head WITHOUT materializing a repeated cache —
+    the bandwidth saving is the point of GQA decode). Query i attends
+    cache positions <= q_start+i (causal within the chunk, full history
+    before it). Operands stay in the cache dtype (bf16 on TPU) with f32
+    accumulation — casting the whole cache to f32 would double the hot
+    loop's HBM traffic and halve MXU throughput.
+
+    Large caches (max_len >= ``_BLOCKWISE_MIN_LEN``) dispatch to the
+    length-aware block-wise path so serving cost follows the live length
+    rather than the padded buffer."""
+    max_len = k_all.shape[2]
+    if max_len >= _BLOCKWISE_MIN_LEN:
+        return _cached_attention_blockwise(q, k_all, v_all, li, q_start)
+    k_cache, v_cache = k_all[li], v_all[li]
     b, n_q, h, d = q.shape
     kv = k_cache.shape[2]
     group = h // kv                                  # 1 = plain MHA
     scale = d ** -0.5
-    max_len = k_cache.shape[1]
-    q_pos = q_start + jnp.arange(n_q)                           # [Q]
+    q_pos = _q_positions(q_start, b, n_q)                       # [B, Q]
     k_pos = jnp.arange(max_len)                                 # [S]
-    mask = k_pos[None, :] <= q_pos[:, None]                     # [Q, S]
+    mask = k_pos[None, None, :] <= q_pos[:, :, None]            # [B, Q, S]
     qg = q.reshape(b, n_q, kv, group, d)
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
-    scores = jnp.where(mask[None, None, None], scores, -jnp.inf)
+    scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)                     # f32
     o = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype),
                    v_cache, preferred_element_type=jnp.float32)
@@ -107,9 +209,18 @@ def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
     q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
     # write this chunk into the stacked cache (in place under jit: the
     # pre-update buffer has no later consumer)
-    k_all = jax.lax.dynamic_update_slice(k_all, k[None], (li, 0, pos, 0, 0))
-    v_all = jax.lax.dynamic_update_slice(v_all, v[None], (li, 0, pos, 0, 0))
-    o = _cached_attention(q, k_all[li], v_all[li], pos)
+    pos = jnp.asarray(pos)
+    if pos.ndim == 0:                   # uniform frontier: contiguous slice
+        k_all = jax.lax.dynamic_update_slice(k_all, k[None],
+                                             (li, 0, pos, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(v_all, v[None],
+                                             (li, 0, pos, 0, 0))
+    else:                               # per-row frontiers: unique scatter
+        b_idx = jnp.arange(k.shape[0])[:, None]
+        s_idx = pos[:, None] + jnp.arange(k.shape[1])[None, :]
+        k_all = k_all.at[li, b_idx, s_idx].set(k, unique_indices=True)
+        v_all = v_all.at[li, b_idx, s_idx].set(v, unique_indices=True)
+    o = _cached_attention(q, k_all, v_all, li, pos)
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
@@ -147,7 +258,7 @@ def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
     logits, so paying the lm_head vocab projection there is pure waste)."""
     x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
     b, n_q = tokens.shape
-    positions = jnp.broadcast_to(pos + jnp.arange(n_q), (b, n_q))
+    positions = _q_positions(pos, b, n_q)           # scalar or per-row pos
     rope = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
     # Unrolled layer loop with static per-layer indices — NOT a lax.scan
@@ -347,13 +458,16 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "cfg", "draft_cfg", "max_new_tokens", "num_speculative"))
+    "cfg", "draft_cfg", "max_new_tokens", "num_speculative", "commit",
+    "return_rounds"))
 def speculative_generate_device(params: dict, draft_params: dict,
                                 prompt: jax.Array,
                                 cfg: T.TransformerConfig,
                                 draft_cfg: T.TransformerConfig,
                                 max_new_tokens: int,
-                                num_speculative: int = 4) -> jax.Array:
+                                num_speculative: int = 4,
+                                commit: str = "per_row",
+                                return_rounds: bool = False) -> jax.Array:
     """Greedy speculative decoding as ONE compiled device program.
 
     The host-driven :func:`speculative_generate` syncs with the device
@@ -379,24 +493,33 @@ def speculative_generate_device(params: dict, draft_params: dict,
     demonstration, not a speedup. ``bench.py``'s arm trains a real
     draft and records 2.8-2.9× over batch-1 greedy.
 
-    Batch > 1 uses MIN-COMMIT: acceptance length is data-dependent per
-    row, but each round commits ``min_r(acc_r) + 1`` tokens UNIFORMLY —
-    every committed token is still that row's exact target-greedy token
-    (a row's first min+1 tokens are a prefix of its accepted chunk), and
-    the single scalar cache frontier survives unchanged. Rows that
-    accepted more simply re-verify the surplus next round, so expected
-    tokens/round decays toward 1 as batch grows — speculation is a
-    LATENCY tool; batched decode is already throughput-efficient.
+    Batch > 1 uses PER-ROW CACHE FRONTIERS: acceptance length is
+    data-dependent per row, so the cache ``length`` and every position
+    argument generalize to [B] vectors — RoPE positions, causal masks,
+    and the K/V writes (a unique-index scatter instead of a contiguous
+    slice) all take per-row frontiers. Each row commits its OWN
+    ``acc_r + 1`` tokens per round; no row waits for the batch minimum,
+    so tokens/round does not decay as per-row acceptances diverge (the
+    min-commit design this replaced decayed toward 1 with batch). Rows
+    that reach ``max_new_tokens`` freeze (commit clamped to 0) while the
+    rest finish.
+
+    ``commit="min"`` restores the decayed min-commit schedule (every row
+    commits the batch-minimum acceptance) — kept as the measured baseline
+    for the bench's acceptance sweep, not for production use.
+    ``return_rounds=True`` additionally returns the number of
+    draft→verify rounds executed (tokens/round = the speculation
+    efficiency the sweep records).
 
     Cache discipline (static shapes throughout): the target's stale
     entries from rejected drafts are overwritten by the next round's
-    k+1-wide chunk before any query can reach them (same argument as the
-    host version); the draft runs k+1 steps per round — the last
-    proposal's K/V is written eagerly — so full acceptance needs no
-    backfill branch. The token buffer is written with full k+1-wide
-    unmasked slices: positions past the committed count are garbage that
-    the next round's write (which starts exactly there) or the final
-    slice removes.
+    k+1-wide per-row chunk before any query can reach them (same argument
+    as the host version, applied row-wise); the draft runs k+1 steps per
+    round — the last proposal's K/V is written eagerly — so full
+    acceptance needs no backfill branch. The token buffer is written with
+    full k+1-wide rows at each row's own offset: positions past the
+    committed count are garbage that the next round's write (which starts
+    exactly there) or the final slice removes.
     """
     b, s = prompt.shape
     k = num_speculative
@@ -405,13 +528,28 @@ def speculative_generate_device(params: dict, draft_params: dict,
     max_len = s + max_new_tokens + k + 2
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
     _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
+    # per-row frontiers: vectorize the scalar length prefill produced so
+    # the while_loop state pytree is shape-stable across rounds
+    t_cache = dict(t_cache, length=jnp.full((b,), s, jnp.int32))
+    d_cache = dict(d_cache, length=jnp.full((b,), s, jnp.int32))
 
     # new tokens land here; k+1 slack for the final round's overshoot
+    # (commits clamp so no row's write can start past max_new_tokens)
     buf0 = jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)
     pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)   # [B]
 
+    if commit not in ("per_row", "min"):
+        raise ValueError(f"unknown commit policy {commit!r}")
+
+    def _pos_arg(pos):
+        """Position argument for the decode stack: at batch 1 per-row and
+        uniform frontiers coincide, so hand the cache writers the SCALAR
+        form — the contiguous dynamic_update_slice path instead of the
+        scatter, which measured ~17% slower end-to-end at b1."""
+        return pos[0] if b == 1 else pos
+
     def round_body(state):
-        t_cache, d_cache, buf, n_gen, pending, pos = state
+        t_cache, d_cache, buf, n_gen, pending, pos, rounds = state
 
         # draft proposes k tokens per row; the LAST proposal's K/V is
         # written eagerly through the head-free block body (no
@@ -419,44 +557,57 @@ def speculative_generate_device(params: dict, draft_params: dict,
         def d_step(carry, i):
             tok, cache = carry
             logits, cache = decode_step(draft_params, tok, cache,
-                                        pos + i, draft_cfg)
+                                        _pos_arg(pos) + i, draft_cfg)
+            # keep the carried length [B]-shaped: the scalar-pos fast path
+            # (b==1) returns a scalar length, which would flip the scan
+            # carry's type
+            cache = dict(cache, length=jnp.broadcast_to(
+                cache["length"], (b,)).astype(jnp.int32))
             nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
             return (nxt, cache), tok
         (last, d_cache), fed = jax.lax.scan(
             d_step, (pending, d_cache), jnp.arange(k))
         _, d_cache = _blocks_forward(draft_params, last[:, None],
-                                     d_cache, pos + k, draft_cfg)
+                                     d_cache, _pos_arg(pos) + k, draft_cfg)
         proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
         # proposed[0] == pending; drafts are proposed[1:]
         drafts = proposed[1:]                                   # [k, B]
 
         chunk = proposed.T                                      # [B, k+1]
-        logits, t_cache = extend_step(params, chunk, t_cache, pos, cfg)
+        logits, t_cache = extend_step(params, chunk, t_cache,
+                                      _pos_arg(pos), cfg)
         argmaxes = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         # per-row accepted = longest prefix where draft matched target
         matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
         acc = jnp.cumprod(matches, axis=1).sum(axis=1)          # [B], 0..k
-        # uniform commit: min over rows keeps one scalar cache frontier;
-        # each row's correction token at that length is its next pending
-        count = jnp.min(acc) + 1
-        buf = jax.lax.dynamic_update_slice(buf, chunk, (0, n_gen))
-        new_pending = jax.lax.dynamic_slice_in_dim(
-            argmaxes, count - 1, 1, axis=1)[:, 0]
+        # per-row commit, clamped so finished rows freeze and no write
+        # can overrun the buffer slack
+        committed = jnp.min(acc) if commit == "min" else acc
+        count = jnp.minimum(committed + 1, max_new_tokens - n_gen)  # [B]
+        b_idx = jnp.arange(b)[:, None]
+        buf = buf.at[b_idx, n_gen[:, None] + jnp.arange(k + 1)[None]].set(
+            chunk, unique_indices=True)
+        sel = jnp.clip(count - 1, 0, k)
+        corr = jnp.take_along_axis(argmaxes, sel[:, None], axis=1)[:, 0]
+        new_pending = jnp.where(count > 0, corr, pending)
         n_gen = n_gen + count
         pos = pos + count
-        # rollback: stale cache entries past pos are rewritten by the
-        # next round's chunk before any query reaches them
+        # rollback: stale cache entries past each row's pos are rewritten
+        # by the next round's chunk before any query reaches them
         t_cache = dict(t_cache, length=pos.astype(jnp.int32))
         d_cache = dict(d_cache, length=pos.astype(jnp.int32))
-        return (t_cache, d_cache, buf, n_gen, new_pending, pos)
+        return (t_cache, d_cache, buf, n_gen, new_pending, pos, rounds + 1)
 
     def cond(state):
-        return state[3] < max_new_tokens
+        return jnp.min(state[3]) < max_new_tokens
 
-    state0 = (t_cache, d_cache, buf0, jnp.asarray(0, jnp.int32), pending0,
-              jnp.asarray(s, jnp.int32))
-    _, _, buf, _, _, _ = jax.lax.while_loop(cond, round_body, state0)
-    return jnp.concatenate([prompt, buf[:, :max_new_tokens]], axis=1)
+    state0 = (t_cache, d_cache, buf0,
+              jnp.zeros((b,), jnp.int32), pending0,
+              jnp.full((b,), s, jnp.int32), jnp.asarray(0, jnp.int32))
+    _, _, buf, _, _, _, rounds = jax.lax.while_loop(cond, round_body,
+                                                    state0)
+    tokens = jnp.concatenate([prompt, buf[:, :max_new_tokens]], axis=1)
+    return (tokens, rounds) if return_rounds else tokens
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
