@@ -20,6 +20,20 @@ def main(argv):
         argv = argv[1:]
     verb, args = argv[0], argv[1:]
 
+    # injected per-call latency: lets tests measure the parallel-prefetch
+    # win over a slow link without a real network; the TIME log records
+    # each call's [start, end] so tests can assert fetch OVERLAP directly
+    # (wall-clock ratios flake under CI load; overlap doesn't)
+    lat = os.environ.get("FAKE_GSUTIL_LATENCY_S")
+    time_log = os.environ.get("FAKE_GSUTIL_TIME_LOG")
+    import time
+    t0 = time.time()
+    if lat:
+        time.sleep(float(lat))
+    if time_log:
+        with open(time_log, "a") as f:
+            f.write(f"{verb} {t0:.4f} {time.time():.4f}\n")
+
     # auth observability for the credential-scoping tests: record which
     # identity each call ran under (CLOUDSDK_AUTH_ACCESS_TOKEN is how the
     # real gcloud suite receives an explicit access token)
@@ -27,7 +41,8 @@ def main(argv):
     if auth_log:
         with open(auth_log, "a") as f:
             tok = os.environ.get("CLOUDSDK_AUTH_ACCESS_TOKEN", "AMBIENT")
-            f.write(f"{verb} {tok}\n")
+            target = next((a for a in args if a.startswith("gs://")), "-")
+            f.write(f"{verb} {target} {tok}\n")
 
     if verb == "stat":
         return 0 if os.path.isfile(to_local(args[0])) else 1

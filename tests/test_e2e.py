@@ -1105,6 +1105,86 @@ def test_gcs_service_account_scopes_every_gsutil_call(tmp_path):
         register_storage("gs", None)
 
 
+def test_gcs_multi_identity_scopes_calls_per_bucket(tmp_path):
+    """tony.gcs.service-account with bucket=sa pairs (the list-valued
+    tony.other.namenodes analog, TonyConfigurationKeys.java:29): the job
+    carries ONE token per identity and every gsutil call runs under the
+    token mapped to ITS target bucket — data reads from one project's
+    bucket, staging/history writes to another's, distinct identities."""
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    gcs_root = tmp_path / "gcs"
+    (gcs_root / "bkt-data").mkdir(parents=True)
+    (gcs_root / "bkt-stage").mkdir(parents=True)
+    tokens = np.random.RandomState(0).randint(
+        0, 128, size=(64, 65), dtype=np.int32)
+    (gcs_root / "bkt-data" / "tokens.bin").write_bytes(tokens.tobytes())
+    gsutil_shim = tmp_path / "gsutil"
+    gsutil_shim.write_text(
+        f"#!/bin/bash\nexec {PY} "
+        f"{os.path.join(FIXTURES, '..', 'fake_gsutil.py')} \"$@\"\n")
+    gsutil_shim.chmod(0o755)
+    gcloud_shim = tmp_path / "gcloud"
+    gcloud_shim.write_text(
+        f"#!/bin/bash\nexec {PY} "
+        f"{os.path.join(FIXTURES, '..', 'fake_gcloud.py')} \"$@\"\n")
+    gcloud_shim.chmod(0o755)
+    auth_log = tmp_path / "auth.log"
+
+    os.environ["FAKE_GCS_ROOT"] = str(gcs_root)
+    (tmp_path / "gcloud-state").mkdir()
+    os.environ["FAKE_GCLOUD_ROOT"] = str(tmp_path / "gcloud-state")
+    os.environ["TONY_GSUTIL"] = str(gsutil_shim)
+    os.environ["TONY_GCLOUD"] = str(gcloud_shim)
+    os.environ["FAKE_GSUTIL_AUTH_LOG"] = str(auth_log)
+    from tony_tpu.storage import register_storage
+    try:
+        script = os.path.join(repo, "examples", "lm", "train_lm.py")
+        client = make_client(
+            tmp_path,
+            f"{PY} {script} --steps 10 --batch_size 8 --seq_len 64 "
+            f"--preset tiny --data_files gs://bkt-data/tokens.bin",
+            {"tony.worker.instances": "1",
+             "tony.staging.dir": "gs://bkt-stage/staging",
+             "tony.gcs.service-account":
+                 "bkt-data=data-sa@proj.iam,bkt-stage=stage-sa@proj.iam",
+             "tony.application.mesh": "dp=-1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": "",
+                       "TONY_GSUTIL": str(gsutil_shim),
+                       "FAKE_GCS_ROOT": str(gcs_root),
+                       "FAKE_GSUTIL_AUTH_LOG": str(auth_log)})
+        import json as _json
+        cred = _json.loads(client.gcs_token)
+        assert cred["bkt-data"].startswith("fake-token-for-data-sa@")
+        assert cred["bkt-stage"].startswith("fake-token-for-stage-sa@")
+        assert client.run() == 0
+        calls = [c.split() for c in
+                 auth_log.read_text().strip().splitlines()]
+        assert calls, "no gsutil calls recorded"
+        data_calls = [c for c in calls
+                      if c[1].startswith("gs://bkt-data")]
+        stage_calls = [c for c in calls
+                       if c[1].startswith("gs://bkt-stage")]
+        assert data_calls and stage_calls
+        # EVERY call carried the token of ITS bucket's identity
+        for verb, target, tok in data_calls:
+            assert tok.startswith("fake-token-for-data-sa@"), (
+                verb, target, tok)
+        for verb, target, tok in stage_calls:
+            assert tok.startswith("fake-token-for-stage-sa@"), (
+                verb, target, tok)
+        ambient = [c for c in calls if c[-1] == "AMBIENT"]
+        assert not ambient, f"gsutil ran under ambient creds: {ambient}"
+    finally:
+        for var in ("FAKE_GCS_ROOT", "FAKE_GCLOUD_ROOT", "TONY_GSUTIL",
+                    "TONY_GCLOUD", "FAKE_GSUTIL_AUTH_LOG"):
+            os.environ.pop(var, None)
+        register_storage("gs", None)
+
+
 @pytest.mark.slow
 def test_distributed_moe_lm_trains(tmp_path):
     """Expert parallelism across PROCESSES: 2 workers x 1 CPU device,

@@ -24,8 +24,11 @@ def make_fake_gsutil(tmp_path, monkeypatch) -> str:
     monkeypatch.setenv("FAKE_GCS_ROOT", str(tmp_path / "gcs"))
     (tmp_path / "gcs").mkdir(exist_ok=True)
     gsutil = tmp_path / "gsutil"
+    # -S skips site/sitecustomize: the dev image's sitecustomize drags in
+    # the TPU platform on EVERY interpreter start, which would dominate
+    # each fake call (fake_gsutil.py uses only the stdlib)
     gsutil.write_text(
-        f"#!/bin/bash\nexec {sys.executable} {FAKE_GSUTIL} \"$@\"\n")
+        f"#!/bin/bash\nexec {sys.executable} -S {FAKE_GSUTIL} \"$@\"\n")
     gsutil.chmod(0o755)
     return str(gsutil)
 
@@ -304,6 +307,130 @@ class TestRangedReads:
             assert rest == payload[len(payload) // 2:]
             f.seek(11)                       # second line start
             assert f.readline() == b"line-00001\n"
+
+    def test_parallel_prefetch_overlap_and_bytes(self, tmp_path,
+                                                 monkeypatch):
+        """Sequential gs:// scans keep ``prefetch_depth`` ranged fetches
+        in flight (the DataFetcher-overlap property,
+        HdfsAvroFileSplitReader.java:176 — here against subprocess-per-
+        chunk gsutil). Asserted from the fake's per-call [start, end]
+        timestamps — >= 3 cat fetches genuinely concurrent at depth 4,
+        none at depth 1 — which holds under arbitrary CI load where a
+        wall-clock ratio would flake. Bytes must be identical."""
+        gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+        store = GcsStorage(gsutil=gsutil)
+        store.READ_CHUNK = 4096                      # 16 chunks
+        payload = os.urandom(16 * 4096)
+        store.write_bytes("gs://bucket/big.bin", payload)
+        monkeypatch.setenv("FAKE_GSUTIL_LATENCY_S", "0.15")
+        time_log = tmp_path / "times.log"
+        monkeypatch.setenv("FAKE_GSUTIL_TIME_LOG", str(time_log))
+
+        def scan(depth):
+            store.prefetch_depth = depth
+            time_log.write_text("")
+            chunks = []
+            # production read pattern: the record decoders pull small
+            # reads that the BufferedReader refills one READ_CHUNK at a
+            # time (f.read() whole-file would batch the serial baseline
+            # into DEFAULT_BUFFER_SIZE raw reads instead)
+            with store.open_read("gs://bucket/big.bin") as f:
+                while True:
+                    piece = f.read(2048)
+                    if not piece:
+                        break
+                    chunks.append(piece)
+            spans = [(float(a), float(b)) for verb, a, b in
+                     (l.split() for l in time_log.read_text().splitlines())
+                     if verb == "cat"]
+            # max number of fetches simultaneously in flight
+            events = ([(s, 1) for s, _ in spans]
+                      + [(e, -1) for _, e in spans])
+            live = peak = 0
+            for _, d in sorted(events):
+                live += d
+                peak = max(peak, live)
+            return b"".join(chunks), peak
+
+        data_serial, peak_serial = scan(1)
+        data_par, peak_par = scan(4)
+        assert data_serial == payload and data_par == payload
+        assert peak_serial == 1, peak_serial
+        assert peak_par >= 3, peak_par
+
+    def test_prefetch_probe_reads_stay_small(self, tmp_path, monkeypatch):
+        """A small-buffer header probe must NOT pull prefetch windows —
+        asserted by CALL COUNT (the fake's auth log records every
+        invocation), not wall time, so CI load can't flake it."""
+        gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+        store = GcsStorage(gsutil=gsutil)
+        store.READ_CHUNK = 4096
+        store.write_bytes("gs://bucket/probe.bin", os.urandom(16 * 4096))
+        call_log = tmp_path / "calls.log"
+        monkeypatch.setenv("FAKE_GSUTIL_AUTH_LOG", str(call_log))
+        with store.open_read("gs://bucket/probe.bin", buffer_size=64) as f:
+            head = f.read(64)
+        assert len(head) == 64
+        calls = call_log.read_text().splitlines()
+        # size() (du) + exactly one small ranged read (cat); a leaked
+        # prefetch window would add depth-1 more cat calls
+        assert len([c for c in calls if c.startswith("cat")]) == 1, calls
+        assert len(calls) <= 2, calls
+
+    def test_multi_identity_token_map(self, tmp_path, monkeypatch):
+        """A JSON {bucket: token} credential blob (tony.gcs.service-account
+        with bucket=sa pairs — the list-valued tony.other.namenodes
+        analog) selects the token by each call's target bucket; an
+        unmapped bucket raises instead of leaking ambient credentials."""
+        import json
+
+        gsutil = make_fake_gsutil(tmp_path, monkeypatch)
+        auth_log = tmp_path / "auth.log"
+        monkeypatch.setenv("FAKE_GSUTIL_AUTH_LOG", str(auth_log))
+        blob = json.dumps({"bkt-a": "tok-a", "bkt-b": "tok-b"})
+        st = GcsStorage(gsutil=gsutil, token=blob)
+        st.write_bytes("gs://bkt-a/x", b"1")
+        st.write_bytes("gs://bkt-b/y", b"2")
+        assert st.read_bytes("gs://bkt-a/x") == b"1"
+        calls = [l.split() for l in auth_log.read_text().splitlines()]
+        assert calls
+        for verb, target, tok in calls:
+            if target.startswith("gs://bkt-a"):
+                assert tok == "tok-a", (verb, target, tok)
+            elif target.startswith("gs://bkt-b"):
+                assert tok == "tok-b", (verb, target, tok)
+        with pytest.raises(StorageError, match="no GCS identity"):
+            st.write_bytes("gs://unlisted/z", b"3")
+        # a cross-bucket op spanning two identities cannot run as one
+        # gsutil call under a single token — it must fail loudly
+        with pytest.raises(StorageError, match="DIFFERENT identities"):
+            st.move("gs://bkt-a/x", "gs://bkt-b/moved")
+        # '*' maps the default identity
+        st2 = GcsStorage(gsutil=gsutil,
+                         token=json.dumps({"*": "tok-any"}))
+        st2.write_bytes("gs://whatever/z", b"3")
+        assert auth_log.read_text().splitlines()[-1].endswith("tok-any")
+        # same default identity on both sides: cross-bucket ops fine
+        st2.move("gs://whatever/z", "gs://other/z")
+
+    def test_mint_credential_parses_pairs(self, monkeypatch):
+        """bucket=sa parsing: one mint per DISTINCT account, bad entries
+        rejected at submit time."""
+        from tony_tpu.client.client import _mint_gcs_credential
+        import json
+
+        minted = []
+        monkeypatch.setattr("tony_tpu.client.client._mint_gcs_token",
+                            lambda sa: minted.append(sa) or f"tok:{sa}")
+        blob = _mint_gcs_credential(
+            "bkt-a=sa1@x.iam, bkt-b=sa2@x.iam, gs://bkt-c/=sa1@x.iam")
+        assert json.loads(blob) == {"bkt-a": "tok:sa1@x.iam",
+                                    "bkt-b": "tok:sa2@x.iam",
+                                    "bkt-c": "tok:sa1@x.iam"}
+        assert minted == ["sa1@x.iam", "sa2@x.iam"]   # deduped
+        assert _mint_gcs_credential("solo@x.iam") == "tok:solo@x.iam"
+        with pytest.raises(ValueError, match="bucket=service-account"):
+            _mint_gcs_credential("=sa@x.iam")
 
     def test_sopen_ssize_dispatch(self, tmp_path, monkeypatch):
         from tony_tpu.storage import register_storage, sopen, ssize

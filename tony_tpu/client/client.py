@@ -40,6 +40,44 @@ from tony_tpu.utils.version import inject_version_info
 log = logging.getLogger("tony_tpu.client")
 
 
+def _mint_gcs_credential(spec: str) -> str:
+    """Mint the job's GCS credential from ``tony.gcs.service-account``.
+
+    Two forms (the ``tony.other.namenodes`` analog — the reference carries
+    a LIST of filesystems, each with its own delegation token,
+    TonyConfigurationKeys.java:29, fetched per-namenode in
+    TonyClient.java:509-540):
+
+    * a single service account — one identity for every bucket the job
+      touches (the common case; returns its bare access token), or
+    * comma-separated ``bucket=sa`` pairs (``*`` = default identity) —
+      one token is minted per DISTINCT account and the result is an
+      opaque JSON blob ``{bucket: token}``. The blob rides the exact
+      same plumbing as a bare token (env var → RPC renew push →
+      heartbeat fan-out → executor token file); only GcsStorage
+      interprets it, selecting by each call's target bucket.
+    """
+    if "=" not in spec:
+        return _mint_gcs_token(spec)
+    per_sa: dict[str, str] = {}
+    cred: dict[str, str] = {}
+    for pair in spec.split(","):
+        pair = pair.strip()
+        if not pair:
+            continue
+        bucket, _, sa = pair.partition("=")
+        bucket = bucket.strip().removeprefix("gs://").strip("/")
+        sa = sa.strip()
+        if not bucket or not sa:
+            raise ValueError(
+                f"bad tony.gcs.service-account entry {pair!r}; expected "
+                f"'bucket=service-account' (or a single service account)")
+        if sa not in per_sa:
+            per_sa[sa] = _mint_gcs_token(sa)
+        cred[bucket] = per_sa[sa]
+    return json.dumps(cred)
+
+
 def _mint_gcs_token(service_account: str) -> str:
     """Short-lived access token via gcloud impersonation — the client's
     delegation-token fetch (reference TonyClient.java:509). Requires the
@@ -128,9 +166,11 @@ class TonyClient:
         # under the job identity, never ambient host credentials. Rides
         # env only (like the secret), persisted 0600 for tooling.
         self.gcs_token: str | None = None
+        self.gcs_token_minted_at: float = 0.0
         gcs_sa = conf.get(K.GCS_SERVICE_ACCOUNT_KEY)
         if gcs_sa:
-            self.gcs_token = _mint_gcs_token(gcs_sa)
+            self.gcs_token = _mint_gcs_credential(gcs_sa)
+            self.gcs_token_minted_at = time.monotonic()
             storage.register_storage(
                 "gs", storage.GcsStorage(token=self.gcs_token))
         # Per-job TLS (rpc/tls.py): cert generated in stage(), paths set
@@ -304,7 +344,12 @@ class TonyClient:
         started = time.monotonic()
         renew_s = self.conf.get_int(K.GCS_TOKEN_RENEW_MS_KEY,
                                     2_700_000) / 1000.0
-        next_renew = started + renew_s
+        # anchor the cadence to MINT time, not monitor() start: tokens
+        # expire ~1h after minting, and staging/launch before monitor()
+        # (plus any stretch where rpc is not yet connected) counts
+        # against that budget — the `now >= next_renew` check below then
+        # renews immediately once the rpc comes up late
+        next_renew = (self.gcs_token_minted_at or started) + renew_s
         while True:
             time.sleep(self.POLL_PERIOD_S)
             if (self.gcs_token and self.rpc is not None
@@ -344,13 +389,16 @@ class TonyClient:
         its own expiry, and the caller retries on a short fuse."""
         sa = self.conf.get(K.GCS_SERVICE_ACCOUNT_KEY)
         try:
-            token = _mint_gcs_token(sa)
+            # multi-identity specs re-mint EVERY identity on the same
+            # cadence (one blob, one push)
+            token = _mint_gcs_credential(sa)
             self.rpc.renew_gcs_token(token)
         except Exception:
             log.warning("GCS token renewal failed (will retry shortly)",
                         exc_info=True)
             return False
         self.gcs_token = token
+        self.gcs_token_minted_at = time.monotonic()
         os.environ[constants.TONY_GCS_TOKEN] = token
         storage.register_storage(
             "gs", storage.GcsStorage(token=token))

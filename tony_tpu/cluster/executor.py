@@ -174,7 +174,11 @@ class TaskExecutor:
         """Write the current GCS token to this task's local token file
         (0600) and return its path; the heartbeater atomically rewrites
         it when the client pushes a renewal."""
-        path = os.path.join(os.getcwd(), f".gcs-token-{self.task_index}")
+        # job_name in the filename: executors of different job types with
+        # the same index can share a working directory without contending
+        # on one file
+        path = os.path.join(
+            os.getcwd(), f".gcs-token-{self.job_name}-{self.task_index}")
         fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_TRUNC, 0o600)
         with os.fdopen(fd, "w") as f:
             f.write(os.environ.get(constants.TONY_GCS_TOKEN, ""))

@@ -132,6 +132,12 @@ REMOTE_JOB_DIR_KEY = "tony.staging.remote-job-dir"
 # token for this service account (gcloud impersonation) and every gsutil
 # call in the job — client staging, coordinator history writes, executor
 # data reads — runs under it instead of ambient host credentials.
+# Either ONE service account (a single identity for every bucket) or
+# comma-separated "bucket=sa" pairs ("*" = default identity) — the
+# reference's namenode LIST, one delegation token per filesystem: a job
+# can read data from one project's bucket and write history to another's
+# under distinct identities; calls to a bucket with no mapped identity
+# fail rather than fall back to ambient credentials.
 GCS_SERVICE_ACCOUNT_KEY = "tony.gcs.service-account"
 # Renewal period for the scoped token (impersonation tokens expire ~1h):
 # the client re-mints on this cadence and pushes via renewGcsToken; the

@@ -20,6 +20,7 @@ the binary with ``TONY_GSUTIL``.
 from __future__ import annotations
 
 import io
+import json
 import os
 import re
 import shutil
@@ -267,14 +268,36 @@ class _GcsRangedReader(io.RawIOBase):
     """Seekable raw stream over ranged GCS reads. Wrapped in a
     ``BufferedReader`` by :meth:`GcsStorage.open_read`, which turns the
     data feed's byte-at-a-time parsing into chunk-sized ``readinto``
-    calls — one gsutil invocation per ~4 MB of sequential scan."""
+    calls — one gsutil invocation per ~4 MB of sequential scan.
 
-    def __init__(self, storage: "GcsStorage", path: str) -> None:
+    Sequential scans additionally PREFETCH: scan-sized reads (>= one
+    READ_CHUNK) keep a window of ``depth`` chunk fetches in flight on a
+    thread pool, so a TPU-rate consumer is not gated on one serial gsutil
+    fork per chunk (the reference's DataFetcher thread overlapped reads
+    the same way against its HDFS client,
+    HdfsAvroFileSplitReader.java:176 — here each fetch is a subprocess,
+    so overlap needs N of them). Small reads (header/magic probes through
+    a small ``buffer_size``) bypass the window and fetch exactly what was
+    asked. Memory bound: depth x READ_CHUNK."""
+
+    def __init__(self, storage: "GcsStorage", path: str,
+                 depth: int | None = None) -> None:
         super().__init__()
         self._storage = storage
         self._path = path
         self._pos = 0
         self._size = storage.size(path)
+        self._depth = storage.prefetch_depth if depth is None else depth
+        self._futures: dict[int, object] = {}    # chunk index -> Future
+        self._pool = None
+
+    def _chunk_future(self, j: int, c: int):
+        fut = self._futures.get(j)
+        if fut is None:
+            fut = self._pool.submit(self._storage.read_range, self._path,
+                                    j * c, min(c, self._size - j * c))
+            self._futures[j] = fut
+        return fut
 
     def readable(self) -> bool:
         return True
@@ -306,10 +329,44 @@ class _GcsRangedReader(io.RawIOBase):
         if self._pos >= self._size:
             return 0
         n = min(len(b), self._size - self._pos)
-        data = self._storage.read_range(self._path, self._pos, n)
-        b[:len(data)] = data
-        self._pos += len(data)
-        return len(data)
+        c = self._storage.READ_CHUNK
+        if self._depth <= 1 or len(b) < c:
+            # serial path: probes and depth-1 configs fetch exactly n
+            data = self._storage.read_range(self._path, self._pos, n)
+            b[:len(data)] = data
+            self._pos += len(data)
+            return len(data)
+        if self._pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._pool = ThreadPoolExecutor(
+                max_workers=self._depth,
+                thread_name_prefix="tony-gcs-prefetch")
+        i = self._pos // c
+        last = (self._size - 1) // c
+        # evict chunks behind the cursor or beyond the window (seeks);
+        # cancel() is best-effort — a running fetch just gets discarded
+        for j in list(self._futures):
+            if j < i or j >= i + self._depth:
+                self._futures.pop(j).cancel()
+        for j in range(i, min(i + self._depth, last + 1)):
+            self._chunk_future(j, c)
+        data = self._chunk_future(i, c).result()
+        start = self._pos - i * c
+        out = data[start:start + n]       # serve from chunk i only; the
+        if start + len(out) >= len(data):  # BufferedReader loops on short
+            self._futures.pop(i, None)     # reads
+        b[:len(out)] = out
+        self._pos += len(out)
+        return len(out)
+
+    def close(self) -> None:
+        for fut in self._futures.values():
+            fut.cancel()
+        self._futures.clear()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+        super().close()
 
 
 class GcsStorage(Storage):
@@ -332,15 +389,28 @@ class GcsStorage(Storage):
         #: and executors without any byte of it touching the bucket
         self.token = token
         self._size_cache: dict[str, tuple[int, float]] = {}
+        #: concurrent ranged fetches per open_read stream (sequential-scan
+        #: prefetch window); 1 disables the pool entirely
+        self.prefetch_depth = max(1, int(
+            os.environ.get("TONY_GCS_PREFETCH_DEPTH", "4")))
 
     # -- plumbing ----------------------------------------------------------
-    def _env(self) -> dict[str, str] | None:
+    def _env(self, args: tuple = ()) -> dict[str, str] | None:
         """Subprocess env: inject the job's scoped token (gcloud-suite
         tools honor CLOUDSDK_AUTH_ACCESS_TOKEN over ambient credentials);
         None → inherit, keeping the ambient-credential default. A token
         FILE wins over the env value — it is re-read per call, so
         client-pushed renewals (executor heartbeat republishing) reach
-        processes that forked before the renewal."""
+        processes that forked before the renewal.
+
+        The credential may be a JSON ``{bucket: token}`` blob
+        (multi-identity jobs, ``tony.gcs.service-account`` with
+        ``bucket=sa`` pairs — the list-valued ``tony.other.namenodes``
+        analog): the token is then selected by this CALL's target bucket
+        (first gs:// argument), ``*`` as the fallback identity. A bucket
+        with no mapped identity is a configuration error and raises —
+        silently falling back to ambient credentials would defeat the
+        per-job identity scoping."""
         tok = self.token
         if not tok:
             tok_file = os.environ.get("TONY_GCS_TOKEN_FILE")
@@ -354,6 +424,35 @@ class GcsStorage(Storage):
             tok = os.environ.get("TONY_GCS_TOKEN")
         if not tok:
             return None
+        if tok.lstrip().startswith("{"):
+            try:
+                mapping = json.loads(tok)
+            except ValueError:
+                mapping = None
+            if isinstance(mapping, dict):
+                buckets = {a[len("gs://"):].split("/", 1)[0]
+                           for a in args
+                           if isinstance(a, str) and a.startswith("gs://")}
+                toks = set()
+                for bucket in buckets or {""}:
+                    t = mapping.get(bucket) or mapping.get("*")
+                    if not t:
+                        raise StorageError(
+                            f"no GCS identity mapped for bucket "
+                            f"{bucket!r} (tony.gcs.service-account lists "
+                            f"{sorted(mapping)}; add '{bucket}=sa' or a "
+                            f"'*=sa' default)")
+                    toks.add(t)
+                if len(toks) > 1:
+                    # a single gsutil call runs under ONE identity; a
+                    # cross-bucket op spanning two would silently act on
+                    # the second bucket as the first's identity — make
+                    # the caller copy through read+write instead
+                    raise StorageError(
+                        f"one call touches buckets {sorted(buckets)} "
+                        f"mapped to DIFFERENT identities; split the "
+                        f"operation per bucket")
+                tok = toks.pop()
         return {**os.environ, "CLOUDSDK_AUTH_ACCESS_TOKEN": tok}
 
     def _run(self, *args: str, input_bytes: bytes | None = None,
@@ -362,7 +461,7 @@ class GcsStorage(Storage):
             proc = subprocess.run(
                 [self.gsutil, "-q", *args], input=input_bytes,
                 stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                env=self._env(), timeout=self.timeout_s)
+                env=self._env(args), timeout=self.timeout_s)
         except subprocess.TimeoutExpired as e:
             raise StorageError(
                 f"{self.gsutil} {' '.join(args)} timed out after "
@@ -381,7 +480,7 @@ class GcsStorage(Storage):
             proc = subprocess.run(
                 [self.gsutil, "-q", *args],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-                env=self._env(), timeout=self.timeout_s)
+                env=self._env(args), timeout=self.timeout_s)
         except subprocess.TimeoutExpired as e:
             raise StorageError(
                 f"{self.gsutil} {' '.join(args)} timed out after "
@@ -394,7 +493,7 @@ class GcsStorage(Storage):
             proc = subprocess.run(
                 [self.gsutil, "-q", "ls", pattern],
                 stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                env=self._env(), timeout=self.timeout_s)
+                env=self._env(("ls", pattern)), timeout=self.timeout_s)
         except subprocess.TimeoutExpired as e:
             raise StorageError(
                 f"{self.gsutil} ls {pattern} timed out after "
@@ -482,20 +581,31 @@ class GcsStorage(Storage):
     #: that a header probe doesn't pull the whole object
     READ_CHUNK = 4 * 1024 * 1024
 
+    def _invalidate_size(self, *paths: str) -> None:
+        """Drop cached stat results for mutated objects — a process that
+        overwrites an object and sizes it within the TTL (split math right
+        after staging/convert) must see the new size, not the cached one."""
+        for p in paths:
+            self._size_cache.pop(p, None)
+
     def write_bytes(self, path: str, data: bytes) -> None:
         self._run("cp", "-", path, input_bytes=data)
+        self._invalidate_size(path)
 
     def open_append(self, path: str):
         return _GcsAppendStream(self, path)
 
     def move(self, src: str, dst: str) -> None:
         self._run("mv", src, dst)
+        self._invalidate_size(src, dst)
 
     def remove(self, path: str) -> None:
         self._run("rm", path)
+        self._invalidate_size(path)
 
     def put(self, local_path: str, path: str) -> None:
         self._run("cp", local_path, path)
+        self._invalidate_size(path)
 
     def get(self, path: str, local_path: str) -> None:
         os.makedirs(os.path.dirname(local_path) or ".", exist_ok=True)
@@ -504,6 +614,7 @@ class GcsStorage(Storage):
     def put_tree(self, local_dir: str, path: str) -> None:
         # rsync -r preserves relative layout on repeated stagings
         self._run("rsync", "-r", local_dir.rstrip("/"), path.rstrip("/"))
+        self._size_cache.clear()    # a prefix-wide write: anything under it
 
     def get_tree(self, path: str, local_dir: str) -> None:
         os.makedirs(local_dir, exist_ok=True)
