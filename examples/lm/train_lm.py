@@ -100,6 +100,12 @@ def main() -> int:
                         help="mixture-of-experts FFN with this many experts "
                              "(0 = dense); experts shard over the mesh's ep "
                              "axis, composing with dp/tp/cp/pp")
+    parser.add_argument("--pp_schedule", default="gpipe",
+                        choices=("gpipe", "1f1b"),
+                        help="pipeline schedule when the mesh has a pp "
+                             "axis: gpipe (default) or 1f1b (O(pp) live "
+                             "microbatch activations instead of O(M) — "
+                             "for deep pipelines / many microbatches)")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -112,13 +118,22 @@ def main() -> int:
     cfg = T.PRESETS[args.preset].scaled(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         cp_strategy=args.cp_strategy,
-        num_experts=args.num_experts)
+        num_experts=args.num_experts,
+        pp_schedule=args.pp_schedule)
 
     params = shard_pytree(T.init_params(jax.random.PRNGKey(0), cfg),
                           T.logical_axes(cfg), mesh)
     opt = default_optimizer(lr=args.lr, total_steps=args.steps)
-    step_fn = make_train_step(lambda p, b: T.lm_loss(p, b, cfg, mesh),
-                              opt, mesh)
+    if cfg.pp_schedule == "1f1b" and mesh.shape.get("pp", 1) > 1:
+        # 1F1B produces its own gradients (the loss head runs inside the
+        # pipeline) — it plugs in through the value_and_grad hook
+        step_fn = make_train_step(
+            None, opt, mesh,
+            value_and_grad_fn=lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))
+    else:
+        step_fn = make_train_step(lambda p, b: T.lm_loss(p, b, cfg, mesh),
+                                  opt, mesh)
 
     mgr = (CheckpointManager(args.ckpt_dir,
                              save_interval_steps=args.ckpt_every)

@@ -458,6 +458,101 @@ class TestPipelineTransformer:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=3e-5, err_msg=str(path))
 
+    def test_1f1b_loss_and_grads_match_unpipelined(self, setup):
+        """The 1F1B schedule (explicit-vjp pipeline, O(pp) live
+        activations) reproduces the unsharded model's loss AND full
+        gradient pytree."""
+        T, shard_pytree, cfg, params, batch, ref_loss = setup
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        sp = shard_pytree(params, T.logical_axes(cfg), mesh)
+        g_ref = jax.grad(lambda p: T.lm_loss(p, batch, cfg, None))(params)
+        with jax.set_mesh(mesh):
+            loss, g = jax.jit(lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        flat_ref, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+        for (path, a), b in zip(flat_ref, jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5, err_msg=str(path))
+
+    def test_1f1b_matches_gpipe_grads(self, setup):
+        """Same mesh, same microbatching: the two schedules must agree on
+        loss and gradients (they compute the same math in a different
+        order)."""
+        T, shard_pytree, cfg, params, batch, _ = setup
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        sp = shard_pytree(params, T.logical_axes(cfg), mesh)
+        with jax.set_mesh(mesh):
+            l_gp, g_gp = jax.jit(jax.value_and_grad(
+                lambda p: T.lm_loss(p, batch, cfg, mesh)))(sp)
+            l_1f, g_1f = jax.jit(lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(l_1f), float(l_gp), rtol=1e-6)
+        for a, b in zip(jax.tree.leaves(g_gp), jax.tree.leaves(g_1f)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5)
+
+    def test_1f1b_pp4_deep_schedule(self, setup):
+        """pp=4 with M > S microbatches exercises warmup, steady 1F1B
+        cadence, and cooldown on every stage."""
+        T, shard_pytree, cfg, params, batch, _ = setup
+        cfg4 = cfg.scaled(n_layers=4, pp_microbatches=8)
+        params4 = T.init_params(jax.random.PRNGKey(3), cfg4)
+        ref_loss, g_ref = jax.value_and_grad(
+            lambda p: T.lm_loss(p, batch, cfg4, None))(params4)
+        mesh = make_mesh({"pp": 4, "dp": 2})
+        sp = shard_pytree(params4, T.logical_axes(cfg4), mesh)
+        with jax.set_mesh(mesh):
+            loss, g = jax.jit(lambda p, b: T.lm_value_and_grad(
+                p, b, cfg4, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        flat_ref, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+        for (path, a), b in zip(flat_ref, jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5, err_msg=str(path))
+
+    def test_1f1b_degenerate_no_pp_axis(self, setup):
+        """Without a pp axis the same entry point falls back to plain AD
+        and still matches the reference."""
+        T, shard_pytree, cfg, params, batch, ref_loss = setup
+        mesh = make_mesh({"dp": 8})
+        sp = shard_pytree(params, T.logical_axes(cfg), mesh)
+        with jax.set_mesh(mesh):
+            loss, g = jax.jit(lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        g_ref = jax.grad(lambda p: T.lm_loss(p, batch, cfg, None))(params)
+        for a, b in zip(jax.tree.leaves(g_ref), jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5)
+
+    def test_1f1b_train_step_reduces_loss(self, setup):
+        from tony_tpu.models.train import (default_optimizer, init_state,
+                                           make_train_step)
+        T, shard_pytree, cfg, params, batch, _ = setup
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        sp = shard_pytree(jax.tree.map(jnp.copy, params),
+                          T.logical_axes(cfg), mesh)
+        opt = default_optimizer(lr=1e-3)
+        state = init_state(sp, opt)
+        step = make_train_step(
+            None, opt, mesh,
+            value_and_grad_fn=lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))
+        state, m0 = step(state, batch)
+        for _ in range(3):
+            state, m = step(state, batch)
+        assert float(m["loss"]) < float(m0["loss"])
+        assert bool(jnp.isfinite(m["grad_norm"]))
+
+    def test_1f1b_moe_rejected(self, setup):
+        T, shard_pytree, cfg, params, batch, _ = setup
+        mcfg = cfg.scaled(num_experts=4, pp_schedule="1f1b")
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        with pytest.raises(NotImplementedError, match="1f1b"):
+            T.lm_value_and_grad(T.init_params(jax.random.PRNGKey(9), mcfg),
+                                batch, mcfg, mesh)
+
     @pytest.mark.slow
     def test_pp_train_step_reduces_loss(self, setup):
         from tony_tpu.models.train import (default_optimizer, init_state,
@@ -531,6 +626,21 @@ class TestPipelineTransformer:
             state, m = step(state, batch)
         assert float(m["loss"]) < float(m0["loss"])
         assert bool(jnp.isfinite(m["grad_norm"]))
+
+    def test_pp_moe_without_ep_axis_matches_unpipelined(self, setup):
+        """MoE + pipeline on a mesh with NO ep axis: the stage body takes
+        the GSPMD-constraint dispatch (moe_ffn) with expert weights
+        replicated across pp ranks, relying on constrain's Manual-axes
+        fallback inside shard_map — previously an untested configuration
+        (round-4 advisor finding)."""
+        T, shard_pytree, cfg, params, batch, _ = setup
+        mcfg = cfg.scaled(num_experts=4)
+        mparams = T.init_params(jax.random.PRNGKey(5), mcfg)
+        ref = float(T.lm_loss(mparams, batch, mcfg, None))
+        mesh = make_mesh({"pp": 2, "dp": 4})
+        sp = shard_pytree(mparams, T.logical_axes(mcfg), mesh)
+        loss = jax.jit(lambda p, b: T.lm_loss(p, b, mcfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), ref, rtol=2e-3)
 
     def test_pp_moe_indivisible_experts_raises(self, setup):
         T, shard_pytree, cfg, params, batch, _ = setup
@@ -681,6 +791,87 @@ class TestUlyssesAttention:
         np.testing.assert_allclose(
             ulysses_attention(q, k, v, mesh, causal=True),
             _dense_attention(q, k, v, True), atol=2e-5)
+
+    @pytest.fixture(scope="class")
+    def gqa_qkv(self):
+        r = np.random.RandomState(7)
+        q = jnp.asarray(r.randn(2, 32, 8, 16), jnp.float32)
+        k = jnp.asarray(r.randn(2, 32, 4, 16), jnp.float32)   # 2 groups
+        v = jnp.asarray(r.randn(2, 32, 4, 16), jnp.float32)
+        return q, k, v
+
+    def _gqa_dense(self, q, k, v, causal=True):
+        rep = q.shape[2] // k.shape[2]
+        return _dense_attention(q, jnp.repeat(k, rep, axis=2),
+                                jnp.repeat(v, rep, axis=2), causal)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_gqa_unexpanded_matches_dense(self, gqa_qkv, causal,
+                                          monkeypatch):
+        """kv heads divide cp: K/V ride the all-to-alls UNEXPANDED — the
+        local body must receive H_kv-wide K/V (the payload assertion) and
+        still compute the grouped attention exactly."""
+        import tony_tpu.parallel.ulysses as U
+        q, k, v = gqa_qkv
+        seen = []
+        orig = U.ulysses_attention_local
+
+        def spy(q, k, v, **kw):
+            seen.append(k.shape)
+            return orig(q, k, v, **kw)
+
+        monkeypatch.setattr(U, "ulysses_attention_local", spy)
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        out = U.ulysses_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(out, self._gqa_dense(q, k, v, causal),
+                                   atol=2e-5)
+        # local shard saw [B, S/cp, H_kv, D] — unexpanded (4 kv heads,
+        # not 8): the inter-chip K/V payload is H/H_kv x smaller
+        assert seen and seen[0][2] == 4, seen
+
+    @pytest.mark.slow
+    def test_gqa_unexpanded_grads_match_dense(self, gqa_qkv):
+        from tony_tpu.parallel import ulysses_attention
+        q, k, v = gqa_qkv
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        g = jax.grad(lambda *a: ulysses_attention(*a, mesh).sum(),
+                     argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: self._gqa_dense(*a).sum(),
+                      argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=2e-5)
+
+    def test_gqa_indivisible_kv_expands(self, monkeypatch):
+        """kv heads that cannot split over cp (2 % 4 != 0) expand to full
+        width — correctness over the payload saving."""
+        import tony_tpu.parallel.ulysses as U
+        r = np.random.RandomState(8)
+        q = jnp.asarray(r.randn(2, 32, 8, 16), jnp.float32)
+        k = jnp.asarray(r.randn(2, 32, 2, 16), jnp.float32)
+        v = jnp.asarray(r.randn(2, 32, 2, 16), jnp.float32)
+        seen = []
+        orig = U.ulysses_attention_local
+
+        def spy(q, k, v, **kw):
+            seen.append(k.shape)
+            return orig(q, k, v, **kw)
+
+        monkeypatch.setattr(U, "ulysses_attention_local", spy)
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        out = U.ulysses_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(out, self._gqa_dense(q, k, v, True),
+                                   atol=2e-5)
+        assert seen and seen[0][2] == 8, seen    # expanded
+
+    def test_gqa_unexpanded_matches_ring(self, gqa_qkv):
+        """Both cp strategies agree on grouped-query attention with
+        unexpanded K/V."""
+        from tony_tpu.parallel import ring_attention, ulysses_attention
+        q, k, v = gqa_qkv
+        mesh = make_mesh({"dp": 2, "cp": 4})
+        np.testing.assert_allclose(
+            ulysses_attention(q, k, v, mesh, causal=True),
+            ring_attention(q, k, v, mesh, causal=True), atol=2e-5)
 
     def test_indivisible_heads_rejected(self):
         from tony_tpu.parallel import ulysses_attention

@@ -63,15 +63,22 @@ def init_state(params: Any, optimizer: optax.GradientTransformation,
             "step": jnp.zeros((), jnp.int32)}
 
 
-def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
+def make_train_step(loss_fn: Callable[[Any, Any], jax.Array] | None,
                     optimizer: optax.GradientTransformation,
                     mesh: Mesh | None = None,
-                    donate: bool = True) -> Callable:
+                    donate: bool = True,
+                    value_and_grad_fn: Callable | None = None) -> Callable:
     """Compile ``state, batch → state, metrics``.
 
     ``loss_fn(params, batch) -> scalar``. Under a mesh the step runs as one
     SPMD program; gradients of replicated params are reduced by XLA
     automatically (no explicit all-reduce anywhere).
+
+    ``value_and_grad_fn(params, batch) -> (loss, grads)`` replaces
+    ``jax.value_and_grad(loss_fn)`` for schedules that produce their own
+    gradients (the 1F1B pipeline, transformer.lm_value_and_grad — 1F1B
+    must run the loss inside the pipeline, so it cannot be a jax.grad
+    target); ``loss_fn`` may then be None.
     """
 
     fused = hasattr(optimizer, "fused_apply")
@@ -82,8 +89,10 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array],
         raise ValueError("fused optimizers are single-chip only — use "
                          "default_optimizer(fused=False) with a mesh")
 
+    vag = value_and_grad_fn or jax.value_and_grad(loss_fn)
+
     def step(state: TrainState, batch: Any):
-        loss, grads = jax.value_and_grad(loss_fn)(state["params"], batch)
+        loss, grads = vag(state["params"], batch)
         if fused:
             # single-pass update (ops/optim.py): params change inside the
             # kernel, no separate apply_updates traversal

@@ -83,6 +83,11 @@ class TransformerConfig:
     # divides the batch (bubble (pp-1)/(pp+1)), else pp. Must divide the
     # global batch; the per-microbatch batch must divide the dp axis.
     pp_microbatches: int = 0
+    # Pipeline schedule: "gpipe" (differentiable through lm_loss — the
+    # default) or "1f1b" (O(pp) instead of O(M) live microbatch
+    # activations; gradients come from lm_value_and_grad, not jax.grad —
+    # make_train_step's value_and_grad_fn hook).
+    pp_schedule: str = "gpipe"
     # MoE: 0 experts = dense MLP
     num_experts: int = 0
     moe_top_k: int = 2
@@ -95,10 +100,13 @@ class TransformerConfig:
         if kv <= 0 or self.n_heads % kv:
             raise ValueError(f"n_kv_heads={kv} must be a positive divisor "
                              f"of n_heads={self.n_heads}")
-        if self.remat_policy not in ("full", "dots"):
+        if self.remat_policy not in ("full", "dots", "attn"):
             raise ValueError(f"unknown remat_policy "
-                             f"{self.remat_policy!r}; expected 'full' or "
-                             f"'dots'")
+                             f"{self.remat_policy!r}; expected 'full', "
+                             f"'dots', or 'attn'")
+        if self.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown pp_schedule {self.pp_schedule!r}; "
+                             f"expected 'gpipe' or '1f1b'")
 
     @property
     def head_dim(self) -> int:
@@ -266,10 +274,9 @@ def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
                          f"expected 'ring' or 'ulysses'")
     if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
         if cp_strategy == "ulysses":
-            # ulysses all-to-alls split the HEAD dim over cp, which GQA's
-            # few kv heads generally cannot satisfy — expand first
+            # GQA K/V stay unexpanded when kv heads divide tp·cp — the
+            # wrapper expands only when the head split cannot be satisfied
             from tony_tpu.parallel.ulysses import ulysses_attention
-            k, v = expand_kv(q, k, v)
             return ulysses_attention(q, k, v, mesh, causal=True)
         # ring rides GQA K/V unexpanded: the rotation payload (the ring's
         # whole inter-chip cost) shrinks by n_heads/n_kv_heads
@@ -286,8 +293,16 @@ def _remat_policy(cfg: TransformerConfig):
         return None
     if cfg.remat_policy == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "attn":
+        # save ONLY the flash kernel's outputs (o [B,S,H,D] + lse
+        # [B,H,S], named in ops/attention.py's vjp fwd rules): the
+        # backward replay recomputes the cheap projections but the
+        # O(S²) flash forward is DCE'd — the long-context policy, where
+        # remat="full" re-pays the very kernel that dominates the step
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_out", "flash_lse")
     raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}; "
-                     f"expected 'full' or 'dots'")
+                     f"expected 'full', 'dots', or 'attn'")
 
 
 def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None,
@@ -363,6 +378,29 @@ def _lm_head(params: dict, x: jax.Array, cfg: TransformerConfig,
     return constrain(logits, ("batch", "seq", "vocab"), mesh, rules)
 
 
+def _pp_layout(cfg: TransformerConfig, mesh: Mesh, batch: int):
+    """(pp, microbatches) for a pipelined forward — ONE definition of the
+    stage-divisibility check and the auto-microbatch rule, shared by the
+    GPipe (:func:`_forward_pp`) and 1F1B (:func:`lm_value_and_grad`)
+    arms so the two schedules can never drift apart."""
+    pp = mesh.shape.get("pp", 1)
+    if cfg.n_layers % max(pp, 1):
+        raise ValueError(f"n_layers={cfg.n_layers} not divisible into "
+                         f"{pp} pipeline stages")
+    m = cfg.pp_microbatches
+    if not m:
+        # auto: the microbatch dim stays sharded over dp/fsdp inside the
+        # pipeline's shard_map, so M must divide b AND leave b/M divisible
+        # by the live batch axes — i.e. M | b/dp. Aim for 2·pp (bubble
+        # (pp-1)/(3·pp-1)), settle for the largest divisor below it.
+        dp_total = 1
+        for a in ("dp", "fsdp"):
+            dp_total *= mesh.shape.get(a, 1)
+        per = max(batch // max(dp_total, 1), 1)
+        m = next(k for k in range(min(2 * pp, per), 0, -1) if per % k == 0)
+    return pp, m
+
+
 def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
                 mesh: Mesh, rules) -> tuple:
     """Pipeline-parallel forward: blocks run as GPipe stages over the mesh's
@@ -376,27 +414,13 @@ def _forward_pp(params: dict, tokens: jax.Array, cfg: TransformerConfig,
     """
     from tony_tpu.parallel.pipeline import pipeline_apply
 
-    pp = mesh.shape["pp"]
-    if cfg.n_layers % pp:
-        raise ValueError(f"n_layers={cfg.n_layers} not divisible into "
-                         f"{pp} pipeline stages")
+    b, s = tokens.shape
+    pp, m = _pp_layout(cfg, mesh, b)
     ep = mesh.shape.get("ep", 1)
     ep_axis = "ep" if (cfg.num_experts and ep > 1) else None
     if ep_axis and cfg.num_experts % ep:
         raise ValueError(f"num_experts={cfg.num_experts} not divisible "
                          f"over ep={ep}")
-    b, s = tokens.shape
-    m = cfg.pp_microbatches
-    if not m:
-        # auto: the microbatch dim stays sharded over dp/fsdp inside the
-        # pipeline's shard_map, so M must divide b AND leave b/M divisible
-        # by the live batch axes — i.e. M | b/dp. Aim for 2·pp (bubble
-        # (pp-1)/(3·pp-1)), settle for the largest divisor below it.
-        dp_total = 1
-        for a in ("dp", "fsdp"):
-            dp_total *= mesh.shape.get(a, 1)
-        per = max(b // max(dp_total, 1), 1)
-        m = next(k for k in range(min(2 * pp, per), 0, -1) if per % k == 0)
     x = params["embed"][tokens].astype(cfg.dtype)
     x = constrain(x, ("batch", "seq", "embed"), mesh, rules)
     blocks = jax.tree.map(
@@ -505,3 +529,80 @@ def lm_loss(params: dict, batch: dict, cfg: TransformerConfig,
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
     logits, aux = forward(params, inputs, cfg, mesh, rules)
     return masked_cross_entropy(logits, targets) + cfg.moe_aux_weight * aux
+
+
+def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
+                      mesh: Mesh, rules=DEFAULT_RULES):
+    """Next-token loss AND parameter gradients via the 1F1B pipeline
+    schedule (``cfg.pp_schedule == "1f1b"``) — the memory-scalable arm of
+    pipeline parallelism (parallel/pipeline.py: O(pp) live microbatch
+    activations instead of GPipe's O(M)).
+
+    Not a ``jax.grad`` target: 1F1B starts each microbatch's backward at
+    the last stage as soon as its forward lands, which requires the loss
+    head inside the pipeline — so this function IS the differentiation.
+    Plug into ``make_train_step(..., value_and_grad_fn=...)``.
+
+    The loss normalizes per (microbatch, data shard) — identical to
+    :func:`lm_loss` whenever mask counts are uniform (always true for
+    dense LM batches without -1 padding).
+    """
+    from tony_tpu.models.train import masked_cross_entropy as _mxe
+    from tony_tpu.parallel.pipeline import pipeline_value_and_grad
+
+    if "inputs" in batch:
+        inputs, targets = batch["inputs"], batch["targets"]
+    else:
+        inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "pp_schedule='1f1b' does not support MoE: the aux-loss side "
+            "channel rides the GPipe schedule only (use 'gpipe')")
+    b, s = inputs.shape
+    pp, m = _pp_layout(cfg, mesh, b)
+
+    def embed_fn(e):
+        x = e[inputs].astype(cfg.dtype)
+        return constrain(x, ("batch", "seq", "embed"), mesh, rules)
+
+    x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+
+    def stage_fn(stage_params, h):
+        hb, hs = h.shape[0], h.shape[1]
+        positions = jnp.broadcast_to(jnp.arange(hs), (hb, hs))
+        rope = rope_tables(positions, cfg.head_dim)
+        block_fn = functools.partial(_block, cfg=cfg, mesh=None,
+                                     rules=rules)
+        if cfg.remat:
+            block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg))
+
+        def body(h, p):
+            h, _aux = block_fn(h, p, rope=rope)
+            return h, None
+
+        h, _ = jax.lax.scan(body, h, stage_params,
+                            unroll=cfg.scan_unroll)
+        return h
+
+    def loss_head(hp, out_mb, tgt_mb):
+        logits = _lm_head(hp, out_mb, cfg, None, rules)
+        return _mxe(logits, tgt_mb)
+
+    head_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    blocks = jax.tree.map(
+        lambda a: a.reshape((pp, a.shape[0] // pp) + a.shape[1:]),
+        params["blocks"])
+    loss, g_blocks, g_head, dx = pipeline_value_and_grad(
+        stage_fn, blocks, x, head_params, targets, mesh,
+        loss_head=loss_head, num_microbatches=m)
+    (g_embed,) = embed_vjp(dx)
+    grads = {
+        "embed": g_embed,
+        "blocks": jax.tree.map(
+            lambda g: g.reshape((g.shape[0] * g.shape[1],) + g.shape[2:]),
+            g_blocks),
+        "final_norm": g_head["final_norm"],
+        "lm_head": g_head["lm_head"],
+    }
+    return loss, grads

@@ -70,6 +70,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -688,6 +689,14 @@ def _flash_attention_bhsd(q, k, v, causal, g, bq, bk, band):
 def _flash_fwd_rule(q, k, v, causal, g, bq, bk, band):
     o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                             bk=bk, band=band)
+    # checkpoint_name on the kernel OUTPUTS: under
+    # remat_policy="attn" (save_only_these_names) the remat replay
+    # fetches o/lse from the saved forward and DCE drops the flash
+    # forward kernel from the recompute graph entirely — the backward
+    # then re-runs only the cheap projections, not the O(S²) kernel.
+    # Under other policies the names are inert.
+    o = checkpoint_name(o, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return o, (q, k, v, o, lse)
 
 
@@ -713,6 +722,8 @@ def _flash_attention_lse_bhsd(q, k, v, causal, g, bq, bk, band):
 def _flash_lse_fwd_rule(q, k, v, causal, g, bq, bk, band):
     o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
                             bk=bk, band=band)
+    o = checkpoint_name(o, "flash_out")       # see _flash_fwd_rule
+    lse = checkpoint_name(lse, "flash_lse")
     return (o, lse), (q, k, v, o, lse)
 
 

@@ -1,17 +1,34 @@
-"""Pipeline parallelism: GPipe-style microbatch pipelining over ``pp``.
+"""Pipeline parallelism over ``pp``: GPipe and 1F1B schedules.
 
 Green-field for the TPU build (SURVEY.md §2.3: PP absent from the reference).
 Stages live on different devices along the mesh's ``pp`` axis; activations
-hop stage→stage with ``lax.ppermute`` (point-to-point, so pp tolerates DCN);
-microbatches fill the pipeline GPipe-fashion: with S stages and M
-microbatches the steady loop runs M+S-1 ticks and bubble overhead is
-(S-1)/(M+S-1). Differentiable end-to-end: AD through scan+ppermute yields
-the reverse pipeline schedule automatically.
+hop stage→stage with ``lax.ppermute`` (point-to-point, so pp tolerates DCN).
 
-Constraint: the stage function must map activations to activations of the
-same shape/dtype (natural for transformer blocks). Per-stage params are
-stacked on a leading [S, ...] axis, sharded P("pp") — each device reads only
-its own stage's slice.
+Two schedules:
+
+* **GPipe** (:func:`pipeline_apply`, the default): all-forward-then-backward.
+  With S stages and M microbatches the loop runs M+S-1 ticks, bubble
+  (S-1)/(M+S-1). Differentiable end-to-end — AD through scan+ppermute yields
+  the reverse schedule automatically — which is what makes it drop into any
+  ``jax.grad`` without ceremony. The cost is activation memory: the scan
+  holds every tick's stage input for the backward, O(M) microbatch
+  activations per device.
+
+* **1F1B** (:func:`pipeline_value_and_grad`): each stage starts microbatch
+  backwards as soon as the last stage has consumed that microbatch, so at
+  most S microbatch activations are ever live per device — O(S) instead of
+  O(M), the schedule that lets deep pipelines scale M for bubble without
+  scaling memory. The price of starting backwards early is that the loss
+  must be computed per microbatch INSIDE the pipeline (at the last stage),
+  so this entry point takes the loss head and returns gradients explicitly
+  rather than being differentiated through. Backward ticks recompute the
+  stage forward from the saved input (one extra forward per microbatch —
+  the same trade a remat'd GPipe stage makes).
+
+Constraint (both schedules): the stage function must map activations to
+activations of the same shape/dtype (natural for transformer blocks).
+Per-stage params are stacked on a leading [S, ...] axis, sharded P("pp") —
+each device reads only its own stage's slice.
 """
 
 from __future__ import annotations
@@ -82,6 +99,258 @@ def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
     for a in batch_axes:
         aux_acc = lax.pmean(aux_acc, a)
     return outputs, aux_acc
+
+
+def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
+                         microbatches: jax.Array, head_batches: Any, *,
+                         stage_fn: Callable[[Any, jax.Array], jax.Array],
+                         loss_head: Callable[[Any, jax.Array, Any],
+                                             jax.Array],
+                         axis_name: str,
+                         batch_axes: tuple[str, ...]) -> tuple:
+    """Per-device 1F1B body (inside shard_map over ``axis_name``).
+
+    The Megatron non-interleaved schedule in closed form — for stage s of
+    S with warmup w(s) = S-1-s, microbatch i runs::
+
+        forward  at tick s + i          (i < w: pipeline warmup)
+                 at tick 2i + s         (steady 1F1B cadence)
+        backward at tick 2S - 1 - s + 2i
+
+    over T = 2M + 2S - 2 ticks. Forward ticks save ONLY the stage input
+    into a depth-S ring (in-flight microbatches per stage never exceed
+    S - s); backward ticks re-run the stage forward under ``jax.vjp`` from
+    that input (remat-style) and produce param grads plus the input
+    cotangent. The last stage seeds its backward from ``loss_head``
+    directly — no output cotangent ever enters from outside, which is
+    precisely what lets backwards start before the full batch has been
+    forwarded. Activations hop forward and cotangents hop backward via
+    ppermute OUTSIDE the scheduling conds (collectives must execute
+    uniformly on every device every tick; unscheduled devices ship
+    zeros that are never read — the closed forms above guarantee a
+    consumer tick always directly follows a producer tick).
+
+    Returns (loss_sum, stage_grads, head_grads, dxs) — per-device, not
+    yet reduced: loss_sum/head_grads live on the last stage, dxs on stage
+    0, stage_grads on their own stage.
+    """
+    s_count = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    params = jax.tree.map(lambda v: v[0], stage_params)
+    m = microbatches.shape[0]
+    n_ticks = 2 * m + 2 * s_count - 2
+
+    def fwd_sched(sg, t):
+        """(does stage ``sg`` forward at tick ``t``, which microbatch)."""
+        w = s_count - 1 - sg
+        ts = t - sg
+        has = (ts >= 0) & (
+            (ts < jnp.minimum(w, m))
+            | ((ts % 2 == 0) & (ts // 2 >= w) & (ts // 2 < m)))
+        idx = jnp.clip(jnp.where(ts < w, ts, ts // 2), 0, m - 1)
+        return has, idx
+
+    carry0 = (
+        jnp.zeros_like(microbatches[0]),                 # fwd_state (wire)
+        jnp.zeros_like(microbatches[0]),                 # cot_state (wire)
+        jnp.zeros((s_count,) + microbatches.shape[1:],
+                  microbatches.dtype),                   # in_buf ring
+        jnp.zeros((s_count,) + microbatches.shape[1:],
+                  microbatches.dtype),                   # resid ring
+        jnp.zeros_like(microbatches),                    # dxs (stage 0)
+        jax.tree.map(jnp.zeros_like, params),            # stage grads
+        jax.tree.map(jnp.zeros_like, head_params),       # head grads
+        jnp.zeros((), jnp.float32),                      # loss sum
+    )
+
+    def tick(carry, t):
+        (fwd_state, cot_state, in_buf, resid, dxs, grads, hgrads,
+         loss_acc) = carry
+        has_fwd, fwd_i = fwd_sched(stage, t)
+        u = t - (2 * s_count - 1 - stage)
+        has_bwd = (u >= 0) & (u % 2 == 0) & (u // 2 < m)
+        bwd_i = jnp.clip(u // 2, 0, m - 1)
+
+        # file the arrival: the activation on the wire was sent by the
+        # previous stage's forward LAST tick; at warmup->steady boundaries
+        # its consumption tick here lags the arrival by more than one
+        # tick, so a bare register would be overwritten by later sends —
+        # the ring holds each microbatch until this stage's schedule
+        # reaches it (in-flight never exceeds S, same bound as resid)
+        has_in, in_i = fwd_sched((stage - 1) % s_count, t - 1)
+        has_in = has_in & (t >= 1)
+        in_buf = in_buf.at[in_i % s_count].set(
+            jnp.where(has_in, fwd_state, in_buf[in_i % s_count]))
+
+        inp = jnp.where(stage == 0, microbatches[fwd_i],
+                        in_buf[fwd_i % s_count])
+
+        def fwd_branch(resid):
+            out = stage_fn(params, inp)
+            return out, resid.at[fwd_i % s_count].set(inp)
+
+        def fwd_noop(resid):
+            return jnp.zeros_like(fwd_state), resid
+
+        out, resid = lax.cond(has_fwd, fwd_branch, fwd_noop, resid)
+
+        saved = resid[bwd_i % s_count]
+        head_mb = jax.tree.map(lambda a: a[bwd_i], head_batches)
+
+        def bwd_branch(op):
+            grads, hgrads, dxs, loss_acc = op
+
+            def last_case(_):
+                def last_fn(p, hp, x):
+                    return loss_head(hp, stage_fn(p, x), head_mb)
+                lval, vjp_fn = jax.vjp(last_fn, params, head_params, saved)
+                dp, dhp, dinp = vjp_fn(jnp.ones((), lval.dtype))
+                return dp, dhp, dinp, lval.astype(jnp.float32)
+
+            def mid_case(_):
+                out2, vjp_fn = jax.vjp(
+                    lambda p, x: stage_fn(p, x), params, saved)
+                dp, dinp = vjp_fn(cot_state)
+                return (dp, jax.tree.map(jnp.zeros_like, head_params),
+                        dinp, jnp.zeros((), jnp.float32))
+
+            dp, dhp, dinp, lval = lax.cond(stage == s_count - 1,
+                                           last_case, mid_case, None)
+            grads = jax.tree.map(jnp.add, grads, dp)
+            hgrads = jax.tree.map(jnp.add, hgrads, dhp)
+            # dxs is only meaningful on stage 0 (masked at the end)
+            dxs = dxs.at[bwd_i].set(
+                jnp.where(stage == 0, dinp, dxs[bwd_i]))
+            return (grads, hgrads, dxs, loss_acc + lval), dinp
+
+        def bwd_noop(op):
+            return op, jnp.zeros_like(cot_state)
+
+        (grads, hgrads, dxs, loss_acc), dinp = lax.cond(
+            has_bwd, bwd_branch, bwd_noop, (grads, hgrads, dxs, loss_acc))
+
+        shift_f = [(i, (i + 1) % s_count) for i in range(s_count)]
+        shift_b = [(i, (i - 1) % s_count) for i in range(s_count)]
+        fwd_state = lax.ppermute(out, axis_name, shift_f)
+        cot_state = lax.ppermute(dinp, axis_name, shift_b)
+        return (fwd_state, cot_state, in_buf, resid, dxs, grads, hgrads,
+                loss_acc), None
+
+    carry, _ = lax.scan(tick, carry0,
+                        jnp.arange(n_ticks, dtype=jnp.int32))
+    _, _, _, _, dxs, grads, hgrads, loss_acc = carry
+
+    last = (stage == s_count - 1)
+    loss = lax.psum(jnp.where(last, loss_acc, 0.0), axis_name) / m
+    hgrads = jax.tree.map(
+        lambda g: lax.psum(jnp.where(last, g, jnp.zeros_like(g)),
+                           axis_name), hgrads)
+    first = (stage == 0)
+    dxs = jax.tree.map(
+        lambda g: lax.psum(jnp.where(first, g, jnp.zeros_like(g)),
+                           axis_name), dxs)
+    # reduce over the data axes: params (and the head) are replicated
+    # across dp/fsdp, so their grads average; loss averages; dx is the
+    # cotangent of THIS shard's tokens — scaled, not summed
+    d_total = 1
+    for a in batch_axes:
+        d_total *= lax.axis_size(a)
+        loss = lax.pmean(loss, a)
+        grads = jax.tree.map(lambda g, _a=a: lax.pmean(g, _a), grads)
+        hgrads = jax.tree.map(lambda g, _a=a: lax.pmean(g, _a), hgrads)
+    grads = jax.tree.map(lambda g: g[None] / m, grads)
+    hgrads = jax.tree.map(lambda g: g / m, hgrads)
+    dxs = dxs / (m * d_total)
+    return loss, grads, hgrads, dxs
+
+
+def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                            stacked_params: Any, x: jax.Array,
+                            head_params: Any, head_batch: Any, mesh: Mesh,
+                            *, loss_head: Callable[[Any, jax.Array, Any],
+                                                   jax.Array],
+                            num_microbatches: int, axis_name: str = "pp",
+                            batch_axes: tuple[str, ...] = ("dp", "fsdp"),
+                            param_specs: Any = None):
+    """1F1B pipeline: loss AND gradients in one schedule.
+
+    Same stage contract as :func:`pipeline_apply` (stacked [S, ...]
+    params, shape-preserving ``stage_fn``), plus the loss head the last
+    stage applies per microbatch: ``loss_head(head_params, out_mb,
+    head_batch_mb) -> scalar`` — the mean loss of the LOCAL microbatch
+    shard (so the global loss is exactly the mean of per-microbatch means;
+    with masked losses this matches a single global mean only when every
+    microbatch shard has the same mask count — the standard 1F1B
+    normalization trade).
+
+    ``head_batch``: pytree with leading batch dim [B, ...] (targets etc.),
+    microbatched and delivered to ``loss_head`` alongside the activations.
+
+    Returns ``(loss, stage_grads, head_grads, dx)`` where stage_grads
+    matches ``stacked_params``, head_grads matches ``head_params``, and
+    dx is d(loss)/dx — feed it to the caller's vjp of whatever produced x
+    (the embedding) to complete the parameter gradients.
+
+    Activation memory is O(S) microbatches per device (vs GPipe's O(M));
+    each microbatch pays one extra stage forward (remat-style recompute in
+    the backward tick). Not differentiable through — it IS the
+    differentiation.
+
+    Known trade: ``head_params`` (and their gradients) are REPLICATED on
+    every device (in_specs P()) — the loss head runs inside the
+    shard_map's Manual context, where GSPMD sharding constraints cannot
+    reach. GPipe runs its head outside the pipeline under ordinary
+    sharding propagation, so for a model whose lm_head is fsdp-sharded
+    and HBM-critical, GPipe remains the right schedule; sharding the
+    head inside 1F1B would need explicit collectives in ``loss_head``.
+    """
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(f"batch {b} not divisible into "
+                         f"{num_microbatches} microbatches")
+    num_stages = jax.tree.leaves(stacked_params)[0].shape[0]
+    m = num_microbatches
+    mb = b // m
+    xs = x.reshape((m, mb) + x.shape[1:])
+    head_xs = jax.tree.map(
+        lambda a: a.reshape((m, mb) + a.shape[1:]), head_batch)
+
+    if axis_name not in mesh.shape or mesh.shape[axis_name] == 1:
+        # degenerate: no pp axis — same value/grad contract via plain AD
+        def total(sp, hp, xs):
+            def body(h, p):
+                return stage_fn(p, h), None
+
+            def one_mb(xmb, hmb):
+                out, _ = lax.scan(body, xmb, sp)
+                return loss_head(hp, out, hmb)
+
+            losses = jax.vmap(one_mb)(xs, head_xs)
+            return losses.mean()
+
+        (loss, (g_sp, g_hp, g_xs)) = jax.value_and_grad(
+            total, argnums=(0, 1, 2))(stacked_params, head_params, xs)
+        return loss, g_sp, g_hp, g_xs.reshape(x.shape)
+
+    pp = mesh.shape[axis_name]
+    if num_stages != pp:
+        raise ValueError(f"{num_stages} stacked stages but pp axis has "
+                         f"{pp} ranks — need exactly one stage per rank")
+    live = tuple(a for a in batch_axes
+                 if a in mesh.shape and mesh.shape[a] > 1)
+    data_spec = P(None, live if len(live) > 1 else (live[0] if live else None))
+    if param_specs is None:
+        param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    head_specs = jax.tree.map(lambda _: P(), head_params)
+    fn = functools.partial(_pipeline_1f1b_local, stage_fn=stage_fn,
+                           loss_head=loss_head, axis_name=axis_name,
+                           batch_axes=live)
+    loss, g_sp, g_hp, g_xs = jax.shard_map(
+        fn, mesh=mesh,
+        in_specs=(param_specs, head_specs, data_spec, data_spec),
+        out_specs=(P(), param_specs, head_specs, data_spec),
+        check_vma=False)(stacked_params, head_params, xs, head_xs)
+    return loss, g_sp, g_hp, g_xs.reshape(x.shape)
 
 
 def pipeline_apply(stage_fn: Callable[[Any, jax.Array], Any],

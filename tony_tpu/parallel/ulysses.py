@@ -37,15 +37,28 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
     """Per-shard Ulysses body — call inside ``shard_map`` with the sequence
     dim sharded over ``axis_name``.
 
-    q, k, v: [B, S_local, H, D]; H must be divisible by the axis size.
+    q: [B, S_local, H, D]; k/v: [B, S_local, H_kv, D] with H_kv | H —
+    grouped-query K/V ride the all-to-all UNEXPANDED when H_kv divides
+    the axis size: the kv-head dim splits over cp exactly like the query
+    heads, and the contiguous split preserves group alignment (each cp
+    rank's H/cp query heads are exactly (H_kv/cp)·(H/H_kv), so query
+    head j still pairs with local kv head j // rep). The K/V payload —
+    the strategy's whole inter-chip cost besides q/o — shrinks by
+    H/H_kv. H and H_kv must both divide the axis size (the wrapper
+    expands K/V first when H_kv cannot).
     Returns [B, S_local, H, D].
     """
     from tony_tpu.parallel.ring_attention import _flash_block, _flash_chunks
 
     b, s_loc, h, d = q.shape
+    h_kv = k.shape[2]
     cp = lax.axis_size(axis_name)
     if h % cp:
         raise ValueError(f"n_heads={h} not divisible by {axis_name}={cp}")
+    if h_kv % cp:
+        raise ValueError(f"kv heads ({h_kv}) not divisible by "
+                         f"{axis_name}={cp}; expand K/V first "
+                         f"(ulysses_attention does this automatically)")
     if _flash_chunks() and _flash_block(s_loc * cp) is None:
         # Unlike ring chunks (S_local each), ulysses attends the FULL
         # gathered sequence per device — a silent dense fallback there
@@ -59,8 +72,8 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
         return _single_chunk(q, k, v, causal=causal, scale=scale)
 
     def seq_to_heads(x):
-        # [B, S/c, H, D] → [B, S, H/c, D]: split heads across the axis,
-        # gather the full sequence.
+        # [B, S/c, H', D] → [B, S, H'/c, D]: split heads across the axis,
+        # gather the full sequence (H' = H for q, H_kv for k/v).
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
 
@@ -71,7 +84,8 @@ def ulysses_attention_local(q, k, v, *, axis_name: str = "cp",
     qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
     # full sequence is local after the all-to-all; _single_chunk picks the
     # engine (flash pallas kernel on TPU with a tiling block, dense
-    # otherwise) — one selection policy shared with the ring path
+    # otherwise) — one selection policy shared with the ring path; both
+    # consume grouped K/V natively
     o = _single_chunk(qh, kh, vh, causal=causal, scale=scale)
     return heads_to_seq(o)
 
@@ -84,9 +98,30 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, causal: bool = True,
     all-to-all counterpart of :func:`ring_attention` (same call shape).
     Batch over dp/fsdp, sequence over cp, heads over tp; axes missing from
     ``mesh`` (or size 1) are dropped. With tp live, each tp shard runs
-    Ulysses over its own head subset (local heads must still divide cp)."""
+    Ulysses over its own head subset (local heads must still divide cp).
+
+    GQA K/V (fewer heads than Q) ride the all-to-alls UNEXPANDED when the
+    kv heads divide both the tp sharding and the cp split — the K/V
+    payload shrinks by H/H_kv, the same discipline as the ring's
+    unexpanded rotation. Otherwise (H_kv < tp·cp granularity) K/V expand
+    to full width first — correctness over the payload saving."""
+    import jax.numpy as jnp
+
     from tony_tpu.parallel.sharding import attention_spec
     spec, s_spec = attention_spec(mesh, batch_axes, seq_axis, head_axis)
+    h, hk = q.shape[2], k.shape[2]
+    if hk != h and (hk <= 0 or h % hk):
+        raise ValueError(f"kv heads ({hk}) must divide heads ({h})")
+    if hk != h:
+        tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+        cp = mesh.shape.get(seq_axis, 1) if seq_axis else 1
+        # the kv-head dim must survive the tp shard AND the local
+        # all-to-all split: hk % (tp·cp) == 0 keeps every rank's local
+        # kv heads aligned with its query-head groups
+        if hk % max(tp, 1) or (hk // max(tp, 1)) % max(cp, 1):
+            rep = h // hk
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
     if s_spec is None:
         fn = functools.partial(_single_chunk, causal=causal, scale=scale)
