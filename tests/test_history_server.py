@@ -7,6 +7,7 @@ import os
 import time
 import urllib.error
 import urllib.request
+from html.parser import HTMLParser
 
 import pytest
 
@@ -119,6 +120,117 @@ def test_config_page_and_api(server, dirs):
     assert status == 200 and "tony.worker.instances" in body
     status, body = _get(server, "/api/jobs/application_4_0001/config")
     assert json.loads(body)["tony.worker.instances"] == "2"
+
+
+class _PageParser(HTMLParser):
+    """Structural HTML reader for the three pages: tables as row-lists of
+    cell texts, plus every link's (href, text) — the BrowserTest analog
+    (reference: tony-history-server/test/controllers), so markup
+    regressions fail the suite instead of passing substring checks."""
+
+    def __init__(self):
+        super().__init__()
+        self.tables: list[list[list[str]]] = []
+        self.links: list[tuple[str, str]] = []
+        self._row: list[str] | None = None
+        self._cell: list[str] | None = None
+        self._href: str | None = None
+        self._link_text: list[str] = []
+
+    def handle_starttag(self, tag, attrs):
+        if tag == "table":
+            self.tables.append([])
+        elif tag == "tr" and self.tables:
+            self._row = []
+            self.tables[-1].append(self._row)
+        elif tag in ("td", "th") and self._row is not None:
+            self._cell = []
+        elif tag == "a":
+            self._href = dict(attrs).get("href", "")
+            self._link_text = []
+
+    def handle_endtag(self, tag):
+        if tag in ("td", "th") and self._cell is not None:
+            self._row.append("".join(self._cell).strip())
+            self._cell = None
+        elif tag == "tr":
+            self._row = None
+        elif tag == "a" and self._href is not None:
+            self.links.append((self._href, "".join(self._link_text)))
+            self._href = None
+
+    def handle_data(self, data):
+        if self._cell is not None:
+            self._cell.append(data)
+        if self._href is not None:
+            self._link_text.append(data)
+
+
+def _parse(body: str) -> _PageParser:
+    p = _PageParser()
+    p.feed(body)
+    return p
+
+
+def test_index_page_structure(server, dirs):
+    """The job index renders a real table: one row per job with the
+    declared columns, the app id as a link to its events page, and a
+    config link — not just the strings somewhere in the markup."""
+    _write_job(dirs.intermediate, "application_9_0001")
+    _write_job(dirs.intermediate, "application_9_0002", status="FAILED",
+               user="bob")
+    _, body = _get(server, "/")
+    page = _parse(body)
+    assert len(page.tables) == 1
+    header, *rows = page.tables[0]
+    assert header == ["Job", "User", "Started (UTC)", "Completed (UTC)",
+                      "Status", "Uptime", ""]
+    assert len(rows) == 2
+    by_id = {r[0]: r for r in rows}
+    assert set(by_id) == {"application_9_0001", "application_9_0002"}
+    assert by_id["application_9_0001"][1] == "alice"
+    assert by_id["application_9_0001"][4] == "SUCCEEDED"
+    assert by_id["application_9_0002"][1] == "bob"
+    assert by_id["application_9_0002"][4] == "FAILED"
+    # every row's cells populated (timestamps render, uptime non-empty)
+    for r in rows:
+        assert all(c for c in r[:6]), r
+    assert ("/jobs/application_9_0001",
+            "application_9_0001") in page.links
+    assert ("/config/application_9_0002", "config") in page.links
+
+
+def test_events_page_structure(server, dirs):
+    """The event timeline is a table ordered by timestamp with the
+    declared columns and a back-link to the index."""
+    _write_job(dirs.intermediate, "application_9_0003")
+    _, body = _get(server, "/jobs/application_9_0003")
+    page = _parse(body)
+    assert len(page.tables) == 1
+    header, *rows = page.tables[0]
+    assert header == ["Time (UTC)", "Event", "Payload"]
+    assert [r[1] for r in rows] == ["APPLICATION_INITED",
+                                    "APPLICATION_FINISHED"]
+    # timeline ordered by the rendered timestamps
+    times = [r[0] for r in rows]
+    assert times == sorted(times) and all(times)
+    assert ("/", "← all jobs") in page.links
+
+
+def test_config_page_structure(server, dirs):
+    """The config table renders key/value CELLS (sorted by key), not
+    merely the substrings."""
+    _write_job(dirs.intermediate, "application_9_0004")
+    _, body = _get(server, "/config/application_9_0004")
+    page = _parse(body)
+    assert len(page.tables) == 1
+    header, *rows = page.tables[0]
+    assert header == ["Key", "Value"]
+    as_dict = {k: v for k, v in rows}
+    assert as_dict["tony.worker.instances"] == "2"
+    assert as_dict["tony.application.name"] == "application_9_0004"
+    keys = [k for k, _ in rows]
+    assert keys == sorted(keys)
 
 
 def test_unknown_job_404(server):
