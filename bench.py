@@ -444,11 +444,18 @@ def _speculative_arm(new: int = 256, k: int = 10):
     int(og.tokens[0, -1])
     t_g8 = (time.perf_counter() - t0) / 3
 
-    def time_spec_b8(draft_p, commit):
-        fn = jax.jit(functools.partial(
+    # ONE jitted fn per commit policy, hoisted out of the draft loop:
+    # draft params are runtime args, so all three drafts share a compile
+    spec_fns = {
+        commit: jax.jit(functools.partial(
             speculative_generate_device, cfg=cfg_t, draft_cfg=cfg_d,
             max_new_tokens=new, num_speculative=k, commit=commit,
             return_rounds=True))
+        for commit in ("per_row", "min")
+    }
+
+    def time_spec_b8(draft_p, commit):
+        fn = spec_fns[commit]
         o, rounds = fn(p_t, draft_p, b8)
         int(o[0, -1])                            # compile + warm
         t0 = time.perf_counter()

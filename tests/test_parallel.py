@@ -511,6 +511,25 @@ class TestPipelineTransformer:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        atol=3e-5, err_msg=str(path))
 
+    def test_1f1b_tp_sharded_head(self, setup):
+        """pp x tp: the loss head runs vocab-SHARDED inside the pipeline
+        (distributed logsumexp + psum'd picked logit; activation
+        cotangent psum'd over tp) and still reproduces the unsharded
+        loss and gradients — the memory parity point with GPipe's
+        propagated head sharding."""
+        T, shard_pytree, cfg, params, batch, ref_loss = setup
+        mesh = make_mesh({"pp": 2, "tp": 2, "dp": 2})
+        sp = shard_pytree(params, T.logical_axes(cfg), mesh)
+        g_ref = jax.grad(lambda p: T.lm_loss(p, batch, cfg, None))(params)
+        with jax.set_mesh(mesh):
+            loss, g = jax.jit(lambda p, b: T.lm_value_and_grad(
+                p, b, cfg, mesh))(sp, batch)
+        np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+        flat_ref, _ = jax.tree_util.tree_flatten_with_path(g_ref)
+        for (path, a), b in zip(flat_ref, jax.tree.leaves(g)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=3e-5, err_msg=str(path))
+
     def test_1f1b_degenerate_no_pp_axis(self, setup):
         """Without a pp axis the same entry point falls back to plain AD
         and still matches the reference."""

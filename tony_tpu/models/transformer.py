@@ -584,9 +584,57 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
                             unroll=cfg.scan_unroll)
         return h
 
-    def loss_head(hp, out_mb, tgt_mb):
-        logits = _lm_head(hp, out_mb, cfg, None, rules)
-        return _mxe(logits, tgt_mb)
+    # Loss head: vocab-sharded over tp when the mesh can (matching the
+    # GPipe arm, where the lm_head stays tp-sharded by propagation) — the
+    # head runs inside the pipeline's Manual context, so the softmax
+    # combines across vocab shards with explicit collectives (distributed
+    # logsumexp + psum-picked logit); the pipeline psums the activation
+    # cotangent across tp (pipeline_value_and_grad head_reduce_axes).
+    tp = mesh.shape.get("tp", 1)
+    vocab_sharded = (tp > 1 and cfg.vocab_size % tp == 0
+                     and mesh.shape.get("pp", 1) > 1)
+    if vocab_sharded:
+        from jax.sharding import PartitionSpec as _P
+
+        def loss_head(hp, out_mb, tgt_mb):
+            h = rms_norm_reference(out_mb, hp["final_norm"])
+            logits = jnp.einsum("bsd,dv->bsv", h, hp["lm_head"],
+                                preferred_element_type=jnp.float32)
+            # same storage rounding as _lm_head, math back in f32
+            logits = logits.astype(cfg.logits_storage_dtype).astype(
+                jnp.float32)
+            v_loc = logits.shape[-1]
+            shard = jax.lax.axis_index("tp")
+            # the max is a numerical stabilizer only — lse is shift-
+            # invariant and the shift's gradient cancels exactly, so stop
+            # gradients rather than differentiate pmax (which has no rule)
+            gmax = jax.lax.pmax(
+                jax.lax.stop_gradient(jnp.max(logits, axis=-1)), "tp")
+            # psum_rep: identity transpose so per-rank vjps yield TRUE
+            # partials (the stock psum transpose re-psums a replicated
+            # cotangent, scaling every upstream gradient by tp); the
+            # pipeline sums the partials across tp exactly once
+            # (head_reduce_axes)
+            from tony_tpu.parallel.sharding import psum_rep
+            lse = gmax + jnp.log(psum_rep(
+                jnp.sum(jnp.exp(logits - gmax[..., None]), axis=-1),
+                "tp"))
+            ids = shard * v_loc + jnp.arange(v_loc)
+            onehot = tgt_mb[..., None] == ids
+            picked = psum_rep(
+                jnp.sum(jnp.where(onehot, logits, 0.0), axis=-1), "tp")
+            mask = (tgt_mb >= 0).astype(jnp.float32)
+            return ((lse - picked) * mask).sum() / jnp.maximum(
+                mask.sum(), 1.0)
+
+        head_specs = {"final_norm": _P(), "lm_head": _P(None, "tp")}
+        reduce_axes = ("tp",)
+    else:
+        def loss_head(hp, out_mb, tgt_mb):
+            logits = _lm_head(hp, out_mb, cfg, None, rules)
+            return _mxe(logits, tgt_mb)
+
+        head_specs, reduce_axes = None, ()
 
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
@@ -595,7 +643,8 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
         params["blocks"])
     loss, g_blocks, g_head, dx = pipeline_value_and_grad(
         stage_fn, blocks, x, head_params, targets, mesh,
-        loss_head=loss_head, num_microbatches=m)
+        loss_head=loss_head, num_microbatches=m,
+        head_specs=head_specs, head_reduce_axes=reduce_axes)
     (g_embed,) = embed_vjp(dx)
     grads = {
         "embed": g_embed,

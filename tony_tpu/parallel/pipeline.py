@@ -107,7 +107,10 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
                          loss_head: Callable[[Any, jax.Array, Any],
                                              jax.Array],
                          axis_name: str,
-                         batch_axes: tuple[str, ...]) -> tuple:
+                         batch_axes: tuple[str, ...],
+                         head_specs: Any = None,
+                         stage_specs: Any = None,
+                         head_reduce_axes: tuple[str, ...] = ()) -> tuple:
     """Per-device 1F1B body (inside shard_map over ``axis_name``).
 
     The Megatron non-interleaved schedule in closed form — for stage s of
@@ -205,6 +208,47 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
                     return loss_head(hp, stage_fn(p, x), head_mb)
                 lval, vjp_fn = jax.vjp(last_fn, params, head_params, saved)
                 dp, dhp, dinp = vjp_fn(jnp.ones((), lval.dtype))
+                # head sharded over head_reduce_axes (tp-vocab shards):
+                # each rank's vjp yields the PARTIAL cotangents from its
+                # vocab slice's loss paths — the activation cotangent and
+                # any head leaf replicated over those axes must sum
+                # across them (sharded leaves own their slice's grads
+                # outright). Safe inside the conds: the predicates are
+                # uniform across the reduce axes, so all participants
+                # enter together.
+                def _reduce_tree(grads_tree, specs_tree, ax):
+                    """psum ``grads_tree`` leaves over ``ax`` EXCEPT those
+                    whose spec already shards over ``ax`` (they own their
+                    slice's grads outright). Specs are zipped by hand: P
+                    is a tuple subclass tree.map would descend into, and
+                    a bare None leaf (valid replicated spec) vanishes
+                    from tree_leaves without the is_leaf."""
+                    flat_g, td = jax.tree_util.tree_flatten(grads_tree)
+                    flat_s = jax.tree_util.tree_leaves(
+                        specs_tree,
+                        is_leaf=lambda x: x is None or isinstance(x, P))
+
+                    def _reduce(g, spec):
+                        named = set()
+                        for entry in (tuple(spec) if spec is not None
+                                      else ()):
+                            if isinstance(entry, (tuple, list)):
+                                named.update(entry)
+                            elif entry is not None:
+                                named.add(entry)
+                        return g if ax in named else lax.psum(g, ax)
+
+                    return td.unflatten(
+                        [_reduce(g, s) for g, s in zip(flat_g, flat_s)])
+
+                for ax in head_reduce_axes:
+                    dinp = lax.psum(dinp, ax)
+                    # the last STAGE's params also sit upstream of the
+                    # partitioned loss paths — their partials sum too,
+                    # spec-aware like the head's (an ax-sharded stage
+                    # leaf owns its slice)
+                    dp = _reduce_tree(dp, stage_specs, ax)
+                    dhp = _reduce_tree(dhp, head_specs, ax)
                 return dp, dhp, dinp, lval.astype(jnp.float32)
 
             def mid_case(_):
@@ -271,7 +315,9 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
                                                    jax.Array],
                             num_microbatches: int, axis_name: str = "pp",
                             batch_axes: tuple[str, ...] = ("dp", "fsdp"),
-                            param_specs: Any = None):
+                            param_specs: Any = None,
+                            head_specs: Any = None,
+                            head_reduce_axes: tuple[str, ...] = ()):
     """1F1B pipeline: loss AND gradients in one schedule.
 
     Same stage contract as :func:`pipeline_apply` (stacked [S, ...]
@@ -296,13 +342,17 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
     the backward tick). Not differentiable through — it IS the
     differentiation.
 
-    Known trade: ``head_params`` (and their gradients) are REPLICATED on
-    every device (in_specs P()) — the loss head runs inside the
-    shard_map's Manual context, where GSPMD sharding constraints cannot
-    reach. GPipe runs its head outside the pipeline under ordinary
-    sharding propagation, so for a model whose lm_head is fsdp-sharded
-    and HBM-critical, GPipe remains the right schedule; sharding the
-    head inside 1F1B would need explicit collectives in ``loss_head``.
+    ``head_specs`` / ``head_reduce_axes``: by default head_params (and
+    their gradients) replicate on every device (in_specs P()) — the
+    loss head runs inside the shard_map's Manual context, where GSPMD
+    sharding constraints cannot reach. To SHARD the head (a big lm_head
+    over tp), pass per-leaf ``head_specs`` and name the sharding axes in
+    ``head_reduce_axes``; ``loss_head`` must then combine across those
+    axes itself (distributed logsumexp etc. — psum/pmax over the axis
+    names), and the pipeline psums the activation cotangent plus any
+    still-replicated head leaves' grads across them (sharded leaves own
+    their slice's grads). transformer.lm_value_and_grad wires this up
+    for the vocab-sharded lm_head.
     """
     b = x.shape[0]
     if b % num_microbatches:
@@ -341,10 +391,13 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
     data_spec = P(None, live if len(live) > 1 else (live[0] if live else None))
     if param_specs is None:
         param_specs = jax.tree.map(lambda _: P(axis_name), stacked_params)
-    head_specs = jax.tree.map(lambda _: P(), head_params)
+    if head_specs is None:
+        head_specs = jax.tree.map(lambda _: P(), head_params)
     fn = functools.partial(_pipeline_1f1b_local, stage_fn=stage_fn,
                            loss_head=loss_head, axis_name=axis_name,
-                           batch_axes=live)
+                           batch_axes=live, head_specs=head_specs,
+                           stage_specs=param_specs,
+                           head_reduce_axes=head_reduce_axes)
     loss, g_sp, g_hp, g_xs = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, head_specs, data_spec, data_spec),

@@ -10,6 +10,7 @@ shardings, let XLA insert the collectives.
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Sequence
 
 import jax
@@ -31,6 +32,35 @@ DEFAULT_RULES: Rules = (
     ("stage", "pp"),                 # pipeline stages
     ("norm", None),
 )
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_rep(x, axis_name: str):
+    """``lax.psum`` whose TRANSPOSE treats the cotangent as replicated.
+
+    Inside a ``shard_map`` body with ``check_vma=False``, the stock
+    psum's transpose psums the cotangent again — a replicated seed (the
+    usual case: a loss differentiated identically on every rank) comes
+    back multiplied by the axis size, so a per-rank ``jax.vjp`` of a
+    cross-shard reduction yields axis_size x the true partials
+    (measured: seeding 1.0 through a tp=2 psum doubles every upstream
+    gradient). This wrapper's backward is the identity, so per-rank
+    vjps yield TRUE partials — callers then sum partials across the
+    axis exactly once, where they choose to (the 1F1B pipeline's
+    head_reduce_axes does). Use for manual-collective loss heads; the
+    primal is a plain psum."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _psum_rep_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _psum_rep_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+psum_rep.defvjp(_psum_rep_fwd, _psum_rep_bwd)
 
 
 def _auto_axes(mesh) -> set[str]:
