@@ -124,7 +124,10 @@ def main() -> int:
     params = shard_pytree(T.init_params(jax.random.PRNGKey(0), cfg),
                           T.logical_axes(cfg), mesh)
     opt = default_optimizer(lr=args.lr, total_steps=args.steps)
-    if cfg.pp_schedule == "1f1b" and mesh.shape.get("pp", 1) > 1:
+    use_1f1b = cfg.pp_schedule == "1f1b" and mesh.shape.get("pp", 1) > 1
+    print(f"pipeline schedule: {'1f1b' if use_1f1b else 'gpipe'}",
+          flush=True)
+    if use_1f1b:
         # 1F1B produces its own gradients (the loss head runs inside the
         # pipeline) — it plugs in through the value_and_grad hook
         step_fn = make_train_step(

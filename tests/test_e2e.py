@@ -211,18 +211,23 @@ class TestE2E:
         assert "done:" in out
 
     @pytest.mark.slow
-    def test_distributed_pipeline_parallel_lm_trains(self, tmp_path):
+    @pytest.mark.parametrize("pp_schedule", ["gpipe", "1f1b"])
+    def test_distributed_pipeline_parallel_lm_trains(self, tmp_path,
+                                                     pp_schedule):
         """Pipeline parallelism across PROCESSES: 2 workers × 1 CPU device,
         mesh pp=2 — each process holds one stage of the flagship LM and
         activations hop stage→stage over the gloo collective backend (the
         same ppermute pattern that rides DCN between slices on real TPU).
         The batch is replicated over pp, so both processes must feed
-        identical data (train.data_parallel_rank seeding)."""
+        identical data (train.data_parallel_rank seeding). Both schedules
+        drive the same CLI: gpipe differentiates through lm_loss, 1f1b
+        routes through lm_value_and_grad via the value_and_grad hook."""
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         script = os.path.join(repo, "examples", "lm", "train_lm.py")
         client = make_client(
             tmp_path, f"{PY} {script} --steps 12 --batch_size 8 "
-                      f"--seq_len 64 --preset tiny",
+                      f"--seq_len 64 --preset tiny "
+                      f"--pp_schedule {pp_schedule}",
             {"tony.worker.instances": "2",
              "tony.application.mesh": "pp=2,dp=-1",
              "tony.application.timeout": "180000"},
@@ -233,6 +238,9 @@ class TestE2E:
                                 "worker-0.stdout")).read() + \
             open(os.path.join(client.job_dir, "logs", "worker-1.stdout")).read()
         assert "'pp': 2" in out       # train_lm prints the resolved mesh
+        # schedule-specific: a silent fallback to the other schedule fails
+        # (train_lm prints the RESOLVED branch, not the flag)
+        assert f"pipeline schedule: {pp_schedule}" in out
         assert "done:" in out
 
     def test_per_task_restart_within_session(self, tmp_path):
