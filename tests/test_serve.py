@@ -1,0 +1,110 @@
+"""Continuous batching: slot reuse, per-request exactness, eos handling."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import transformer as T
+from tony_tpu.models.decode import generate
+from tony_tpu.models.serve import ContinuousBatcher
+
+CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _reference(params, prompt, max_new):
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None], CFG,
+                   max_new_tokens=max_new, rng=jax.random.PRNGKey(0),
+                   temperature=0.0)
+    return [int(t) for t in np.asarray(out.tokens[0, len(prompt):])]
+
+
+class TestContinuousBatching:
+    def test_token_identical_with_slot_reuse(self, params):
+        """6 requests of mixed lengths through 3 slots: every request's
+        output equals its solo greedy generate — including requests
+        admitted into a REUSED slot whose cache still holds the previous
+        occupant's stale K/V beyond the frontier."""
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (5, 3, 7, 4, 6, 3)]
+        batcher = ContinuousBatcher(params, CFG, batch=3, max_len=32,
+                                    chunk=4)
+        outs = batcher.serve(prompts, max_new_tokens=6)
+        for i, p in enumerate(prompts):
+            assert outs[i] == _reference(params, p, 6), f"request {i}"
+
+    def test_single_slot_serializes_correctly(self, params):
+        """batch=1 degenerates to sequential serving — same outputs."""
+        rng = np.random.RandomState(1)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (4, 6)]
+        batcher = ContinuousBatcher(params, CFG, batch=1, max_len=32,
+                                    chunk=3)
+        outs = batcher.serve(prompts, max_new_tokens=5)
+        for i, p in enumerate(prompts):
+            assert outs[i] == _reference(params, p, 5)
+
+    def test_eos_stops_a_row_early(self, params):
+        """A request whose greedy chain hits eos stops there (eos token
+        included), freeing the slot; others run to their budget."""
+        rng = np.random.RandomState(2)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (5, 4)]
+        ref0 = _reference(params, prompts[0], 6)
+        eos = ref0[2]            # third generated token of request 0
+        batcher = ContinuousBatcher(params, CFG, batch=2, max_len=32,
+                                    eos_id=eos, chunk=2)
+        outs = batcher.serve(prompts, max_new_tokens=6)
+        assert outs[0] == ref0[:3]          # stopped AT the eos token
+        ref1 = _reference(params, prompts[1], 6)
+        cut = (ref1.index(eos) + 1) if eos in ref1 else 6
+        assert outs[1] == ref1[:cut]
+
+    def test_prompt_too_long_rejected(self, params):
+        batcher = ContinuousBatcher(params, CFG, batch=1, max_len=16)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            batcher.serve([[1] * 14], max_new_tokens=8)
+
+    def test_per_request_budgets(self, params):
+        """Mixed generation budgets (the case continuous batching exists
+        for): each request stops at ITS budget and slots recycle."""
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=4))
+                   for _ in range(4)]
+        budgets = [2, 7, 3, 5]
+        batcher = ContinuousBatcher(params, CFG, batch=2, max_len=32,
+                                    chunk=3)
+        outs = batcher.serve(prompts, budgets)
+        for i, (p, b) in enumerate(zip(prompts, budgets)):
+            assert outs[i] == _reference(params, p, b), f"request {i}"
+        assert batcher.steps_executed >= max(budgets)
+
+    def test_idle_slots_do_not_march(self, params):
+        """Queue drained with a straggler still running: freed slots are
+        reset EVERY chunk (not just once), so an idle slot's garbage
+        frontier cannot walk toward the cache end."""
+        rng = np.random.RandomState(4)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=4))
+                   for _ in range(3)]
+        batcher = ContinuousBatcher(params, CFG, batch=3, max_len=32,
+                                    chunk=2)
+        outs = batcher.serve(prompts, [2, 2, 12])
+        for i, (p, b) in enumerate(zip(prompts, [2, 2, 12])):
+            assert outs[i] == _reference(params, p, b)
+        lengths = np.asarray(batcher.cache["length"])
+        assert (lengths <= 4 + 12).all(), lengths   # no runaway frontier
+
+    def test_invalid_request_rejected_before_serving(self, params):
+        """A bad request ANYWHERE in the list fails up front — no partial
+        serve that would discard completed outputs mid-flight."""
+        batcher = ContinuousBatcher(params, CFG, batch=1, max_len=16)
+        with pytest.raises(ValueError, match="request 1"):
+            batcher.serve([[1, 2], [1] * 14], max_new_tokens=8)
+        with pytest.raises(ValueError, match="must be positive"):
+            batcher.serve([[1, 2]], max_new_tokens=0)

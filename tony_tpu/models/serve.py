@@ -1,0 +1,197 @@
+"""Continuous batching for the serving path.
+
+Static-batch serving (one :func:`~tony_tpu.models.decode.generate` call
+per request batch) leaves rows idle from the moment they finish until the
+LAST row finishes — at mixed request lengths most of the batch is dead
+weight. Continuous batching retires a row the step it completes and
+admits the next queued request into its cache slot while the other rows
+keep decoding; utilization follows the OFFERED load, not the slowest
+request. (The industry-standard serving pattern; green-field here —
+SURVEY.md §2.3, the reference delegates all compute and has no serving
+path.)
+
+The round-5 per-row decode machinery is exactly what makes this cheap
+(models/decode.py): cache ``length`` is a [B] vector, RoPE positions,
+causal masks, and K/V writes all take per-row frontiers, and the
+length-aware block-wise attention reads only each batch's LIVE rows of a
+shared padded cache. On top of that, three small device programs:
+
+- :func:`admit_row` — a batch-1 prefill whose K/V land in the retired
+  row's cache slot (one contiguous ``dynamic_update_slice`` per buffer)
+  and whose last-position logits seed the row's next step;
+- :func:`step_rows` — a ``lax.scan`` of ``n`` per-row greedy decode
+  steps over the whole batch (one dispatch per chunk, not per token);
+- :func:`retire_rows` — zero the freed rows' frontiers so idle slots
+  never walk off the end of the cache.
+
+Correctness argument for slot reuse: a row's queries attend positions
+``<= pos_r`` only. A new occupant's prefill rewrites positions
+``[0, S_prompt)`` and its decode steps write exactly at ``pos_r`` before
+reading it, so every position a query can reach was written by the
+CURRENT occupant — the previous request's stale K/V beyond the frontier
+is unreachable by construction (the same argument the speculative
+decoder makes for rejected-draft entries).
+
+The admission loop itself (:class:`ContinuousBatcher`) is host-driven —
+admission is inherently data-dependent control flow (which request, into
+which slot, at what length) and runs at human/request rate, while the
+token loop stays on device in ``step_rows`` chunks.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from tony_tpu.models import transformer as T
+from tony_tpu.models.decode import decode_step, init_kv_cache, prefill
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "logits"))
+def admit_row(params, cache, logits, row, prompt, cfg):
+    """Admit a request into cache slot ``row``.
+
+    prompt: [1, S_p] (batch-1 prefill; retraces per distinct prompt
+    length — pad/bucket lengths upstream if that matters). Returns
+    (cache, logits) with the row's K/V filled, its frontier at S_p, and
+    its next-step logits seeded.
+    """
+    lg1, mini = prefill(params, prompt, cfg, max_len=prompt.shape[1])
+    new_k = jax.lax.dynamic_update_slice(cache["k"], mini["k"],
+                                         (0, row, 0, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(cache["v"], mini["v"],
+                                         (0, row, 0, 0, 0))
+    length = cache["length"].at[row].set(prompt.shape[1])
+    return ({"k": new_k, "v": new_v, "length": length},
+            logits.at[row].set(lg1[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "n"),
+                   donate_argnames=("cache", "logits"))
+def step_rows(params, cache, logits, n, cfg):
+    """``n`` greedy decode steps for every row at its OWN frontier.
+    Returns (tokens [B, n], cache, logits). Idle rows decode garbage
+    that the host discards — uniform batch math keeps this one compiled
+    program regardless of which rows are live."""
+
+    def body(carry, _):
+        lg, c = carry
+        tok = jnp.argmax(lg, axis=-1)
+        lg, c = decode_step(params, tok, c, c["length"], cfg)
+        return (lg, c), tok
+
+    (lg, cache), toks = jax.lax.scan(body, (logits, cache), None, length=n)
+    return toks.T, cache, lg
+
+
+@functools.partial(jax.jit, donate_argnames=("cache",))
+def retire_rows(cache, mask):
+    """Reset retired rows' frontiers to 0 (mask: [B] bool). Keeps idle
+    slots from marching their garbage frontier into the cache end."""
+    return dict(cache, length=jnp.where(mask, 0, cache["length"]))
+
+
+class ContinuousBatcher:
+    """Host-side admission loop over the device programs above.
+
+    ``serve(prompts, max_new_tokens)`` runs every request to completion
+    (``max_new_tokens`` or ``eos_id``) through a fixed ``batch`` of cache
+    slots, admitting the next queued request the moment a slot frees.
+    Outputs are the same greedy tokens :func:`decode.generate` produces
+    for each request alone (test-verified token-identical on CPU).
+    """
+
+    def __init__(self, params, cfg: T.TransformerConfig, batch: int,
+                 max_len: int, eos_id: int | None = None,
+                 chunk: int = 8) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.batch = batch
+        self.max_len = max_len
+        self.eos_id = eos_id
+        #: device steps per host round trip — latency/overhead trade:
+        #: a finished row idles at most chunk-1 steps before its slot
+        #: is reused
+        self.chunk = max(1, chunk)
+        self.cache = init_kv_cache(cfg, batch, max_len)
+        # per-row frontiers from the start (decode.py's [B] position path)
+        self.cache = dict(self.cache,
+                          length=jnp.zeros((batch,), jnp.int32))
+        self.logits = jnp.zeros((batch, cfg.vocab_size),
+                                cfg.logits_storage_dtype)
+
+    def serve(self, prompts: Sequence, max_new_tokens):
+        """Run all ``prompts`` (each a [S_p] int sequence) to completion;
+        returns a list of per-request generated-token lists, order-
+        matching the input. ``max_new_tokens``: one int for all requests
+        or a per-request sequence (mixed-length serving is the whole
+        point). ``self.steps_executed`` counts device decode steps run —
+        the utilization denominator (each step advances every slot)."""
+        import numpy as np
+
+        queue = list(range(len(prompts)))
+        outputs: list[list[int]] = [[] for _ in prompts]
+        if isinstance(max_new_tokens, int):
+            budget = [max_new_tokens] * len(prompts)
+        else:
+            budget = list(max_new_tokens)
+            if len(budget) != len(prompts):
+                raise ValueError("per-request max_new_tokens length "
+                                 "must match prompts")
+        # validate EVERY request before admitting any: a mid-serve raise
+        # would discard completed outputs and strand the batcher state
+        for req, (p, b) in enumerate(zip(prompts, budget)):
+            if b <= 0:
+                raise ValueError(f"request {req}: max_new_tokens must be "
+                                 f"positive, got {b}")
+            if len(p) + b > self.max_len:
+                raise ValueError(
+                    f"request {req}: prompt {len(p)} + {b} new tokens "
+                    f"exceeds max_len {self.max_len}")
+        occupant: list[int | None] = [None] * self.batch
+        self.steps_executed = 0
+
+        def admit_next(row: int) -> None:
+            req = queue.pop(0)
+            tok = jnp.asarray(prompts[req], jnp.int32)[None]
+            self.cache, self.logits = admit_row(
+                self.params, self.cache, self.logits, row, tok, self.cfg)
+            occupant[row] = req
+
+        for row in range(self.batch):
+            if queue:
+                admit_next(row)
+
+        while any(o is not None for o in occupant):
+            toks, self.cache, self.logits = step_rows(
+                self.params, self.cache, self.logits, self.chunk, self.cfg)
+            self.steps_executed += self.chunk
+            host_toks = np.asarray(toks)
+            freed = []
+            for row, req in enumerate(occupant):
+                if req is None:
+                    continue
+                for t in host_toks[row]:
+                    outputs[req].append(int(t))
+                    budget[req] -= 1
+                    if budget[req] == 0 or (self.eos_id is not None
+                                            and int(t) == self.eos_id):
+                        # surplus chunk tokens past completion discarded
+                        occupant[row] = None
+                        freed.append(row)
+                        break
+            for row in freed:
+                if queue:
+                    admit_next(row)
+            # reset ALL unoccupied rows (not just newly freed): a slot
+            # idle across many chunks would otherwise march its garbage
+            # frontier every step until it clamps at the cache end
+            if any(o is None for o in occupant):
+                self.cache = retire_rows(
+                    self.cache,
+                    jnp.asarray([o is None for o in occupant]))
+        return outputs
