@@ -145,6 +145,8 @@ class ContinuousBatcher:
         # validate EVERY request before admitting any: a mid-serve raise
         # would discard completed outputs and strand the batcher state
         for req, (p, b) in enumerate(zip(prompts, budget)):
+            if len(p) == 0:
+                raise ValueError(f"request {req}: empty prompt")
             if b <= 0:
                 raise ValueError(f"request {req}: max_new_tokens must be "
                                  f"positive, got {b}")

@@ -554,10 +554,12 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
         inputs, targets = batch["inputs"], batch["targets"]
     else:
         inputs, targets = batch["tokens"][:, :-1], batch["tokens"][:, 1:]
-    if cfg.num_experts:
+    if cfg.num_experts and mesh.shape.get("ep", 1) > 1:
         raise NotImplementedError(
-            "pp_schedule='1f1b' does not support MoE: the aux-loss side "
-            "channel rides the GPipe schedule only (use 'gpipe')")
+            "pp_schedule='1f1b' supports MoE with REPLICATED experts "
+            "only (no ep axis): the explicit-collective dispatch's psum "
+            "transposes are not exact under the schedule's per-rank "
+            "vjps — shard experts over ep with pp_schedule='gpipe'")
     b, s = inputs.shape
     pp, m = _pp_layout(cfg, mesh, b)
 
@@ -566,6 +568,8 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
         return constrain(x, ("batch", "seq", "embed"), mesh, rules)
 
     x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+
+    with_aux = bool(cfg.num_experts)
 
     def stage_fn(stage_params, h):
         hb, hs = h.shape[0], h.shape[1]
@@ -576,13 +580,15 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
         if cfg.remat:
             block_fn = jax.checkpoint(block_fn, policy=_remat_policy(cfg))
 
-        def body(h, p):
-            h, _aux = block_fn(h, p, rope=rope)
-            return h, None
+        def body(carry, p):
+            h, acc = carry
+            h, aux = block_fn(h, p, rope=rope)
+            return (h, acc + aux), None
 
-        h, _ = jax.lax.scan(body, h, stage_params,
-                            unroll=cfg.scan_unroll)
-        return h
+        (h, aux), _ = jax.lax.scan(
+            body, (h, jnp.zeros((), jnp.float32)), stage_params,
+            unroll=cfg.scan_unroll)
+        return (h, aux) if with_aux else h
 
     # Loss head: vocab-sharded over tp when the mesh can (matching the
     # GPipe arm, where the lm_head stays tp-sharded by propagation) — the
@@ -644,7 +650,8 @@ def lm_value_and_grad(params: dict, batch: dict, cfg: TransformerConfig,
     loss, g_blocks, g_head, dx = pipeline_value_and_grad(
         stage_fn, blocks, x, head_params, targets, mesh,
         loss_head=loss_head, num_microbatches=m,
-        head_specs=head_specs, head_reduce_axes=reduce_axes)
+        head_specs=head_specs, head_reduce_axes=reduce_axes,
+        with_aux=with_aux, aux_weight=cfg.moe_aux_weight)
     (g_embed,) = embed_vjp(dx)
     grads = {
         "embed": g_embed,

@@ -110,7 +110,9 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
                          batch_axes: tuple[str, ...],
                          head_specs: Any = None,
                          stage_specs: Any = None,
-                         head_reduce_axes: tuple[str, ...] = ()) -> tuple:
+                         head_reduce_axes: tuple[str, ...] = (),
+                         with_aux: bool = False,
+                         aux_weight: float = 0.0) -> tuple:
     """Per-device 1F1B body (inside shard_map over ``axis_name``).
 
     The Megatron non-interleaved schedule in closed form — for stage s of
@@ -190,6 +192,8 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
 
         def fwd_branch(resid):
             out = stage_fn(params, inp)
+            if with_aux:
+                out = out[0]        # aux re-derived in the backward tick
             return out, resid.at[fwd_i % s_count].set(inp)
 
         def fwd_noop(resid):
@@ -204,9 +208,29 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
             grads, hgrads, dxs, loss_acc = op
 
             def last_case(_):
+                # aux-path gradients are REPLICATED across the head's
+                # reduce axes (every rank computes the full aux), while
+                # CE-path gradients are per-rank partials (psum_rep in
+                # the loss head) — the reductions below psum BOTH, so
+                # the aux seed pre-divides by the reduce-axes product to
+                # come out exact; the reported loss re-applies the true
+                # weight via the vjp's aux output
+                denom = 1
+                for _ax in head_reduce_axes:
+                    denom = denom * lax.axis_size(_ax)
+                w_eff = aux_weight / denom
+
                 def last_fn(p, hp, x):
-                    return loss_head(hp, stage_fn(p, x), head_mb)
-                lval, vjp_fn = jax.vjp(last_fn, params, head_params, saved)
+                    res = stage_fn(p, x)
+                    if with_aux:
+                        out, aux = res
+                        return (loss_head(hp, out, head_mb)
+                                + w_eff * aux), aux
+                    return loss_head(hp, res, head_mb), jnp.zeros(())
+                lval, vjp_fn, aux_v = jax.vjp(last_fn, params, head_params,
+                                              saved, has_aux=True)
+                lval = lval + (aux_weight - w_eff) * aux_v.astype(
+                    lval.dtype)
                 dp, dhp, dinp = vjp_fn(jnp.ones((), lval.dtype))
                 # head sharded over head_reduce_axes (tp-vocab shards):
                 # each rank's vjp yields the PARTIAL cotangents from its
@@ -252,11 +276,22 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
                 return dp, dhp, dinp, lval.astype(jnp.float32)
 
             def mid_case(_):
-                out2, vjp_fn = jax.vjp(
-                    lambda p, x: stage_fn(p, x), params, saved)
-                dp, dinp = vjp_fn(cot_state)
+                if with_aux:
+                    (out2, aux_v), vjp_fn = jax.vjp(
+                        lambda p, x: stage_fn(p, x), params, saved)
+                    # seed the aux cotangent with its loss weight: one
+                    # vjp covers both the activation path and the
+                    # stage-local aux-loss path
+                    dp, dinp = vjp_fn(
+                        (cot_state, jnp.asarray(aux_weight, aux_v.dtype)))
+                    lval = (aux_weight * aux_v).astype(jnp.float32)
+                else:
+                    out2, vjp_fn = jax.vjp(
+                        lambda p, x: stage_fn(p, x), params, saved)
+                    dp, dinp = vjp_fn(cot_state)
+                    lval = jnp.zeros((), jnp.float32)
                 return (dp, jax.tree.map(jnp.zeros_like, head_params),
-                        dinp, jnp.zeros((), jnp.float32))
+                        dinp, lval)
 
             dp, dhp, dinp, lval = lax.cond(stage == s_count - 1,
                                            last_case, mid_case, None)
@@ -285,7 +320,9 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
     _, _, _, _, dxs, grads, hgrads, loss_acc = carry
 
     last = (stage == s_count - 1)
-    loss = lax.psum(jnp.where(last, loss_acc, 0.0), axis_name) / m
+    # every stage contributes to loss_acc (mid stages their weighted aux,
+    # the last stage CE + aux) — plain psum over pp sums them exactly once
+    loss = lax.psum(loss_acc, axis_name) / m
     hgrads = jax.tree.map(
         lambda g: lax.psum(jnp.where(last, g, jnp.zeros_like(g)),
                            axis_name), hgrads)
@@ -317,7 +354,9 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
                             batch_axes: tuple[str, ...] = ("dp", "fsdp"),
                             param_specs: Any = None,
                             head_specs: Any = None,
-                            head_reduce_axes: tuple[str, ...] = ()):
+                            head_reduce_axes: tuple[str, ...] = (),
+                            with_aux: bool = False,
+                            aux_weight: float = 0.0):
     """1F1B pipeline: loss AND gradients in one schedule.
 
     Same stage contract as :func:`pipeline_apply` (stacked [S, ...]
@@ -369,11 +408,17 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
         # degenerate: no pp axis — same value/grad contract via plain AD
         def total(sp, hp, xs):
             def body(h, p):
+                if with_aux:
+                    out, aux = stage_fn(p, h)
+                    return out, aux
                 return stage_fn(p, h), None
 
             def one_mb(xmb, hmb):
-                out, _ = lax.scan(body, xmb, sp)
-                return loss_head(hp, out, hmb)
+                out, auxes = lax.scan(body, xmb, sp)
+                loss = loss_head(hp, out, hmb)
+                if with_aux:
+                    loss = loss + aux_weight * auxes.sum()
+                return loss
 
             losses = jax.vmap(one_mb)(xs, head_xs)
             return losses.mean()
@@ -397,7 +442,8 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
                            loss_head=loss_head, axis_name=axis_name,
                            batch_axes=live, head_specs=head_specs,
                            stage_specs=param_specs,
-                           head_reduce_axes=head_reduce_axes)
+                           head_reduce_axes=head_reduce_axes,
+                           with_aux=with_aux, aux_weight=aux_weight)
     loss, g_sp, g_hp, g_xs = jax.shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, head_specs, data_spec, data_spec),
