@@ -418,3 +418,76 @@ def test_speculative_commit_policies_and_rounds():
         speculative_generate_device(params, params, prompt, CFG, CFG,
                                     max_new_tokens=8, num_speculative=3,
                                     commit="bogus")
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("window", [0, 5, 16])
+def test_speculative_window_commit_equals_greedy(window):
+    """The bounded-window commit (scatter-free per-row cache writes) is
+    token-identical to greedy across window sizes — including window=5,
+    the minimum legal slack for k=3, where any acceptance divergence
+    immediately clamps. 0 = the 4*(k+1) default."""
+    from tony_tpu.models.decode import speculative_generate_device
+
+    params = T.init_params(jax.random.PRNGKey(0), CFG)
+    draft_params = T.init_params(jax.random.PRNGKey(99), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(21), (4, 6), 0,
+                                CFG.vocab_size)
+    want = generate(params, prompt, CFG, max_new_tokens=9,
+                    rng=jax.random.PRNGKey(0), temperature=0.0)
+    for draft in (params, draft_params):    # self-draft + rejecting draft
+        got = speculative_generate_device(params, draft, prompt, CFG, CFG,
+                                          max_new_tokens=9,
+                                          num_speculative=3,
+                                          commit="window", window=window)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))
+
+
+@pytest.mark.slow
+def test_speculative_window_commit_clamp_forced():
+    """Window commit stays exact when the clamp provably BITES: one row's
+    draft is perfect (its tokens' embeddings untouched) and the other's
+    is sabotaged (draft embeddings corrupted exactly for the tokens its
+    greedy trajectory visits — the rows' trajectories are disjoint for
+    this seed, asserted), so per-row speculation diverges ~k positions
+    per round while window=k+2 allows divergence 1. Also pins the
+    heterogeneity itself via batch-1 round counts, so a model/seed drift
+    that equalised acceptance would fail loudly instead of silently
+    weakening the test."""
+    from tony_tpu.models.decode import speculative_generate_device
+
+    params = T.init_params(jax.random.PRNGKey(0), CFG)
+    prompt = jax.random.randint(jax.random.PRNGKey(0), (2, 6), 0,
+                                CFG.vocab_size)
+    n = 24
+    want = generate(params, prompt, CFG, max_new_tokens=n,
+                    rng=jax.random.PRNGKey(0), temperature=0.0)
+    traj = np.asarray(want.tokens)
+    set_a = set(traj[0].tolist())
+    set_b = set(traj[1][prompt.shape[1]:].tolist())
+    assert not (set_a & set_b), "seed drift: trajectories overlap"
+    corrupt = jnp.asarray(sorted(set_b - set_a), jnp.int32)
+    semi = dict(params, embed=params["embed"].at[corrupt].add(1.0))
+
+    rounds_alone = []
+    for r in range(2):
+        _, rounds = speculative_generate_device(
+            params, semi, prompt[r:r + 1], CFG, CFG, max_new_tokens=n,
+            num_speculative=4, commit="per_row", return_rounds=True)
+        rounds_alone.append(int(rounds))
+    # row 0 speculates near-perfectly, row 1 barely — the batched run's
+    # per-row frontiers MUST hit the window bound
+    assert rounds_alone[0] < rounds_alone[1] // 2, rounds_alone
+
+    for window in (6, 0):          # slack 1 (max clamping) and default
+        got = speculative_generate_device(
+            params, semi, prompt, CFG, CFG, max_new_tokens=n,
+            num_speculative=4, commit="window", window=window)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want.tokens))
+
+    with pytest.raises(ValueError, match="window"):
+        speculative_generate_device(params, semi, prompt, CFG, CFG,
+                                    max_new_tokens=n, num_speculative=4,
+                                    commit="window", window=3)

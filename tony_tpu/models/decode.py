@@ -189,7 +189,50 @@ def _cached_attention(q, k_all, v_all, li, q_start):
     return o.reshape(b, n_q, h, d).astype(q.dtype)
 
 
-def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
+def _window_write(buf_all, chunk, li, pos, window):
+    """Bounded-window per-row cache write: the scatter-free alternative to
+    ``.at[li, b, pos_b + j].set`` when per-row frontiers are guaranteed to
+    lie within ``window`` positions of each other (max(pos) - min(pos) <=
+    window - K — the caller's commit schedule enforces it).
+
+    One contiguous ``window``-wide slice of the stacked cache is read,
+    each row's K-token chunk lands at its own offset via a one-hot
+    einsum (an MXU-shaped [B,W,K]x[B,K,KV*hd] contraction instead of a
+    serialized gather/scatter), and the window is written back with one
+    ``dynamic_update_slice``. Traffic is O(B * window) contiguous rows —
+    independent of max_len and free of scatter lowering. Measured ~25%
+    faster per speculative round than the global-cache scatter at the
+    bench shapes (see docs/performance.md, round 5)."""
+    b, n_k, kv, d = chunk.shape
+    max_len = buf_all.shape[2]
+    # clamp base the way dynamic_slice clamps its start (start <=
+    # max_len - window), so `off` stays relative to where the slice
+    # ACTUALLY lands. This clamp is LOAD-BEARING: near the end of
+    # generation the draft writes' base sits up to k past the slowest
+    # active row and the slice would run off the cache tail — the
+    # caller's sizing argument (speculative_generate_device) only
+    # guarantees the clamp shifts base by <= k-1 rows, which the
+    # offsets absorb because they are computed against the CLAMPED base
+    base = jnp.minimum(jnp.min(pos), max_len - window)
+    # clip is a safety net only: the commit schedule keeps every offset
+    # in [0, window - K] (window-invariant proof in
+    # speculative_generate_device); a clipped frozen-row surrogate writes
+    # garbage into that DEAD row's own cache, which nothing reads
+    off = jnp.clip(pos - base, 0, window - n_k)                 # [B]
+    w_idx = jnp.arange(window)
+    sel = (w_idx[None, :, None]
+           == off[:, None, None] + jnp.arange(n_k)[None, None, :])
+    win = jax.lax.dynamic_slice(
+        buf_all, (li, 0, base, 0, 0),
+        (1, b, window, kv, d))[0]                               # [B, W, KV, hd]
+    upd = jnp.einsum("bwj,bjkd->bwkd", sel.astype(chunk.dtype), chunk)
+    win = jnp.where(sel.any(-1)[..., None, None], upd, win)
+    return jax.lax.dynamic_update_slice(buf_all, win[None],
+                                        (li, 0, base, 0, 0))
+
+
+def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope,
+                  window=None):
     """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1;
     k_all/v_all: the FULL stacked caches [L, B, max_len, KV, hd]; ``li``:
     this layer's static index; ``rope``: (cos, sin) tables precomputed once
@@ -197,8 +240,10 @@ def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
     training forward). Writes only the K-token slice into the stacked
     cache (a layer-scan carrying the caches as xs/ys instead forced XLA to
     COPY the whole cache every decode step — the xs and ys buffers of a
-    scan cannot alias — which dominated decode wall-clock). Returns
-    (x, k_all, v_all)."""
+    scan cannot alias — which dominated decode wall-clock). ``window``
+    (static) selects the bounded-window write for vector ``pos`` whose
+    rows the caller keeps within the window — see :func:`_window_write`.
+    Returns (x, k_all, v_all)."""
     p = layer_params
     cos, sin = rope
 
@@ -215,6 +260,9 @@ def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope):
                                              (li, 0, pos, 0, 0))
         v_all = jax.lax.dynamic_update_slice(v_all, v[None],
                                              (li, 0, pos, 0, 0))
+    elif window is not None:            # bounded divergence: window write
+        k_all = _window_write(k_all, k, li, pos, window)
+        v_all = _window_write(v_all, v, li, pos, window)
     else:                               # per-row frontiers: unique scatter
         b_idx = jnp.arange(k.shape[0])[:, None]
         s_idx = pos[:, None] + jnp.arange(k.shape[1])[None, :]
@@ -250,7 +298,8 @@ def _mlp(h, p, cfg):
 
 
 def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
-                    cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+                    cfg: T.TransformerConfig,
+                    window: int | None = None) -> tuple[jax.Array, dict]:
     """Run the decoder blocks over a K-token chunk, writing its K/V into
     the cache. Returns (block output x [B, K, D], updated cache) — the
     shared body of :func:`extend_step` and the head-free K/V write the
@@ -268,20 +317,23 @@ def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
     for li in range(cfg.n_layers):
         layer_params = jax.tree.map(lambda a: a[li], params["blocks"])
         x, new_k, new_v = _decode_block(
-            x, layer_params, new_k, new_v, li, pos, cfg, rope)
+            x, layer_params, new_k, new_v, li, pos, cfg, rope, window)
     return x, {"k": new_k, "v": new_v, "length": pos + tokens.shape[1]}
 
 
 def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
-                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+                cfg: T.TransformerConfig,
+                window: int | None = None) -> tuple[jax.Array, dict]:
     """Extend the cache with a K-token chunk at positions pos..pos+K-1.
     tokens: [B, K] int32; returns (logits [B, K, V] in
     cfg.logits_storage_dtype — logits[:, i] is the next-token distribution
     AFTER tokens[:, :i+1] — and the updated cache), rounded EXACTLY like
     the training forward so greedy decode agrees with it token for token.
     The chunked verify primitive for speculative decoding; K=1 is the
-    plain decode step."""
-    x, new_cache = _blocks_forward(params, tokens, cache, pos, cfg)
+    plain decode step. ``window`` (static; vector ``pos`` only) routes
+    the K/V writes through the bounded-window path —
+    :func:`_window_write`."""
+    x, new_cache = _blocks_forward(params, tokens, cache, pos, cfg, window)
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
                         preferred_element_type=jnp.float32)
@@ -290,11 +342,13 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
 
 
 def decode_step(params: dict, token: jax.Array, cache: dict, pos,
-                cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+                cfg: T.TransformerConfig,
+                window: int | None = None) -> tuple[jax.Array, dict]:
     """One decode step. token: [B] int32; returns (logits [B, V] in
     cfg.logits_storage_dtype, updated cache). ``pos`` is the position
     being written (traced ok)."""
-    logits, new_cache = extend_step(params, token[:, None], cache, pos, cfg)
+    logits, new_cache = extend_step(params, token[:, None], cache, pos, cfg,
+                                    window)
     return logits[:, 0], new_cache
 
 
@@ -459,14 +513,15 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "draft_cfg", "max_new_tokens", "num_speculative", "commit",
-    "return_rounds"))
+    "window", "return_rounds"))
 def speculative_generate_device(params: dict, draft_params: dict,
                                 prompt: jax.Array,
                                 cfg: T.TransformerConfig,
                                 draft_cfg: T.TransformerConfig,
                                 max_new_tokens: int,
                                 num_speculative: int = 4,
-                                commit: str = "per_row",
+                                commit: str = "window",
+                                window: int = 0,
                                 return_rounds: bool = False) -> jax.Array:
     """Greedy speculative decoding as ONE compiled device program.
 
@@ -496,13 +551,18 @@ def speculative_generate_device(params: dict, draft_params: dict,
     Batch > 1 uses PER-ROW CACHE FRONTIERS: acceptance length is
     data-dependent per row, so the cache ``length`` and every position
     argument generalize to [B] vectors — RoPE positions, causal masks,
-    and the K/V writes (a unique-index scatter instead of a contiguous
-    slice) all take per-row frontiers. Each row commits its OWN
-    ``acc_r + 1`` tokens per round; no row waits for the batch minimum,
-    so tokens/round does not decay as per-row acceptances diverge (the
-    min-commit design this replaced decayed toward 1 with batch). Rows
-    that reach ``max_new_tokens`` freeze (commit clamped to 0) while the
-    rest finish.
+    and the K/V writes all take per-row frontiers. Each row commits its
+    OWN ``acc_r + 1`` tokens per round; no row waits for the batch
+    minimum, so tokens/round does not decay as per-row acceptances
+    diverge (the min-commit design this replaced decayed toward 1 with
+    batch). Rows that reach ``max_new_tokens`` freeze (commit clamped
+    to 0) while the rest finish. ``commit="per_row"`` writes each row's
+    K/V at its own frontier with a unique-index scatter; the default
+    ``commit="window"`` (below) keeps per-row commits but replaces the
+    scatter with a bounded-window write — measured faster at every
+    acceptance level (interleaved medians, one v5e, b8 k=10: +7% at
+    near-perfect acceptance, +4.5% at a mediocre draft, +36% over
+    min-commit).
 
     ``commit="min"`` restores the decayed min-commit schedule (every row
     commits the batch-minimum acceptance) — kept as the measured baseline
@@ -510,6 +570,29 @@ def speculative_generate_device(params: dict, draft_params: dict,
     ``return_rounds=True`` additionally returns the number of
     draft→verify rounds executed (tokens/round = the speculation
     efficiency the sweep records).
+
+    ``commit="window"`` (the default) is per-row commit with the cache
+    writes routed
+    through the scatter-free bounded-window path (:func:`_window_write`):
+    rows commit their own acceptance like ``per_row``, EXCEPT a row more
+    than ``window - (k+1)`` positions ahead of the slowest active row is
+    clamped to that bound, so every round's writes land inside one
+    ``window``-wide contiguous slice of the cache (one dynamic slice +
+    an MXU one-hot merge instead of a global-cache scatter). ``window``
+    defaults to ``4*(k+1)`` — wide enough that clamping only bites when
+    per-row acceptances diverge persistently, at which point the
+    schedule degrades gracefully toward min-commit rather than paying
+    the scatter. Rows that finish FREEZE their true frontier and are
+    excluded from the window base (no drag-along writes are needed: a
+    frozen row's surrogate position is clipped into the active window
+    and its writes are garbage into its own dead cache rows, which
+    nothing reads — its committed tokens already live in the output
+    buffer). Window-invariant (enforced each round, relied on by
+    ``_window_write``): for every active row,
+    ``pos_r - min(active pos) <= window - (k+1)``; the clamp preserves
+    it because the slowest active row is never clamped and every other
+    row is cut to exactly the bound. Token-identical to greedy, same as
+    the other schedules (test-verified, including forced-clamp windows).
 
     Cache discipline (static shapes throughout): the target's stale
     entries from rejected drafts are overwritten by the next round's
@@ -525,7 +608,32 @@ def speculative_generate_device(params: dict, draft_params: dict,
     k = num_speculative
     if k < 1:
         raise ValueError("num_speculative must be >= 1")
-    max_len = s + max_new_tokens + k + 2
+    if commit not in ("per_row", "min", "window"):
+        raise ValueError(f"unknown commit policy {commit!r}")
+    if commit == "window" and b > 1:
+        window = window or 4 * (k + 1)
+        if window < k + 2:
+            raise ValueError(f"window must be >= num_speculative + 2 "
+                             f"(chunk width k+1 plus >= 1 slack), got "
+                             f"{window}")
+        # `window` rows of tail padding suffice: the target-chunk write's
+        # base (= the slowest active row, < s+max_new_tokens) never
+        # clamps, and the draft writes' base (+i <= +k) clamps by at most
+        # c = amin + k - (s+max_new_tokens) <= k-1 rows, which keeps
+        # every K=1 offset at slack + c <= window - 2 — inside the
+        # window (_window_write computes offsets AGAINST the clamped
+        # base, so a clamp shifts the slice, not the write positions).
+        # Oversizing further would inflate the padded cache the dense
+        # attention path reads every step.
+        max_len = s + max_new_tokens + window
+    else:
+        # includes commit="window" at b==1: the window path is a no-op
+        # there (win=None routes to the scalar contiguous-slice writes),
+        # so take per_row's k+2 padding rather than inflating the padded
+        # cache the dense attention reads every step
+        max_len = s + max_new_tokens + k + 2
+    #: per-row divergence bound in window mode (chunk is k+1 wide)
+    slack = window - (k + 1)
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
     _, d_cache = prefill(draft_params, prompt, draft_cfg, max_len)
     # per-row frontiers: vectorize the scalar length prefill produced so
@@ -538,9 +646,6 @@ def speculative_generate_device(params: dict, draft_params: dict,
     buf0 = jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)
     pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)   # [B]
 
-    if commit not in ("per_row", "min"):
-        raise ValueError(f"unknown commit policy {commit!r}")
-
     def _pos_arg(pos):
         """Position argument for the decode stack: at batch 1 per-row and
         uniform frontiers coincide, so hand the cache writers the SCALAR
@@ -548,8 +653,25 @@ def speculative_generate_device(params: dict, draft_params: dict,
         scatter, which measured ~17% slower end-to-end at b1."""
         return pos[0] if b == 1 else pos
 
+    # static per-call write mode: bounded-window writes only make sense
+    # for genuinely per-row (vector) positions
+    win = window if (commit == "window" and b > 1) else None
+
     def round_body(state):
         t_cache, d_cache, buf, n_gen, pending, pos, rounds = state
+
+        if win is not None:
+            # frozen rows (n_gen == max_new_tokens) are excluded from the
+            # window base — otherwise their pinned frontier would stall
+            # the window and deadlock the still-active rows — and fed a
+            # surrogate position clipped into the active window (their
+            # writes/reads are garbage in dead rows; see docstring)
+            active = n_gen < max_new_tokens
+            amin = jnp.min(jnp.where(active, pos, jnp.iinfo(jnp.int32).max))
+            pos_fed = jnp.where(active, pos,
+                                jnp.clip(pos, amin, amin + slack))
+        else:
+            pos_fed = pos
 
         # draft proposes k tokens per row; the LAST proposal's K/V is
         # written eagerly through the head-free block body (no
@@ -557,7 +679,8 @@ def speculative_generate_device(params: dict, draft_params: dict,
         def d_step(carry, i):
             tok, cache = carry
             logits, cache = decode_step(draft_params, tok, cache,
-                                        _pos_arg(pos) + i, draft_cfg)
+                                        _pos_arg(pos_fed) + i, draft_cfg,
+                                        win)
             # keep the carried length [B]-shaped: the scalar-pos fast path
             # (b==1) returns a scalar length, which would flip the scan
             # carry's type
@@ -568,14 +691,15 @@ def speculative_generate_device(params: dict, draft_params: dict,
         (last, d_cache), fed = jax.lax.scan(
             d_step, (pending, d_cache), jnp.arange(k))
         _, d_cache = _blocks_forward(draft_params, last[:, None],
-                                     d_cache, _pos_arg(pos) + k, draft_cfg)
+                                     d_cache, _pos_arg(pos_fed) + k,
+                                     draft_cfg, win)
         proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
         # proposed[0] == pending; drafts are proposed[1:]
         drafts = proposed[1:]                                   # [k, B]
 
         chunk = proposed.T                                      # [B, k+1]
         logits, t_cache = extend_step(params, chunk, t_cache,
-                                      _pos_arg(pos), cfg)
+                                      _pos_arg(pos_fed), cfg, win)
         argmaxes = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         # per-row accepted = longest prefix where draft matched target
         matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
@@ -584,6 +708,21 @@ def speculative_generate_device(params: dict, draft_params: dict,
         # can overrun the buffer slack
         committed = jnp.min(acc) if commit == "min" else acc
         count = jnp.minimum(committed + 1, max_new_tokens - n_gen)  # [B]
+        if win is not None:
+            # window clamp: no row may end the round more than `slack`
+            # past the slowest still-active row. The min-achieving row is
+            # never clamped (count_min + slack >= count_min), so the
+            # post-clamp active minimum EQUALS amin_next and the window
+            # invariant holds next round; every active row still
+            # advances >= 1 (amin_next >= amin + 1 and pos <= amin +
+            # slack give the bound >= 1), so the loop terminates.
+            amin_next = jnp.min(jnp.where(active, pos + count,
+                                          jnp.iinfo(jnp.int32).max))
+            # min-then-max (not clip): a frozen row ahead of the window
+            # has a NEGATIVE bound, and clip(x, 0, neg) is neg under
+            # numpy semantics — the max(..., 0) keeps its count frozen
+            count = jnp.maximum(
+                jnp.minimum(count, amin_next + slack - pos), 0)
         b_idx = jnp.arange(b)[:, None]
         buf = buf.at[b_idx, n_gen[:, None] + jnp.arange(k + 1)[None]].set(
             chunk, unique_indices=True)
