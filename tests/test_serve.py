@@ -7,7 +7,8 @@ import pytest
 
 from tony_tpu.models import transformer as T
 from tony_tpu.models.decode import generate
-from tony_tpu.models.serve import ContinuousBatcher
+from tony_tpu.models.serve import (ContinuousBatcher,
+                                   SpeculativeContinuousBatcher)
 
 CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
 
@@ -123,3 +124,72 @@ class TestContinuousBatching:
             batcher.serve([[1, 2]], max_new_tokens=0)
         with pytest.raises(ValueError, match="empty prompt"):
             batcher.serve([[1, 2], []], max_new_tokens=4)
+
+
+class TestSpeculativeContinuousBatching:
+    """Continuous batching composed with speculative decoding: every
+    slot runs draft-propose/target-verify rounds at its own frontier
+    and commits its own acceptance; slot reuse/retirement identical to
+    the greedy batcher."""
+
+    def test_token_identical_with_slot_reuse(self, params):
+        """7 mixed-length requests with mixed budgets through 3 slots,
+        self-draft and rejecting draft: every request equals its solo
+        greedy generate, and the self-draft (full acceptance) finishes
+        in strictly fewer speculative rounds."""
+        draft = T.init_params(jax.random.PRNGKey(99), CFG)
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(0, CFG.vocab_size,
+                                    size=rng.randint(3, 9)))
+                   for _ in range(7)]
+        budgets = [int(b) for b in rng.randint(4, 14, size=7)]
+        rounds = {}
+        for d, name in ((params, "self"), (draft, "rej")):
+            batcher = SpeculativeContinuousBatcher(
+                params, CFG, d, CFG, batch=3, max_len=64,
+                num_speculative=3, chunk=2)
+            outs = batcher.serve(prompts, budgets)
+            for i, (p, b) in enumerate(zip(prompts, budgets)):
+                assert outs[i] == _reference(params, p, b), (name, i)
+            rounds[name] = batcher.rounds_executed
+        assert rounds["self"] < rounds["rej"]
+
+    def test_eos_frees_slot_early(self, params):
+        """A request hitting eos mid-speculative-chunk stops there (eos
+        included, surplus committed tokens discarded) and its slot is
+        recycled."""
+        rng = np.random.RandomState(2)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (5, 4, 6)]
+        ref0 = _reference(params, prompts[0], 8)
+        eos = ref0[2]
+        batcher = SpeculativeContinuousBatcher(
+            params, CFG, params, CFG, batch=2, max_len=64,
+            num_speculative=4, eos_id=eos, chunk=2)
+        outs = batcher.serve(prompts, max_new_tokens=8)
+        assert outs[0] == ref0[:3]
+        for i in (1, 2):
+            ref = _reference(params, prompts[i], 8)
+            cut = (ref.index(eos) + 1) if eos in ref else 8
+            assert outs[i] == ref[:cut]
+
+    def test_bad_num_speculative_rejected(self, params):
+        with pytest.raises(ValueError, match="num_speculative"):
+            SpeculativeContinuousBatcher(params, CFG, params, CFG,
+                                         batch=2, max_len=32,
+                                         num_speculative=0)
+
+    def test_distinct_draft_config(self, params):
+        """The draft may have a different architecture (the production
+        shape: a much smaller model) — caches sized per-config."""
+        dcfg = CFG.scaled(n_layers=1, d_model=32, n_heads=2, d_ff=64)
+        draft = T.init_params(jax.random.PRNGKey(5), dcfg)
+        rng = np.random.RandomState(7)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=5))
+                   for _ in range(4)]
+        batcher = SpeculativeContinuousBatcher(
+            params, CFG, draft, dcfg, batch=2, max_len=48,
+            num_speculative=3, chunk=3)
+        outs = batcher.serve(prompts, max_new_tokens=7)
+        for i, p in enumerate(prompts):
+            assert outs[i] == _reference(params, p, 7), f"request {i}"

@@ -412,6 +412,57 @@ def _sample(logits, rng, temperature: float, top_k: int):
                                       axis=-1)[:, 0]
 
 
+def _propose_and_verify(params, draft_params, t_cache, d_cache, pending,
+                        pos_arg, cfg, draft_cfg, k, win, token_dtype):
+    """One speculative round, shared by :func:`speculative_generate_device`
+    and the serving path (:class:`tony_tpu.models.serve`'s speculative
+    batcher): the draft proposes ``k`` tokens per row following
+    ``pending`` (a ``lax.scan`` of single steps whose LAST proposal's K/V
+    is written eagerly through the head-free block body), and the target
+    verifies the k+1-wide chunk in one :func:`extend_step`.
+
+    ``pos_arg`` is the position handed to the decode stack — a scalar
+    (uniform frontier fast path) or a [B] vector (per-row frontiers);
+    ``win`` routes vector-position K/V writes through the bounded-window
+    path. Returns ``(chunk [B, k+1], argmaxes [B, k+1], acc [B],
+    t_cache, d_cache)`` where ``chunk[:, 0] == pending``, ``argmaxes``
+    are the target's greedy continuations after each chunk prefix, and
+    ``acc`` is the per-row length of the longest draft prefix the target
+    agreed with. The COMMIT decision (how much of the chunk each row
+    keeps) is the caller's — generation clamps to budgets/windows,
+    serving clamps to nothing."""
+    b = pending.shape[0]
+
+    def d_step(carry, i):
+        tok, cache = carry
+        logits, cache = decode_step(draft_params, tok, cache,
+                                    pos_arg + i, draft_cfg, win)
+        # keep the carried length [B]-shaped: the scalar-pos fast path
+        # (b==1) returns a scalar length, which would flip the scan
+        # carry's type
+        cache = dict(cache, length=jnp.broadcast_to(
+            cache["length"], (b,)).astype(jnp.int32))
+        nxt = jnp.argmax(logits, axis=-1).astype(token_dtype)
+        return (nxt, cache), tok
+
+    (last, d_cache), fed = jax.lax.scan(
+        d_step, (pending, d_cache), jnp.arange(k))
+    _, d_cache = _blocks_forward(draft_params, last[:, None],
+                                 d_cache, pos_arg + k, draft_cfg, win)
+    proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
+    # proposed[0] == pending; drafts are proposed[1:]
+    drafts = proposed[1:]                                   # [k, B]
+
+    chunk = proposed.T                                      # [B, k+1]
+    logits, t_cache = extend_step(params, chunk, t_cache, pos_arg, cfg,
+                                  win)
+    argmaxes = jnp.argmax(logits, axis=-1).astype(token_dtype)
+    # per-row accepted = longest prefix where draft matched target
+    matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
+    acc = jnp.cumprod(matches, axis=1).sum(axis=1)          # [B], 0..k
+    return chunk, argmaxes, acc, t_cache, d_cache
+
+
 def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
                          cfg: T.TransformerConfig,
                          draft_cfg: T.TransformerConfig,
@@ -610,12 +661,16 @@ def speculative_generate_device(params: dict, draft_params: dict,
         raise ValueError("num_speculative must be >= 1")
     if commit not in ("per_row", "min", "window"):
         raise ValueError(f"unknown commit policy {commit!r}")
-    if commit == "window" and b > 1:
+    if commit == "window":
+        # default + validate at ANY batch size (a window accepted at b=1
+        # must not start raising when the batch widens), though the
+        # window write itself only engages at b > 1
         window = window or 4 * (k + 1)
         if window < k + 2:
             raise ValueError(f"window must be >= num_speculative + 2 "
                              f"(chunk width k+1 plus >= 1 slack), got "
                              f"{window}")
+    if commit == "window" and b > 1:
         # `window` rows of tail padding suffice: the target-chunk write's
         # base (= the slowest active row, < s+max_new_tokens) never
         # clamps, and the draft writes' base (+i <= +k) clamps by at most
@@ -673,37 +728,9 @@ def speculative_generate_device(params: dict, draft_params: dict,
         else:
             pos_fed = pos
 
-        # draft proposes k tokens per row; the LAST proposal's K/V is
-        # written eagerly through the head-free block body (no
-        # full-acceptance backfill branch, no wasted lm_head projection)
-        def d_step(carry, i):
-            tok, cache = carry
-            logits, cache = decode_step(draft_params, tok, cache,
-                                        _pos_arg(pos_fed) + i, draft_cfg,
-                                        win)
-            # keep the carried length [B]-shaped: the scalar-pos fast path
-            # (b==1) returns a scalar length, which would flip the scan
-            # carry's type
-            cache = dict(cache, length=jnp.broadcast_to(
-                cache["length"], (b,)).astype(jnp.int32))
-            nxt = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-            return (nxt, cache), tok
-        (last, d_cache), fed = jax.lax.scan(
-            d_step, (pending, d_cache), jnp.arange(k))
-        _, d_cache = _blocks_forward(draft_params, last[:, None],
-                                     d_cache, _pos_arg(pos_fed) + k,
-                                     draft_cfg, win)
-        proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
-        # proposed[0] == pending; drafts are proposed[1:]
-        drafts = proposed[1:]                                   # [k, B]
-
-        chunk = proposed.T                                      # [B, k+1]
-        logits, t_cache = extend_step(params, chunk, t_cache,
-                                      _pos_arg(pos_fed), cfg, win)
-        argmaxes = jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        # per-row accepted = longest prefix where draft matched target
-        matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
-        acc = jnp.cumprod(matches, axis=1).sum(axis=1)          # [B], 0..k
+        chunk, argmaxes, acc, t_cache, d_cache = _propose_and_verify(
+            params, draft_params, t_cache, d_cache, pending,
+            _pos_arg(pos_fed), cfg, draft_cfg, k, win, prompt.dtype)
         # per-row commit, clamped so finished rows freeze and no write
         # can overrun the buffer slack
         committed = jnp.min(acc) if commit == "min" else acc
