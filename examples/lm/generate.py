@@ -34,6 +34,8 @@ def main() -> int:
     parser.add_argument("--max_new_tokens", type=int, default=32)
     parser.add_argument("--temperature", type=float, default=0.8)
     parser.add_argument("--top_k", type=int, default=40)
+    parser.add_argument("--top_p", type=float, default=0.0,
+                        help="nucleus sampling mass (0 = off)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
@@ -54,7 +56,8 @@ def main() -> int:
                                 cfg.vocab_size)
     t0 = time.perf_counter()
     out = generate(params, prompt, cfg, max_new_tokens=args.max_new_tokens,
-                   rng=rng, temperature=args.temperature, top_k=args.top_k)
+                   rng=rng, temperature=args.temperature, top_k=args.top_k,
+                   top_p=args.top_p)
     int(out.tokens[0, -1])   # host fetch: timing must include execution
     n = int(out.tokens.shape[0] * args.max_new_tokens)
     dt = time.perf_counter() - t0

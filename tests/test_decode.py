@@ -83,6 +83,55 @@ class TestDecode:
         assert not np.array_equal(np.asarray(out.tokens),
                                   np.asarray(out2.tokens))
 
+    def test_nucleus_sampling_respects_the_nucleus(self, params):
+        """Every top-p sample lies inside the nucleus a numpy reference
+        computes from the same logits (smallest prefix of the
+        temperature-scaled distribution reaching p, crossing token
+        kept); a tiny p degenerates to greedy argmax."""
+        from tony_tpu.models.decode import _sample
+
+        logits = jax.random.normal(jax.random.PRNGKey(3),
+                                   (4, CFG.vocab_size)) * 3.0
+        temp, p = 0.7, 0.6
+        scaled = np.asarray(logits, np.float64) / temp
+        exp = np.exp(scaled - scaled.max(axis=-1, keepdims=True))
+        probs = exp / exp.sum(axis=-1, keepdims=True)
+        nuclei = []
+        for row in probs:
+            order = np.argsort(-row)
+            cum = np.cumsum(row[order])
+            keep = (cum - row[order]) < p
+            nuclei.append(set(order[keep].tolist()))
+        for seed in range(20):
+            tok, logp = _sample(logits, jax.random.PRNGKey(seed),
+                                temperature=temp, top_k=0, top_p=p)
+            for r in range(4):
+                assert int(tok[r]) in nuclei[r], (seed, r)
+            assert np.all(np.isfinite(np.asarray(logp)))
+        # p -> 0 keeps only the argmax (position 0 is always kept)
+        tok, _ = _sample(logits, jax.random.PRNGKey(0), temperature=temp,
+                         top_k=0, top_p=1e-9)
+        np.testing.assert_array_equal(
+            np.asarray(tok), np.asarray(jnp.argmax(logits, axis=-1)))
+
+    def test_nucleus_generate_end_to_end(self, params):
+        """top_p threads through generate(): valid tokens, and a tiny
+        nucleus reproduces greedy decoding despite temperature > 0."""
+        prompt = jax.random.randint(jax.random.PRNGKey(4), (2, 4), 0,
+                                    CFG.vocab_size)
+        out = generate(params, prompt, CFG, max_new_tokens=5,
+                       rng=jax.random.PRNGKey(7), temperature=0.9,
+                       top_p=0.8)
+        gen = np.asarray(out.tokens[:, 4:])
+        assert (gen >= 0).all() and (gen < CFG.vocab_size).all()
+        greedy = generate(params, prompt, CFG, max_new_tokens=5,
+                          rng=jax.random.PRNGKey(7), temperature=0.0)
+        tiny = generate(params, prompt, CFG, max_new_tokens=5,
+                        rng=jax.random.PRNGKey(7), temperature=0.9,
+                        top_p=1e-9)
+        np.testing.assert_array_equal(np.asarray(tiny.tokens),
+                                      np.asarray(greedy.tokens))
+
     def test_cache_shapes(self):
         cache = init_kv_cache(CFG, batch=2, max_len=32)
         assert cache["k"].shape == (CFG.n_layers, 2, 32, CFG.n_heads,

@@ -126,6 +126,41 @@ class TestContinuousBatching:
             batcher.serve([[1, 2], []], max_new_tokens=4)
 
 
+class TestSampledServing:
+    """temperature/top_k/top_p on the continuous batcher: valid tokens,
+    seed-reproducible workloads, seed-sensitive outputs."""
+
+    def test_sampled_serve_reproducible_by_seed(self, params):
+        rng = np.random.RandomState(5)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=4))
+                   for _ in range(5)]
+
+        def run(seed):
+            b = ContinuousBatcher(params, CFG, batch=2, max_len=32,
+                                  chunk=3, temperature=0.8, top_k=50,
+                                  top_p=0.9, seed=seed)
+            return b.serve(prompts, max_new_tokens=6)
+
+        outs = run(0)
+        for o in outs:
+            assert len(o) == 6
+            assert all(0 <= t < CFG.vocab_size for t in o)
+        assert outs == run(0)          # same seed, same workload
+        assert outs != run(1)          # overwhelmingly likely
+
+    def test_greedy_default_unchanged_by_seed(self, params):
+        rng = np.random.RandomState(6)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=4))
+                   for _ in range(3)]
+        a = ContinuousBatcher(params, CFG, batch=2, max_len=32,
+                              chunk=3, seed=0).serve(prompts, 5)
+        b = ContinuousBatcher(params, CFG, batch=2, max_len=32,
+                              chunk=3, seed=7).serve(prompts, 5)
+        assert a == b
+        for i, p in enumerate(prompts):
+            assert a[i] == _reference(params, p, 5)
+
+
 class TestSpeculativeContinuousBatching:
     """Continuous batching composed with speculative decoding: every
     slot runs draft-propose/target-verify rounds at its own frontier

@@ -391,12 +391,20 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
                     "length": jnp.asarray(s, jnp.int32)}
 
 
-def _sample(logits, rng, temperature: float, top_k: int):
+def _sample(logits, rng, temperature: float, top_k: int,
+            top_p: float = 0.0):
     """logits [B, V] → (token [B], logprob [B]). Math in f32 whatever the
     storage dtype.
 
+    Filters compose in the standard order: top-k mask → temperature →
+    top-p (nucleus: keep the smallest prefix of the temperature-scaled
+    distribution whose cumulative probability reaches ``top_p``; the
+    token that crosses the threshold is kept; 0 disables). Ties at the
+    nucleus cutoff logit are all kept — the usual implementation trade
+    for a sort-free vocab-order mask.
+
     The returned logprob is the MODEL's log p(token) — computed from the
-    raw logits, before top-k masking or temperature — so it is usable for
+    raw logits, before any masking or temperature — so it is usable for
     perplexity / importance weights regardless of sampling settings."""
     logits = logits.astype(jnp.float32)
     model_logp = jax.nn.log_softmax(logits, axis=-1)
@@ -407,7 +415,18 @@ def _sample(logits, rng, temperature: float, top_k: int):
     if temperature == 0.0:
         token = jnp.argmax(logits, axis=-1)
     else:
-        token = jax.random.categorical(rng, logits / temperature, axis=-1)
+        scaled = logits / temperature
+        if 0.0 < top_p < 1.0:
+            desc = -jnp.sort(-scaled, axis=-1)               # descending
+            probs = jax.nn.softmax(desc, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # keep positions whose PRECEDING mass is < top_p (the
+            # crossing token stays; position 0 always kept)
+            kept = (cum - probs) < top_p                     # [B, V]
+            last = kept.sum(axis=-1) - 1                     # [B]
+            cut = jnp.take_along_axis(desc, last[:, None], axis=1)
+            scaled = jnp.where(scaled < cut, -jnp.inf, scaled)
+        token = jax.random.categorical(rng, scaled, axis=-1)
     return token, jnp.take_along_axis(model_logp, token[:, None],
                                       axis=-1)[:, 0]
 
@@ -777,12 +796,15 @@ def speculative_generate_device(params: dict, draft_params: dict,
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "max_new_tokens",
-                                             "temperature", "top_k"))
+                                             "temperature", "top_k",
+                                             "top_p"))
 def generate(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
              max_new_tokens: int, rng: jax.Array,
-             temperature: float = 0.0, top_k: int = 0) -> GenerateOutput:
+             temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0) -> GenerateOutput:
     """Prefill + scan-decode. prompt: [B, S] int32. Greedy when
-    temperature=0. One compiled program; re-traces only on new static
+    temperature=0; ``top_k``/``top_p`` (nucleus) filters compose as in
+    :func:`_sample`. One compiled program; re-traces only on new static
     shapes/config."""
     b, s = prompt.shape
     max_len = s + max_new_tokens
@@ -790,7 +812,7 @@ def generate(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
 
     def step(carry, step_rng):
         logits, cache = carry
-        token, logp = _sample(logits, step_rng, temperature, top_k)
+        token, logp = _sample(logits, step_rng, temperature, top_k, top_p)
         new_logits, cache = decode_step(params, token, cache,
                                         cache["length"], cfg)
         return (new_logits, cache), (token, logp)

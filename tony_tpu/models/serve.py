@@ -19,8 +19,10 @@ shared padded cache. On top of that, three small device programs:
 - :func:`admit_row` — a batch-1 prefill whose K/V land in the retired
   row's cache slot (one contiguous ``dynamic_update_slice`` per buffer)
   and whose last-position logits seed the row's next step;
-- :func:`step_rows` — a ``lax.scan`` of ``n`` per-row greedy decode
-  steps over the whole batch (one dispatch per chunk, not per token);
+- :func:`step_rows` — a ``lax.scan`` of ``n`` per-row decode steps over
+  the whole batch (one dispatch per chunk, not per token; greedy by
+  default, or sampled through the same top-k/temperature/nucleus stack
+  as ``decode.generate``);
 - :func:`retire_rows` — zero the freed rows' frontiers so idle slots
   never walk off the end of the cache.
 
@@ -53,8 +55,8 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
-from tony_tpu.models.decode import (_propose_and_verify, decode_step,
-                                    init_kv_cache, prefill)
+from tony_tpu.models.decode import (_propose_and_verify, _sample,
+                                    decode_step, init_kv_cache, prefill)
 
 
 def _place_prefill(cache, mini, row, s_p):
@@ -84,21 +86,30 @@ def admit_row(params, cache, logits, row, prompt, cfg):
             logits.at[row].set(lg1[0]))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "n"),
+@functools.partial(jax.jit, static_argnames=("cfg", "n", "temperature",
+                                             "top_k", "top_p"),
                    donate_argnames=("cache", "logits"))
-def step_rows(params, cache, logits, n, cfg):
-    """``n`` greedy decode steps for every row at its OWN frontier.
-    Returns (tokens [B, n], cache, logits). Idle rows decode garbage
-    that the host discards — uniform batch math keeps this one compiled
-    program regardless of which rows are live."""
+def step_rows(params, cache, logits, rng, n, cfg, temperature=0.0,
+              top_k=0, top_p=0.0):
+    """``n`` decode steps for every row at its OWN frontier — greedy at
+    ``temperature=0`` (default), otherwise sampled per row through the
+    same filter stack as :func:`tony_tpu.models.decode.generate`
+    (top-k → temperature → nucleus). ``rng``: a PRNGKey, split per step
+    (rows sample independently from one key — ``categorical`` on [B, V]
+    draws per-row). Returns (tokens [B, n], cache, logits). Idle rows
+    decode garbage that the host discards — uniform batch math keeps
+    this one compiled program regardless of which rows are live."""
 
-    def body(carry, _):
+    def body(carry, step_rng):
         lg, c = carry
-        tok = jnp.argmax(lg, axis=-1)
+        # _sample handles temperature==0 as argmax; its unused logprob
+        # output is DCE'd under jit
+        tok, _ = _sample(lg, step_rng, temperature, top_k, top_p)
         lg, c = decode_step(params, tok, c, c["length"], cfg)
         return (lg, c), tok
 
-    (lg, cache), toks = jax.lax.scan(body, (logits, cache), None, length=n)
+    (lg, cache), toks = jax.lax.scan(body, (logits, cache),
+                                     jax.random.split(rng, n))
     return toks.T, cache, lg
 
 
@@ -178,18 +189,38 @@ class ContinuousBatcher:
     ``serve(prompts, max_new_tokens)`` runs every request to completion
     (``max_new_tokens`` or ``eos_id``) through a fixed ``batch`` of cache
     slots, admitting the next queued request the moment a slot frees.
-    Outputs are the same greedy tokens :func:`decode.generate` produces
-    for each request alone (test-verified token-identical on CPU).
+    At the default ``temperature=0`` outputs are the same greedy tokens
+    :func:`decode.generate` produces for each request alone
+    (test-verified token-identical on CPU); with ``temperature``/
+    ``top_k``/``top_p`` set, slots sample through the same filter stack
+    as ``generate`` instead (seed-reproducible per workload — see
+    ``__init__``).
     """
 
     def __init__(self, params, cfg: T.TransformerConfig, batch: int,
                  max_len: int, eos_id: int | None = None,
-                 chunk: int = 8) -> None:
+                 chunk: int = 8, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 seed: int = 0) -> None:
         self.params = params
         self.cfg = cfg
         self.batch = batch
         self.max_len = max_len
         self.eos_id = eos_id
+        #: sampling controls (greedy by default); the rng stream restarts
+        #: from ``seed`` at every serve() call, so a workload re-served
+        #: with the same seed reproduces its outputs — but a request's
+        #: samples depend on its admission timing within the workload,
+        #: not on the request alone (shared stream; acceptable for
+        #: serving, use generate() for per-request reproducibility)
+        self.temperature = temperature
+        self.top_k = top_k
+        self.top_p = top_p
+        self.seed = seed
+        # usable standalone (the _admit/_dispatch seams don't require a
+        # serve() call first); serve() re-seeds for per-workload
+        # reproducibility
+        self._rng = jax.random.PRNGKey(seed)
         #: device steps per host round trip — latency/overhead trade:
         #: a finished row idles at most chunk-1 steps before its slot
         #: is reused
@@ -212,8 +243,10 @@ class ContinuousBatcher:
         (a [B, n] array or list of per-row sequences, in order)."""
         import numpy as np
 
+        self._rng, sub = jax.random.split(self._rng)
         toks, self.cache, self.logits = step_rows(
-            self.params, self.cache, self.logits, self.chunk, self.cfg)
+            self.params, self.cache, self.logits, sub, self.chunk,
+            self.cfg, self.temperature, self.top_k, self.top_p)
         self.steps_executed += self.chunk
         return np.asarray(toks)
 
@@ -251,6 +284,7 @@ class ContinuousBatcher:
         occupant: list[int | None] = [None] * self.batch
         self.steps_executed = 0
         self.rounds_executed = 0
+        self._rng = jax.random.PRNGKey(self.seed)
 
         def admit_next(row: int) -> None:
             req = queue.pop(0)
@@ -316,7 +350,13 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
     step-utilization reading remains meaningful — useful tokens /
     (steps_executed * slots) is the fraction of verified positions that
     became committed tokens (acceptance efficiency × occupancy).
-    ``rounds_executed`` counts speculative rounds."""
+    ``rounds_executed`` counts speculative rounds.
+
+    Greedy-only: draft/verify acceptance is defined against the
+    target's argmax chain, so the base class's sampling knobs do not
+    apply here (speculative SAMPLING — rejection-sampling the draft
+    distribution against the target's — is a different scheme; use the
+    greedy batcher with ``temperature>0`` for sampled serving)."""
 
     def __init__(self, params, cfg: T.TransformerConfig,
                  draft_params, draft_cfg: T.TransformerConfig,
