@@ -559,6 +559,34 @@ def _speculative_arm(new: int = 256, k: int = 10):
             new / r_mc, 2)
         out[f"spec_b8_window_tokens_per_round{name}"] = round(
             new / r_wd, 2)
+    # speculative SAMPLING (temperature > 0): same round machinery with
+    # the min(1, p/q) accept test — committed stream distributed as
+    # direct target sampling; the win rides the draft's acceptance just
+    # like the greedy case. Temperature-only on this task: sampling
+    # wanders OFF the deterministic affine chain, and on those
+    # out-of-distribution contexts the toy draft's nucleus no longer
+    # overlaps the target's — top_p=0.9 measured acceptance collapse
+    # (1.17 tokens/round, 0.13x) where temperature-only holds 4.8
+    # tokens/round (see docs/performance.md)
+    gen_s = functools.partial(generate, cfg=cfg_t, max_new_tokens=new,
+                              temperature=0.9)
+    spec_s = jax.jit(functools.partial(
+        speculative_generate_device, cfg=cfg_t, draft_cfg=cfg_d,
+        max_new_tokens=new, num_speculative=k, temperature=0.9))
+    og = gen_s(p_t, b8, rng=jax.random.PRNGKey(0)); int(og.tokens[0, -1])
+    os_ = spec_s(p_t, p_d, b8, rng=jax.random.PRNGKey(0)); int(os_[0, -1])
+    t0 = time.perf_counter()
+    for i in range(3):
+        og = gen_s(p_t, b8, rng=jax.random.PRNGKey(i))
+    int(og.tokens[0, -1])
+    t_gs = (time.perf_counter() - t0) / 3
+    t0 = time.perf_counter()
+    for i in range(3):
+        os_ = spec_s(p_t, p_d, b8, rng=jax.random.PRNGKey(i))
+    int(os_[0, -1])
+    t_ss = (time.perf_counter() - t0) / 3
+    out["spec_b8_sampled_vs_sampled"] = round(t_gs / t_ss, 2)
+
     out.update(_spec_serving_arm(cfg_t, cfg_d, p_t, p_d,
                                  make_data, new=new, k=k))
     return out

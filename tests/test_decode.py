@@ -540,3 +540,80 @@ def test_speculative_window_commit_clamp_forced():
         speculative_generate_device(params, semi, prompt, CFG, CFG,
                                     max_new_tokens=n, num_speculative=4,
                                     commit="window", window=3)
+
+
+class TestSpeculativeSampling:
+    """Rejection-sampling speculation (temperature > 0): committed
+    tokens are distributed exactly as target-only sampling, for any
+    draft."""
+
+    SCFG = T.TransformerConfig(vocab_size=11, d_model=24, n_layers=2,
+                               n_heads=2, d_ff=48, max_seq=1024,
+                               dtype=jnp.float32,
+                               logits_dtype=jnp.float32, remat=False)
+
+    def test_requires_rng(self):
+        from tony_tpu.models.decode import speculative_generate_device
+
+        params = T.init_params(jax.random.PRNGKey(0), self.SCFG)
+        prompt = jnp.asarray([[3, 7, 1, 5]], jnp.int32)
+        with pytest.raises(ValueError, match="rng"):
+            speculative_generate_device(params, params, prompt, self.SCFG,
+                                        self.SCFG, max_new_tokens=4,
+                                        num_speculative=2, temperature=0.8)
+
+    def test_self_draft_accepts_everything(self):
+        """With draft == target and no filters the accept ratio is
+        exactly 1, so the round count is deterministic:
+        ceil(max_new / (k+1))."""
+        from tony_tpu.models.decode import speculative_generate_device
+
+        params = T.init_params(jax.random.PRNGKey(0), self.SCFG)
+        prompt = jnp.asarray([[3, 7, 1, 5]], jnp.int32).repeat(4, 0)
+        _, rounds = speculative_generate_device(
+            params, params, prompt, self.SCFG, self.SCFG,
+            max_new_tokens=12, num_speculative=3, temperature=1.0,
+            rng=jax.random.PRNGKey(5), return_rounds=True)
+        assert int(rounds) == 3
+
+    @pytest.mark.slow
+    def test_matches_target_distribution_any_draft(self):
+        """The core guarantee, measured: the 2-token joint distribution
+        of speculative sampling with a MISMATCHED draft (a different
+        random model) matches direct target sampling to sampling noise
+        (TV ~ 0.05 at ~3k samples), while the draft's own distribution
+        is far away (TV ~ 0.7) — so the tolerance has discriminating
+        power. Run under the bounded-window commit with the minimum
+        window so the clamped-pending path (accepted-token-at-the-cut)
+        is exercised too."""
+        from tony_tpu.models.decode import speculative_generate_device
+
+        cfg = self.SCFG
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        draft = T.init_params(jax.random.PRNGKey(99), cfg)
+        pm = jnp.asarray([[3, 7, 1, 5]], jnp.int32).repeat(512, 0)
+        n = 2
+
+        def joint(fn, seed0, batches=6):
+            c = np.zeros((cfg.vocab_size, cfg.vocab_size))
+            for i in range(batches):
+                a = fn(jax.random.PRNGKey(seed0 + i))
+                for r in a:
+                    c[r[0], r[1]] += 1
+            return c / c.sum()
+
+        ref = joint(lambda key: np.asarray(generate(
+            params, pm, cfg, max_new_tokens=n, rng=key, temperature=0.9,
+            top_p=0.85).tokens[:, -n:]), 200)
+        spec = joint(lambda key: np.asarray(speculative_generate_device(
+            params, draft, pm, cfg, cfg, max_new_tokens=n,
+            num_speculative=3, temperature=0.9, top_p=0.85,
+            commit="window", window=5, rng=key)[:, -n:]), 100)
+        draft_only = joint(lambda key: np.asarray(generate(
+            draft, pm, cfg, max_new_tokens=n, rng=key, temperature=0.9,
+            top_p=0.85).tokens[:, -n:]), 300)
+
+        tv_spec = 0.5 * np.abs(spec - ref).sum()
+        tv_draft = 0.5 * np.abs(draft_only - ref).sum()
+        assert tv_spec < 0.1, tv_spec
+        assert tv_draft > 0.3, tv_draft    # the test can tell them apart

@@ -391,68 +391,82 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
                     "length": jnp.asarray(s, jnp.int32)}
 
 
+def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
+    """The sampling filter stack on [..., V] f32 logits: top-k mask →
+    temperature → top-p nucleus mask (keep the smallest prefix of the
+    temperature-scaled distribution whose cumulative probability reaches
+    ``top_p``; the crossing token stays; ties at the cutoff logit are
+    all kept — the usual trade for a sort-free vocab-order mask; 0
+    disables). Returns unnormalized log-space logits whose softmax IS
+    the sampling distribution — shared by ad-hoc sampling
+    (:func:`_sample`) and speculative SAMPLING, where the accept ratio
+    must be computed against exactly the filtered distributions both
+    models sample from. ``temperature`` must be > 0 here (the greedy
+    case never needs a distribution)."""
+    if top_k > 0:
+        vals, _ = jax.lax.top_k(logits, top_k)
+        cutoff = vals[..., -1][..., None]
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    scaled = logits / temperature
+    if 0.0 < top_p < 1.0:
+        desc = -jnp.sort(-scaled, axis=-1)                   # descending
+        probs = jax.nn.softmax(desc, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep positions whose PRECEDING mass is < top_p (the crossing
+        # token stays; position 0 always kept)
+        kept = (cum - probs) < top_p
+        last = kept.sum(axis=-1) - 1
+        cut = jnp.take_along_axis(desc, last[..., None], axis=-1)
+        scaled = jnp.where(scaled < cut, -jnp.inf, scaled)
+    return scaled
+
+
 def _sample(logits, rng, temperature: float, top_k: int,
             top_p: float = 0.0):
     """logits [B, V] → (token [B], logprob [B]). Math in f32 whatever the
-    storage dtype.
-
-    Filters compose in the standard order: top-k mask → temperature →
-    top-p (nucleus: keep the smallest prefix of the temperature-scaled
-    distribution whose cumulative probability reaches ``top_p``; the
-    token that crosses the threshold is kept; 0 disables). Ties at the
-    nucleus cutoff logit are all kept — the usual implementation trade
-    for a sort-free vocab-order mask.
+    storage dtype. Filters compose per :func:`_filter_logits`.
 
     The returned logprob is the MODEL's log p(token) — computed from the
     raw logits, before any masking or temperature — so it is usable for
     perplexity / importance weights regardless of sampling settings."""
     logits = logits.astype(jnp.float32)
     model_logp = jax.nn.log_softmax(logits, axis=-1)
-    if top_k > 0:
-        vals, _ = jax.lax.top_k(logits, top_k)
-        cutoff = vals[:, -1][:, None]
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     if temperature == 0.0:
+        # top-k cannot change an argmax (the argmax is in every top-k)
         token = jnp.argmax(logits, axis=-1)
     else:
-        scaled = logits / temperature
-        if 0.0 < top_p < 1.0:
-            desc = -jnp.sort(-scaled, axis=-1)               # descending
-            probs = jax.nn.softmax(desc, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
-            # keep positions whose PRECEDING mass is < top_p (the
-            # crossing token stays; position 0 always kept)
-            kept = (cum - probs) < top_p                     # [B, V]
-            last = kept.sum(axis=-1) - 1                     # [B]
-            cut = jnp.take_along_axis(desc, last[:, None], axis=1)
-            scaled = jnp.where(scaled < cut, -jnp.inf, scaled)
-        token = jax.random.categorical(rng, scaled, axis=-1)
+        token = jax.random.categorical(
+            rng, _filter_logits(logits, temperature, top_k, top_p),
+            axis=-1)
     return token, jnp.take_along_axis(model_logp, token[:, None],
                                       axis=-1)[:, 0]
 
 
-def _propose_and_verify(params, draft_params, t_cache, d_cache, pending,
-                        pos_arg, cfg, draft_cfg, k, win, token_dtype):
-    """One speculative round, shared by :func:`speculative_generate_device`
-    and the serving path (:class:`tony_tpu.models.serve`'s speculative
-    batcher): the draft proposes ``k`` tokens per row following
-    ``pending`` (a ``lax.scan`` of single steps whose LAST proposal's K/V
-    is written eagerly through the head-free block body), and the target
+def _propose_chunk(params, draft_params, t_cache, d_cache, pending,
+                   pos_arg, cfg, draft_cfg, k, win, token_dtype,
+                   propose, extra_xs):
+    """The draft-propose + target-verify scaffold shared by the greedy
+    and sampled speculative rounds: the draft runs ``k`` single steps
+    following ``pending`` (a ``lax.scan``; the LAST proposal's K/V is
+    written eagerly through the head-free block body), then the target
     verifies the k+1-wide chunk in one :func:`extend_step`.
+
+    ``propose(logits, x)`` picks each draft step's next token from the
+    draft's [B, V] logits (``x`` is that step's element of
+    ``extra_xs`` — rng keys for sampling, unused for greedy) and
+    returns ``(token [B], aux)``; the per-step ``aux`` pytrees come
+    back stacked (the sampled round collects the draft's sampling
+    distributions this way).
 
     ``pos_arg`` is the position handed to the decode stack — a scalar
     (uniform frontier fast path) or a [B] vector (per-row frontiers);
     ``win`` routes vector-position K/V writes through the bounded-window
-    path. Returns ``(chunk [B, k+1], argmaxes [B, k+1], acc [B],
-    t_cache, d_cache)`` where ``chunk[:, 0] == pending``, ``argmaxes``
-    are the target's greedy continuations after each chunk prefix, and
-    ``acc`` is the per-row length of the longest draft prefix the target
-    agreed with. The COMMIT decision (how much of the chunk each row
-    keeps) is the caller's — generation clamps to budgets/windows,
-    serving clamps to nothing."""
+    path. Returns ``(chunk [B, k+1], auxes, logits [B, k+1, V],
+    t_cache, d_cache)`` with ``chunk[:, 0] == pending``."""
     b = pending.shape[0]
 
-    def d_step(carry, i):
+    def d_step(carry, xs):
+        i, x = xs
         tok, cache = carry
         logits, cache = decode_step(draft_params, tok, cache,
                                     pos_arg + i, draft_cfg, win)
@@ -461,25 +475,112 @@ def _propose_and_verify(params, draft_params, t_cache, d_cache, pending,
         # carry's type
         cache = dict(cache, length=jnp.broadcast_to(
             cache["length"], (b,)).astype(jnp.int32))
-        nxt = jnp.argmax(logits, axis=-1).astype(token_dtype)
-        return (nxt, cache), tok
+        nxt, aux = propose(logits, x)
+        return (nxt.astype(token_dtype), cache), (tok, aux)
 
-    (last, d_cache), fed = jax.lax.scan(
-        d_step, (pending, d_cache), jnp.arange(k))
+    (last, d_cache), (fed, auxes) = jax.lax.scan(
+        d_step, (pending, d_cache), (jnp.arange(k), extra_xs))
     _, d_cache = _blocks_forward(draft_params, last[:, None],
                                  d_cache, pos_arg + k, draft_cfg, win)
-    proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
     # proposed[0] == pending; drafts are proposed[1:]
-    drafts = proposed[1:]                                   # [k, B]
-
+    proposed = jnp.concatenate([fed, last[None]])           # [k+1, B]
     chunk = proposed.T                                      # [B, k+1]
     logits, t_cache = extend_step(params, chunk, t_cache, pos_arg, cfg,
                                   win)
+    return chunk, auxes, logits, t_cache, d_cache
+
+
+def _propose_and_verify(params, draft_params, t_cache, d_cache, pending,
+                        pos_arg, cfg, draft_cfg, k, win, token_dtype):
+    """One GREEDY speculative round, shared by
+    :func:`speculative_generate_device` and the serving path
+    (:class:`tony_tpu.models.serve`'s speculative batcher), built on
+    :func:`_propose_chunk`. Returns ``(chunk [B, k+1],
+    argmaxes [B, k+1], acc [B], t_cache, d_cache)`` where ``argmaxes``
+    are the target's greedy continuations after each chunk prefix and
+    ``acc`` is the per-row length of the longest draft prefix the
+    target agreed with. The COMMIT decision (how much of the chunk each
+    row keeps) is the caller's — generation clamps to budgets/windows,
+    serving clamps to nothing."""
+    chunk, _, logits, t_cache, d_cache = _propose_chunk(
+        params, draft_params, t_cache, d_cache, pending, pos_arg, cfg,
+        draft_cfg, k, win, token_dtype,
+        propose=lambda lg, _: (jnp.argmax(lg, axis=-1), ()),
+        extra_xs=jnp.zeros((k,), jnp.int32))
     argmaxes = jnp.argmax(logits, axis=-1).astype(token_dtype)
     # per-row accepted = longest prefix where draft matched target
-    matches = (drafts.T == argmaxes[:, :k]).astype(jnp.int32)
+    matches = (chunk[:, 1:] == argmaxes[:, :k]).astype(jnp.int32)
     acc = jnp.cumprod(matches, axis=1).sum(axis=1)          # [B], 0..k
     return chunk, argmaxes, acc, t_cache, d_cache
+
+
+def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
+                                pending, pos_arg, cfg, draft_cfg, k, win,
+                                token_dtype, rng, temperature, top_k,
+                                top_p):
+    """One SPECULATIVE-SAMPLING round (the rejection-sampling
+    counterpart of :func:`_propose_and_verify`): the draft SAMPLES k
+    tokens from its filtered distribution q, the target verifies the
+    chunk once, and each proposal x_i is accepted with probability
+    ``min(1, p_i(x_i)/q_i(x_i))`` — the classic scheme whose committed
+    tokens are distributed EXACTLY as target-only sampling from the
+    filtered p, for any draft. On the first rejection the round's extra
+    token is drawn from the residual ``normalize(max(p - q, 0))``; on
+    full acceptance, from the bonus position's p (equivalently: residual
+    against q = 0). Both models' distributions run through the SAME
+    filter stack (:func:`_filter_logits`) — filtering only p or only q
+    would break the guarantee.
+
+    Returns ``(chunk [B, k+1], extra [B], acc [B], t_cache, d_cache)``:
+    ``chunk[:, :acc+1]`` are committable tokens and ``extra`` is the
+    round's residual/bonus sample — the next ``pending`` when the caller
+    commits the full ``acc + 1``. A caller clamping its commit BELOW
+    ``acc + 1`` (budget/window) must take ``chunk[:, count]`` as pending
+    instead: an accepted draft token is itself a faithful sample of
+    p( · | chunk[:count]) — that is precisely what acceptance certifies
+    — while ``extra`` belongs to the deeper position only.
+
+    The accept test is ``u * q(x) < p(x)`` (never divides; q(x) > 0
+    because x was sampled from q). All probability math in f32."""
+    b = pending.shape[0]
+    d_rng, u_rng, r_rng = jax.random.split(rng, 3)
+    vocab = cfg.vocab_size
+
+    def propose(logits, key):
+        f = _filter_logits(logits.astype(jnp.float32), temperature,
+                           top_k, top_p)
+        return (jax.random.categorical(key, f, axis=-1),
+                jax.nn.softmax(f, axis=-1))
+
+    chunk, qs, logits, t_cache, d_cache = _propose_chunk(
+        params, draft_params, t_cache, d_cache, pending, pos_arg, cfg,
+        draft_cfg, k, win, token_dtype,
+        propose=propose, extra_xs=jax.random.split(d_rng, k))
+    p = jax.nn.softmax(_filter_logits(logits.astype(jnp.float32),
+                                      temperature, top_k, top_p),
+                       axis=-1)                             # [B, k+1, V]
+    x = chunk[:, 1:].astype(jnp.int32)[..., None]           # [B, k, 1]
+    qx = jnp.take_along_axis(qs.transpose(1, 0, 2), x, axis=2)[..., 0]
+    px = jnp.take_along_axis(p[:, :k], x, axis=2)[..., 0]   # [B, k]
+    u = jax.random.uniform(u_rng, (b, k))
+    accept = (u * qx < px).astype(jnp.int32)
+    acc = jnp.cumprod(accept, axis=1).sum(axis=1)           # [B], 0..k
+
+    # residual/bonus at the decision position: q rows padded with a zero
+    # slab so acc == k selects residual against 0, i.e. the bonus p
+    sel = acc[:, None, None]                                # [B, 1, 1]
+    p_sel = jnp.take_along_axis(p, sel, axis=1)[:, 0]       # [B, V]
+    q_pad = jnp.concatenate(
+        [qs.transpose(1, 0, 2),
+         jnp.zeros((b, 1, vocab), jnp.float32)], axis=1)
+    q_sel = jnp.take_along_axis(q_pad, sel, axis=1)[:, 0]
+    res = jnp.maximum(p_sel - q_sel, 0.0)
+    # numeric guard: mathematically res sums to > 0 whenever a rejection
+    # happened, but f32 cancellation can zero it — fall back to p
+    res = jnp.where(res.sum(-1, keepdims=True) > 0, res, p_sel)
+    extra = jax.random.categorical(r_rng, jnp.log(res),
+                                   axis=-1).astype(token_dtype)
+    return chunk, extra, acc, t_cache, d_cache
 
 
 def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
@@ -583,7 +684,7 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
 
 @functools.partial(jax.jit, static_argnames=(
     "cfg", "draft_cfg", "max_new_tokens", "num_speculative", "commit",
-    "window", "return_rounds"))
+    "window", "temperature", "top_k", "top_p", "return_rounds"))
 def speculative_generate_device(params: dict, draft_params: dict,
                                 prompt: jax.Array,
                                 cfg: T.TransformerConfig,
@@ -592,6 +693,10 @@ def speculative_generate_device(params: dict, draft_params: dict,
                                 num_speculative: int = 4,
                                 commit: str = "window",
                                 window: int = 0,
+                                temperature: float = 0.0,
+                                top_k: int = 0,
+                                top_p: float = 0.0,
+                                rng: jax.Array | None = None,
                                 return_rounds: bool = False) -> jax.Array:
     """Greedy speculative decoding as ONE compiled device program.
 
@@ -673,6 +778,19 @@ def speculative_generate_device(params: dict, draft_params: dict,
     full k+1-wide rows at each row's own offset: positions past the
     committed count are garbage that the next round's write (which starts
     exactly there) or the final slice removes.
+
+    ``temperature > 0`` switches every round to SPECULATIVE SAMPLING
+    (:func:`_propose_and_verify_sampled`, rng required): the draft
+    samples its proposals, the target accept/rejects each with the
+    classic ``min(1, p/q)`` test, and the committed stream is
+    distributed exactly as target-only sampling through the same
+    ``top_k``/``top_p`` filter stack as :func:`generate` — for ANY
+    draft (a bad draft costs rounds, never correctness;
+    distribution-verified against direct sampling in the tests). All
+    commit schedules compose: a row clamped below its acceptance takes
+    the accepted draft token at the cut as its next pending (itself a
+    faithful sample at that position), the unclamped row takes the
+    round's residual/bonus sample.
     """
     b, s = prompt.shape
     k = num_speculative
@@ -680,6 +798,9 @@ def speculative_generate_device(params: dict, draft_params: dict,
         raise ValueError("num_speculative must be >= 1")
     if commit not in ("per_row", "min", "window"):
         raise ValueError(f"unknown commit policy {commit!r}")
+    if temperature > 0.0 and rng is None:
+        raise ValueError("speculative sampling (temperature > 0) "
+                         "requires an rng key")
     if commit == "window":
         # default + validate at ANY batch size (a window accepted at b=1
         # must not start raising when the batch widens), though the
@@ -718,7 +839,14 @@ def speculative_generate_device(params: dict, draft_params: dict,
     # new tokens land here; k+1 slack for the final round's overshoot
     # (commits clamp so no row's write can start past max_new_tokens)
     buf0 = jnp.zeros((b, max_new_tokens + k + 1), prompt.dtype)
-    pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)   # [B]
+    if temperature > 0.0:
+        rng, p0_rng = jax.random.split(rng)
+        pending0 = jax.random.categorical(
+            p0_rng, _filter_logits(t_logits.astype(jnp.float32),
+                                   temperature, top_k, top_p),
+            axis=-1).astype(prompt.dtype)
+    else:
+        pending0 = jnp.argmax(t_logits, axis=-1).astype(prompt.dtype)
 
     def _pos_arg(pos):
         """Position argument for the decode stack: at batch 1 per-row and
@@ -732,7 +860,12 @@ def speculative_generate_device(params: dict, draft_params: dict,
     win = window if (commit == "window" and b > 1) else None
 
     def round_body(state):
-        t_cache, d_cache, buf, n_gen, pending, pos, rounds = state
+        if temperature > 0.0:
+            (t_cache, d_cache, buf, n_gen, pending, pos, rounds,
+             cur_rng) = state
+            cur_rng, round_rng = jax.random.split(cur_rng)
+        else:
+            t_cache, d_cache, buf, n_gen, pending, pos, rounds = state
 
         if win is not None:
             # frozen rows (n_gen == max_new_tokens) are excluded from the
@@ -747,9 +880,16 @@ def speculative_generate_device(params: dict, draft_params: dict,
         else:
             pos_fed = pos
 
-        chunk, argmaxes, acc, t_cache, d_cache = _propose_and_verify(
-            params, draft_params, t_cache, d_cache, pending,
-            _pos_arg(pos_fed), cfg, draft_cfg, k, win, prompt.dtype)
+        if temperature > 0.0:
+            chunk, extra, acc, t_cache, d_cache = (
+                _propose_and_verify_sampled(
+                    params, draft_params, t_cache, d_cache, pending,
+                    _pos_arg(pos_fed), cfg, draft_cfg, k, win,
+                    prompt.dtype, round_rng, temperature, top_k, top_p))
+        else:
+            chunk, argmaxes, acc, t_cache, d_cache = _propose_and_verify(
+                params, draft_params, t_cache, d_cache, pending,
+                _pos_arg(pos_fed), cfg, draft_cfg, k, win, prompt.dtype)
         # per-row commit, clamped so finished rows freeze and no write
         # can overrun the buffer slack
         committed = jnp.min(acc) if commit == "min" else acc
@@ -772,16 +912,29 @@ def speculative_generate_device(params: dict, draft_params: dict,
         b_idx = jnp.arange(b)[:, None]
         buf = buf.at[b_idx, n_gen[:, None] + jnp.arange(k + 1)[None]].set(
             chunk, unique_indices=True)
-        sel = jnp.clip(count - 1, 0, k)
-        corr = jnp.take_along_axis(argmaxes, sel[:, None], axis=1)[:, 0]
-        new_pending = jnp.where(count > 0, corr, pending)
+        if temperature > 0.0:
+            # committed in full: the residual/bonus sample continues the
+            # stream; clamped below acc+1: the accepted draft token AT
+            # the cut is itself a faithful sample there (see
+            # _propose_and_verify_sampled)
+            cont = jnp.take_along_axis(
+                chunk, jnp.clip(count, 0, k)[:, None], axis=1)[:, 0]
+            new_pending = jnp.where(
+                count > 0, jnp.where(count == acc + 1, extra, cont),
+                pending)
+        else:
+            sel = jnp.clip(count - 1, 0, k)
+            corr = jnp.take_along_axis(argmaxes, sel[:, None],
+                                       axis=1)[:, 0]
+            new_pending = jnp.where(count > 0, corr, pending)
         n_gen = n_gen + count
         pos = pos + count
         # rollback: stale cache entries past each row's pos are rewritten
         # by the next round's chunk before any query reaches them
         t_cache = dict(t_cache, length=pos.astype(jnp.int32))
         d_cache = dict(d_cache, length=pos.astype(jnp.int32))
-        return (t_cache, d_cache, buf, n_gen, new_pending, pos, rounds + 1)
+        out = (t_cache, d_cache, buf, n_gen, new_pending, pos, rounds + 1)
+        return out + (cur_rng,) if temperature > 0.0 else out
 
     def cond(state):
         return jnp.min(state[3]) < max_new_tokens
@@ -789,8 +942,10 @@ def speculative_generate_device(params: dict, draft_params: dict,
     state0 = (t_cache, d_cache, buf0,
               jnp.zeros((b,), jnp.int32), pending0,
               jnp.full((b,), s, jnp.int32), jnp.asarray(0, jnp.int32))
-    _, _, buf, _, _, _, rounds = jax.lax.while_loop(cond, round_body,
-                                                    state0)
+    if temperature > 0.0:
+        state0 = state0 + (rng,)
+    final = jax.lax.while_loop(cond, round_body, state0)
+    buf, rounds = final[2], final[6]
     tokens = jnp.concatenate([prompt, buf[:, :max_new_tokens]], axis=1)
     return (tokens, rounds) if return_rounds else tokens
 
