@@ -560,7 +560,8 @@ def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
                                       temperature, top_k, top_p),
                        axis=-1)                             # [B, k+1, V]
     x = chunk[:, 1:].astype(jnp.int32)[..., None]           # [B, k, 1]
-    qx = jnp.take_along_axis(qs.transpose(1, 0, 2), x, axis=2)[..., 0]
+    q_bkv = qs.transpose(1, 0, 2)                           # [B, k, V]
+    qx = jnp.take_along_axis(q_bkv, x, axis=2)[..., 0]
     px = jnp.take_along_axis(p[:, :k], x, axis=2)[..., 0]   # [B, k]
     u = jax.random.uniform(u_rng, (b, k))
     accept = (u * qx < px).astype(jnp.int32)
@@ -571,8 +572,7 @@ def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
     sel = acc[:, None, None]                                # [B, 1, 1]
     p_sel = jnp.take_along_axis(p, sel, axis=1)[:, 0]       # [B, V]
     q_pad = jnp.concatenate(
-        [qs.transpose(1, 0, 2),
-         jnp.zeros((b, 1, vocab), jnp.float32)], axis=1)
+        [q_bkv, jnp.zeros((b, 1, vocab), jnp.float32)], axis=1)
     q_sel = jnp.take_along_axis(q_pad, sel, axis=1)[:, 0]
     res = jnp.maximum(p_sel - q_sel, 0.0)
     # numeric guard: mathematically res sums to > 0 whenever a rejection
@@ -698,7 +698,9 @@ def speculative_generate_device(params: dict, draft_params: dict,
                                 top_p: float = 0.0,
                                 rng: jax.Array | None = None,
                                 return_rounds: bool = False) -> jax.Array:
-    """Greedy speculative decoding as ONE compiled device program.
+    """Speculative decoding as ONE compiled device program — greedy
+    (token-exact) by default, rejection-SAMPLED (distribution-exact)
+    at ``temperature > 0``.
 
     The host-driven :func:`speculative_generate` syncs with the device
     every round for the acceptance decision — a network round trip per

@@ -55,7 +55,8 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
-from tony_tpu.models.decode import (_propose_and_verify, _sample,
+from tony_tpu.models.decode import (_filter_logits, _propose_and_verify,
+                                    _propose_and_verify_sampled, _sample,
                                     decode_step, init_kv_cache, prefill)
 
 
@@ -120,29 +121,43 @@ def retire_rows(cache, mask):
     return dict(cache, length=jnp.where(mask, 0, cache["length"]))
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg"),
+@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg",
+                                             "temperature", "top_k",
+                                             "top_p"),
                    donate_argnames=("t_cache", "d_cache", "pending"))
 def spec_admit_row(params, draft_params, t_cache, d_cache, pending, row,
-                   prompt, cfg, draft_cfg):
+                   prompt, rng, cfg, draft_cfg, temperature=0.0,
+                   top_k=0, top_p=0.0):
     """Speculative admission: prefill BOTH models on the prompt into
     cache slot ``row`` (the draft keeps its own per-slot K/V history) and
     seed the row's ``pending`` token from the target's last-position
-    logits. Same contract as :func:`admit_row` otherwise."""
+    logits — argmax at ``temperature=0``, otherwise a sample through the
+    same filter stack the rounds use (the seed token is part of the
+    request's sampled stream). Same contract as :func:`admit_row`
+    otherwise."""
     lg, mini_t = prefill(params, prompt, cfg, max_len=prompt.shape[1])
     _, mini_d = prefill(draft_params, prompt, draft_cfg,
                         max_len=prompt.shape[1])
     s_p = prompt.shape[1]
     t_cache = _place_prefill(t_cache, mini_t, row, s_p)
     d_cache = _place_prefill(d_cache, mini_d, row, s_p)
-    pending = pending.at[row].set(
-        jnp.argmax(lg[0], axis=-1).astype(pending.dtype))
+    if temperature == 0.0:
+        seed_tok = jnp.argmax(lg[0], axis=-1)
+    else:
+        seed_tok = jax.random.categorical(
+            rng, _filter_logits(lg[0].astype(jnp.float32), temperature,
+                                top_k, top_p), axis=-1)
+    pending = pending.at[row].set(seed_tok.astype(pending.dtype))
     return t_cache, d_cache, pending
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg", "n", "k"),
+@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg", "n", "k",
+                                             "temperature", "top_k",
+                                             "top_p"),
                    donate_argnames=("t_cache", "d_cache", "pending"))
-def spec_step_rows(params, draft_params, t_cache, d_cache, pending, n, cfg,
-                   draft_cfg, k):
+def spec_step_rows(params, draft_params, t_cache, d_cache, pending, rng,
+                   n, cfg, draft_cfg, k, temperature=0.0, top_k=0,
+                   top_p=0.0):
     """``n`` speculative rounds for every row at its OWN frontier — the
     serving analog of :func:`step_rows` built on the same
     propose-and-verify round the speculative decoder uses
@@ -159,17 +174,32 @@ def spec_step_rows(params, draft_params, t_cache, d_cache, pending, n, cfg,
     separately-fetched device array costs its own transport round trip
     (~100 ms on a tunneled chip — returning chunks and counts apart
     measured 242 ms/sync vs ~130 for the greedy batcher's single token
-    array, erasing speculation's win)."""
+    array, erasing speculation's win).
 
-    def body(carry, _):
+    ``temperature > 0`` runs SAMPLED rounds instead
+    (:func:`decode._propose_and_verify_sampled`): serving commits the
+    full per-row acceptance every round, so each slot's next pending is
+    simply the round's residual/bonus sample, and each request's
+    committed stream is distributed exactly as target-only sampling
+    through the same filter stack."""
+
+    def body(carry, round_rng):
         t_cache, d_cache, pending = carry
         pos = t_cache["length"]                                  # [B]
-        chunk, argmaxes, acc, t_cache, d_cache = _propose_and_verify(
-            params, draft_params, t_cache, d_cache, pending, pos,
-            cfg, draft_cfg, k, None, pending.dtype)
+        if temperature == 0.0:
+            chunk, argmaxes, acc, t_cache, d_cache = _propose_and_verify(
+                params, draft_params, t_cache, d_cache, pending, pos,
+                cfg, draft_cfg, k, None, pending.dtype)
+            pending = jnp.take_along_axis(argmaxes, acc[:, None],
+                                          axis=1)[:, 0]
+        else:
+            chunk, extra, acc, t_cache, d_cache = (
+                _propose_and_verify_sampled(
+                    params, draft_params, t_cache, d_cache, pending,
+                    pos, cfg, draft_cfg, k, None, pending.dtype,
+                    round_rng, temperature, top_k, top_p))
+            pending = extra
         count = acc + 1
-        pending = jnp.take_along_axis(argmaxes, acc[:, None],
-                                      axis=1)[:, 0]
         new_len = (pos + count).astype(jnp.int32)
         t_cache = dict(t_cache, length=new_len)
         d_cache = dict(d_cache, length=new_len)
@@ -179,7 +209,7 @@ def spec_step_rows(params, draft_params, t_cache, d_cache, pending, n, cfg,
         return (t_cache, d_cache, pending), packed
 
     (t_cache, d_cache, pending), packed = jax.lax.scan(
-        body, (t_cache, d_cache, pending), None, length=n)
+        body, (t_cache, d_cache, pending), jax.random.split(rng, n))
     return packed, t_cache, d_cache, pending
 
 
@@ -352,19 +382,22 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
     became committed tokens (acceptance efficiency × occupancy).
     ``rounds_executed`` counts speculative rounds.
 
-    Greedy-only: draft/verify acceptance is defined against the
-    target's argmax chain, so the base class's sampling knobs do not
-    apply here (speculative SAMPLING — rejection-sampling the draft
-    distribution against the target's — is a different scheme; use the
-    greedy batcher with ``temperature>0`` for sampled serving)."""
+    ``temperature > 0`` switches every slot's rounds to SPECULATIVE
+    SAMPLING (``decode._propose_and_verify_sampled``): each request's
+    committed stream is distributed exactly as target-only sampling
+    through the same temperature/top-k/top-p stack, for any draft —
+    greedy rounds remain the token-exact default."""
 
     def __init__(self, params, cfg: T.TransformerConfig,
                  draft_params, draft_cfg: T.TransformerConfig,
                  batch: int, max_len: int,
                  num_speculative: int = 4, eos_id: int | None = None,
-                 chunk: int = 4) -> None:
+                 chunk: int = 4, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 0.0,
+                 seed: int = 0) -> None:
         super().__init__(params, cfg, batch, max_len, eos_id=eos_id,
-                         chunk=chunk)
+                         chunk=chunk, temperature=temperature,
+                         top_k=top_k, top_p=top_p, seed=seed)
         if num_speculative < 1:
             raise ValueError("num_speculative must be >= 1")
         self.draft_params = draft_params
@@ -378,17 +411,21 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         self.pending = jnp.zeros((batch,), jnp.int32)
 
     def _admit(self, row: int, tokens) -> None:
+        self._rng, sub = jax.random.split(self._rng)
         self.cache, self.d_cache, self.pending = spec_admit_row(
             self.params, self.draft_params, self.cache, self.d_cache,
-            self.pending, row, tokens, self.cfg, self.draft_cfg)
+            self.pending, row, tokens, sub, self.cfg, self.draft_cfg,
+            self.temperature, self.top_k, self.top_p)
 
     def _dispatch(self):
         import numpy as np
 
+        self._rng, sub = jax.random.split(self._rng)
         packed, self.cache, self.d_cache, self.pending = (
             spec_step_rows(self.params, self.draft_params, self.cache,
-                           self.d_cache, self.pending, self.chunk,
-                           self.cfg, self.draft_cfg, self.k))
+                           self.d_cache, self.pending, sub, self.chunk,
+                           self.cfg, self.draft_cfg, self.k,
+                           self.temperature, self.top_k, self.top_p))
         self.rounds_executed += self.chunk
         self.steps_executed += self.chunk * (self.k + 1)
         # ONE host fetch per sync (see spec_step_rows: separate fetches
