@@ -243,6 +243,32 @@ class TestE2E:
         assert f"pipeline schedule: {pp_schedule}" in out
         assert "done:" in out
 
+    @pytest.mark.slow
+    def test_serving_job_through_the_cluster(self, tmp_path):
+        """Serving rides the SAME submission path as training: a
+        single-worker job runs the continuous-batching example
+        (examples/lm/serve_lm.py — speculative + sampled mode, the full
+        serving stack) through client → coordinator → executor and exits
+        0 with its served-request report in the task log. The reference
+        has no serving path at all; this pins that the green-field one
+        composes with the orchestration layer."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "lm", "serve_lm.py")
+        client = make_client(
+            tmp_path, f"{PY} {script} --preset tiny --draft_preset tiny "
+                      f"--requests 5 --slots 2 --max_new_tokens 8 "
+                      f"--temperature 0.8 --top_k 40",
+            {"tony.worker.instances": "1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "served 5 requests" in out
+        assert "speculative sampled" in out
+        assert "speculative rounds:" in out
+
     def test_per_task_restart_within_session(self, tmp_path):
         """tony.task.restart-count: one worker fails once, is relaunched
         IN-SESSION (no whole-job reset — the reference kills the job and

@@ -214,6 +214,27 @@ class TestSpeculativeContinuousBatching:
                                          batch=2, max_len=32,
                                          num_speculative=0)
 
+    def test_vocab_mismatch_rejected(self, params):
+        """A draft with a different vocabulary is silent corruption in
+        greedy mode and a shape error in sampled mode — rejected up
+        front, at the batcher AND at the generate-path entry points."""
+        from tony_tpu.models.decode import (speculative_generate,
+                                            speculative_generate_device)
+
+        bad_cfg = CFG.scaled(vocab_size=CFG.vocab_size // 2)
+        bad = T.init_params(jax.random.PRNGKey(1), bad_cfg)
+        with pytest.raises(ValueError, match="vocab"):
+            SpeculativeContinuousBatcher(params, CFG, bad, bad_cfg,
+                                         batch=2, max_len=32)
+        prompt = jnp.asarray([[1, 2, 3]], jnp.int32)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate_device(params, bad, prompt, CFG, bad_cfg,
+                                        max_new_tokens=4,
+                                        num_speculative=2)
+        with pytest.raises(ValueError, match="vocab"):
+            speculative_generate(params, bad, prompt, CFG, bad_cfg,
+                                 max_new_tokens=4, num_speculative=2)
+
     @pytest.mark.slow
     def test_sampled_speculative_serving_matches_target_distribution(self):
         """Sampled speculative serving (rejection-sampling rounds inside
@@ -247,7 +268,7 @@ class TestSpeculativeContinuousBatching:
         counts = sum(joint_serve(s) for s in range(8))
         spec_p = counts / counts.sum()
 
-        pm = jnp.asarray([prompt], jnp.int32).repeat(192, 0)
+        pm = jnp.asarray([prompt], jnp.int32).repeat(n_req, 0)
 
         def joint_gen(model, seed0):
             c = np.zeros((cfg.vocab_size, cfg.vocab_size))

@@ -442,6 +442,19 @@ def _sample(logits, rng, temperature: float, top_k: int,
                                       axis=-1)[:, 0]
 
 
+def _check_draft_vocab(cfg, draft_cfg):
+    """Speculation compares TOKEN IDS between draft and target, so the
+    two models must share a vocabulary. A mismatch is silent corruption
+    in greedy mode (target ids past the draft's vocab clamp in its
+    embedding gather, producing garbage proposals) and a shape error in
+    sampled mode — reject it up front."""
+    if draft_cfg.vocab_size != cfg.vocab_size:
+        raise ValueError(
+            f"draft vocab_size {draft_cfg.vocab_size} != target "
+            f"vocab_size {cfg.vocab_size}: speculative decoding requires "
+            f"a shared vocabulary (same tokenizer)")
+
+
 def _propose_chunk(params, draft_params, t_cache, d_cache, pending,
                    pos_arg, cfg, draft_cfg, k, win, token_dtype,
                    propose, extra_xs):
@@ -619,6 +632,7 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
     if num_speculative < 1:
         raise ValueError("num_speculative must be >= 1 (use generate() for "
                          "plain greedy decoding)")
+    _check_draft_vocab(cfg, draft_cfg)
     k = num_speculative
     max_len = s + max_new_tokens + k + 1
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
@@ -803,6 +817,7 @@ def speculative_generate_device(params: dict, draft_params: dict,
     if temperature > 0.0 and rng is None:
         raise ValueError("speculative sampling (temperature > 0) "
                          "requires an rng key")
+    _check_draft_vocab(cfg, draft_cfg)
     if commit == "window":
         # default + validate at ANY batch size (a window accepted at b=1
         # must not start raising when the batch widens), though the

@@ -395,11 +395,14 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                  chunk: int = 4, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  seed: int = 0) -> None:
+        from tony_tpu.models.decode import _check_draft_vocab
+
         super().__init__(params, cfg, batch, max_len, eos_id=eos_id,
                          chunk=chunk, temperature=temperature,
                          top_k=top_k, top_p=top_p, seed=seed)
         if num_speculative < 1:
             raise ValueError("num_speculative must be >= 1")
+        _check_draft_vocab(cfg, draft_cfg)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         self.k = num_speculative
