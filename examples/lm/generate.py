@@ -36,6 +36,9 @@ def main() -> int:
     parser.add_argument("--top_k", type=int, default=40)
     parser.add_argument("--top_p", type=float, default=0.0,
                         help="nucleus sampling mass (0 = off)")
+    parser.add_argument("--beam_width", type=int, default=0,
+                        help="beam search instead of sampling (> 0 "
+                             "enables; returns the best beam)")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args()
 
@@ -55,6 +58,21 @@ def main() -> int:
     prompt = jax.random.randint(rng, (args.batch_size, args.prompt_len), 0,
                                 cfg.vocab_size)
     t0 = time.perf_counter()
+    if args.beam_width > 0:
+        from tony_tpu.models.decode import beam_search
+        beams = beam_search(params, prompt, cfg,
+                            max_new_tokens=args.max_new_tokens,
+                            beam_width=args.beam_width)
+        int(beams.tokens[0, 0, -1])
+        n = int(beams.tokens.shape[0] * args.max_new_tokens)
+        dt = time.perf_counter() - t0
+        print(f"beam search W={args.beam_width}: best-beam shape "
+              f"{beams.tokens.shape[::2]} in {dt:.2f}s "
+              f"({n / dt:,.0f} tok/s incl. compile)")
+        print("best beam token ids:",
+              beams.tokens[0, 0, args.prompt_len:].tolist()[:16])
+        print("beam scores:", [round(float(x), 2) for x in beams.scores[0]])
+        return 0
     out = generate(params, prompt, cfg, max_new_tokens=args.max_new_tokens,
                    rng=rng, temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p)

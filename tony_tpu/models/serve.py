@@ -55,7 +55,8 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
-from tony_tpu.models.decode import (_filter_logits, _propose_and_verify,
+from tony_tpu.models.decode import (_check_draft_vocab, _filter_logits,
+                                    _propose_and_verify,
                                     _propose_and_verify_sampled, _sample,
                                     decode_step, init_kv_cache, prefill)
 
@@ -395,8 +396,6 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                  chunk: int = 4, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  seed: int = 0) -> None:
-        from tony_tpu.models.decode import _check_draft_vocab
-
         super().__init__(params, cfg, batch, max_len, eos_id=eos_id,
                          chunk=chunk, temperature=temperature,
                          top_k=top_k, top_p=top_p, seed=seed)
