@@ -663,6 +663,33 @@ class TestBeamSearch:
         with pytest.raises(ValueError, match="beam_width"):
             beam_search(bparams, prompt, self.BCFG, max_new_tokens=3,
                         beam_width=0)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            beam_search(bparams, prompt, self.BCFG, max_new_tokens=0,
+                        beam_width=2)
+
+    def test_tp_sharded_beams_match_unsharded(self):
+        """Beam search under tensor parallelism: sharded params give the
+        same beams/scores via XLA sharding propagation — the per-step
+        cache gather by parent index must respect the propagated cache
+        sharding."""
+        from tony_tpu.models.decode import beam_search
+        from tony_tpu.parallel import make_mesh, shard_pytree
+
+        cfg = self.BCFG.scaled(vocab_size=16)   # tp-divisible lm_head
+        params = T.init_params(jax.random.PRNGKey(2), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 5), 0,
+                                    cfg.vocab_size)
+        ref = beam_search(params, prompt, cfg, max_new_tokens=5,
+                          beam_width=3)
+        mesh = make_mesh({"tp": 2, "dp": 4})
+        sharded = shard_pytree(params, T.logical_axes(cfg), mesh)
+        with jax.set_mesh(mesh):
+            out = beam_search(sharded, prompt, cfg,
+                              max_new_tokens=5, beam_width=3)
+        np.testing.assert_array_equal(np.asarray(ref.tokens),
+                                      np.asarray(out.tokens))
+        np.testing.assert_allclose(np.asarray(ref.scores),
+                                   np.asarray(out.scores), atol=1e-4)
 
 
 class TestSpeculativeSampling:
