@@ -138,6 +138,51 @@ class TestDecode:
                                     CFG.head_dim)
         assert cache["k"].dtype == CFG.dtype
 
+    def test_flash_safe_len_boundaries(self):
+        """The TPU flash kernels' alignment rule prefill pads to: free up
+        to 256, 256-multiples to 1024, 1024-multiples beyond."""
+        from tony_tpu.models.decode import _flash_safe_len
+
+        assert [_flash_safe_len(s) for s in (1, 100, 256)] == [1, 100, 256]
+        assert [_flash_safe_len(s) for s in (257, 300, 512, 1000)] == \
+            [512, 512, 512, 1024]
+        assert [_flash_safe_len(s) for s in (1024, 1025, 1056, 2048,
+                                             2049)] == \
+            [1024, 2048, 2048, 2048, 3072]
+
+    def test_prefill_padding_preserves_outputs(self, params, monkeypatch):
+        """The prompt-padding path (TPU flash alignment; forced here on
+        CPU through the _pad_prompts seam): padded prefill produces the
+        same logits, cache K/V, and greedy continuations as unpadded —
+        causal masking keeps real positions independent of the padding
+        and only real rows reach the cache."""
+        import tony_tpu.models.decode as D
+
+        prompt = jax.random.randint(jax.random.PRNGKey(12), (2, 300), 0,
+                                    CFG.vocab_size)
+        lg_ref, cache_ref = prefill(params, prompt, CFG, max_len=310)
+        monkeypatch.setattr(D, "_pad_prompts", lambda: True)
+        assert D._flash_safe_len(300) == 512        # genuinely pads
+        lg_pad, cache_pad = prefill(params, prompt, CFG, max_len=310)
+        np.testing.assert_allclose(np.asarray(lg_pad),
+                                   np.asarray(lg_ref), rtol=2e-4,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(cache_pad["k"]),
+                                   np.asarray(cache_ref["k"]),
+                                   rtol=2e-4, atol=2e-4)
+        assert int(cache_pad["length"]) == 300
+        # greedy continuation off the padded-prefill cache matches the
+        # unpadded one (eager decode_step calls — no jit cache aliasing
+        # between the patched and unpatched traces)
+        ca, cb = cache_pad, cache_ref
+        la, lb = lg_pad, lg_ref
+        for _ in range(3):
+            ta = jnp.argmax(la, axis=-1)
+            tb = jnp.argmax(lb, axis=-1)
+            np.testing.assert_array_equal(np.asarray(ta), np.asarray(tb))
+            la, ca = decode_step(params, ta, ca, ca["length"], CFG)
+            lb, cb = decode_step(params, tb, cb, cb["length"], CFG)
+
     @pytest.mark.slow
     def test_moe_greedy_generate_matches_full_forward(self):
         """MoE decode: cached generation equals the full-forward loop (high

@@ -126,6 +126,61 @@ class TestContinuousBatching:
             batcher.serve([[1, 2], []], max_new_tokens=4)
 
 
+class TestSharedPrefix:
+    """Shared-prefix caching: the prefix prefills once into a K/V
+    template; admission copies it and runs only the request's suffix."""
+
+    def _refs(self, params, prefix, suffixes, budgets):
+        out = []
+        for sfx, b in zip(suffixes, budgets):
+            full = jnp.asarray(prefix + sfx, jnp.int32)[None]
+            g = generate(params, full, CFG, max_new_tokens=b,
+                         rng=jax.random.PRNGKey(0), temperature=0.0)
+            out.append([int(t) for t in
+                        np.asarray(g.tokens[0, full.shape[1]:])])
+        return out
+
+    def test_greedy_prefix_serving_token_identical(self, params):
+        """Serving suffixes against a shared prefix equals per-request
+        greedy decode of prefix+suffix — including slot reuse, where a
+        new occupant's template copy overwrites the previous request's
+        K/V."""
+        rs = np.random.RandomState(7)
+        prefix = [int(t) for t in rs.randint(0, CFG.vocab_size, size=9)]
+        suffixes = [list(rs.randint(0, CFG.vocab_size,
+                                    size=rs.randint(2, 6)))
+                    for _ in range(5)]
+        budgets = [int(b) for b in rs.randint(4, 9, size=5)]
+        batcher = ContinuousBatcher(params, CFG, batch=2, max_len=48,
+                                    chunk=3, shared_prefix=prefix)
+        outs = batcher.serve(suffixes, budgets)
+        assert outs == self._refs(params, prefix, suffixes, budgets)
+
+    def test_speculative_prefix_serving_token_identical(self, params):
+        """The speculative batcher's prefix admission fills BOTH models'
+        caches from their own templates; greedy rounds stay token-exact."""
+        draft = T.init_params(jax.random.PRNGKey(99), CFG)
+        rs = np.random.RandomState(8)
+        prefix = [int(t) for t in rs.randint(0, CFG.vocab_size, size=7)]
+        suffixes = [list(rs.randint(0, CFG.vocab_size, size=3))
+                    for _ in range(4)]
+        budgets = [5, 7, 4, 6]
+        batcher = SpeculativeContinuousBatcher(
+            params, CFG, draft, CFG, batch=2, max_len=48,
+            num_speculative=3, chunk=2, shared_prefix=prefix)
+        outs = batcher.serve(suffixes, budgets)
+        assert outs == self._refs(params, prefix, suffixes, budgets)
+
+    def test_prefix_budget_validation(self, params):
+        batcher = ContinuousBatcher(params, CFG, batch=1, max_len=16,
+                                    shared_prefix=[1, 2, 3, 4])
+        with pytest.raises(ValueError, match="shared prefix 4"):
+            batcher.serve([[5] * 6], max_new_tokens=8)
+        with pytest.raises(ValueError, match="non-empty"):
+            ContinuousBatcher(params, CFG, batch=1, max_len=16,
+                              shared_prefix=[])
+
+
 class TestSampledServing:
     """temperature/top_k/top_p on the continuous batcher: valid tokens,
     seed-reproducible workloads, seed-sensitive outputs."""

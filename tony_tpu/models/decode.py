@@ -352,15 +352,50 @@ def decode_step(params: dict, token: jax.Array, cache: dict, pos,
     return logits[:, 0], new_cache
 
 
+def _pad_prompts() -> bool:
+    """Whether prefill right-pads prompts to flash-block-aligned lengths
+    (needed on TPU; a seam so the CPU tests can force the padding path
+    and pin its slicing/last-position logic)."""
+    return jax.default_backend() == "tpu"
+
+
+def _flash_safe_len(s: int) -> int:
+    """Smallest sequence length >= s the TPU flash kernels accept: any
+    length up to 256 tiles (block_q clamps to s; sub-128-lane cases fall
+    back to dense attention inside flash_attention), lengths up to 1024
+    must tile the 256-wide q blocks, and longer ones must tile the
+    1024-wide kv blocks."""
+    if s <= 256:
+        return s
+    if s <= 1024:
+        return -(-s // 256) * 256
+    return -(-s // 1024) * 1024
+
+
 def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
             max_len: int) -> tuple[jax.Array, dict]:
     """Process the whole prompt in one forward, filling the cache.
     tokens: [B, S]; returns (last-position logits [B, V] in
-    cfg.logits_storage_dtype, cache)."""
+    cfg.logits_storage_dtype, cache).
+
+    Arbitrary prompt lengths: the TPU flash kernels need block-aligned
+    sequences, so the forward runs at :func:`_flash_safe_len` with the
+    prompt right-padded by zeros — causal masking keeps every REAL
+    position's output independent of the padding tail, and only the real
+    S rows of K/V are written to the cache (the returned logits read
+    position S-1, not the padded end). Serving prompts are whatever
+    length users send; without this, any prompt past 256 tokens that
+    didn't tile the blocks raised at trace time. Caveat: with MoE
+    layers, padded tokens still occupy router capacity (capacity scales
+    with the PADDED length), so extreme padding can shift routing-drop
+    behavior at low capacity factors."""
     b, s = tokens.shape
+    sp = _flash_safe_len(s) if _pad_prompts() else s
+    if sp != s:
+        tokens = jnp.pad(tokens, ((0, 0), (0, sp - s)))
     cache = init_kv_cache(cfg, b, max_len)
     x = params["embed"][tokens].astype(cfg.dtype)
-    positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+    positions = jnp.broadcast_to(jnp.arange(sp), (b, sp))
     cos, sin = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
     # Unrolled layers, prompt K/V written straight into the stacked cache
@@ -380,11 +415,11 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)
         k_filled = jax.lax.dynamic_update_slice(
-            k_filled, k[None], (li, 0, 0, 0, 0))
+            k_filled, k[:, :s][None], (li, 0, 0, 0, 0))
         v_filled = jax.lax.dynamic_update_slice(
-            v_filled, v[None], (li, 0, 0, 0, 0))
+            v_filled, v[:, :s][None], (li, 0, 0, 0, 0))
     x = rms_norm_reference(x, params["final_norm"])
-    logits = jnp.einsum("bd,dv->bv", x[:, -1], params["lm_head"],
+    logits = jnp.einsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
                         preferred_element_type=jnp.float32)
     logits = logits.astype(cfg.logits_storage_dtype)
     return logits, {"k": k_filled, "v": v_filled,

@@ -44,6 +44,13 @@ every slot runs draft-propose/target-verify rounds at its own frontier
 (:func:`spec_step_rows`) while admission/retirement reuse slots exactly
 as in the greedy batcher — vLLM-style continuous batching with
 speculative decoding, token-identical to per-request greedy decode.
+
+Shared-prefix caching (``shared_prefix=``, both batchers): a system
+prompt every request continues from prefills ONCE into a K/V template;
+admission copies the template into the slot and runs only the request's
+own tokens through the model (:func:`prefix_admit_row` — a chunked
+``extend_step`` against the copied prefix history), token-identical to
+serving prefix+prompt in full.
 """
 
 from __future__ import annotations
@@ -58,7 +65,8 @@ from tony_tpu.models import transformer as T
 from tony_tpu.models.decode import (_check_draft_vocab, _filter_logits,
                                     _propose_and_verify,
                                     _propose_and_verify_sampled, _sample,
-                                    decode_step, init_kv_cache, prefill)
+                                    decode_step, extend_step,
+                                    init_kv_cache, prefill)
 
 
 def _place_prefill(cache, mini, row, s_p):
@@ -86,6 +94,53 @@ def admit_row(params, cache, logits, row, prompt, cfg):
     lg1, mini = prefill(params, prompt, cfg, max_len=prompt.shape[1])
     return (_place_prefill(cache, mini, row, prompt.shape[1]),
             logits.at[row].set(lg1[0]))
+
+
+def prefix_template(params, prefix, cfg):
+    """Prefill a SHARED PREFIX once (a system prompt every request
+    continues from); returns the [L, 1, P, KV, hd] K/V template
+    :func:`prefix_admit_row` copies into each admitted slot. prefix:
+    [P] ints."""
+    _, mini = prefill(params, jnp.asarray(prefix, jnp.int32)[None], cfg,
+                      max_len=len(prefix))
+    return {"k": mini["k"], "v": mini["v"]}
+
+
+def _extend_from_template(model_params, template, suffix, model_cfg):
+    """Build a [L, 1, P+S]-row mini cache from a prefix ``template`` and
+    run the ``suffix`` through the model against it (a chunked
+    :func:`extend_step` — suffix queries attend the full prefix history
+    exactly as a monolithic prefill of prefix+suffix would). Returns
+    (suffix logits [1, S, V], filled mini cache, total length P+S).
+    Shared by the greedy and speculative prefix admitters."""
+    l, _, p_len, kv, hd = template["k"].shape
+    s_len = suffix.shape[1]
+    mini = {
+        "k": jnp.concatenate(
+            [template["k"],
+             jnp.zeros((l, 1, s_len, kv, hd), template["k"].dtype)],
+            axis=2),
+        "v": jnp.concatenate(
+            [template["v"],
+             jnp.zeros((l, 1, s_len, kv, hd), template["v"].dtype)],
+            axis=2),
+        "length": jnp.asarray(p_len, jnp.int32)}
+    lg, mini = extend_step(model_params, suffix, mini, p_len, model_cfg)
+    return lg, mini, p_len + s_len
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "logits"))
+def prefix_admit_row(params, cache, logits, row, template, suffix, cfg):
+    """Admit a request that CONTINUES a shared prefix: the prefix's K/V
+    come from the precomputed ``template`` (one prefill for the whole
+    serve, not one per request) and only the request's ``suffix``
+    [1, S] runs a forward (:func:`_extend_from_template`). Admission
+    compute drops from O(P+S) to O(S) tokens; at a long system prompt
+    and short user turns that is the dominant admission cost."""
+    lg, mini, total = _extend_from_template(params, template, suffix, cfg)
+    return (_place_prefill(cache, mini, row, total),
+            logits.at[row].set(lg[0, -1]))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "temperature",
@@ -148,6 +203,34 @@ def spec_admit_row(params, draft_params, t_cache, d_cache, pending, row,
         seed_tok = jax.random.categorical(
             rng, _filter_logits(lg[0].astype(jnp.float32), temperature,
                                 top_k, top_p), axis=-1)
+    pending = pending.at[row].set(seed_tok.astype(pending.dtype))
+    return t_cache, d_cache, pending
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg",
+                                             "temperature", "top_k",
+                                             "top_p"),
+                   donate_argnames=("t_cache", "d_cache", "pending"))
+def spec_prefix_admit_row(params, draft_params, t_cache, d_cache, pending,
+                          row, t_template, d_template, suffix, rng, cfg,
+                          draft_cfg, temperature=0.0, top_k=0, top_p=0.0):
+    """Shared-prefix admission for the speculative batcher: BOTH models'
+    prefix K/V come from precomputed templates and only the suffix runs
+    a forward through each (:func:`_extend_from_template`); the pending
+    seed comes from the target's last suffix position, argmax or
+    sampled, as in :func:`spec_admit_row`."""
+    lg, mini_t, total = _extend_from_template(params, t_template,
+                                              suffix, cfg)
+    _, mini_d, _ = _extend_from_template(draft_params, d_template,
+                                         suffix, draft_cfg)
+    t_cache = _place_prefill(t_cache, mini_t, row, total)
+    d_cache = _place_prefill(d_cache, mini_d, row, total)
+    if temperature == 0.0:
+        seed_tok = jnp.argmax(lg[0, -1], axis=-1)
+    else:
+        seed_tok = jax.random.categorical(
+            rng, _filter_logits(lg[0, -1].astype(jnp.float32),
+                                temperature, top_k, top_p), axis=-1)
     pending = pending.at[row].set(seed_tok.astype(pending.dtype))
     return t_cache, d_cache, pending
 
@@ -232,12 +315,26 @@ class ContinuousBatcher:
                  max_len: int, eos_id: int | None = None,
                  chunk: int = 8, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
-                 seed: int = 0) -> None:
+                 seed: int = 0,
+                 shared_prefix=None) -> None:
         self.params = params
         self.cfg = cfg
         self.batch = batch
         self.max_len = max_len
         self.eos_id = eos_id
+        #: shared-prefix caching: when set (a token sequence, e.g. a
+        #: system prompt), every request's prompt is interpreted as a
+        #: CONTINUATION of it — the prefix prefills once into a K/V
+        #: template that admission copies into the slot, and only the
+        #: request's own tokens run a forward (prefix_admit_row).
+        #: Outputs are token-identical to serving prefix+prompt in full.
+        self.shared_prefix = (None if shared_prefix is None
+                              else list(shared_prefix))
+        if self.shared_prefix is not None and not self.shared_prefix:
+            raise ValueError("shared_prefix must be non-empty when given")
+        self._prefix_template = (
+            prefix_template(params, self.shared_prefix, cfg)
+            if self.shared_prefix else None)
         #: sampling controls (greedy by default); the rng stream restarts
         #: from ``seed`` at every serve() call, so a workload re-served
         #: with the same seed reproduces its outputs — but a request's
@@ -266,8 +363,14 @@ class ContinuousBatcher:
     # --- device seams (overridden by the speculative batcher) ---
 
     def _admit(self, row: int, tokens) -> None:
-        self.cache, self.logits = admit_row(
-            self.params, self.cache, self.logits, row, tokens, self.cfg)
+        if self._prefix_template is not None:
+            self.cache, self.logits = prefix_admit_row(
+                self.params, self.cache, self.logits, row,
+                self._prefix_template, tokens, self.cfg)
+        else:
+            self.cache, self.logits = admit_row(
+                self.params, self.cache, self.logits, row, tokens,
+                self.cfg)
 
     def _dispatch(self):
         """Run one device chunk; returns per-slot newly generated tokens
@@ -302,16 +405,19 @@ class ContinuousBatcher:
                                  "must match prompts")
         # validate EVERY request before admitting any: a mid-serve raise
         # would discard completed outputs and strand the batcher state
+        p_len = len(self.shared_prefix) if self.shared_prefix else 0
         for req, (p, b) in enumerate(zip(prompts, budget)):
             if len(p) == 0:
                 raise ValueError(f"request {req}: empty prompt")
             if b <= 0:
                 raise ValueError(f"request {req}: max_new_tokens must be "
                                  f"positive, got {b}")
-            if len(p) + b > self.max_len:
+            if p_len + len(p) + b > self.max_len:
                 raise ValueError(
-                    f"request {req}: prompt {len(p)} + {b} new tokens "
-                    f"exceeds max_len {self.max_len}")
+                    f"request {req}: "
+                    + (f"shared prefix {p_len} + " if p_len else "")
+                    + f"prompt {len(p)} + {b} new tokens exceeds "
+                      f"max_len {self.max_len}")
         occupant: list[int | None] = [None] * self.batch
         self.steps_executed = 0
         self.rounds_executed = 0
@@ -395,15 +501,20 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                  num_speculative: int = 4, eos_id: int | None = None,
                  chunk: int = 4, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
-                 seed: int = 0) -> None:
+                 seed: int = 0, shared_prefix=None) -> None:
         super().__init__(params, cfg, batch, max_len, eos_id=eos_id,
                          chunk=chunk, temperature=temperature,
-                         top_k=top_k, top_p=top_p, seed=seed)
+                         top_k=top_k, top_p=top_p, seed=seed,
+                         shared_prefix=shared_prefix)
         if num_speculative < 1:
             raise ValueError("num_speculative must be >= 1")
         _check_draft_vocab(cfg, draft_cfg)
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
+        # the draft needs its own prefix template (its K/V dims differ)
+        self._draft_prefix_template = (
+            prefix_template(draft_params, self.shared_prefix, draft_cfg)
+            if self.shared_prefix else None)
         self.k = num_speculative
         self.d_cache = init_kv_cache(draft_cfg, batch, max_len)
         self.d_cache = dict(self.d_cache,
@@ -414,10 +525,17 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
 
     def _admit(self, row: int, tokens) -> None:
         self._rng, sub = jax.random.split(self._rng)
-        self.cache, self.d_cache, self.pending = spec_admit_row(
-            self.params, self.draft_params, self.cache, self.d_cache,
-            self.pending, row, tokens, sub, self.cfg, self.draft_cfg,
-            self.temperature, self.top_k, self.top_p)
+        if self._prefix_template is not None:
+            self.cache, self.d_cache, self.pending = spec_prefix_admit_row(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                self.pending, row, self._prefix_template,
+                self._draft_prefix_template, tokens, sub, self.cfg,
+                self.draft_cfg, self.temperature, self.top_k, self.top_p)
+        else:
+            self.cache, self.d_cache, self.pending = spec_admit_row(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                self.pending, row, tokens, sub, self.cfg, self.draft_cfg,
+                self.temperature, self.top_k, self.top_p)
 
     def _dispatch(self):
         import numpy as np
