@@ -293,20 +293,20 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
 
     params = T.init_params(jax.random.PRNGKey(0), cfg)
 
-    def make_fns(max_len):
+    def make_fns(max_len, run_cfg):
         # fresh closures per variant: the blockwise/dense dispatch happens
         # at trace time off D._BLOCKWISE_MIN_LEN, so variants must not
         # share a jit cache entry
         @jax.jit
         def do_prefill(p, toks):
-            return D.prefill(p, toks, cfg, max_len)
+            return D.prefill(p, toks, run_cfg, max_len)
 
         @functools.partial(jax.jit, static_argnames=("n",))
         def scan_decode(p, logits, cache, n):
             def step(carry, _):
                 lg, c = carry
                 token = jnp.argmax(lg, axis=-1)
-                lg, c = D.decode_step(p, token, c, c["length"], cfg)
+                lg, c = D.decode_step(p, token, c, c["length"], run_cfg)
                 return (lg, c), token
 
             (_, _), gen = jax.lax.scan(step, (logits, cache), None,
@@ -315,14 +315,14 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
 
         return do_prefill, scan_decode
 
-    def time_one(max_len, force_dense=False, b=batch):
+    def time_one(max_len, force_dense=False, b=batch, run_cfg=cfg):
         prompt = jax.random.randint(jax.random.PRNGKey(17),
                                     (b, prompt_len), 0, cfg.vocab_size)
         saved = D._BLOCKWISE_MIN_LEN
         if force_dense:
             D._BLOCKWISE_MIN_LEN = 1 << 30
         try:
-            do_prefill, scan_decode = make_fns(max_len)
+            do_prefill, scan_decode = make_fns(max_len, run_cfg)
             # prefill (incl. the O(max_len) cache zero-init) runs OUTSIDE
             # the timed region — the metric is decode-step cost vs padded
             # max_len, and the fixed prefill would pull the ratio toward 1
@@ -351,6 +351,15 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
     # overhead share a batching queue can reclaim
     wide = 4 * batch
     tps2k_wide = time_one(2048, b=wide)
+    # int8 KV cache: HBM footprint and cache read traffic halve vs bf16
+    # (a serving host fits ~2x the slots or 2x max_len); throughput at
+    # the SAME shape should hold near parity — the b8 step is per-op-
+    # overhead-bound, not bandwidth-bound (docs/performance.md) — so the
+    # ratio below is a regression guard for the capacity win, not a
+    # speed claim
+    qcfg = cfg.scaled(kv_cache_dtype="int8")
+    tps8k_quant = time_one(8192, run_cfg=qcfg)
+    tps2k_wide_quant = time_one(2048, b=wide, run_cfg=qcfg)
     return {
         "decode_maxlen2k_tokens_per_s": round(tps2k, 1),
         "decode_maxlen8k_tokens_per_s": round(tps8k, 1),
@@ -360,6 +369,12 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
         f"decode_maxlen2k_b{wide}_tokens_per_s": round(tps2k_wide, 1),
         f"decode_b{wide}_vs_b{batch}_per_slot": round(
             tps2k_wide / tps2k / (wide / batch), 2),
+        "decode_quant8_maxlen8k_tokens_per_s": round(tps8k_quant, 1),
+        "decode_quant8_vs_bf16_8k": round(tps8k_quant / tps8k, 2),
+        f"decode_quant8_maxlen2k_b{wide}_tokens_per_s": round(
+            tps2k_wide_quant, 1),
+        f"decode_quant8_vs_bf16_2k_b{wide}": round(
+            tps2k_wide_quant / tps2k_wide, 2),
     }
 
 

@@ -57,11 +57,16 @@ def main() -> int:
     parser.add_argument("--top_k", type=int, default=0)
     parser.add_argument("--top_p", type=float, default=0.0)
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--kv_cache_dtype", default="model",
+                        choices=("model", "int8"),
+                        help="int8 = quantized KV cache (half the cache "
+                             "HBM per slot; ~2x slots in the same memory)")
     args = parser.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
-        dtype=jnp.bfloat16 if on_tpu else jnp.float32, remat=False)
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32, remat=False,
+        kv_cache_dtype=args.kv_cache_dtype)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     if args.ckpt_dir:
         with CheckpointManager(args.ckpt_dir) as mgr:
@@ -88,7 +93,8 @@ def main() -> int:
         # the draft must share the target's vocabulary (speculation
         # compares token ids), so override the preset's vocab_size
         draft_cfg = T.PRESETS[args.draft_preset].scaled(
-            dtype=cfg.dtype, remat=False, vocab_size=cfg.vocab_size)
+            dtype=cfg.dtype, remat=False, vocab_size=cfg.vocab_size,
+            kv_cache_dtype=args.kv_cache_dtype)
         draft_params = T.init_params(jax.random.PRNGKey(1), draft_cfg)
         batcher = SpeculativeContinuousBatcher(
             params, cfg, draft_params, draft_cfg,

@@ -40,6 +40,28 @@ class TestContinuousBatching:
         for i, p in enumerate(prompts):
             assert outs[i] == _reference(params, p, 6), f"request {i}"
 
+    def test_quantized_cache_token_identical_to_quant_generate(self,
+                                                               params):
+        """int8 KV serving: the batcher with a quantized cache equals
+        per-request generate under the SAME quantized config (quant-to-
+        quant is deterministic — per-row math is batch-independent on
+        CPU; quant-to-float agreement is approximate by design). Slot
+        reuse included."""
+        qcfg = CFG.scaled(kv_cache_dtype="int8")
+        rng = np.random.RandomState(7)
+        prompts = [list(rng.randint(0, qcfg.vocab_size, size=n))
+                   for n in (5, 3, 6, 4)]
+        batcher = ContinuousBatcher(params, qcfg, batch=2, max_len=32,
+                                    chunk=4)
+        outs = batcher.serve(prompts, max_new_tokens=6)
+        for i, p in enumerate(prompts):
+            want = generate(params, jnp.asarray(p, jnp.int32)[None],
+                            qcfg, max_new_tokens=6,
+                            rng=jax.random.PRNGKey(0), temperature=0.0)
+            assert outs[i] == [int(t) for t in
+                               np.asarray(want.tokens[0, len(p):])], \
+                f"request {i}"
+
     def test_single_slot_serializes_correctly(self, params):
         """batch=1 degenerates to sequential serving — same outputs."""
         rng = np.random.RandomState(1)

@@ -53,11 +53,53 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
                   max_len: int) -> dict:
     """Zeroed cache pytree: k/v of shape [L, B, max_len, KV, hd] — KV is
     cfg.kv_heads, so grouped-query configs carry an n_heads/n_kv_heads×
-    smaller cache (the main GQA payoff at long max_len)."""
+    smaller cache (the main GQA payoff at long max_len).
+
+    ``cfg.kv_cache_dtype == "int8"`` stores k/v as int8 with per-token,
+    per-kv-head absmax scales in parallel ``k_scale``/``v_scale`` buffers
+    of shape [L, B, max_len, KV, 1] (f32) — the SAME rank and leading
+    dims as k/v, so every cache write path (contiguous slice, bounded
+    window, per-row scatter) applies to the scale buffers unchanged with
+    a trailing dim of 1. Cache memory and read traffic halve vs bf16
+    (each of k and v costs 1 + 4/hd bytes per element ≈ 1.06 at hd=64,
+    vs 2 bf16); see :func:`_kv_quantize` for the numerics."""
     shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    if cfg.kv_quant:
+        sshape = shape[:-1] + (1,)
+        return {"k": jnp.zeros(shape, jnp.int8),
+                "v": jnp.zeros(shape, jnp.int8),
+                "k_scale": jnp.zeros(sshape, jnp.float32),
+                "v_scale": jnp.zeros(sshape, jnp.float32),
+                "length": jnp.zeros((), jnp.int32)}
     return {"k": jnp.zeros(shape, cfg.dtype),
             "v": jnp.zeros(shape, cfg.dtype),
             "length": jnp.zeros((), jnp.int32)}
+
+
+#: cache keys that hold per-position buffers (and so follow every write/
+#: gather/tile path together); "length" is the only non-buffer key
+_KV_BUFS = ("k", "v", "k_scale", "v_scale")
+
+
+def _kv_bufs(cache: dict) -> dict:
+    """The cache's position-indexed buffers (k/v + scales when present),
+    without the length field."""
+    return {n: cache[n] for n in _KV_BUFS if n in cache}
+
+
+def _kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization of a K/V chunk [..., KV, hd] along its
+    head dim: scale = absmax/127 per (token, kv-head), q = round(x/scale)
+    in [-127, 127]. Returns (q int8, scale [..., KV, 1] f32). Integer
+    values up to 127 are exact in bf16, so the dequantized dot can cast
+    the int8 operand straight to the compute dtype and apply the scale
+    OUTSIDE the contraction (it is constant along hd — see
+    :func:`_cached_attention`), keeping HBM reads int8-wide."""
+    x32 = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x32), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(x32 / scale), -127, 127).astype(jnp.int8)
+    return q, scale
 
 
 # Length-aware decode attention: caches at or above this many positions
@@ -80,7 +122,7 @@ def _q_positions(q_start, b, n_q):
     return q_start[:, None] + jnp.arange(n_q)[None, :]
 
 
-def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
+def _cached_attention_blockwise(q, bufs, li, q_start,
                                 block: int = DECODE_BLOCK):
     """Online-softmax cached attention reading only the ACTIVE cache
     blocks. The dense path reads all max_len rows every step — cost
@@ -108,7 +150,16 @@ def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
 
     Trailing partial blocks: ``max_len`` need not divide by ``block`` —
     the last slice start is clamped (dynamic_slice semantics) and a
-    position-range mask discards the re-read rows."""
+    position-range mask discards the re-read rows.
+
+    Quantized caches (``k_scale``/``v_scale`` present in ``bufs``): the
+    int8 K/V blocks cast to the compute dtype inside the dots (integer
+    values <= 127 are exact in bf16) and the per-token scales apply
+    OUTSIDE the hd-contractions they are constant along — the K scale on
+    the [.., q, s] scores, the V scale folded into ``p`` — so HBM block
+    reads stay int8-wide."""
+    k_all, v_all = bufs["k"], bufs["v"]
+    quant = "k_scale" in bufs
     b, n_q, h, d = q.shape
     max_len = k_all.shape[2]
     kv = k_all.shape[3]
@@ -129,12 +180,19 @@ def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
             k_all, (li, 0, start, 0, 0), (1, b, block, kv, d))[0]
         vb = jax.lax.dynamic_slice(
             v_all, (li, 0, start, 0, 0), (1, b, block, kv, d))[0]
+        if quant:
+            kb, vb = kb.astype(q.dtype), vb.astype(q.dtype)
         k_pos = start + jnp.arange(block)                       # [S]
         # >= i*block drops rows re-read by a clamped trailing slice
         mask = ((k_pos[None, None, :] >= i * block)
                 & (k_pos[None, None, :] <= q_pos[:, :, None]))  # [B, Q, S]
         s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kb,
                        preferred_element_type=jnp.float32) * scale
+        if quant:
+            ksb = jax.lax.dynamic_slice(
+                bufs["k_scale"], (li, 0, start, 0, 0),
+                (1, b, block, kv, 1))[0, ..., 0]                # [B, S, KV]
+            s = s * ksb.transpose(0, 2, 1)[:, :, None, None, :]
         s = jnp.where(mask[:, None, None], s, -jnp.inf)
         new_m = jnp.maximum(m, s.max(axis=-1))
         # all-masked (query, block) pairs keep m=-inf; subtract 0 there so
@@ -143,7 +201,14 @@ def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
         alpha = jnp.exp(m - safe_m)                             # -inf -> 0
         p = jnp.exp(s - safe_m[..., None])
         l = l * alpha + p.sum(axis=-1)
-        pv = jnp.einsum("bkgqs,bskd->bkgqd", p.astype(v_all.dtype), vb,
+        if quant:
+            vsb = jax.lax.dynamic_slice(
+                bufs["v_scale"], (li, 0, start, 0, 0),
+                (1, b, block, kv, 1))[0, ..., 0]                # [B, S, KV]
+            p_eff = p * vsb.transpose(0, 2, 1)[:, :, None, None, :]
+        else:
+            p_eff = p
+        pv = jnp.einsum("bkgqs,bskd->bkgqd", p_eff.astype(vb.dtype), vb,
                         preferred_element_type=jnp.float32)
         acc = acc * alpha[..., None] + pv
         return new_m, l, acc
@@ -154,24 +219,31 @@ def _cached_attention_blockwise(q, k_all, v_all, li, q_start,
     return o.astype(q.dtype)
 
 
-def _cached_attention(q, k_all, v_all, li, q_start):
-    """q: [B, K, H, hd] holding positions q_start..q_start+K-1; caches:
-    stacked [L, B, max_len, KV, hd] with ``li`` this layer's static index
-    (KV = H for MHA; KV < H for grouped-query, where each query group
-    reads its shared K/V head WITHOUT materializing a repeated cache —
-    the bandwidth saving is the point of GQA decode). Query i attends
-    cache positions <= q_start+i (causal within the chunk, full history
-    before it). Operands stay in the cache dtype (bf16 on TPU) with f32
-    accumulation — casting the whole cache to f32 would double the hot
-    loop's HBM traffic and halve MXU throughput.
+def _cached_attention(q, bufs, li, q_start):
+    """q: [B, K, H, hd] holding positions q_start..q_start+K-1; ``bufs``:
+    the cache's stacked [L, B, max_len, KV, hd] k/v buffers (plus
+    ``k_scale``/``v_scale`` for int8 caches) with ``li`` this layer's
+    static index (KV = H for MHA; KV < H for grouped-query, where each
+    query group reads its shared K/V head WITHOUT materializing a
+    repeated cache — the bandwidth saving is the point of GQA decode).
+    Query i attends cache positions <= q_start+i (causal within the
+    chunk, full history before it). Operands stay in the cache dtype
+    (bf16 on TPU; int8 casting to the compute dtype in-dot for quantized
+    caches) with f32 accumulation — casting the whole cache to f32 would
+    double the hot loop's HBM traffic and halve MXU throughput.
 
     Large caches (max_len >= ``_BLOCKWISE_MIN_LEN``) dispatch to the
     length-aware block-wise path so serving cost follows the live length
     rather than the padded buffer."""
+    k_all, v_all = bufs["k"], bufs["v"]
     max_len = k_all.shape[2]
     if max_len >= _BLOCKWISE_MIN_LEN:
-        return _cached_attention_blockwise(q, k_all, v_all, li, q_start)
+        return _cached_attention_blockwise(q, bufs, li, q_start)
+    quant = "k_scale" in bufs
     k_cache, v_cache = k_all[li], v_all[li]
+    if quant:
+        k_cache, v_cache = (k_cache.astype(q.dtype),
+                            v_cache.astype(q.dtype))
     b, n_q, h, d = q.shape
     kv = k_cache.shape[2]
     group = h // kv                                  # 1 = plain MHA
@@ -182,8 +254,16 @@ def _cached_attention(q, k_all, v_all, li, q_start):
     qg = q.reshape(b, n_q, kv, group, d)
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
+    if quant:
+        # per-token K scale is constant along the contracted hd — apply
+        # it on the scores instead of dequantizing the cache
+        ks = bufs["k_scale"][li, ..., 0].transpose(0, 2, 1)     # [B, KV, S]
+        scores = scores * ks[:, :, None, None, :]
     scores = jnp.where(mask[:, None, None], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)                     # f32
+    if quant:
+        vs = bufs["v_scale"][li, ..., 0].transpose(0, 2, 1)     # [B, KV, S]
+        probs = probs * vs[:, :, None, None, :]
     o = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype),
                    v_cache, preferred_element_type=jnp.float32)
     return o.reshape(b, n_q, h, d).astype(q.dtype)
@@ -231,19 +311,49 @@ def _window_write(buf_all, chunk, li, pos, window):
                                         (li, 0, base, 0, 0))
 
 
-def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope,
+def _kv_writes(bufs: dict, k: jax.Array, v: jax.Array) -> dict:
+    """The buffer→chunk map a K/V write must apply: plain k/v for float
+    caches, quantized k/v plus their scale chunks for int8 caches. The
+    single source of truth for the quantized write layout — shared by
+    the decode blocks and prefill."""
+    if "k_scale" in bufs:
+        kq, ks = _kv_quantize(k)
+        vq, vs = _kv_quantize(v)
+        return {"k": kq, "k_scale": ks, "v": vq, "v_scale": vs}
+    return {"k": k, "v": v}
+
+
+def _write_kv_chunk(buf, chunk, li, pos, window):
+    """Write a K-token chunk [B, K, KV, d] into the stacked cache buffer
+    [L, B, max_len, KV, d] at layer ``li``, positions ``pos``. The three
+    write modes (scalar contiguous slice / bounded window / per-row
+    unique scatter) are dtype- and trailing-dim-agnostic, so int8 caches
+    route their [.., KV, 1] scale buffers through the same path as k/v."""
+    if pos.ndim == 0:                   # uniform frontier: contiguous slice
+        return jax.lax.dynamic_update_slice(buf, chunk[None],
+                                            (li, 0, pos, 0, 0))
+    if window is not None:              # bounded divergence: window write
+        return _window_write(buf, chunk, li, pos, window)
+    # per-row frontiers: unique scatter
+    b_idx = jnp.arange(chunk.shape[0])[:, None]
+    s_idx = pos[:, None] + jnp.arange(chunk.shape[1])[None, :]
+    return buf.at[li, b_idx, s_idx].set(chunk, unique_indices=True)
+
+
+def _decode_block(x, layer_params, bufs, li, pos, cfg, rope,
                   window=None):
     """Chunked decoder block. x: [B, K, D] at positions pos..pos+K-1;
-    k_all/v_all: the FULL stacked caches [L, B, max_len, KV, hd]; ``li``:
-    this layer's static index; ``rope``: (cos, sin) tables precomputed once
-    per chunk (position-only, so layer-invariant — same hoisting as the
-    training forward). Writes only the K-token slice into the stacked
-    cache (a layer-scan carrying the caches as xs/ys instead forced XLA to
-    COPY the whole cache every decode step — the xs and ys buffers of a
-    scan cannot alias — which dominated decode wall-clock). ``window``
+    ``bufs``: the FULL stacked cache buffers [L, B, max_len, KV, hd]
+    (k/v, plus scales for int8 caches); ``li``: this layer's static
+    index; ``rope``: (cos, sin) tables precomputed once per chunk
+    (position-only, so layer-invariant — same hoisting as the training
+    forward). Writes only the K-token slice into the stacked cache (a
+    layer-scan carrying the caches as xs/ys instead forced XLA to COPY
+    the whole cache every decode step — the xs and ys buffers of a scan
+    cannot alias — which dominated decode wall-clock). ``window``
     (static) selects the bounded-window write for vector ``pos`` whose
     rows the caller keeps within the window — see :func:`_window_write`.
-    Returns (x, k_all, v_all)."""
+    Returns (x, bufs)."""
     p = layer_params
     cos, sin = rope
 
@@ -255,25 +365,14 @@ def _decode_block(x, layer_params, k_all, v_all, li, pos, cfg, rope,
     # write this chunk into the stacked cache (in place under jit: the
     # pre-update buffer has no later consumer)
     pos = jnp.asarray(pos)
-    if pos.ndim == 0:                   # uniform frontier: contiguous slice
-        k_all = jax.lax.dynamic_update_slice(k_all, k[None],
-                                             (li, 0, pos, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(v_all, v[None],
-                                             (li, 0, pos, 0, 0))
-    elif window is not None:            # bounded divergence: window write
-        k_all = _window_write(k_all, k, li, pos, window)
-        v_all = _window_write(v_all, v, li, pos, window)
-    else:                               # per-row frontiers: unique scatter
-        b_idx = jnp.arange(k.shape[0])[:, None]
-        s_idx = pos[:, None] + jnp.arange(k.shape[1])[None, :]
-        k_all = k_all.at[li, b_idx, s_idx].set(k, unique_indices=True)
-        v_all = v_all.at[li, b_idx, s_idx].set(v, unique_indices=True)
-    o = _cached_attention(q, k_all, v_all, li, pos)
+    bufs = {n: _write_kv_chunk(bufs[n], c, li, pos, window)
+            for n, c in _kv_writes(bufs, k, v).items()}
+    o = _cached_attention(q, bufs, li, pos)
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
     mlp_out = _mlp(h, p, cfg)
-    return x + mlp_out, k_all, v_all
+    return x + mlp_out, bufs
 
 
 def _mlp(h, p, cfg):
@@ -313,12 +412,12 @@ def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
     # Unrolled layer loop with static per-layer indices — NOT a lax.scan
     # with the caches as xs/ys (see _decode_block: scan forces whole-cache
     # copies every step)
-    new_k, new_v = cache["k"], cache["v"]
+    bufs = _kv_bufs(cache)
     for li in range(cfg.n_layers):
         layer_params = jax.tree.map(lambda a: a[li], params["blocks"])
-        x, new_k, new_v = _decode_block(
-            x, layer_params, new_k, new_v, li, pos, cfg, rope, window)
-    return x, {"k": new_k, "v": new_v, "length": pos + tokens.shape[1]}
+        x, bufs = _decode_block(
+            x, layer_params, bufs, li, pos, cfg, rope, window)
+    return x, dict(bufs, length=pos + tokens.shape[1])
 
 
 def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
@@ -399,8 +498,9 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     cos, sin = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
     # Unrolled layers, prompt K/V written straight into the stacked cache
-    # (same no-scan rationale as extend_step)
-    k_filled, v_filled = cache["k"], cache["v"]
+    # (same no-scan rationale as extend_step; int8 caches quantize at the
+    # write — the prefill forward itself runs full-precision)
+    bufs = _kv_bufs(cache)
     for li in range(cfg.n_layers):
         p = jax.tree.map(lambda a: a[li], params["blocks"])
         h = rms_norm_reference(x, p["attn_norm"])
@@ -414,16 +514,14 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)
-        k_filled = jax.lax.dynamic_update_slice(
-            k_filled, k[:, :s][None], (li, 0, 0, 0, 0))
-        v_filled = jax.lax.dynamic_update_slice(
-            v_filled, v[:, :s][None], (li, 0, 0, 0, 0))
+        for n, c in _kv_writes(bufs, k[:, :s], v[:, :s]).items():
+            bufs[n] = _write_kv_chunk(bufs[n], c, li,
+                                      jnp.asarray(0, jnp.int32), None)
     x = rms_norm_reference(x, params["final_norm"])
     logits = jnp.einsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
                         preferred_element_type=jnp.float32)
     logits = logits.astype(cfg.logits_storage_dtype)
-    return logits, {"k": k_filled, "v": v_filled,
-                    "length": jnp.asarray(s, jnp.int32)}
+    return logits, dict(bufs, length=jnp.asarray(s, jnp.int32))
 
 
 def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
@@ -1052,10 +1150,10 @@ def beam_search(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
     logits, cache = prefill(params, prompt, cfg, max_len)
 
     # tile the prefilled cache across beams: [L, B, ...] -> [L, B*W, ...]
-    def tile(x):
-        return jnp.repeat(x, w, axis=1)
-    cache = {"k": tile(cache["k"]), "v": tile(cache["v"]),
-             "length": cache["length"]}
+    # (all position buffers — k/v plus int8 scales when present)
+    cache = dict({n: jnp.repeat(x, w, axis=1)
+                  for n, x in _kv_bufs(cache).items()},
+                 length=cache["length"])
 
     logp0 = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     scores, first = jax.lax.top_k(logp0, w)                  # [B, W]
@@ -1086,8 +1184,8 @@ def beam_search(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
         # reorder every per-beam tensor by parent; the cache gathers
         # along its flattened B*W axis
         gidx = (jnp.arange(b)[:, None] * w + parent).reshape(-1)
-        cache = dict(cache,
-                     k=cache["k"][:, gidx], v=cache["v"][:, gidx])
+        cache = dict(cache, **{n: x[:, gidx]
+                               for n, x in _kv_bufs(cache).items()})
         take = functools.partial(jnp.take_along_axis, indices=parent,
                                  axis=1)
         alive = take(alive)

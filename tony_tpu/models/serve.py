@@ -63,7 +63,7 @@ import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
 from tony_tpu.models.decode import (_check_draft_vocab, _filter_logits,
-                                    _propose_and_verify,
+                                    _kv_bufs, _propose_and_verify,
                                     _propose_and_verify_sampled, _sample,
                                     decode_step, extend_step,
                                     init_kv_cache, prefill)
@@ -71,14 +71,13 @@ from tony_tpu.models.decode import (_check_draft_vocab, _filter_logits,
 
 def _place_prefill(cache, mini, row, s_p):
     """Land a batch-1 prefill's K/V into cache slot ``row`` (one
-    contiguous ``dynamic_update_slice`` per buffer) and set the row's
-    frontier to the prompt length."""
-    return {
-        "k": jax.lax.dynamic_update_slice(cache["k"], mini["k"],
-                                          (0, row, 0, 0, 0)),
-        "v": jax.lax.dynamic_update_slice(cache["v"], mini["v"],
-                                          (0, row, 0, 0, 0)),
-        "length": cache["length"].at[row].set(s_p)}
+    contiguous ``dynamic_update_slice`` per buffer — k/v plus int8
+    scales when the cache is quantized) and set the row's frontier to
+    the prompt length."""
+    placed = {n: jax.lax.dynamic_update_slice(cache[n], mini[n],
+                                              (0, row, 0, 0, 0))
+              for n in _kv_bufs(mini)}
+    return dict(placed, length=cache["length"].at[row].set(s_p))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
@@ -103,7 +102,7 @@ def prefix_template(params, prefix, cfg):
     [P] ints."""
     _, mini = prefill(params, jnp.asarray(prefix, jnp.int32)[None], cfg,
                       max_len=len(prefix))
-    return {"k": mini["k"], "v": mini["v"]}
+    return _kv_bufs(mini)
 
 
 def _extend_from_template(model_params, template, suffix, model_cfg):
@@ -113,18 +112,14 @@ def _extend_from_template(model_params, template, suffix, model_cfg):
     exactly as a monolithic prefill of prefix+suffix would). Returns
     (suffix logits [1, S, V], filled mini cache, total length P+S).
     Shared by the greedy and speculative prefix admitters."""
-    l, _, p_len, kv, hd = template["k"].shape
+    p_len = template["k"].shape[2]
     s_len = suffix.shape[1]
-    mini = {
-        "k": jnp.concatenate(
-            [template["k"],
-             jnp.zeros((l, 1, s_len, kv, hd), template["k"].dtype)],
-            axis=2),
-        "v": jnp.concatenate(
-            [template["v"],
-             jnp.zeros((l, 1, s_len, kv, hd), template["v"].dtype)],
-            axis=2),
-        "length": jnp.asarray(p_len, jnp.int32)}
+    mini = dict(
+        {n: jnp.concatenate(
+            [x, jnp.zeros(x.shape[:2] + (s_len,) + x.shape[3:],
+                          x.dtype)], axis=2)
+         for n, x in template.items()},
+        length=jnp.asarray(p_len, jnp.int32))
     lg, mini = extend_step(model_params, suffix, mini, p_len, model_cfg)
     return lg, mini, p_len + s_len
 

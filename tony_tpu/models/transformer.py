@@ -93,6 +93,15 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # KV-cache storage for the decode/serving path: "model" stores K/V in
+    # ``dtype`` (exact); "int8" quantizes each written token per kv-head
+    # (absmax/127 scale carried in a parallel [.., KV, 1] f32 buffer) —
+    # the cache's HBM footprint and read traffic halve vs bf16, so a
+    # serving host fits ~2x the slots (or 2x max_len) in the same memory.
+    # Decode logits shift by the ~0.4% relative rounding of K/V; training
+    # and prefill math are untouched (quantization happens only at the
+    # cache write). See models/decode.py.
+    kv_cache_dtype: str = "model"
 
     def __post_init__(self):
         # fail where the config was written, not at first trace
@@ -107,6 +116,10 @@ class TransformerConfig:
         if self.pp_schedule not in ("gpipe", "1f1b"):
             raise ValueError(f"unknown pp_schedule {self.pp_schedule!r}; "
                              f"expected 'gpipe' or '1f1b'")
+        if self.kv_cache_dtype not in ("model", "int8"):
+            raise ValueError(f"unknown kv_cache_dtype "
+                             f"{self.kv_cache_dtype!r}; expected 'model' "
+                             f"or 'int8'")
 
     @property
     def head_dim(self) -> int:
@@ -116,6 +129,10 @@ class TransformerConfig:
     def kv_heads(self) -> int:
         return (self.n_kv_heads if self.n_kv_heads is not None
                 else self.n_heads)
+
+    @property
+    def kv_quant(self) -> bool:
+        return self.kv_cache_dtype == "int8"
 
     @property
     def logits_storage_dtype(self):
