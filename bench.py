@@ -204,6 +204,12 @@ def main() -> None:
         # (ops/attention.py _FUSED_PARTIALS_BYTES) has an efficiency
         # number to regress against.
         secondary("seq8k", cfg, 4, 8192, 10, key=6)
+        # sliding-window attention at the same shape: the kernels triage
+        # out-of-window blocks like above-diagonal ones (skip + DMA
+        # elision), so attention cost goes O(seq·window). Measured 1.34x
+        # over full causal at this shape when introduced (round 5).
+        secondary("seq8k_win1k", cfg.scaled(attn_window=1024), 4, 8192,
+                  10, key=6)
         # extreme context (seq 32768, b1) under the attention-output-save
         # remat policy (round 5): saving the flash o/lse lets the
         # backward skip re-running the O(S²) forward kernel — +19%
@@ -315,9 +321,10 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
 
         return do_prefill, scan_decode
 
-    def time_one(max_len, force_dense=False, b=batch, run_cfg=cfg):
+    def time_one(max_len, force_dense=False, b=batch, run_cfg=cfg,
+                 p_len=prompt_len):
         prompt = jax.random.randint(jax.random.PRNGKey(17),
-                                    (b, prompt_len), 0, cfg.vocab_size)
+                                    (b, p_len), 0, cfg.vocab_size)
         saved = D._BLOCKWISE_MIN_LEN
         if force_dense:
             D._BLOCKWISE_MIN_LEN = 1 << 30
@@ -360,6 +367,14 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
     qcfg = cfg.scaled(kv_cache_dtype="int8")
     tps8k_quant = time_one(8192, run_cfg=qcfg)
     tps2k_wide_quant = time_one(2048, b=wide, run_cfg=qcfg)
+    # sliding-window decode at DEEP history (7k-token prompt): full
+    # attention walks every live cache block per token; a window-1024
+    # model walks ~4 blocks regardless of history — per-token serving
+    # cost O(window), the decode-side claim of attn_window.
+    deep = 7168
+    tps_deep_full = time_one(8192, p_len=deep)
+    tps_deep_win = time_one(8192, p_len=deep,
+                            run_cfg=cfg.scaled(attn_window=1024))
     return {
         "decode_maxlen2k_tokens_per_s": round(tps2k, 1),
         "decode_maxlen8k_tokens_per_s": round(tps8k, 1),
@@ -375,6 +390,10 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
             tps2k_wide_quant, 1),
         f"decode_quant8_vs_bf16_2k_b{wide}": round(
             tps2k_wide_quant / tps2k_wide, 2),
+        "decode_deep7k_tokens_per_s": round(tps_deep_full, 1),
+        "decode_deep7k_win1k_tokens_per_s": round(tps_deep_win, 1),
+        "decode_win1k_vs_full_deep7k": round(
+            tps_deep_win / tps_deep_full, 2),
     }
 
 

@@ -106,6 +106,11 @@ def main() -> int:
                              "axis: gpipe (default) or 1f1b (O(pp) live "
                              "microbatch activations instead of O(M) — "
                              "for deep pipelines / many microbatches)")
+    parser.add_argument("--attn_window", type=int, default=0,
+                        help="sliding-window attention: each token "
+                             "attends its N most recent positions "
+                             "(0 = full causal); attention cost goes "
+                             "O(seq*window) instead of O(seq^2)")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -119,7 +124,8 @@ def main() -> int:
         dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         cp_strategy=args.cp_strategy,
         num_experts=args.num_experts,
-        pp_schedule=args.pp_schedule)
+        pp_schedule=args.pp_schedule,
+        attn_window=args.attn_window)
 
     params = shard_pytree(T.init_params(jax.random.PRNGKey(0), cfg),
                           T.logical_axes(cfg), mesh)

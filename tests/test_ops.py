@@ -385,3 +385,94 @@ class TestFusedAdamW:
         assert new_p["w"].dtype == jnp.bfloat16
         assert new_state.nu["w"].dtype == jnp.float32
         assert bool(jnp.all(new_p["w"] < params["w"]))   # moved downhill
+
+
+class TestSlidingWindow:
+    """Sliding-window (local) attention: query i attends positions
+    (i-window, i]. The flash kernels triage out-of-window blocks exactly
+    like above-diagonal ones (skip + DMA elision), so correctness must
+    hold at every block/window alignment — window smaller than, equal
+    to, larger than, and not a multiple of the block size."""
+
+    @pytest.fixture(scope="class")
+    def wqkv(self):
+        r = np.random.RandomState(5)
+        shape = (2, 128, 2, 32)
+        return tuple(jnp.asarray(r.randn(*shape), jnp.float32)
+                     for _ in range(3))
+
+    @pytest.mark.parametrize("window", [1, 17, 32, 50, 96, 127, 128, 999])
+    def test_forward_matches_dense(self, wqkv, window):
+        q, k, v = wqkv
+        o = flash_attention(q, k, v, causal=True, window=window,
+                            block_q=32, block_k=32)
+        ref = reference_attention(q, k, v, causal=True, window=window)
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    @pytest.mark.parametrize("window", [17, 50, 96])
+    def test_gradients_match_dense(self, wqkv, window):
+        q, k, v = wqkv
+        g = jax.grad(lambda *a: flash_attention(
+            *a, window=window, block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(
+            *a, window=window).sum(), argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_gradients_two_pass(self, wqkv, monkeypatch):
+        import tony_tpu.ops.attention as A
+        monkeypatch.setattr(A, "_FUSED_PARTIALS_BYTES", 0)
+        q, k, v = wqkv
+        g = jax.grad(lambda *a: flash_attention(
+            *a, window=50, block_q=32, block_k=32).sum(),
+            argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda *a: reference_attention(
+            *a, window=50).sum(), argnums=(0, 1, 2))(q, k, v)
+        for got, want in zip(g, gr):
+            np.testing.assert_allclose(got, want, atol=5e-5)
+
+    def test_gqa_forward_matches_dense(self):
+        r = np.random.RandomState(6)
+        q = jnp.asarray(r.randn(2, 128, 4, 32), jnp.float32)
+        k = jnp.asarray(r.randn(2, 128, 2, 32), jnp.float32)
+        v = jnp.asarray(r.randn(2, 128, 2, 32), jnp.float32)
+        o = flash_attention(q, k, v, causal=True, window=40,
+                            block_q=32, block_k=32)
+        ref = reference_attention(q, k, v, causal=True, window=40)
+        np.testing.assert_allclose(o, ref, atol=2e-5)
+
+    def test_with_lse_matches_dense(self, wqkv):
+        from tony_tpu.ops.attention import _dense_with_lse
+        q, k, v = wqkv
+        o, lse = flash_attention_with_lse(q, k, v, causal=True, window=50,
+                                          block_q=32, block_k=32)
+        oref, lref = _dense_with_lse(q, k, v, causal=True, scale=None,
+                                     window=50)
+        np.testing.assert_allclose(o, oref, atol=2e-5)
+        np.testing.assert_allclose(lse, lref, atol=2e-5)
+
+    def test_out_of_window_kv_cannot_leak(self, wqkv):
+        """The sharp masking check: corrupting K/V at position p must
+        leave every query at position >= p+window BIT-IDENTICAL, and
+        must change some query inside [p, p+window)."""
+        q, k, v = wqkv
+        w, p = 40, 30
+        o1 = flash_attention(q, k, v, causal=True, window=w,
+                             block_q=32, block_k=32)
+        k2 = k.at[:, p].set(1e4)
+        v2 = v.at[:, p].set(-1e4)
+        o2 = flash_attention(q, k2, v2, causal=True, window=w,
+                             block_q=32, block_k=32)
+        np.testing.assert_array_equal(np.asarray(o1[:, p + w:]),
+                                      np.asarray(o2[:, p + w:]))
+        assert float(jnp.max(jnp.abs(o1[:, p:p + w] - o2[:, p:p + w]))) > 1
+
+    def test_window_requires_causal(self, wqkv):
+        q, k, v = wqkv
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8,
+                            block_q=32, block_k=32)
+        with pytest.raises(ValueError, match=">= 1"):
+            flash_attention(q, k, v, causal=True, window=0,
+                            block_q=32, block_k=32)

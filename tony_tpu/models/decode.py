@@ -123,7 +123,8 @@ def _q_positions(q_start, b, n_q):
 
 
 def _cached_attention_blockwise(q, bufs, li, q_start,
-                                block: int = DECODE_BLOCK):
+                                block: int = DECODE_BLOCK,
+                                attn_window: int | None = None):
     """Online-softmax cached attention reading only the ACTIVE cache
     blocks. The dense path reads all max_len rows every step — cost
     scales with the padded buffer, not the tokens generated, which at
@@ -168,6 +169,11 @@ def _cached_attention_blockwise(q, bufs, li, q_start,
     q_pos = _q_positions(q_start, b, n_q)                       # [B, Q]
     qg = q.reshape(b, n_q, kv, group, d)
     n_active = (jnp.max(q_pos) + block) // block                # traced
+    # sliding window: blocks entirely older than every row's window are
+    # never read — the loop STARTS at the window's first block, so
+    # per-token serving cost is O(window) regardless of history length
+    lo = (jnp.maximum(jnp.min(q_pos) - attn_window + 1, 0) // block
+          if attn_window is not None else 0)
 
     m0 = jnp.full((b, kv, group, n_q), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((b, kv, group, n_q), jnp.float32)
@@ -186,6 +192,9 @@ def _cached_attention_blockwise(q, bufs, li, q_start,
         # >= i*block drops rows re-read by a clamped trailing slice
         mask = ((k_pos[None, None, :] >= i * block)
                 & (k_pos[None, None, :] <= q_pos[:, :, None]))  # [B, Q, S]
+        if attn_window is not None:
+            mask = mask & (q_pos[:, :, None] - k_pos[None, None, :]
+                           < attn_window)
         s = jnp.einsum("bqkgd,bskd->bkgqs", qg, kb,
                        preferred_element_type=jnp.float32) * scale
         if quant:
@@ -213,13 +222,13 @@ def _cached_attention_blockwise(q, bufs, li, q_start,
         acc = acc * alpha[..., None] + pv
         return new_m, l, acc
 
-    m, l, acc = jax.lax.fori_loop(0, n_active, body, (m0, l0, acc0))
-    o = acc / l[..., None]                  # every query sees position 0
+    m, l, acc = jax.lax.fori_loop(lo, n_active, body, (m0, l0, acc0))
+    o = acc / l[..., None]          # l > 0: every query attends itself
     o = o.transpose(0, 3, 1, 2, 4).reshape(b, n_q, h, d)
     return o.astype(q.dtype)
 
 
-def _cached_attention(q, bufs, li, q_start):
+def _cached_attention(q, bufs, li, q_start, attn_window=None):
     """q: [B, K, H, hd] holding positions q_start..q_start+K-1; ``bufs``:
     the cache's stacked [L, B, max_len, KV, hd] k/v buffers (plus
     ``k_scale``/``v_scale`` for int8 caches) with ``li`` this layer's
@@ -238,7 +247,8 @@ def _cached_attention(q, bufs, li, q_start):
     k_all, v_all = bufs["k"], bufs["v"]
     max_len = k_all.shape[2]
     if max_len >= _BLOCKWISE_MIN_LEN:
-        return _cached_attention_blockwise(q, bufs, li, q_start)
+        return _cached_attention_blockwise(q, bufs, li, q_start,
+                                           attn_window=attn_window)
     quant = "k_scale" in bufs
     k_cache, v_cache = k_all[li], v_all[li]
     if quant:
@@ -251,6 +261,9 @@ def _cached_attention(q, bufs, li, q_start):
     q_pos = _q_positions(q_start, b, n_q)                       # [B, Q]
     k_pos = jnp.arange(max_len)                                 # [S]
     mask = k_pos[None, None, :] <= q_pos[:, :, None]            # [B, Q, S]
+    if attn_window is not None:
+        mask = mask & (q_pos[:, :, None] - k_pos[None, None, :]
+                       < attn_window)
     qg = q.reshape(b, n_q, kv, group, d)
     scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
                         preferred_element_type=jnp.float32) * scale
@@ -367,7 +380,8 @@ def _decode_block(x, layer_params, bufs, li, pos, cfg, rope,
     pos = jnp.asarray(pos)
     bufs = {n: _write_kv_chunk(bufs[n], c, li, pos, window)
             for n, c in _kv_writes(bufs, k, v).items()}
-    o = _cached_attention(q, bufs, li, pos)
+    o = _cached_attention(q, bufs, li, pos,
+                          attn_window=cfg.attn_window or None)
     x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
@@ -510,7 +524,7 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
         # GQA K/V go to the kernels unexpanded (flash/reference consume
         # kv_heads-wide K/V natively; no-op distinction for MHA)
-        o = T._attention(q, k, v, None)
+        o = T._attention(q, k, v, None, window=cfg.attn_window or None)
         x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)

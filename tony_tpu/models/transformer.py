@@ -78,6 +78,15 @@ class TransformerConfig:
     # (ppermute K/V rotation, O(S/cp) memory, any head count) or "ulysses"
     # (two all-to-alls, full-seq attention on H/cp local heads).
     cp_strategy: str = "ring"
+    # Sliding-window attention (Mistral-style local attention): each
+    # query attends its `attn_window` most recent positions. 0 = full
+    # causal. The flash kernels triage out-of-window blocks exactly like
+    # above-diagonal ones (skipped compute + elided DMA), so fwd+bwd
+    # attention cost scales with seq×window instead of seq²; the decode
+    # blockwise path starts its cache walk at the window's first block,
+    # making per-token serving cost O(window) regardless of history.
+    # Not composable with context parallelism (cp > 1) yet.
+    attn_window: int = 0
     # GPipe microbatch count when the mesh has a pp axis > 1 (forward routes
     # through parallel/pipeline.py automatically). 0 = auto: 2·pp if it
     # divides the batch (bubble (pp-1)/(pp+1)), else pp. Must divide the
@@ -120,6 +129,9 @@ class TransformerConfig:
             raise ValueError(f"unknown kv_cache_dtype "
                              f"{self.kv_cache_dtype!r}; expected 'model' "
                              f"or 'int8'")
+        if self.attn_window < 0:
+            raise ValueError(f"attn_window must be >= 0 (0 = full causal "
+                             f"attention), got {self.attn_window}")
 
     @property
     def head_dim(self) -> int:
@@ -283,13 +295,23 @@ def expand_kv(q: jax.Array, k: jax.Array,
     return jnp.repeat(k, rep, axis=2), jnp.repeat(v, rep, axis=2)
 
 
-def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
+def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring",
+               window: int | None = None):
     if cp_strategy not in ("ring", "ulysses"):
         # Silent fallback would make a typo'd strategy benchmark the wrong
         # collective pattern.
         raise ValueError(f"unknown cp_strategy {cp_strategy!r}; "
                          f"expected 'ring' or 'ulysses'")
     if mesh is not None and "cp" in mesh.shape and mesh.shape["cp"] > 1:
+        if window is not None:
+            # a window crossing chunk boundaries needs window-aware hop
+            # masking in the ring (and head-split-aware masking in
+            # ulysses) — silently ignoring it would train a different
+            # model than the config says
+            raise NotImplementedError(
+                "attn_window is not supported with context parallelism "
+                "(cp > 1) yet; shard long sequences with cp OR bound "
+                "attention with a window, not both")
         if cp_strategy == "ulysses":
             # GQA K/V stay unexpanded when kv heads divide tp·cp — the
             # wrapper expands only when the head split cannot be satisfied
@@ -300,8 +322,8 @@ def _attention(q, k, v, mesh: Mesh | None, cp_strategy: str = "ring"):
         return ring_attention(q, k, v, mesh, causal=True)
     # flash and reference both consume GQA K/V natively (fewer kv heads)
     if jax.default_backend() == "tpu":
-        return flash_attention(q, k, v, causal=True)
-    return reference_attention(q, k, v, causal=True)
+        return flash_attention(q, k, v, causal=True, window=window)
+    return reference_attention(q, k, v, causal=True, window=window)
 
 
 def _remat_policy(cfg: TransformerConfig):
@@ -349,7 +371,8 @@ def _block(x, p, cfg: TransformerConfig, mesh, rules, rope=None,
     q = constrain(q, ("batch", "seq", "heads", "kv"), mesh, rules)
     k = constrain(k, ("batch", "seq", kv_head_axis, "kv"), mesh, rules)
     v = constrain(v, ("batch", "seq", kv_head_axis, "kv"), mesh, rules)
-    o = _attention(q, k, v, mesh, cfg.cp_strategy)
+    o = _attention(q, k, v, mesh, cfg.cp_strategy,
+                   cfg.attn_window or None)
     attn_out = jnp.einsum("bshk,hkd->bsd", o, p["wo"])
     x = x + constrain(attn_out, ("batch", "seq", "embed"), mesh, rules)
 
@@ -523,11 +546,20 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
     recompute is excluded, so this yields MFU (not HFU) when divided by
     wall-clock achieved FLOPs. Attention is counted causal (half of the full
     S² score/value matmuls), matching what the flash kernel actually executes.
+    Sliding-window models (cfg.attn_window) count only the attended
+    length — the mean over positions of min(position+1, window) — so MFU
+    stays an honest achieved/model-FLOPs ratio rather than crediting
+    skipped blocks.
     """
     d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
     kv_width = cfg.kv_heads * cfg.head_dim     # == d for MHA
-    proj = 4 * d * d + 4 * d * kv_width   # wq + wo, + wk + wv (GQA-aware)
-    attn = 2 * seq * d                    # QK^T + AV, causal half of 4·S·d
+    if cfg.attn_window and cfg.attn_window < seq:
+        w = cfg.attn_window
+        # positions 0..w-1 attend position+1 keys; the rest attend w
+        mean_attended = (w * (w + 1) / 2 + (seq - w) * w) / seq
+        attn = 4 * mean_attended * d      # QK^T + AV over attended keys
+    else:
+        attn = 2 * seq * d                # QK^T + AV, causal half of 4·S·d
     if cfg.num_experts:
         mlp = 2 * d * cfg.num_experts + cfg.moe_top_k * 4 * d * f
     else:

@@ -109,25 +109,48 @@ def _pick_group(bh: int, block_h: int) -> int:
     return best
 
 
-def _causal_mask(qi, ki, bq: int, bk: int):
+def _causal_mask(qi, ki, bq: int, bk: int, window: int | None = None):
     """[bq, bk] bool mask for the (qi, ki) block — computed once per grid
     step and shared by all heads in the group. ``qi`` is the BAND-relative
     q-block index (callers take program_id(..) mod blocks-per-band; for
-    plain MHA the band is the whole sequence and the mod is identity)."""
+    plain MHA the band is the whole sequence and the mod is identity).
+    ``window`` adds the sliding-window bound: query attends only the
+    ``window`` most recent positions (qpos - kpos < window)."""
     qpos = qi * bq + lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = ki * bk + lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-    return qpos >= kpos
+    mask = qpos >= kpos
+    if window is not None:
+        mask = jnp.logical_and(mask, qpos - kpos < window)
+    return mask
 
 
-def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None):
-    """Causal block triage, shared by every kernel: blocks entirely above
-    the diagonal are skipped (``on_skip`` runs if given — e.g. zeroing
-    partial outputs), blocks entirely below it run ``accumulate(False)``
-    (no per-element compare/select — measurable in these VPU-bound
-    kernels, increasingly so at long sequence where such blocks dominate),
-    and diagonal-crossing blocks run ``accumulate(True)``."""
+def _block_work(qi, ki, bq: int, bk: int, window: int | None):
+    """Whether block (qi, ki) holds ANY attended (q, k) pair: below-or-on
+    the diagonal, and — with a sliding window — not entirely older than
+    the window (youngest k in the block within ``window`` of the oldest
+    q)."""
     work = (qi + 1) * bq > ki * bk
+    if window is not None:
+        work = jnp.logical_and(work,
+                               qi * bq - ((ki + 1) * bk - 1) < window)
+    return work
+
+
+def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None,
+                     window: int | None = None):
+    """Causal (+ sliding-window) block triage, shared by every kernel:
+    blocks with no attended pair — entirely above the diagonal, or (with
+    ``window``) entirely older than the window — are skipped (``on_skip``
+    runs if given — e.g. zeroing partial outputs); blocks whose every
+    pair is attended run ``accumulate(False)`` (no per-element
+    compare/select — measurable in these VPU-bound kernels, increasingly
+    so at long sequence where such blocks dominate); boundary-crossing
+    blocks run ``accumulate(True)``."""
+    work = _block_work(qi, ki, bq, bk, window)
     unmasked = qi * bq >= (ki + 1) * bk - 1
+    if window is not None:
+        unmasked = jnp.logical_and(
+            unmasked, (qi + 1) * bq - 1 - ki * bk < window)
 
     @pl.when(jnp.logical_and(work, unmasked))
     def _():
@@ -149,7 +172,7 @@ def _causal_dispatch(qi, ki, bq: int, bk: int, accumulate, on_skip=None):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
                 *, causal: bool, g: int, bq: int, bk: int,
-                nk: int, band_nq: int):
+                nk: int, band_nq: int, window: int | None):
     # Q arrives PRE-SCALED by scale·log2e (:func:`_prep_flat`), so the
     # raw MXU dot is already the base-2 score and the kernel never
     # touches a [bq, bk] scale multiply; all max/sum bookkeeping below
@@ -165,7 +188,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
         ml_scr[:] = jnp.full_like(ml_scr, _NEG_INF)
 
     def _accumulate(masked: bool):
-        mask = _causal_mask(qi, ki, bq, bk) if masked else None
+        mask = _causal_mask(qi, ki, bq, bk, window) if masked else None
         for gi in range(g):
             q = q_ref[gi]                              # [bq, d], pre-scaled
             k = k_ref[gi]                              # [bk, d]
@@ -194,7 +217,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             ml_scr[gi, :, 1:2] = l_new
 
     if causal:
-        _causal_dispatch(qi, ki, bq, bk, _accumulate)
+        _causal_dispatch(qi, ki, bq, bk, _accumulate, window=window)
     else:
         _accumulate(False)
 
@@ -209,32 +232,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, ml_scr, acc_scr,
             lse_ref[gi] = (_LN2 * (m + jnp.log2(jnp.maximum(l, 1e-30))))[:, 0]
 
 
-def _kv_index_map(causal: bool, bq: int, bk: int, band_nq: int):
+def _kv_index_map(causal: bool, bq: int, bk: int, band_nq: int,
+                  window: int | None = None):
     """K/V block index map for q-major grids ``(b, qi, ki)``. For causal
     kernels the ki coordinate is CLAMPED to the last diagonal-touching
     block of the (band-relative) q row: skipped above-diagonal steps then
     repeat the previous step's block index, and the Pallas pipeline elides
     the HBM→VMEM copy for an unchanged index — at long sequence nearly
     half the K/V DMA traffic was being fetched for blocks the kernel
-    never reads."""
+    never reads. A sliding ``window`` clamps from BELOW too: kv blocks
+    entirely older than the window repeat the first in-window block's
+    index, so their DMA is elided the same way — what makes windowed
+    cost scale with the window, not the sequence."""
     if not causal:
         return lambda b, i, j: (b, j, 0)
 
     def _map(b, i, j):
-        last = ((i % band_nq + 1) * bq - 1) // bk
+        rel = i % band_nq
+        last = ((rel + 1) * bq - 1) // bk
+        if window is not None:
+            first = jnp.maximum(rel * bq - window + 1, 0) // bk
+            return (b, jnp.clip(j, first, last), 0)
         return (b, jnp.minimum(j, last), 0)
 
     return _map
 
 
-def _flash_forward(q, k, v, *, causal, g, bq, bk, band):
+def _flash_forward(q, k, v, *, causal, g, bq, bk, band, window=None):
     bh, sq, d = q.shape                 # sq = rep·band under GQA
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
     kernel = functools.partial(_fwd_kernel, causal=causal,
                                g=g, bq=bq, bk=bk, nk=nk,
-                               band_nq=_cdiv(band, bq))
-    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq))
+                               band_nq=_cdiv(band, bq), window=window)
+    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq), window)
     o, lse = pl.pallas_call(
         kernel,
         grid=(bh // g, nq, nk),
@@ -307,7 +338,8 @@ _BWD_BK = 512
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       *refs, causal: bool, g: int, bq: int, bk: int,
-                      nq: int, has_dlse: bool, band_nq: int):
+                      nq: int, has_dlse: bool, band_nq: int,
+                      window: int | None):
     # refs = ([dlse_ref,] dqp_ref, dk_ref, dv_ref, dk_scr, dv_scr): the
     # dlse input exists only for the with-lse entry point, so the hot
     # plain-attention path compiles the exact same kernel.
@@ -330,7 +362,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # iota+compare is in the noise next to exp2), which keeps one
         # copy of the [bq, bk] f32 intermediates on the kernel stack —
         # the VMEM room that pays for 512-wide kv blocks.
-        mask = _causal_mask(qi, ki, bq, bk) if causal else None
+        mask = _causal_mask(qi, ki, bq, bk, window) if causal else None
         for gi in range(g):
             q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]                               # [bk, d]
@@ -365,7 +397,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)).astype(dqp_ref.dtype)
 
     if causal:
-        work = (qi + 1) * bq > ki * bk
+        work = _block_work(qi, ki, bq, bk, window)
 
         @pl.when(work)
         def _():
@@ -373,7 +405,8 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
         @pl.when(jnp.logical_not(work))
         def _():
-            # blocks above the diagonal contribute nothing, but their dq
+            # blocks with no attended pair (above the diagonal, or older
+            # than the sliding window) contribute nothing, but their dq
             # partial blocks still exist and must be zeroed
             dqp_ref[:] = jnp.zeros_like(dqp_ref)
     else:
@@ -385,18 +418,25 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd_q_maps(causal: bool, bq: int, bk: int, band_nq: int):
+def _bwd_q_maps(causal: bool, bq: int, bk: int, band_nq: int,
+                window: int | None = None):
     """Index maps for q-side operands on the kv-major grid ``(b, ki, qi)``.
     For causal kernels the leading (band-relative) q blocks of each kv
     sweep sit above the diagonal and are skipped — clamp them to the
     first diagonal-touching block so the pipeline doesn't DMA blocks the
-    kernel never reads (mirror of :func:`_kv_index_map`)."""
+    kernel never reads (mirror of :func:`_kv_index_map`). With a sliding
+    ``window``, trailing q blocks entirely NEWER than window-past-this-kv
+    are skipped too — clamp from above symmetrically."""
     if not causal:
         return (lambda b, j, i: (b, i, 0)), (lambda b, j, i: (b, i))
 
     def _clamp(j, i):
         rel = i % band_nq
         first = (j * bk) // bq
+        if window is not None:
+            last = jnp.minimum((j + 1) * bk - 1 + window - 1, band_nq
+                               * bq - 1) // bq
+            return i - rel + jnp.clip(rel, first, jnp.maximum(last, first))
         return i - rel + jnp.maximum(rel, first)
 
     return (lambda b, j, i: (b, _clamp(j, i), 0),
@@ -404,7 +444,7 @@ def _bwd_q_maps(causal: bool, bq: int, bk: int, band_nq: int):
 
 
 def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
-                          bq, bk, band):
+                          bq, bk, band, window=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     has_dlse = dlse is not None
@@ -430,7 +470,7 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                            # [bh, sq]
     lse2 = lse * _LOG2E
-    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq)
+    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq, window)
     in_specs = [
         pl.BlockSpec((g, bq, d), q_map),
         pl.BlockSpec((g, bk, d), lambda b, j, i: (b, j, 0)),
@@ -446,7 +486,7 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
     dqp, dk, dv = pl.pallas_call(
         functools.partial(_bwd_fused_kernel, causal=causal,
                           g=g, bq=bq, bk=bk, nq=nq, has_dlse=has_dlse,
-                          band_nq=band_nq),
+                          band_nq=band_nq, window=window),
         grid=(bh // g, nk, nq),
         in_specs=in_specs,
         out_specs=[
@@ -484,7 +524,7 @@ def _flash_backward_fused(q, k, v, o, lse, do, dlse, *, causal, g,
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                dq_scr, *, causal: bool, g: int, bq: int,
-               bk: int, nk: int, band_nq: int):
+               bk: int, nk: int, band_nq: int, window: int | None):
     qi = pl.program_id(1) % band_nq     # GQA band-relative (identity: MHA)
     ki = pl.program_id(2)
 
@@ -493,7 +533,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_scr[:] = jnp.zeros_like(dq_scr)
 
     def _accumulate(masked: bool):
-        mask = _causal_mask(qi, ki, bq, bk) if masked else None
+        mask = _causal_mask(qi, ki, bq, bk, window) if masked else None
         for gi in range(g):
             q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]
@@ -515,7 +555,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                                       preferred_element_type=jnp.float32)
 
     if causal:
-        _causal_dispatch(qi, ki, bq, bk, _accumulate)
+        _causal_dispatch(qi, ki, bq, bk, _accumulate, window=window)
     else:
         _accumulate(False)
 
@@ -531,7 +571,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *,
                 causal: bool, g: int, bq: int, bk: int, nq: int,
-                band_nq: int):
+                band_nq: int, window: int | None):
     ki = pl.program_id(1)
     qi_g = pl.program_id(2)             # global: init/finalize sequencing
     qi = qi_g % band_nq                 # GQA band-relative: causal triage
@@ -542,7 +582,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
     def _accumulate(masked: bool):
-        mask = _causal_mask(qi, ki, bq, bk) if masked else None
+        mask = _causal_mask(qi, ki, bq, bk, window) if masked else None
         for gi in range(g):
             q = q_ref[gi]                               # [bq, d], pre-scaled
             k = k_ref[gi]                               # [bk, d]
@@ -568,7 +608,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)     # [bk, d]
 
     if causal:
-        _causal_dispatch(qi, ki, bq, bk, _accumulate)
+        _causal_dispatch(qi, ki, bq, bk, _accumulate, window=window)
     else:
         _accumulate(False)
 
@@ -579,7 +619,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
-                    bq, bk, band):
+                    bq, bk, band, window=None):
     bh, sq, d = q.shape
     sk = k.shape[1]
     nq, nk = _cdiv(sq, bq), _cdiv(sk, bk)
@@ -595,7 +635,7 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
     if partial_bytes <= _FUSED_PARTIALS_BYTES:
         return _flash_backward_fused(q, k, v, o, lse, do, dlse,
                                      causal=causal, g=g, bq=bq, bk=bk,
-                                     band=band)
+                                     band=band, window=window)
     # Mosaic allocates kernel stack for BOTH _causal_dispatch bodies, so the
     # [bq, bk] f32 intermediates count twice; 256-wide blocks keep the
     # two-pass kernels inside the ~16 MB VMEM budget (long sequences have
@@ -617,11 +657,12 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
     if dlse is not None:
         delta = delta - dlse
     lse2 = lse * _LOG2E
-    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq))
+    kv_map = _kv_index_map(causal, bq, bk, _cdiv(band, bq), window)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, g=g,
-                          bq=bq, bk=bk, nk=nk, band_nq=_cdiv(band, bq)),
+                          bq=bq, bk=bk, nk=nk, band_nq=_cdiv(band, bq),
+                          window=window),
         grid=(bh // g, nq, nk),
         in_specs=[
             pl.BlockSpec((g, bq, d), lambda b, i, j: (b, i, 0)),
@@ -640,10 +681,11 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
     )(q, k, v, do, lse2, delta)
 
     band_nq = _cdiv(band, bq)
-    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq)
+    q_map, q_map2 = _bwd_q_maps(causal, bq, bk, band_nq, window)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, g=g,
-                          bq=bq, bk=bk, nq=nq, band_nq=band_nq),
+                          bq=bq, bk=bk, nq=nq, band_nq=band_nq,
+                          window=window),
         grid=(bh // g, nk, nq),
         in_specs=[
             pl.BlockSpec((g, bq, d), q_map),
@@ -676,19 +718,19 @@ def _flash_backward(q, k, v, o, lse, do, dlse=None, *, causal, g,
 # Public op
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_bhsd(q, k, v, causal, g, bq, bk, band):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_bhsd(q, k, v, causal, g, bq, bk, band, window):
     # q arrives pre-scaled by scale·log2e (:func:`_prep_flat`); the fold
     # sits OUTSIDE this custom_vjp boundary, so plain AD of the multiply
     # routes the scale factor into dq for free.
     o, _ = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
-                          bk=bk, band=band)
+                          bk=bk, band=band, window=window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, g, bq, bk, band):
+def _flash_fwd_rule(q, k, v, causal, g, bq, bk, band, window):
     o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
-                            bk=bk, band=band)
+                            bk=bk, band=band, window=window)
     # checkpoint_name on the kernel OUTPUTS: under
     # remat_policy="attn" (save_only_these_names) the remat replay
     # fetches o/lse from the saved forward and DCE drops the flash
@@ -700,48 +742,65 @@ def _flash_fwd_rule(q, k, v, causal, g, bq, bk, band):
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd_rule(causal, g, bq, bk, band, residuals, grad):
+def _flash_bwd_rule(causal, g, bq, bk, band, window, residuals, grad):
     q, k, v, o, lse = residuals
     return _flash_backward(q, k, v, o, lse, grad, causal=causal,
-                           g=g, bq=bq, bk=bk, band=band)
+                           g=g, bq=bq, bk=bk, band=band, window=window)
 
 
 _flash_attention_bhsd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_attention_lse_bhsd(q, k, v, causal, g, bq, bk, band):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_attention_lse_bhsd(q, k, v, causal, g, bq, bk, band, window):
     """(o, lse) variant with lse as a DIFFERENTIATED output — what
     cross-chunk softmax merging (ring attention) needs: the merge weights
     are exp(lse_chunk - lse_total), so d(lse) must flow back into the
     score gradient (ds gains a +p·dlse term, folded into delta)."""
     return _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
-                          bk=bk, band=band)
+                          bk=bk, band=band, window=window)
 
 
-def _flash_lse_fwd_rule(q, k, v, causal, g, bq, bk, band):
+def _flash_lse_fwd_rule(q, k, v, causal, g, bq, bk, band, window):
     o, lse = _flash_forward(q, k, v, causal=causal, g=g, bq=bq,
-                            bk=bk, band=band)
+                            bk=bk, band=band, window=window)
     o = checkpoint_name(o, "flash_out")       # see _flash_fwd_rule
     lse = checkpoint_name(lse, "flash_lse")
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_lse_bwd_rule(causal, g, bq, bk, band, residuals, grads):
+def _flash_lse_bwd_rule(causal, g, bq, bk, band, window, residuals,
+                        grads):
     q, k, v, o, lse = residuals
     do, dlse = grads
     return _flash_backward(q, k, v, o, lse, do,
                            dlse.astype(jnp.float32),
-                           causal=causal, g=g, bq=bq, bk=bk, band=band)
+                           causal=causal, g=g, bq=bq, bk=bk, band=band,
+                           window=window)
 
 
 _flash_attention_lse_bhsd.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
+def _resolve_window(window, causal: bool, sq: int) -> int | None:
+    """Validate/normalize the sliding-window size: None or >= sq means
+    full causal attention (no window term compiled into the kernels);
+    windowed non-causal attention is undefined here (the window is
+    anchored on the causal diagonal)."""
+    if window is None:
+        return None
+    if not causal:
+        raise ValueError("sliding-window attention requires causal=True "
+                         "(the window is anchored on the diagonal)")
+    if window < 1:
+        raise ValueError(f"window must be >= 1, got {window}")
+    return None if window >= sq else int(window)
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     scale: float | None = None,
                     block_q: int = 256, block_k: int = 1024,
-                    block_h: int = 4):
+                    block_h: int = 4, window: int | None = None):
     """Fused attention over [batch, seq, heads, head_dim] inputs.
 
     K/V may carry FEWER heads than Q (grouped-query attention, h_kv | h):
@@ -763,14 +822,25 @@ def flash_attention(q, k, v, *, causal: bool = True,
     [block_q, block_k] f32 score intermediates times the g-scaled
     input/output/scratch blocks. Differentiable via the fused kv-major
     flash backward (two-pass kernels for long sequences).
+
+    ``window`` enables SLIDING-WINDOW attention (causal only): each
+    query attends its ``window`` most recent positions. Blocks entirely
+    older than the window are triaged out exactly like above-diagonal
+    blocks — skipped compute AND elided DMA (index maps clamp from
+    below) — so fwd+bwd cost scales with ``seq × window``, not seq²;
+    the boundary blocks take the masked body with the window bound
+    folded into the same [bq, bk] compare the causal mask already pays.
     """
     if _sub_tile(q, block_q):
-        return reference_attention(q, k, v, causal=causal, scale=scale)
+        return reference_attention(q, k, v, causal=causal, scale=scale,
+                                   window=window)
+    window = _resolve_window(window, causal, q.shape[1])
     qf, kf, vf, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
                                              block_k, block_h)
     b, sq, h, d = q.shape
     hk = k.shape[2]
-    o = _flash_attention_bhsd(qf, kf, vf, causal, g, bq, bk, band)
+    o = _flash_attention_bhsd(qf, kf, vf, causal, g, bq, bk, band,
+                              window)
     return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3))
 
 
@@ -842,26 +912,30 @@ def _prep_flat(q, k, v, scale, block_q: int, block_k: int, block_h: int):
 def flash_attention_with_lse(q, k, v, *, causal: bool = True,
                              scale: float | None = None,
                              block_q: int = 256, block_k: int = 1024,
-                             block_h: int = 4):
+                             block_h: int = 4, window: int | None = None):
     """Like :func:`flash_attention` but also returns the row logsumexp
     ([batch, heads, seq], f32) as a DIFFERENTIATED output — the primitive
-    for cross-chunk online-softmax merging (ring attention): merged
+    for cross-chunk softmax merging (ring attention): merged
     results are ``o = Σ_c o_c · exp(lse_c - logaddexp_c lse_c)``, and the
     lse cotangent flows back into the score gradients. GQA K/V (fewer
-    heads than Q) is supported exactly as in :func:`flash_attention`."""
+    heads than Q) and sliding windows are supported exactly as in
+    :func:`flash_attention`."""
     if _sub_tile(q, block_q):
-        return _dense_with_lse(q, k, v, causal=causal, scale=scale)
+        return _dense_with_lse(q, k, v, causal=causal, scale=scale,
+                               window=window)
+    window = _resolve_window(window, causal, q.shape[1])
     qf, kf, vf, g, bq, bk, band = _prep_flat(q, k, v, scale, block_q,
                                              block_k, block_h)
     b, sq, h, d = q.shape
     hk = k.shape[2]
     o, lse = _flash_attention_lse_bhsd(qf, kf, vf, causal, g, bq, bk,
-                                       band)
+                                       band, window)
     return (o[:b * hk].reshape(b, h, sq, d).transpose(0, 2, 1, 3),
             lse[:b * hk].reshape(b, h, sq))
 
 
-def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None):
+def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None,
+                    window: int | None = None):
     """Dense (o, lse): the sub-tile fallback for the with-lse entry and
     the body of :func:`reference_attention` (plain jnp, so AD provides
     the dlse flow for free). GQA K/V (fewer heads than Q) is expanded —
@@ -869,6 +943,7 @@ def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None):
     the kernels exist for."""
     d = q.shape[-1]
     h, hk = q.shape[2], k.shape[2]
+    window = _resolve_window(window, causal, q.shape[1])
     if h != hk:
         if hk <= 0 or h % hk:
             raise ValueError(f"kv heads ({hk}) must divide heads ({h})")
@@ -878,7 +953,11 @@ def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None):
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                    preferred_element_type=jnp.float32) * scale
     if causal:
-        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        qpos = jnp.arange(q.shape[1])[:, None]
+        kpos = jnp.arange(k.shape[1])[None, :]
+        mask = qpos >= kpos
+        if window is not None:
+            mask = mask & (qpos - kpos < window)
         s = jnp.where(mask[None, None], s, _NEG_INF)
     lse = jax.scipy.special.logsumexp(s, axis=-1)
     p = jnp.exp(s - lse[..., None])
@@ -887,9 +966,11 @@ def _dense_with_lse(q, k, v, *, causal: bool, scale: float | None):
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
-                        scale: float | None = None):
+                        scale: float | None = None,
+                        window: int | None = None):
     """Dense O(S²) attention in plain jnp — the correctness oracle for
-    the kernels and the fallback for odd shapes (GQA-aware; see
-    :func:`_dense_with_lse`, whose output this is)."""
-    o, _ = _dense_with_lse(q, k, v, causal=causal, scale=scale)
+    the kernels and the fallback for odd shapes (GQA-aware, sliding-
+    window-aware; see :func:`_dense_with_lse`, whose output this is)."""
+    o, _ = _dense_with_lse(q, k, v, causal=causal, scale=scale,
+                           window=window)
     return o
