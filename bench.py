@@ -322,7 +322,7 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
         return do_prefill, scan_decode
 
     def time_one(max_len, force_dense=False, b=batch, run_cfg=cfg,
-                 p_len=prompt_len):
+                 p_len=prompt_len, p=params):
         prompt = jax.random.randint(jax.random.PRNGKey(17),
                                     (b, p_len), 0, cfg.vocab_size)
         saved = D._BLOCKWISE_MIN_LEN
@@ -334,13 +334,13 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
             # the timed region — the metric is decode-step cost vs padded
             # max_len, and the fixed prefill would pull the ratio toward 1
             # while the init's max_len-scaled writes pull it away
-            logits, cache = do_prefill(params, prompt)
-            gen = scan_decode(params, logits, cache, steps)
+            logits, cache = do_prefill(p, prompt)
+            gen = scan_decode(p, logits, cache, steps)
             int(gen[0, 0])                       # compile + warm
             reps = []
             for _ in range(3):
                 t0 = time.perf_counter()
-                gen = scan_decode(params, logits, cache, steps)
+                gen = scan_decode(p, logits, cache, steps)
                 int(gen[0, 0])
                 reps.append(time.perf_counter() - t0)
             return b * steps / sorted(reps)[1]
@@ -375,6 +375,13 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
     tps_deep_full = time_one(8192, p_len=deep)
     tps_deep_win = time_one(8192, p_len=deep,
                             run_cfg=cfg.scaled(attn_window=1024))
+    # weight-only int8 (models/quantize.py): halves the matmul weights'
+    # HBM read (the parameter-bound share of small-batch decode); the
+    # all-int8 arm composes it with the int8 KV cache at the wide batch
+    from tony_tpu.models.quantize import quantize_weights_int8
+    wq = quantize_weights_int8(params)
+    tps2k_wq = time_one(2048, p=wq)
+    tps2k_wide_all8 = time_one(2048, b=wide, run_cfg=qcfg, p=wq)
     return {
         "decode_maxlen2k_tokens_per_s": round(tps2k, 1),
         "decode_maxlen8k_tokens_per_s": round(tps8k, 1),
@@ -394,6 +401,12 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
         "decode_deep7k_win1k_tokens_per_s": round(tps_deep_win, 1),
         "decode_win1k_vs_full_deep7k": round(
             tps_deep_win / tps_deep_full, 2),
+        "decode_wq8_maxlen2k_tokens_per_s": round(tps2k_wq, 1),
+        "decode_wq8_vs_bf16_2k": round(tps2k_wq / tps2k, 2),
+        f"decode_all_int8_b{wide}_tokens_per_s": round(
+            tps2k_wide_all8, 1),
+        f"decode_all_int8_vs_bf16_b{wide}": round(
+            tps2k_wide_all8 / tps2k_wide, 2),
     }
 
 

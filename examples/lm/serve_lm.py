@@ -61,6 +61,10 @@ def main() -> int:
                         choices=("model", "int8"),
                         help="int8 = quantized KV cache (half the cache "
                              "HBM per slot; ~2x slots in the same memory)")
+    parser.add_argument("--quantize_weights", action="store_true",
+                        help="serve with weight-only int8 matmul weights "
+                             "(half the weight HBM; see "
+                             "models/quantize.py)")
     args = parser.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -75,6 +79,10 @@ def main() -> int:
                 template=init_state(params, default_optimizer()))
         params = state["params"]
         print(f"restored step {int(state['step'])} from {args.ckpt_dir}")
+    if args.quantize_weights:
+        from tony_tpu.models.quantize import quantize_weights_int8
+        params = quantize_weights_int8(params)
+        print("serving with weight-only int8 matmul weights")
 
     rs = np.random.RandomState(args.seed)
     # mixed lengths and budgets — the workload shape slot reuse exists for
@@ -96,6 +104,9 @@ def main() -> int:
             dtype=cfg.dtype, remat=False, vocab_size=cfg.vocab_size,
             kv_cache_dtype=args.kv_cache_dtype)
         draft_params = T.init_params(jax.random.PRNGKey(1), draft_cfg)
+        if args.quantize_weights:
+            from tony_tpu.models.quantize import quantize_weights_int8
+            draft_params = quantize_weights_int8(draft_params)
         batcher = SpeculativeContinuousBatcher(
             params, cfg, draft_params, draft_cfg,
             num_speculative=args.num_speculative, **kw)

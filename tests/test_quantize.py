@@ -1,0 +1,142 @@
+"""Weight-only int8 serving quantization: structure, numerics, and the
+quant-to-quant exactness contract (same as the int8 KV cache's)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import transformer as T
+from tony_tpu.models import decode as D
+from tony_tpu.models.quantize import (QuantizedWeight, _quantize,
+                                      quantize_weights_int8)
+
+CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(scope="module")
+def qparams(params):
+    return quantize_weights_int8(params)
+
+
+class TestQuantizeWeights:
+    def test_structure(self, params, qparams):
+        """Matmul weights become QuantizedWeight; embed, norms stay."""
+        for name in ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"):
+            w = qparams["blocks"][name]
+            assert isinstance(w, QuantizedWeight), name
+            assert w.q.dtype == jnp.int8
+            assert w.q.shape == params["blocks"][name].shape
+        assert isinstance(qparams["lm_head"], QuantizedWeight)
+        for name in ("attn_norm", "mlp_norm"):
+            assert not isinstance(qparams["blocks"][name], QuantizedWeight)
+        assert not isinstance(qparams["embed"], QuantizedWeight)
+        assert not isinstance(qparams["final_norm"], QuantizedWeight)
+
+    def test_moe_experts_not_quantized(self):
+        cfg = CFG.scaled(num_experts=4)
+        qp = quantize_weights_int8(T.init_params(jax.random.PRNGKey(1),
+                                                 cfg))
+        for name in ("router", "w_gate", "w_down"):
+            assert not isinstance(qp["blocks"][name], QuantizedWeight)
+        # attention weights still quantize
+        assert isinstance(qp["blocks"]["wq"], QuantizedWeight)
+
+    def test_per_channel_roundtrip_bound(self):
+        w = jax.random.normal(jax.random.PRNGKey(2), (16, 4, 8),
+                              jnp.float32)
+        qw = _quantize(w, (0,))
+        assert qw.scale.shape == (4, 8)
+        deq = qw.q.astype(jnp.float32) * qw.scale
+        # symmetric absmax: error <= per-channel absmax / 254
+        bound = jnp.max(jnp.abs(w), axis=0) / 254.0
+        assert bool(jnp.all(jnp.abs(deq - w) <= bound + 1e-7))
+
+    def test_weinsum_fold_matches_dequantized(self):
+        """The scale-outside-the-dot fold == einsum over the explicitly
+        dequantized weight (same math, reassociated)."""
+        w = jax.random.normal(jax.random.PRNGKey(3), (16, 4, 8),
+                              jnp.float32)
+        x = jax.random.normal(jax.random.PRNGKey(4), (2, 3, 16),
+                              jnp.float32)
+        qw = _quantize(w, (0,))
+        got = D._weinsum("bsd,dhk->bshk", x, qw)
+        want = jnp.einsum("bsd,dhk->bshk", x,
+                          qw.q.astype(jnp.float32) * qw.scale)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   atol=1e-4)
+        # plain arrays pass straight through
+        np.testing.assert_allclose(
+            np.asarray(D._weinsum("bsd,dhk->bshk", x, w)),
+            np.asarray(jnp.einsum("bsd,dhk->bshk", x, w)), atol=1e-6)
+
+    def test_prefill_logits_track_float(self, params, qparams):
+        prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 12), 0,
+                                    CFG.vocab_size)
+        lf, _ = D.prefill(params, prompt, CFG, 32)
+        lq, _ = D.prefill(qparams, prompt, CFG, 32)
+        rel = float(jnp.max(jnp.abs(lf - lq)) / jnp.max(jnp.abs(lf)))
+        assert rel < 0.05, rel
+
+    def test_serving_token_identical_to_generate(self, qparams):
+        """Quant-to-quant: the batcher with quantized weights equals
+        per-request generate with the same weights (deterministic)."""
+        from tony_tpu.models.serve import ContinuousBatcher
+        rs = np.random.RandomState(3)
+        prompts = [list(rs.randint(0, CFG.vocab_size, size=n))
+                   for n in (5, 7, 4)]
+        b = ContinuousBatcher(qparams, CFG, batch=2, max_len=32, chunk=4)
+        outs = b.serve(prompts, max_new_tokens=6)
+        for i, p in enumerate(prompts):
+            want = D.generate(qparams, jnp.asarray(p, jnp.int32)[None],
+                              CFG, 6, jax.random.PRNGKey(0))
+            assert outs[i] == [int(t) for t in
+                               np.asarray(want.tokens[0, len(p):])], i
+
+    def test_beam_and_speculative_equal_greedy(self, qparams):
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 6), 0,
+                                    CFG.vocab_size)
+        g = D.generate(qparams, prompt, CFG, 10, jax.random.PRNGKey(0))
+        bs = D.beam_search(qparams, prompt, CFG, 10, beam_width=1)
+        np.testing.assert_array_equal(np.asarray(bs.tokens[:, 0]),
+                                      np.asarray(g.tokens))
+        sp = D.speculative_generate_device(qparams, qparams, prompt, CFG,
+                                           CFG, max_new_tokens=10,
+                                           num_speculative=3)
+        np.testing.assert_array_equal(np.asarray(sp), np.asarray(g.tokens))
+
+    def test_composes_with_int8_cache_and_window(self, qparams):
+        cfg = CFG.scaled(kv_cache_dtype="int8", attn_window=24)
+        prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 30), 0,
+                                    CFG.vocab_size)
+        out = D.generate(qparams, prompt, cfg, 12, jax.random.PRNGKey(0))
+        tk = np.asarray(out.tokens)
+        assert tk.shape == (2, 42)
+        assert (tk >= 0).all() and (tk < CFG.vocab_size).all()
+
+    def test_tp_sharded_quant_decode_matches_unsharded(self, params,
+                                                       qparams):
+        """TP serving recipe: quantize AFTER shard_pytree — the int8
+        weights/scales inherit the float weights' shardings — and
+        sharded quantized decode is token-identical to unsharded
+        quantized decode."""
+        from tony_tpu.parallel.mesh import make_mesh
+        from tony_tpu.parallel.sharding import shard_pytree
+        mesh = make_mesh({"tp": 2, "dp": -1})
+        sharded = shard_pytree(params, T.logical_axes(CFG), mesh)
+        qs = quantize_weights_int8(sharded)
+        # the quantized leaves carry the weight's tp sharding
+        assert "tp" in str(qs["blocks"]["wq"].q.sharding.spec)
+        assert "tp" in str(qs["blocks"]["wq"].scale.sharding.spec)
+        prompt = jax.random.randint(jax.random.PRNGKey(8), (2, 8), 0,
+                                    CFG.vocab_size)
+        with jax.set_mesh(mesh):
+            out_s = D.generate(qs, prompt, CFG, 10, jax.random.PRNGKey(0))
+        out_u = D.generate(qparams, prompt, CFG, 10, jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out_s.tokens),
+                                      np.asarray(out_u.tokens))

@@ -40,8 +40,27 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
+from tony_tpu.models.quantize import QuantizedWeight
 from tony_tpu.ops.norms import rms_norm_reference
 from tony_tpu.parallel.moe import moe_ffn
+
+
+def _weinsum(spec, x, w, pet=None):
+    """Weight-matmul dispatch: plain arrays take the ordinary einsum;
+    :class:`~tony_tpu.models.quantize.QuantizedWeight` operands compute
+    the dot on the int8 weight cast to FLOAT32 (not the bf16 compute
+    dtype: XLA fuses the int8→f32 convert into the dot's operand read,
+    while int8→bf16 MATERIALIZES a full-size converted copy — measured
+    3× slower on the lm_head matmul; f32 is exact for integers ≤ 127
+    anyway) and apply the per-output-channel scale OUTSIDE the
+    contraction. ``pet=jnp.float32`` callers (the lm_head) get f32 out
+    either way."""
+    if isinstance(w, QuantizedWeight):
+        y = jnp.einsum(spec, x.astype(jnp.float32),
+                       w.q.astype(jnp.float32),
+                       preferred_element_type=jnp.float32) * w.scale
+        return y if pet == jnp.float32 else y.astype(x.dtype)
+    return jnp.einsum(spec, x, w, preferred_element_type=pet)
 
 
 class GenerateOutput(NamedTuple):
@@ -371,9 +390,9 @@ def _decode_block(x, layer_params, bufs, li, pos, cfg, rope,
     cos, sin = rope
 
     h = rms_norm_reference(x, p["attn_norm"])
-    q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
-    k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
-    v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+    q = _weinsum("bsd,dhk->bshk", h, p["wq"])
+    k = _weinsum("bsd,dhk->bshk", h, p["wk"])
+    v = _weinsum("bsd,dhk->bshk", h, p["wv"])
     q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
     # write this chunk into the stacked cache (in place under jit: the
     # pre-update buffer has no later consumer)
@@ -382,7 +401,7 @@ def _decode_block(x, layer_params, bufs, li, pos, cfg, rope,
             for n, c in _kv_writes(bufs, k, v).items()}
     o = _cached_attention(q, bufs, li, pos,
                           attn_window=cfg.attn_window or None)
-    x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+    x = x + _weinsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
     mlp_out = _mlp(h, p, cfg)
@@ -405,9 +424,9 @@ def _mlp(h, p, cfg):
                          capacity_factor=cfg.moe_capacity_factor,
                          activation=jax.nn.silu)
         return out
-    gate = jnp.einsum("bsd,df->bsf", h, p["w_gate"])
-    up = jnp.einsum("bsd,df->bsf", h, p["w_up"])
-    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
+    gate = _weinsum("bsd,df->bsf", h, p["w_gate"])
+    up = _weinsum("bsd,df->bsf", h, p["w_up"])
+    return _weinsum("bsf,fd->bsd", jax.nn.silu(gate) * up, p["w_down"])
 
 
 def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
@@ -448,8 +467,8 @@ def extend_step(params: dict, tokens: jax.Array, cache: dict, pos,
     :func:`_window_write`."""
     x, new_cache = _blocks_forward(params, tokens, cache, pos, cfg, window)
     x = rms_norm_reference(x, params["final_norm"])
-    logits = jnp.einsum("bsd,dv->bsv", x, params["lm_head"],
-                        preferred_element_type=jnp.float32)
+    logits = _weinsum("bsd,dv->bsv", x, params["lm_head"],
+                      pet=jnp.float32)
     logits = logits.astype(cfg.logits_storage_dtype)
     return logits, new_cache
 
@@ -518,22 +537,22 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     for li in range(cfg.n_layers):
         p = jax.tree.map(lambda a: a[li], params["blocks"])
         h = rms_norm_reference(x, p["attn_norm"])
-        q = jnp.einsum("bsd,dhk->bshk", h, p["wq"])
-        k = jnp.einsum("bsd,dhk->bshk", h, p["wk"])
-        v = jnp.einsum("bsd,dhk->bshk", h, p["wv"])
+        q = _weinsum("bsd,dhk->bshk", h, p["wq"])
+        k = _weinsum("bsd,dhk->bshk", h, p["wk"])
+        v = _weinsum("bsd,dhk->bshk", h, p["wv"])
         q, k = T.apply_rope(q, cos, sin), T.apply_rope(k, cos, sin)
         # GQA K/V go to the kernels unexpanded (flash/reference consume
         # kv_heads-wide K/V natively; no-op distinction for MHA)
         o = T._attention(q, k, v, None, window=cfg.attn_window or None)
-        x = x + jnp.einsum("bshk,hkd->bsd", o, p["wo"])
+        x = x + _weinsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)
         for n, c in _kv_writes(bufs, k[:, :s], v[:, :s]).items():
             bufs[n] = _write_kv_chunk(bufs[n], c, li,
                                       jnp.asarray(0, jnp.int32), None)
     x = rms_norm_reference(x, params["final_norm"])
-    logits = jnp.einsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
-                        preferred_element_type=jnp.float32)
+    logits = _weinsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
+                      pet=jnp.float32)
     logits = logits.astype(cfg.logits_storage_dtype)
     return logits, dict(bufs, length=jnp.asarray(s, jnp.int32))
 
