@@ -11,10 +11,12 @@ all compute and has no serving path).
 Scheme: symmetric per-OUTPUT-CHANNEL absmax int8. For every served
 matmul ``y = x @ W`` the scale is constant along the contracted axes, so
 it factors OUT of the dot: the kernels compute
-``(x @ W_int8.astype(bf16)) * scale`` — integer values ≤ 127 are exact
-in bf16, the MXU accumulates in f32, and the HBM weight read is
-int8-wide (`decode._weinsum` is the single dispatch point). Same fold
-as the int8 KV cache's score/value scales.
+``(x @ W_int8.astype(f32)) * scale`` — f32, NOT bf16: XLA fuses the
+int8→f32 convert into the dot's operand read while int8→bf16
+MATERIALIZES a full-size converted copy (measured 3× slower on the
+lm_head matmul; integers ≤ 127 are exact either way). The HBM weight
+read stays int8-wide; `decode._weinsum` is the single dispatch point.
+Same fold as the int8 KV cache's score/value scales.
 
 Scope: the decode/serving entry points (`decode.prefill`,
 ``extend_step``/``decode_step`` and everything built on them — generate,

@@ -553,6 +553,7 @@ def train_flops_per_token(cfg: TransformerConfig, seq: int) -> float:
     """
     d, f, L, v = cfg.d_model, cfg.d_ff, cfg.n_layers, cfg.vocab_size
     kv_width = cfg.kv_heads * cfg.head_dim     # == d for MHA
+    proj = 4 * d * d + 4 * d * kv_width   # wq + wo, + wk + wv (GQA-aware)
     if cfg.attn_window and cfg.attn_window < seq:
         w = cfg.attn_window
         # positions 0..w-1 attend position+1 keys; the rest attend w
