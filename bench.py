@@ -375,6 +375,13 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
     tps_deep_full = time_one(8192, p_len=deep)
     tps_deep_win = time_one(8192, p_len=deep,
                             run_cfg=cfg.scaled(attn_window=1024))
+    # rolling ring-buffer cache (kv_cache_capacity): same windowed math
+    # over a capacity-row ring instead of the max_len buffer — 8x less
+    # cache memory at this shape, measured speed parity; the length
+    # ceiling disappears (requests may run past max_len)
+    tps_deep_ring = time_one(8192, p_len=deep,
+                             run_cfg=cfg.scaled(attn_window=1024,
+                                                kv_cache_capacity=1024))
     # weight-only int8 (models/quantize.py): halves the matmul weights'
     # HBM read (the parameter-bound share of small-batch decode); the
     # all-int8 arm composes it with the int8 KV cache at the wide batch
@@ -401,6 +408,9 @@ def _serving_decode_arm(cfg, batch: int = 8, prompt_len: int = 128,
         "decode_deep7k_win1k_tokens_per_s": round(tps_deep_win, 1),
         "decode_win1k_vs_full_deep7k": round(
             tps_deep_win / tps_deep_full, 2),
+        "decode_ring1k_deep7k_tokens_per_s": round(tps_deep_ring, 1),
+        "decode_ring_vs_linear_win_deep7k": round(
+            tps_deep_ring / tps_deep_win, 2),
         "decode_wq8_maxlen2k_tokens_per_s": round(tps2k_wq, 1),
         "decode_wq8_vs_bf16_2k": round(tps2k_wq / tps2k, 2),
         f"decode_all_int8_b{wide}_tokens_per_s": round(

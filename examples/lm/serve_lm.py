@@ -65,12 +65,22 @@ def main() -> int:
                         help="serve with weight-only int8 matmul weights "
                              "(half the weight HBM; see "
                              "models/quantize.py)")
+    parser.add_argument("--attn_window", type=int, default=0,
+                        help="sliding-window attention (0 = full causal)")
+    parser.add_argument("--kv_cache_capacity", type=int, default=0,
+                        help="rolling KV cache rows per slot (0 = "
+                             "linear cache of max_len rows); requires "
+                             "--attn_window, lifts the request-length "
+                             "ceiling — O(capacity) memory however "
+                             "long the stream")
     args = parser.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
         dtype=jnp.bfloat16 if on_tpu else jnp.float32, remat=False,
-        kv_cache_dtype=args.kv_cache_dtype)
+        kv_cache_dtype=args.kv_cache_dtype,
+        attn_window=args.attn_window,
+        kv_cache_capacity=args.kv_cache_capacity)
     params = T.init_params(jax.random.PRNGKey(0), cfg)
     if args.ckpt_dir:
         with CheckpointManager(args.ckpt_dir) as mgr:
@@ -102,7 +112,8 @@ def main() -> int:
         # compares token ids), so override the preset's vocab_size
         draft_cfg = T.PRESETS[args.draft_preset].scaled(
             dtype=cfg.dtype, remat=False, vocab_size=cfg.vocab_size,
-            kv_cache_dtype=args.kv_cache_dtype)
+            kv_cache_dtype=args.kv_cache_dtype,
+            attn_window=args.attn_window)
         draft_params = T.init_params(jax.random.PRNGKey(1), draft_cfg)
         if args.quantize_weights:
             from tony_tpu.models.quantize import quantize_weights_int8

@@ -1051,3 +1051,118 @@ class TestSlidingWindowDecode:
             jnp.asarray(700), attn_window=w)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    atol=1e-2)
+
+
+class TestRollingCache:
+    """Rolling (ring-buffer) KV cache: O(capacity) memory however long
+    the stream runs. Requires a sliding window (full-causal queries need
+    the history the ring overwrote); reads mask rows by their ring
+    offset from each query's absolute position."""
+
+    LCFG = CFG.scaled(attn_window=24)
+    RCFG = LCFG.scaled(kv_cache_capacity=32)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="attn_window"):
+            CFG.scaled(kv_cache_capacity=32)
+        with pytest.raises(ValueError, match="kv_cache_capacity"):
+            CFG.scaled(attn_window=24, kv_cache_capacity=8)
+
+    def test_cache_is_capacity_sized(self):
+        c = init_kv_cache(self.RCFG, 2, 999)
+        assert c["k"].shape[2] == 32
+
+    def test_ring_generate_equals_linear_windowed(self, params):
+        """Same positions attended, same math: ring generate matches the
+        linear windowed-cache generate (prompt shorter than capacity —
+        no wraparound reordering of the softmax rows)."""
+        prompt = jax.random.randint(jax.random.PRNGKey(70), (2, 20), 0,
+                                    CFG.vocab_size)
+        out_lin = generate(params, prompt, self.LCFG, 30,
+                           jax.random.PRNGKey(0))
+        out_ring = generate(params, prompt, self.RCFG, 30,
+                            jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(out_lin.tokens),
+                                      np.asarray(out_ring.tokens))
+
+    def test_generation_far_past_capacity(self, params):
+        """The headline property: generate 3x the ring capacity in one
+        stream — the fixed 32-row cache serves a 96-token generation —
+        and the stream stays in close agreement with the linear windowed
+        reference (jit partitioning rounds differently; wraparound
+        reorders softmax row order, so bit-equality is not the
+        contract past capacity)."""
+        prompt = jax.random.randint(jax.random.PRNGKey(71), (1, 10), 0,
+                                    CFG.vocab_size)
+        out = generate(params, prompt, self.RCFG, 96,
+                       jax.random.PRNGKey(0))
+        tk = np.asarray(out.tokens)
+        assert tk.shape == (1, 106)
+        assert (tk >= 0).all() and (tk < CFG.vocab_size).all()
+        ref = generate(params, prompt, self.LCFG, 96,
+                       jax.random.PRNGKey(0))
+        agree = (tk == np.asarray(ref.tokens)).mean()
+        assert agree > 0.8, agree
+
+    def test_prompt_longer_than_capacity(self, params):
+        """Prefill keeps only the last `capacity` prompt rows — all a
+        windowed query can ever reach. First decode logits must match
+        the linear windowed cache's exactly (same eager prefill math)."""
+        from tony_tpu.models import decode as D
+        prompt = jax.random.randint(jax.random.PRNGKey(72), (2, 45), 0,
+                                    CFG.vocab_size)
+        lg_r, c_r = D.prefill(params, prompt, self.RCFG, max_len=60)
+        lg_l, c_l = D.prefill(params, prompt, self.LCFG, max_len=60)
+        np.testing.assert_array_equal(np.asarray(lg_r), np.asarray(lg_l))
+        nxt = jnp.argmax(lg_r, -1)
+        s_r, _ = D.decode_step(params, nxt, c_r, c_r["length"], self.RCFG)
+        s_l, _ = D.decode_step(params, nxt, c_l, c_l["length"], self.LCFG)
+        np.testing.assert_allclose(np.asarray(s_r), np.asarray(s_l),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_batcher_slots_independent(self, params):
+        """2-slot ring serving == each request through a 1-slot batcher
+        (same jit partitioning on both sides — exact), including a
+        request whose prompt exceeds the capacity and one that runs
+        past max_len (the ring lifts the length ceiling)."""
+        from tony_tpu.models.serve import ContinuousBatcher
+        rs = np.random.RandomState(5)
+        prompts = [list(rs.randint(0, CFG.vocab_size, size=n))
+                   for n in (10, 45)]
+        budgets = [60, 20]
+        b2 = ContinuousBatcher(params, self.RCFG, batch=2, max_len=48,
+                               chunk=4)
+        outs = b2.serve(prompts, max_new_tokens=budgets)
+        for i, p in enumerate(prompts):
+            b1 = ContinuousBatcher(params, self.RCFG, batch=1,
+                                   max_len=48, chunk=4)
+            solo = b1.serve([p], max_new_tokens=[budgets[i]])
+            assert outs[i] == solo[0], f"request {i}"
+
+    def test_refusals(self, params):
+        from tony_tpu.models import decode as D
+        from tony_tpu.models.serve import (ContinuousBatcher,
+                                           SpeculativeContinuousBatcher)
+        prompt = jax.random.randint(jax.random.PRNGKey(73), (1, 8), 0,
+                                    CFG.vocab_size)
+        with pytest.raises(ValueError, match="linear KV cache"):
+            D.beam_search(params, prompt, self.RCFG, 4)
+        with pytest.raises(ValueError, match="linear KV cache"):
+            D.speculative_generate_device(params, params, prompt,
+                                          self.RCFG, self.RCFG,
+                                          max_new_tokens=4)
+        with pytest.raises(ValueError, match="linear KV cache"):
+            ContinuousBatcher(params, self.RCFG, batch=1, max_len=32,
+                              shared_prefix=[1, 2, 3])
+        with pytest.raises(ValueError, match="linear KV"):
+            SpeculativeContinuousBatcher(params, self.RCFG, params,
+                                         self.RCFG, batch=1, max_len=32)
+
+    def test_int8_ring_composes(self, params):
+        cfg = self.RCFG.scaled(kv_cache_dtype="int8")
+        prompt = jax.random.randint(jax.random.PRNGKey(74), (2, 12), 0,
+                                    CFG.vocab_size)
+        out = generate(params, prompt, cfg, 50, jax.random.PRNGKey(0))
+        tk = np.asarray(out.tokens)
+        assert tk.shape == (2, 62)
+        assert (tk >= 0).all() and (tk < CFG.vocab_size).all()

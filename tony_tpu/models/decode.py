@@ -68,6 +68,11 @@ class GenerateOutput(NamedTuple):
     logprobs: jax.Array      # [B, max_new_tokens] logprob of each sampled token
 
 
+def _ring_capacity(cfg: T.TransformerConfig) -> int:
+    """Rolling-cache rows per slot (0 = linear cache of max_len rows)."""
+    return cfg.kv_cache_capacity
+
+
 def init_kv_cache(cfg: T.TransformerConfig, batch: int,
                   max_len: int) -> dict:
     """Zeroed cache pytree: k/v of shape [L, B, max_len, KV, hd] — KV is
@@ -81,8 +86,15 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
     window, per-row scatter) applies to the scale buffers unchanged with
     a trailing dim of 1. Cache memory and read traffic halve vs bf16
     (each of k and v costs 1 + 4/hd bytes per element ≈ 1.06 at hd=64,
-    vs 2 bf16); see :func:`_kv_quantize` for the numerics."""
-    shape = (cfg.n_layers, batch, max_len, cfg.kv_heads, cfg.head_dim)
+    vs 2 bf16); see :func:`_kv_quantize` for the numerics.
+
+    ``cfg.kv_cache_capacity`` allocates a ROLLING cache of that many
+    rows instead of ``max_len`` — writes wrap modulo the capacity
+    (sliding-window models only; the ring read masks by each row's
+    absolute position). Memory is O(capacity) however long the stream
+    runs."""
+    rows = _ring_capacity(cfg) or max_len
+    shape = (cfg.n_layers, batch, rows, cfg.kv_heads, cfg.head_dim)
     if cfg.kv_quant:
         sshape = shape[:-1] + (1,)
         return {"k": jnp.zeros(shape, jnp.int8),
@@ -301,6 +313,50 @@ def _cached_attention(q, bufs, li, q_start, attn_window=None):
     return o.reshape(b, n_q, h, d).astype(q.dtype)
 
 
+def _ring_cached_attention(q, bufs, li, q_pos, attn_window: int):
+    """Cached attention over a ROLLING cache [L, B, C, KV, hd]: writes
+    wrapped modulo C, so ring row ``r`` holds the most recent absolute
+    position congruent to r — ``q_pos - ((q_pos - r) mod C)``. A query
+    at ``q_pos`` attends exactly the rows whose offset
+    ``(q_pos - r) mod C`` is below ``min(attn_window, q_pos + 1)``:
+    in-window history written by the CURRENT occupant (older residue in
+    a reused slot can never satisfy the offset test — the slot-reuse
+    argument of serve.py carries over row-wise). Dense over the C ring
+    rows: C ≈ the window, the size regime where the dense einsum beats
+    the blockwise walk anyway. Single-position queries only (K = 1 —
+    the callers enforce it; chunked verify keeps the linear cache).
+
+    q: [B, 1, H, hd]; q_pos: [B] absolute positions. Quantized caches
+    fold their scales outside the dots exactly as the linear paths do."""
+    k_all, v_all = bufs["k"], bufs["v"]
+    quant = "k_scale" in bufs
+    b, n_q, h, d = q.shape
+    c = k_all.shape[2]
+    k_cache, v_cache = k_all[li], v_all[li]
+    if quant:
+        k_cache, v_cache = (k_cache.astype(q.dtype),
+                            v_cache.astype(q.dtype))
+    kv = k_cache.shape[2]
+    group = h // kv
+    scale = d ** -0.5
+    offset = jnp.mod(q_pos[:, None] - jnp.arange(c)[None, :], c)  # [B, C]
+    mask = offset < jnp.minimum(attn_window, q_pos[:, None] + 1)
+    qg = q.reshape(b, n_q, kv, group, d)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    if quant:
+        ks = bufs["k_scale"][li, ..., 0].transpose(0, 2, 1)     # [B, KV, C]
+        scores = scores * ks[:, :, None, None, :]
+    scores = jnp.where(mask[:, None, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)                     # f32
+    if quant:
+        vs = bufs["v_scale"][li, ..., 0].transpose(0, 2, 1)
+        probs = probs * vs[:, :, None, None, :]
+    o = jnp.einsum("bkgqs,bskd->bqkgd", probs.astype(v_cache.dtype),
+                   v_cache, preferred_element_type=jnp.float32)
+    return o.reshape(b, n_q, h, d).astype(q.dtype)
+
+
 def _window_write(buf_all, chunk, li, pos, window):
     """Bounded-window per-row cache write: the scatter-free alternative to
     ``.at[li, b, pos_b + j].set`` when per-row frontiers are guaranteed to
@@ -397,10 +453,21 @@ def _decode_block(x, layer_params, bufs, li, pos, cfg, rope,
     # write this chunk into the stacked cache (in place under jit: the
     # pre-update buffer has no later consumer)
     pos = jnp.asarray(pos)
-    bufs = {n: _write_kv_chunk(bufs[n], c, li, pos, window)
-            for n, c in _kv_writes(bufs, k, v).items()}
-    o = _cached_attention(q, bufs, li, pos,
-                          attn_window=cfg.attn_window or None)
+    cap = _ring_capacity(cfg)
+    if cap:
+        # rolling cache: the write position wraps modulo the capacity
+        # (single-token chunks only — _blocks_forward enforces it), and
+        # the read masks rows by their ring offset from each query
+        bufs = {n: _write_kv_chunk(bufs[n], c, li, pos % cap, None)
+                for n, c in _kv_writes(bufs, k, v).items()}
+        q_pos = (jnp.broadcast_to(pos, (x.shape[0],))
+                 if pos.ndim == 0 else pos)
+        o = _ring_cached_attention(q, bufs, li, q_pos, cfg.attn_window)
+    else:
+        bufs = {n: _write_kv_chunk(bufs[n], c, li, pos, window)
+                for n, c in _kv_writes(bufs, k, v).items()}
+        o = _cached_attention(q, bufs, li, pos,
+                              attn_window=cfg.attn_window or None)
     x = x + _weinsum("bshk,hkd->bsd", o, p["wo"])
 
     h = rms_norm_reference(x, p["mlp_norm"])
@@ -439,6 +506,11 @@ def _blocks_forward(params: dict, tokens: jax.Array, cache: dict, pos,
     logits, so paying the lm_head vocab projection there is pure waste)."""
     x = params["embed"][tokens].astype(cfg.dtype)              # [B, K, D]
     b, n_q = tokens.shape
+    if _ring_capacity(cfg) and n_q > 1:
+        raise ValueError(
+            "rolling KV cache (kv_cache_capacity) supports single-token "
+            "decode steps only — chunked verify (speculative decoding) "
+            "needs the linear cache")
     positions = _q_positions(pos, b, n_q)           # scalar or per-row pos
     rope = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
 
@@ -547,9 +619,21 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
         x = x + _weinsum("bshk,hkd->bsd", o, p["wo"])
         h = rms_norm_reference(x, p["mlp_norm"])
         x = x + _mlp(h, p, cfg)
-        for n, c in _kv_writes(bufs, k[:, :s], v[:, :s]).items():
-            bufs[n] = _write_kv_chunk(bufs[n], c, li,
-                                      jnp.asarray(0, jnp.int32), None)
+        cap = _ring_capacity(cfg)
+        if cap:
+            # rolling cache: only the last min(s, cap) prompt positions
+            # survive (everything older is outside every future query's
+            # window anyway — cap >= attn_window); each lands at its
+            # ring slot position % cap
+            s0 = max(s - cap, 0)
+            idx = jnp.arange(s0, s) % cap
+            for n, c in _kv_writes(bufs, k[:, s0:s], v[:, s0:s]).items():
+                layer = bufs[n][li].at[:, idx].set(c, unique_indices=True)
+                bufs[n] = bufs[n].at[li].set(layer)
+        else:
+            for n, c in _kv_writes(bufs, k[:, :s], v[:, :s]).items():
+                bufs[n] = _write_kv_chunk(bufs[n], c, li,
+                                          jnp.asarray(0, jnp.int32), None)
     x = rms_norm_reference(x, params["final_norm"])
     logits = _weinsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
                       pet=jnp.float32)
@@ -606,6 +690,15 @@ def _sample(logits, rng, temperature: float, top_k: int,
             axis=-1)
     return token, jnp.take_along_axis(model_logp, token[:, None],
                                       axis=-1)[:, 0]
+
+
+def _check_no_ring(cfg, what: str):
+    """Entry points whose cache discipline needs the LINEAR cache
+    (chunked verifies, beam gathers, prefix templates) reject rolling
+    caches up front — a silent wrong-output would be far worse."""
+    if _ring_capacity(cfg):
+        raise ValueError(f"{what} requires a linear KV cache; unset "
+                         f"kv_cache_capacity (rolling cache) for it")
 
 
 def _check_draft_vocab(cfg, draft_cfg):
@@ -799,6 +892,8 @@ def speculative_generate(params: dict, draft_params: dict, prompt: jax.Array,
         raise ValueError("num_speculative must be >= 1 (use generate() for "
                          "plain greedy decoding)")
     _check_draft_vocab(cfg, draft_cfg)
+    _check_no_ring(cfg, "speculative decoding")
+    _check_no_ring(draft_cfg, "speculative decoding (draft)")
     k = num_speculative
     max_len = s + max_new_tokens + k + 1
     t_logits, t_cache = prefill(params, prompt, cfg, max_len)
@@ -984,6 +1079,8 @@ def speculative_generate_device(params: dict, draft_params: dict,
         raise ValueError("speculative sampling (temperature > 0) "
                          "requires an rng key")
     _check_draft_vocab(cfg, draft_cfg)
+    _check_no_ring(cfg, "speculative decoding")
+    _check_no_ring(draft_cfg, "speculative decoding (draft)")
     if commit == "window":
         # default + validate at ANY batch size (a window accepted at b=1
         # must not start raising when the batch widens), though the
@@ -1178,6 +1275,7 @@ def beam_search(params: dict, prompt: jax.Array, cfg: T.TransformerConfig,
         raise ValueError("beam_width must be >= 1")
     if max_new_tokens < 1:
         raise ValueError("max_new_tokens must be >= 1")
+    _check_no_ring(cfg, "beam search")
     v = cfg.vocab_size
     max_len = s + max_new_tokens
     logits, cache = prefill(params, prompt, cfg, max_len)

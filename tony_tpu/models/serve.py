@@ -62,8 +62,9 @@ import jax
 import jax.numpy as jnp
 
 from tony_tpu.models import transformer as T
-from tony_tpu.models.decode import (_check_draft_vocab, _filter_logits,
-                                    _kv_bufs, _propose_and_verify,
+from tony_tpu.models.decode import (_check_draft_vocab, _check_no_ring,
+                                    _filter_logits, _kv_bufs,
+                                    _propose_and_verify,
                                     _propose_and_verify_sampled, _sample,
                                     decode_step, extend_step,
                                     init_kv_cache, prefill)
@@ -327,6 +328,15 @@ class ContinuousBatcher:
                               else list(shared_prefix))
         if self.shared_prefix is not None and not self.shared_prefix:
             raise ValueError("shared_prefix must be non-empty when given")
+        #: rolling KV cache (cfg.kv_cache_capacity): slots hold a ring
+        #: of O(window) rows and requests may run past max_len — the
+        #: budget check below relaxes accordingly. Prefix templates are
+        #: positional and don't survive ring wraparound.
+        self._ring = bool(cfg.kv_cache_capacity)
+        if self.shared_prefix is not None:
+            # prefix templates are positional; they don't survive ring
+            # wraparound
+            _check_no_ring(cfg, "shared-prefix caching")
         self._prefix_template = (
             prefix_template(params, self.shared_prefix, cfg)
             if self.shared_prefix else None)
@@ -407,7 +417,9 @@ class ContinuousBatcher:
             if b <= 0:
                 raise ValueError(f"request {req}: max_new_tokens must be "
                                  f"positive, got {b}")
-            if p_len + len(p) + b > self.max_len:
+            if not self._ring and p_len + len(p) + b > self.max_len:
+                # rolling caches have no length ceiling — the ring holds
+                # the window however long the stream runs
                 raise ValueError(
                     f"request {req}: "
                     + (f"shared prefix {p_len} + " if p_len else "")
@@ -504,6 +516,8 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         if num_speculative < 1:
             raise ValueError("num_speculative must be >= 1")
         _check_draft_vocab(cfg, draft_cfg)
+        _check_no_ring(cfg, "speculative serving (chunked verify)")
+        _check_no_ring(draft_cfg, "speculative serving (draft)")
         self.draft_params = draft_params
         self.draft_cfg = draft_cfg
         # the draft needs its own prefix template (its K/V dims differ)

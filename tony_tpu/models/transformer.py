@@ -87,6 +87,15 @@ class TransformerConfig:
     # making per-token serving cost O(window) regardless of history.
     # Not composable with context parallelism (cp > 1) yet.
     attn_window: int = 0
+    # Rolling (ring-buffer) KV cache for WINDOWED decode: > 0 allocates
+    # that many cache rows per slot instead of max_len, with writes
+    # wrapping modulo the capacity — serving/generation memory is
+    # O(capacity) however long the stream runs. Requires attn_window > 0
+    # (a full-causal query needs the whole history) and capacity >=
+    # attn_window. Greedy/sampled generate + continuous batching;
+    # speculative decoding, beam search, and shared-prefix templates
+    # keep the linear cache (models/decode.py rejects the combos).
+    kv_cache_capacity: int = 0
     # GPipe microbatch count when the mesh has a pp axis > 1 (forward routes
     # through parallel/pipeline.py automatically). 0 = auto: 2·pp if it
     # divides the batch (bubble (pp-1)/(pp+1)), else pp. Must divide the
@@ -132,6 +141,17 @@ class TransformerConfig:
         if self.attn_window < 0:
             raise ValueError(f"attn_window must be >= 0 (0 = full causal "
                              f"attention), got {self.attn_window}")
+        if self.kv_cache_capacity:
+            if not self.attn_window:
+                raise ValueError(
+                    "kv_cache_capacity (rolling KV cache) requires "
+                    "attn_window > 0: a full-causal query attends the "
+                    "whole history, which a ring buffer has overwritten")
+            if self.kv_cache_capacity < self.attn_window:
+                raise ValueError(
+                    f"kv_cache_capacity ({self.kv_cache_capacity}) must "
+                    f"be >= attn_window ({self.attn_window}): a decode "
+                    f"step reads its window's rows from the ring")
 
     @property
     def head_dim(self) -> int:
