@@ -269,6 +269,33 @@ class TestE2E:
         assert "speculative sampled" in out
         assert "speculative rounds:" in out
 
+    @pytest.mark.slow
+    def test_serving_with_quantized_ring_cache_through_the_cluster(
+            self, tmp_path):
+        """The round-5 serving levers compose with the orchestration
+        layer: a cluster-submitted serving job runs with the int8 KV
+        cache, weight-only int8 matmuls, sliding-window attention, and
+        the rolling ring cache all enabled (streams wrap past the
+        32-row capacity; the ring's past-max_len ceiling lift is
+        unit-tested in test_decode.py's TestRollingCache) and exits
+        0."""
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = os.path.join(repo, "examples", "lm", "serve_lm.py")
+        client = make_client(
+            tmp_path, f"{PY} {script} --preset tiny --requests 4 "
+                      f"--slots 2 --prompt_len 10 --max_new_tokens 40 "
+                      f"--kv_cache_dtype int8 --quantize_weights "
+                      f"--attn_window 24 --kv_cache_capacity 32",
+            {"tony.worker.instances": "1",
+             "tony.application.timeout": "180000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+        out = open(os.path.join(client.job_dir, "logs",
+                                "worker-0.stdout")).read()
+        assert "served 4 requests" in out
+        assert "weight-only int8" in out
+
     def test_per_task_restart_within_session(self, tmp_path):
         """tony.task.restart-count: one worker fails once, is relaunched
         IN-SESSION (no whole-job reset — the reference kills the job and
