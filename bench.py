@@ -216,6 +216,13 @@ def main() -> None:
         # measured over remat="full" at this shape.
         secondary("seq32k", T.PRESETS["small"].scaled(
             remat=True, remat_policy="attn"), 1, 32768, 5, key=9)
+        # sliding window at extreme context — the regime where the
+        # quadratic attention term dominates and the window pays most
+        # (2.16x over full causal when introduced; MFU is the honest
+        # windowed-FLOPs ratio, so it DROPS while tokens/s rises)
+        secondary("seq32k_win4k", T.PRESETS["small"].scaled(
+            remat=True, remat_policy="attn", attn_window=4096),
+            1, 32768, 5, key=9)
         # ring-attention flash-chunk arm (cp=1 degenerate, 2 chunks on one
         # chip): runs flash_attention_with_lse + the logsumexp hop merge —
         # the exact per-hop compute of the cp ring — on real hardware, and
