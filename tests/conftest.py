@@ -32,7 +32,25 @@ def _clean_env() -> dict[str, str]:
     return env
 
 
+def _raise_stack_limit() -> None:
+    """A full-suite process compiles 500+ XLA programs; deep LLVM
+    recursion on the default 8 MB stack can segfault intermittently —
+    raise the soft stack limit toward 256 MB (clamped to the hard cap)."""
+    try:
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_STACK)
+        want = 256 * 1024 * 1024
+        if hard != resource.RLIM_INFINITY:
+            want = min(want, hard)
+        if soft != resource.RLIM_INFINITY and soft < want:
+            resource.setrlimit(resource.RLIMIT_STACK, (want, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
 def pytest_configure(config):
+    _raise_stack_limit()     # both branches: the limit is inherited by
+    #                          the re-exec and still applies without one
     if os.environ.get("TONY_PYTEST_CLEAN") == "1":
         return
     capman = config.pluginmanager.getplugin("capturemanager")
@@ -44,3 +62,24 @@ def pytest_configure(config):
 
 
 os.environ.setdefault("TONY_TEST_MODE", "1")
+
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_between_modules():
+    """Reset XLA's in-process compilation caches after each test module.
+
+    A full-suite process compiles 500+ XLA programs; with everything
+    accumulated in one process the CPU compiler segfaults intermittently
+    on a late compile (observed deterministically at the same test once
+    the suite grew past ~520 programs, while the same tests pass in a
+    fresh process). Dropping the caches at module boundaries keeps the
+    compiler's working state bounded; modules re-jit their own programs
+    anyway (shared cross-module jit hits are rare), so the runtime cost
+    is small."""
+    yield
+    if "jax" in sys.modules:     # don't force a jax import on jax-free
+        import jax               # modules just to clear empty caches
+        jax.clear_caches()
