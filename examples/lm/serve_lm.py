@@ -73,6 +73,15 @@ def main() -> int:
                              "--attn_window, lifts the request-length "
                              "ceiling — O(capacity) memory however "
                              "long the stream")
+    parser.add_argument("--no_pipeline", action="store_true",
+                        help="sequential serve loop (the A/B baseline; "
+                             "default is double-buffered dispatch — "
+                             "chunk N+1 issued before chunk N's fetch)")
+    parser.add_argument("--no_bucketed_admission", action="store_true",
+                        help="per-length admission (compiles per "
+                             "distinct prompt length; default pads to "
+                             "power-of-two buckets and batches freed "
+                             "slots into one dispatch)")
     args = parser.parse_args()
 
     on_tpu = jax.default_backend() == "tpu"
@@ -106,7 +115,9 @@ def main() -> int:
 
     kw = dict(batch=args.slots, max_len=max_len,
               temperature=args.temperature, top_k=args.top_k,
-              top_p=args.top_p, seed=args.seed)
+              top_p=args.top_p, seed=args.seed,
+              pipeline=not args.no_pipeline,
+              bucketed_admission=not args.no_bucketed_admission)
     if args.draft_preset:
         # the draft must share the target's vocabulary (speculation
         # compares token ids), so override the preset's vocab_size
@@ -140,6 +151,11 @@ def main() -> int:
         print(f"decode steps: {batcher.steps_executed} "
               f"(slot-step utilization "
               f"{useful / max(1, batcher.steps_executed * args.slots):.2f})")
+    phases = batcher.phase_times.summary()
+    if phases:
+        print("host phases:",
+              "  ".join(f"{name} {v['total_s']:.2f}s/{v['count']}"
+                        for name, v in phases.items()))
     print("first request tokens:", outputs[0][:12])
     return 0
 

@@ -67,6 +67,44 @@ os.environ.setdefault("TONY_TEST_MODE", "1")
 import pytest
 
 
+@pytest.fixture
+def retrace_guard():
+    """Retrace-count regression guard for the serving device programs.
+
+    `tony_tpu.models.serve.TRACE_COUNTS` increments once per TRACE of a
+    serving program, keyed by (program name, static shape) — a Python
+    side effect inside the jitted bodies, so it counts compiles, not
+    calls. The fixture snapshots the counter and yields a guard whose
+    ``new_traces(name)`` returns the per-shape trace deltas for one
+    program and ``assert_max(name, n)`` pins an upper bound — the
+    bucketed-admission invariant ("at most one program per length
+    bucket, however many distinct prompt lengths") is asserted through
+    this, and any change that reintroduces per-length retraces fails
+    loudly here rather than as a silent serving-latency regression."""
+    from tony_tpu.models import serve
+
+    before = dict(serve.TRACE_COUNTS)
+
+    class Guard:
+        def new_traces(self, name: str) -> dict:
+            """{static shape: new traces} for program ``name`` since the
+            fixture snapshot."""
+            return {key[1]: count - before.get(key, 0)
+                    for key, count in serve.TRACE_COUNTS.items()
+                    if key[0] == name and count > before.get(key, 0)}
+
+        def total_new(self, name: str) -> int:
+            return sum(self.new_traces(name).values())
+
+        def assert_max(self, name: str, n: int) -> None:
+            traces = self.new_traces(name)
+            assert sum(traces.values()) <= n, (
+                f"{name}: {sum(traces.values())} new traces (cap {n}) — "
+                f"per-shape: {traces}")
+
+    yield Guard()
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Reset XLA's in-process compilation caches after each test module.

@@ -1072,6 +1072,20 @@ class TestRollingCache:
         c = init_kv_cache(self.RCFG, 2, 999)
         assert c["k"].shape[2] == 32
 
+    def test_oversized_capacity_warns_o_capacity_cost(self):
+        """_ring_cached_attention is dense over ALL capacity rows every
+        step: capacity a large multiple of the window silently pays
+        O(capacity) per token, not O(window) — init warns once. A
+        capacity near the window (the intended regime) stays quiet."""
+        import warnings
+
+        big = CFG.scaled(attn_window=24, kv_cache_capacity=96)
+        with pytest.warns(UserWarning, match=r"O\(capacity\)"):
+            init_kv_cache(big, 1, 999)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            init_kv_cache(self.RCFG, 1, 999)     # 32 rows, window 24
+
     def test_ring_generate_equals_linear_windowed(self, params):
         """Same positions attended, same math: ring generate matches the
         linear windowed-cache generate (prompt shorter than capacity —

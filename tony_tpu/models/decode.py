@@ -34,6 +34,7 @@ Usage::
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import NamedTuple
 
 import jax
@@ -45,6 +46,43 @@ from tony_tpu.ops.norms import rms_norm_reference
 from tony_tpu.parallel.moe import moe_ffn
 
 
+#: TOKEN POSITIONS (the sequence axis, NOT batch x seq) STRICTLY ABOVE
+#: which a quantized matmul is "prefill-shaped": compute-bound, not
+#: weight-read-bound, so the int8 weight converts to the bf16 compute
+#: dtype ONCE per call (the materialized copy amortizes over the many
+#: activation rows) and the dot runs at bf16 MXU throughput instead of
+#: f32. Gating on the sequence axis alone keeps every decode-shaped
+#: call — single steps (S=1) and speculative verify chunks (S=k+1) — on
+#: the fused-f32 kernel AT ANY BATCH SIZE: a batch-widened decode step
+#: must never flip kernels (re-paying the materialized-copy cost per
+#: step), and verify vs single-step logits must come from the SAME
+#: kernel or the chunked-verify == single-step token-identity contract
+#: quietly erodes on TPU.
+#:
+#: The threshold sits ON a power-of-two admission-ladder rung and the
+#: comparison is STRICT (> not >=) on purpose: ``next_pow2(n) <= 256
+#: iff n <= 256``, so a prompt and its padded power-of-two bucket always
+#: land on the same side — bucketed admission (serve.py) and an
+#: exact-length prefill of the same prompt pick the SAME kernel, keeping
+#: quantized serving == solo generate on TPU. (A custom
+#: ``admission_buckets`` ladder whose rungs straddle 256 — e.g. a
+#: 300-token bucket holding 200-token prompts — reintroduces the flip;
+#: keep a rung at 256 if you serve int8 weights in bf16.)
+#:
+#: Known carve-out: SHARED-PREFIX serving decomposes one logical prompt
+#: into a template prefill (P positions) and a suffix extend (S
+#: positions), each gated on its own length, while the solo baseline
+#: prefills P+S in one call — when those land on different sides of the
+#: rung (e.g. P, S <= 256 < P+S), the components run different kernels
+#: and near-tie argmaxes can flip vs the monolithic prefill. This is
+#: inherent to any shape-gated kernel choice applied to a decomposed
+#: computation, and it is the SAME caveat class as chunked-vs-monolithic
+#: matmul noise on TPU (see speculative_generate's caveats): quantized
+#: shared-prefix exactness is CPU-pinned; on TPU it holds modulo
+#: near-tie flips.
+_QUANT_PREFILL_MIN_S = 256
+
+
 def _weinsum(spec, x, w, pet=None):
     """Weight-matmul dispatch: plain arrays take the ordinary einsum;
     :class:`~tony_tpu.models.quantize.QuantizedWeight` operands compute
@@ -54,8 +92,27 @@ def _weinsum(spec, x, w, pet=None):
     3× slower on the lm_head matmul; f32 is exact for integers ≤ 127
     anyway) and apply the per-output-channel scale OUTSIDE the
     contraction. ``pet=jnp.float32`` callers (the lm_head) get f32 out
-    either way."""
+    either way.
+
+    PREFILL-shaped quantized matmuls (more than ``_QUANT_PREFILL_MIN_S``
+    token positions on the sequence axis, bf16 activations) instead cast
+    the int8 weight to bf16: prefill over a long prompt is compute-bound
+    and f32 MXU throughput is far below bf16, so the one-time converted
+    copy is the right trade there — the scale still applies outside the
+    contraction with f32 accumulation, so the numerics contract (int8
+    values exact in the operand dtype, scale exact in f32) is unchanged.
+    Decode-shaped calls (any batch size — the gate reads the sequence
+    axis only), 2-D projections (the lm_head's last-position read), and
+    f32 activations (the CPU/test path) keep the f32 route bit-for-bit;
+    the strict on-a-ladder-rung threshold keeps bucket-padded and
+    exact-length prefills of the same prompt on the same kernel (see the
+    constant's comment)."""
     if isinstance(w, QuantizedWeight):
+        s_len = x.shape[1] if x.ndim >= 3 else 1
+        if s_len > _QUANT_PREFILL_MIN_S and x.dtype == jnp.bfloat16:
+            y = jnp.einsum(spec, x, w.q.astype(x.dtype),
+                           preferred_element_type=jnp.float32) * w.scale
+            return y if pet == jnp.float32 else y.astype(x.dtype)
         y = jnp.einsum(spec, x.astype(jnp.float32),
                        w.q.astype(jnp.float32),
                        preferred_element_type=jnp.float32) * w.scale
@@ -93,7 +150,18 @@ def init_kv_cache(cfg: T.TransformerConfig, batch: int,
     (sliding-window models only; the ring read masks by each row's
     absolute position). Memory is O(capacity) however long the stream
     runs."""
-    rows = _ring_capacity(cfg) or max_len
+    cap = _ring_capacity(cfg)
+    rows = cap or max_len
+    if cap and cfg.attn_window and cap >= 4 * cfg.attn_window:
+        # _ring_cached_attention is dense over ALL capacity rows every
+        # step — per-token cost is O(capacity), NOT O(window). Capacity
+        # near the window is the intended regime; a large multiple
+        # silently forfeits the sliding window's cost bound.
+        warnings.warn(
+            f"kv_cache_capacity={rows} is {rows // cfg.attn_window}x "
+            f"attn_window={cfg.attn_window}: ring-cache attention reads "
+            "every capacity row per token (O(capacity), not O(window)) — "
+            "size the capacity near the window", stacklevel=2)
     shape = (cfg.n_layers, batch, rows, cfg.kv_heads, cfg.head_dim)
     if cfg.kv_quant:
         sshape = shape[:-1] + (1,)
@@ -594,10 +662,26 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     with the PADDED length), so extreme padding can shift routing-drop
     behavior at low capacity factors."""
     b, s = tokens.shape
+    cache = init_kv_cache(cfg, b, max_len)
+    x, bufs = _prompt_forward(params, tokens, cfg, _kv_bufs(cache), s)
+    logits = _weinsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
+                      pet=jnp.float32)
+    logits = logits.astype(cfg.logits_storage_dtype)
+    return logits, dict(bufs, length=jnp.asarray(s, jnp.int32))
+
+
+def _prompt_forward(params, tokens, cfg, bufs, s):
+    """The prompt forward shared by :func:`prefill` and
+    :func:`prefill_rows`: right-pads ``tokens`` [B, s] to a flash-safe
+    length when the kernels need it, runs the unrolled layer loop writing
+    positions [0, s) of K/V into ``bufs``, and returns the final-norm'd
+    activations [B, s_padded, D] plus the filled buffers — each caller
+    does its own lm_head projection (last position for prefill, per-row
+    true last positions for the bucketed variant)."""
+    b = tokens.shape[0]
     sp = _flash_safe_len(s) if _pad_prompts() else s
     if sp != s:
         tokens = jnp.pad(tokens, ((0, 0), (0, sp - s)))
-    cache = init_kv_cache(cfg, b, max_len)
     x = params["embed"][tokens].astype(cfg.dtype)
     positions = jnp.broadcast_to(jnp.arange(sp), (b, sp))
     cos, sin = T.rope_tables(positions, cfg.head_dim)   # once, not per layer
@@ -605,7 +689,6 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
     # Unrolled layers, prompt K/V written straight into the stacked cache
     # (same no-scan rationale as extend_step; int8 caches quantize at the
     # write — the prefill forward itself runs full-precision)
-    bufs = _kv_bufs(cache)
     for li in range(cfg.n_layers):
         p = jax.tree.map(lambda a: a[li], params["blocks"])
         h = rms_norm_reference(x, p["attn_norm"])
@@ -634,11 +717,59 @@ def prefill(params: dict, tokens: jax.Array, cfg: T.TransformerConfig,
             for n, c in _kv_writes(bufs, k[:, :s], v[:, :s]).items():
                 bufs[n] = _write_kv_chunk(bufs[n], c, li,
                                           jnp.asarray(0, jnp.int32), None)
-    x = rms_norm_reference(x, params["final_norm"])
-    logits = _weinsum("bd,dv->bv", x[:, s - 1], params["lm_head"],
+    return rms_norm_reference(x, params["final_norm"]), bufs
+
+
+def prefill_rows(params: dict, tokens: jax.Array, lengths: jax.Array,
+                 cfg: T.TransformerConfig) -> tuple[jax.Array, dict]:
+    """BUCKETED multi-prompt prefill: process K prompts right-padded to
+    one shared bucket length in a single forward, compiling once per
+    bucket instead of once per distinct prompt length. tokens: [K, S_b]
+    int32 with each row's real prompt in its first ``lengths[k]``
+    positions (``lengths`` is TRACED — any mix of real lengths reuses
+    the bucket's compiled program); returns (per-row last-REAL-position
+    logits [K, V], mini cache of S_b rows with per-row frontiers at the
+    true lengths).
+
+    Correctness of the padding tail: causal masking keeps every real
+    position's output independent of the positions after it (the same
+    argument :func:`prefill` makes for flash-block padding), and the
+    padding rows' K/V beyond each row's frontier are unreachable by any
+    future query — decode writes position ``lengths[k]`` before reading
+    it, overwriting the first padding row, and queries attend positions
+    <= their own only (the serve.py slot-reuse argument). MoE caveat as
+    in :func:`prefill`: padded tokens still occupy router capacity.
+
+    Rolling caches are rejected: ring writes wrap padded positions onto
+    live rows (padding at position p lands on ring row p % C, clobbering
+    real history), so ring configs keep the per-length admission path."""
+    _check_no_ring(cfg, "bucketed prefill")
+    k_rows, s = tokens.shape
+    cache = init_kv_cache(cfg, k_rows, s)
+    x, bufs = _prompt_forward(params, tokens, cfg, _kv_bufs(cache), s)
+    xl = x[jnp.arange(k_rows), lengths - 1]                   # [K, D]
+    logits = _weinsum("bd,dv->bv", xl, params["lm_head"],
                       pet=jnp.float32)
-    logits = logits.astype(cfg.logits_storage_dtype)
-    return logits, dict(bufs, length=jnp.asarray(s, jnp.int32))
+    return (logits.astype(cfg.logits_storage_dtype),
+            dict(bufs, length=lengths.astype(jnp.int32)))
+
+
+def place_rows(cache: dict, mini: dict, rows: jax.Array,
+               lengths: jax.Array) -> dict:
+    """Land a K-row mini cache's K/V into cache slots ``rows`` — the
+    multi-row counterpart of serve.py's single-slot placement: one
+    scatter on the batch axis per buffer (k/v plus int8 scales) covering
+    positions [0, S_b), and the slots' frontiers set to their true
+    ``lengths``. Out-of-range row indices are DROPPED (standard jit
+    scatter semantics) — the batched admission path pads its row vector
+    with distinct out-of-range sentinels, so a partial admission batch
+    writes exactly its real rows."""
+    s_b = mini["k"].shape[2]
+    placed = {n: cache[n].at[:, rows, :s_b].set(
+                  mini[n], mode="drop", unique_indices=True)
+              for n in _kv_bufs(mini)}
+    return dict(placed, length=cache["length"].at[rows].set(
+        lengths.astype(jnp.int32), mode="drop", unique_indices=True))
 
 
 def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
@@ -813,21 +944,39 @@ def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
     — while ``extra`` belongs to the deeper position only.
 
     The accept test is ``u * q(x) < p(x)`` (never divides; q(x) > 0
-    because x was sampled from q). All probability math in f32."""
+    because x was sampled from q). All probability math in f32.
+
+    ``rng`` may be one PRNGKey (a shared per-round stream — the
+    generate-path callers) or a [B, 2] array of PER-ROW keys (the
+    serving path: each slot's draws come from its own request-derived
+    stream, so a request's sampled tokens are a function of (request,
+    round index) alone — independent of which other requests share the
+    batch or when admission happened, which is what lets the pipelined
+    serve loop shift admission timing without changing outputs)."""
     b = pending.shape[0]
-    d_rng, u_rng, r_rng = jax.random.split(rng, 3)
+    per_row = rng.ndim == 2
+    if per_row:
+        trip = jax.vmap(lambda kk: jax.random.split(kk, 3))(rng)
+        d_rng, u_rng, r_rng = trip[:, 0], trip[:, 1], trip[:, 2]
+        # [k, B, 2]: scan xs of per-row draft-step keys
+        d_xs = jax.vmap(lambda kk: jax.random.split(kk, k))(
+            d_rng).transpose(1, 0, 2)
+    else:
+        d_rng, u_rng, r_rng = jax.random.split(rng, 3)
+        d_xs = jax.random.split(d_rng, k)
     vocab = cfg.vocab_size
 
     def propose(logits, key):
         f = _filter_logits(logits.astype(jnp.float32), temperature,
                            top_k, top_p)
-        return (jax.random.categorical(key, f, axis=-1),
-                jax.nn.softmax(f, axis=-1))
+        tok = (jax.vmap(jax.random.categorical)(key, f) if per_row
+               else jax.random.categorical(key, f, axis=-1))
+        return tok, jax.nn.softmax(f, axis=-1)
 
     chunk, qs, logits, t_cache, d_cache = _propose_chunk(
         params, draft_params, t_cache, d_cache, pending, pos_arg, cfg,
         draft_cfg, k, win, token_dtype,
-        propose=propose, extra_xs=jax.random.split(d_rng, k))
+        propose=propose, extra_xs=d_xs)
     p = jax.nn.softmax(_filter_logits(logits.astype(jnp.float32),
                                       temperature, top_k, top_p),
                        axis=-1)                             # [B, k+1, V]
@@ -835,7 +984,8 @@ def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
     q_bkv = qs.transpose(1, 0, 2)                           # [B, k, V]
     qx = jnp.take_along_axis(q_bkv, x, axis=2)[..., 0]
     px = jnp.take_along_axis(p[:, :k], x, axis=2)[..., 0]   # [B, k]
-    u = jax.random.uniform(u_rng, (b, k))
+    u = (jax.vmap(lambda kk: jax.random.uniform(kk, (k,)))(u_rng)
+         if per_row else jax.random.uniform(u_rng, (b, k)))
     accept = (u * qx < px).astype(jnp.int32)
     acc = jnp.cumprod(accept, axis=1).sum(axis=1)           # [B], 0..k
 
@@ -850,8 +1000,10 @@ def _propose_and_verify_sampled(params, draft_params, t_cache, d_cache,
     # numeric guard: mathematically res sums to > 0 whenever a rejection
     # happened, but f32 cancellation can zero it — fall back to p
     res = jnp.where(res.sum(-1, keepdims=True) > 0, res, p_sel)
-    extra = jax.random.categorical(r_rng, jnp.log(res),
-                                   axis=-1).astype(token_dtype)
+    extra = (jax.vmap(jax.random.categorical)(r_rng, jnp.log(res))
+             if per_row
+             else jax.random.categorical(r_rng, jnp.log(res), axis=-1)
+             ).astype(token_dtype)
     return chunk, extra, acc, t_cache, d_cache
 
 

@@ -18,6 +18,20 @@ lm_head matmul; integers ≤ 127 are exact either way). The HBM weight
 read stays int8-wide; `decode._weinsum` is the single dispatch point.
 Same fold as the int8 KV cache's score/value scales.
 
+PREFILL-shaped matmuls invert the trade: over a long prompt the dot is
+COMPUTE-bound, f32 MXU throughput sits far below bf16, and the
+materialized bf16 weight copy amortizes across the many activation
+rows — so `_weinsum` converts the int8 weight to the bf16 compute
+dtype once per call when the activation's SEQUENCE axis exceeds 256
+positions (decode-shaped calls at any batch size and f32 activations
+keep the fused-f32 path bit-for-bit; the strict on-a-power-of-two
+threshold keeps bucket-padded admission and exact-length prefills of
+the same prompt on the same kernel — see `_QUANT_PREFILL_MIN_S`). The
+scale still applies outside the contraction in f32. `bench.py`'s
+`prefill_wq8_vs_bf16` arm pins quantized prefill near float-weight
+prefill; without this, serving int8 weights paid a time-to-first-token
+tax exactly where admission cost matters (ADVICE.md round 5).
+
 Scope: the decode/serving entry points (`decode.prefill`,
 ``extend_step``/``decode_step`` and everything built on them — generate,
 beam search, speculative decoding, continuous batching) consume

@@ -14,15 +14,20 @@ The round-5 per-row decode machinery is exactly what makes this cheap
 (models/decode.py): cache ``length`` is a [B] vector, RoPE positions,
 causal masks, and K/V writes all take per-row frontiers, and the
 length-aware block-wise attention reads only each batch's LIVE rows of a
-shared padded cache. On top of that, three small device programs:
+shared padded cache. On top of that, the device programs:
 
-- :func:`admit_row` — a batch-1 prefill whose K/V land in the retired
-  row's cache slot (one contiguous ``dynamic_update_slice`` per buffer)
-  and whose last-position logits seed the row's next step;
+- :func:`admit_rows` — a BUCKETED, BATCHED admission: K prompts padded
+  to one power-of-two length bucket prefill in a single dispatch and
+  land in K freed cache slots (one scatter per buffer), compiling once
+  per bucket instead of once per distinct prompt length and paying one
+  transport dispatch however many slots freed in the chunk;
+- :func:`admit_row` — the single-slot, exact-length admission the
+  batched path replaced; kept for rolling (ring) caches, whose wrapped
+  writes cannot take padded prompts, and for direct API use;
 - :func:`step_rows` — a ``lax.scan`` of ``n`` per-row decode steps over
   the whole batch (one dispatch per chunk, not per token; greedy by
   default, or sampled through the same top-k/temperature/nucleus stack
-  as ``decode.generate``);
+  as ``decode.generate`` — from PER-REQUEST key streams, see below);
 - :func:`retire_rows` — zero the freed rows' frontiers so idle slots
   never walk off the end of the cache.
 
@@ -32,12 +37,39 @@ Correctness argument for slot reuse: a row's queries attend positions
 reading it, so every position a query can reach was written by the
 CURRENT occupant — the previous request's stale K/V beyond the frontier
 is unreachable by construction (the same argument the speculative
-decoder makes for rejected-draft entries).
+decoder makes for rejected-draft entries). Bucketed admission extends it
+one step: the padding tail's K/V (positions [len, bucket)) sits beyond
+the frontier and every decode step overwrites position ``pos_r`` before
+reading it, so padding rows are unreachable too.
 
 The admission loop itself (:class:`ContinuousBatcher`) is host-driven —
 admission is inherently data-dependent control flow (which request, into
 which slot, at what length) and runs at human/request rate, while the
-token loop stays on device in ``step_rows`` chunks.
+token loop stays on device in ``step_rows`` chunks. The loop is
+PIPELINED (double-buffered dispatch): chunk N+1 is issued *before* chunk
+N's tokens are fetched, so the host-side EOS/budget bookkeeping and the
+transport round trip (~100 ms per sync on a tunneled chip) overlap
+device compute instead of serializing with it. Nothing on the host feeds
+the device between chunks — per-request rng streams are derivable ahead
+of time — EXCEPT retirement/admission, which the loop handles two ways:
+completions the host can PREDICT (budget exhaustion with requests still
+queued) process their chunk synchronously so the admission lands before
+the next dispatch, exactly as the sequential loop would; unpredictable
+completions (an eos mid-chunk) are caught up AFTER the speculatively
+issued chunk — the freed row ran one chunk of garbage that the host
+discards exactly as idle-slot garbage is discarded, and the late
+admission overwrites the slot before anything reads it.
+
+Sampling uses PER-REQUEST key streams: request ``q``'s draw at its
+``t``-th generated token comes from ``fold_in(fold_in(seed_key, q), t)``
+— a function of the workload seed, the request index, and the step
+alone. A request's sampled output is therefore independent of admission
+timing and batch composition (the pre-pipelining loop's shared stream
+made samples depend on WHEN a request was admitted), which is also what
+lets the pipelined loop shift an admission by a chunk without changing
+any output: pipelined and sequential (``pipeline=False``) serving are
+token-identical in every mode — greedy, sampled, speculative, and
+shared-prefix (test-enforced on CPU).
 
 :class:`SpeculativeContinuousBatcher` composes the two serving features:
 every slot runs draft-propose/target-verify rounds at its own frontier
@@ -48,26 +80,68 @@ speculative decoding, token-identical to per-request greedy decode.
 Shared-prefix caching (``shared_prefix=``, both batchers): a system
 prompt every request continues from prefills ONCE into a K/V template;
 admission copies the template into the slot and runs only the request's
-own tokens through the model (:func:`prefix_admit_row` — a chunked
+own tokens through the model (:func:`prefix_admit_rows` — a chunked
 ``extend_step`` against the copied prefix history), token-identical to
 serving prefix+prompt in full.
+
+``TRACE_COUNTS`` records one entry per (program, static shape) TRACE —
+a Python side effect inside the jitted bodies, executed at trace time
+only — so tests (and the conftest retrace guard) can pin "bucketed
+admission compiles once per bucket" as a regression invariant.
 """
 
 from __future__ import annotations
 
+import collections
 import functools
 from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tony_tpu.models import transformer as T
 from tony_tpu.models.decode import (_check_draft_vocab, _check_no_ring,
                                     _filter_logits, _kv_bufs,
                                     _propose_and_verify,
-                                    _propose_and_verify_sampled, _sample,
+                                    _propose_and_verify_sampled,
                                     decode_step, extend_step,
-                                    init_kv_cache, prefill)
+                                    init_kv_cache, place_rows, prefill,
+                                    prefill_rows)
+from tony_tpu.runtime.profiler import PhaseTimes
+
+#: Trace-time program counters keyed by (program name, static shape):
+#: incremented when a serving device program is TRACED (compiled), not
+#: when it is called. The bucketed-admission tests and the conftest
+#: ``retrace_guard`` fixture assert on deltas of this counter.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+#: smallest bucketed-admission pad length — prompts shorter than this
+#: share one program rather than compiling 16 tiny variants
+_MIN_ADMIT_BUCKET = 16
+
+#: rng-stream id for rows with no occupant (their draws are garbage the
+#: host discards; any fixed stream works)
+_IDLE_STREAM = 0x7FFFFFFF
+
+
+def _count_trace(name: str, shape) -> None:
+    TRACE_COUNTS[(name, tuple(shape))] += 1
+
+
+def _row_samples(logits, keys, temperature, top_k, top_p):
+    """One sampling decision per row from PER-ROW keys [B, 2] — argmax
+    at ``temperature == 0`` (keys unused; pass None), otherwise the same
+    filter stack as :func:`decode.generate` followed by a vmapped
+    per-row categorical. The SINGLE implementation behind
+    :func:`step_rows`' scan body and the batched speculative admitters'
+    seed draws, so the "same filter stack as generate" contract cannot
+    drift between the admission seed and the step/round draws."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1)
+    f = _filter_logits(logits.astype(jnp.float32), temperature, top_k,
+                       top_p)
+    return jax.vmap(jax.random.categorical)(keys, f)
 
 
 def _place_prefill(cache, mini, row, s_p):
@@ -84,23 +158,52 @@ def _place_prefill(cache, mini, row, s_p):
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "logits"))
 def admit_row(params, cache, logits, row, prompt, cfg):
-    """Admit a request into cache slot ``row``.
+    """Admit a request into cache slot ``row`` at its EXACT length.
 
     prompt: [1, S_p] (batch-1 prefill; retraces per distinct prompt
-    length — pad/bucket lengths upstream if that matters). Returns
-    (cache, logits) with the row's K/V filled, its frontier at S_p, and
-    its next-step logits seeded.
+    length). The batcher's default admission path is the bucketed
+    :func:`admit_rows`; this per-length program remains for rolling
+    (ring) caches — whose wrapped writes cannot take padded prompts —
+    and for direct API use. Returns (cache, logits) with the row's K/V
+    filled, its frontier at S_p, and its next-step logits seeded.
     """
+    _count_trace("admit_row", prompt.shape)
     lg1, mini = prefill(params, prompt, cfg, max_len=prompt.shape[1])
     return (_place_prefill(cache, mini, row, prompt.shape[1]),
             logits.at[row].set(lg1[0]))
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "logits"))
+def admit_rows(params, cache, logits, rows, prompts, lengths, cfg):
+    """BUCKETED, BATCHED admission: land K prompts (one length bucket)
+    into K freed cache slots in ONE dispatch.
+
+    prompts: [K, S_bucket] right-padded to the bucket; lengths: [K]
+    true prompt lengths (TRACED — any mix of real lengths reuses the
+    bucket's compiled program); rows: [K] target slots, with unused
+    entries set to DISTINCT out-of-range sentinels (>= batch) whose
+    scatter updates drop — the batcher always pads K to the full slot
+    count, so each bucket compiles exactly one program however many
+    slots freed. The prefill runs all K rows
+    (:func:`~tony_tpu.models.decode.prefill_rows`), each slot's K/V land
+    via one batch-axis scatter per buffer
+    (:func:`~tony_tpu.models.decode.place_rows`), and each slot's
+    next-step logits seed from its true last prompt position."""
+    _count_trace("admit_rows", prompts.shape)
+    lg, mini = prefill_rows(params, prompts, lengths, cfg)
+    return (place_rows(cache, mini, rows, lengths),
+            logits.at[rows].set(lg, mode="drop", unique_indices=True))
+
+
 def prefix_template(params, prefix, cfg):
     """Prefill a SHARED PREFIX once (a system prompt every request
     continues from); returns the [L, 1, P, KV, hd] K/V template
-    :func:`prefix_admit_row` copies into each admitted slot. prefix:
-    [P] ints."""
+    :func:`prefix_admit_rows` copies into each admitted slot. prefix:
+    [P] ints. Rolling caches are rejected up front: a ring-shaped
+    buffer's shape[2] is the capacity, which the template consumers
+    would misread as the prefix length and build a corrupt cache."""
+    _check_no_ring(cfg, "prefix templates")
     _, mini = prefill(params, jnp.asarray(prefix, jnp.int32)[None], cfg,
                       max_len=len(prefix))
     return _kv_bufs(mini)
@@ -125,6 +228,29 @@ def _extend_from_template(model_params, template, suffix, model_cfg):
     return lg, mini, p_len + s_len
 
 
+def _extend_rows_from_template(model_params, template, suffixes, lengths,
+                               model_cfg):
+    """Batched-bucketed counterpart of :func:`_extend_from_template`:
+    tile the prefix template across K rows and run all K right-padded
+    suffixes [K, S_b] through the model against it in one chunked
+    :func:`extend_step`. Each row's padding-tail K/V land beyond its
+    frontier (unreachable — the bucketed-admission argument). Returns
+    (per-row last-REAL-suffix-position logits [K, V], mini cache,
+    per-row totals P + lengths)."""
+    p_len = template["k"].shape[2]
+    k_rows, s_len = suffixes.shape
+    mini = dict(
+        {n: jnp.concatenate(
+            [jnp.broadcast_to(x, (x.shape[0], k_rows) + x.shape[2:]),
+             jnp.zeros(x.shape[:1] + (k_rows, s_len) + x.shape[3:],
+                       x.dtype)], axis=2)
+         for n, x in template.items()},
+        length=jnp.asarray(p_len, jnp.int32))
+    lg, mini = extend_step(model_params, suffixes, mini, p_len, model_cfg)
+    return (lg[jnp.arange(k_rows), lengths - 1], mini,
+            p_len + lengths.astype(jnp.int32))
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "logits"))
 def prefix_admit_row(params, cache, logits, row, template, suffix, cfg):
@@ -133,36 +259,62 @@ def prefix_admit_row(params, cache, logits, row, template, suffix, cfg):
     serve, not one per request) and only the request's ``suffix``
     [1, S] runs a forward (:func:`_extend_from_template`). Admission
     compute drops from O(P+S) to O(S) tokens; at a long system prompt
-    and short user turns that is the dominant admission cost."""
+    and short user turns that is the dominant admission cost. Per-length
+    program — the batcher's default is the bucketed
+    :func:`prefix_admit_rows`."""
+    _count_trace("prefix_admit_row", suffix.shape)
     lg, mini, total = _extend_from_template(params, template, suffix, cfg)
     return (_place_prefill(cache, mini, row, total),
             logits.at[row].set(lg[0, -1]))
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "logits"))
+def prefix_admit_rows(params, cache, logits, rows, template, suffixes,
+                      lengths, cfg):
+    """Bucketed, batched shared-prefix admission: K suffixes (one length
+    bucket, right-padded) continue the precomputed prefix ``template``
+    and land in K freed slots in one dispatch — the
+    :func:`admit_rows` contract (sentinel-padded ``rows``, traced true
+    ``lengths``, one compiled program per bucket) applied to
+    O(suffix)-cost prefix admission."""
+    _count_trace("prefix_admit_rows", suffixes.shape)
+    lg, mini, totals = _extend_rows_from_template(params, template,
+                                                  suffixes, lengths, cfg)
+    return (place_rows(cache, mini, rows, totals),
+            logits.at[rows].set(lg, mode="drop", unique_indices=True))
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "n", "temperature",
                                              "top_k", "top_p"),
                    donate_argnames=("cache", "logits"))
-def step_rows(params, cache, logits, rng, n, cfg, temperature=0.0,
-              top_k=0, top_p=0.0):
+def step_rows(params, cache, logits, keys, offsets, n, cfg,
+              temperature=0.0, top_k=0, top_p=0.0):
     """``n`` decode steps for every row at its OWN frontier — greedy at
     ``temperature=0`` (default), otherwise sampled per row through the
     same filter stack as :func:`tony_tpu.models.decode.generate`
-    (top-k → temperature → nucleus). ``rng``: a PRNGKey, split per step
-    (rows sample independently from one key — ``categorical`` on [B, V]
-    draws per-row). Returns (tokens [B, n], cache, logits). Idle rows
-    decode garbage that the host discards — uniform batch math keeps
-    this one compiled program regardless of which rows are live."""
+    (top-k → temperature → nucleus). ``keys``: [B, 2] PER-ROW PRNG keys
+    (each row's occupant request's stream); ``offsets``: [B] int32
+    per-row counts of draws already taken, so step ``j`` samples row
+    ``r`` from ``fold_in(keys[r], offsets[r] + j)`` — a request's
+    samples are a function of its own stream position alone, independent
+    of batch composition or admission timing (what lets the pipelined
+    loop shift admissions without changing outputs). Returns (tokens
+    [B, n], cache, logits). Idle rows decode garbage that the host
+    discards — uniform batch math keeps this one compiled program
+    regardless of which rows are live."""
+    _count_trace("step_rows", (cache["k"].shape, n))
 
-    def body(carry, step_rng):
+    def body(carry, j):
         lg, c = carry
-        # _sample handles temperature==0 as argmax; its unused logprob
-        # output is DCE'd under jit
-        tok, _ = _sample(lg, step_rng, temperature, top_k, top_p)
+        step_keys = (jax.vmap(jax.random.fold_in)(keys, offsets + j)
+                     if temperature > 0.0 else None)
+        tok = _row_samples(lg, step_keys, temperature, top_k, top_p)
         lg, c = decode_step(params, tok, c, c["length"], cfg)
         return (lg, c), tok
 
     (lg, cache), toks = jax.lax.scan(body, (logits, cache),
-                                     jax.random.split(rng, n))
+                                     jnp.arange(n))
     return toks.T, cache, lg
 
 
@@ -180,13 +332,15 @@ def retire_rows(cache, mask):
 def spec_admit_row(params, draft_params, t_cache, d_cache, pending, row,
                    prompt, rng, cfg, draft_cfg, temperature=0.0,
                    top_k=0, top_p=0.0):
-    """Speculative admission: prefill BOTH models on the prompt into
-    cache slot ``row`` (the draft keeps its own per-slot K/V history) and
-    seed the row's ``pending`` token from the target's last-position
-    logits — argmax at ``temperature=0``, otherwise a sample through the
-    same filter stack the rounds use (the seed token is part of the
-    request's sampled stream). Same contract as :func:`admit_row`
-    otherwise."""
+    """Speculative admission at the EXACT prompt length: prefill BOTH
+    models on the prompt into cache slot ``row`` (the draft keeps its
+    own per-slot K/V history) and seed the row's ``pending`` token from
+    the target's last-position logits — argmax at ``temperature=0``,
+    otherwise a sample through the same filter stack the rounds use
+    (the seed token is part of the request's sampled stream). Same
+    contract as :func:`admit_row` otherwise; the batcher's default is
+    the bucketed :func:`spec_admit_rows`."""
+    _count_trace("spec_admit_row", prompt.shape)
     lg, mini_t = prefill(params, prompt, cfg, max_len=prompt.shape[1])
     _, mini_d = prefill(draft_params, prompt, draft_cfg,
                         max_len=prompt.shape[1])
@@ -207,14 +361,41 @@ def spec_admit_row(params, draft_params, t_cache, d_cache, pending, row,
                                              "temperature", "top_k",
                                              "top_p"),
                    donate_argnames=("t_cache", "d_cache", "pending"))
+def spec_admit_rows(params, draft_params, t_cache, d_cache, pending,
+                    rows, prompts, lengths, keys, cfg, draft_cfg,
+                    temperature=0.0, top_k=0, top_p=0.0):
+    """Bucketed, batched speculative admission: K prompts (one length
+    bucket) prefill BOTH models in one dispatch each and land in K
+    freed slots — the :func:`admit_rows` contract applied to the
+    speculative batcher's dual caches. ``keys``: [K, 2] per-request
+    seed-draw keys (stream position 0 of each request; rounds consume
+    positions 1+), used only at ``temperature > 0``."""
+    _count_trace("spec_admit_rows", prompts.shape)
+    lg, mini_t = prefill_rows(params, prompts, lengths, cfg)
+    _, mini_d = prefill_rows(draft_params, prompts, lengths, draft_cfg)
+    t_cache = place_rows(t_cache, mini_t, rows, lengths)
+    d_cache = place_rows(d_cache, mini_d, rows, lengths)
+    seed_tok = _row_samples(lg, keys, temperature, top_k, top_p)
+    pending = pending.at[rows].set(seed_tok.astype(pending.dtype),
+                                   mode="drop", unique_indices=True)
+    return t_cache, d_cache, pending
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg",
+                                             "temperature", "top_k",
+                                             "top_p"),
+                   donate_argnames=("t_cache", "d_cache", "pending"))
 def spec_prefix_admit_row(params, draft_params, t_cache, d_cache, pending,
                           row, t_template, d_template, suffix, rng, cfg,
                           draft_cfg, temperature=0.0, top_k=0, top_p=0.0):
-    """Shared-prefix admission for the speculative batcher: BOTH models'
-    prefix K/V come from precomputed templates and only the suffix runs
-    a forward through each (:func:`_extend_from_template`); the pending
-    seed comes from the target's last suffix position, argmax or
-    sampled, as in :func:`spec_admit_row`."""
+    """Shared-prefix admission for the speculative batcher at the EXACT
+    suffix length: BOTH models' prefix K/V come from precomputed
+    templates and only the suffix runs a forward through each
+    (:func:`_extend_from_template`); the pending seed comes from the
+    target's last suffix position, argmax or sampled, as in
+    :func:`spec_admit_row`. The batcher's default is the bucketed
+    :func:`spec_prefix_admit_rows`."""
+    _count_trace("spec_prefix_admit_row", suffix.shape)
     lg, mini_t, total = _extend_from_template(params, t_template,
                                               suffix, cfg)
     _, mini_d, _ = _extend_from_template(draft_params, d_template,
@@ -231,13 +412,41 @@ def spec_prefix_admit_row(params, draft_params, t_cache, d_cache, pending,
     return t_cache, d_cache, pending
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg",
+                                             "temperature", "top_k",
+                                             "top_p"),
+                   donate_argnames=("t_cache", "d_cache", "pending"))
+def spec_prefix_admit_rows(params, draft_params, t_cache, d_cache,
+                           pending, rows, t_template, d_template,
+                           suffixes, lengths, keys, cfg, draft_cfg,
+                           temperature=0.0, top_k=0, top_p=0.0):
+    """Bucketed, batched shared-prefix speculative admission: K suffixes
+    (one length bucket) continue both models' templates in one chunked
+    extend each (:func:`_extend_rows_from_template`) and land in K freed
+    slots, seeding each slot's pending from its true last suffix
+    position."""
+    _count_trace("spec_prefix_admit_rows", suffixes.shape)
+    lg, mini_t, totals = _extend_rows_from_template(params, t_template,
+                                                    suffixes, lengths,
+                                                    cfg)
+    _, mini_d, _ = _extend_rows_from_template(draft_params, d_template,
+                                              suffixes, lengths,
+                                              draft_cfg)
+    t_cache = place_rows(t_cache, mini_t, rows, totals)
+    d_cache = place_rows(d_cache, mini_d, rows, totals)
+    seed_tok = _row_samples(lg, keys, temperature, top_k, top_p)
+    pending = pending.at[rows].set(seed_tok.astype(pending.dtype),
+                                   mode="drop", unique_indices=True)
+    return t_cache, d_cache, pending
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg", "n", "k",
                                              "temperature", "top_k",
                                              "top_p"),
                    donate_argnames=("t_cache", "d_cache", "pending"))
-def spec_step_rows(params, draft_params, t_cache, d_cache, pending, rng,
-                   n, cfg, draft_cfg, k, temperature=0.0, top_k=0,
-                   top_p=0.0):
+def spec_step_rows(params, draft_params, t_cache, d_cache, pending, keys,
+                   offsets, n, cfg, draft_cfg, k, temperature=0.0,
+                   top_k=0, top_p=0.0):
     """``n`` speculative rounds for every row at its OWN frontier — the
     serving analog of :func:`step_rows` built on the same
     propose-and-verify round the speculative decoder uses
@@ -257,13 +466,17 @@ def spec_step_rows(params, draft_params, t_cache, d_cache, pending, rng,
     array, erasing speculation's win).
 
     ``temperature > 0`` runs SAMPLED rounds instead
-    (:func:`decode._propose_and_verify_sampled`): serving commits the
-    full per-row acceptance every round, so each slot's next pending is
-    simply the round's residual/bonus sample, and each request's
-    committed stream is distributed exactly as target-only sampling
-    through the same filter stack."""
+    (:func:`decode._propose_and_verify_sampled`, handed PER-ROW round
+    keys ``fold_in(keys[r], offsets[r] + i)`` — each slot's draws come
+    from its occupant request's own stream, the same
+    admission-timing-independence contract as :func:`step_rows`):
+    serving commits the full per-row acceptance every round, so each
+    slot's next pending is simply the round's residual/bonus sample,
+    and each request's committed stream is distributed exactly as
+    target-only sampling through the same filter stack."""
+    _count_trace("spec_step_rows", (t_cache["k"].shape, n, k))
 
-    def body(carry, round_rng):
+    def body(carry, i):
         t_cache, d_cache, pending = carry
         pos = t_cache["length"]                                  # [B]
         if temperature == 0.0:
@@ -273,11 +486,12 @@ def spec_step_rows(params, draft_params, t_cache, d_cache, pending, rng,
             pending = jnp.take_along_axis(argmaxes, acc[:, None],
                                           axis=1)[:, 0]
         else:
+            round_keys = jax.vmap(jax.random.fold_in)(keys, offsets + i)
             chunk, extra, acc, t_cache, d_cache = (
                 _propose_and_verify_sampled(
                     params, draft_params, t_cache, d_cache, pending,
                     pos, cfg, draft_cfg, k, None, pending.dtype,
-                    round_rng, temperature, top_k, top_p))
+                    round_keys, temperature, top_k, top_p))
             pending = extra
         count = acc + 1
         new_len = (pos + count).astype(jnp.int32)
@@ -289,7 +503,7 @@ def spec_step_rows(params, draft_params, t_cache, d_cache, pending, rng,
         return (t_cache, d_cache, pending), packed
 
     (t_cache, d_cache, pending), packed = jax.lax.scan(
-        body, (t_cache, d_cache, pending), jax.random.split(rng, n))
+        body, (t_cache, d_cache, pending), jnp.arange(n))
     return packed, t_cache, d_cache, pending
 
 
@@ -303,16 +517,38 @@ class ContinuousBatcher:
     :func:`decode.generate` produces for each request alone
     (test-verified token-identical on CPU); with ``temperature``/
     ``top_k``/``top_p`` set, slots sample through the same filter stack
-    as ``generate`` instead (seed-reproducible per workload — see
+    as ``generate`` instead, from per-request key streams (see
     ``__init__``).
+
+    The serve loop is PIPELINED by default (``pipeline=True``): chunk
+    N+1 is dispatched before chunk N's tokens are fetched, overlapping
+    the fetch's transport round trip and the host bookkeeping with
+    device compute. ``pipeline=False`` keeps the sequential
+    issue→fetch→bookkeep→admit loop; both produce identical outputs in
+    every mode (test-enforced) — the sequential loop exists as the
+    equivalence baseline and A/B arm, not for production use.
+
+    Admission is BUCKETED and BATCHED by default: prompts pad to
+    power-of-two length buckets (compile once per bucket, not once per
+    distinct prompt length) and every slot freed in the same chunk lands
+    in one :func:`admit_rows` dispatch. Rolling (ring) caches fall back
+    to the per-length :func:`admit_row` path — padded prompts cannot
+    take wrapped writes.
     """
+
+    #: first per-request stream position consumed by step_rows sampling
+    #: (the speculative batcher's admission seed-draw takes position 0,
+    #: so its rounds start at 1)
+    _off0 = 0
 
     def __init__(self, params, cfg: T.TransformerConfig, batch: int,
                  max_len: int, eos_id: int | None = None,
                  chunk: int = 8, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
                  seed: int = 0,
-                 shared_prefix=None) -> None:
+                 shared_prefix=None, pipeline: bool = True,
+                 bucketed_admission: bool = True,
+                 admission_buckets: Sequence[int] | None = None) -> None:
         self.params = params
         self.cfg = cfg
         self.batch = batch
@@ -322,7 +558,7 @@ class ContinuousBatcher:
         #: system prompt), every request's prompt is interpreted as a
         #: CONTINUATION of it — the prefix prefills once into a K/V
         #: template that admission copies into the slot, and only the
-        #: request's own tokens run a forward (prefix_admit_row).
+        #: request's own tokens run a forward (prefix_admit_rows).
         #: Outputs are token-identical to serving prefix+prompt in full.
         self.shared_prefix = (None if shared_prefix is None
                               else list(shared_prefix))
@@ -340,34 +576,160 @@ class ContinuousBatcher:
         self._prefix_template = (
             prefix_template(params, self.shared_prefix, cfg)
             if self.shared_prefix else None)
-        #: sampling controls (greedy by default); the rng stream restarts
-        #: from ``seed`` at every serve() call, so a workload re-served
-        #: with the same seed reproduces its outputs — but a request's
-        #: samples depend on its admission timing within the workload,
-        #: not on the request alone (shared stream; acceptable for
-        #: serving, use generate() for per-request reproducibility)
+        #: sampling controls (greedy by default). Streams are
+        #: PER-REQUEST: request q's t-th draw comes from
+        #: fold_in(fold_in(PRNGKey(seed), q), t) — a re-served workload
+        #: with the same seed reproduces its outputs, and a request's
+        #: samples depend only on (seed, its index, its prompt), not on
+        #: admission timing or what else shares the batch
         self.temperature = temperature
         self.top_k = top_k
         self.top_p = top_p
         self.seed = seed
-        # usable standalone (the _admit/_dispatch seams don't require a
-        # serve() call first); serve() re-seeds for per-workload
-        # reproducibility
-        self._rng = jax.random.PRNGKey(seed)
         #: device steps per host round trip — latency/overhead trade:
         #: a finished row idles at most chunk-1 steps before its slot
         #: is reused
         self.chunk = max(1, chunk)
+        #: double-buffered dispatch (see class docstring)
+        self.pipeline = bool(pipeline)
+        #: bucketed+batched admission; ring caches force the per-length
+        #: fallback (wrapped writes can't take padded prompts)
+        self.bucketed_admission = bool(bucketed_admission) and not self._ring
+        if admission_buckets is not None:
+            ladder = sorted({int(b) for b in admission_buckets})
+            if not ladder or ladder[0] < 1:
+                raise ValueError("admission_buckets must be positive "
+                                 f"lengths, got {admission_buckets}")
+            self.admission_buckets: tuple[int, ...] | None = tuple(ladder)
+        else:
+            self.admission_buckets = None          # auto: powers of two
         self.cache = init_kv_cache(cfg, batch, max_len)
         # per-row frontiers from the start (decode.py's [B] position path)
         self.cache = dict(self.cache,
                           length=jnp.zeros((batch,), jnp.int32))
         self.logits = jnp.zeros((batch, cfg.vocab_size),
                                 cfg.logits_storage_dtype)
+        self.steps_executed = 0
+        self.rounds_executed = 0
+        self.phase_times = PhaseTimes()
+        # seams usable standalone (no serve() call required); serve()
+        # re-seeds for per-workload reproducibility
+        self._reset_streams()
 
-    # --- device seams (overridden by the speculative batcher) ---
+    # --- per-request rng streams ---
 
-    def _admit(self, row: int, tokens) -> None:
+    def _reset_streams(self) -> None:
+        self._base_key = jax.random.PRNGKey(self.seed)
+        idle = jax.random.fold_in(self._base_key, _IDLE_STREAM)
+        #: [B, 2] per-row keys: each row carries its occupant REQUEST's
+        #: stream key; idle rows draw garbage from a fixed idle stream
+        self._row_keys = jnp.tile(idle[None], (self.batch, 1))
+        #: per-row stream positions consumed so far (host-side ints)
+        self._row_off = [self._off0] * self.batch
+
+    def _req_key(self, req: int):
+        return jax.random.fold_in(self._base_key, req)
+
+    # --- admission (bucketed/batched with a per-length fallback) ---
+
+    def _bucket_for(self, n: int) -> int:
+        """Padded admission length for an n-token prompt (suffix, when a
+        shared prefix is set): the smallest power-of-two (or custom
+        ladder) bucket >= n, clamped to the cache's admissible length.
+        Powers of two are flash-block-aligned at every size, so TPU
+        prefill never re-pads a bucket."""
+        cap = self.max_len - (len(self.shared_prefix)
+                              if self.shared_prefix else 0)
+        if self.admission_buckets is not None:
+            for b in self.admission_buckets:
+                if b >= n:
+                    return min(b, cap)
+            return cap
+        b = _MIN_ADMIT_BUCKET
+        while b < n:
+            b <<= 1
+        return min(b, cap)
+
+    def _marshal_wave(self, pairs):
+        """THE home of the sentinel scheme: ([batch] row targets, [batch,
+        2] per-request base rng keys) for a set of admitted (row,
+        request) pairs, padded to the full slot count — unused entries
+        get DISTINCT out-of-range row sentinels (their scatters drop)
+        and the idle rng stream. One marshalling shared by prompt
+        placement, stream rebinding, and the speculative seed draws, so
+        the scheme cannot drift apart between paths; the keys come from
+        ONE vmapped fold_in per wave, not one dispatch per row."""
+        rows = self.batch + np.arange(self.batch, dtype=np.int32)
+        req_ids = [_IDLE_STREAM] * self.batch
+        for i, (row, req) in enumerate(pairs):
+            rows[i] = row
+            req_ids[i] = req
+        return (jnp.asarray(rows),
+                jax.vmap(self._req_key)(jnp.asarray(req_ids)))
+
+    def _pad_prompts_to(self, grp, prompts, bucket):
+        """[batch, bucket] right-padded prompt matrix plus [batch] true
+        lengths for one bucket group (entries past the group are inert —
+        their scatter targets are :meth:`_marshal_wave`'s out-of-range
+        sentinels)."""
+        toks = np.zeros((self.batch, bucket), np.int64)
+        lens = np.ones((self.batch,), np.int32)
+        for i, (_, req) in enumerate(grp):
+            p = prompts[req]
+            toks[i, :len(p)] = p
+            lens[i] = len(p)
+        return jnp.asarray(toks, jnp.int32), jnp.asarray(lens)
+
+    def _admit_batch(self, pairs, prompts) -> None:
+        """Admit (row, request-index) pairs: group by length bucket and
+        land each group in ONE device dispatch (legacy per-row programs
+        when bucketing is off/ring). Also rebinds each row's rng stream
+        to its new occupant — one scatter of the wave's marshalled keys,
+        not a dispatch per row."""
+        if not pairs:
+            return
+        with self.phase_times.phase("admit"):
+            if self.bucketed_admission:
+                groups: dict[int, list] = {}
+                for row, req in pairs:
+                    groups.setdefault(
+                        self._bucket_for(len(prompts[req])),
+                        []).append((row, req))
+                for bucket in sorted(groups):
+                    grp = groups[bucket]
+                    rows, keys = self._marshal_wave(grp)
+                    toks, lens = self._pad_prompts_to(grp, prompts,
+                                                      bucket)
+                    self._admit_rows(rows, toks, lens, keys)
+                    self._rebind_streams(grp, rows, keys)
+            else:
+                for row, req in pairs:
+                    self._admit_legacy(row, req, prompts)
+                rows, keys = self._marshal_wave(pairs)
+                self._rebind_streams(pairs, rows, keys)
+
+    def _rebind_streams(self, pairs, rows, keys) -> None:
+        """Rebind the admitted rows' rng streams to their new occupants:
+        ONE scatter of the wave's already-marshalled base keys (the
+        sentinel rows drop), plus the host-side stream-position
+        resets."""
+        self._row_keys = self._row_keys.at[rows].set(
+            keys, mode="drop", unique_indices=True)
+        for row, _ in pairs:
+            self._row_off[row] = self._off0
+
+    def _admit_rows(self, rows, toks, lens, keys) -> None:
+        if self._prefix_template is not None:
+            self.cache, self.logits = prefix_admit_rows(
+                self.params, self.cache, self.logits, rows,
+                self._prefix_template, toks, lens, self.cfg)
+        else:
+            self.cache, self.logits = admit_rows(
+                self.params, self.cache, self.logits, rows, toks, lens,
+                self.cfg)
+
+    def _admit_legacy(self, row, req, prompts) -> None:
+        tokens = jnp.asarray(prompts[req], jnp.int32)[None]
         if self._prefix_template is not None:
             self.cache, self.logits = prefix_admit_row(
                 self.params, self.cache, self.logits, row,
@@ -377,17 +739,35 @@ class ContinuousBatcher:
                 self.params, self.cache, self.logits, row, tokens,
                 self.cfg)
 
-    def _dispatch(self):
-        """Run one device chunk; returns per-slot newly generated tokens
-        (a [B, n] array or list of per-row sequences, in order)."""
-        import numpy as np
+    # --- dispatch/fetch seams (overridden by the speculative batcher) ---
 
-        self._rng, sub = jax.random.split(self._rng)
-        toks, self.cache, self.logits = step_rows(
-            self.params, self.cache, self.logits, sub, self.chunk,
-            self.cfg, self.temperature, self.top_k, self.top_p)
+    #: most tokens one chunk can commit per row (the greedy step loop
+    #: commits exactly one per step; the speculative batcher overrides)
+    def _chunk_tokens_max(self) -> int:
+        return self.chunk
+
+    def _issue(self):
+        """Issue one device chunk WITHOUT fetching it (async dispatch —
+        returns the not-yet-materialized device tokens). The pipelined
+        loop issues chunk N+1 here before fetching chunk N."""
+        with self.phase_times.phase("dispatch"):
+            offs = jnp.asarray(self._row_off, jnp.int32)
+            toks, self.cache, self.logits = step_rows(
+                self.params, self.cache, self.logits, self._row_keys,
+                offs, self.chunk, self.cfg, self.temperature, self.top_k,
+                self.top_p)
         self.steps_executed += self.chunk
-        return np.asarray(toks)
+        for r in range(self.batch):
+            self._row_off[r] += self.chunk
+        return toks
+
+    def _fetch(self, handle):
+        """Block on a previously issued chunk: remaining device compute
+        plus the transport round trip — the cost the pipelined loop
+        overlaps with the NEXT chunk. Returns per-row sequences of newly
+        generated tokens."""
+        with self.phase_times.phase("fetch"):
+            return np.asarray(handle)
 
     def _retire(self, mask) -> None:
         self.cache = retire_rows(self.cache, jnp.asarray(mask))
@@ -398,7 +778,9 @@ class ContinuousBatcher:
         matching the input. ``max_new_tokens``: one int for all requests
         or a per-request sequence (mixed-length serving is the whole
         point). ``self.steps_executed`` counts device decode steps run —
-        the utilization denominator (each step advances every slot)."""
+        the utilization denominator (each step advances every slot);
+        ``self.phase_times`` holds per-phase host wall clock
+        (dispatch/fetch/admit/retire) for the call."""
         queue = list(range(len(prompts)))
         outputs: list[list[int]] = [[] for _ in prompts]
         if isinstance(max_new_tokens, int):
@@ -426,24 +808,31 @@ class ContinuousBatcher:
                     + f"prompt {len(p)} + {b} new tokens exceeds "
                       f"max_len {self.max_len}")
         occupant: list[int | None] = [None] * self.batch
+        done = [False] * len(prompts)
         self.steps_executed = 0
         self.rounds_executed = 0
-        self._rng = jax.random.PRNGKey(self.seed)
+        self.phase_times = PhaseTimes()
+        self._reset_streams()
 
-        def admit_next(row: int) -> None:
-            req = queue.pop(0)
-            self._admit(row, jnp.asarray(prompts[req], jnp.int32)[None])
-            occupant[row] = req
+        def admit_into(rows_):
+            pairs = []
+            for row in rows_:
+                if queue:
+                    pairs.append((row, queue.pop(0)))
+            if pairs:
+                self._admit_batch(pairs, prompts)
+                for row, req in pairs:
+                    occupant[row] = req
 
-        for row in range(self.batch):
-            if queue:
-                admit_next(row)
-
-        while any(o is not None for o in occupant):
-            host_toks = self._dispatch()
+        def consume(host_toks, snap):
+            """Apply one fetched chunk under the occupancy it was ISSUED
+            with; returns the rows it freed. Rows whose snapshot request
+            already finished (a speculatively issued chunk crossed the
+            completion) carry garbage and are skipped — the same discard
+            as idle-slot garbage."""
             freed = []
-            for row, req in enumerate(occupant):
-                if req is None:
+            for row, req in enumerate(snap):
+                if req is None or done[req]:
                     continue
                 for t in host_toks[row]:
                     outputs[req].append(int(t))
@@ -451,17 +840,80 @@ class ContinuousBatcher:
                     if budget[req] == 0 or (self.eos_id is not None
                                             and int(t) == self.eos_id):
                         # surplus chunk tokens past completion discarded
+                        done[req] = True
                         occupant[row] = None
                         freed.append(row)
                         break
-            for row in freed:
-                if queue:
-                    admit_next(row)
+            return freed
+
+        def settle(freed):
+            admit_into(freed)
             # reset ALL unoccupied rows (not just newly freed): a slot
             # idle across many chunks would otherwise march its garbage
             # frontier every step until it clamps at the cache end
             if any(o is None for o in occupant):
-                self._retire([o is None for o in occupant])
+                with self.phase_times.phase("retire"):
+                    self._retire([o is None for o in occupant])
+
+        admit_into(range(self.batch))
+
+        if not self.pipeline:
+            # sequential loop: issue → fetch → bookkeep → admit. The
+            # equivalence baseline and A/B arm; every fetch serializes
+            # the transport round trip with device compute.
+            while any(o is not None for o in occupant):
+                snap = list(occupant)
+                settle(consume(self._fetch(self._issue()), snap))
+            return outputs
+
+        live = [r is not None for r in occupant]
+
+        def certainly_final():
+            """The chunk about to be issued provably retires every live
+            request (budget exhaustion; eos and speculative acceptance
+            only finish EARLIER, and every speculative round commits
+            >= 1 token) with nothing queued — issuing past it would be a
+            guaranteed-garbage dispatch."""
+            return not queue and all(
+                budget[req] <= self.chunk
+                for req in occupant if req is not None and not done[req])
+
+        def defer_issue(snap):
+            """Process the in-flight chunk BEFORE issuing the next one
+            when the host can PREDICT a completion with requests still
+            queued: budget exhaustion is host-visible ahead of time, and
+            issuing across it would run the freed slot idle for a whole
+            chunk — a step-utilization loss the sequential loop doesn't
+            pay. Unpredictable completions (eos mid-chunk) are NOT
+            deferred for — the loop stays optimistic and catches up
+            after the fact (the freed row's speculatively-issued chunk
+            is discarded as garbage). Budget-only workloads therefore
+            pipeline LOSSLESSLY: chunk count, admission timing, and
+            utilization all match the sequential loop."""
+            return bool(queue) and any(
+                req is not None and not done[req]
+                and budget[req] <= self._chunk_tokens_max()
+                for req in snap)
+
+        inflight = ((self._issue(), list(occupant))
+                    if any(live) else None)
+        while inflight is not None:
+            handle, snap = inflight
+            nxt = None
+            if not certainly_final() and not defer_issue(snap):
+                # double-buffer: chunk N+1 enters the device queue before
+                # chunk N's fetch blocks on the transport
+                nxt = (self._issue(), list(occupant))
+            freed = consume(self._fetch(handle), snap)
+            settle(freed)
+            if nxt is not None and all(o is None for o in occupant):
+                # every request retired while the speculative chunk was
+                # in flight (eos beat the budget bound): drop it
+                # unfetched — all its rows are garbage
+                nxt = None
+            if nxt is None and any(o is not None for o in occupant):
+                nxt = (self._issue(), list(occupant))
+            inflight = nxt
         return outputs
 
 
@@ -473,8 +925,12 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
     ``extend_step``; each slot commits its own acceptance
     (:func:`spec_step_rows`, built on the same propose-and-verify round
     as ``decode.speculative_generate_device``). Slot reuse works exactly
-    as in the greedy batcher: admission prefills BOTH caches, retirement
-    frees the slot, and idle rows decode garbage the host discards.
+    as in the greedy batcher: admission prefills BOTH caches (bucketed
+    and batched by default — :func:`spec_admit_rows`), retirement frees
+    the slot, and idle rows decode garbage the host discards. The
+    pipelined loop and its catch-up semantics are inherited unchanged —
+    one packed array per sync keeps the double-buffered fetch a single
+    transport round trip.
 
     Outputs are token-identical to the greedy batcher (and therefore to
     per-request ``decode.generate``) wherever chunked and single-step
@@ -500,7 +956,14 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
     SAMPLING (``decode._propose_and_verify_sampled``): each request's
     committed stream is distributed exactly as target-only sampling
     through the same temperature/top-k/top-p stack, for any draft —
-    greedy rounds remain the token-exact default."""
+    greedy rounds remain the token-exact default. Draws come from
+    per-request streams (the admission seed takes stream position 0,
+    round ``r`` takes position ``1 + r``), so a request's sampled output
+    is independent of admission timing — pipelined == sequential here
+    too."""
+
+    #: stream position 0 is the admission seed draw; rounds start at 1
+    _off0 = 1
 
     def __init__(self, params, cfg: T.TransformerConfig,
                  draft_params, draft_cfg: T.TransformerConfig,
@@ -508,11 +971,15 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                  num_speculative: int = 4, eos_id: int | None = None,
                  chunk: int = 4, temperature: float = 0.0,
                  top_k: int = 0, top_p: float = 0.0,
-                 seed: int = 0, shared_prefix=None) -> None:
+                 seed: int = 0, shared_prefix=None,
+                 pipeline: bool = True, bucketed_admission: bool = True,
+                 admission_buckets: Sequence[int] | None = None) -> None:
         super().__init__(params, cfg, batch, max_len, eos_id=eos_id,
                          chunk=chunk, temperature=temperature,
                          top_k=top_k, top_p=top_p, seed=seed,
-                         shared_prefix=shared_prefix)
+                         shared_prefix=shared_prefix, pipeline=pipeline,
+                         bucketed_admission=bucketed_admission,
+                         admission_buckets=admission_buckets)
         if num_speculative < 1:
             raise ValueError("num_speculative must be >= 1")
         _check_draft_vocab(cfg, draft_cfg)
@@ -532,8 +999,33 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         # yet written) replaces the greedy batcher's per-slot logits
         self.pending = jnp.zeros((batch,), jnp.int32)
 
-    def _admit(self, row: int, tokens) -> None:
-        self._rng, sub = jax.random.split(self._rng)
+    def _chunk_tokens_max(self) -> int:
+        # one sync = chunk rounds x up to k+1 commits per row
+        return self.chunk * (self.k + 1)
+
+    def _admit_rows(self, rows, toks, lens, keys) -> None:
+        # the seed draw takes stream position 0 of each admitted
+        # request's base key — one vmapped fold over the wave's
+        # ALREADY-marshalled keys (shared with the rebind scatter), not
+        # a second per-request derivation
+        seed_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys)
+        if self._prefix_template is not None:
+            self.cache, self.d_cache, self.pending = (
+                spec_prefix_admit_rows(
+                    self.params, self.draft_params, self.cache,
+                    self.d_cache, self.pending, rows,
+                    self._prefix_template, self._draft_prefix_template,
+                    toks, lens, seed_keys, self.cfg, self.draft_cfg,
+                    self.temperature, self.top_k, self.top_p))
+        else:
+            self.cache, self.d_cache, self.pending = spec_admit_rows(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                self.pending, rows, toks, lens, seed_keys, self.cfg,
+                self.draft_cfg, self.temperature, self.top_k, self.top_p)
+
+    def _admit_legacy(self, row, req, prompts) -> None:
+        tokens = jnp.asarray(prompts[req], jnp.int32)[None]
+        sub = jax.random.fold_in(self._req_key(req), 0)
         if self._prefix_template is not None:
             self.cache, self.d_cache, self.pending = spec_prefix_admit_row(
                 self.params, self.draft_params, self.cache, self.d_cache,
@@ -546,20 +1038,26 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                 self.pending, row, tokens, sub, self.cfg, self.draft_cfg,
                 self.temperature, self.top_k, self.top_p)
 
-    def _dispatch(self):
-        import numpy as np
-
-        self._rng, sub = jax.random.split(self._rng)
-        packed, self.cache, self.d_cache, self.pending = (
-            spec_step_rows(self.params, self.draft_params, self.cache,
-                           self.d_cache, self.pending, sub, self.chunk,
-                           self.cfg, self.draft_cfg, self.k,
-                           self.temperature, self.top_k, self.top_p))
+    def _issue(self):
+        with self.phase_times.phase("dispatch"):
+            offs = jnp.asarray(self._row_off, jnp.int32)
+            packed, self.cache, self.d_cache, self.pending = (
+                spec_step_rows(self.params, self.draft_params, self.cache,
+                               self.d_cache, self.pending, self._row_keys,
+                               offs, self.chunk, self.cfg, self.draft_cfg,
+                               self.k, self.temperature, self.top_k,
+                               self.top_p))
         self.rounds_executed += self.chunk
         self.steps_executed += self.chunk * (self.k + 1)
+        for r in range(self.batch):
+            self._row_off[r] += self.chunk
+        return packed
+
+    def _fetch(self, handle):
         # ONE host fetch per sync (see spec_step_rows: separate fetches
         # pay separate transport round trips)
-        packed = np.asarray(packed)                    # [n, B, k+2]
+        with self.phase_times.phase("fetch"):
+            packed = np.asarray(handle)                # [n, B, k+2]
         return [
             [int(t) for i in range(packed.shape[0])
              for t in packed[i, row, 1:1 + packed[i, row, 0]]]

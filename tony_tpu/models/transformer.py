@@ -92,9 +92,12 @@ class TransformerConfig:
     # wrapping modulo the capacity — serving/generation memory is
     # O(capacity) however long the stream runs. Requires attn_window > 0
     # (a full-causal query needs the whole history) and capacity >=
-    # attn_window. Greedy/sampled generate + continuous batching;
-    # speculative decoding, beam search, and shared-prefix templates
-    # keep the linear cache (models/decode.py rejects the combos).
+    # attn_window. Per-token read COST is O(capacity), not O(window)
+    # (the ring read is dense over all capacity rows) — size capacity
+    # near the window; init_kv_cache warns at >= 4x. Greedy/sampled
+    # generate + continuous batching; speculative decoding, beam
+    # search, and shared-prefix templates keep the linear cache
+    # (models/decode.py rejects the combos).
     kv_cache_capacity: int = 0
     # GPipe microbatch count when the mesh has a pp axis > 1 (forward routes
     # through parallel/pipeline.py automatically). 0 = auto: 2·pp if it
