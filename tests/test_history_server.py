@@ -376,6 +376,184 @@ def test_index_shows_uptime_column(tmp_path):
     assert "95.7%" in page
 
 
+# ---------------------------------------------------------------------------
+# Metrics plane: /metrics (live Prometheus) + /api/jobs/<id>/metrics (replay)
+# ---------------------------------------------------------------------------
+
+def _snapshot_wire(tokens=100, rss=64 << 20):
+    from tony_tpu.runtime import metrics as M
+    reg = M.MetricsRegistry()
+    reg.counter("tony_serve_tokens_total", help="useful generated tokens"
+                ).inc(tokens)
+    reg.gauge("tony_process_rss_bytes", help="resident set size").set(rss)
+    reg.histogram("tony_train_step_seconds", help="step wall",
+                  buckets=(0.1, 1.0)).observe(0.5)
+    return reg.to_wire()
+
+
+def _wait_for(predicate, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _check_exposition(text):
+    """Prometheus text-format sanity: every TYPE appears once, every
+    sample line is `name{labels} value` with a numeric value, and no
+    series repeats."""
+    types, series = {}, []
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            assert kind in ("counter", "gauge", "histogram")
+            types[name] = kind
+        elif line.startswith("# HELP ") or not line.strip():
+            continue
+        else:
+            series.append(line)
+            float(line.rpartition(" ")[2])
+    keys = [s.rpartition(" ")[0] for s in series]
+    assert len(set(keys)) == len(keys), "duplicate series"
+    return types, series
+
+
+def test_metrics_route_live_then_replay(server, dirs):
+    """A RUNNING job's heartbeat-shipped snapshots are served live on
+    /metrics (from the flushed .inprogress jhist) and, once the job
+    finishes, /api/jobs/<id>/metrics reconstructs the same series purely
+    from the METRICS_SNAPSHOT events."""
+    from tony_tpu.events import events as ev
+    app = "application_m_0001"
+    handler = EventHandler(dirs.intermediate, app, "alice")
+    handler.start()
+    handler.emit(ev.APPLICATION_INITED, app_id=app, num_tasks=1, host="h")
+    wire_w0 = _snapshot_wire(tokens=100)
+    wire_am = _snapshot_wire(tokens=0, rss=32 << 20)
+    handler.emit(ev.METRICS_SNAPSHOT, tasks={"worker:0": wire_w0},
+                 session_id=0)
+    final_tasks = {"worker:0": _snapshot_wire(tokens=250),
+                   "am:0": wire_am}
+    handler.emit(ev.METRICS_SNAPSHOT, tasks=final_tasks, session_id=0)
+    # the async writer flushes per event — wait until all three landed
+    inprog = handler._inprogress_path
+    assert _wait_for(lambda: os.path.exists(inprog) and
+                     open(inprog).read().count("METRICS_SNAPSHOT") == 2)
+
+    status, text = _get(server, "/metrics")
+    assert status == 200
+    types, series = _check_exposition(text)
+    assert types["tony_serve_tokens_total"] == "counter"
+    assert types["tony_train_step_seconds"] == "histogram"
+    assert (f'tony_serve_tokens_total{{job="{app}",task="worker:0"}} 250'
+            in text)
+    assert f'tony_process_rss_bytes{{job="{app}",task="am:0"}}' in text
+    assert f'tony_train_step_seconds_bucket{{job="{app}",le="+Inf"' in text
+    assert 'tony_history_jobs{state="running"} 1' in text
+
+    # finish the job; replay must reconstruct the SAME series from jhist
+    handler.stop("SUCCEEDED")
+    server.metadata_cache.invalidate_all()
+    server.events_cache.invalidate_all()
+    status, body = _get(server, f"/api/jobs/{app}/metrics")
+    assert status == 200
+    m = json.loads(body)
+    assert m["snapshot_count"] == 2
+    assert m["tasks"] == final_tasks          # latest snapshot, bit-exact
+    assert m["snapshots"][0]["tasks"] == {"worker:0": wire_w0}
+    # a finished job no longer exports live series
+    _, text = _get(server, "/metrics")
+    assert "tony_serve_tokens_total" not in text
+    assert 'tony_history_jobs{state="finished"} 1' in text
+
+
+def test_job_page_renders_metrics_section(server, dirs):
+    from tony_tpu.events import events as ev
+    app = "application_m_0002"
+    handler = EventHandler(dirs.intermediate, app, "alice")
+    handler.start()
+    handler.emit(ev.APPLICATION_INITED, app_id=app, num_tasks=1, host="h")
+    handler.emit(ev.METRICS_SNAPSHOT,
+                 tasks={"worker:0": _snapshot_wire(tokens=42)},
+                 session_id=0)
+    handler.emit(ev.APPLICATION_FINISHED, app_id=app, status="SUCCEEDED")
+    handler.stop("SUCCEEDED")
+    _, body = _get(server, f"/jobs/{app}")
+    page = _parse(body)
+    # events table (snapshot rows excluded from the timeline) + metrics
+    assert len(page.tables) == 2
+    event_rows = [r[1] for r in page.tables[0][1:]]
+    assert "METRICS_SNAPSHOT" not in event_rows
+    header, *rows = page.tables[1]
+    assert header == ["Task", "Metric", "Labels", "Value"]
+    by_metric = {(r[0], r[1]): r[3] for r in rows}
+    assert by_metric[("worker:0", "tony_serve_tokens_total")] == "42"
+
+
+def test_job_metrics_replay_capped(server, dirs):
+    """The JSON replay truncates to the newest MAX_METRICS_SNAPSHOTS
+    while snapshot_count reports the untruncated total and `tasks` stays
+    the LATEST snapshot."""
+    from tony_tpu.events import events as ev
+    app = "application_m_0005"
+    handler = EventHandler(dirs.intermediate, app, "alice")
+    handler.start()
+    for i in range(5):
+        handler.emit(ev.METRICS_SNAPSHOT,
+                     tasks={"worker:0": _snapshot_wire(tokens=i)},
+                     session_id=0)
+    handler.stop("SUCCEEDED")
+    server.MAX_METRICS_SNAPSHOTS = 3
+    try:
+        _, body = _get(server, f"/api/jobs/{app}/metrics")
+    finally:
+        del server.MAX_METRICS_SNAPSHOTS     # restore class default
+    m = json.loads(body)
+    assert m["snapshot_count"] == 5
+    assert len(m["snapshots"]) == 3
+    counters = {n: v for n, _, v in m["tasks"]["worker:0"]["c"]}
+    assert counters["tony_serve_tokens_total"] == 4     # the latest
+    # the kept window is the NEWEST three, oldest-first
+    kept = [dict((n, v) for n, _, v in s["tasks"]["worker:0"]["c"])
+            ["tony_serve_tokens_total"] for s in m["snapshots"]]
+    assert kept == [2, 3, 4]
+
+
+def test_job_metrics_api_no_snapshots_and_404(server, dirs):
+    _write_job(dirs.intermediate, "application_m_0003")
+    status, body = _get(server, "/api/jobs/application_m_0003/metrics")
+    assert status == 200
+    m = json.loads(body)
+    assert m["snapshot_count"] == 0 and m["tasks"] == {}
+    with pytest.raises(urllib.error.HTTPError) as exc:
+        _get(server, "/api/jobs/no_such_app/metrics")
+    assert exc.value.code == 404
+
+
+def test_metrics_route_skips_malformed_snapshots(server, dirs):
+    """A corrupted snapshot in the event stream must not 500 /metrics —
+    the bad task is skipped, good tasks still render."""
+    from tony_tpu.events import events as ev
+    app = "application_m_0004"
+    handler = EventHandler(dirs.intermediate, app, "bob")
+    handler.start()
+    handler.emit(ev.METRICS_SNAPSHOT,
+                 tasks={"worker:0": {"c": "corrupt"},
+                        "worker:1": _snapshot_wire(tokens=9)},
+                 session_id=0)
+    inprog = handler._inprogress_path
+    assert _wait_for(lambda: os.path.exists(inprog) and
+                     "METRICS_SNAPSHOT" in open(inprog).read())
+    status, text = _get(server, "/metrics")
+    assert status == 200
+    _check_exposition(text)
+    assert 'task="worker:1"' in text and 'task="worker:0"' not in text
+    handler.stop("FAILED")
+
+
 def test_bearer_token_auth(dirs, tmp_path):
     """With a token configured, every route except /healthz needs
     `Authorization: Bearer <token>`; wrong/missing tokens get 401."""
@@ -400,6 +578,7 @@ def test_bearer_token_auth(dirs, tmp_path):
                 return e.code
         assert status("/") == 401
         assert status("/api/jobs") == 401
+        assert status("/metrics") == 401          # scrapes need the token
         assert status("/api/jobs", token="wrong") == 401
         assert status("/healthz") == 200          # probes stay open
         assert status("/", token="s3cret") == 200

@@ -122,6 +122,130 @@ def test_event_handler_roundtrip(tmp_path):
     assert find_job_files(str(tmp_path)) == [final]
 
 
+def test_emit_after_stop_drops_with_warning(tmp_path, caplog):
+    """emit() after stop() used to enqueue silently into a dead queue —
+    the event vanished with no trace. It must now warn and drop, and the
+    final file must not grow."""
+    import logging
+    h = EventHandler(str(tmp_path), "app_10", "bob")
+    h.start()
+    h.emit("APPLICATION_INITED", app_id="app_10")
+    final = h.stop("SUCCEEDED")
+    size = os.path.getsize(final)
+    with caplog.at_level(logging.WARNING, logger="tony_tpu.events.events"):
+        h.emit("TASK_FINISHED", task="worker:0", exit_code=0)
+    assert any("after stop()" in r.message for r in caplog.records)
+    assert os.path.getsize(final) == size
+    assert [e.event_type for e in parse_events(final)] == [
+        "APPLICATION_INITED"]
+
+
+def test_stop_is_idempotent(tmp_path):
+    h = EventHandler(str(tmp_path), "app_11", "bob")
+    h.start()
+    h.emit("APPLICATION_INITED", app_id="app_11")
+    first = h.stop("SUCCEEDED")
+    second = h.stop("FAILED")           # second verdict must not re-rename
+    assert first == second == h.final_path
+    assert os.path.exists(first)
+    assert len(os.listdir(tmp_path)) == 1
+
+
+def test_stop_retryable_after_failed_rename(tmp_path):
+    """A transient storage error during stop()'s rename must not latch
+    the handler as finished: emits stay refused, but a retried stop()
+    re-attempts the move instead of returning a path that was never
+    created."""
+    h = EventHandler(str(tmp_path), "app_12", "bob")
+    h.start()
+    h.emit("APPLICATION_INITED", app_id="app_12")
+    real_move = h._storage.move
+    calls = []
+
+    def flaky_move(src, dst):
+        calls.append(dst)
+        if len(calls) == 1:
+            raise OSError("transient backend flake")
+        return real_move(src, dst)
+
+    h._storage.move = flaky_move
+    try:
+        import pytest
+        with pytest.raises(OSError):
+            h.stop("SUCCEEDED")
+        assert h.final_path is None           # nothing reported as final
+        h.emit("TASK_FINISHED", task="w:0")   # still refused (closed)
+        final = h.stop("SUCCEEDED")           # retry re-attempts the move
+    finally:
+        h._storage.move = real_move
+    assert os.path.exists(final) and final.endswith(".jhist")
+    assert len(calls) == 2
+
+
+def test_jhist_filename_codec_fuzz():
+    """Fuzz the filename codec over hyphenated/digit-leading users and
+    every completed/status/in-progress combination: round-trip
+    history_file_name → from_file_name must reproduce the metadata, with
+    the ONE documented ambiguity rule (a trailing all-digit token smaller
+    than started_ms is part of the user, not a completed_ms — completion
+    cannot precede start)."""
+    import random
+    rng = random.Random(0xC0DEC)
+    letters = "abcdefghijklmnopqrstuvwxyz"
+    statuses = [None, "SUCCEEDED", "FAILED", "KILLED", "RUNNING"]
+
+    def rand_user():
+        # segments joined by hyphens; digit-leading allowed, and at least
+        # one letter somewhere (an ALL-digit user is inherently ambiguous
+        # with completed_ms in this reference-inherited codec)
+        segs = []
+        for _ in range(rng.randint(1, 4)):
+            seg = "".join(rng.choice(letters + "0123456789_")
+                          for _ in range(rng.randint(1, 6)))
+            segs.append(seg)
+        user = "-".join(segs)
+        if not any(ch in letters for ch in user):
+            user += rng.choice(letters)
+        return user
+
+    for trial in range(500):
+        app_id = f"application_{rng.randint(1, 10**13)}_{rng.randint(0, 9999):04d}"
+        started = rng.randint(1_600_000_000_000, 1_900_000_000_000)
+        completed = (started + rng.randint(0, 10**9)
+                     if rng.random() < 0.5 else None)
+        status = rng.choice(statuses)
+        in_progress = completed is None and rng.random() < 0.5
+        user = rand_user()
+        name = history_file_name(app_id, started, user,
+                                 completed_ms=completed, status=status,
+                                 in_progress=in_progress)
+        md = JobMetadata.from_file_name(name)
+        assert md is not None, name
+        assert (md.app_id, md.started_ms, md.user, md.completed_ms,
+                md.status, md.in_progress) == \
+            (app_id, started, user, completed, status, in_progress), name
+        assert is_valid_history_file_name(name)
+
+
+def test_jhist_codec_digit_leading_user_all_variants():
+    """The documented disambiguation pins digit-leading users in every
+    (completed, status, inprogress) shape — including the regression
+    shapes of the original fix."""
+    # (a PURELY numeric user like "7" is excluded: with a status token
+    # and no completed_ms it is inherently ambiguous with completed_ms
+    # in this reference-inherited codec — the documented limitation)
+    for user in ("007-james", "99-44-x", "4dmin-2", "7x"):
+        for completed in (None, 1_700_000_000_999):
+            for status in (None, "SUCCEEDED"):
+                name = history_file_name(
+                    "application_1_2", 1_700_000_000_000, user,
+                    completed_ms=completed, status=status)
+                md = JobMetadata.from_file_name(name)
+                assert md is not None, name
+                assert (md.user, md.completed_ms, md.status) == \
+                    (user, completed, status), name
+
+
 def test_parse_skips_malformed_lines(tmp_path):
     p = tmp_path / "a-1-2-u-SUCCEEDED.jhist"
     p.write_text('{"event_type": "X", "payload": {}, "timestamp": 1}\n'

@@ -1,0 +1,313 @@
+"""Metrics-plane unit tests: registry semantics, wire codec, Prometheus
+rendering, the coordinator-side snapshot table, and the PhaseTimes bridge
+(tony_tpu/runtime/metrics.py)."""
+
+import json
+import threading
+
+import pytest
+
+from tony_tpu.runtime import metrics as M
+from tony_tpu.runtime.profiler import PhaseTimes
+
+
+# ---------------------------------------------------------------------------
+# Instruments
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    reg = M.MetricsRegistry()
+    c = reg.counter("reqs_total", help="requests")
+    c.inc()
+    c.inc(2.5)
+    assert c.value == 3.5
+    g = reg.gauge("depth")
+    g.set(7)
+    g.inc(-2)
+    assert g.value == 5.0
+    h = reg.histogram("lat_seconds", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 5.0, 100.0):
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(105.65)
+    # le semantics: value == bound counts in that bound's bucket
+    assert h.cumulative() == [2, 3, 4, 5]
+
+
+def test_get_or_create_returns_same_instrument():
+    reg = M.MetricsRegistry()
+    assert reg.counter("a") is reg.counter("a")
+    assert reg.counter("a", phase="x") is reg.counter("a", phase="x")
+    assert reg.counter("a", phase="x") is not reg.counter("a", phase="y")
+
+
+def test_kind_conflict_rejected():
+    reg = M.MetricsRegistry()
+    reg.counter("x_total")
+    with pytest.raises(ValueError, match="already registered"):
+        reg.gauge("x_total")
+
+
+def test_histogram_requires_buckets():
+    reg = M.MetricsRegistry()
+    with pytest.raises(ValueError, match="bucket"):
+        reg.histogram("h", buckets=())
+
+
+def test_concurrent_get_or_create_single_instrument():
+    reg = M.MetricsRegistry()
+    seen = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        c = reg.counter("shared_total")
+        for _ in range(1000):
+            c.inc()
+        seen.append(c)
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len({id(c) for c in seen}) == 1
+    # per-instrument lock in inc(): concurrent writers lose no updates
+    assert reg.counter("shared_total").value == 8000.0
+
+
+# ---------------------------------------------------------------------------
+# Wire codec
+# ---------------------------------------------------------------------------
+
+def _populated_registry():
+    reg = M.MetricsRegistry()
+    reg.counter("tok_total", help="tokens", task="worker:0").inc(42)
+    reg.gauge("rss_bytes").set(1234.5)
+    h = reg.histogram("step_seconds", buckets=(0.5, 1.0), phase="fit")
+    h.observe(0.2)
+    h.observe(3.0)
+    return reg
+
+
+def test_wire_round_trip_bit_exact():
+    reg = _populated_registry()
+    encoded = reg.to_wire_json()
+    decoded = M.from_wire_json(encoded)
+    assert decoded == reg.to_wire()
+    assert json.dumps(decoded, separators=(",", ":")) == encoded
+
+
+@pytest.mark.parametrize("bad", [
+    "not json",
+    '"a string"',
+    "[]",
+    '{"c": 7}',
+    '{"c": [["only-two", {}]]}',
+    '{"c": [["x", "not-labels", 1]]}',
+    '{"c": [["x", {}, "not-a-number"]]}',
+    '{"h": [["x", {}, 5]]}',
+    '{"h": [["x", {}, {"b": [1], "n": [1], "s": 0, "c": 0}]]}',  # n != b+1
+    '{"m": []}',
+    # Prometheus-corruption vectors: anything passing validate_wire must
+    # render cleanly, so illegal names/keys and non-finite values reject
+    '{"c": [["bad name", {}, 1]]}',
+    '{"c": [["x\\ny", {}, 1]]}',
+    '{"c": [["x", {"bad-key": "v"}, 1]]}',
+    '{"c": [["x", {"k": [1]}, 1]]}',
+    '{"c": [["x", {}, NaN]]}',
+    '{"g": [["x", {}, Infinity]]}',
+    '{"h": [["x", {}, {"b": [2.0, 1.0], "n": [0, 0, 0], "s": 0, "c": 0}]]}',
+    # missing "s" must be ValueError, never a KeyError escaping ingest
+    '{"h": [["x", {}, {"b": [0.1], "n": [0, 0], "c": 0}]]}',
+    '{"h": [["x", {}, {"b": [0.1], "n": [0, 0], "s": 0.0, "c": true}]]}',
+    '{"h": [["x", {}, {"b": [0.1], "n": [0, 0], "s": 0.0, "c": -1}]]}',
+    # meta values must be string sequences — series_from_wire indexes them
+    '{"c": [["x", {}, 1]], "m": {"x": 5}}',
+    '{"c": [["x", {}, 1]], "m": {"x": []}}',
+    '{"c": [["x", {}, 1]], "m": {"x": [3, 4]}}',
+])
+def test_malformed_wire_rejected(bad):
+    with pytest.raises(ValueError):
+        M.from_wire_json(bad)
+
+
+def test_snapshot_table_ingest_survives_garbage():
+    table = M.SnapshotTable()
+    good = M.MetricsRegistry()
+    good.counter("x_total").inc(3)
+    assert table.ingest("worker:0", good.to_wire_json())
+    for garbage in ("}{", "null", '{"g": {}}', 17, None, b"bytes"):
+        assert table.ingest("worker:0", garbage) is False
+    assert table.rejected == 6
+    assert table.get("worker:0")["c"] == [["x_total", {}, 3.0]]
+    # histogram with well-typed SHAPE but poisoned elements must also
+    # be rejected — these would crash the Prometheus renderer
+    assert table.ingest("worker:0", json.dumps(
+        {"c": [], "g": [],
+         "h": [["x", {}, {"b": ["bad"], "n": [1, 2], "s": 0, "c": 0}]],
+         "m": {}})) is False
+    assert table.ingest("worker:0", json.dumps(
+        {"c": [], "g": [],
+         "h": [["x", {}, {"b": [1.0], "n": [1, "x"], "s": 0, "c": 0}]],
+         "m": {}})) is False
+    table.clear()
+    assert table.tasks() == []
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition
+# ---------------------------------------------------------------------------
+
+def _parse_exposition(text):
+    """Minimal format checker: returns ({name: type}, {series_line})."""
+    types, series = {}, []
+    for line in text.splitlines():
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = kind
+        elif line.startswith("# HELP ") or not line.strip():
+            continue
+        else:
+            series.append(line)
+            name_labels, _, value = line.rpartition(" ")
+            float(value)                       # every sample is numeric
+    assert len(set(s.rpartition(" ")[0] for s in series)) == len(series), \
+        "duplicate series in exposition"
+    return types, series
+
+
+def test_render_prometheus_valid_exposition():
+    reg = _populated_registry()
+    text = M.render_registry(reg, extra_labels={"job": "app_1"})
+    types, series = _parse_exposition(text)
+    assert types == {"tok_total": "counter", "rss_bytes": "gauge",
+                     "step_seconds": "histogram"}
+    assert "# HELP tok_total tokens" in text
+    assert 'tok_total{job="app_1",task="worker:0"} 42' in text
+    assert 'rss_bytes{job="app_1"} 1234.5' in text
+    # histogram expands to cumulative buckets + sum + count
+    assert 'step_seconds_bucket{job="app_1",le="0.5",phase="fit"} 1' in text
+    assert 'step_seconds_bucket{job="app_1",le="1",phase="fit"} 1' in text
+    assert 'step_seconds_bucket{job="app_1",le="+Inf",phase="fit"} 2' in text
+    assert 'step_seconds_sum{job="app_1",phase="fit"} 3.2' in text
+    assert 'step_seconds_count{job="app_1",phase="fit"} 2' in text
+
+
+def test_render_prometheus_dedupes_and_escapes():
+    entries = [
+        ("counter", "c_total", {"t": 'a"b\n'}, 1.0, ""),
+        ("counter", "c_total", {"t": 'a"b\n'}, 2.0, ""),   # dup: last wins
+    ]
+    text = M.render_prometheus(entries)
+    assert text.count("c_total{") == 1
+    assert 'c_total{t="a\\"b\\n"} 2' in text
+
+
+def test_render_prometheus_empty():
+    assert M.render_prometheus([]) == ""
+
+
+# ---------------------------------------------------------------------------
+# Bridges + default registry
+# ---------------------------------------------------------------------------
+
+def test_observe_phase_times_bridge_accumulates():
+    reg = M.MetricsRegistry()
+    pt = PhaseTimes()
+    with pt.phase("fetch"):
+        pass
+    with pt.phase("fetch"):
+        pass
+    with pt.phase("admit"):
+        pass
+    M.observe_phase_times(pt, reg)
+    assert reg.counter("tony_serve_phase_ops_total", phase="fetch").value == 2
+    assert reg.counter("tony_serve_phase_ops_total", phase="admit").value == 1
+    # a second serve() call's fold ADDS (monotonic counters)
+    M.observe_phase_times(pt, reg)
+    assert reg.counter("tony_serve_phase_ops_total", phase="fetch").value == 4
+    assert reg.counter("tony_serve_phase_seconds_total",
+                       phase="fetch").value >= 0.0
+
+
+def test_sample_host_stats_populates_gauges():
+    reg = M.MetricsRegistry()
+    M.sample_host_stats(reg)
+    wire = reg.to_wire()
+    names = {name for name, _, _ in wire["g"]}
+    assert "tony_process_uptime_seconds" in names
+    # /proc exists on the CI image: rss + cpu should land too
+    assert "tony_process_rss_bytes" in names
+    assert "tony_process_cpu_seconds" in names
+    rss = dict((n, v) for n, _, v in wire["g"])["tony_process_rss_bytes"]
+    assert rss > 1 << 20                      # a python process is > 1 MiB
+
+
+def test_default_registry_swap_restores():
+    prev = M.set_default(M.NullRegistry())
+    try:
+        null = M.get_default()
+        null.counter("anything").inc()
+        null.histogram("h").observe(1.0)
+        assert null.to_wire() == {"c": [], "g": [], "h": [], "m": {}}
+    finally:
+        M.set_default(prev)
+    assert M.get_default() is prev
+
+
+def test_serve_loop_observes_into_registry():
+    """The continuous batcher's instrumentation lands admitted/retired/
+    token counters and the PhaseTimes fold in the default registry."""
+    jax = pytest.importorskip("jax")
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+
+    cfg = T.PRESETS["tiny"]
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    reg = M.MetricsRegistry()
+    prev = M.set_default(reg)
+    try:
+        b = ContinuousBatcher(params, cfg, batch=2, max_len=48, chunk=4)
+        prompts = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        outs = b.serve(prompts, [6, 4, 5])
+    finally:
+        M.set_default(prev)
+    assert [len(o) for o in outs] == [6, 4, 5]
+    assert reg.counter("tony_serve_requests_admitted_total").value == 3
+    assert reg.counter("tony_serve_requests_retired_total").value == 3
+    assert reg.counter("tony_serve_tokens_total").value == 15
+    assert reg.gauge("tony_serve_queue_depth").value == 0
+    assert reg.counter("tony_serve_phase_ops_total", phase="fetch").value > 0
+    assert reg.counter("tony_serve_phase_seconds_total",
+                       phase="dispatch").value > 0
+
+
+def test_train_step_observes_into_registry():
+    jax = pytest.importorskip("jax")
+    import jax.numpy as jnp
+    from tony_tpu.models.train import (default_optimizer, init_state,
+                                       make_train_step)
+
+    # toy quadratic model: the test targets the step instrumentation,
+    # not the transformer (whose own path test_serve/test_parallel cover)
+    params = {"w": jnp.ones((4,), jnp.float32)}
+    opt = default_optimizer(lr=1e-2)
+    state = init_state(params, opt)
+    step = make_train_step(
+        lambda p, b: jnp.mean((b["x"] @ p["w"] - b["y"]) ** 2), opt)
+    batch = {"x": jnp.ones((2, 4), jnp.float32),
+             "y": jnp.zeros((2,), jnp.float32)}
+    reg = M.MetricsRegistry()
+    prev = M.set_default(reg)
+    try:
+        for _ in range(3):
+            state, m = step(state, batch)
+        float(m["loss"])
+    finally:
+        M.set_default(prev)
+    assert reg.counter("tony_train_steps_total").value == 3
+    assert reg.counter("tony_train_examples_total").value == 6
+    h = reg.histogram("tony_train_step_seconds")
+    assert h.count == 3 and h.sum > 0

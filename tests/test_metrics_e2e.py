@@ -1,0 +1,159 @@
+"""End-to-end metrics plane: a real local-backend job ships per-task
+registry snapshots over heartbeats, the coordinator folds them into
+METRICS_SNAPSHOT jhist events, and the history server exports them —
+live Prometheus text while the job RUNS, JSON replay after it finishes
+(the acceptance path of the metrics-plane issue)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events import events as ev
+from tony_tpu.history.server import HistoryServer
+from tony_tpu.runtime import metrics as M
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+PY = sys.executable
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _latest_snapshot_from_jhist(hist_dir):
+    """(path, last METRICS_SNAPSHOT event) across every jhist/inprogress
+    file under hist_dir, or (None, None)."""
+    for path in sorted(ev.find_job_files(hist_dir), reverse=True):
+        events = ev.parse_events(path)
+        snaps = [e for e in events
+                 if e.event_type == ev.METRICS_SNAPSHOT]
+        if snaps:
+            return path, snaps[-1]
+    return None, None
+
+
+@pytest.mark.e2e
+def test_metrics_plane_end_to_end(tmp_path):
+    hist = str(tmp_path / "tony-history")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": hist,
+        "tony.application.timeout": "60000",
+        "tony.worker.instances": "1",
+        "tony.task.heartbeat-interval-ms": "100",
+        "tony.metrics.snapshot-interval-ms": "300",
+    })
+    client = TonyClient(
+        conf, f"{PY} {os.path.join(FIXTURES, 'sleep_briefly.py')} 4")
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.run()))
+    t.start()
+    server = None
+    try:
+        # wait until the coordinator's .inprogress stream carries a
+        # snapshot with the worker's heartbeat-shipped gauges
+        intermediate = os.path.join(hist, "intermediate")
+        deadline = time.monotonic() + 45
+        snap = None
+        while time.monotonic() < deadline and t.is_alive():
+            if os.path.isdir(intermediate):
+                _, snap = _latest_snapshot_from_jhist(intermediate)
+                if snap and "worker:0" in snap.payload.get("tasks", {}):
+                    break
+                snap = None
+            time.sleep(0.1)
+        assert snap is not None, "no METRICS_SNAPSHOT with worker:0 " \
+                                 "appeared while the job ran"
+
+        # LIVE export: /metrics renders the running job's per-task series
+        server = HistoryServer(TonyConfig({
+            "tony.history.location": hist}), port=0)
+        server.start()
+        status, text = _get(server.port, "/metrics")
+        assert status == 200
+        app_id = client.app_id
+        assert (f'tony_process_rss_bytes{{job="{app_id}",'
+                f'task="worker:0"}}' in text)
+        assert (f'tony_executor_uptime_seconds{{job="{app_id}",'
+                f'task="worker:0"}}' in text)
+        assert "# TYPE tony_process_rss_bytes gauge" in text
+        assert 'tony_history_jobs{state="running"} 1' in text
+        # valid exposition: numeric samples, no duplicate series
+        samples = [ln for ln in text.splitlines()
+                   if ln.strip() and not ln.startswith("#")]
+        for ln in samples:
+            float(ln.rpartition(" ")[2])
+        keys = [ln.rpartition(" ")[0] for ln in samples]
+        assert len(set(keys)) == len(keys)
+    finally:
+        t.join(timeout=90)
+        if server is not None:
+            server.stop()
+    assert result.get("code") == 0
+
+    # REPLAY: after the job finished, a fresh server reconstructs the
+    # same series purely from METRICS_SNAPSHOT events in the jhist.
+    jhist_path, final_snap = _latest_snapshot_from_jhist(hist)
+    assert jhist_path is not None and jhist_path.endswith(".jhist")
+    server2 = HistoryServer(TonyConfig({
+        "tony.history.location": hist}), port=0)
+    server2.start()
+    try:
+        status, body = _get(server2.port, f"/api/jobs/{client.app_id}/metrics")
+        assert status == 200
+        m = json.loads(body)
+        assert m["snapshot_count"] >= 1
+        # identical to what the jhist holds — the replay IS the jhist
+        assert m["tasks"] == final_snap.payload["tasks"]
+        worker = m["tasks"]["worker:0"]
+        M.validate_wire(worker)
+        gauges = {name: value for name, _, value in worker["g"]}
+        assert gauges["tony_process_rss_bytes"] > 1 << 20
+        assert gauges["tony_executor_uptime_seconds"] > 0
+        assert "tony_process_cpu_seconds" in gauges
+        # the executor's final beat shipped the child exit-code counter
+        counters = {(name, tuple(sorted(labels.items()))): value
+                    for name, labels, value in worker["c"]}
+        assert counters[("tony_executor_child_exits_total",
+                         (("code", "0"),))] == 1
+        # the coordinator's own registry rode along as pseudo-task am:0
+        assert "am:0" in m["tasks"]
+        # finished job: no live series on /metrics anymore
+        _, text = _get(server2.port, "/metrics")
+        assert 'task="worker:0"' not in text
+        assert 'tony_history_jobs{state="finished"} 1' in text
+    finally:
+        server2.stop()
+
+
+def test_heartbeater_without_provider_sends_old_style(monkeypatch):
+    """A Heartbeater with no snapshot provider (the pre-metrics shape)
+    sends metrics-less beats — and a provider that RAISES costs a beat
+    nothing (the snapshot collapses to \"\" instead of failing the
+    ping). Liveness never depends on the piggyback."""
+    from tony_tpu.cluster.executor import Heartbeater
+
+    class FakeRpc:
+        def __init__(self):
+            self.calls = []
+
+        def task_executor_heartbeat(self, task_id, metrics=""):
+            self.calls.append((task_id, metrics))
+            return ""
+
+    rpc = FakeRpc()
+    hb = Heartbeater(rpc, "worker:0", interval_s=0.01)
+    assert hb._snapshot() == ""
+    hb.snapshot_fn = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    assert hb._snapshot() == ""               # provider error → plain beat
+    hb.snapshot_fn = lambda: '{"c":[],"g":[],"h":[],"m":{}}'
+    assert hb._snapshot() == '{"c":[],"g":[],"h":[],"m":{}}'

@@ -5,6 +5,7 @@ import os
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from tony_tpu import constants
 from tony_tpu.runtime import profiler
@@ -25,6 +26,46 @@ def test_profile_dir_per_task(monkeypatch):
 def test_maybe_start_disabled(monkeypatch):
     monkeypatch.delenv(constants.TONY_PROFILE_ENABLED, raising=False)
     assert profiler.maybe_start() is False
+
+
+class TestMaybeStartReportsLiveness:
+    """maybe_start() must return whether the profiler server is actually
+    LIVE — not merely that profiling was requested (the old behavior
+    returned True with no TB_PORT and even when start_server raised)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_latch(self):
+        profiler._reset_server_state_for_tests()
+        yield
+        profiler._reset_server_state_for_tests()
+
+    def test_no_tb_port_returns_false(self, monkeypatch):
+        monkeypatch.setenv(constants.TONY_PROFILE_ENABLED, "true")
+        monkeypatch.delenv(constants.TB_PORT, raising=False)
+        assert profiler.maybe_start() is False
+        monkeypatch.setenv(constants.TB_PORT, "0")
+        assert profiler.maybe_start() is False
+        monkeypatch.setenv(constants.TB_PORT, "")     # exported but empty
+        assert profiler.maybe_start() is False
+
+    def test_server_start_failure_returns_false(self, monkeypatch):
+        monkeypatch.setenv(constants.TONY_PROFILE_ENABLED, "true")
+        monkeypatch.setenv(constants.TB_PORT, "12345")
+        monkeypatch.setattr(
+            jax.profiler, "start_server",
+            lambda port: (_ for _ in ()).throw(RuntimeError("boom")))
+        assert profiler.maybe_start() is False
+        assert profiler._server_started is False      # retryable next call
+
+    def test_server_start_success_returns_true_and_latches(
+            self, monkeypatch):
+        started = []
+        monkeypatch.setenv(constants.TONY_PROFILE_ENABLED, "true")
+        monkeypatch.setenv(constants.TB_PORT, "12345")
+        monkeypatch.setattr(jax.profiler, "start_server", started.append)
+        assert profiler.maybe_start() is True
+        assert profiler.maybe_start() is True         # idempotent
+        assert started == [12345]                     # started exactly once
 
 
 def test_trace_writes_capture(tmp_path, monkeypatch):

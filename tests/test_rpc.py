@@ -22,6 +22,7 @@ class FakeImpl(ApplicationRpc):
         self.expected = expected
         self.registered = {}
         self.heartbeats = []
+        self.heartbeat_snapshots = []   # the piggybacked metrics strings
         self.results = []
         self.tb_url = None
         self.finished = False
@@ -59,8 +60,9 @@ class FakeImpl(ApplicationRpc):
         self.finished = True
         return "SUCCEEDED"
 
-    def task_executor_heartbeat(self, task_id):
+    def task_executor_heartbeat(self, task_id, metrics=""):
         self.heartbeats.append(task_id)
+        self.heartbeat_snapshots.append(metrics)
 
     def get_application_status(self):
         return ApplicationStatus(
@@ -143,6 +145,97 @@ def test_singleton_per_address(server):
     b = ApplicationRpcClient.get_instance(f"localhost:{srv.port}")
     assert a is b
     a.close()
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat metrics piggyback (the TaskMonitor/MetricsRpc analog riding
+# the existing beat)
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatMetricsPiggyback:
+    def test_old_style_heartbeat_still_accepted(self, server):
+        """An executor sending NO snapshot (the pre-metrics client call
+        shape AND a raw wire message without the field) must keep
+        working end to end through rpc/server.py + rpc/client.py."""
+        impl, srv = server
+        client = ApplicationRpcClient(f"localhost:{srv.port}")
+        client.task_executor_heartbeat("worker:0")          # old call shape
+        assert impl.heartbeats == ["worker:0"]
+        assert impl.heartbeat_snapshots == [""]
+        client.close()
+
+    def test_wire_message_without_metrics_field(self, server):
+        """A HeartbeatRequest serialized WITHOUT the metrics field (what
+        an old binary puts on the wire) deserializes server-side with
+        the proto3 default and is handled normally."""
+        import grpc
+        from tony_tpu.rpc import tony_pb2 as pb
+        from tony_tpu.rpc.server import SERVICE_NAME
+        impl, srv = server
+        # serialize only field 1, exactly like the old message definition
+        raw = pb.HeartbeatRequest(task_id="worker:1").SerializeToString()
+        assert b"metrics" not in raw
+        channel = grpc.insecure_channel(f"localhost:{srv.port}")
+        stub = channel.unary_unary(
+            f"/{SERVICE_NAME}/TaskExecutorHeartbeat",
+            request_serializer=lambda m: m,
+            response_deserializer=pb.HeartbeatResponse.FromString)
+        stub(raw, timeout=10.0)
+        channel.close()
+        assert impl.heartbeats == ["worker:1"]
+        assert impl.heartbeat_snapshots == [""]
+
+    def test_snapshot_round_trips_bit_exact(self, server):
+        """The piggybacked registry snapshot must arrive byte-identical
+        and decode back to the same wire dict."""
+        from tony_tpu.runtime import metrics as M
+        impl, srv = server
+        reg = M.MetricsRegistry()
+        reg.counter("tony_serve_tokens_total",
+                    help="useful generated tokens").inc(123)
+        reg.gauge("tony_process_rss_bytes", help="rss").set(4096.5)
+        h = reg.histogram("tony_train_step_seconds", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(2.0)
+        payload = reg.to_wire_json()
+        client = ApplicationRpcClient(f"localhost:{srv.port}")
+        client.task_executor_heartbeat("worker:0", payload)
+        client.close()
+        assert impl.heartbeat_snapshots == [payload]        # bit-exact
+        decoded = M.from_wire_json(impl.heartbeat_snapshots[0])
+        assert decoded == reg.to_wire()
+        # and the decoded snapshot re-encodes to the identical string
+        import json
+        assert json.dumps(decoded, separators=(",", ":")) == payload
+
+    def test_malformed_snapshot_never_kills_coordinator_handler(
+            self, tmp_path, monkeypatch):
+        """Garbage metrics on the heartbeat must neither raise out of the
+        coordinator's handler nor poison a previously-good snapshot."""
+        monkeypatch.chdir(tmp_path)
+        from tony_tpu.cluster.coordinator import Coordinator, CoordinatorRpc
+        from tony_tpu.conf.config import TonyConfig
+        conf = TonyConfig({
+            "tony.worker.instances": "1",
+            "tony.history.location": str(tmp_path / "hist")})
+        co = Coordinator(conf, "application_rpc_metrics", str(tmp_path))
+        try:
+            rpc = CoordinatorRpc(co)
+            good = ('{"c":[["tony_serve_tokens_total",{},7]],"g":[],'
+                    '"h":[],"m":{}}')
+            rpc.task_executor_heartbeat("worker:0", good)
+            assert co.metrics_table.tasks() == ["worker:0"]
+            for garbage in ("NOT JSON", "[]", '{"c": "nope"}',
+                            '{"c": [["x", {}, "str"]]}',
+                            '{"h": [["x", {}, {"b": [], "n": []}]]}',
+                            "\x00\xff"):
+                rpc.task_executor_heartbeat("worker:0", garbage)   # no raise
+            # the last GOOD snapshot survives the garbage
+            assert co.metrics_table.get("worker:0")["c"] == [
+                ["tony_serve_tokens_total", {}, 7]]
+            assert co.metrics_table.rejected == 6
+        finally:
+            co.rpc_server.stop(0)
 
 
 # ---------------------------------------------------------------------------

@@ -45,6 +45,7 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.events import events as ev
 from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.utils.docker import docker_wrap
 from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
                                   WorkerSpecResponse)
@@ -109,8 +110,14 @@ class CoordinatorRpc(ApplicationRpc):
         self.co.client_signalled_finish.set()
         return self.co.final_status or "RUNNING"
 
-    def task_executor_heartbeat(self, task_id: str) -> str:
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
         self.co.hb_monitor.ping(task_id)
+        if metrics:
+            # Telemetry rides the liveness channel but must never break
+            # it: ingest validates and drops malformed snapshots (keeping
+            # the task's previous good one) instead of raising into the
+            # RPC handler.
+            self.co.metrics_table.ingest(task_id, metrics)
         return os.environ.get(constants.TONY_GCS_TOKEN, "")
 
     def renew_gcs_token(self, token: str) -> None:
@@ -192,6 +199,13 @@ class Coordinator:
         self._workers_terminated = False
         self._preprocess_proc = None
         self._session_metrics: list[dict] = []   # prior attempts' uptimes
+        # Per-task last heartbeat-shipped metrics snapshot (the
+        # TaskMonitor table analog), folded into METRICS_SNAPSHOT jhist
+        # events on the configured cadence by the monitor loop.
+        self.metrics_table = metrics_mod.SnapshotTable()
+        self._metrics_interval_s = conf.get_int(
+            K.METRICS_SNAPSHOT_INTERVAL_KEY, 5000) / 1000.0
+        self._metrics_last_emit = time.monotonic()
 
     # ------------------------------------------------------------------
     # RPC-driven hooks
@@ -499,11 +513,35 @@ class Coordinator:
             self.hb_monitor.unregister(c.task_id)
             self.record_completion(jt, idx, c.exit_code, preempted=c.preempted)
 
+    def _maybe_emit_metrics(self, force: bool = False) -> None:
+        """Fold the per-task snapshot table (plus the coordinator's own
+        registry as pseudo-task "am:0" — missed-heartbeat counters,
+        process stats) into one METRICS_SNAPSHOT jhist event, on the
+        tony.metrics.snapshot-interval-ms cadence (``force`` for the
+        final at-stop emit). The event stream is flushed per record, so
+        the history server's /metrics reads live values from the
+        .inprogress file."""
+        now = time.monotonic()
+        if not force and (self._metrics_interval_s <= 0
+                          or now - self._metrics_last_emit
+                          < self._metrics_interval_s):
+            return
+        self._metrics_last_emit = now
+        payload = self.metrics_table.as_payload()
+        metrics_mod.sample_host_stats()
+        own = metrics_mod.get_default().to_wire()
+        if own["c"] or own["g"] or own["h"]:
+            payload[f"{constants.COORDINATOR_JOB_NAME}:0"] = own
+        if payload:
+            self.events.emit(ev.METRICS_SNAPSHOT, tasks=payload,
+                             session_id=self.session.session_id)
+
     def monitor(self, started_at: float) -> SessionStatus:
         """The hot control loop (reference: monitor:591-646)."""
         while True:
             time.sleep(self.MONITOR_PERIOD_S)
             self._apply_completions(self.backend.poll_completed())
+            self._maybe_emit_metrics()
             if self.timeout_s > 0 and time.monotonic() - started_at > self.timeout_s:
                 self.failure_message = (
                     f"application timed out after {self.timeout_s:.0f}s")
@@ -754,6 +792,9 @@ class Coordinator:
             # completions (session-id filtering already drops cross-session
             # RPC reports, but process-exit reports carry no session id)
             self._restart_dup.clear()
+            # the table holds the dead generation's snapshots; the new
+            # session's executors repopulate it within one heartbeat
+            self.metrics_table.clear()
             self.events.emit(ev.SESSION_RESET,
                              old_session_id=self.session.session_id)
             # Keep the failed attempt's uptime: the north-star fraction must
@@ -815,6 +856,10 @@ class Coordinator:
         self.backend.kill_all()
         self.backend.stop()
         self.hb_monitor.stop()
+        # Final metrics flush BEFORE the terminal event: short jobs (and
+        # single-node jobs, which never reach the monitor loop) still get
+        # at least one METRICS_SNAPSHOT for the history replay.
+        self._maybe_emit_metrics(force=True)
         self.events.emit(
             ev.APPLICATION_FINISHED, app_id=self.app_id,
             status=self.final_status,

@@ -36,6 +36,7 @@ from tony_tpu import constants
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
+from tony_tpu.runtime import metrics as metrics_mod
 
 log = logging.getLogger("tony_tpu.executor")
 
@@ -70,11 +71,16 @@ class Heartbeater(threading.Thread):
     MAX_CONSECUTIVE_FAILURES = 5
 
     def __init__(self, rpc: ApplicationRpcClient, task_id: str,
-                 interval_s: float, gcs_token_file: str | None = None) -> None:
+                 interval_s: float, gcs_token_file: str | None = None,
+                 snapshot_fn=None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
         self.interval_s = interval_s
+        #: () -> compact JSON metrics snapshot piggybacked on each beat
+        #: (None = old-style liveness-only heartbeats). A provider error
+        #: must never cost a ping — collection is wrapped below.
+        self.snapshot_fn = snapshot_fn
         self.stop_event = threading.Event()
         self.skip_remaining = int(
             os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
@@ -100,6 +106,16 @@ class Heartbeater(threading.Thread):
             log.info("renewed GCS token republished to %s",
                      self.gcs_token_file)
 
+    def _snapshot(self) -> str:
+        if self.snapshot_fn is None:
+            return ""
+        try:
+            return self.snapshot_fn() or ""
+        except Exception:
+            log.warning("metrics snapshot collection failed; sending "
+                        "plain heartbeat", exc_info=True)
+            return ""
+
     def run(self) -> None:
         while not self.stop_event.wait(self.interval_s):
             if self.skip_remaining > 0:
@@ -108,7 +124,8 @@ class Heartbeater(threading.Thread):
                          self.skip_remaining)
                 continue
             try:
-                tok = self.rpc.task_executor_heartbeat(self.task_id)
+                tok = self.rpc.task_executor_heartbeat(self.task_id,
+                                                       self._snapshot())
                 self._failures = 0
                 self._republish_token(tok)
             except Exception:  # any send failure counts
@@ -143,6 +160,22 @@ class TaskExecutor:
         self.registration_timeout_s = conf.get_int(
             K.TASK_REGISTRATION_TIMEOUT_KEY, 300000) / 1000.0
         self.bootstrap: dict | None = None
+        self._started_at = time.monotonic()
+
+    # ------------------------------------------------------------------
+    def metrics_snapshot(self) -> str:
+        """Compact JSON snapshot for the heartbeat piggyback: this host's
+        process stats (RSS/CPU from /proc, uptime) plus whatever else
+        landed in the executor's default registry (e.g. child exit
+        codes). The user training process runs in its own process — its
+        registry stays there; what ships here is the HOST-side view the
+        coordinator can't see otherwise."""
+        reg = metrics_mod.get_default()
+        metrics_mod.sample_host_stats(reg)
+        reg.gauge("tony_executor_uptime_seconds",
+                  help="seconds since this executor started").set(
+                      time.monotonic() - self._started_at)
+        return reg.to_wire_json()
 
     # ------------------------------------------------------------------
     def register_and_get_cluster_spec(self) -> dict:
@@ -391,7 +424,8 @@ class TaskExecutor:
         token_file = (self._publish_gcs_token()
                       if os.environ.get(constants.TONY_GCS_TOKEN) else None)
         heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s,
-                                  gcs_token_file=token_file)
+                                  gcs_token_file=token_file,
+                                  snapshot_fn=self.metrics_snapshot)
         heartbeater.start()
         if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
             try:
@@ -419,8 +453,26 @@ class TaskExecutor:
                 "PATH", "")
             extra_env["PATH"] = venv_bin + os.pathsep + base_path
         exit_code = self.run_user_process(extra_env)
+        metrics_mod.get_default().counter(
+            "tony_executor_child_exits_total",
+            help="user-process exits by code",
+            code=str(exit_code)).inc()
         self.apply_chaos_after_training()
         heartbeater.stop_event.set()
+        # Join before the final beat: an in-flight periodic beat (whose
+        # snapshot predates the exit-code counter) landing AFTER the
+        # final one would overwrite it in the coordinator's last-
+        # snapshot table. Bounded wait — the beat's own RPC deadline.
+        heartbeater.join(timeout=15)
+        try:
+            # One explicit final beat so the exit-code counter (and the
+            # last host stats) reach the coordinator even though the
+            # periodic heartbeater is stopping — best-effort, like the
+            # result report below.
+            self.rpc.task_executor_heartbeat(self.task_id,
+                                             self.metrics_snapshot())
+        except Exception:
+            log.debug("final metrics heartbeat failed", exc_info=True)
         try:
             self.rpc.register_execution_result(
                 exit_code, self.job_name, str(self.task_index), self.session_id)

@@ -14,6 +14,8 @@ import threading
 import time
 from typing import Callable
 
+from tony_tpu.runtime import metrics as metrics_mod
+
 log = logging.getLogger(__name__)
 
 
@@ -79,6 +81,12 @@ class HeartbeatMonitor:
             for task_id in newly_dead:
                 log.warning("task %s missed heartbeats for %.1fs — deemed dead",
                             task_id, self.expiry_s)
+                # rides the coordinator's "am:0" entry in METRICS_SNAPSHOT
+                # events, so expiries are visible fleet-wide
+                metrics_mod.get_default().counter(
+                    "tony_missed_heartbeat_expiries_total",
+                    help="tasks deemed dead after missed heartbeats",
+                    task=task_id).inc()
                 try:
                     self.on_expired(task_id)
                 except Exception:

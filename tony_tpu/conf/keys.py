@@ -67,6 +67,15 @@ TASK_PROFILE_ENABLED_KEY = "tony.task.profile.enabled"            # per-host jax
 TASK_PROFILE_DIR_KEY = "tony.task.profile.dir"                    # trace output root
 
 # ---------------------------------------------------------------------------
+# Metrics plane ("tony.metrics.*" — the TaskMonitor/MetricsRpc analog):
+# executors piggyback a registry snapshot on every heartbeat; the
+# coordinator folds its per-task last-snapshot table into a
+# METRICS_SNAPSHOT jhist event on this cadence (0 disables the periodic
+# emit; the final at-stop snapshot still lands).
+# ---------------------------------------------------------------------------
+METRICS_SNAPSHOT_INTERVAL_KEY = "tony.metrics.snapshot-interval-ms"
+
+# ---------------------------------------------------------------------------
 # Chief designation (TonyConfigurationKeys: chief name/index)
 # ---------------------------------------------------------------------------
 CHIEF_REGEX_KEY = "tony.application.chief.name"
@@ -183,6 +192,7 @@ DEFAULTS: dict[str, str] = {
     TASK_EXECUTION_TIMEOUT_KEY: "0",
     TASK_PROFILE_ENABLED_KEY: "false",
     TASK_PROFILE_DIR_KEY: "",
+    METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
     HISTORY_LOCATION_KEY: "",

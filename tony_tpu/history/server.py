@@ -34,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig, parse_cli_confs
 from tony_tpu.events import events as ev
+from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.storage import (StorageError, sdirname, sjoin, storage_for)
 
 log = logging.getLogger(__name__)
@@ -182,9 +183,17 @@ class HistoryServer:
 
     Routes (reference: tony-history-server/conf/routes:1-3):
       GET /                -> jobs-metadata index (triggers migration)
-      GET /jobs/<appId>    -> per-job event timeline
+      GET /jobs/<appId>    -> per-job event timeline + latest metrics
       GET /config/<appId>  -> per-job frozen config
       GET /api/jobs, /api/jobs/<id>/events, /api/jobs/<id>/config -> JSON
+      GET /metrics         -> Prometheus text exposition: live per-task
+                              series from every RUNNING job's latest
+                              METRICS_SNAPSHOT (heartbeat-shipped,
+                              coordinator-aggregated), labeled
+                              {job, task}, plus server-local gauges
+      GET /api/jobs/<id>/metrics -> JSON replay of the job's
+                              METRICS_SNAPSHOT events (works for
+                              finished jobs purely from the jhist)
     """
 
     def __init__(self, conf: TonyConfig, port: int | None = None) -> None:
@@ -334,6 +343,110 @@ class HistoryServer:
         self._uptime_by_path[path] = result
         return result
 
+    # -- metrics -------------------------------------------------------------
+    @staticmethod
+    def _latest_metrics_snapshot(events: list[ev.Event]) -> ev.Event | None:
+        for e in reversed(events):
+            if e.event_type == ev.METRICS_SNAPSHOT:
+                return e
+        return None
+
+    #: how much of a live jhist tail one scrape reads looking for the
+    #: newest snapshot — comfortably holds many snapshot records; a
+    #: fleet whose single snapshot outgrows this shows up as a missing
+    #: job on /metrics, not an error
+    _LIVE_TAIL_BYTES = 1 << 19
+
+    def _latest_live_snapshot(self, job: dict) -> ev.Event | None:
+        """Newest METRICS_SNAPSHOT of a RUNNING job, read from a bounded
+        TAIL of its growing .inprogress file (the job_uptime idiom) —
+        every scrape sees fresh values at O(tail) cost, instead of
+        re-parsing an ever-growing file through the 30s events cache
+        (which would both block handler threads on old jobs and serve
+        30s-stale 'live' gauges against the 5s snapshot cadence)."""
+        try:
+            tail = storage_for(job["path"]).read_tail(
+                job["path"], self._LIVE_TAIL_BYTES).decode(
+                    "utf-8", errors="replace")
+        except (OSError, StorageError):
+            return None
+        for line in reversed(tail.splitlines()):
+            if '"METRICS_SNAPSHOT"' not in line:
+                continue
+            try:
+                e = ev.Event.from_json(line)
+            except (json.JSONDecodeError, KeyError):
+                continue      # the tail window's partial first line
+            if e.event_type == ev.METRICS_SNAPSHOT:
+                return e
+        return None
+
+    #: snapshots returned in one /api/jobs/<id>/metrics response — a
+    #: long-lived job at the 5s default cadence accumulates thousands of
+    #: METRICS_SNAPSHOT events, and serializing all of them would block a
+    #: handler thread on a multi-MB response; the newest ones are what a
+    #: timeline consumer wants, and snapshot_count still reports the total
+    MAX_METRICS_SNAPSHOTS = 200
+
+    def job_metrics(self, app_id: str) -> dict | None:
+        """JSON replay of a job's METRICS_SNAPSHOT events: the snapshot
+        timeline (newest ``MAX_METRICS_SNAPSHOTS``, oldest-first;
+        ``snapshot_count`` is the untruncated total) plus the latest
+        per-task series — reconstructed purely from the jhist, so it
+        works identically for running (.inprogress) and finished jobs."""
+        events = self.job_events(app_id)
+        if events is None:
+            return None
+        snaps = [e for e in events if e.event_type == ev.METRICS_SNAPSHOT]
+        latest = snaps[-1] if snaps else None
+        return {
+            "app_id": app_id,
+            "snapshot_count": len(snaps),
+            "snapshots": [{"timestamp": e.timestamp,
+                           "session_id": e.payload.get("session_id"),
+                           "tasks": e.payload.get("tasks", {})}
+                          for e in snaps[-self.MAX_METRICS_SNAPSHOTS:]],
+            "tasks": (latest.payload.get("tasks", {}) if latest else {}),
+        }
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition of LIVE series: every running job's
+        latest coordinator-aggregated METRICS_SNAPSHOT (read from the
+        flushed-per-event .inprogress jhist), each task's series labeled
+        {job=<app_id>, task=<task_id>}, plus the server's own gauges.
+        Names stay unique by construction (one latest snapshot per
+        (job, task)); render_prometheus additionally drops any duplicate
+        series defensively."""
+        entries: list[tuple] = []
+        jobs = self.list_jobs()
+        running = 0
+        for job in jobs:
+            if job["status"] != "RUNNING":
+                continue
+            running += 1
+            latest = self._latest_live_snapshot(job)
+            if latest is None:
+                continue
+            tasks = latest.payload.get("tasks", {})
+            if not isinstance(tasks, dict):
+                continue
+            for task_id, wire in sorted(tasks.items()):
+                try:
+                    metrics_mod.validate_wire(wire)
+                except (ValueError, TypeError):
+                    log.warning("skipping malformed snapshot for %s/%s",
+                                job["app_id"], task_id)
+                    continue
+                entries.extend(metrics_mod.series_from_wire(
+                    wire, {"job": job["app_id"], "task": task_id}))
+        entries.append(("gauge", "tony_history_jobs",
+                        {"state": "running"}, float(running),
+                        "jobs known to the history server"))
+        entries.append(("gauge", "tony_history_jobs",
+                        {"state": "finished"}, float(len(jobs) - running),
+                        "jobs known to the history server"))
+        return metrics_mod.render_prometheus(entries)
+
     # -- html rendering ------------------------------------------------------
     def _render_index(self) -> str:
         rows = []
@@ -358,14 +471,57 @@ class HistoryServer:
         events = self.job_events(app_id)
         if events is None:
             return None
+        # METRICS_SNAPSHOT events render as their own section below —
+        # inlining each multi-task wire blob into the timeline would bury
+        # the lifecycle events it exists to show.
+        timeline = [e for e in events
+                    if e.event_type != ev.METRICS_SNAPSHOT]
         rows = "".join(
             f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
             f"<td>{html.escape(e.event_type)}</td>"
             f"<td><pre>{html.escape(json.dumps(e.payload, indent=1))}</pre>"
-            f"</td></tr>" for e in events)
+            f"</td></tr>" for e in timeline)
         body = ("<table><tr><th>Time (UTC)</th><th>Event</th><th>Payload</th>"
-                "</tr>" + rows + "</table>") if events else "<p>No events.</p>"
+                "</tr>" + rows + "</table>") if timeline \
+            else "<p>No events.</p>"
+        body += self._render_metrics_section(events)
         return _PAGE.format(title=f"Events — {html.escape(app_id)}", body=body)
+
+    def _render_metrics_section(self, events: list[ev.Event]) -> str:
+        """Per-job metrics table from the LATEST snapshot: one row per
+        (task, series) with counters/gauges as values and histograms as
+        count/sum. Empty string when the job shipped no metrics."""
+        latest = self._latest_metrics_snapshot(events)
+        if latest is None:
+            return ""
+        rows = []
+        tasks = latest.payload.get("tasks", {})
+        for task_id in sorted(tasks if isinstance(tasks, dict) else ()):
+            wire = tasks[task_id]
+            try:
+                metrics_mod.validate_wire(wire)
+            except (ValueError, TypeError):
+                continue
+            for kind, name, labels, value, _ in \
+                    metrics_mod.series_from_wire(wire):
+                if kind == "histogram":
+                    shown = (f"count={value['c']} "
+                             f"sum={round(value['s'], 6)}")
+                else:
+                    shown = f"{round(float(value), 6):g}"
+                label_txt = ",".join(f"{k}={v}"
+                                     for k, v in sorted(labels.items()))
+                rows.append(
+                    f"<tr><td>{html.escape(task_id)}</td>"
+                    f"<td>{html.escape(name)}</td>"
+                    f"<td>{html.escape(label_txt)}</td>"
+                    f"<td>{html.escape(shown)}</td></tr>")
+        if not rows:
+            return ""
+        return ("<h1>Metrics (latest snapshot, "
+                f"{_fmt_ts(latest.timestamp)})</h1>"
+                "<table><tr><th>Task</th><th>Metric</th><th>Labels</th>"
+                "<th>Value</th></tr>" + "".join(rows) + "</table>")
 
     def _render_config(self, app_id: str) -> str | None:
         conf = self.job_config(app_id)
@@ -437,8 +593,16 @@ class HistoryServer:
                     page = server._render_config(path[len("/config/"):])
                     self._not_found() if page is None else \
                         self._send(200, page, "text/html")
+                elif path == "/metrics":
+                    self._send(200, server.render_prometheus(),
+                               "text/plain; version=0.0.4")
                 elif path == "/api/jobs":
                     self._json(server.list_jobs())
+                elif path.startswith("/api/jobs/") and \
+                        path.endswith("/metrics"):
+                    app_id = path[len("/api/jobs/"):-len("/metrics")]
+                    m = server.job_metrics(app_id)
+                    self._not_found() if m is None else self._json(m)
                 elif path.startswith("/api/jobs/") and \
                         path.endswith("/events"):
                     app_id = path[len("/api/jobs/"):-len("/events")]

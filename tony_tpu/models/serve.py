@@ -108,6 +108,7 @@ from tony_tpu.models.decode import (_check_draft_vocab, _check_no_ring,
                                     decode_step, extend_step,
                                     init_kv_cache, place_rows, prefill,
                                     prefill_rows)
+from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime.profiler import PhaseTimes
 
 #: Trace-time program counters keyed by (program name, static shape):
@@ -780,7 +781,14 @@ class ContinuousBatcher:
         point). ``self.steps_executed`` counts device decode steps run —
         the utilization denominator (each step advances every slot);
         ``self.phase_times`` holds per-phase host wall clock
-        (dispatch/fetch/admit/retire) for the call."""
+        (dispatch/fetch/admit/retire) for the call.
+
+        The call also observes into the default metrics registry
+        (``runtime/metrics.py``): admitted/retired request counters,
+        useful-token counter, queue-depth gauge, and — on return — the
+        PhaseTimes accumulation as per-phase ``tony_serve_phase_*``
+        counters. Swap in a :class:`~tony_tpu.runtime.metrics.NullRegistry`
+        to serve uninstrumented (the bench contrast arm)."""
         queue = list(range(len(prompts)))
         outputs: list[list[int]] = [[] for _ in prompts]
         if isinstance(max_new_tokens, int):
@@ -814,6 +822,21 @@ class ContinuousBatcher:
         self.phase_times = PhaseTimes()
         self._reset_streams()
 
+        # Registry instrumentation: a handful of GIL-atomic increments
+        # per host SYNC (not per token — token counts batch into one inc
+        # per consume), so the hot loop pays nanoseconds per chunk
+        # (pinned by bench.py's metrics-overhead arm).
+        reg = metrics_mod.get_default()
+        admitted_c = reg.counter("tony_serve_requests_admitted_total",
+                                 help="requests admitted into cache slots")
+        retired_c = reg.counter("tony_serve_requests_retired_total",
+                                help="requests retired (eos or budget)")
+        tokens_c = reg.counter("tony_serve_tokens_total",
+                               help="useful generated tokens")
+        qdepth_g = reg.gauge("tony_serve_queue_depth",
+                             help="requests waiting for a free slot")
+        qdepth_g.set(len(queue))
+
         def admit_into(rows_):
             pairs = []
             for row in rows_:
@@ -823,6 +846,8 @@ class ContinuousBatcher:
                 self._admit_batch(pairs, prompts)
                 for row, req in pairs:
                     occupant[row] = req
+                admitted_c.inc(len(pairs))
+            qdepth_g.set(len(queue))
 
         def consume(host_toks, snap):
             """Apply one fetched chunk under the occupancy it was ISSUED
@@ -831,11 +856,13 @@ class ContinuousBatcher:
             completion) carry garbage and are skipped — the same discard
             as idle-slot garbage."""
             freed = []
+            appended = 0
             for row, req in enumerate(snap):
                 if req is None or done[req]:
                     continue
                 for t in host_toks[row]:
                     outputs[req].append(int(t))
+                    appended += 1
                     budget[req] -= 1
                     if budget[req] == 0 or (self.eos_id is not None
                                             and int(t) == self.eos_id):
@@ -844,6 +871,10 @@ class ContinuousBatcher:
                         occupant[row] = None
                         freed.append(row)
                         break
+            if appended:
+                tokens_c.inc(appended)
+            if freed:
+                retired_c.inc(len(freed))
             return freed
 
         def settle(freed):
@@ -864,6 +895,7 @@ class ContinuousBatcher:
             while any(o is not None for o in occupant):
                 snap = list(occupant)
                 settle(consume(self._fetch(self._issue()), snap))
+            metrics_mod.observe_phase_times(self.phase_times, reg)
             return outputs
 
         live = [r is not None for r in occupant]
@@ -914,6 +946,10 @@ class ContinuousBatcher:
             if nxt is None and any(o is not None for o in occupant):
                 nxt = (self._issue(), list(occupant))
             inflight = nxt
+        # fold the call's PhaseTimes accumulation into the registry (the
+        # PhaseTimes→metrics bridge: per-phase seconds/ops counters stay
+        # monotonic across serve() calls while .phase_times itself resets)
+        metrics_mod.observe_phase_times(self.phase_times, reg)
         return outputs
 
 

@@ -13,6 +13,7 @@ mnist_distributed.py:113-126).
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable
 
 import jax
@@ -23,6 +24,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tony_tpu.parallel.sharding import (DEFAULT_RULES, Rules,
                                         logical_sharding, param_shardings,
                                         shard_pytree)
+from tony_tpu.runtime import metrics as metrics_mod
 
 
 # Train state is a plain dict pytree: {"params", "opt_state", "step"}.
@@ -110,7 +112,7 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array] | None,
 
     jitted = jax.jit(step, donate_argnums=(0,) if donate else ())
     if mesh is None:
-        return jitted
+        return _instrument_step(jitted)
 
     def sharded_step(state, batch):
         # set_mesh must wrap the CALL, not the traced body: the ambient mesh
@@ -118,7 +120,42 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array] | None,
         with jax.set_mesh(mesh):
             return jitted(state, batch)
 
-    return sharded_step
+    return _instrument_step(sharded_step)
+
+
+def _instrument_step(step_fn: Callable) -> Callable:
+    """Observe per-call wall time and example throughput into the default
+    metrics registry (``tony_train_step_seconds`` histogram,
+    ``tony_train_steps_total`` / ``tony_train_examples_total`` counters).
+
+    The timing is the HOST wall of the dispatch: jitted steps run async,
+    but under a saturated loop with donated state each dispatch gates on
+    the previous step's completion, so steady-state wall-per-call tracks
+    step time (the same caveat every async-dispatch profiler carries;
+    ``PhaseTimes``/``StepTracer`` in runtime/profiler.py give the precise
+    per-phase / device-side views). Cost per call is one perf_counter
+    pair plus three GIL-atomic observations — noise next to any real
+    step."""
+
+    def instrumented(state, batch):
+        t0 = time.perf_counter()
+        out = step_fn(state, batch)
+        dt = time.perf_counter() - t0
+        reg = metrics_mod.get_default()
+        reg.histogram("tony_train_step_seconds",
+                      help="host wall seconds per train-step dispatch"
+                      ).observe(dt)
+        reg.counter("tony_train_steps_total", help="train steps run").inc()
+        leaves = jax.tree.leaves(batch)
+        if leaves and getattr(leaves[0], "shape", None):
+            # leading batch dim of the first leaf = local examples/step;
+            # rate(examples_total) is the examples/s the fleet view wants
+            reg.counter("tony_train_examples_total",
+                        help="examples consumed by train steps").inc(
+                            leaves[0].shape[0])
+        return out
+
+    return instrumented
 
 
 def batch_sharding(mesh: Mesh, rules: Rules = DEFAULT_RULES,

@@ -180,13 +180,17 @@ class ApplicationRpcClient(ApplicationRpc):
                           retries=retries)
         return resp.message
 
-    def task_executor_heartbeat(self, task_id: str) -> str:
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
         # Heartbeats get a tight retry budget: the executor-side heartbeater
         # counts consecutive failures itself (reference: TaskExecutor.java:
         # 264-268 dies after 5 failed sends). Returns the job's current
         # GCS token ("" when scoping is off) — the renewal fan-out.
+        # ``metrics``: optional piggybacked registry snapshot (compact
+        # JSON); "" keeps the old-style liveness-only beat.
         resp = self._call(self._heartbeat,
-                          pb.HeartbeatRequest(task_id=task_id), retries=2)
+                          pb.HeartbeatRequest(task_id=task_id,
+                                              metrics=metrics or ""),
+                          retries=2)
         return resp.gcs_token
 
     def renew_gcs_token(self, token: str) -> None:

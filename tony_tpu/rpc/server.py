@@ -115,8 +115,23 @@ class ApplicationRpcServer:
         def _finish(req, ctx):
             return pb.FinishApplicationResponse(message=impl.finish_application())
 
+        # Old-signature compatibility, both directions: req.metrics is ""
+        # for old-style SENDERS (proto3 default), and an old-style IMPL
+        # whose task_executor_heartbeat still takes only task_id keeps
+        # working — the piggyback is dropped rather than TypeError-ing
+        # every beat. Decided once at handler build, not per call.
+        try:
+            import inspect
+            _hb_takes_metrics = len(inspect.signature(
+                impl.task_executor_heartbeat).parameters) >= 2
+        except (TypeError, ValueError):
+            _hb_takes_metrics = True
+
         def _heartbeat(req, ctx):
-            tok = impl.task_executor_heartbeat(req.task_id)
+            if _hb_takes_metrics:
+                tok = impl.task_executor_heartbeat(req.task_id, req.metrics)
+            else:
+                tok = impl.task_executor_heartbeat(req.task_id)
             return pb.HeartbeatResponse(gcs_token=tok or "")
 
         def _renew_gcs_token(req, ctx):

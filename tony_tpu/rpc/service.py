@@ -75,10 +75,17 @@ class ApplicationRpc(abc.ABC):
     def finish_application(self) -> str: ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str) -> str:
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
         """Record the ping; returns the job's CURRENT GCS access token
         ("" when credential scoping is off) — the heartbeat doubles as
-        the token-renewal fan-out channel."""
+        the token-renewal fan-out channel.
+
+        ``metrics`` optionally carries a compact JSON snapshot of the
+        executor's metrics registry (runtime/metrics.py ``to_wire``),
+        piggybacked on the beat — the TaskMonitor/MetricsRpc analog. ""
+        (an old-style heartbeat) must always be accepted, and a
+        malformed snapshot must never fail the ping: liveness and
+        telemetry share the channel but only liveness is load-bearing."""
         ...
 
     def renew_gcs_token(self, token: str) -> None:

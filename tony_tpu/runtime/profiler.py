@@ -106,22 +106,41 @@ def profile_dir() -> str | None:
 
 def maybe_start() -> bool:
     """Start the per-host profiler server (idempotent) when enabled.
-    Returns True if profiling is active for this task."""
+
+    Returns whether the profiler server is actually LIVE for this task —
+    False when profiling is disabled, when no TB_PORT is exported (or
+    it is 0), or when the server failed to start. (It used to return
+    bare ``enabled``, reporting True for a task nothing could connect
+    to.) Trace-file capture (:func:`trace` / :class:`StepTracer`) is
+    independent of the server and keyed on ``tony.task.profile.dir``."""
     global _server_started
     enabled = os.environ.get(constants.TONY_PROFILE_ENABLED, "") == "true"
     if not enabled:
         return False
-    if not _server_started:
-        import jax
-        port = int(os.environ.get(constants.TB_PORT, "0"))
-        if port:
-            try:
-                jax.profiler.start_server(port)
-                _server_started = True
-                log.info("jax profiler server on port %d", port)
-            except Exception:
-                log.warning("profiler server failed to start", exc_info=True)
+    if _server_started:
+        return True
+    import jax
+    port = int(os.environ.get(constants.TB_PORT, "0") or "0")
+    if not port:
+        log.warning("profiling enabled but no TB_PORT exported — "
+                    "profiler server not started")
+        return False
+    try:
+        jax.profiler.start_server(port)
+    except Exception:
+        log.warning("profiler server failed to start", exc_info=True)
+        return False
+    _server_started = True
+    log.info("jax profiler server on port %d", port)
     return True
+
+
+def _reset_server_state_for_tests() -> None:
+    """Forget that a profiler server was started (test isolation only —
+    jax keeps its own server singleton; this resets OUR latch so
+    maybe_start()'s decision logic can be exercised repeatedly)."""
+    global _server_started
+    _server_started = False
 
 
 @contextlib.contextmanager
