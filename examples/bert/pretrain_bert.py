@@ -24,28 +24,35 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 import tony_tpu.runtime as rt
+from tony_tpu.io.prefetch import DevicePrefetcher
 from tony_tpu.models import bert as B
+from tony_tpu.models.loop import run_training
 from tony_tpu.models.train import (batch_sharding, default_optimizer,
-                                   global_batch, init_state,
-                                   make_train_step)
+                                   init_state, make_train_step)
 from tony_tpu.parallel import shard_pytree
 
 CONFIGS = {"base": B.BERT_BASE, "tiny": B.BERT_TINY}
 MASK_FRACTION = 0.15
 
 
-def synthetic_mlm_batch(rng, batch, seq, cfg):
-    """Random token ids with 15% positions masked-out as targets (-1 =
-    ignore elsewhere), the MLM shape without a corpus."""
-    kt, km = jax.random.split(rng)
-    tokens = jax.random.randint(kt, (batch, seq), 0, cfg.vocab_size)
-    masked = jax.random.uniform(km, (batch, seq)) < MASK_FRACTION
-    targets = jnp.where(masked, tokens, -1)
+def synthetic_mlm_batches(seed, batch, seq, cfg):
+    """Infinite host-side MLM batches: random token ids with 15% positions
+    masked-out as targets (-1 = ignore elsewhere), the MLM shape without a
+    corpus. Numpy on the prefetcher's producer thread — masking/decode
+    overlaps the device step."""
+    rs = np.random.RandomState(seed)
     mask_id = cfg.vocab_size - 1
-    inputs = jnp.where(masked, mask_id, tokens)
-    return {"tokens": inputs, "targets": targets}
+    while True:
+        tokens = rs.randint(0, cfg.vocab_size,
+                            size=(batch, seq)).astype(np.int32)
+        masked = rs.rand(batch, seq) < MASK_FRACTION
+        yield {
+            "tokens": np.where(masked, mask_id, tokens).astype(np.int32),
+            "targets": np.where(masked, tokens, -1).astype(np.int32),
+        }
 
 
 def main() -> int:
@@ -77,22 +84,23 @@ def main() -> int:
     step = make_train_step(lambda p, b: B.mlm_loss(p, b, cfg, mesh), opt,
                            mesh)
 
-    sharding = batch_sharding(mesh, logical=("batch", "seq"))
-    rng = jax.random.PRNGKey(1000 + info.task_index)
-    loss = float("nan")
+    # Each process contributes its local shard; assembly + H2D run on the
+    # prefetcher's producer thread, overlapped with the device step.
+    data = DevicePrefetcher(
+        synthetic_mlm_batches(1000 + info.task_index, args.batch_size,
+                              seq, cfg),
+        sharding=batch_sharding(mesh, logical=("batch", "seq")))
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        rng, key = jax.random.split(rng)
-        # Each process contributes its local shard of the global batch.
-        batch = global_batch(
-            sharding, synthetic_mlm_batch(key, args.batch_size, seq, cfg))
-        state, metrics = step(state, batch)
-        if i % 20 == 0 or i == args.steps - 1:
-            loss = float(metrics["loss"])
-            tok_s = (args.batch_size * info.num_processes * seq * (i + 1)
-                     / (time.perf_counter() - t0))
-            print(f"step {i} mlm loss {loss:.4f} tok/s {tok_s:,.0f}",
-                  flush=True)
+
+    def log_fn(i, metrics, batch):
+        tok_s = (args.batch_size * info.num_processes * seq * (i + 1)
+                 / (time.perf_counter() - t0))
+        print(f"step {i} mlm loss {float(metrics['loss']):.4f} "
+              f"tok/s {tok_s:,.0f}", flush=True)
+
+    state, metrics = run_training(step, state, data, args.steps,
+                                  log_every=20, log_fn=log_fn)
+    loss = float(metrics["loss"]) if metrics else float("nan")
     ok = jnp.isfinite(loss)
     print(f"done: final loss {loss:.4f}", flush=True)
     return 0 if ok else 1

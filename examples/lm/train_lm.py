@@ -9,6 +9,11 @@ everything the reference left to user scripts, done the jax way:
   step compiles to one SPMD program per step (XLA inserts the collectives);
 - orbax checkpointing with ``restore_or_init`` makes coordinator retries
   (ATTEMPT_NUMBER > 0) resume from the last step instead of restarting;
+- the input pipeline is device-prefetched (``tony_tpu.io.prefetch``):
+  reader decode, global-array assembly, and the H2D copy run on a
+  producer thread, overlapped with device compute by the framework's
+  ``run_training`` driver (``--prefetch_depth 0`` for the synchronous
+  contrast);
 - step-bounded profiler capture (``tony.task.profile.enabled=true``) records
   steady-state traces, skipping compile noise.
 
@@ -30,50 +35,48 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 import tony_tpu.runtime as rt
+from tony_tpu.io.prefetch import (DevicePrefetcher, reader_epochs,
+                                   synchronous_batches)
 from tony_tpu.models import transformer as T
 from tony_tpu.models.checkpoint import CheckpointManager, attempt_number
+from tony_tpu.models.loop import run_training
 from tony_tpu.models.train import (batch_sharding, data_parallel_rank,
-                                   default_optimizer, global_batch,
-                                   init_state, make_train_step)
+                                   default_optimizer, init_state,
+                                   make_train_step)
 from tony_tpu.parallel import shard_pytree
 from tony_tpu.runtime.profiler import StepTracer
 
 
-def synthetic_batch(rng: jax.Array, batch: int, seq: int, vocab: int):
-    tokens = jax.random.randint(rng, (batch, seq + 1), 0, vocab)
-    return {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
+def synthetic_source(seed: int, batch: int, seq: int, vocab: int):
+    """Infinite host-side token batches (numpy: the prefetcher's producer
+    thread decodes + assembles while the device computes)."""
+    rs = np.random.RandomState(seed)
+    while True:
+        tokens = rs.randint(0, vocab, size=(batch, seq + 1)).astype(np.int32)
+        yield {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
 
 
-def file_batches(paths, batch: int, seq: int, mesh, steps: int, seed: int):
-    """Token batches from binary files via the data-feed layer: each record
-    is seq+1 int32 token ids; every process reads only its byte-range split
-    (tony_tpu.io) and batches assemble as global sharded arrays. Cycles
-    epochs (reshuffled) until ``steps`` batches are yielded."""
-    import numpy as np
-    from tony_tpu.io.jax_feed import global_batches
+def file_source(paths, batch: int, seq: int, seed: int):
+    """Epochal host-batch source over the sharded data-feed layer: each
+    record is seq+1 int32 token ids; every process reads only its
+    byte-range split (tony_tpu.io), reshuffled deterministically per epoch
+    (seed + epoch). The DevicePrefetcher cycles epochs until the step loop
+    stops pulling."""
+    epoch_fn, per_epoch = reader_epochs(paths, batch, np.int32, (seq + 1,),
+                                        shuffle=True, seed=seed)
+    if per_epoch == 0:
+        raise ValueError(
+            f"data files hold fewer than one full batch per process "
+            f"(batch_size={batch}, seq_len={seq}) — nothing to train on")
 
-    produced = 0
-    epoch = 0
-    while produced < steps:
-        yielded_this_epoch = False
-        # batch axes mirror the train step's ("batch",) logical rule
-        # (dp and fsdp jointly) so file-fed and synthetic batches shard
-        # identically on any mesh.
-        for tokens in global_batches(paths, batch, np.int32, (seq + 1,),
-                                     mesh, batch_axes=("dp", "fsdp"),
-                                     shuffle=True, seed=seed + epoch):
+    def epochs(epoch: int):
+        for tokens in epoch_fn(epoch):
             yield {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
-            yielded_this_epoch = True
-            produced += 1
-            if produced >= steps:
-                return
-        if not yielded_this_epoch:
-            raise ValueError(
-                f"data files hold fewer than one full batch per process "
-                f"(batch_size={batch}, seq_len={seq}) — nothing to train on")
-        epoch += 1
+
+    return epochs
 
 
 def main() -> int:
@@ -111,6 +114,10 @@ def main() -> int:
                              "attends its N most recent positions "
                              "(0 = full causal); attention cost goes "
                              "O(seq*window) instead of O(seq^2)")
+    parser.add_argument("--prefetch_depth", type=int, default=2,
+                        help="device-prefetch queue depth (batches decoded "
+                             "+ transferred ahead of the step loop); 0 = "
+                             "synchronous inline feed (A/B contrast)")
     args = parser.parse_args()
 
     info = rt.initialize()
@@ -153,45 +160,49 @@ def main() -> int:
 
     b_sharding = batch_sharding(mesh, logical=("batch", "seq"))
     tracer = StepTracer(start=start_step + 5, stop=start_step + 8)
-    # seed by dp-rank, not task index: on meshes where the batch replicates
-    # across processes (pure pp/tp) every process must feed identical data
-    rng = jax.random.PRNGKey(data_parallel_rank(mesh)
-                             + 1000 * attempt_number())
 
-    data_it = (file_batches(args.data_files, args.batch_size, args.seq_len,
-                            mesh, args.steps - start_step,
-                            seed=attempt_number())
-               if args.data_files else None)
+    # Host-batch source: files (epochal, per-epoch reshuffle) or synthetic.
+    # Synthetic seeds by dp-rank, not task index: on meshes where the batch
+    # replicates across processes (pure pp/tp) every process must feed
+    # identical data. Each process contributes its LOCAL shard; the
+    # prefetcher assembles global sharded arrays on its producer thread so
+    # decode + H2D overlap device compute.
+    source = (file_source(args.data_files, args.batch_size, args.seq_len,
+                          seed=attempt_number())
+              if args.data_files else
+              synthetic_source(data_parallel_rank(mesh)
+                               + 1000 * attempt_number(),
+                               args.batch_size, args.seq_len,
+                               cfg.vocab_size))
+    if args.prefetch_depth > 0:
+        data = DevicePrefetcher(source, sharding=b_sharding,
+                                depth=args.prefetch_depth)
+    else:
+        # synchronous contrast: decode + assembly inline on the step path
+        # (same source protocol, no overlap)
+        data = synchronous_batches(source, sharding=b_sharding)
 
     t0 = time.perf_counter()
-    loss = float("nan")
-    for step in range(start_step, args.steps):
-        tracer.step(step)
-        if data_it is not None:
-            batch = next(data_it)
-        else:
-            rng, key = jax.random.split(rng)
-            # Per-process shard → global array (per-task rng means the data
-            # differs across hosts; device_put would assert value equality).
-            batch = global_batch(
-                b_sharding, synthetic_batch(key, args.batch_size,
-                                            args.seq_len, cfg.vocab_size))
-        state, metrics = step_fn(state, batch)
-        if mgr:
-            mgr.save(step + 1, state)
-        if step % 20 == 0 or step == args.steps - 1:
-            loss = float(metrics["loss"])
-            # global tokens/step from the assembled batch itself (batch may
-            # shard over processes — dp — or replicate — pure pp/tp)
-            gb = batch["inputs"].shape[0]
-            tok_s = (gb * args.seq_len * (step - start_step + 1)
-                     / (time.perf_counter() - t0))
-            print(f"step {step} loss {loss:.4f} tok/s {tok_s:,.0f}",
-                  flush=True)
-    tracer.close()
+
+    def log_fn(step, metrics, batch):
+        loss = float(metrics["loss"])
+        # global tokens/step from the assembled batch itself (batch may
+        # shard over processes — dp — or replicate — pure pp/tp)
+        gb = batch["inputs"].shape[0]
+        tok_s = (gb * args.seq_len * (step - start_step + 1)
+                 / (time.perf_counter() - t0))
+        print(f"step {step} loss {loss:.4f} tok/s {tok_s:,.0f}", flush=True)
+
+    try:
+        state, metrics = run_training(
+            step_fn, state, data, args.steps, start_step=start_step,
+            checkpoint=mgr, log_every=20, log_fn=log_fn,
+            step_hook=tracer.step)
+    finally:
+        tracer.close()
     if mgr:
-        mgr.wait_until_finished()
         mgr.close()
+    loss = float(metrics["loss"]) if metrics else float("nan")
     ok = jnp.isfinite(loss)
     print(f"done: final loss {loss:.4f}", flush=True)
     return 0 if ok else 1

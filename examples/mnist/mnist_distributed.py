@@ -109,59 +109,70 @@ def main() -> int:
         h = jnp.maximum(x @ params["w1"] + params["b1"], 0.0)
         return h @ params["w2"] + params["b2"]
 
-    def loss_fn(params, x, y):
-        logits = forward(params, x)
+    def loss_fn(params, batch):
+        logits = forward(params, batch["x"])
         return optax.softmax_cross_entropy_with_integer_labels(
-            logits, y).mean()
+            logits, batch["y"]).mean()
 
     tx = optax.sgd(args.lr, momentum=0.9)
 
     # --- sharding: batch over dp, params replicated ------------------------
+    from tony_tpu.io.prefetch import DevicePrefetcher
+    from tony_tpu.models.loop import run_training
+    from tony_tpu.models.train import init_state, make_train_step
+
     repl = NamedSharding(mesh, P())
     batch_sharded = NamedSharding(mesh, P(dp_axis))
     params = jax.device_put(
         init_params(jax.random.PRNGKey(info.session_id)), repl)
-    opt_state = jax.device_put(tx.init(params), repl)
+    state = init_state(params, tx)
+    train_step = make_train_step(loss_fn, tx, mesh)
 
     @jax.jit
-    def train_step(params, opt_state, x, y):
-        loss, grads = jax.value_and_grad(loss_fn)(params, x, y)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
+    def accuracy(params, batch):
+        return (forward(params, batch["x"]).argmax(-1) == batch["y"]).mean()
 
-    @jax.jit
-    def accuracy(params, x, y):
-        return (forward(params, x).argmax(-1) == y).mean()
-
-    # Each process feeds its slice of the global batch
-    # (jax.make_array_from_process_local_data — the HdfsAvroFileSplitReader
-    # byte-split idea applied to arrays).
+    # Each process feeds its slice of the global batch; the prefetcher's
+    # producer thread runs the index/gather/reshape decode AND the
+    # jax.make_array_from_process_local_data assembly (the
+    # HdfsAvroFileSplitReader byte-split idea applied to arrays) while the
+    # device runs the previous step.
     local_bs = args.batch_size // info.num_processes
     rng = np.random.RandomState(1234 + info.process_id)
 
-    def global_batch():
-        idx = rng.randint(0, len(images), size=(local_bs,))
-        x = images[idx].reshape(local_bs, 784)
-        y = labels[idx]
-        gx = jax.make_array_from_process_local_data(batch_sharded, x)
-        gy = jax.make_array_from_process_local_data(batch_sharded, y)
-        return gx, gy
+    def host_batches():
+        while True:
+            idx = rng.randint(0, len(images), size=(local_bs,))
+            yield {"x": images[idx].reshape(local_bs, 784), "y": labels[idx]}
+
+    # held-out global batch for the periodic eval hook
+    eval_batch = {
+        k: jax.make_array_from_process_local_data(batch_sharded, v)
+        for k, v in next(host_batches()).items()}
 
     t0 = time.time()
-    loss = float("nan")
-    for step in range(args.steps):
-        x, y = global_batch()
-        params, opt_state, loss = train_step(params, opt_state, x, y)
-        if step % 50 == 0 and info.process_id == 0:
-            print(f"step {step} loss {float(loss):.4f}", flush=True)
+
+    def log_fn(step, metrics, batch):
+        if info.process_id == 0:
+            eval_s = (f" acc {float(metrics['eval']):.3f}"
+                      if "eval" in metrics else "")
+            print(f"step {step} loss {float(metrics['loss']):.4f}{eval_s}",
+                  flush=True)
+
+    state, metrics = run_training(
+        train_step, state,
+        DevicePrefetcher(host_batches(), sharding=batch_sharded),
+        args.steps,
+        eval_fn=lambda s: accuracy(s["params"], eval_batch),
+        eval_every=50, log_every=50, log_fn=log_fn)
     wall = time.time() - t0
 
-    x, y = global_batch()
-    acc = float(accuracy(params, x, y))
+    loss = float(metrics["loss"]) if metrics else float("nan")
+    acc = float(accuracy(state["params"], eval_batch))
     throughput = args.steps * args.batch_size / wall
     if info.process_id == 0:
         print(f"done: {args.steps} steps in {wall:.1f}s "
-              f"({throughput:.0f} img/s), final loss {float(loss):.4f}, "
+              f"({throughput:.0f} img/s), final loss {loss:.4f}, "
               f"acc {acc:.3f}", flush=True)
     if acc < args.target_acc:
         print(f"FAILED: accuracy {acc:.3f} < target {args.target_acc}",

@@ -23,20 +23,27 @@ import time
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 
 import tony_tpu.runtime as rt
+from tony_tpu.io.prefetch import DevicePrefetcher
 from tony_tpu.models import resnet as R
-from tony_tpu.models.train import batch_sharding, global_batch
+from tony_tpu.models.loop import run_training
+from tony_tpu.models.train import batch_sharding
 
 
-def synthetic_batch(rng, batch, image_size, num_classes, dtype):
-    kx, ky = jax.random.split(rng)
-    return {
-        "image": jax.random.normal(
-            kx, (batch, image_size, image_size, 3), dtype),
-        "label": jax.random.randint(ky, (batch,), 0, num_classes),
-    }
+def synthetic_batches(seed, batch, image_size, num_classes):
+    """Infinite host-side image batches (f32 numpy; the train step casts
+    to the model dtype on device — a fused elementwise op)."""
+    rs = np.random.RandomState(seed)
+    while True:
+        yield {
+            "image": rs.randn(batch, image_size, image_size, 3)
+                       .astype(np.float32),
+            "label": rs.randint(0, num_classes, size=(batch,))
+                       .astype(np.int32),
+        }
 
 
 def main() -> int:
@@ -61,40 +68,48 @@ def main() -> int:
     params, stats = R.init_resnet(jax.random.PRNGKey(0), depth=args.depth,
                                   num_classes=args.num_classes, dtype=dtype)
     opt = optax.sgd(args.lr, momentum=0.9, nesterov=True)
-    opt_state = opt.init(params)
+    # batch-norm stats ride in the state pytree, so the step keeps the
+    # (state, batch) -> (state, metrics) shape run_training drives
+    state = {"params": params, "stats": stats,
+             "opt_state": opt.init(params),
+             "step": jnp.zeros((), jnp.int32)}
 
-    def step_fn(params, stats, opt_state, batch):
+    def step_impl(state, batch):
+        batch = dict(batch, image=batch["image"].astype(dtype))
         (loss, new_stats), grads = jax.value_and_grad(
             R.classification_loss, has_aux=True)(
-                params, stats, batch, args.depth)
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
-        return params, new_stats, opt_state, loss
+                state["params"], state["stats"], batch, args.depth)
+        updates, opt_state = opt.update(grads, state["opt_state"],
+                                        state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "stats": new_stats,
+                "opt_state": opt_state,
+                "step": state["step"] + 1}, {"loss": loss}
 
-    jitted = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+    jitted = jax.jit(step_impl, donate_argnums=(0,))
 
-    def step(params, stats, opt_state, batch):
+    def step_fn(state, batch):
         with jax.set_mesh(mesh):
-            return jitted(params, stats, opt_state, batch)
+            return jitted(state, batch)
 
-    sharding = batch_sharding(mesh)
-    rng = jax.random.PRNGKey(info.task_index)
-    loss = float("nan")
+    # Per-process shard → global array, assembled + transferred on the
+    # prefetcher's producer thread (multi-host feeding pattern, off the
+    # step critical path).
+    data = DevicePrefetcher(
+        synthetic_batches(info.task_index, args.batch_size,
+                          args.image_size, args.num_classes),
+        sharding=batch_sharding(mesh))
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        rng, key = jax.random.split(rng)
-        # Per-process shard → global array (multi-host feeding pattern).
-        batch = global_batch(
-            sharding, synthetic_batch(key, args.batch_size, args.image_size,
-                                      args.num_classes, dtype))
-        params, stats, opt_state, loss = step(params, stats, opt_state,
-                                              batch)
-        if i % 10 == 0 or i == args.steps - 1:
-            loss = float(loss)
-            img_s = (args.batch_size * info.num_processes * (i + 1)
-                     / (time.perf_counter() - t0))
-            print(f"step {i} loss {loss:.4f} images/s {img_s:,.1f}",
-                  flush=True)
+
+    def log_fn(i, metrics, batch):
+        img_s = (args.batch_size * info.num_processes * (i + 1)
+                 / (time.perf_counter() - t0))
+        print(f"step {i} loss {float(metrics['loss']):.4f} "
+              f"images/s {img_s:,.1f}", flush=True)
+
+    state, metrics = run_training(step_fn, state, data, args.steps,
+                                  log_every=10, log_fn=log_fn)
+    loss = float(metrics["loss"]) if metrics else float("nan")
     ok = jnp.isfinite(loss)
     print(f"done: final loss {loss:.4f}", flush=True)
     return 0 if ok else 1

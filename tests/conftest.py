@@ -69,28 +69,36 @@ import pytest
 
 @pytest.fixture
 def retrace_guard():
-    """Retrace-count regression guard for the serving device programs.
+    """Retrace-count regression guard for serving AND training programs.
 
     `tony_tpu.models.serve.TRACE_COUNTS` increments once per TRACE of a
     serving program, keyed by (program name, static shape) — a Python
     side effect inside the jitted bodies, so it counts compiles, not
-    calls. The fixture snapshots the counter and yields a guard whose
+    calls. `tony_tpu.models.train.TRACE_COUNTS` does the same for
+    ``train_step``/``eval_step`` (keyed by batch leaf shapes). The
+    fixture snapshots both counters and yields a guard whose
     ``new_traces(name)`` returns the per-shape trace deltas for one
     program and ``assert_max(name, n)`` pins an upper bound — the
     bucketed-admission invariant ("at most one program per length
-    bucket, however many distinct prompt lengths") is asserted through
-    this, and any change that reintroduces per-length retraces fails
-    loudly here rather than as a silent serving-latency regression."""
-    from tony_tpu.models import serve
+    bucket") and the train-loop invariant ("one compiled step per batch
+    shape across a full run_training run") are asserted through this,
+    and any change that reintroduces retraces fails loudly here rather
+    than as a silent latency regression."""
 
-    before = dict(serve.TRACE_COUNTS)
+    def _trace_counts() -> dict:
+        from tony_tpu.models import serve, train
+        counts = dict(serve.TRACE_COUNTS)
+        counts.update(train.TRACE_COUNTS)   # names disjoint by convention
+        return counts
+
+    before = _trace_counts()
 
     class Guard:
         def new_traces(self, name: str) -> dict:
             """{static shape: new traces} for program ``name`` since the
             fixture snapshot."""
             return {key[1]: count - before.get(key, 0)
-                    for key, count in serve.TRACE_COUNTS.items()
+                    for key, count in _trace_counts().items()
                     if key[0] == name and count > before.get(key, 0)}
 
         def total_new(self, name: str) -> int:

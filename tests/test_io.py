@@ -258,6 +258,73 @@ def test_mid_stream_short_tail_does_not_drop_later_files(tmp_path):
                                      np.arange(100, 140, dtype=dtype)]))
 
 
+def test_short_tail_warns_once_per_reader_not_per_call_site(tmp_path,
+                                                            caplog):
+    # Two short-tailed files consumed through TWO array_batches call sites
+    # over the SAME reader (the spill / mixed-delivery pattern): the drop
+    # warning fires once per reader, while every full record still arrives.
+    import logging
+
+    dtype, row = np.float32, (2,)
+    rs = record_size_for(dtype, row)
+    paths = []
+    # short tails in files 0 and 2, placed so call site 1 consumes the
+    # first tail and call site 2 the second
+    for i, (n, tail) in enumerate([(2, b"xy"), (3, b""), (2, b"zzz"),
+                                   (3, b"")]):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(np.arange(i * 100, i * 100 + n * 2,
+                                dtype=dtype).tobytes() + tail)
+        paths.append(str(p))
+    with caplog.at_level(logging.WARNING, logger="tony_tpu.io.jax_feed"):
+        with FileSplitReader(paths, record_size=rs, use_native=False) as r:
+            first = [next(array_batches(r, 4, dtype, row,
+                                        drop_remainder=False))]
+            rest = list(array_batches(r, 4, dtype, row,
+                                      drop_remainder=False))
+    assert sum(b.shape[0] for b in first + rest) == 10   # all full records
+    tails = [rec for rec in caplog.records if "short tail" in rec.message]
+    assert len(tails) == 1
+
+
+def test_reader_close_timeout_drops_queue_reference(monkeypatch, caplog):
+    """A prefetch thread wedged in hung IO must not pin decoded records:
+    the close-timeout path warns, drains the queue, and drops the reader's
+    (and finalizer's) reference so records are GC-able."""
+    import logging
+    import threading
+    import time as _time
+
+    from tony_tpu.io import reader as reader_mod
+
+    release = threading.Event()
+
+    def hung_generate(segments, record_size):
+        yield b"x" * 8
+        release.wait()          # hung IO: stop cannot interrupt this
+        yield b"y" * 8
+
+    monkeypatch.setattr(reader_mod._PythonImpl, "_generate",
+                        staticmethod(hung_generate))
+    impl = reader_mod._PythonImpl([], 8, capacity=4, shuffle=False, seed=0,
+                                  prefetch=True)
+    try:
+        deadline = _time.monotonic() + 5
+        while impl._queue.qsize() < 1 and _time.monotonic() < deadline:
+            _time.sleep(0.01)
+        assert impl._queue.qsize() >= 1     # first record decoded + parked
+        with caplog.at_level(logging.WARNING, logger="tony_tpu.io.reader"):
+            t0 = _time.monotonic()
+            impl.close()                    # join times out (thread wedged)
+        assert _time.monotonic() - t0 < 10
+        assert impl._queue is None          # records released, not pinned
+        assert any("did not exit" in r.message for r in caplog.records)
+    finally:
+        release.set()                       # let the daemon thread finish
+        impl._producer.join(timeout=5)
+    assert not impl._producer.is_alive()
+
+
 def test_reader_next_batch_after_close_returns_empty(tmp_path):
     # Both impls must agree: next_batch on a closed reader is [], not a
     # crash (the native path used to hand C++ a NULL handle).

@@ -17,6 +17,9 @@ Python over py4j per TaskExecutor.java:281). Components:
                ndarray, and local-spill delivery modes
   jax_feed   — decode to ndarray + assemble global sharded jax.Arrays via
                jax.make_array_from_process_local_data
+  prefetch   — DevicePrefetcher: background decode + assembly + H2D into a
+               bounded queue so input work overlaps device compute
+               (consumed by models/loop.run_training)
 """
 
 from tony_tpu.io.split import (FileSegment, compute_read_info,
@@ -29,11 +32,15 @@ from tony_tpu.io.avro import (AvroFormatError, AvroWriter, is_avro_file,
                               read_datum, write_datum)
 from tony_tpu.io.reader import DataFeedError, FileSplitReader
 
-# jax_feed re-exports are lazy: it imports numpy (and jax inside its
-# functions), which orchestration-only installs — submit hosts, `tony
-# convert` — do not carry (pyproject's "compute" extra).
-_LAZY_JAX_FEED = ("array_batches", "global_batches", "record_size_for",
-                  "records_to_array", "to_global_array")
+# jax_feed / prefetch re-exports are lazy: they import numpy (and jax
+# inside their functions), which orchestration-only installs — submit
+# hosts, `tony convert` — do not carry (pyproject's "compute" extra).
+_LAZY = {name: "tony_tpu.io.jax_feed"
+         for name in ("array_batches", "global_batches", "record_size_for",
+                      "records_to_array", "to_global_array")}
+_LAZY.update({name: "tony_tpu.io.prefetch"
+              for name in ("DevicePrefetcher", "PrefetchShapeError",
+                           "reader_epochs", "synchronous_batches")})
 
 __all__ = [
     "FileSegment", "compute_read_info", "full_records_in_split",
@@ -43,12 +50,12 @@ __all__ = [
     "AvroWriter", "AvroFormatError", "is_avro_file",
     "read_datum", "write_datum",
     "FileSplitReader", "DataFeedError",
-    *_LAZY_JAX_FEED,
+    *_LAZY,
 ]
 
 
 def __getattr__(name: str):
-    if name in _LAZY_JAX_FEED:
+    if name in _LAZY:
         import importlib
-        return getattr(importlib.import_module("tony_tpu.io.jax_feed"), name)
+        return getattr(importlib.import_module(_LAZY[name]), name)
     raise AttributeError(f"module 'tony_tpu.io' has no attribute {name!r}")

@@ -43,10 +43,13 @@ def array_batches(reader: FileSplitReader, batch_size: int, dtype,
     """Iterate the reader's split as fixed-size [batch, *row_shape] arrays.
 
     Short tail records (a file whose size is not a record multiple) are
-    dropped — they cannot form a full row.
+    dropped — they cannot form a full row. The drop warning fires once per
+    READER (flagged on the reader object), not once per call site: a
+    reader consumed through several ``array_batches`` calls — the spill /
+    prefetch mixed-delivery pattern — still reports its short tails
+    exactly once.
     """
     rec_bytes = record_size_for(dtype, row_shape)
-    warned = False
     exhausted = False
     while not exhausted:
         # Keep pulling until we hold batch_size FULL records or the reader is
@@ -59,8 +62,9 @@ def array_batches(reader: FileSplitReader, batch_size: int, dtype,
                 exhausted = True
                 break
             kept = [r for r in records if len(r) == rec_bytes]
-            if len(kept) < len(records) and not warned:
-                warned = True
+            if len(kept) < len(records) and not getattr(
+                    reader, "_short_tail_warned", False):
+                reader._short_tail_warned = True
                 log.warning("dropping %d short tail record(s) (< %d bytes)",
                             len(records) - len(kept), rec_bytes)
             full.extend(kept)

@@ -1,0 +1,330 @@
+"""Device-prefetched training input pipeline: decode-ahead + H2D overlap.
+
+PR 1 removed host/device serialization from the *serve* loop; this module
+removes it from the *train* path. Without it every step pays reader decode,
+``jax.make_array_from_process_local_data`` assembly, and the host-to-device
+copy inline between step dispatches — the training loop is input-bound the
+moment decode cost is nonzero (TF-Replicator's overlapped host input
+pipelines and Podracer's decoupled host/device architecture both hinge on
+exactly this overlap; see PAPERS.md).
+
+:class:`DevicePrefetcher` runs a background producer thread that pulls host
+batches from a source (``reader_epochs`` over the sharded data-feed layer,
+or any iterable), assembles them into **global sharded jax.Arrays**
+(``jax.make_array_from_process_local_data`` against the train step's batch
+sharding) or ``jax.device_put``s them, and parks them in a bounded queue —
+so the H2D transfer of batch N+1 overlaps the device compute of batch N.
+
+Contract (each clause is test-pinned in tests/test_prefetch.py):
+
+- **clean shutdown** — ``close()`` stops the producer, drains the queue
+  (a put-blocked producer can never deadlock a closing consumer), joins
+  the thread, and drops the queue reference so parked device batches are
+  GC-able; a prefetcher dropped without ``close()`` is released by a
+  ``weakref`` finalizer (the reader's finalizer discipline);
+- **exception propagation** — a producer error (decode failure, source
+  bug) re-raises in the consumer with its ORIGINAL traceback, never
+  swallowed in a daemon thread;
+- **deterministic epochs** — an epochal source is called as
+  ``source(epoch)``; :func:`reader_epochs` seeds each epoch's reshuffle
+  with ``seed + epoch``, so a restarted job replays the same stream;
+- **consistent shapes** — every produced batch must match the first
+  batch's tree structure and leaf shapes/dtypes; a mismatch raises
+  :class:`PrefetchShapeError` instead of silently retracing the jitted
+  train step (the train-side analog of serve's retrace guard).
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import weakref
+from typing import Any, Callable, Iterable, Iterator
+
+log = logging.getLogger(__name__)
+
+_SENTINEL = object()
+_THREAD_SEQ = itertools.count()
+
+
+class PrefetchShapeError(RuntimeError):
+    """A produced batch's structure or leaf shapes/dtypes differ from the
+    first batch's — feeding it would silently retrace the jitted step."""
+
+
+def _tree_spec(tree: Any) -> tuple:
+    import jax
+    leaves, treedef = jax.tree.flatten(tree)
+    return (str(treedef),
+            tuple((tuple(getattr(l, "shape", ())),
+                   str(getattr(l, "dtype", type(l).__name__)))
+                  for l in leaves))
+
+
+def _assemble(batch: Any, sharding) -> Any:
+    """Host pytree → device pytree, ON THE PRODUCER THREAD (this is the
+    H2D copy the overlap hides). With a sharding every leaf assembles as
+    a global sharded array from this process's local shard (the
+    multi-host feeding recipe — ``train.global_batch``); without one,
+    ``device_put`` to the default device (single-process feeds)."""
+    import jax
+    if sharding is None:
+        return jax.tree.map(jax.device_put, batch)
+    return jax.tree.map(
+        lambda x: jax.make_array_from_process_local_data(sharding, x),
+        batch)
+
+
+def _iterate(source, epochs: int | None) -> Iterator[Any]:
+    """The one epochal-iteration contract, shared by the producer thread
+    and :func:`synchronous_batches`: a callable source is cycled
+    ``source(0), source(1), …`` (bounded by ``epochs``), a plain iterable
+    is a single pass, and an empty epoch raises instead of spinning
+    forever under ``itertools.count()``."""
+    epochal = callable(source)
+    epoch_iter: Iterable[int] = (
+        (range(epochs) if epochs is not None else itertools.count())
+        if epochal else (0,))
+    for epoch in epoch_iter:
+        produced = 0
+        for host_batch in (source(epoch) if epochal else source):
+            produced += 1
+            yield host_batch
+        if epochal and produced == 0:
+            raise ValueError(
+                f"prefetch source yielded no batches for epoch {epoch} "
+                f"— nothing to train on")
+
+
+def synchronous_batches(source, sharding=None,
+                        epochs: int | None = None) -> Iterator[Any]:
+    """The prefetcher's stream WITHOUT the producer thread: decode +
+    assembly + H2D inline on the caller's critical path. The A/B
+    contrast arm (``train_lm.py --prefetch_depth 0``) — same source
+    protocol, same epochal cycling and empty-epoch guard, so the two
+    feeds differ only in overlap."""
+    for host_batch in _iterate(source, epochs):
+        yield _assemble(host_batch, sharding)
+
+
+def _producer(source, epochs, sharding, q, stop, error_box) -> None:
+    """Producer body (module-level: must NOT reference the prefetcher —
+    it would pin it against its finalizer). Any error lands in
+    ``error_box`` and re-raises in the consumer. The trailing sentinel is
+    best-effort with a bounded loop; consumers use timeout-gets that
+    re-check ``stop``, so a missing sentinel cannot deadlock them."""
+    spec = None
+    try:
+        for host_batch in _iterate(source, epochs):
+            if stop.is_set():
+                return
+            batch = _assemble(host_batch, sharding)
+            got = _tree_spec(batch)
+            if spec is None:
+                spec = got
+            elif got != spec:
+                raise PrefetchShapeError(
+                    f"batch shape changed mid-stream: first batch was "
+                    f"{spec}, got {got} — the jitted train step would "
+                    f"retrace; pad or drop the odd batch")
+            while not stop.is_set():
+                try:
+                    q.put(batch, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if stop.is_set():
+                return
+    except BaseException as e:           # noqa: BLE001 — box EVERYTHING;
+        error_box.append(e)              # the consumer re-raises it
+    finally:
+        for _ in range(50):
+            try:
+                q.put(_SENTINEL, timeout=0.1)
+                break
+            except queue.Full:
+                if stop.is_set():
+                    break
+
+
+def _release(stop, q) -> None:
+    """Finalizer for prefetchers dropped without close(): unblock the
+    producer (it exits its put loop once ``stop`` is set and the queue
+    has room)."""
+    stop.set()
+    try:
+        q.get_nowait()
+    except Exception:
+        pass
+
+
+class DevicePrefetcher:
+    """Iterator of device-resident batches, assembled ``depth`` ahead.
+
+    ``source`` is either a plain iterable of host-batch pytrees (one
+    pass), or a callable ``epoch -> iterable`` (epochal mode: called with
+    0, 1, 2, … so the source can reshuffle deterministically per epoch —
+    see :func:`reader_epochs`; ``epochs`` bounds the count, None cycles
+    forever). ``sharding`` is the train step's batch
+    :class:`~jax.sharding.NamedSharding` (``train.batch_sharding``),
+    applied to every leaf's leading dims; None means plain
+    ``device_put``.
+
+    ``depth`` bounds the queue: each slot parks one full global batch of
+    DEVICE memory, so 2 (one being consumed + one in flight) is right
+    unless per-batch decode cost is highly variable.
+
+    Usage::
+
+        with DevicePrefetcher(epoch_fn, sharding=b_sharding) as batches:
+            state, metrics = run_training(step_fn, state, batches, steps)
+    """
+
+    def __init__(self, source: Iterable | Callable[[int], Iterable],
+                 sharding=None, depth: int = 2,
+                 epochs: int | None = None) -> None:
+        self._q: queue.Queue | None = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        #: one-slot box the producer stores its exception into
+        self._error_box: list = []
+        self._done = False
+        self._thread = threading.Thread(
+            target=_producer,
+            args=(source, epochs, sharding, self._q, self._stop,
+                  self._error_box),
+            name=f"tony-datafeed-device-{next(_THREAD_SEQ)}", daemon=True)
+        self._thread.start()
+        self._finalizer = weakref.finalize(
+            self, _release, self._stop, self._q)
+
+    def __iter__(self) -> Iterator[Any]:
+        return self
+
+    def __next__(self) -> Any:
+        if self._done:
+            raise StopIteration
+        if self._q is None:
+            raise RuntimeError("DevicePrefetcher is closed")
+        while True:
+            try:
+                # timeout + stop re-check: a cross-thread close() may
+                # retire the producer before its sentinel lands
+                item = self._q.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise RuntimeError("DevicePrefetcher is closed")
+                if not self._thread.is_alive():
+                    # The producer may have parked its last batch(es) +
+                    # sentinel and exited INSIDE our timeout window — drain
+                    # before concluding, or a finite epoch silently loses
+                    # its tail.
+                    try:
+                        item = self._q.get_nowait()
+                    except queue.Empty:
+                        self._done = True
+                        if self._error_box:
+                            raise self._error_box.pop()
+                        raise StopIteration
+                    if item is _SENTINEL:
+                        self._done = True
+                        if self._error_box:
+                            raise self._error_box.pop()
+                        raise StopIteration
+                    return item
+                continue
+            if item is _SENTINEL:
+                self._done = True
+                if self._error_box:
+                    # the exception object carries the producer's original
+                    # traceback; re-raising here preserves it
+                    raise self._error_box.pop()
+                raise StopIteration
+            return item
+
+    def close(self) -> None:
+        """Stop the producer and release everything it parked. Never
+        blocks on a full queue (close-during-full-queue is test-pinned),
+        never leaves a live thread behind on the normal path."""
+        self._stop.set()
+        q = self._q
+        if q is not None:
+            while True:                   # unblock a put() on a full queue
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+            if self._thread.is_alive():
+                log.warning("device-prefetch thread did not exit; dropping "
+                            "its queue (daemon thread dies with the process)")
+        if q is not None:
+            while True:                   # items put between drain and exit
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+        # Drop the queue reference (and the finalizer's) so parked device
+        # batches are GC-able even if the thread is wedged in the source.
+        self._finalizer.detach()
+        self._q = None
+        self._done = True
+
+    def __enter__(self) -> "DevicePrefetcher":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def reader_epochs(paths: list[str], batch_size_per_process: int, dtype,
+                  row_shape: tuple[int, ...], *, shuffle: bool = True,
+                  seed: int = 0, process_index: int | None = None,
+                  process_count: int | None = None,
+                  ) -> tuple[Callable[[int], Iterator], int]:
+    """Epochal host-batch source over the sharded data-feed layer.
+
+    Returns ``(epoch_fn, batches_per_epoch)``: ``epoch_fn(epoch)`` yields
+    this process's LOCAL ``[batch, *row_shape]`` ndarrays for one pass
+    over its byte-range split, reshuffled deterministically per epoch
+    (reader seed = ``seed + epoch`` — a resumed attempt replays the same
+    stream). Every process yields the SAME ``batches_per_epoch`` — the
+    minimum over all processes' full-batch counts, computed from file
+    sizes with no communication (``jax_feed.global_batches``' equal-count
+    guarantee) — so the jitted-step loop cannot deadlock multi-host.
+    """
+    from tony_tpu.io.jax_feed import array_batches, record_size_for
+    from tony_tpu.io.reader import FileSplitReader
+    from tony_tpu.io.split import full_records_in_split
+    from tony_tpu.storage import ssize
+
+    if process_index is None or process_count is None:
+        import jax
+        pid = jax.process_index() if process_index is None else process_index
+        pcount = (jax.process_count() if process_count is None
+                  else process_count)
+    else:
+        pid, pcount = process_index, process_count
+    record_size = record_size_for(dtype, row_shape)
+    sizes = [ssize(p) for p in paths]
+    per_epoch = min(
+        full_records_in_split(paths, i, pcount, record_size, sizes=sizes)
+        // batch_size_per_process
+        for i in range(pcount))
+
+    def epoch_fn(epoch: int) -> Iterator:
+        reader = FileSplitReader(
+            paths, task_index=pid, task_num=pcount,
+            record_size=record_size, shuffle=shuffle, seed=seed + epoch,
+            sizes=sizes)
+        try:
+            it = array_batches(reader, batch_size_per_process, dtype,
+                               row_shape)
+            for _ in range(per_epoch):
+                yield next(it)
+        finally:
+            reader.close()
+
+    return epoch_fn, per_epoch

@@ -302,9 +302,21 @@ class _PythonImpl:
             if self._producer.is_alive():
                 # stuck inside the generator (hung IO): leave the daemon
                 # thread to die with the process rather than raise from
-                # closing a generator another thread is executing
+                # closing a generator another thread is executing — but
+                # drain and DROP the queue (and the finalizer's reference
+                # to it) so already-decoded records are GC-able instead of
+                # pinned behind a wedged thread. The producer keeps its own
+                # queue reference; any residual puts it lands before dying
+                # are bounded by the queue capacity.
                 log.warning("datafeed prefetch thread did not exit; "
                             "leaving generator to the daemon thread")
+                while True:
+                    try:
+                        self._queue.get_nowait()
+                    except Exception:
+                        break
+                self._finalizer.detach()   # stop already set; queue drained
+                self._queue = None
                 return
         # Release the fd held by the suspended generator now, not at GC time
         # (the native impl guarantees this via its finalizer).

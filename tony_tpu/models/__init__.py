@@ -7,6 +7,7 @@ logical sharding axes from tony_tpu.parallel.sharding.
 """
 
 from tony_tpu.models import bert, mnist, resnet, transformer
+from tony_tpu.models.loop import run_training
 from tony_tpu.models.train import (
     TrainState,
     batch_sharding,
@@ -26,5 +27,6 @@ __all__ = [
     "make_train_step",
     "mnist",
     "resnet",
+    "run_training",
     "transformer",
 ]

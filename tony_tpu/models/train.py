@@ -12,6 +12,7 @@ mnist_distributed.py:113-126).
 
 from __future__ import annotations
 
+import collections
 import functools
 import time
 from typing import Any, Callable
@@ -29,6 +30,20 @@ from tony_tpu.runtime import metrics as metrics_mod
 
 # Train state is a plain dict pytree: {"params", "opt_state", "step"}.
 TrainState = dict
+
+#: Trace-time program counters keyed by (program name, batch leaf
+#: shapes/dtypes): incremented when the train/eval step is TRACED
+#: (compiled), not when it is called — the train-side twin of
+#: ``serve.TRACE_COUNTS``. The conftest ``retrace_guard`` fixture reads
+#: both, so tests pin "one compiled train step per batch shape across a
+#: full run_training run" the same way serve pins bucketed admission.
+TRACE_COUNTS: collections.Counter = collections.Counter()
+
+
+def _count_trace(name: str, batch: Any) -> None:
+    TRACE_COUNTS[(name, tuple(
+        (tuple(getattr(l, "shape", ())), str(getattr(l, "dtype", "?")))
+        for l in jax.tree.leaves(batch)))] += 1
 
 
 def masked_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:
@@ -94,6 +109,7 @@ def make_train_step(loss_fn: Callable[[Any, Any], jax.Array] | None,
     vag = value_and_grad_fn or jax.value_and_grad(loss_fn)
 
     def step(state: TrainState, batch: Any):
+        _count_trace("train_step", batch)   # trace-time only: counts compiles
         loss, grads = vag(state["params"], batch)
         if fused:
             # single-pass update (ops/optim.py): params change inside the
@@ -172,7 +188,17 @@ def data_parallel_rank(mesh: Mesh, axes: tuple[str, ...] = ("dp", "fsdp"),
     coordinate (e.g. pure-pp or pure-tp meshes, where the batch is
     REPLICATED across processes) get the same rank and must feed identical
     data; seeding by task index there would hand ``global_batch`` divergent
-    "replicas" that silently disagree across devices."""
+    "replicas" that silently disagree across devices.
+
+    Memoized per (mesh, axes): the body runs an ``np.vectorize`` scan over
+    every mesh device, and data sources call this from step-adjacent paths
+    (the prefetcher's epoch seeding) — the device↔process assignment is
+    fixed for the life of the process, so the scan pays once."""
+    return _data_parallel_rank_cached(mesh, tuple(axes))
+
+
+@functools.lru_cache(maxsize=64)
+def _data_parallel_rank_cached(mesh: Mesh, axes: tuple[str, ...]) -> int:
     import numpy as np
     local = set(jax.local_devices())
     coords = np.argwhere(
@@ -201,7 +227,11 @@ def global_batch(sharding: NamedSharding, local_tree: Any) -> Any:
 
 def make_eval_step(loss_fn: Callable[[Any, Any], jax.Array],
                    mesh: Mesh | None = None) -> Callable:
-    jitted = jax.jit(lambda params, batch: loss_fn(params, batch))
+    def eval_step(params, batch):
+        _count_trace("eval_step", batch)
+        return loss_fn(params, batch)
+
+    jitted = jax.jit(eval_step)
     if mesh is None:
         return jitted
 
