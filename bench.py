@@ -257,6 +257,12 @@ def main() -> None:
         # random draft speculation is a correctness demo only).
         out.update(_speculative_arm())
 
+    # job bring-up wall against the fake gcloud fleet: cold 4-gang launch
+    # parallel vs the serial baseline (max-of-gangs vs sum-of-gangs), and
+    # the warm-restart wall where surviving slices are adopted and the
+    # content-stamp probe skips the tarball ship entirely. Hardware-free.
+    out.update(_launch_arm())
+
     # device-prefetched vs synchronous train feed: with nonzero decode
     # cost the pipelined loop's step wall should approach the
     # pure-compute wall (decode + H2D overlap the device step) while the
@@ -364,6 +370,133 @@ def _input_pipeline_arm(cfg, batch, seq, steps: int = 20):
         "train_feed_data_wait_s_sync": round(wait_sync, 4),
         # ~0 = the prefetcher stays ahead of the step loop
         "train_feed_data_wait_s_prefetch": round(wait_pre, 4),
+    }
+
+
+def _launch_arm(num_gangs: int = 4, create_delay_s: float = 0.6,
+                scp_delay_s: float = 0.3) -> dict:
+    """Job bring-up wall: parallel gang launch + content-addressed staging.
+
+    Drives the REAL TpuSliceBackend against the fake gcloud (tests/
+    fake_gcloud.py) with injected per-gang latency D on slice creation
+    (plus a smaller scp delay), the hermetic stand-in for the minutes
+    real `gcloud create` + scp staging take. Three measurements:
+
+    - cold serial: one launch_task at a time — the pre-change
+      schedule_tasks behavior, wall ~= num_gangs * (D + stage);
+    - cold parallel: all gangs in flight at once (what the coordinator's
+      launch pool now does), wall ~= D + stage — the acceptance bound is
+      < 2*D for 4 gangs;
+    - warm restart: a FRESH backend over the surviving fleet (the
+      coordinator-relaunch case) — create fails fast with ALREADY_EXISTS
+      and the slice is adopted, the stage digest probe matches, and ZERO
+      tarballs ship (`launch_warm_stage_skip` pins that).
+
+    The deterministic tier-1 / slow test variants live in
+    tests/test_launch.py and call this function with scaled delays."""
+    import concurrent.futures
+    import os
+    import shutil
+    import sys
+    import tempfile
+
+    from tony_tpu.backend.base import LaunchSpec
+    from tony_tpu.backend.tpu import TpuSliceBackend
+    from tony_tpu.conf.config import TonyConfig
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    fake = os.path.join(repo, "tests", "fake_gcloud.py")
+    tmp = tempfile.mkdtemp(prefix="tony-launch-bench-")
+    bindir = os.path.join(tmp, "bin")
+    os.makedirs(bindir)
+    gcloud = os.path.join(bindir, "gcloud")
+    with open(gcloud, "w") as f:
+        f.write(f"#!/bin/bash\nexec {sys.executable} {fake} \"$@\"\n")
+    os.chmod(gcloud, 0o755)
+    job_dir = os.path.join(tmp, "job")
+    log_dir = os.path.join(job_dir, "logs")
+    os.makedirs(log_dir)
+    with open(os.path.join(job_dir, "tony-final.xml"), "w") as f:
+        f.write("<configuration></configuration>\n")
+
+    saved_env = {k: os.environ.get(k) for k in
+                 ("PATH", "FAKE_GCLOUD_ROOT", "FAKE_NUM_WORKERS",
+                  "FAKE_DELAY_CREATE_S", "FAKE_DELAY_SCP_S")}
+    os.environ["PATH"] = f"{bindir}:{os.environ['PATH']}"
+    os.environ["FAKE_NUM_WORKERS"] = "1"
+    os.environ["FAKE_DELAY_CREATE_S"] = str(create_delay_s)
+    os.environ["FAKE_DELAY_SCP_S"] = str(scp_delay_s)
+
+    conf = TonyConfig({
+        "tony.scheduler.backend": "tpu",
+        "tony.tpu.project": "bench", "tony.tpu.zone": "z",
+        "tony.tpu.accelerator-type": "v5litepod",
+        "tony.worker.instances": str(num_gangs),
+        "tony.worker.slices": str(num_gangs),
+    })
+
+    def specs():
+        return [LaunchSpec(task_id=f"worker:{i}", command="true", env={},
+                           log_dir=log_dir, cwd=job_dir, tpu_topology="2x4")
+                for i in range(num_gangs)]
+
+    def scp_count(fleet):
+        path = os.path.join(fleet, "calls.log")
+        if not os.path.exists(path):
+            return 0
+        return sum(1 for line in open(path)
+                   if line.split()[3:4] == ["scp"])
+
+    def launch_all(backend, parallel):
+        t0 = time.perf_counter()
+        if parallel:
+            with concurrent.futures.ThreadPoolExecutor(num_gangs) as pool:
+                list(pool.map(backend.launch_task, specs()))
+        else:
+            for s in specs():
+                backend.launch_task(s)
+        return time.perf_counter() - t0
+
+    try:
+        serial_fleet = os.path.join(tmp, "fleet-serial")
+        os.makedirs(serial_fleet)
+        os.environ["FAKE_GCLOUD_ROOT"] = serial_fleet
+        serial_b = TpuSliceBackend(conf, app_id="bench")
+        serial_wall = launch_all(serial_b, parallel=False)
+        serial_b.stop()
+
+        fleet = os.path.join(tmp, "fleet")
+        os.makedirs(fleet)
+        os.environ["FAKE_GCLOUD_ROOT"] = fleet
+        cold_b = TpuSliceBackend(conf, app_id="bench")
+        cold_wall = launch_all(cold_b, parallel=True)
+        cold_b.kill_all()            # NOT stop(): the fleet must survive
+
+        # warm restart: a fresh backend (new coordinator attempt) over the
+        # surviving fleet
+        ships_before = scp_count(fleet)
+        warm_b = TpuSliceBackend(conf, app_id="bench")
+        warm_wall = launch_all(warm_b, parallel=True)
+        warm_ships = scp_count(fleet) - ships_before
+        warm_b.stop()
+    finally:
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        shutil.rmtree(tmp, ignore_errors=True)
+    return {
+        "launch_gangs": num_gangs,
+        "launch_gang_delay_s": create_delay_s,
+        "launch_cold_serial_wall_s": round(serial_wall, 2),
+        "launch_cold_parallel_wall_s": round(cold_wall, 2),
+        # ~num_gangs when bring-up is delay-dominated (the win)
+        "launch_cold_wall_vs_serial": round(serial_wall / cold_wall, 2),
+        "launch_warm_wall_s": round(warm_wall, 2),
+        # 1 = the stamp probe matched on every gang: zero tarball ships
+        "launch_warm_stage_skip": int(warm_ships == 0),
+        "launch_warm_vs_cold": round(cold_wall / max(warm_wall, 1e-9), 2),
     }
 
 

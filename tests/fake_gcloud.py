@@ -22,12 +22,29 @@ counters so they work across fake invocations):
   FAKE_FAIL_UNPACK_N=k    first k staging-unpack ssh commands drop
                           ("Connection reset by peer")
   FAKE_FAIL_DESCRIBE_N=k  first k describes exit 1 (API flakiness)
+  FAKE_FAIL_DELETE_N=k    first k deletes exit 1 (slice left in place)
+
+Injected latency (the launch-wall benchmark's knob — real slice creation
+and scp staging take minutes; the fake sleeps instead):
+  FAKE_DELAY_CREATE_S / FAKE_DELAY_SCP_S / FAKE_DELAY_SSH_S /
+  FAKE_DELAY_DESCRIBE_S = seconds slept before executing that verb.
+
+Like real gcloud, ``create`` of an existing slice fails ALREADY_EXISTS
+(the backend adopts the surviving slice on that error — the warm-restart
+path).
 """
 
 import os
 import shutil
 import subprocess
 import sys
+import time
+
+
+def inject_delay(verb: str) -> None:
+    d = os.environ.get(f"FAKE_DELAY_{verb.upper()}_S")
+    if d:
+        time.sleep(float(d))
 
 
 def root() -> str:
@@ -108,12 +125,24 @@ def main(argv):
                 return f[len(prefix):]
         return None
 
+    if verb != "create":
+        inject_delay(verb)
+
     if verb == "create":
         if scripted_failure("CREATE"):
             print("ERROR: (gcloud.compute.tpus.tpu-vm.create) "
                   "RESOURCE_EXHAUSTED: quota exceeded for "
                   "TPUV5sLitepodPerProjectPerZone", file=sys.stderr)
             return 1
+        if os.path.isdir(slice_dir(name)):
+            # fails FAST like the real API — only a SUCCESSFUL create
+            # pays the provisioning wait, which is why the adopt path's
+            # warm restart is cheap
+            print(f"ERROR: (gcloud.compute.tpus.tpu-vm.create) "
+                  f"ALREADY_EXISTS: node {name} already exists",
+                  file=sys.stderr)
+            return 1
+        inject_delay(verb)
         d = slice_dir(name)
         os.makedirs(d, exist_ok=True)
         with open(os.path.join(d, "state"), "w") as f:
@@ -135,6 +164,10 @@ def main(argv):
         return 0
 
     if verb == "delete":
+        if scripted_failure("DELETE"):
+            print("ERROR: (gcloud.compute.tpus.tpu-vm.delete) "
+                  "INTERNAL: please retry", file=sys.stderr)
+            return 1
         if not os.path.isdir(slice_dir(name)):
             return 1
         shutil.rmtree(slice_dir(name))

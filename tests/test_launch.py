@@ -1,0 +1,570 @@
+"""Parallel gang launch + content-addressed staging cache.
+
+Three layers under test, all hermetic via the fake gcloud (the MiniYARN
+trick, tests/fake_gcloud.py — now with injected per-verb latency):
+
+- the coordinator's launch fan-out (tony.launch.max-concurrent): bounded
+  concurrency, serial fallback, launch failures funneled into
+  record_completion instead of aborting the scheduling pass;
+- the TPU backend's claim-or-wait gang logic under REAL concurrent
+  callers (it always tolerated them; schedule_tasks finally provides
+  some): waiter deadline expiry, provisioner failure waking co-gang
+  waiters that re-claim, dead-gang reprovision racing a session retry;
+- the content-stamp staging cache: a warm restart onto surviving slices
+  ships ZERO tarballs (stamp-match path pinned), a content change falls
+  back to the full re-stage, and the 4-gang cold-launch wall lands under
+  2*D against a serial baseline of ~4*D (bench.py's launch arm, run at
+  deterministic tier-1 delays here and realistic delays under `slow`).
+"""
+
+import os
+import sys
+import threading
+import time
+import types
+
+import pytest
+
+from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
+from tony_tpu.backend.tpu import (STAGE_DIGEST_FILE, TpuProvisioningError,
+                                  TpuSliceBackend, compute_stage_digest)
+from tony_tpu.cluster.coordinator import Coordinator
+from tony_tpu.cluster.session import TaskStatus
+from tony_tpu.conf.config import TonyConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FAKE_GCLOUD = os.path.join(REPO, "tests", "fake_gcloud.py")
+sys.path.insert(0, REPO)          # for `import bench` (repo-root script)
+
+
+@pytest.fixture
+def fake_gcloud(tmp_path, monkeypatch):
+    """Fake `gcloud` on PATH, rooted at tmp_path/fleet (2 hosts/slice)."""
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    gcloud = bindir / "gcloud"
+    gcloud.write_text(
+        f"#!/bin/bash\nexec {sys.executable} {FAKE_GCLOUD} \"$@\"\n")
+    gcloud.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_GCLOUD_ROOT", str(fleet))
+    monkeypatch.setenv("FAKE_NUM_WORKERS", "2")
+    return str(fleet)
+
+
+def make_backend(tmp_path, extra=None, instances=2, slices=1):
+    base = {
+        "tony.scheduler.backend": "tpu",
+        "tony.tpu.project": "p", "tony.tpu.zone": "z",
+        "tony.tpu.accelerator-type": "v5litepod",
+        "tony.worker.instances": str(instances),
+        "tony.worker.slices": str(slices),
+    }
+    base.update(extra or {})
+    return TpuSliceBackend(TonyConfig(base), app_id="app1")
+
+
+def make_job_dir(tmp_path, name="job"):
+    job = tmp_path / name
+    (job / "logs").mkdir(parents=True)
+    (job / "tony-final.xml").write_text("<configuration></configuration>")
+    return str(job)
+
+
+def spec_for(i, job_dir):
+    return LaunchSpec(task_id=f"worker:{i}", command="true", env={},
+                      log_dir=os.path.join(job_dir, "logs"),
+                      cwd=job_dir, tpu_topology="4x4")
+
+
+def calls(fleet):
+    path = os.path.join(fleet, "calls.log")
+    if not os.path.exists(path):
+        return []
+    return open(path).read().splitlines()
+
+
+def launch_concurrently(backend, specs):
+    """Launch every spec from its own thread (what the coordinator's
+    launch pool does) and collect per-thread exceptions."""
+    errors = {}
+
+    def one(s):
+        try:
+            backend.launch_task(s)
+        except Exception as e:      # noqa: BLE001 - recorded for asserts
+            errors[s.task_id] = e
+
+    threads = [threading.Thread(target=one, args=(s,)) for s in specs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# Backend concurrency edge cases
+# ---------------------------------------------------------------------------
+class TestGangConcurrency:
+    def test_await_gang_deadline_expiry(self, fake_gcloud, tmp_path):
+        """A waiter whose provisioner never finishes must expire with the
+        timeout error, not hang the launch thread forever."""
+        b = make_backend(tmp_path, {"tony.tpu.provision-timeout-ms": "50",
+                                    "tony.tpu.create-retries": "0",
+                                    "tony.tpu.stage-retries": "0",
+                                    "tony.tpu.retry-backoff-ms": "10"})
+        gang = ("worker", 0)
+        with b._lock:
+            b._gangs[gang] = {"name": "stuck", "ready": threading.Event()}
+        with pytest.raises(TpuProvisioningError, match="timed out"):
+            b._await_gang(gang, 0.05)
+
+    def test_provisioner_failure_wakes_waiters_then_reclaim(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        """Both co-gang launchers fail when the provisioner's create dies
+        (the waiter wakes on the retracted entry instead of its deadline);
+        a retry RE-CLAIMS the gang with a fresh entry and succeeds."""
+        monkeypatch.setenv("FAKE_FAIL_CREATE_N", "1")
+        b = make_backend(tmp_path, {"tony.tpu.create-retries": "0"})
+        job_dir = make_job_dir(tmp_path)
+        specs = [spec_for(0, job_dir), spec_for(1, job_dir)]
+        errors = launch_concurrently(b, specs)
+        assert sorted(errors) == ["worker:0", "worker:1"]
+        for e in errors.values():
+            assert isinstance(e, TpuProvisioningError)
+        assert ("worker", 0) not in b._gangs     # failed claim retracted
+
+        # session retry: the gang is re-claimed fresh and provisions
+        errors = launch_concurrently(b, specs)
+        assert errors == {}
+        assert b._gangs[("worker", 0)]["ready"].is_set()
+        ops = [c.split()[3] for c in calls(fake_gcloud)]
+        assert ops.count("create") == 2          # 1 failed + 1 succeeded
+        b.stop()
+
+    def test_dead_gang_reprovision_races_session_retry(
+            self, fake_gcloud, tmp_path):
+        """Two tasks of a DEAD gang relaunch concurrently (a session retry
+        fanning out): exactly one claims the reprovision (one delete + one
+        create), the other waits on the fresh entry, both launch. The
+        surviving gang is untouched."""
+        b = make_backend(tmp_path, instances=4, slices=2)
+        job_dir = make_job_dir(tmp_path)
+        specs = [spec_for(i, job_dir) for i in range(4)]
+        assert launch_concurrently(b, specs) == {}
+
+        # gang s1 dies: poison the cached state the way the poller would
+        with b._lock:
+            b._state_cache[("worker", 1)] = "PREEMPTED"
+            b._state_ts[("worker", 1)] = float("inf")
+            b._reported.update({"worker:2", "worker:3"})
+        errors = launch_concurrently(b, [specs[2], specs[3]])
+        assert errors == {}
+        assert b._state_cache.get(("worker", 1)) != "PREEMPTED"
+        assert b._gangs[("worker", 1)]["ready"].is_set()
+
+        def gang_ops(op, suffix):
+            return sum(1 for c in calls(fake_gcloud)
+                       if c.split()[3] == op and c.split()[4].endswith(suffix))
+        assert gang_ops("create", "-s1") == 2    # initial + ONE reprovision
+        assert gang_ops("delete", "-s1") == 1
+        assert gang_ops("create", "-s0") == 1    # survivor untouched
+        # the relaunched tasks must not be instantly re-failed off the
+        # stale PREEMPTED cache (their procs may legitimately have
+        # EXITED 0 by now — only preempted events are the regression)
+        assert not [e for e in b.poll_completed() if e.preempted]
+        b.stop()
+
+    def test_failed_delete_does_not_adopt_dead_slice(
+            self, fake_gcloud, tmp_path, monkeypatch):
+        """Reprovision path: when the delete of a DEAD slice fails, the
+        create's ALREADY_EXISTS must surface as a provisioning error —
+        adopting the slice we just classified as preempted would stage
+        onto a dead VM with a misleading error."""
+        b = make_backend(tmp_path, {"tony.tpu.create-retries": "0"})
+        job_dir = make_job_dir(tmp_path)
+        b.launch_task(spec_for(0, job_dir))
+        with b._lock:
+            b._state_cache[("worker", 0)] = "PREEMPTED"
+            b._state_ts[("worker", 0)] = float("inf")
+            b._reported.add("worker:0")
+        monkeypatch.setenv("FAKE_FAIL_DELETE_N", "1")
+        with pytest.raises(TpuProvisioningError, match="ALREADY_EXISTS"):
+            b.launch_task(spec_for(0, job_dir))
+        b.stop()
+
+
+# ---------------------------------------------------------------------------
+# Content-addressed staging
+# ---------------------------------------------------------------------------
+class TestStagingCache:
+    def test_warm_restart_zero_tarball_ships(self, fake_gcloud, tmp_path):
+        """The stamp-match path, pinned: a FRESH backend (coordinator
+        restart / session retry re-staging a surviving slice) probes the
+        content stamp, matches, and ships ZERO tarballs."""
+        job_dir = make_job_dir(tmp_path)
+        b1 = make_backend(tmp_path)
+        assert launch_concurrently(
+            b1, [spec_for(0, job_dir), spec_for(1, job_dir)]) == {}
+        cold_scps = sum(1 for c in calls(fake_gcloud)
+                        if c.split()[3] == "scp")
+        assert cold_scps == 1                    # the tarball shipped once
+        b1.kill_all()                            # fleet survives
+
+        b2 = make_backend(tmp_path)              # fresh: empty _gangs
+        assert launch_concurrently(
+            b2, [spec_for(0, job_dir), spec_for(1, job_dir)]) == {}
+        log = calls(fake_gcloud)
+        warm_scps = sum(1 for c in log if c.split()[3] == "scp")
+        assert warm_scps == cold_scps            # ZERO new ships
+        assert any(STAGE_DIGEST_FILE in c and "ssh" == c.split()[3]
+                   for c in log)                 # the probe really ran
+        # and the executors really launched on the adopted slice
+        assert set(b2._procs) == {"worker:0", "worker:1"}
+        b2.stop()
+
+    def test_digest_mismatch_falls_back_to_full_restage(
+            self, fake_gcloud, tmp_path):
+        """A content change between attempts fails the stamp probe and the
+        full idempotent re-stage ships the new tree."""
+        job_dir = make_job_dir(tmp_path)
+        b1 = make_backend(tmp_path)
+        b1.launch_task(spec_for(0, job_dir))
+        scps_before = sum(1 for c in calls(fake_gcloud)
+                          if c.split()[3] == "scp")
+        b1.kill_all()
+
+        with open(os.path.join(job_dir, "train.py"), "w") as f:
+            f.write("print('v2')\n")
+        b2 = make_backend(tmp_path)
+        b2.launch_task(spec_for(0, job_dir))
+        scps_after = sum(1 for c in calls(fake_gcloud)
+                         if c.split()[3] == "scp")
+        assert scps_after == scps_before + 1     # re-shipped
+        b2.stop()
+
+    def test_stage_digest_deterministic_and_content_only(self, tmp_path):
+        """Identical content hashes identically across rebuilds (mtimes
+        must not leak into it), volatile/secret entries are excluded, and
+        any content change moves the digest."""
+        job = tmp_path / "j"
+        (job / "src").mkdir(parents=True)
+        (job / "src" / "train.py").write_text("print(1)\n")
+        (job / "tony-final.xml").write_text("<configuration/>")
+        d1 = compute_stage_digest(str(job))
+        # volatile coordinator files and secrets must not perturb it
+        (job / "logs").mkdir()
+        (job / "logs" / "worker-0.stdout").write_text("noise")
+        (job / "coordinator.addr").write_text("host:123")
+        (job / ".tony-secret").write_text("s3cret")
+        (job / ".tony-tls.key").write_text("KEY")
+        (job / ".tony-stage.tgz").write_text("tarball")
+        os.utime(job / "src" / "train.py", (1, 1))   # mtime-only change
+        assert compute_stage_digest(str(job)) == d1
+        (job / "src" / "train.py").write_text("print(2)\n")
+        d2 = compute_stage_digest(str(job))
+        assert d2 != d1
+        # the tarball ships modes, empty dirs, and symlinks too — a
+        # chmod+x / added dir / retargeted link must move the digest or
+        # the stamp cache would serve a stale tree
+        os.chmod(job / "src" / "train.py", 0o755)
+        d3 = compute_stage_digest(str(job))
+        assert d3 != d2
+        (job / "src" / "empty").mkdir()
+        d4 = compute_stage_digest(str(job))
+        assert d4 != d3
+        os.symlink("src", job / "data")              # dir symlink
+        assert compute_stage_digest(str(job)) != d4
+
+    def test_tarball_excludes_tls_key_and_volatile_files(self, fake_gcloud,
+                                                         tmp_path):
+        """The stage tarball must never carry the TLS PRIVATE key, the
+        auth secret, or per-run volatile files (their churn would also
+        defeat the content stamp across coordinator attempts)."""
+        import tarfile
+        job_dir = make_job_dir(tmp_path)
+        for name in (".tony-tls.key", ".tony-secret", ".gcs-token",
+                     "coordinator.addr", "final-status.json"):
+            with open(os.path.join(job_dir, name), "w") as f:
+                f.write("x")
+        with open(os.path.join(job_dir, ".tony-tls.crt"), "w") as f:
+            f.write("public cert")
+        b = make_backend(tmp_path)
+        b._prepare_stage_artifacts(job_dir)
+        names = tarfile.open(
+            os.path.join(job_dir, ".tony-stage.tgz")).getnames()
+        for banned in (".tony-tls.key", ".tony-secret", ".gcs-token",
+                       "coordinator.addr", "final-status.json", "logs"):
+            assert banned not in names
+        assert ".tony-tls.crt" in names          # executors pin with it
+        assert "tony-final.xml" in names
+
+
+# ---------------------------------------------------------------------------
+# Coordinator fan-out
+# ---------------------------------------------------------------------------
+class RecordingBackend(SchedulerBackend):
+    """Stub that measures launch concurrency and can fail chosen tasks."""
+
+    def __init__(self, launch_s=0.0, fail_tasks=()):
+        self.launch_s = launch_s
+        self.fail_tasks = set(fail_tasks)
+        self.launched = []
+        self.inflight = 0
+        self.max_inflight = 0
+        self._lock = threading.Lock()
+
+    def launch_task(self, spec):
+        with self._lock:
+            self.inflight += 1
+            self.max_inflight = max(self.max_inflight, self.inflight)
+        try:
+            time.sleep(self.launch_s)
+            if spec.task_id in self.fail_tasks:
+                raise TpuProvisioningError(f"no capacity for {spec.task_id}")
+            with self._lock:
+                self.launched.append(spec.task_id)
+        finally:
+            with self._lock:
+                self.inflight -= 1
+
+    def poll_completed(self):
+        return []
+
+    def kill_task(self, task_id):
+        pass
+
+    def kill_all(self):
+        pass
+
+    def stop(self):
+        pass
+
+
+def make_coordinator(tmp_path, extra=None):
+    base = {"tony.worker.instances": "4",
+            "tony.history.location": str(tmp_path / "hist")}
+    base.update(extra or {})
+    job_dir = tmp_path / "job"
+    job_dir.mkdir(exist_ok=True)
+    return Coordinator(TonyConfig(base), "app_fanout", str(job_dir))
+
+
+class TestCoordinatorFanOut:
+    def test_launches_overlap_up_to_pool_bound(self, tmp_path):
+        co = make_coordinator(tmp_path)
+        co.backend = RecordingBackend(launch_s=0.2)
+        t0 = time.monotonic()
+        co.schedule_tasks("true")
+        submitted = time.monotonic() - t0
+        co._drain_launches()
+        assert submitted < 0.15          # returns before launches land
+        assert co.backend.max_inflight >= 3
+        assert sorted(co.backend.launched) == [f"worker:{i}"
+                                               for i in range(4)]
+        co.rpc_server.stop()
+
+    def test_max_concurrent_one_is_serial(self, tmp_path):
+        co = make_coordinator(tmp_path, {"tony.launch.max-concurrent": "1"})
+        co.backend = RecordingBackend(launch_s=0.05)
+        co.schedule_tasks("true")
+        co._drain_launches()
+        assert co.backend.max_inflight == 1
+        assert len(co.backend.launched) == 4
+        co.rpc_server.stop()
+
+    def test_launch_failure_funnels_into_completion(self, tmp_path):
+        """A failed provision fails the TASK through record_completion —
+        co-scheduled launches still land, the session reduces to FAILED,
+        and the backend's actionable error is preserved for stop()."""
+        co = make_coordinator(tmp_path)
+        # launch_s keeps every launch in flight when worker:2's failure
+        # lands — launches not yet STARTED at that point are legitimately
+        # skipped by their liveness check (the session is already doomed)
+        co.backend = RecordingBackend(launch_s=0.1, fail_tasks={"worker:2"})
+        co.schedule_tasks("true")
+        co._drain_launches()
+        failed = co.session.get_task("worker", 2)
+        assert failed.status is TaskStatus.FAILED
+        assert co.session.status.value == "FAILED"
+        assert sorted(co.backend.launched) == ["worker:0", "worker:1",
+                                               "worker:3"]
+        assert any("no capacity" in e for e in co._launch_errors)
+        co.rpc_server.stop()
+
+    def test_relaunch_failure_funnels_not_raises(self, tmp_path):
+        """A launch failure that triggers the in-session restart path and
+        then fails AGAIN must keep funneling — consuming restart budget
+        until the task is FAILED — not raise out of the launch thread and
+        strand the task in SCHEDULED forever (job hang)."""
+        co = make_coordinator(tmp_path, {"tony.task.restart-count": "2"})
+        co.backend = RecordingBackend(launch_s=0.05,
+                                      fail_tasks={"worker:2"})
+        co.schedule_tasks("true")
+        co._drain_launches(timeout=30)
+        task = co.session.get_task("worker", 2)
+        assert task.status is TaskStatus.FAILED
+        assert task.restarts == 2                 # budget fully consumed
+        assert co.session.status.value == "FAILED"
+        co.rpc_server.stop()
+
+    def test_identical_directory_resources_dedupe(self, tmp_path):
+        """Satellite: two job types listing the SAME directory content
+        under one basename must localize once, not raise the collision
+        error (the dedup previously only handled files)."""
+        for parent in ("a", "b"):
+            d = tmp_path / parent / "assets" / "sub"
+            d.mkdir(parents=True)
+            (d / "vocab.txt").write_text("tokens")
+            (tmp_path / parent / "assets" / "top.json").write_text("{}")
+        co = make_coordinator(tmp_path)
+        req_w = types.SimpleNamespace(
+            job_type="worker", resources=str(tmp_path / "a" / "assets"))
+        req_p = types.SimpleNamespace(
+            job_type="ps", resources=str(tmp_path / "b" / "assets"))
+        co._localize_resources(req_w)
+        co._localize_resources(req_p)        # identical tree: no error
+        assert (tmp_path / "job" / "assets" / "sub" / "vocab.txt").exists()
+
+        (tmp_path / "b" / "assets" / "sub" / "vocab.txt").write_text("DIFF")
+        with pytest.raises(ValueError, match="collides"):
+            co._localize_resources(req_p)    # different tree: still loud
+
+        # type clash (file vs dir under the same name) lands in dircmp's
+        # common_funny — it must read as "different", not silently pass
+        c = tmp_path / "c" / "assets"
+        c.mkdir(parents=True)
+        (c / "top.json").write_text("{}")
+        (c / "sub").write_text("a FILE named like the dir")
+        with pytest.raises(ValueError, match="collides"):
+            co._localize_resources(types.SimpleNamespace(
+                job_type="eval", resources=str(c)))
+        co.rpc_server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Startup observability acceptance: tony_startup_* per gang on the LIVE
+# /metrics exposition and in the jhist replay of the finished job
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+def test_startup_metrics_live_and_in_jhist_replay(fake_gcloud, tmp_path):
+    import json
+    import urllib.request
+
+    from tony_tpu.client.client import TonyClient
+    from tony_tpu.history.server import HistoryServer
+
+    hist = str(tmp_path / "hist")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": hist,
+        "tony.application.timeout": "90000",
+        "tony.scheduler.backend": "tpu",
+        "tony.tpu.project": "p", "tony.tpu.zone": "z",
+        "tony.tpu.accelerator-type": "v5litepod",
+        "tony.tpu.state-refresh-ms": "200",
+        "tony.worker.instances": "4",
+        "tony.worker.slices": "2",
+        "tony.worker.tpu.topology": "4x4",
+        "tony.metrics.snapshot-interval-ms": "200",
+        "tony.application.python-binary-path": sys.executable,
+    })
+    client = TonyClient(conf, 'bash -c "sleep 6"')
+    result = {}
+    t = threading.Thread(target=lambda: result.update(code=client.run()))
+    t.start()
+    server = None
+
+    def get(port, path):
+        with urllib.request.urlopen(
+                f"http://localhost:{port}{path}", timeout=10) as r:
+            return r.read().decode("utf-8")
+
+    try:
+        server = HistoryServer(TonyConfig({"tony.history.location": hist}),
+                               port=0)
+        server.start()
+        # LIVE: the per-gang bring-up gauges ride the coordinator registry
+        # (pseudo-task am:0) into METRICS_SNAPSHOT and hence /metrics
+        deadline = time.monotonic() + 45
+        text = ""
+        while time.monotonic() < deadline and t.is_alive():
+            try:
+                text = get(server.port, "/metrics")
+            except OSError:
+                text = ""
+            if 'tony_startup_provision_seconds{gang="worker/s1"' in text:
+                break
+            time.sleep(0.3)
+        for gang in ("worker/s0", "worker/s1"):
+            assert f'tony_startup_provision_seconds{{gang="{gang}"' in text
+            assert f'tony_startup_stage_seconds{{gang="{gang}"' in text
+        assert "tony_startup_dispatch_seconds" in text
+    finally:
+        t.join(timeout=120)
+        if server is not None:
+            server.stop()
+    assert result.get("code") == 0
+
+    # REPLAY: a fresh server reconstructs the same gauges and the LAUNCH
+    # timeline purely from the finished jhist
+    server2 = HistoryServer(TonyConfig({"tony.history.location": hist}),
+                            port=0)
+    server2.start()
+    try:
+        m = json.loads(get(server2.port,
+                           f"/api/jobs/{client.app_id}/metrics"))
+        gauges = {(name, labels.get("gang")): value
+                  for name, labels, value in m["tasks"]["am:0"]["g"]}
+        for gang in ("worker/s0", "worker/s1"):
+            assert gauges[("tony_startup_provision_seconds", gang)] >= 0
+            assert gauges[("tony_startup_stage_seconds", gang)] >= 0
+        events = json.loads(get(server2.port,
+                                f"/api/jobs/{client.app_id}/events"))
+        launches = [e for e in events if e["event_type"] == "LAUNCH"]
+        phases = {(e["payload"]["gang"], e["payload"]["phase"])
+                  for e in launches}
+        for gang in ("worker/s0", "worker/s1"):
+            assert {(gang, "provision"), (gang, "stage"),
+                    (gang, "dispatch")} <= phases
+        # cold run: the stage really shipped (no stale cache hit)
+        assert all(not e["payload"].get("cached") for e in launches
+                   if e["payload"]["phase"] == "stage")
+        page = get(server2.port, f"/jobs/{client.app_id}")
+        assert "Bring-up timeline" in page
+    finally:
+        server2.stop()
+
+
+# ---------------------------------------------------------------------------
+# Launch-wall benchmark (bench.py arm) — deterministic tier-1 variant and
+# the latency-realistic slow variant
+# ---------------------------------------------------------------------------
+class TestLaunchWall:
+    def test_cold_parallel_under_2d_warm_ships_nothing(self):
+        """Acceptance: with per-gang delay D injected into the fake
+        gcloud, a 4-gang cold launch lands under 2*D (serial baseline
+        ~4*D) and the warm restart ships zero tarballs."""
+        import bench
+        d = 2.0
+        res = bench._launch_arm(num_gangs=4, create_delay_s=d,
+                                scp_delay_s=0.0)
+        assert res["launch_cold_parallel_wall_s"] < 2 * d, res
+        assert res["launch_cold_serial_wall_s"] > 3 * d, res
+        assert res["launch_warm_stage_skip"] == 1, res
+        assert res["launch_warm_wall_s"] < d, res
+
+    @pytest.mark.slow
+    def test_launch_wall_realistic_latency(self):
+        """Latency-realistic variant: slower create AND a real scp cost,
+        so the ratio reflects staging too."""
+        import bench
+        res = bench._launch_arm(num_gangs=4, create_delay_s=6.0,
+                                scp_delay_s=2.0)
+        assert res["launch_cold_wall_vs_serial"] > 2.0, res
+        assert res["launch_cold_parallel_wall_s"] < 2 * 6.0 + 2.0, res
+        assert res["launch_warm_stage_skip"] == 1, res
+        assert res["launch_warm_vs_cold"] > 2.0, res

@@ -49,6 +49,16 @@ class SchedulerBackend(abc.ABC):
         YARN container completion is in the reference (RMCallbackHandler.
         onContainersCompleted:992)."""
 
+    def take_launch_timings(self) -> list[dict]:
+        """Drain per-gang bring-up wall timings recorded since the last
+        call: ``{"gang", "phase" (provision|stage|dispatch), "seconds",
+        "task"?, "cached"?}`` dicts. The coordinator polls this from the
+        monitor loop, folds the walls into ``tony_startup_*_seconds``
+        gauges, and emits them as jhist LAUNCH events — so where bring-up
+        time went is visible live and in replay. Backends without
+        startup phases may return []."""
+        return []
+
     @abc.abstractmethod
     def kill_task(self, task_id: str) -> None: ...
 

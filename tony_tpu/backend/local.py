@@ -17,6 +17,7 @@ import os
 import signal
 import subprocess
 import threading
+import time
 
 from tony_tpu import constants
 from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
@@ -36,8 +37,17 @@ class LocalBackend(SchedulerBackend):
         self._preempted: set[str] = set()
         self._preemption_simulated = False
         self._lock = threading.Lock()
+        #: drained by the coordinator via take_launch_timings(); local
+        #: launches have no provision/stage phase, only process dispatch
+        self._timings: list[dict] = []
+
+    def take_launch_timings(self) -> list[dict]:
+        with self._lock:
+            recs, self._timings = self._timings, []
+        return recs
 
     def launch_task(self, spec: LaunchSpec) -> None:
+        t_start = time.monotonic()
         os.makedirs(spec.log_dir, exist_ok=True)
         # Relaunch of the same task id (session retry racing a slow death):
         # reap the previous generation first so its exit event and fds are
@@ -67,6 +77,10 @@ class LocalBackend(SchedulerBackend):
             self._reported.discard(spec.task_id)
             self._killed.discard(spec.task_id)
             self._preempted.discard(spec.task_id)
+            self._timings.append({
+                "gang": spec.task_id.partition(":")[0], "phase": "dispatch",
+                "seconds": round(time.monotonic() - t_start, 6),
+                "task": spec.task_id})
         log.info("launched %s as pid %d", spec.task_id, proc.pid)
 
     def _maybe_simulate_preemption(self) -> None:

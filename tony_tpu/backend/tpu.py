@@ -35,6 +35,7 @@ the MiniYARN trick — plus command-plan unit tests in the reference's style
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
@@ -59,6 +60,82 @@ REMOTE_JOB_DIR = "~/tony-job"
 #: slice hosts need no pip install — the fat-jar-on-HDFS analog
 #: (reference: cli/ClusterSubmitter.java:37-61 ships tony's own jar)
 FRAMEWORK_DIR = ".tony-framework"
+#: content stamp written on every host as the LAST staging command: holds
+#: the sha256 of the staged tree, so a later stage of the same content
+#: (session retry, warm coordinator restart onto a surviving slice) is a
+#: one-ssh probe instead of a full tarball ship + untar
+STAGE_DIGEST_FILE = ".tony-stage.digest"
+
+#: job-dir entries excluded from the stage tarball AND the content digest.
+#: Two reasons to be here: per-run volatile files (logs, the coordinator's
+#: published address/status, the digest artifacts themselves) that would
+#: make a retried coordinator hash a different tree for identical content,
+#: and secrets that must never ride a user-readable tarball — the auth
+#: secret travels only as a chmod-600 scp'd file, the TLS PRIVATE key and
+#: the GCS token never leave the coordinator host at all (executors get
+#: the public cert scp'd separately).
+STAGE_EXCLUDE = frozenset({
+    constants.TONY_LOG_DIR, ".tony-stage.tgz", STAGE_DIGEST_FILE,
+    constants.TONY_SECRET_FILE, constants.TONY_TLS_KEY_FILE,
+    ".gcs-token", ".history-config.xml",
+    constants.COORDINATOR_ADDR_FILE, constants.FINAL_STATUS_FILE,
+    constants.FINAL_STATUS_FILE + ".tmp",
+})
+
+
+def compute_stage_digest(job_dir: str) -> str:
+    """sha256 over everything the stage tarball would ship from
+    ``job_dir`` (top-level STAGE_EXCLUDE entries pruned — the same set
+    the tarball skips), in sorted-walk order: file contents AND
+    permission bits, symlink targets (file and directory links alike —
+    ``os.walk`` lists unfollowed dir-symlinks under ``dirs``), and
+    directory entries themselves (an added empty dir changes the tree).
+    Deliberately mtime-free: the gzip header of a rebuilt tarball
+    carries a fresh mtime, so hashing tarball BYTES would never match
+    across coordinator attempts even when nothing changed."""
+    h = hashlib.sha256()
+    base = os.path.abspath(job_dir)
+
+    def mode_of(path: str) -> bytes:
+        try:
+            return oct(os.lstat(path).st_mode & 0o7777).encode()
+        except OSError:
+            return b"?"
+
+    def entry(kind: bytes, relp: str, tail: bytes) -> None:
+        h.update(kind + relp.encode() + b"\0" + tail + b"\0")
+
+    for root, dirs, files in os.walk(base):
+        if root == base:
+            dirs[:] = sorted(d for d in dirs if d not in STAGE_EXCLUDE)
+            files = [f for f in files if f not in STAGE_EXCLUDE]
+        else:
+            dirs.sort()
+        rel = os.path.relpath(root, base)
+        for name in dirs:
+            path = os.path.join(root, name)
+            relp = os.path.normpath(os.path.join(rel, name))
+            if os.path.islink(path):
+                entry(b"l", relp, os.readlink(path).encode())
+            else:
+                entry(b"d", relp, mode_of(path))
+        for name in sorted(files):
+            path = os.path.join(root, name)
+            relp = os.path.normpath(os.path.join(rel, name))
+            if os.path.islink(path):
+                entry(b"l", relp, os.readlink(path).encode())
+                continue
+            entry(b"f", relp, mode_of(path))
+            try:
+                with open(path, "rb") as f:
+                    for chunk in iter(lambda: f.read(1 << 20), b""):
+                        h.update(chunk)
+            except OSError:
+                # vanished mid-walk (a racing writer): salt the digest so
+                # the stage ships rather than stamping unverified content
+                h.update(os.urandom(16))
+            h.update(b"\0")
+    return h.hexdigest()
 
 
 class TpuProvisioningError(RuntimeError):
@@ -127,6 +204,11 @@ class TpuSliceBackend(SchedulerBackend):
         self._state_cache: dict[str, str] = {}
         self._state_ts: dict[str, float] = {}
         self._artifacts_ready = False
+        #: content digest of the stage artifacts, set when they are built
+        self._stage_digest: str | None = None
+        #: drained by the coordinator via take_launch_timings()
+        self._timings: list[dict] = []
+        self._timings_lock = threading.Lock()
         if not dry_run:
             if shutil.which("gcloud") is None:
                 raise TpuProvisioningError(
@@ -219,13 +301,28 @@ class TpuSliceBackend(SchedulerBackend):
                 f"--project={self.project}", f"--zone={self.zone}",
                 "--worker=all", "--quiet"]
 
+    def stage_probe_command(self, job_type: str, digest: str,
+                            slice_idx: int = 0) -> list[str]:
+        """One ssh across the gang checking every host's content stamp
+        against ``digest``. Exit 0 (all hosts match) means the staged tree
+        is byte-identical to what we would ship — the scp+untar (or gsutil
+        rsync) is skipped entirely; any mismatch/missing stamp falls back
+        to the idempotent full re-stage."""
+        probe = (f'[ "$(cat {REMOTE_JOB_DIR}/{STAGE_DIGEST_FILE} '
+                 f'2>/dev/null)" = "{digest}" ]')
+        return self.ssh_command(job_type, "all", probe, slice_idx)
+
     def stage_commands(self, job_type: str, job_dir: str,
-                       slice_idx: int = 0) -> list[list[str]]:
+                       slice_idx: int = 0,
+                       digest: str | None = None) -> list[list[str]]:
         """Command plan localizing the job dir onto every slice host
         (reference: TonyApplicationMaster.java:1090-1104). gs:// pull when
         the client staged remotely, tarball-over-scp otherwise. The per-job
         auth secret travels ONLY as a chmod-600 scp'd file — never in the
-        tarball (user-readable paths), the bucket, or any command argv."""
+        tarball (user-readable paths), the bucket, or any command argv.
+        With ``digest``, the content stamp is written as the LAST command
+        — only after every staging step (including the secret/cert ships)
+        succeeded, so a partial stage can never probe as complete."""
         remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
         if remote_staging:
             pull = (f"rm -rf {REMOTE_JOB_DIR} && mkdir -p {REMOTE_JOB_DIR} "
@@ -257,6 +354,11 @@ class TpuSliceBackend(SchedulerBackend):
         if os.path.exists(cert_path):
             cmds.append(self.scp_command(
                 job_type, cert_path, f"{REMOTE_JOB_DIR}/.tony-tls.crt",
+                slice_idx))
+        if digest:
+            cmds.append(self.ssh_command(
+                job_type, "all",
+                f"echo {digest} > {REMOTE_JOB_DIR}/{STAGE_DIGEST_FILE}",
                 slice_idx))
         return cmds
 
@@ -335,21 +437,7 @@ class TpuSliceBackend(SchedulerBackend):
                 is_provisioner = False
         if is_provisioner:
             try:
-                if dead:
-                    cmd = self.delete_slice_command(job_type, wait=True,
-                                                    slice_idx=slice_idx)
-                    if self.dry_run:
-                        log.info("[dry-run] %s", " ".join(cmd))
-                    else:
-                        # bounded by the SAME per-command timeout the
-                        # _await_gang deadline is derived from (7× it) —
-                        # a hardcoded bound here would let the pipeline
-                        # outrun the co-gang waiters' deadline
-                        delete_timeout = self.conf.get_int(
-                            K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
-                        subprocess.run(cmd, capture_output=True,
-                                       timeout=delete_timeout)
-                self._provision(job_type, slice_idx, spec)
+                self._provision(job_type, slice_idx, spec, reprovision=dead)
             except BaseException:
                 with self._lock:
                     # Only retract OUR generation — a concurrent retry may
@@ -389,12 +477,18 @@ class TpuSliceBackend(SchedulerBackend):
             if self.dry_run:
                 log.info("[dry-run] %s", " ".join(cmd))
                 return
-            self._procs[spec.task_id] = subprocess.Popen(
-                cmd, stdout=open(os.path.join(
+            t0 = time.monotonic()
+            # Popen dups the log fd into the child, so the coordinator's
+            # own handle closes right here — long sessions with many
+            # restarts no longer accumulate open fds per launch.
+            with open(os.path.join(
                     spec.log_dir,
                     f"{constants.task_log_stem(spec.task_id)}.stdout"),
-                    "ab"),
-                stderr=subprocess.STDOUT)
+                    "ab") as out:
+                self._procs[spec.task_id] = subprocess.Popen(
+                    cmd, stdout=out, stderr=subprocess.STDOUT)
+        self._record_timing(self._gang_label(gang), "dispatch",
+                            time.monotonic() - t0, task=spec.task_id)
 
     def _await_gang(self, gang: tuple[str, int], timeout_s: float) -> None:
         """Wait until the gang is provisioned+staged. The deadline covers
@@ -407,16 +501,16 @@ class TpuSliceBackend(SchedulerBackend):
         that must be waited on instead."""
         # Worst case: delete (reprovision path) + (1 + create-retries)
         # creates + their backoff sleeps + (1 + stage-retries) passes over
-        # the 5 staging commands (scp tarball, unpack, scp secret, chmod,
-        # scp TLS cert), each command bounded by timeout_s; +1 command of
-        # scheduling slack so a co-gang waiter never times out while the
-        # provisioner is still succeeding.
+        # the 7 staging commands (digest probe, scp tarball, unpack, scp
+        # secret, chmod, scp TLS cert, digest stamp), each command bounded
+        # by timeout_s; +1 command of scheduling slack so a co-gang waiter
+        # never times out while the provisioner is still succeeding.
         create_r = self.conf.get_int(K.TPU_CREATE_RETRIES_KEY, 3)
         stage_r = self.conf.get_int(K.TPU_STAGE_RETRIES_KEY, 2)
         backoff = self.conf.get_int(K.TPU_RETRY_BACKOFF_KEY, 5000) / 1000
         backoff_total = sum(min(backoff * 2 ** i, 60.0)
                             for i in range(create_r))
-        worst_cmds = 1 + (1 + create_r) + 5 * (1 + stage_r) + 1
+        worst_cmds = 1 + (1 + create_r) + 7 * (1 + stage_r) + 1
         deadline = time.monotonic() + worst_cmds * timeout_s + backoff_total
         while True:
             with self._lock:
@@ -433,15 +527,28 @@ class TpuSliceBackend(SchedulerBackend):
                     f"timed out waiting for gang {self._gang_label(gang)} "
                     f"to provision")
 
-    def _provision(self, job_type: str, slice_idx: int,
-                   spec: LaunchSpec) -> None:
-        """Create + stage one gang. Runs WITHOUT self._lock (launch_task
-        claimed the gang first); touches no shared state."""
+    def _provision(self, job_type: str, slice_idx: int, spec: LaunchSpec,
+                   reprovision: bool = False) -> None:
+        """Create + stage one gang (``reprovision``: synchronously delete
+        the dead slice first — a create with the same name must not race
+        the delete). Runs WITHOUT self._lock (launch_task claimed the gang
+        first); touches no shared state beyond the timing log."""
         gang = self._gang_label((job_type, slice_idx))
-        cmd = self.create_slice_command(job_type, spec.tpu_topology,
-                                        slice_idx)
         timeout_s = self.conf.get_int(K.TPU_PROVISION_TIMEOUT_KEY, 600000) / 1000
         backoff_s = self.conf.get_int(K.TPU_RETRY_BACKOFF_KEY, 5000) / 1000
+        t0 = time.monotonic()
+        if reprovision:
+            # bounded by the SAME per-command timeout the _await_gang
+            # deadline is derived from — a hardcoded bound here would let
+            # the pipeline outrun the co-gang waiters' deadline
+            cmd = self.delete_slice_command(job_type, wait=True,
+                                            slice_idx=slice_idx)
+            if self.dry_run:
+                log.info("[dry-run] %s", " ".join(cmd))
+            else:
+                subprocess.run(cmd, capture_output=True, timeout=timeout_s)
+        cmd = self.create_slice_command(job_type, spec.tpu_topology,
+                                        slice_idx)
         if self.dry_run:
             log.info("[dry-run] %s", " ".join(cmd))
         else:
@@ -468,6 +575,20 @@ class TpuSliceBackend(SchedulerBackend):
                     ok, stderr, retryable = False, "create timed out", True
                 if ok:
                     break
+                if ("ALREADY_EXISTS" in stderr
+                        or "already exists" in stderr) and not reprovision:
+                    # Warm restart: the slice survives from a previous
+                    # coordinator attempt. Adopt it — the staging step
+                    # below probes the content stamp and re-ships only on
+                    # mismatch, so the surviving gang comes up in ~0.
+                    # NOT on the reprovision path: there ALREADY_EXISTS
+                    # means the delete of the DEAD slice failed, and
+                    # adopting it would stage onto a preempted VM — fail
+                    # loudly instead (a later retry re-detects the dead
+                    # state via the refreshed poller and re-deletes).
+                    log.info("slice for %s already exists — adopting the "
+                             "surviving slice", gang)
+                    break
                 if creates_left <= 0 or not retryable:
                     raise TpuProvisioningError(
                         f"slice provisioning failed for {gang}: {stderr}")
@@ -479,6 +600,8 @@ class TpuSliceBackend(SchedulerBackend):
                     backoff_s, creates_left)
                 time.sleep(backoff_s)
                 backoff_s = min(backoff_s * 2, 60.0)
+        self._record_timing(gang, "provision", time.monotonic() - t0,
+                            reprovision=reprovision)
         # Staging re-runs from the top on a dropped connection: the
         # command sequence is idempotent (rm -rf + mkdir + untar; scp
         # overwrites), so a mid-sequence ssh/scp failure — or a HUNG one
@@ -524,23 +647,26 @@ class TpuSliceBackend(SchedulerBackend):
         shutil.copytree(
             pkg_src, fw_dst,
             ignore=shutil.ignore_patterns("__pycache__", "*.pyc"))
-        exclude = {"logs", ".tony-secret", ".tony-stage.tgz"}
         remote_staging = self.conf.get(K.REMOTE_JOB_DIR_KEY) or ""
         if remote_staging:
             # gs:// mode: the client already pushed the job dir; add the
-            # framework so hosts pull ONE complete tree.
+            # framework so hosts pull ONE complete tree. The digest is
+            # computed over the LOCAL spool (framework included) — the
+            # same content the hosts rsync down.
             from tony_tpu.storage import sjoin, storage_for
             storage_for(remote_staging).put_tree(
                 os.path.join(job_dir, FRAMEWORK_DIR),
                 sjoin(remote_staging, FRAMEWORK_DIR))
+            self._stage_digest = compute_stage_digest(job_dir)
             self._artifacts_ready = True    # only after the work succeeded
             return
         tarball = os.path.join(job_dir, ".tony-stage.tgz")
         with tarfile.open(tarball, "w:gz") as tf:
             for name in sorted(os.listdir(job_dir)):
-                if name in exclude:
+                if name in STAGE_EXCLUDE:
                     continue
                 tf.add(os.path.join(job_dir, name), arcname=name)
+        self._stage_digest = compute_stage_digest(job_dir)
         self._artifacts_ready = True        # only after the work succeeded
 
     def _stage(self, job_type: str, slice_idx: int, spec: LaunchSpec,
@@ -551,9 +677,32 @@ class TpuSliceBackend(SchedulerBackend):
                 raise TpuProvisioningError(
                     f"cannot stage {job_type}: launch spec has no job dir")
             job_dir = "<job-dir>"    # command-plan inspection only
+        digest = None
         if not self.dry_run:
             self._prepare_stage_artifacts(job_dir)
-        for cmd in self.stage_commands(job_type, job_dir, slice_idx):
+            digest = self._stage_digest
+        gang = self._gang_label((job_type, slice_idx))
+        t0 = time.monotonic()
+        if digest:
+            # Check-then-ship: one ssh probe of the per-host content stamp.
+            # A match means the staged tree is byte-identical (session
+            # retry / warm restart onto a surviving slice) — skip the
+            # whole scp+untar. Any probe failure (missing stamp, fresh
+            # slice, hung ssh) falls through to the idempotent full stage.
+            try:
+                res = subprocess.run(
+                    self.stage_probe_command(job_type, digest, slice_idx),
+                    capture_output=True, timeout=timeout_s)
+                if res.returncode == 0:
+                    log.info("stage digest match for %s — skipping ship",
+                             gang)
+                    self._record_timing(gang, "stage",
+                                        time.monotonic() - t0, cached=True)
+                    return
+            except subprocess.TimeoutExpired:
+                pass
+        for cmd in self.stage_commands(job_type, job_dir, slice_idx,
+                                       digest=digest):
             if self.dry_run:
                 log.info("[dry-run] %s", " ".join(cmd))
                 continue
@@ -563,6 +712,20 @@ class TpuSliceBackend(SchedulerBackend):
             if res.returncode != 0:
                 raise TpuProvisioningError(
                     f"staging failed for {job_type}: {res.stderr}")
+        self._record_timing(gang, "stage", time.monotonic() - t0,
+                            cached=False)
+
+    def _record_timing(self, gang: str, phase: str, seconds: float,
+                       **extra) -> None:
+        rec = {"gang": gang, "phase": phase,
+               "seconds": round(seconds, 6), **extra}
+        with self._timings_lock:
+            self._timings.append(rec)
+
+    def take_launch_timings(self) -> list[dict]:
+        with self._timings_lock:
+            recs, self._timings = self._timings, []
+        return recs
 
     def _slice_state(self, gang: tuple[str, int]) -> str:
         if self.dry_run:

@@ -52,8 +52,10 @@ from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
 
 log = logging.getLogger("tony_tpu.coordinator")
 
-COORDINATOR_ADDR_FILE = "coordinator.addr"
-FINAL_STATUS_FILE = "final-status.json"
+# Re-exported from constants (the backend's stage-digest exclusions need
+# the names without importing this module); client code imports them here.
+COORDINATOR_ADDR_FILE = constants.COORDINATOR_ADDR_FILE
+FINAL_STATUS_FILE = constants.FINAL_STATUS_FILE
 
 
 def make_backend(conf: TonyConfig, app_id: str = "app") -> SchedulerBackend:
@@ -206,6 +208,20 @@ class Coordinator:
         self._metrics_interval_s = conf.get_int(
             K.METRICS_SNAPSHOT_INTERVAL_KEY, 5000) / 1000.0
         self._metrics_last_emit = time.monotonic()
+        # Launch fan-out (tony.launch.max-concurrent): schedule_tasks
+        # dispatches backend launches on semaphore-bounded DAEMON threads
+        # so an N-gang bring-up costs max-of-gangs wall, not sum. Daemon
+        # on purpose — ThreadPoolExecutor's non-daemon workers are joined
+        # by an atexit hook, which would hold a killed coordinator
+        # process hostage to a minutes-long in-flight gcloud create even
+        # after stop()'s bounded drain gave up. The inflight counter +
+        # condition lets session resets / teardown drain launches before
+        # the kill sweep.
+        self._launch_sema: threading.BoundedSemaphore | None = None
+        self._launch_lock = threading.Lock()
+        self._launch_cv = threading.Condition(self._launch_lock)
+        self._launch_inflight = 0
+        self._launch_errors: list[str] = []
 
     # ------------------------------------------------------------------
     # RPC-driven hooks
@@ -302,6 +318,24 @@ class Coordinator:
         global resources into each container)."""
         import filecmp
         import shutil
+
+        def _same_tree(a: str, b: str) -> bool:
+            # ignore=[], hide=[]: dircmp's DEFAULT_IGNORES would silently
+            # exclude .git/__pycache__/... from the comparison, and
+            # common_funny holds type mismatches (file vs dir) — both
+            # must count as "different", or the dedup would hand one job
+            # type another's tree.
+            cmp = filecmp.dircmp(a, b, ignore=[], hide=[])
+            if cmp.left_only or cmp.right_only or cmp.funny_files \
+                    or cmp.common_funny:
+                return False
+            _, mismatch, errors = filecmp.cmpfiles(
+                a, b, cmp.common_files, shallow=False)
+            if mismatch or errors:
+                return False
+            return all(_same_tree(os.path.join(a, d), os.path.join(b, d))
+                       for d in cmp.common_dirs)
+
         for path in filter(None, (request.resources or "").split(",")):
             path = path.strip()
             if not path:
@@ -309,10 +343,14 @@ class Coordinator:
             dst = os.path.join(self.job_dir, os.path.basename(path))
             if os.path.exists(dst):
                 # Resources are flattened by basename; a silent skip would
-                # hand one job type another's file. Identical content (same
-                # file listed by several job types) is fine.
+                # hand one job type another's file. Identical content (the
+                # same file OR directory tree listed by several job types)
+                # is fine.
                 if os.path.isfile(path) and os.path.isfile(dst) and \
                         filecmp.cmp(path, dst, shallow=False):
+                    continue
+                if os.path.isdir(path) and os.path.isdir(dst) and \
+                        _same_tree(path, dst):
                     continue
                 raise ValueError(
                     f"{request.job_type}: resource {path!r} collides with an "
@@ -326,17 +364,87 @@ class Coordinator:
                     f"{request.job_type}: resource {path!r} does not exist")
 
     def schedule_tasks(self, user_command: str) -> None:
-        """Bind every task to an allocation and launch it (reference:
-        scheduleTasks:549 + ContainerLauncher.run:1080)."""
+        """Bind every task to an allocation and fan the launches out
+        through the bounded launch pool (reference: scheduleTasks:549 +
+        ContainerLauncher.run:1080 — made concurrent: provisioning and
+        staging one TPU gang takes minutes, and the backend's
+        claim-or-wait gang logic already tolerates concurrent callers, so
+        an N-gang job's bring-up wall is max-of-gangs instead of sum).
+        Returns once every launch is SUBMITTED — the monitor loop starts
+        while launches are still in flight, and a launch failure funnels
+        into record_completion like any other task failure instead of
+        aborting the scheduling pass."""
         self._user_command = user_command   # per-task restarts rebuild specs
         requests = self.session.requests
+        bindings = []
         for job_type, request in requests.items():
             self._localize_resources(request)
             while True:
                 task = self.session.next_allocation(job_type)
                 if task is None:
                     break
-                self._launch_task(task, request, user_command)
+                bindings.append((task, request))
+        for task, request in bindings:
+            self._submit_launch(task, request, user_command)
+
+    def _submit_launch(self, task, request, user_command: str) -> None:
+        if self._launch_sema is None:
+            self._launch_sema = threading.BoundedSemaphore(
+                max(1, self.conf.get_int(K.LAUNCH_MAX_CONCURRENT_KEY, 8)))
+        with self._launch_cv:
+            self._launch_inflight += 1
+
+        def run():
+            try:
+                with self._launch_sema:
+                    self._guarded_launch(task, request, user_command)
+            finally:
+                with self._launch_cv:
+                    self._launch_inflight -= 1
+                    self._launch_cv.notify_all()
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"tony-launch-{task.task_id}").start()
+
+    def _guarded_launch(self, task, request, user_command: str) -> None:
+        """Pool-side launch wrapper: re-checks job liveness at launch time
+        (the session verdict — or a client kill — may land while this
+        launch waits for a pool slot) and funnels failures into
+        record_completion, so a failed provision fails the TASK and the
+        monitor's normal reduction/retry machinery takes over."""
+        with self._completion_lock:
+            live = (task.session_id == self.session.session_id
+                    and self.session.status is SessionStatus.RUNNING
+                    and self.final_status is None
+                    and not self.client_signalled_finish.is_set())
+        if not live:
+            log.info("skipping launch of %s — session verdict landed first",
+                     task.task_id)
+            return
+        try:
+            self._launch_task(task, request, user_command)
+        except Exception as e:
+            log.exception("launch of %s failed", task.task_id)
+            with self._launch_lock:
+                self._launch_errors.append(
+                    f"launch of {task.task_id} failed: {e}")
+            self.record_completion(task.job_type, task.index, 1)
+
+    def _drain_launches(self, timeout: float | None = None) -> None:
+        """Wait out in-flight launches before a session reset or teardown:
+        a launch landing AFTER the kill sweep would inject a zombie
+        process (or a freshly provisioned slice) into the next session /
+        past stop()."""
+        deadline = (time.monotonic() + timeout) if timeout else None
+        with self._launch_cv:
+            while self._launch_inflight:
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    log.warning("%d launch(es) still in flight after "
+                                "drain — proceeding", self._launch_inflight)
+                    return
+                self._launch_cv.wait(timeout=remaining)
 
     def _launch_task(self, task, request, user_command: str) -> None:
         """Launch one bound task (shared by initial scheduling and
@@ -479,8 +587,23 @@ class Coordinator:
                         and self.final_status is None
                         and not self.client_signalled_finish.is_set())
             if live:
-                self._launch_task(relaunch, self.session.requests[job_type],
-                                  self._user_command)
+                try:
+                    self._launch_task(relaunch,
+                                      self.session.requests[job_type],
+                                      self._user_command)
+                except Exception as e:
+                    # A failed RELAUNCH funnels like any launch failure —
+                    # each recursion consumes restart budget, so this
+                    # terminates with the task marked FAILED. Raising
+                    # instead would kill the calling launch/RPC/monitor
+                    # thread and strand the task in SCHEDULED (never
+                    # completed → the monitor loop would spin forever).
+                    log.exception("relaunch of %s failed",
+                                  relaunch.task_id)
+                    with self._launch_lock:
+                        self._launch_errors.append(
+                            f"relaunch of {relaunch.task_id} failed: {e}")
+                    self.record_completion(job_type, index, 1)
             else:
                 log.info("skipping restart launch of %s — session verdict "
                          "landed first", relaunch.task_id)
@@ -513,6 +636,26 @@ class Coordinator:
             self.hb_monitor.unregister(c.task_id)
             self.record_completion(jt, idx, c.exit_code, preempted=c.preempted)
 
+    _STARTUP_PHASES = ("provision", "stage", "dispatch")
+
+    def _drain_launch_timings(self) -> None:
+        """Fold backend bring-up walls into per-gang
+        ``tony_startup_<phase>_seconds`` gauges — they ride the
+        coordinator's own registry into METRICS_SNAPSHOT as pseudo-task
+        am:0, hence the history server's live /metrics exposition and the
+        jhist replay — and emit each record as a LAUNCH jhist event so
+        the history UI can show where bring-up time went."""
+        for rec in self.backend.take_launch_timings():
+            phase = rec.get("phase")
+            if phase in self._STARTUP_PHASES:
+                metrics_mod.get_default().gauge(
+                    f"tony_startup_{phase}_seconds",
+                    help=f"wall seconds this gang's last {phase} took",
+                    gang=str(rec.get("gang", ""))).set(
+                        float(rec.get("seconds", 0.0)))
+            self.events.emit(ev.LAUNCH,
+                             session_id=self.session.session_id, **rec)
+
     def _maybe_emit_metrics(self, force: bool = False) -> None:
         """Fold the per-task snapshot table (plus the coordinator's own
         registry as pseudo-task "am:0" — missed-heartbeat counters,
@@ -541,6 +684,7 @@ class Coordinator:
         while True:
             time.sleep(self.MONITOR_PERIOD_S)
             self._apply_completions(self.backend.poll_completed())
+            self._drain_launch_timings()
             self._maybe_emit_metrics()
             if self.timeout_s > 0 and time.monotonic() - started_at > self.timeout_s:
                 self.failure_message = (
@@ -774,7 +918,12 @@ class Coordinator:
                     self.retries_left)
             else:
                 break
-            # reset (reference: reset:570-585): stop everything, new session
+            # reset (reference: reset:570-585): stop everything, new session.
+            # In-flight launches from the failed session must land (or be
+            # skipped by their liveness check — the verdict is set by now)
+            # BEFORE the kill sweep, or a late launch would inject a
+            # zombie into the new session's gang.
+            self._drain_launches()
             self.backend.kill_all()
             # drain completion events from the killed generation so they are
             # not misattributed to the new session
@@ -792,6 +941,10 @@ class Coordinator:
             # completions (session-id filtering already drops cross-session
             # RPC reports, but process-exit reports carry no session id)
             self._restart_dup.clear()
+            # the dead session's launch errors must not mislabel a LATER
+            # failure at stop() (the new session re-records its own)
+            with self._launch_lock:
+                self._launch_errors.clear()
             # the table holds the dead generation's snapshots; the new
             # session's executors repopulate it within one heartbeat
             self.metrics_table.clear()
@@ -840,6 +993,15 @@ class Coordinator:
     def stop(self, status: SessionStatus) -> int:
         self.final_status = status.value
         self.failure_message = self.failure_message or self.session.failure_message
+        with self._launch_lock:
+            launch_error = self._launch_errors[0] if self._launch_errors \
+                else None
+        if status is not SessionStatus.SUCCEEDED and launch_error:
+            # A funneled launch failure reduces to a generic exit-code
+            # line; attach the backend's actionable provisioning error.
+            self.failure_message = (
+                f"{self.failure_message} ({launch_error})"
+                if self.failure_message else launch_error)
         log.info("application finished: %s (%s)", self.final_status,
                  self.failure_message or "ok")
         # Final-status file FIRST — it is the client's authoritative signal,
@@ -853,12 +1015,20 @@ class Coordinator:
             json.dump(final, f)
         os.replace(tmp, os.path.join(self.job_dir, FINAL_STATUS_FILE))
         self._kill_preprocess()
+        # In-flight launches finish (or skip on their final_status check)
+        # before the kill sweep; bounded so a minutes-long gcloud create
+        # can't hold a client kill hostage — a straggler past the bound is
+        # logged and the sweep proceeds.
+        self._drain_launches(
+            timeout=5 if os.environ.get("TONY_TEST_MODE") else 120)
         self.backend.kill_all()
         self.backend.stop()
         self.hb_monitor.stop()
-        # Final metrics flush BEFORE the terminal event: short jobs (and
-        # single-node jobs, which never reach the monitor loop) still get
-        # at least one METRICS_SNAPSHOT for the history replay.
+        # Final launch-timing + metrics flush BEFORE the terminal event:
+        # short jobs (and single-node jobs, which never reach the monitor
+        # loop) still get their LAUNCH events and at least one
+        # METRICS_SNAPSHOT for the history replay.
+        self._drain_launch_timings()
         self._maybe_emit_metrics(force=True)
         self.events.emit(
             ev.APPLICATION_FINISHED, app_id=self.app_id,

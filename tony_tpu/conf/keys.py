@@ -67,6 +67,16 @@ TASK_PROFILE_ENABLED_KEY = "tony.task.profile.enabled"            # per-host jax
 TASK_PROFILE_DIR_KEY = "tony.task.profile.dir"                    # trace output root
 
 # ---------------------------------------------------------------------------
+# Launch fan-out ("tony.launch.*"): how many backend launch_task calls the
+# coordinator keeps in flight at once during schedule_tasks. Provisioning
+# and staging a gang takes minutes on real TPU fleets; the backend's
+# claim-or-wait gang logic already tolerates concurrent callers, so a
+# multi-gang job's bring-up wall is max-of-gangs instead of sum-of-gangs.
+# 1 restores the old serial behavior.
+# ---------------------------------------------------------------------------
+LAUNCH_MAX_CONCURRENT_KEY = "tony.launch.max-concurrent"
+
+# ---------------------------------------------------------------------------
 # Metrics plane ("tony.metrics.*" — the TaskMonitor/MetricsRpc analog):
 # executors piggyback a registry snapshot on every heartbeat; the
 # coordinator folds its per-task last-snapshot table into a
@@ -192,6 +202,7 @@ DEFAULTS: dict[str, str] = {
     TASK_EXECUTION_TIMEOUT_KEY: "0",
     TASK_PROFILE_ENABLED_KEY: "false",
     TASK_PROFILE_DIR_KEY: "",
+    LAUNCH_MAX_CONCURRENT_KEY: "8",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
@@ -239,7 +250,8 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 
 # Keys that never denote a job type even though they match the shape.
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
-                                "scheduler", "staging", "docker", "container"})
+                                "scheduler", "staging", "docker", "container",
+                                "launch"})
 
 
 def instances_key(job_type: str) -> str:

@@ -117,6 +117,12 @@ TONY_VENV_ZIP = "venv.zip"
 TONY_VENV_DIR = "venv"
 TONY_JOB_DIR_PREFIX = ".tony"          # staging dir per-application
 TONY_LOG_DIR = "logs"
+# Coordinator-published job-dir files (the application-report channel the
+# reference got from YARN). Defined here so the TPU backend can exclude
+# these per-run volatile files from its content-addressed stage digest
+# without importing the coordinator module.
+COORDINATOR_ADDR_FILE = "coordinator.addr"
+FINAL_STATUS_FILE = "final-status.json"
 
 
 def task_log_stem(task_id: str) -> str:

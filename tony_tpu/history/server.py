@@ -471,11 +471,12 @@ class HistoryServer:
         events = self.job_events(app_id)
         if events is None:
             return None
-        # METRICS_SNAPSHOT events render as their own section below —
-        # inlining each multi-task wire blob into the timeline would bury
-        # the lifecycle events it exists to show.
+        # METRICS_SNAPSHOT and per-phase LAUNCH events render as their own
+        # sections below — inlining each multi-task wire blob / per-gang
+        # timing record into the timeline would bury the lifecycle events
+        # it exists to show.
         timeline = [e for e in events
-                    if e.event_type != ev.METRICS_SNAPSHOT]
+                    if e.event_type not in (ev.METRICS_SNAPSHOT, ev.LAUNCH)]
         rows = "".join(
             f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
             f"<td>{html.escape(e.event_type)}</td>"
@@ -484,8 +485,40 @@ class HistoryServer:
         body = ("<table><tr><th>Time (UTC)</th><th>Event</th><th>Payload</th>"
                 "</tr>" + rows + "</table>") if timeline \
             else "<p>No events.</p>"
+        body += self._render_startup_section(events)
         body += self._render_metrics_section(events)
         return _PAGE.format(title=f"Events — {html.escape(app_id)}", body=body)
+
+    @staticmethod
+    def _render_startup_section(events: list[ev.Event]) -> str:
+        """Per-gang bring-up walls from LAUNCH events: one row per timing
+        record (gang, phase, wall seconds, cache-hit flag) so operators see
+        where startup time went — and whether the content-addressed staging
+        cache skipped the ship. Empty string when the job recorded none."""
+        launches = [e for e in events if e.event_type == ev.LAUNCH]
+        if not launches:
+            return ""
+        rows = []
+        for e in launches:
+            p = e.payload
+            detail = "cache hit (ship skipped)" if p.get("cached") else (
+                "reprovision" if p.get("reprovision") else "")
+            try:
+                seconds = f"{float(p.get('seconds', 0.0)):.3f}"
+            except (TypeError, ValueError):
+                # one malformed payload must not 500 the whole job page
+                seconds = html.escape(str(p.get("seconds")))
+            rows.append(
+                f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
+                f"<td>{html.escape(str(p.get('gang', '')))}</td>"
+                f"<td>{html.escape(str(p.get('phase', '')))}</td>"
+                f"<td>{html.escape(str(p.get('task', '') or ''))}</td>"
+                f"<td>{seconds}</td>"
+                f"<td>{html.escape(detail)}</td></tr>")
+        return ("<h1>Bring-up timeline</h1>"
+                "<table><tr><th>Time (UTC)</th><th>Gang</th><th>Phase</th>"
+                "<th>Task</th><th>Wall (s)</th><th></th></tr>"
+                + "".join(rows) + "</table>")
 
     def _render_metrics_section(self, events: list[ev.Event]) -> str:
         """Per-job metrics table from the LATEST snapshot: one row per
