@@ -263,6 +263,15 @@ def main() -> None:
     # content-stamp probe skips the tarball ship entirely. Hardware-free.
     out.update(_launch_arm())
 
+    # streaming serving data plane: the persistent token-push wire vs a
+    # request/response round trip per chunk, through an injected-latency
+    # transport (LatencyProxy). Deterministic: a tiny CPU model with a
+    # fixed per-sync fetch floor standing in for device compute, so the
+    # ratio measures TRANSPORT shape, not rig noise. The tier-1 pin
+    # (tests/test_serving.py) asserts stream-vs-rr >= 2 at a 50 ms round
+    # trip and streamed wall within 1.15x of the zero-delay wall.
+    out.update(_streaming_arm())
+
     # device-prefetched vs synchronous train feed: with nonzero decode
     # cost the pipelined loop's step wall should approach the
     # pure-compute wall (decode + H2D overlap the device step) while the
@@ -497,6 +506,178 @@ def _launch_arm(num_gangs: int = 4, create_delay_s: float = 0.6,
         # 1 = the stamp probe matched on every gang: zero tarball ships
         "launch_warm_stage_skip": int(warm_ships == 0),
         "launch_warm_vs_cold": round(cold_wall / max(warm_wall, 1e-9), 2),
+    }
+
+
+def _streaming_arm(slots: int = 3, n_req: int = 6, prompt_len: int = 8,
+                   budget: int = 64, chunk: int = 4,
+                   round_trip_s: float = 0.05,
+                   fetch_floor_s: float = 0.02) -> dict:
+    """Streamed (persistent token-push) serving vs the per-chunk
+    request/response tunnel, under an injected transport round trip D.
+
+    Three runs of the SAME workload, identical tokens asserted across
+    all three:
+
+    - **streamed, zero delay**: the floor. ServingServer pushes TOKENS
+      frames as each chunk is consumed; client threads drain them off
+      one multiplexed connection.
+    - **streamed through a LatencyProxy** injecting ``round_trip_s`` of
+      round-trip latency: admissions and deltas pipeline through the
+      link, so the whole workload pays the round trip ONCE (first admit
+      half + last delta half) — wall within ~1.15x of the floor however
+      many chunks flow.
+    - **request/response baseline**: the same engine driven closed-batch
+      and sequentially with the round trip injected INTO the control
+      loop — every chunk fetch and every admission wave pays
+      ``round_trip_s`` serialized with compute. That is the
+      pre-streaming tunnel's cost model (BENCH_r05 measured it at
+      ~70-100 ms per sync on a real tunneled chip; ROADMAP item 1 names
+      it THE serving bottleneck): wall degrades by ~``(chunks +
+      admission waves) x D`` while the streamed wall does not.
+
+    Determinism: a tiny CPU model plus ``fetch_floor_s`` of injected
+    per-sync fetch wall standing in for device chunk compute (the
+    launch arm's fake-gcloud-delay technique), and a short PLUG request
+    submitted first so the engine is provably mid-burst when the real
+    admissions arrive — every run executes the same sync schedule, so
+    the ratios hold on any rig. ``serving_stream_ttft_s`` is the
+    CLIENT-side mean time-to-first-token under the delayed link
+    (includes slot-wait for the requests beyond ``slots``). The tier-1
+    and @slow test variants (tests/test_serving.py) call this function
+    directly."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.netem import LatencyProxy
+    from tony_tpu.serving.server import ServingServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    class FloorFetch(ContinuousBatcher):
+        """Injects a fixed per-sync fetch wall: the deterministic
+        stand-in for device chunk compute."""
+
+        def _fetch(self, handle):
+            if fetch_floor_s > 0:
+                time.sleep(fetch_floor_s)
+            return super()._fetch(handle)
+
+    class TunnelFetch(FloorFetch):
+        """The pre-streaming tunnel: a transport round trip serialized
+        into every chunk fetch and every admission wave."""
+
+        def _fetch(self, handle):
+            time.sleep(round_trip_s)
+            return super()._fetch(handle)
+
+        def _admit_batch(self, pairs, prompts):
+            time.sleep(round_trip_s)
+            super()._admit_batch(pairs, prompts)
+
+    rs = np.random.RandomState(11)
+    prompts = [[int(t) for t in rs.randint(0, cfg.vocab_size,
+                                           size=prompt_len)]
+               for _ in range(n_req)]
+    max_len = prompt_len + budget
+    plug_budget = 6 * chunk          # ~6 syncs of cover for admissions
+    batcher = FloorFetch(params, cfg, batch=slots, max_len=max_len,
+                         chunk=chunk)
+    batcher.serve(prompts[:slots], [chunk] * slots)     # compile + warm
+
+    def run_streamed(delay_rt):
+        # try/finally over the whole lifecycle: a mid-arm failure must
+        # not leak a live engine thread / proxy / client into the
+        # calling process (the tier-1 test imports and runs this arm)
+        srv = ServingServer(batcher, registry=M.MetricsRegistry())
+        proxy = None
+        c = None
+        try:
+            port = srv.start()
+            if delay_rt > 0:
+                proxy = LatencyProxy("127.0.0.1", port, delay_rt / 2)
+                port = proxy.start()
+            outs: list = [None] * n_req
+            ttfts: list = [0.0] * n_req
+            c = StreamingClient("127.0.0.1", port)
+
+            def drain(i, rid, t_submit):
+                toks, first = [], None
+                for delta in c.deltas(rid):
+                    if first is None:
+                        first = time.perf_counter()
+                    toks.extend(delta)
+                outs[i] = toks
+                ttfts[i] = (first or time.perf_counter()) - t_submit
+
+            t0 = time.perf_counter()
+            # the plug: a short request that keeps the engine mid-burst
+            # while the real admissions travel, so they all land in ONE
+            # settle — the open-loop schedule becomes deterministic
+            plug = c.submit(prompts[0], plug_budget)
+            c.next_event(plug, timeout=60)       # its first delta
+            threads = []
+            for i, p in enumerate(prompts):
+                t_submit = time.perf_counter()
+                rid = c.submit(p, budget)
+                th = threading.Thread(target=drain,
+                                      args=(i, rid, t_submit))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t0
+            syncs = batcher.phase_times.count("fetch")
+            return wall, outs, ttfts, syncs
+        finally:
+            # closing the client first cancels anything still in
+            # flight, so the engine abort below is instant either way
+            if c is not None:
+                c.close()
+            if proxy is not None:
+                proxy.stop()
+            srv.stop()
+
+    def run_rr():
+        tb = TunnelFetch(params, cfg, batch=slots, max_len=max_len,
+                         chunk=chunk, pipeline=False)
+        saved = M.set_default(M.MetricsRegistry())
+        try:
+            tb.serve(prompts[:slots], [chunk] * slots)  # warm (cheap)
+            t0 = time.perf_counter()
+            outs = tb.serve(prompts, budget)
+            wall = time.perf_counter() - t0
+        finally:
+            M.set_default(saved)
+        exchanges = (tb.phase_times.count("fetch")
+                     + tb.phase_times.count("admit"))
+        return wall, outs, exchanges
+
+    t_s0, outs0, _, syncs0 = run_streamed(0.0)
+    t_sd, outs_d, ttfts, syncs_d = run_streamed(round_trip_s)
+    t_rr, outs_rr, exchanges = run_rr()
+    assert outs0 == outs_d == outs_rr, (
+        "transport modes produced different tokens — wire corruption")
+    return {
+        "serving_stream_round_trip_s": round_trip_s,
+        "serving_stream_wall_nodelay_s": round(t_s0, 3),
+        "serving_stream_wall_s": round(t_sd, 3),
+        # ~1.0-1.15 = the round trip is paid once, pipelined away
+        "serving_stream_vs_nodelay": round(t_sd / t_s0, 3),
+        "serving_stream_syncs": syncs_d,
+        # the plug makes these equal — the determinism guard
+        "serving_stream_syncs_nodelay": syncs0,
+        "serving_rr_wall_s": round(t_rr, 3),
+        "serving_rr_round_trips": exchanges,
+        # the tentpole ratio: >= 2 at a 50 ms round trip (tier-1-pinned)
+        "serving_stream_vs_rr_wall": round(t_rr / t_sd, 2),
+        "serving_stream_ttft_s": round(sum(ttfts) / len(ttfts), 3),
     }
 
 
@@ -891,8 +1072,9 @@ def _metrics_overhead_arm(cfg, slots: int = 8, prompt_len: int = 64,
         reg.counter("bench_obs_total").inc()
     per_obs_s = (time.perf_counter() - t0) / n
     # the serve loop makes <~8 registry touches per sync (admit/retire/
-    # token/queue-depth counters) plus an O(#phases) fold per CALL
-    obs_per_sync = 8
+    # token/queue-depth counters) plus one TTFT-or-ITL histogram observe
+    # per DELTA (<= slots per sync) plus an O(#phases) fold per CALL
+    obs_per_sync = 8 + 2 * slots
     frac = per_obs_s * obs_per_sync / (t_on / syncs)
     assert frac < 0.01, (
         f"registry observations are {frac:.2%} of per-sync chunk wall — "

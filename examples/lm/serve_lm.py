@@ -18,6 +18,20 @@ Usage:
     python examples/lm/serve_lm.py --preset small --draft_preset tiny \
         --requests 16 --slots 8 --temperature 0.8
 
+Streaming data plane (tony_tpu/serving): the same batcher can serve a
+live admission queue over the persistent TONYS1 token-push protocol —
+
+    # a serving replica (model host)
+    python examples/lm/serve_lm.py --preset tiny --slots 4 \
+        --listen 0.0.0.0:7070
+    # a router front-door spreading sessions across replicas (no model)
+    python examples/lm/serve_lm.py --listen 0.0.0.0:7000 \
+        --route host1:7070,host2:7070
+    # a streaming client (no model): submits the synthetic workload and
+    # prints client-side TTFT / inter-token latency
+    python examples/lm/serve_lm.py --preset tiny --requests 12 \
+        --connect host1:7000
+
 The reference framework has no serving path (it delegates all compute —
 SURVEY.md §2.3); this example exists so a user migrating from it can see
 the green-field serving stack end to end.
@@ -37,6 +51,111 @@ from tony_tpu.models import transformer as T
 from tony_tpu.models.checkpoint import CheckpointManager
 from tony_tpu.models.serve import (ContinuousBatcher,
                                    SpeculativeContinuousBatcher)
+
+
+def _parse_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _run_server(args, batcher) -> int:
+    """--listen: drive the batcher's ServeEngine behind a streaming
+    server until interrupted, then drain gracefully."""
+    from tony_tpu.serving.server import ServingServer
+
+    host, port = _parse_addr(args.listen)
+    server = ServingServer(batcher, bind_host=host, port=port)
+    bound = server.start()
+    mode = ("speculative " if args.draft_preset else "") + (
+        "sampled" if args.temperature > 0 else "greedy")
+    print(f"serving {args.preset} ({mode}) on {host}:{bound} with "
+          f"{args.slots} slots — ^C drains and exits", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining in-flight requests ...", flush=True)
+        server.stop(drain=True)
+    return 0
+
+
+def _run_router(args) -> int:
+    """--listen + --route: the model-free front door."""
+    from tony_tpu.serving.router import ServingRouter
+
+    host, port = _parse_addr(args.listen)
+    replicas = [a.strip() for a in args.route.split(",") if a.strip()]
+    router = ServingRouter(replicas, bind_host=host, port=port)
+    bound = router.start()
+    print(f"routing on {host}:{bound} over {len(replicas)} replicas "
+          f"— ^C exits", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        router.stop()
+    return 0
+
+
+def _run_client(args) -> int:
+    """--connect: submit the synthetic workload over one persistent
+    streaming connection and report client-side TTFT / inter-token
+    latency. No model is built — prompt tokens draw from the named
+    preset's vocab, which must match the server's."""
+    import threading
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.serving.client import StreamingClient
+
+    host, port = _parse_addr(args.connect)
+    vocab = T.PRESETS[args.preset].vocab_size
+    rs = np.random.RandomState(args.seed)
+    prompts = [[int(t) for t in rs.randint(0, vocab,
+                                           size=args.prompt_len)]
+               for _ in range(args.requests)]
+    budgets = [int(b) for b in
+               rs.randint(max(1, args.max_new_tokens // 4),
+                          args.max_new_tokens + 1, size=args.requests)]
+    outs: list = [None] * args.requests
+    ttfts: list = [0.0] * args.requests
+    gaps: list[float] = []
+
+    with StreamingClient(host, port) as client:
+        print(f"connected to {host}:{port}: {client.hello}")
+
+        def drain(i, rid, t_submit):
+            toks, last = [], None
+            for delta in client.deltas(rid):
+                now = time.perf_counter()
+                if last is None:
+                    ttfts[i] = now - t_submit
+                else:
+                    gaps.append((now - last) / len(delta))
+                last = now
+                toks.extend(delta)
+            outs[i] = toks
+
+        t0 = time.perf_counter()
+        threads = []
+        for i, p in enumerate(prompts):
+            rid = client.submit(p, budgets[i])
+            th = threading.Thread(target=drain,
+                                  args=(i, rid, time.perf_counter()))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        dt = time.perf_counter() - t0
+
+    useful = sum(len(o) for o in outs if o)
+    ttfts_s = sorted(ttfts)
+    print(f"streamed {args.requests} requests ({useful} tokens) in "
+          f"{dt:.2f}s — {useful / max(dt, 1e-9):.1f} tok/s")
+    print(f"ttft: p50 {ttfts_s[len(ttfts_s) // 2] * 1e3:.0f} ms  "
+          f"max {ttfts_s[-1] * 1e3:.0f} ms;  inter-token mean "
+          f"{(sum(gaps) / len(gaps) * 1e3) if gaps else 0.0:.1f} ms")
+    print("first request tokens:", (outs[0] or [])[:12])
+    return 0
 
 
 def main() -> int:
@@ -82,7 +201,28 @@ def main() -> int:
                              "distinct prompt length; default pads to "
                              "power-of-two buckets and batches freed "
                              "slots into one dispatch)")
+    parser.add_argument("--listen", default="", metavar="HOST:PORT",
+                        help="serve a LIVE admission queue over the "
+                             "TONYS1 streaming protocol instead of the "
+                             "fixed synthetic workload (with --route: "
+                             "run the router front-door instead)")
+    parser.add_argument("--connect", default="", metavar="HOST:PORT",
+                        help="run as a streaming CLIENT against a "
+                             "--listen server or router (no local "
+                             "model; prints TTFT/ITL)")
+    parser.add_argument("--route", default="",
+                        metavar="HOST:PORT,HOST:PORT",
+                        help="with --listen: route sessions across "
+                             "these replica servers by queue depth "
+                             "(no local model)")
     args = parser.parse_args()
+
+    if args.connect:
+        return _run_client(args)
+    if args.route:
+        if not args.listen:
+            parser.error("--route requires --listen")
+        return _run_router(args)
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
@@ -134,6 +274,9 @@ def main() -> int:
             num_speculative=args.num_speculative, **kw)
     else:
         batcher = ContinuousBatcher(params, cfg, **kw)
+
+    if args.listen:
+        return _run_server(args, batcher)
 
     t0 = time.perf_counter()
     outputs = batcher.serve(prompts, budgets)

@@ -1,5 +1,9 @@
 """Continuous batching: slot reuse, per-request exactness, eos handling,
-pipelined-vs-sequential equivalence, bucketed/batched admission."""
+pipelined-vs-sequential equivalence, bucketed/batched admission, and the
+closed-batch-over-open-loop-engine equivalence pin."""
+
+import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -8,7 +12,7 @@ import pytest
 
 from tony_tpu.models import transformer as T
 from tony_tpu.models.decode import generate
-from tony_tpu.models.serve import (ContinuousBatcher,
+from tony_tpu.models.serve import (ContinuousBatcher, ServeEngine,
                                    SpeculativeContinuousBatcher)
 
 CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
@@ -717,6 +721,110 @@ class TestBucketedAdmission:
         retrace_guard.assert_max("spec_admit_row", 0)
         assert outs[0] == _reference(params, prompts[0], 5)
         assert all(len(o) == 5 for o in outs)
+
+
+class TestClosedBatchEngineEquivalence:
+    """The engine-refactor pin: ``serve()`` rebuilt as a thin wrapper
+    over the open-loop :class:`ServeEngine` stays BIT-identical in
+    outputs — and, for the single-token-per-step modes on budget-only
+    workloads, identical in ``steps_executed`` — to the pre-refactor
+    fixed-queue loop, across greedy / sampled / speculative /
+    shared-prefix modes. The pre-refactor contract is the per-mode
+    solo-generate references (PR 1's pins, all asserted above) plus
+    pipelined==sequential equality; this class additionally pins that
+    an OPEN-LOOP run (incremental submission from another thread, per-
+    request rng streams doing the heavy lifting) produces the same
+    tokens as the closed batch.
+
+    Workloads/shapes deliberately REUSE the earlier tests' (same seeds,
+    batch/max_len/chunk combos) so everything here hits already-
+    compiled programs."""
+
+    def _open_loop(self, batcher, prompts, budgets):
+        outs: dict = {i: [] for i in range(len(prompts))}
+        eng = ServeEngine(
+            batcher, on_delta=lambda r, t: outs[r].extend(t),
+            on_retired=lambda r, reason, n, final: outs[r].extend(final))
+        th = threading.Thread(target=eng.run, daemon=True)
+        th.start()
+        for i, (p, b) in enumerate(zip(prompts, budgets)):
+            eng.submit(i, p, b)
+            if i == 0:
+                time.sleep(0.05)      # a genuinely LIVE queue: later
+                #                       submits land mid-serve
+        eng.drain()
+        th.join(timeout=300)
+        assert not th.is_alive(), "engine did not drain"
+        return [outs[i] for i in range(len(prompts))]
+
+    def _pin(self, make, prompts, budgets, pin_steps=True):
+        bp = make(True)
+        outs_p = bp.serve(prompts, budgets)
+        bs = make(False)
+        outs_s = bs.serve(prompts, budgets)
+        assert outs_p == outs_s
+        if pin_steps:
+            # budget-only workloads pipeline losslessly — the engine
+            # must execute the exact chunk schedule of the sequential
+            # (pre-refactor-equivalent) loop
+            assert bp.steps_executed == bs.steps_executed
+        assert self._open_loop(make(True), prompts, budgets) == outs_p
+        return outs_p
+
+    def test_greedy(self, params):
+        rng = np.random.RandomState(0)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (5, 3, 7, 4, 6, 3)]
+        outs = self._pin(
+            lambda pipeline: ContinuousBatcher(
+                params, CFG, batch=3, max_len=32, chunk=4,
+                pipeline=pipeline),
+            prompts, [6] * 6)
+        for i, p in enumerate(prompts):
+            assert outs[i] == _reference(params, p, 6), i
+
+    def test_sampled(self, params):
+        rng = np.random.RandomState(5)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=4))
+                   for _ in range(5)]
+        self._pin(
+            lambda pipeline: ContinuousBatcher(
+                params, CFG, batch=2, max_len=32, chunk=3,
+                temperature=0.8, top_k=50, top_p=0.9, seed=0,
+                pipeline=pipeline),
+            prompts, [6] * 5)
+
+    def test_shared_prefix(self, params):
+        rs = np.random.RandomState(7)
+        prefix = [int(t) for t in rs.randint(0, CFG.vocab_size, size=9)]
+        suffixes = [list(rs.randint(0, CFG.vocab_size,
+                                    size=rs.randint(2, 6)))
+                    for _ in range(5)]
+        budgets = [int(b) for b in rs.randint(4, 9, size=5)]
+        self._pin(
+            lambda pipeline: ContinuousBatcher(
+                params, CFG, batch=2, max_len=48, chunk=3,
+                shared_prefix=prefix, pipeline=pipeline),
+            suffixes, budgets)
+
+    def test_speculative(self, params):
+        draft = T.init_params(jax.random.PRNGKey(99), CFG)
+        rng = np.random.RandomState(3)
+        prompts = [list(rng.randint(0, CFG.vocab_size,
+                                    size=rng.randint(3, 9)))
+                   for _ in range(7)]
+        budgets = [int(b) for b in rng.randint(4, 14, size=7)]
+        outs = self._pin(
+            lambda pipeline: SpeculativeContinuousBatcher(
+                params, CFG, draft, CFG, batch=3, max_len=64,
+                num_speculative=3, chunk=2, pipeline=pipeline),
+            prompts, budgets,
+            # speculative completions are acceptance-driven, not
+            # host-predictable, so the chunk schedule (unlike tokens)
+            # may legally differ pipelined-vs-sequential
+            pin_steps=False)
+        for i, (p, b) in enumerate(zip(prompts, budgets)):
+            assert outs[i] == _reference(params, p, b), i
 
 
 @pytest.mark.slow

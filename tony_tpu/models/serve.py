@@ -84,6 +84,20 @@ own tokens through the model (:func:`prefix_admit_rows` — a chunked
 ``extend_step`` against the copied prefix history), token-identical to
 serving prefix+prompt in full.
 
+The host loop itself is OPEN-LOOP (:class:`ServeEngine`): the
+issue/fetch/consume/settle cycle runs against a LIVE admission queue —
+requests are submitted (and cancelled) at any time, from any thread, and
+each request's newly generated tokens are emitted as a DELTA the moment
+the chunk that produced them is consumed, not when the request retires.
+That is what a streaming serving data plane needs: time-to-first-token
+and inter-token latency are properties of delta emission, and a
+persistent-connection server (``tony_tpu/serving/``) pushes each delta
+to its client while the next chunk is still computing.
+:meth:`ContinuousBatcher.serve` is a thin CLOSED-BATCH wrapper over the
+engine — submit everything, drain, collect — and remains token-identical
+(and ``steps_executed``-identical) to the pre-engine loop in every mode
+(test-enforced).
+
 ``TRACE_COUNTS`` records one entry per (program, static shape) TRACE —
 a Python side effect inside the jitted bodies, executed at trace time
 only — so tests (and the conftest retrace guard) can pin "bucketed
@@ -94,6 +108,8 @@ from __future__ import annotations
 
 import collections
 import functools
+import threading
+import time
 from typing import Sequence
 
 import jax
@@ -773,6 +789,23 @@ class ContinuousBatcher:
     def _retire(self, mask) -> None:
         self.cache = retire_rows(self.cache, jnp.asarray(mask))
 
+    def _validate_request(self, prompt, max_new: int) -> None:
+        """Reject a request the batcher could not serve: empty prompt,
+        non-positive budget, or (linear caches — rolling caches have no
+        length ceiling) prompt + budget past ``max_len``. Raises
+        ``ValueError`` naming the offending dimension."""
+        p_len = len(self.shared_prefix) if self.shared_prefix else 0
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if max_new <= 0:
+            raise ValueError(f"max_new_tokens must be positive, "
+                             f"got {max_new}")
+        if not self._ring and p_len + len(prompt) + max_new > self.max_len:
+            raise ValueError(
+                (f"shared prefix {p_len} + " if p_len else "")
+                + f"prompt {len(prompt)} + {max_new} new tokens exceeds "
+                  f"max_len {self.max_len}")
+
     def serve(self, prompts: Sequence, max_new_tokens):
         """Run all ``prompts`` (each a [S_p] int sequence) to completion;
         returns a list of per-request generated-token lists, order-
@@ -783,14 +816,21 @@ class ContinuousBatcher:
         ``self.phase_times`` holds per-phase host wall clock
         (dispatch/fetch/admit/retire) for the call.
 
+        A thin CLOSED-BATCH wrapper over :class:`ServeEngine`: submit
+        every request up front, drain, run the engine on the calling
+        thread, and collect each request's streamed deltas into its
+        output list. Token-identical (and ``steps_executed``-identical)
+        to the pre-engine fixed-queue loop in every mode — the engine's
+        live admission queue degenerates to the old FIFO when everything
+        is submitted before the loop starts (test-enforced).
+
         The call also observes into the default metrics registry
         (``runtime/metrics.py``): admitted/retired request counters,
-        useful-token counter, queue-depth gauge, and — on return — the
-        PhaseTimes accumulation as per-phase ``tony_serve_phase_*``
-        counters. Swap in a :class:`~tony_tpu.runtime.metrics.NullRegistry`
-        to serve uninstrumented (the bench contrast arm)."""
-        queue = list(range(len(prompts)))
-        outputs: list[list[int]] = [[] for _ in prompts]
+        useful-token counter, queue-depth gauge, TTFT/inter-token
+        histograms, and — on return — the PhaseTimes accumulation as
+        per-phase ``tony_serve_phase_*`` counters. Swap in a
+        :class:`~tony_tpu.runtime.metrics.NullRegistry` to serve
+        uninstrumented (the bench contrast arm)."""
         if isinstance(max_new_tokens, int):
             budget = [max_new_tokens] * len(prompts)
         else:
@@ -798,158 +838,25 @@ class ContinuousBatcher:
             if len(budget) != len(prompts):
                 raise ValueError("per-request max_new_tokens length "
                                  "must match prompts")
-        # validate EVERY request before admitting any: a mid-serve raise
-        # would discard completed outputs and strand the batcher state
-        p_len = len(self.shared_prefix) if self.shared_prefix else 0
+        outputs: list[list[int]] = [[] for _ in prompts]
+        engine = ServeEngine(
+            self, on_delta=lambda rid, toks: outputs[rid].extend(toks),
+            on_retired=lambda rid, reason, n, final:
+                outputs[rid].extend(final))
+        # every submit happens BEFORE run(), so a bad request anywhere
+        # in the list still fails the whole call up front — nothing is
+        # admitted, no completed output is discarded mid-serve
         for req, (p, b) in enumerate(zip(prompts, budget)):
-            if len(p) == 0:
-                raise ValueError(f"request {req}: empty prompt")
-            if b <= 0:
-                raise ValueError(f"request {req}: max_new_tokens must be "
-                                 f"positive, got {b}")
-            if not self._ring and p_len + len(p) + b > self.max_len:
-                # rolling caches have no length ceiling — the ring holds
-                # the window however long the stream runs
-                raise ValueError(
-                    f"request {req}: "
-                    + (f"shared prefix {p_len} + " if p_len else "")
-                    + f"prompt {len(p)} + {b} new tokens exceeds "
-                      f"max_len {self.max_len}")
-        occupant: list[int | None] = [None] * self.batch
-        done = [False] * len(prompts)
-        self.steps_executed = 0
-        self.rounds_executed = 0
-        self.phase_times = PhaseTimes()
-        self._reset_streams()
-
-        # Registry instrumentation: a handful of GIL-atomic increments
-        # per host SYNC (not per token — token counts batch into one inc
-        # per consume), so the hot loop pays nanoseconds per chunk
-        # (pinned by bench.py's metrics-overhead arm).
-        reg = metrics_mod.get_default()
-        admitted_c = reg.counter("tony_serve_requests_admitted_total",
-                                 help="requests admitted into cache slots")
-        retired_c = reg.counter("tony_serve_requests_retired_total",
-                                help="requests retired (eos or budget)")
-        tokens_c = reg.counter("tony_serve_tokens_total",
-                               help="useful generated tokens")
-        qdepth_g = reg.gauge("tony_serve_queue_depth",
-                             help="requests waiting for a free slot")
-        qdepth_g.set(len(queue))
-
-        def admit_into(rows_):
-            pairs = []
-            for row in rows_:
-                if queue:
-                    pairs.append((row, queue.pop(0)))
-            if pairs:
-                self._admit_batch(pairs, prompts)
-                for row, req in pairs:
-                    occupant[row] = req
-                admitted_c.inc(len(pairs))
-            qdepth_g.set(len(queue))
-
-        def consume(host_toks, snap):
-            """Apply one fetched chunk under the occupancy it was ISSUED
-            with; returns the rows it freed. Rows whose snapshot request
-            already finished (a speculatively issued chunk crossed the
-            completion) carry garbage and are skipped — the same discard
-            as idle-slot garbage."""
-            freed = []
-            appended = 0
-            for row, req in enumerate(snap):
-                if req is None or done[req]:
-                    continue
-                for t in host_toks[row]:
-                    outputs[req].append(int(t))
-                    appended += 1
-                    budget[req] -= 1
-                    if budget[req] == 0 or (self.eos_id is not None
-                                            and int(t) == self.eos_id):
-                        # surplus chunk tokens past completion discarded
-                        done[req] = True
-                        occupant[row] = None
-                        freed.append(row)
-                        break
-            if appended:
-                tokens_c.inc(appended)
-            if freed:
-                retired_c.inc(len(freed))
-            return freed
-
-        def settle(freed):
-            admit_into(freed)
-            # reset ALL unoccupied rows (not just newly freed): a slot
-            # idle across many chunks would otherwise march its garbage
-            # frontier every step until it clamps at the cache end
-            if any(o is None for o in occupant):
-                with self.phase_times.phase("retire"):
-                    self._retire([o is None for o in occupant])
-
-        admit_into(range(self.batch))
-
-        if not self.pipeline:
-            # sequential loop: issue → fetch → bookkeep → admit. The
-            # equivalence baseline and A/B arm; every fetch serializes
-            # the transport round trip with device compute.
-            while any(o is not None for o in occupant):
-                snap = list(occupant)
-                settle(consume(self._fetch(self._issue()), snap))
-            metrics_mod.observe_phase_times(self.phase_times, reg)
-            return outputs
-
-        live = [r is not None for r in occupant]
-
-        def certainly_final():
-            """The chunk about to be issued provably retires every live
-            request (budget exhaustion; eos and speculative acceptance
-            only finish EARLIER, and every speculative round commits
-            >= 1 token) with nothing queued — issuing past it would be a
-            guaranteed-garbage dispatch."""
-            return not queue and all(
-                budget[req] <= self.chunk
-                for req in occupant if req is not None and not done[req])
-
-        def defer_issue(snap):
-            """Process the in-flight chunk BEFORE issuing the next one
-            when the host can PREDICT a completion with requests still
-            queued: budget exhaustion is host-visible ahead of time, and
-            issuing across it would run the freed slot idle for a whole
-            chunk — a step-utilization loss the sequential loop doesn't
-            pay. Unpredictable completions (eos mid-chunk) are NOT
-            deferred for — the loop stays optimistic and catches up
-            after the fact (the freed row's speculatively-issued chunk
-            is discarded as garbage). Budget-only workloads therefore
-            pipeline LOSSLESSLY: chunk count, admission timing, and
-            utilization all match the sequential loop."""
-            return bool(queue) and any(
-                req is not None and not done[req]
-                and budget[req] <= self._chunk_tokens_max()
-                for req in snap)
-
-        inflight = ((self._issue(), list(occupant))
-                    if any(live) else None)
-        while inflight is not None:
-            handle, snap = inflight
-            nxt = None
-            if not certainly_final() and not defer_issue(snap):
-                # double-buffer: chunk N+1 enters the device queue before
-                # chunk N's fetch blocks on the transport
-                nxt = (self._issue(), list(occupant))
-            freed = consume(self._fetch(handle), snap)
-            settle(freed)
-            if nxt is not None and all(o is None for o in occupant):
-                # every request retired while the speculative chunk was
-                # in flight (eos beat the budget bound): drop it
-                # unfetched — all its rows are garbage
-                nxt = None
-            if nxt is None and any(o is not None for o in occupant):
-                nxt = (self._issue(), list(occupant))
-            inflight = nxt
-        # fold the call's PhaseTimes accumulation into the registry (the
-        # PhaseTimes→metrics bridge: per-phase seconds/ops counters stay
-        # monotonic across serve() calls while .phase_times itself resets)
-        metrics_mod.observe_phase_times(self.phase_times, reg)
+            try:
+                engine.submit(req, p, b)
+            except ValueError as e:
+                # unwind the earlier submits (clears the wait queue and
+                # zeroes the queue-depth gauge — no phantom depth from
+                # an engine that never runs)
+                engine._abort_outstanding("stopped")
+                raise ValueError(f"request {req}: {e}") from None
+        engine.drain()
+        engine.run()
         return outputs
 
 
@@ -1103,3 +1010,451 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         m = jnp.asarray(mask)
         self.cache = retire_rows(self.cache, m)
         self.d_cache = retire_rows(self.d_cache, m)
+
+
+class _EngineRequest:
+    """Engine-side record of one live request. ``stream`` is the
+    request's rng-stream index (assigned in submission order, so the
+    closed-batch wrapper reproduces the fixed-queue loop's per-request
+    streams exactly); ``budget`` counts REMAINING tokens."""
+
+    __slots__ = ("rid", "prompt", "budget", "stream", "emitted", "done",
+                 "reason", "t_submit", "t_last")
+
+    def __init__(self, rid, prompt, budget: int, stream: int,
+                 t_submit: float) -> None:
+        self.rid = rid
+        self.prompt = prompt
+        self.budget = budget
+        self.stream = stream
+        self.emitted = 0
+        self.done = False
+        self.reason: str | None = None
+        self.t_submit = t_submit
+        self.t_last = t_submit
+
+
+class ServeEngine:
+    """Open-loop serving engine: the issue/fetch/consume/settle loop of
+    a :class:`ContinuousBatcher` (or its speculative subclass) run
+    against a LIVE admission queue.
+
+    - :meth:`submit`/:meth:`cancel` are thread-safe and callable while
+      :meth:`run` is live — a streaming server's per-connection reader
+      threads feed admissions straight into the loop.
+    - ``on_delta(rid, tokens)`` fires the moment a chunk's tokens for a
+      request are consumed (NOT on retirement) — the emission point
+      time-to-first-token and inter-token latency are measured at
+      (``tony_serve_ttft_seconds`` / ``tony_serve_intertoken_seconds``
+      land in the registry here).
+    - ``on_retired(rid, reason, n_tokens, final_tokens)`` fires exactly
+      once per request, reason one of ``"eos"``/``"budget"``/
+      ``"cancelled"``/``"stopped"``. A request retiring on eos/budget
+      delivers its LAST delta here (``final_tokens``) rather than
+      through ``on_delta``, so a transport can write the final tokens
+      and the retirement atomically — a peer can then never observe
+      the one without the other.
+    - :meth:`drain` is the graceful shutdown: no further submits, run()
+      returns once every accepted request has retired. :meth:`stop`
+      aborts — outstanding requests retire as ``"stopped"``.
+
+    Callback threading: deltas and eos/budget retirements fire on the
+    thread driving :meth:`run`; a ``"cancelled"`` retirement fires on
+    the CANCELLING thread (so a streaming client sees its CANCEL
+    acknowledged without waiting out the in-flight chunk). Consumers
+    that serialize writes (the frame server) take a per-connection send
+    lock. A delta already being consumed when its request is cancelled
+    may still be emitted after the retirement — cancellation discards,
+    so late tokens for a retired rid are dropped by the caller.
+
+    Cancel semantics reuse the pipelined loop's proven catch-up path: a
+    cancelled occupant is only MARKED done; the slot frees when the next
+    consumed chunk crosses it (its tokens are discarded exactly like
+    idle-slot garbage, and the freed slot readmits from the live
+    queue). CANCEL racing retirement is idempotent — unknown or
+    already-done rids are no-ops.
+
+    One engine run per batcher at a time; creating the engine resets the
+    batcher's per-serve state (``steps_executed``, phase times, rng
+    streams), exactly as ``serve()`` did before the refactor.
+    """
+
+    def __init__(self, batcher: ContinuousBatcher, on_delta=None,
+                 on_retired=None, registry=None) -> None:
+        # guard BEFORE the state reset below: constructing a second
+        # engine over a live one would silently rebind the running
+        # engine's rng streams and counters mid-flight
+        if getattr(batcher, "_engine_running", False):
+            raise RuntimeError("batcher is already driven by a live "
+                               "engine")
+        self.b = batcher
+        self.on_delta = on_delta
+        self.on_retired = on_retired
+        self._lock = threading.Lock()
+        self._work = threading.Condition(self._lock)
+        #: rids waiting for a slot, FIFO (deque: O(1) admission pops —
+        #: the old list-queue's pop(0) was O(n) per admission)
+        self._wait: collections.deque = collections.deque()
+        self._reqs: dict = {}                    # rid -> _EngineRequest
+        self._occupant: list[_EngineRequest | None] = \
+            [None] * batcher.batch
+        self._draining = False
+        self._stopped = False
+        self._next_stream = 0
+        # one engine == one serve lifetime: the closed-batch serve()'s
+        # per-call reset moved here
+        batcher.steps_executed = 0
+        batcher.rounds_executed = 0
+        batcher.phase_times = PhaseTimes()
+        batcher._reset_streams()
+        # Registry instrumentation: a handful of locked increments per
+        # host SYNC (token counts batch into one inc per consume; the
+        # TTFT/ITL histograms observe once per DELTA, <= slots per
+        # sync), pinned < 1% of chunk wall by bench.py's overhead arm.
+        reg = registry or metrics_mod.get_default()
+        self._reg = reg
+        self._admitted_c = reg.counter(
+            "tony_serve_requests_admitted_total",
+            help="requests admitted into cache slots")
+        self._retired_c = reg.counter(
+            "tony_serve_requests_retired_total",
+            help="requests retired (eos or budget)")
+        self._cancelled_c = reg.counter(
+            "tony_serve_requests_cancelled_total",
+            help="requests cancelled before completion")
+        self._tokens_c = reg.counter("tony_serve_tokens_total",
+                                     help="useful generated tokens")
+        self._qdepth_g = reg.gauge("tony_serve_queue_depth",
+                                   help="requests waiting for a free slot")
+        self._ttft_h = reg.histogram(
+            "tony_serve_ttft_seconds",
+            help="submit -> first consumed token delta (time to first "
+                 "token, engine-side)")
+        self._itl_h = reg.histogram(
+            "tony_serve_intertoken_seconds",
+            help="mean per-token gap of each consumed delta after a "
+                 "request's first (inter-token latency, engine-side)")
+        self._qdepth_g.set(0)
+
+    # --- thread-safe control surface ---
+
+    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+        """Enqueue a request under caller-chosen id ``rid`` (any
+        hashable; must not collide with a LIVE request's). Raises
+        ``ValueError`` for un-servable requests (validated up front, so
+        a bad request never strands engine state) and ``RuntimeError``
+        once draining/stopped."""
+        prompt = [int(t) for t in prompt]
+        max_new_tokens = int(max_new_tokens)
+        self.b._validate_request(prompt, max_new_tokens)
+        with self._work:
+            if self._draining or self._stopped:
+                raise RuntimeError(
+                    "engine is draining; not accepting new requests")
+            if rid in self._reqs:
+                raise ValueError(f"request id {rid!r} is already active")
+            req = _EngineRequest(rid, prompt, max_new_tokens,
+                                 self._next_stream, time.perf_counter())
+            self._next_stream += 1
+            self._reqs[rid] = req
+            self._wait.append(rid)
+            self._qdepth_g.set(len(self._wait))
+            self._work.notify_all()
+
+    def cancel(self, rid) -> None:
+        """Cancel ``rid``. Idempotent: unknown / already-retired ids are
+        no-ops (CANCEL racing retirement is safe). A waiting request
+        retires immediately; an admitted one is marked done and its slot
+        frees at the next consumed chunk."""
+        with self._work:
+            req = self._reqs.pop(rid, None)
+            if req is None or req.done:
+                return
+            req.done = True
+            req.reason = "cancelled"
+            try:
+                self._wait.remove(rid)
+            except ValueError:
+                pass          # admitted: the loop's consume frees it
+            self._qdepth_g.set(len(self._wait))
+            self._work.notify_all()
+        self._cancelled_c.inc()
+        self._emit_retired(req)
+
+    def drain(self) -> None:
+        """Graceful drain: reject further submits; :meth:`run` returns
+        once every accepted request has retired."""
+        with self._work:
+            self._draining = True
+            self._work.notify_all()
+
+    def stop(self) -> None:
+        """Abort: run() returns after at most the in-flight chunk, and
+        every outstanding request retires as ``"stopped"``."""
+        with self._work:
+            self._draining = True
+            self._stopped = True
+            self._work.notify_all()
+
+    def stats(self) -> dict:
+        """Live occupancy snapshot (the serving server's STATS payload).
+        ``queue_depth`` mirrors the ``tony_serve_queue_depth`` gauge."""
+        with self._lock:
+            return {
+                "queue_depth": len(self._wait),
+                "active": sum(1 for r in self._occupant
+                              if r is not None and not r.done),
+                "slots": self.b.batch,
+                "draining": self._draining,
+            }
+
+    # --- the loop (one driving thread) ---
+
+    def run(self) -> None:
+        """Drive the engine on the CALLING thread until drained or
+        stopped. Between bursts of work the thread blocks on the
+        admission condition — an idle engine costs nothing."""
+        if getattr(self.b, "_engine_running", False):
+            raise RuntimeError("batcher is already driven by an engine")
+        self.b._engine_running = True
+        try:
+            if self.b.pipeline:
+                self._run_pipelined()
+            else:
+                self._run_sequential()
+        finally:
+            # seal the engine even on an abnormal exit (a device error
+            # escaping the loop): late submits must raise rather than
+            # enqueue into a dead engine the caller thinks is live
+            with self._work:
+                self._draining = True
+                self._stopped = True
+            self.b._engine_running = False
+            self._abort_outstanding("stopped")
+            metrics_mod.observe_phase_times(self.b.phase_times, self._reg)
+
+    def _emit_retired(self, req: _EngineRequest, final=()) -> None:
+        if self.on_retired is not None:
+            self.on_retired(req.rid, req.reason, req.emitted,
+                            list(final))
+
+    def _abort_outstanding(self, reason: str) -> None:
+        with self._lock:
+            doomed = [r for r in self._reqs.values() if not r.done]
+            for req in doomed:
+                req.done = True
+                req.reason = reason
+            self._reqs.clear()
+            self._wait.clear()
+            self._occupant = [None] * self.b.batch
+            self._qdepth_g.set(0)
+        for req in doomed:
+            self._emit_retired(req)
+
+    def _wait_for_work(self) -> bool:
+        """Block until there is runnable work (True) or the engine is
+        drained-empty / stopped (False). Live OCCUPANTS count as work,
+        not just waiting requests: a trailing ``_settle()`` can admit a
+        submission that raced the burst's last sweep, and ignoring it
+        here would strand that admitted request (blocked forever, or
+        wrongly aborted as ``"stopped"`` under drain)."""
+        with self._work:
+            while True:
+                if self._stopped:
+                    return False
+                if self._wait or any(r is not None and not r.done
+                                     for r in self._occupant):
+                    return True
+                if self._draining:
+                    return False
+                self._work.wait()
+
+    def _admit_free(self) -> None:
+        """Admit waiting requests into every free slot (row order — the
+        freed order, since consume builds freed lists row-ascending).
+        The device dispatch runs OUTSIDE the lock; a request cancelled
+        between marking and dispatch is discarded at its first consume."""
+        with self._lock:
+            pairs, prompts, admitted = [], {}, []
+            for row in range(self.b.batch):
+                if self._occupant[row] is not None:
+                    continue
+                req = None
+                while self._wait and req is None:
+                    req = self._reqs.get(self._wait.popleft())
+                if req is None:
+                    break
+                self._occupant[row] = req
+                pairs.append((row, req.stream))
+                prompts[req.stream] = req.prompt
+                admitted.append(req)
+            if admitted:
+                self._qdepth_g.set(len(self._wait))
+        if admitted:
+            self.b._admit_batch(pairs, prompts)
+            self._admitted_c.inc(len(admitted))
+
+    def _consume(self, host_toks, snap) -> None:
+        """Apply one fetched chunk under the occupancy it was ISSUED
+        with, freeing completed/cancelled rows and emitting per-request
+        deltas. Rows whose snapshot request already finished (a
+        speculatively issued chunk crossed the completion, or a cancel
+        landed mid-flight) carry garbage and are discarded — the same
+        discard as idle-slot garbage."""
+        deltas, retired = [], []
+        eos = self.b.eos_id
+        with self._lock:
+            for row, req in enumerate(snap):
+                if req is None or req.done:
+                    if req is not None and self._occupant[row] is req:
+                        # cancelled mid-flight: free the slot now
+                        self._occupant[row] = None
+                    continue
+                new = []
+                for t in host_toks[row]:
+                    t = int(t)
+                    new.append(t)
+                    req.emitted += 1
+                    req.budget -= 1
+                    if req.budget == 0 or (eos is not None and t == eos):
+                        # surplus chunk tokens past completion discarded
+                        req.done = True
+                        req.reason = ("eos" if eos is not None and t == eos
+                                      else "budget")
+                        self._reqs.pop(req.rid, None)
+                        if self._occupant[row] is req:
+                            self._occupant[row] = None
+                        break
+                if new:
+                    deltas.append((req, new))
+                if req.done:
+                    retired.append(req)
+        now = time.perf_counter()
+        appended = 0
+        finals = {id(req): new for req, new in deltas
+                  if req in retired}
+        for req, new in deltas:
+            appended += len(new)
+            if req.emitted == len(new):      # this is the first delta
+                self._ttft_h.observe(now - req.t_submit)
+            else:
+                self._itl_h.observe((now - req.t_last) / len(new))
+            req.t_last = now
+            # a retiring request's FINAL delta rides its retirement
+            # callback instead of on_delta, so transports can emit the
+            # two atomically (a replica killed between a final TOKENS
+            # frame and its RETIRED would otherwise leave a router
+            # believing the stream is unfinished and re-admitting PAST
+            # an already-streamed eos)
+            if id(req) not in finals and self.on_delta is not None:
+                self.on_delta(req.rid, new)
+        if appended:
+            self._tokens_c.inc(appended)
+        if retired:
+            self._retired_c.inc(len(retired))
+            for req in retired:
+                self._emit_retired(req, finals.get(id(req), ()))
+
+    def _settle(self) -> None:
+        self._admit_free()
+        # reset ALL unoccupied rows (not just newly freed): a slot idle
+        # across many chunks would otherwise march its garbage frontier
+        # every step until it clamps at the cache end
+        with self._lock:
+            idle = [r is None for r in self._occupant]
+        if any(idle):
+            with self.b.phase_times.phase("retire"):
+                self.b._retire(idle)
+
+    def _sweep_done_occupants(self) -> bool:
+        """Free slots held by done (cancelled) occupants when no chunk
+        is in flight to do it; returns True when any slot is LIVE."""
+        with self._lock:
+            live = False
+            for row, req in enumerate(self._occupant):
+                if req is None:
+                    continue
+                if req.done:
+                    self._occupant[row] = None
+                else:
+                    live = True
+            return live
+
+    def _certainly_final(self) -> bool:
+        """The chunk about to be issued provably retires every live
+        request (budget exhaustion; eos and speculative acceptance only
+        finish EARLIER, and every speculative round commits >= 1 token)
+        with nothing queued — issuing past it would be a guaranteed-
+        garbage dispatch. (A submission landing during that final chunk
+        is admitted at its settle and the loop continues.)"""
+        with self._lock:
+            if self._wait:
+                return False
+            return all(req.budget <= self.b.chunk
+                       for req in self._occupant
+                       if req is not None and not req.done)
+
+    def _defer_issue(self, snap) -> bool:
+        """Process the in-flight chunk BEFORE issuing the next one when
+        the host can PREDICT a completion with requests still queued:
+        budget exhaustion is host-visible ahead of time, and issuing
+        across it would run the freed slot idle for a whole chunk — a
+        step-utilization loss the sequential loop doesn't pay.
+        Unpredictable completions (eos mid-chunk, a cancel) are NOT
+        deferred for — the loop stays optimistic and catches up after
+        the fact. Budget-only workloads therefore pipeline LOSSLESSLY:
+        chunk count, admission timing, and utilization all match the
+        sequential loop."""
+        with self._lock:
+            return bool(self._wait) and any(
+                req is not None and not req.done
+                and req.budget <= self.b._chunk_tokens_max()
+                for req in snap)
+
+    def _run_pipelined(self) -> None:
+        """Double-buffered dispatch against the live queue: chunk N+1
+        enters the device queue before chunk N's fetch blocks on the
+        transport. Structure identical to the pre-engine closed loop —
+        the equivalence pin rests on it."""
+        b = self.b
+        while self._wait_for_work():
+            self._admit_free()
+            if not self._sweep_done_occupants():
+                self._settle()          # everything cancelled pre-issue
+                continue
+            inflight = (b._issue(), list(self._occupant))
+            while inflight is not None:
+                handle, snap = inflight
+                nxt = None
+                if (not self._stopped and not self._certainly_final()
+                        and not self._defer_issue(snap)):
+                    nxt = (b._issue(), list(self._occupant))
+                self._consume(b._fetch(handle), snap)
+                self._settle()
+                if self._stopped:
+                    return               # drop any in-flight chunk
+                with self._lock:
+                    occupied = any(r is not None for r in self._occupant)
+                if nxt is not None and not occupied:
+                    # every request retired while the speculative chunk
+                    # was in flight (eos beat the budget bound): drop it
+                    # unfetched — all its rows are garbage
+                    nxt = None
+                if nxt is None and occupied:
+                    nxt = (b._issue(), list(self._occupant))
+                inflight = nxt
+
+    def _run_sequential(self) -> None:
+        """issue → fetch → bookkeep → admit; the equivalence baseline
+        and A/B arm (``pipeline=False``) — every fetch serializes the
+        transport round trip with device compute."""
+        b = self.b
+        while self._wait_for_work():
+            self._admit_free()
+            while not self._stopped:
+                if not self._sweep_done_occupants():
+                    self._settle()
+                    break
+                snap = list(self._occupant)
+                self._consume(b._fetch(b._issue()), snap)
+                self._settle()

@@ -100,6 +100,14 @@ class ProxyServer:
                         self.remote_host, self.remote_port, e)
             client.close()
             return
+        # TCP_NODELAY both sides: the proxied payloads are interactive
+        # (notebook keystrokes, token-delta frames) — Nagle coalescing
+        # behind an unacked segment adds up to ~40 ms per small write
+        for s in (client, upstream):
+            try:
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
         upstream.settimeout(None)
         t = threading.Thread(target=_pump, args=(client, upstream),
                              daemon=True)

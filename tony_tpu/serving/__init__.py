@@ -1,0 +1,50 @@
+"""Streaming serving data plane: persistent token-push protocol,
+engine-backed server, multiplexing client, multi-replica router.
+
+The layer between the in-process serving engine
+(``tony_tpu.models.serve.ServeEngine`` — the open-loop
+issue/fetch/consume/settle loop over a live admission queue) and
+clients on the network:
+
+  protocol — TONYS1 length-prefixed frame codec (ADMIT/CANCEL/POLL
+             client→server; TOKENS/RETIRED/ERROR/STATS/HELLO
+             server→client), multiplexed request ids on one
+             persistent connection
+  server   — ServingServer: per-connection reader threads feed the
+             engine's live queue; engine delta callbacks push TOKENS
+             frames the moment each chunk is consumed
+  client   — StreamingClient: submit/cancel/stream many requests over
+             one connection (jax-free — runs on gateway hosts)
+  router   — ServingRouter: front door spreading sessions across N
+             replica servers by the ``tony_serve_queue_depth`` gauge,
+             health-checking them, and draining a lost replica's
+             sessions onto survivors with the streamed prefix trimmed
+  netem    — LatencyProxy: deterministic per-direction latency
+             injection for the streamed-vs-request/response bench arm
+
+``server`` pulls in the model stack (jax); ``protocol``/``client``/
+``router``/``netem`` are stdlib-only, so the lazy re-exports below
+keep thin-client imports cheap.
+"""
+
+from tony_tpu.serving.protocol import ProtocolError
+
+_LAZY = {
+    "ServingServer": ("tony_tpu.serving.server", "ServingServer"),
+    "StreamingClient": ("tony_tpu.serving.client", "StreamingClient"),
+    "ServingConnectionError": ("tony_tpu.serving.client",
+                               "ServingConnectionError"),
+    "ServingRouter": ("tony_tpu.serving.router", "ServingRouter"),
+    "LatencyProxy": ("tony_tpu.serving.netem", "LatencyProxy"),
+}
+
+__all__ = ["ProtocolError", *_LAZY]
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(
+        f"module 'tony_tpu.serving' has no attribute {name!r}")

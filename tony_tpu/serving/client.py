@@ -1,0 +1,261 @@
+"""Streaming serving client: one persistent connection, many in-flight
+requests, server-pushed token deltas.
+
+The client is transport-only (stdlib, no jax) — a gateway process or a
+test can drive a remote serving host (or the router front-door, which
+speaks the same protocol) without the model stack installed.
+
+Usage::
+
+    with StreamingClient("10.0.0.5", 7070) as c:
+        rid = c.submit(prompt, max_new_tokens=64)
+        for delta in c.deltas(rid):        # lists of ints, as pushed
+            emit(delta)
+        tokens, reason = c.result(rid)     # or: collect in one call
+
+A reader thread demultiplexes frames by request id into per-request
+event queues, so any number of threads can stream different requests
+concurrently. ``submit(stream=False)`` + ``poll()`` is the long-poll
+(request/response-per-chunk) mode — kept as the streaming bench's
+contrast arm and for dumb clients.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import socket
+import threading
+
+from tony_tpu.serving import protocol as P
+
+
+class ServingConnectionError(ConnectionError):
+    """The serving connection failed (handshake, mid-stream loss, or a
+    connection-scoped server ERROR)."""
+
+
+class StreamingClient:
+    def __init__(self, host: str, port: int,
+                 connect_timeout: float = 10.0) -> None:
+        self._sock = socket.create_connection((host, port),
+                                              timeout=connect_timeout)
+        P.set_nodelay(self._sock)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self._lock = threading.Lock()
+        self._queues: dict[int, queue.Queue] = {}
+        self._stats_q: queue.Queue = queue.Queue()
+        self._next_rid = itertools.count(1)
+        self._closed = False
+        self._conn_error: str | None = None
+        try:
+            self._sock.sendall(P.MAGIC)
+            hello = P.recv_frame(self._sock)
+        except (OSError, P.ProtocolError) as e:
+            self._sock.close()
+            raise ServingConnectionError(f"handshake failed: {e}") from e
+        if hello is None or hello[0] != P.HELLO:
+            self._sock.close()
+            raise ServingConnectionError("no HELLO from server")
+        self.hello = P.unpack_json(hello[2])
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="tony-serve-client-reader",
+                                        daemon=True)
+        self._reader.start()
+
+    # -- wire ---------------------------------------------------------------
+    def _send(self, ftype: int, rid: int, payload: bytes = b"") -> None:
+        with self._send_lock:
+            if self._closed:
+                raise ServingConnectionError(
+                    self._conn_error or "client is closed")
+            try:
+                P.send_frame(self._sock, ftype, rid, payload)
+            except OSError as e:
+                raise ServingConnectionError(str(e)) from e
+
+    def _read_loop(self) -> None:
+        error = "connection closed by server"
+        try:
+            while True:
+                frame = P.recv_frame(self._sock)
+                if frame is None:
+                    break
+                ftype, rid, payload = frame
+                if ftype == P.TOKENS:
+                    self._dispatch(rid, ("tokens",
+                                         P.unpack_tokens(payload)))
+                elif ftype == P.RETIRED:
+                    obj = P.unpack_json(payload)
+                    self._dispatch(rid, ("retired",
+                                         obj.get("reason", "unknown"),
+                                         obj.get("tokens", 0)))
+                elif ftype == P.ERROR:
+                    msg = P.unpack_json(payload).get("message", "error")
+                    if rid == 0:
+                        error = f"server error: {msg}"
+                        break               # connection-scoped: fatal
+                    self._dispatch(rid, ("error", msg))
+                elif ftype == P.STATS:
+                    self._stats_q.put(P.unpack_json(payload))
+                # unknown server frames are ignored (forward compat)
+        except (P.ProtocolError, OSError) as e:
+            error = str(e)
+        with self._lock:
+            self._closed = True
+            self._conn_error = error
+            queues = list(self._queues.values())
+        fatal = ("error", error)
+        for q in queues:
+            q.put(fatal)
+        self._stats_q.put({"error": error})
+
+    def _dispatch(self, rid: int, event: tuple) -> None:
+        with self._lock:
+            q = self._queues.get(rid)
+        if q is not None:
+            q.put(event)
+
+    # -- request surface ----------------------------------------------------
+    def submit(self, prompt, max_new_tokens: int, stream: bool = True,
+               rid: int | None = None) -> int:
+        """Admit a request; returns its (client-chosen or auto) rid."""
+        if rid is None:
+            rid = next(self._next_rid)
+        with self._lock:
+            if self._closed:
+                raise ServingConnectionError(
+                    self._conn_error or "client is closed")
+            self._queues[rid] = queue.Queue()
+        self._send(P.ADMIT, rid, P.pack_json(
+            {"prompt": [int(t) for t in prompt],
+             "max_new_tokens": int(max_new_tokens), "stream": stream}))
+        return rid
+
+    def cancel(self, rid: int) -> None:
+        self._send(P.CANCEL, rid)
+
+    def next_event(self, rid: int, timeout: float | None = None):
+        """Next event for ``rid``: ``("tokens", [ints])``,
+        ``("retired", reason, n)``, or ``("error", message)``. Raises
+        ``queue.Empty`` on timeout."""
+        with self._lock:
+            q = self._queues.get(rid)
+        if q is None:
+            raise KeyError(f"unknown request id {rid}")
+        return q.get(timeout=timeout)
+
+    def _abandon(self, rid: int) -> None:
+        """A consumer walked away from a live request (timeout, early
+        generator exit): best-effort CANCEL so the server frees the
+        slot instead of generating into the void, then release the
+        local queue."""
+        try:
+            self.cancel(rid)
+        except ServingConnectionError:
+            pass
+        self._forget(rid)
+
+    def _event_or_raise(self, rid: int, timeout: float | None):
+        """next_event with the documented failure surface: a timeout
+        (the stream went silent — ``timeout`` is the per-EVENT bound,
+        not the whole request's) cancels the abandoned request and
+        raises ``ServingConnectionError``, never a raw
+        ``queue.Empty``."""
+        try:
+            return self.next_event(rid, timeout=timeout)
+        except queue.Empty:
+            self._abandon(rid)
+            raise ServingConnectionError(
+                f"no event for request {rid} within {timeout}s") from None
+
+    def deltas(self, rid: int, timeout: float | None = 120.0):
+        """Yield token deltas (lists of ints) as the server pushes them;
+        returns on retirement, raises ``ServingConnectionError`` on
+        error or on ``timeout`` seconds without any event. Abandoning
+        the generator early (``break``/close) CANCELs the request. The
+        terminal reason is left for :meth:`result` callers — this
+        generator is the 'emit tokens to the user as they land'
+        surface."""
+        finished = False
+        try:
+            while True:
+                ev = self._event_or_raise(rid, timeout)
+                if ev[0] == "tokens":
+                    yield ev[1]
+                elif ev[0] == "retired":
+                    finished = True
+                    self._forget(rid)
+                    return
+                else:
+                    finished = True
+                    self._forget(rid)
+                    raise ServingConnectionError(ev[1])
+        finally:
+            if not finished:
+                self._abandon(rid)
+
+    def result(self, rid: int, timeout: float | None = 120.0):
+        """Block until ``rid`` retires; returns ``(tokens, reason)``.
+        ``timeout`` bounds the wait per EVENT, not the whole request."""
+        tokens: list[int] = []
+        while True:
+            ev = self._event_or_raise(rid, timeout)
+            if ev[0] == "tokens":
+                tokens.extend(ev[1])
+            elif ev[0] == "retired":
+                self._forget(rid)
+                return tokens, ev[1]
+            else:
+                self._forget(rid)
+                raise ServingConnectionError(ev[1])
+
+    def poll(self, rid: int, timeout: float | None = 120.0):
+        """Long-poll a ``stream=False`` request: one request/response
+        round trip per call (the per-chunk baseline the streaming wire
+        replaces). Returns ``(tokens, None)`` while live and
+        ``([], reason)`` once retired."""
+        self._send(P.POLL, rid)
+        ev = self._event_or_raise(rid, timeout)
+        if ev[0] == "tokens":
+            return ev[1], None
+        if ev[0] == "retired":
+            self._forget(rid)
+            return [], ev[1]
+        self._forget(rid)
+        raise ServingConnectionError(ev[1])
+
+    def stats(self, timeout: float | None = 30.0) -> dict:
+        """Server stats snapshot (the ``tony_serve_queue_depth`` gauge
+        et al. — what the router places by)."""
+        self._send(P.STATS, 0)
+        out = self._stats_q.get(timeout=timeout)
+        if "error" in out:
+            raise ServingConnectionError(out["error"])
+        return out
+
+    def _forget(self, rid: int) -> None:
+        with self._lock:
+            self._queues.pop(rid, None)
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        if self._reader.is_alive():
+            self._reader.join(timeout=5)
+
+    def __enter__(self) -> "StreamingClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,217 @@
+"""TONYS1 streaming serving protocol: the persistent token-push wire.
+
+One persistent TCP connection multiplexes many in-flight requests in
+BOTH directions — the client pushes admissions and cancels, the server
+pushes token deltas the moment the engine consumes them — replacing a
+request/response round trip per chunk (the pre-streaming tunnel paid
+~70-100 ms of transport per chunk AND per admission; see
+docs/serving.md "Streaming serving"). The framing keeps the
+self-describing discipline of the TONY1 record format
+(``tony_tpu/io/framed.py``): a magic preamble so a stray peer fails
+fast, an explicit length prefix so a reader can never lose sync, and a
+JSON HELLO carrying the server's shape so clients need no out-of-band
+schema.
+
+Connection handshake::
+
+    client -> server   magic  b"TONYS1\\0"
+    server -> client   HELLO frame, JSON payload {"v": 1, "slots": N}
+
+Frame layout (everything little-endian)::
+
+    length   4 bytes  u32   bytes that FOLLOW (type + rid + payload)
+    type     1 byte   u8    frame type (below)
+    rid      8 bytes  u64   request id (0 = connection-scoped)
+    payload  length-9 bytes
+
+Frame types:
+
+====== ============ ========= =====================================
+ type   direction    payload   meaning
+====== ============ ========= =====================================
+ADMIT   c -> s       JSON      ``{"prompt": [ints], "max_new_tokens":
+                               n, "stream": bool}`` — submit request
+                               ``rid``. ``stream=false`` buffers
+                               deltas server-side for POLL (the
+                               request/response contrast arm).
+CANCEL  c -> s       (empty)   cancel ``rid`` (idempotent).
+POLL    c -> s       (empty)   long-poll ``rid``: the server answers
+                               with one TOKENS frame as soon as it
+                               has buffered deltas, or RETIRED once
+                               the request is done and drained.
+TOKENS  s -> c       u32[]     a token DELTA for ``rid`` (packed
+                               little-endian u32s, in order).
+RETIRED s -> c       JSON      ``{"reason": "eos"|"budget"|
+                               "cancelled"|"stopped", "tokens": n}``
+                               — terminal, exactly once per request.
+ERROR   s -> c       JSON      ``{"message": str}``. ``rid != 0``:
+                               that request failed (terminal for it).
+                               ``rid == 0``: connection-scoped — the
+                               server closes the connection after
+                               sending it (a protocol violation never
+                               kills the server, only the offending
+                               connection).
+STATS   c -> s       (empty)   request a stats snapshot;
+        s -> c       JSON      answered with a STATS frame carrying
+                               at least ``queue_depth`` (the
+                               ``tony_serve_queue_depth`` gauge),
+                               ``active``, ``slots`` — the router's
+                               placement + health signal.
+HELLO   s -> c       JSON      connection preamble (see above).
+====== ============ ========= =====================================
+
+Everything here is transport-only (stdlib, no jax): importable by thin
+clients, the router, and tests alike.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+
+MAGIC = b"TONYS1\0"
+
+ADMIT = 1
+CANCEL = 2
+POLL = 3
+TOKENS = 4
+RETIRED = 5
+ERROR = 6
+STATS = 7
+HELLO = 8
+
+FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
+               TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
+               STATS: "STATS", HELLO: "HELLO"}
+
+#: sanity bound on one frame's body (type + rid + payload). A prompt of
+#: a million tokens is ~4 MB; anything past this is a corrupt length
+#: prefix, not a request.
+MAX_FRAME_BYTES = 1 << 24
+
+_LEN = struct.Struct("<I")
+_HDR = struct.Struct("<BQ")          # type, rid
+_TOK = struct.Struct("<I")
+
+
+class ProtocolError(ValueError):
+    """Malformed wire data. Connection-scoped by convention: handlers
+    report it (an ERROR frame where possible) and close THAT connection;
+    it must never propagate out of a server's per-connection handler."""
+
+
+def set_nodelay(sock: socket.socket) -> None:
+    """Disable Nagle batching. Token-delta frames are tens of bytes;
+    coalescing them behind an unacked segment adds up to ~40 ms of
+    artificial inter-token latency per delta."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except OSError:
+        pass                       # non-TCP transports (tests, AF_UNIX)
+
+
+def recv_exact(sock: socket.socket, n: int) -> bytes | None:
+    """Read exactly ``n`` bytes. Returns None on clean EOF at a frame
+    boundary (byte 0); raises ProtocolError on EOF mid-read (a peer
+    that died mid-frame)."""
+    chunks: list[bytes] = []
+    got = 0
+    while got < n:
+        try:
+            data = sock.recv(n - got)
+        except OSError as e:
+            if chunks:
+                raise ProtocolError(f"connection lost mid-frame: {e}")
+            return None
+        if not data:
+            if chunks:
+                raise ProtocolError("truncated frame (EOF mid-frame)")
+            return None
+        chunks.append(data)
+        got += len(data)
+    return b"".join(chunks)
+
+
+def encode_frame(ftype: int, rid: int, payload: bytes = b"") -> bytes:
+    body = _HDR.pack(ftype, rid) + payload
+    if len(body) > MAX_FRAME_BYTES:
+        raise ProtocolError(f"frame too large: {len(body)} bytes")
+    return _LEN.pack(len(body)) + body
+
+
+def send_frame(sock: socket.socket, ftype: int, rid: int,
+               payload: bytes = b"") -> None:
+    sock.sendall(encode_frame(ftype, rid, payload))
+
+
+def recv_frame(sock: socket.socket):
+    """Read one frame; returns ``(type, rid, payload)`` or None on clean
+    EOF. Raises ProtocolError on truncation or an implausible length
+    prefix — the reader can then close without ever losing sync."""
+    head = recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (length,) = _LEN.unpack(head)
+    if length < _HDR.size or length > MAX_FRAME_BYTES:
+        raise ProtocolError(f"implausible frame length {length}")
+    body = recv_exact(sock, length)
+    if body is None:
+        raise ProtocolError("truncated frame (EOF after length prefix)")
+    ftype, rid = _HDR.unpack_from(body, 0)
+    return ftype, rid, body[_HDR.size:]
+
+
+def read_magic(sock: socket.socket) -> bool:
+    """Consume and verify the connection preamble; False on anything
+    else (including clean EOF)."""
+    try:
+        got = recv_exact(sock, len(MAGIC))
+    except ProtocolError:
+        return False
+    return got == MAGIC
+
+
+def pack_json(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+def unpack_json(payload: bytes) -> dict:
+    try:
+        obj = json.loads(payload.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed JSON payload: {e}") from e
+    if not isinstance(obj, dict):
+        raise ProtocolError(f"payload is not an object: {obj!r}")
+    return obj
+
+
+def pack_tokens(tokens) -> bytes:
+    return b"".join(_TOK.pack(int(t) & 0xFFFFFFFF) for t in tokens)
+
+
+def unpack_tokens(payload: bytes) -> list[int]:
+    if len(payload) % _TOK.size:
+        raise ProtocolError(
+            f"TOKENS payload of {len(payload)} bytes is not a whole "
+            f"number of u32s")
+    return [t[0] for t in _TOK.iter_unpack(payload)]
+
+
+def parse_admit(payload: bytes) -> tuple[list[int], int, bool]:
+    """Validate an ADMIT payload -> (prompt, max_new_tokens, stream).
+    Anything structurally off is a ProtocolError (connection-scoped),
+    NOT a crash in the engine."""
+    obj = unpack_json(payload)
+    prompt = obj.get("prompt")
+    max_new = obj.get("max_new_tokens")
+    stream = obj.get("stream", True)
+    if (not isinstance(prompt, list)
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       for t in prompt)):
+        raise ProtocolError("ADMIT prompt must be a list of ints")
+    if isinstance(max_new, bool) or not isinstance(max_new, int):
+        raise ProtocolError("ADMIT max_new_tokens must be an int")
+    if not isinstance(stream, bool):
+        raise ProtocolError("ADMIT stream must be a bool")
+    return prompt, max_new, stream

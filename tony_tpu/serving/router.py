@@ -1,0 +1,482 @@
+"""Serving router: one front door, N replica serving hosts.
+
+The router speaks the TONYS1 streaming protocol on BOTH sides — clients
+connect to it exactly as they would to a single
+:class:`~tony_tpu.serving.server.ServingServer`, and it holds one
+persistent link per replica. Per session it:
+
+- **places** by load: the replica whose last-reported
+  ``tony_serve_queue_depth`` gauge + busy slots (the STATS frame, read
+  straight off the replica's metrics registry — the PR-2 metrics plane)
+  is smallest, tie-broken by the router's own not-yet-reported
+  assignment count so a burst of admissions spreads before the next
+  stats refresh;
+- **streams** replica deltas through to the client as they land,
+  remembering every token it forwarded;
+- **health-checks** replicas: a STATS ping per interval, with link EOF
+  / errors marking a replica down immediately and 3 consecutive
+  UNANSWERED pings marking a hung-but-connected one down (unanswered
+  pings, not wall-clock staleness — the router's own scheduling stalls
+  must not down healthy replicas);
+- **fails over** on replica loss: every unfinished session re-admits on
+  a surviving replica with the already-streamed prefix folded into the
+  prompt (``prompt + streamed``) and the remaining budget — greedy
+  continuations are token-identical, so the client sees no duplicated
+  and no dropped tokens (test-enforced).
+
+Router-side series (default registry): ``tony_router_replica_up`` /
+``tony_router_replica_queue_depth`` (gauges, ``replica=host:port``),
+``tony_router_sessions_total{replica=...}``,
+``tony_router_failovers_total``.
+
+The router never touches the model stack — it is deployable on a
+jax-free gateway host.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+import time
+
+from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.serving import protocol as P
+from tony_tpu.serving.server import FrameConn, FrameServerBase
+
+log = logging.getLogger(__name__)
+
+
+class _ReplicaLink:
+    """One persistent connection to a replica server, with a reader
+    thread dispatching its pushed frames back into the router."""
+
+    def __init__(self, addr: str, router: "ServingRouter") -> None:
+        self.addr = addr
+        self._router = router
+        host, _, port = addr.rpartition(":")
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=10)
+        P.set_nodelay(self._sock)
+        self._sock.settimeout(None)
+        self._send_lock = threading.Lock()
+        self.alive = True
+        #: last STATS-reported load (queue_depth + active slots)
+        self.reported_load = 0
+        self.last_stats = time.monotonic()
+        #: health pings sent without a reply since the last one. Health
+        #: is judged on THIS, not on wall time since the last reply — a
+        #: wall-clock threshold also counts the router's own scheduling
+        #: stalls (GC, an in-process jax compile) and would down every
+        #: healthy replica at once after one long stall.
+        self.pings_unanswered = 0
+        #: sessions assigned here and not yet retired (router-side)
+        self.assigned = 0
+        self._sock.sendall(P.MAGIC)
+        hello = P.recv_frame(self._sock)
+        if hello is None or hello[0] != P.HELLO:
+            self._sock.close()
+            raise ConnectionError(f"replica {addr}: no HELLO")
+        self.hello = P.unpack_json(hello[2])
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"tony-router-link-{addr}",
+            daemon=True)
+        self._reader.start()
+
+    def send(self, ftype: int, rid: int, payload: bytes = b"") -> bool:
+        with self._send_lock:
+            if not self.alive:
+                return False
+            try:
+                P.send_frame(self._sock, ftype, rid, payload)
+                return True
+            except OSError:
+                return False
+
+    def _read_loop(self) -> None:
+        router = self._router
+        try:
+            while True:
+                frame = P.recv_frame(self._sock)
+                if frame is None:
+                    break
+                ftype, rid, payload = frame
+                if ftype == P.TOKENS:
+                    router._replica_delta(self, rid,
+                                          P.unpack_tokens(payload))
+                elif ftype == P.RETIRED:
+                    obj = P.unpack_json(payload)
+                    router._replica_retired(
+                        self, rid, obj.get("reason", "unknown"))
+                elif ftype == P.ERROR:
+                    msg = P.unpack_json(payload).get("message", "error")
+                    if rid == 0:
+                        break               # replica dropped our link
+                    router._replica_error(self, rid, msg)
+                elif ftype == P.STATS:
+                    obj = P.unpack_json(payload)
+                    self.reported_load = (int(obj.get("queue_depth", 0))
+                                          + int(obj.get("active", 0)))
+                    self.last_stats = time.monotonic()
+                    self.pings_unanswered = 0
+                    router._note_stats(self)
+        except (P.ProtocolError, OSError):
+            pass
+        router._replica_down(self)
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _RouterSession:
+    __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
+                 "rrid", "cancelled")
+
+    def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
+                 budget: int) -> None:
+        self.conn = conn
+        self.crid = crid
+        self.prompt = prompt
+        self.budget = budget
+        self.streamed: list[int] = []       # every token forwarded
+        self.link: _ReplicaLink | None = None
+        self.rrid = 0
+        #: the client asked for this session's death; a failover must
+        #: finish it as cancelled, never resurrect it on a survivor
+        self.cancelled = False
+
+
+class ServingRouter(FrameServerBase):
+    """Front-door spreading streaming sessions across replica serving
+    hosts. ``replicas``: ``["host:port", ...]`` of running
+    :class:`~tony_tpu.serving.server.ServingServer` instances."""
+
+    def __init__(self, replicas, bind_host: str = "127.0.0.1",
+                 port: int = 0, health_interval_s: float = 0.5,
+                 registry=None) -> None:
+        super().__init__(bind_host, port)
+        self._replica_addrs = list(replicas)
+        if not self._replica_addrs:
+            raise ValueError("router needs at least one replica")
+        self._lock = threading.Lock()
+        self._links: list[_ReplicaLink] = []
+        self._sessions: dict[tuple[int, int], _RouterSession] = {}
+        self._by_rrid: dict[int, _RouterSession] = {}
+        self._next_rrid = itertools.count(1)
+        self._downed: set[int] = set()      # id()s of links already torn
+        self.health_interval_s = health_interval_s
+        self._health_thread: threading.Thread | None = None
+        reg = registry or metrics_mod.get_default()
+        self._reg = reg
+        self._failovers_c = reg.counter(
+            "tony_router_failovers_total",
+            help="sessions re-admitted after a replica loss")
+        self._up_g = {}
+        self._depth_g = {}
+        self._placed_c = {}
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        for addr in self._replica_addrs:
+            # gauges BEFORE the link: the link's reader thread may run
+            # _replica_down (instant replica death) the moment the link
+            # exists, and that path writes these gauges
+            self._up_g[addr] = self._reg.gauge(
+                "tony_router_replica_up",
+                help="1 while the replica link is healthy", replica=addr)
+            self._depth_g[addr] = self._reg.gauge(
+                "tony_router_replica_queue_depth",
+                help="replica's last-reported tony_serve_queue_depth "
+                     "+ busy slots", replica=addr)
+            self._placed_c[addr] = self._reg.counter(
+                "tony_router_sessions_total",
+                help="sessions placed on the replica", replica=addr)
+            self._up_g[addr].set(1)
+            self._links.append(_ReplicaLink(addr, self))
+        port = super().start()
+        self._health_thread = threading.Thread(
+            target=self._health_loop, name="tony-router-health",
+            daemon=True)
+        self._health_thread.start()
+        log.info("router on %s:%s over %d replicas", self.bind_host,
+                 port, len(self._links))
+        return port
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._close_listener()
+        for link in self._links:
+            link.close()
+        self._close_conns()
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    # -- placement ----------------------------------------------------------
+    def _pick_link(self, exclude: _ReplicaLink | None = None):
+        with self._lock:
+            live = [l for l in self._links
+                    if l.alive and l is not exclude]
+            if not live:
+                return None
+            # gauge first (the metrics-plane signal), local assignment
+            # count second (spreads a burst between stats refreshes)
+            return min(live, key=lambda l: (l.reported_load, l.assigned))
+
+    def _health_loop(self) -> None:
+        while not self._stopping.wait(self.health_interval_s):
+            for link in list(self._links):
+                if not link.alive:
+                    continue
+                if link.pings_unanswered >= 3:
+                    log.warning("router: replica %s unresponsive (%d "
+                                "unanswered stats pings); marking down",
+                                link.addr, link.pings_unanswered)
+                    link.close()            # reader EOF -> _replica_down
+                    continue
+                link.pings_unanswered += 1
+                if not link.send(P.STATS, 0):
+                    link.close()
+
+    def _note_stats(self, link: _ReplicaLink) -> None:
+        self._depth_g[link.addr].set(link.reported_load)
+
+    # -- client side (reader threads) ---------------------------------------
+    def _hello_payload(self) -> dict:
+        return {"v": 1, "router": True,
+                "replicas": len(self._replica_addrs)}
+
+    def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
+                      payload: bytes) -> None:
+        if ftype == P.ADMIT:
+            self._admit(conn, rid, payload)
+        elif ftype == P.CANCEL:
+            # capture (link, rrid) under the SAME lock that marks the
+            # cancel: a failover re-placement assigns them as a pair,
+            # and an unlocked read could pair the new link with the old
+            # rrid — a CANCEL the surviving replica would no-op
+            with self._lock:
+                sess = self._sessions.get((conn.id, rid))
+                if sess is not None:
+                    sess.cancelled = True
+                    link, rrid = sess.link, sess.rrid
+            if sess is not None and link is not None:
+                link.send(P.CANCEL, rrid)
+        elif ftype == P.STATS:
+            conn.send(P.STATS, 0, P.pack_json(self.stats()))
+        elif ftype == P.POLL:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": "router supports streaming requests only"}))
+        else:
+            raise P.ProtocolError(
+                f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}")
+
+    def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
+        prompt, max_new, stream = P.parse_admit(payload)
+        if rid == 0:
+            raise P.ProtocolError("ADMIT rid must be nonzero")
+        if not stream:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": "router supports streaming requests only"}))
+            return
+        if max_new <= 0:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": f"max_new_tokens must be positive, "
+                            f"got {max_new}"}))
+            return
+        key = (conn.id, rid)
+        with self._lock:
+            if key in self._sessions:
+                conn.send(P.ERROR, rid, P.pack_json(
+                    {"message": f"request id {rid} is already active"}))
+                return
+            sess = _RouterSession(conn, rid, prompt, max_new)
+            self._sessions[key] = sess
+        if not self._place(sess, exclude=None):
+            with self._lock:
+                self._sessions.pop(key, None)
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": "no live replicas"}))
+
+    def _place(self, sess: _RouterSession,
+               exclude: _ReplicaLink | None) -> bool:
+        """Assign (or re-assign) a session to the least-loaded replica;
+        the replica prompt carries the already-streamed prefix so a
+        failover continues exactly where the stream left off. A failed
+        ADMIT send is handled HERE (tear the link down, retry on the
+        next replica): the link's reader thread may already have run
+        its one-shot ``_replica_down`` sweep before this session was
+        registered, so relying on it would strand the session."""
+        link = self._pick_link(exclude=exclude)
+        if link is None:
+            return False
+        rrid = next(self._next_rrid)
+        with self._lock:
+            # the session may have died while it was between homes: a
+            # client disconnect removed it from _sessions (re-admitting
+            # would burn a survivor's slot generating into a closed
+            # connection), or a CANCEL raced the failover
+            if self._sessions.get((sess.conn.id, sess.crid)) is not sess:
+                return True
+            if sess.cancelled:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+                doomed = True
+            else:
+                doomed = False
+                sess.link = link
+                sess.rrid = rrid
+                self._by_rrid[rrid] = sess
+                link.assigned += 1
+        if doomed:
+            sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
+                {"reason": "cancelled", "tokens": len(sess.streamed)}))
+            return True
+        self._placed_c[link.addr].inc()
+        ok = link.send(P.ADMIT, rrid, P.pack_json(
+            {"prompt": sess.prompt + sess.streamed,
+             "max_new_tokens": sess.budget - len(sess.streamed),
+             "stream": True}))
+        if not ok:
+            # re-place ONLY if this placement still owns the session:
+            # the link's down-sweep may have re-placed it already (it
+            # can run between our registration and the failed send),
+            # and a second placement would double-serve the request
+            with self._lock:
+                still_mine = (self._by_rrid.get(rrid) is sess
+                              and sess.link is link)
+                if still_mine:
+                    self._by_rrid.pop(rrid, None)
+                    link.assigned -= 1
+            link.alive = False
+            link.close()
+            self._replica_down(link)        # idempotent; sweeps others
+            if not still_mine:
+                return True                 # the sweep owns it now
+            return self._place(sess, exclude=link)
+        return True
+
+    def _on_conn_closed(self, conn: FrameConn) -> None:
+        with self._lock:
+            doomed = [s for k, s in list(self._sessions.items())
+                      if s.conn is conn]
+            for s in doomed:
+                self._sessions.pop((conn.id, s.crid), None)
+                self._by_rrid.pop(s.rrid, None)
+                if s.link is not None:
+                    s.link.assigned -= 1
+        for s in doomed:
+            if s.link is not None:
+                s.link.send(P.CANCEL, s.rrid)
+
+    # -- replica side (link reader threads) ---------------------------------
+    def _replica_delta(self, link: _ReplicaLink, rrid: int,
+                       toks: list[int]) -> None:
+        with self._lock:
+            sess = self._by_rrid.get(rrid)
+            if sess is None or sess.link is not link:
+                return                      # stale delta after failover
+            sess.streamed.extend(toks)
+        sess.conn.send(P.TOKENS, sess.crid, P.pack_tokens(toks))
+
+    def _replica_retired(self, link: _ReplicaLink, rrid: int,
+                         reason: str) -> None:
+        with self._lock:
+            sess = self._by_rrid.pop(rrid, None)
+            if sess is None or sess.link is not link:
+                if sess is not None:
+                    self._by_rrid[rrid] = sess
+                return
+            if reason == "stopped":
+                # replica is draining/dying under us: keep the session,
+                # the link-down path re-places it with the prefix trim
+                self._by_rrid[rrid] = sess
+                return
+            self._sessions.pop((sess.conn.id, sess.crid), None)
+            link.assigned -= 1
+        sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
+            {"reason": reason, "tokens": len(sess.streamed)}))
+
+    def _replica_error(self, link: _ReplicaLink, rrid: int,
+                       msg: str) -> None:
+        with self._lock:
+            sess = self._by_rrid.pop(rrid, None)
+            if sess is None:
+                return
+            self._sessions.pop((sess.conn.id, sess.crid), None)
+            link.assigned -= 1
+        sess.conn.send(P.ERROR, sess.crid, P.pack_json({"message": msg}))
+
+    def _replica_down(self, link: _ReplicaLink) -> None:
+        """Replica loss: drain its sessions onto survivors, streamed
+        prefix trimmed into the prompt, remaining budget only."""
+        with self._lock:
+            if id(link) in self._downed:
+                return
+            self._downed.add(id(link))
+        link.alive = False
+        link.close()
+        self._up_g[link.addr].set(0)
+        with self._lock:
+            orphans = [s for s in self._by_rrid.values()
+                       if s.link is link]
+            for s in orphans:
+                self._by_rrid.pop(s.rrid, None)
+                link.assigned -= 1
+        if orphans:
+            log.warning("router: replica %s down; re-admitting %d "
+                        "sessions", link.addr, len(orphans))
+        for sess in orphans:
+            if sess.cancelled:
+                # the client already asked for this session's death —
+                # finishing it as cancelled beats resurrecting it on a
+                # survivor with full remaining budget
+                with self._lock:
+                    self._sessions.pop((sess.conn.id, sess.crid), None)
+                sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
+                    {"reason": "cancelled",
+                     "tokens": len(sess.streamed)}))
+                continue
+            if len(sess.streamed) >= sess.budget:
+                # fully streamed; only the RETIRED frame was lost
+                with self._lock:
+                    self._sessions.pop((sess.conn.id, sess.crid), None)
+                sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
+                    {"reason": "budget", "tokens": len(sess.streamed)}))
+                continue
+            self._failovers_c.inc()
+            if not self._place(sess, exclude=link):
+                with self._lock:
+                    self._sessions.pop((sess.conn.id, sess.crid), None)
+                sess.conn.send(P.ERROR, sess.crid, P.pack_json(
+                    {"message": "no live replicas"}))
+
+    # -- introspection -------------------------------------------------------
+    def stats(self) -> dict:
+        """Router stats snapshot. Carries the protocol-mandated STATS
+        fields (``queue_depth``/``active``/``slots``, here the fleet
+        aggregates — a router can front another router) plus the
+        per-replica detail."""
+        with self._lock:
+            live = [l for l in self._links if l.alive]
+            return {
+                "queue_depth": sum(l.reported_load for l in live),
+                "active": len(self._sessions),
+                "slots": sum(int(l.hello.get("slots", 0))
+                             for l in live),
+                "sessions": len(self._sessions),
+                "replicas": {
+                    l.addr: {"up": int(l.alive),
+                             "reported_load": l.reported_load,
+                             "assigned": l.assigned}
+                    for l in self._links},
+            }

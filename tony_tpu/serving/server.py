@@ -1,0 +1,411 @@
+"""Streaming serving server: one persistent connection per client, one
+:class:`~tony_tpu.models.serve.ServeEngine` per server.
+
+The pre-streaming serving path paid a transport round trip per chunk
+and per admission (request/response against the device tunnel — ~70-100
+ms each, THE serving bottleneck once the loop itself was pipelined).
+Here the engine runs in one thread, each connection gets one reader
+thread feeding admissions/cancels straight into the engine's live
+queue, and the engine's delta callbacks push TOKENS frames the moment a
+chunk is consumed — transport overlaps device compute end-to-end, and
+one connection multiplexes any number of in-flight requests.
+
+Robustness contract (test-enforced):
+
+- a malformed or truncated frame is CONNECTION-scoped: the offender
+  gets a best-effort ``ERROR`` (rid 0) and a clean close; the server
+  and every other connection keep serving;
+- an un-servable ADMIT (bad budget, prompt too long, duplicate rid) is
+  REQUEST-scoped: ``ERROR`` with that rid, connection stays up;
+- a client disconnect cancels all its in-flight requests — their cache
+  slots free at the next consumed chunk and readmit from the queue;
+- ``CANCEL`` racing retirement is idempotent (engine contract).
+
+``stop(drain=True)`` is the graceful path: no new connections or
+admissions, in-flight requests finish and stream out, then the engine
+thread exits. ``kill()`` severs client connections first (peers see
+EOF immediately) and aborts the engine — the router's replica-loss
+drill.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import socket
+import threading
+
+from tony_tpu.serving import protocol as P
+
+log = logging.getLogger(__name__)
+
+
+class FrameConn:
+    """One accepted connection: socket + serialized writes. Engine
+    callbacks, poll responses, and error replies may send from
+    different threads — ``send`` takes the per-connection lock and
+    reports (rather than raises) transport failure."""
+
+    def __init__(self, conn_id: int, sock: socket.socket, addr) -> None:
+        self.id = conn_id
+        self.sock = sock
+        self.addr = addr
+        self._send_lock = threading.Lock()
+        self.alive = True
+
+    def send(self, ftype: int, rid: int, payload: bytes = b"") -> bool:
+        return self.send_many([(ftype, rid, payload)])
+
+    def send_many(self, frames) -> bool:
+        """Write several frames in ONE sendall — a retiring request's
+        final TOKENS and its RETIRED frame share a kernel write, so a
+        process killed between them cannot deliver one without the
+        other (the router's failover reads an unfinished stream off
+        exactly that gap)."""
+        buf = b"".join(P.encode_frame(t, r, p) for t, r, p in frames)
+        with self._send_lock:
+            if not self.alive:
+                return False
+            try:
+                self.sock.sendall(buf)
+                return True
+            except OSError:
+                self.alive = False
+                return False
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class FrameServerBase:
+    """Accept loop + per-connection frame reader for the TONYS1
+    protocol. Subclasses implement ``_hello_payload()``,
+    ``_handle_frame(conn, ftype, rid, payload)`` (raise
+    :class:`~tony_tpu.serving.protocol.ProtocolError` for
+    connection-scoped violations), and ``_on_conn_closed(conn)``."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0) -> None:
+        self.bind_host = bind_host
+        self.port = port
+        self._listener: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._conns: dict[int, FrameConn] = {}
+        self._conn_ids = itertools.count(1)
+        self._conns_lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind((self.bind_host, self.port))
+        server.listen(64)
+        self.port = server.getsockname()[1]
+        self._listener = server
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tony-serve-accept", daemon=True)
+        self._accept_thread.start()
+        return self.port
+
+    def _close_listener(self) -> None:
+        if self._listener is not None:
+            try:
+                self._listener.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def _close_conns(self) -> None:
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        for conn in conns:
+            conn.close()
+
+    # -- accept / read ------------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._stopping.is_set():
+            try:
+                sock, addr = self._listener.accept()
+            except OSError:
+                break                       # listener closed by stop()
+            P.set_nodelay(sock)
+            conn = FrameConn(next(self._conn_ids), sock, addr)
+            with self._conns_lock:
+                self._conns[conn.id] = conn
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             name=f"tony-serve-conn-{conn.id}",
+                             daemon=True).start()
+
+    def _serve_conn(self, conn: FrameConn) -> None:
+        try:
+            if not P.read_magic(conn.sock):
+                log.warning("serving: %s sent no TONYS1 magic; closing",
+                            conn.addr)
+                return
+            conn.send(P.HELLO, 0, P.pack_json(self._hello_payload()))
+            while not self._stopping.is_set():
+                frame = P.recv_frame(conn.sock)
+                if frame is None:
+                    break                   # clean disconnect
+                self._handle_frame(conn, *frame)
+        except P.ProtocolError as e:
+            # connection-scoped: report, close THIS connection, keep
+            # serving everyone else
+            log.warning("serving: protocol error from %s: %s",
+                        conn.addr, e)
+            conn.send(P.ERROR, 0, P.pack_json({"message": str(e)}))
+        except OSError:
+            pass                            # connection reset under us
+        finally:
+            conn.close()
+            with self._conns_lock:
+                self._conns.pop(conn.id, None)
+            self._on_conn_closed(conn)
+
+    # -- subclass surface ---------------------------------------------------
+    def _hello_payload(self) -> dict:
+        raise NotImplementedError
+
+    def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
+                      payload: bytes) -> None:
+        raise NotImplementedError
+
+    def _on_conn_closed(self, conn: FrameConn) -> None:
+        raise NotImplementedError
+
+
+class _Session:
+    """Server-side request state. ``stream=True`` pushes deltas as they
+    land; ``stream=False`` buffers them for long-POLLs (the
+    request/response contrast the streaming arm is measured against)."""
+
+    __slots__ = ("conn", "rid", "stream", "buffer", "retired",
+                 "poll_pending")
+
+    def __init__(self, conn: FrameConn, rid: int, stream: bool) -> None:
+        self.conn = conn
+        self.rid = rid
+        self.stream = stream
+        self.buffer: list[int] = []
+        self.retired: tuple[str, int] | None = None
+        self.poll_pending = False
+
+
+class ServingServer(FrameServerBase):
+    """Drive a batcher's :class:`~tony_tpu.models.serve.ServeEngine`
+    behind the TONYS1 streaming protocol.
+
+    Usage::
+
+        server = ServingServer(batcher, port=0)
+        port = server.start()          # engine + accept threads
+        ...
+        server.stop(drain=True)        # finish in-flight, then exit
+    """
+
+    def __init__(self, batcher, bind_host: str = "127.0.0.1",
+                 port: int = 0, registry=None) -> None:
+        super().__init__(bind_host, port)
+        from tony_tpu.models.serve import ServeEngine
+        self.batcher = batcher
+        self._lock = threading.Lock()
+        self._sessions: dict[tuple[int, int], _Session] = {}
+        self.engine = ServeEngine(batcher, on_delta=self._on_delta,
+                                  on_retired=self._on_retired,
+                                  registry=registry)
+        self._engine_thread: threading.Thread | None = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        self._engine_thread = threading.Thread(
+            target=self.engine.run, name="tony-serve-engine", daemon=True)
+        self._engine_thread.start()
+        port = super().start()
+        log.info("serving on %s:%s (%d slots)", self.bind_host, port,
+                 self.batcher.batch)
+        return port
+
+    def stop(self, drain: bool = False,
+             drain_timeout_s: float = 600.0) -> None:
+        """Stop serving. ``drain=True`` finishes every accepted request
+        (clients keep receiving deltas — and may keep CANCELing /
+        POLLing / STATSing while the drain runs; only ``_stopping`` is
+        deferred, because setting it would make a connection's next
+        frame exit its reader loop and cancel that client's in-flight
+        streams mid-drain); ``drain=False`` aborts — outstanding
+        requests retire as ``"stopped"``. A drain that outlives
+        ``drain_timeout_s`` is escalated to an abort, LOUDLY — a silent
+        degradation would sever clients the caller believes drained."""
+        self._close_listener()              # no new connections
+        if drain:
+            self.engine.drain()
+        else:
+            self._stopping.set()
+            self.engine.stop()
+        if self._engine_thread is not None:
+            self._engine_thread.join(
+                timeout=drain_timeout_s if drain else 60)
+            if self._engine_thread.is_alive():
+                log.warning(
+                    "serving: engine did not %s within %.0fs; aborting "
+                    "outstanding requests",
+                    "drain" if drain else "stop",
+                    drain_timeout_s if drain else 60)
+                self.engine.stop()
+                self._engine_thread.join(timeout=60)
+        self._stopping.set()
+        self._close_conns()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Abrupt replica loss: sever client connections FIRST (peers
+        see EOF immediately — what a crashed host looks like), then
+        abort the engine."""
+        self._stopping.set()
+        self._close_listener()
+        self._close_conns()
+        self.engine.stop()
+        if self._engine_thread is not None:
+            self._engine_thread.join(timeout=60)
+
+    # -- frame handling (reader threads) ------------------------------------
+    def _hello_payload(self) -> dict:
+        return {"v": 1, "slots": self.batcher.batch}
+
+    def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
+                      payload: bytes) -> None:
+        if ftype == P.ADMIT:
+            self._admit(conn, rid, payload)
+        elif ftype == P.CANCEL:
+            self.engine.cancel((conn.id, rid))
+        elif ftype == P.POLL:
+            self._poll(conn, rid)
+        elif ftype == P.STATS:
+            conn.send(P.STATS, 0, P.pack_json(self.engine.stats()))
+        else:
+            raise P.ProtocolError(
+                f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}")
+
+    def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
+        # structural violations are connection-scoped (raise), an
+        # un-servable request is request-scoped (ERROR with its rid)
+        prompt, max_new, stream = P.parse_admit(payload)
+        if rid == 0:
+            raise P.ProtocolError("ADMIT rid must be nonzero")
+        key = (conn.id, rid)
+        with self._lock:
+            if key in self._sessions:
+                conn.send(P.ERROR, rid, P.pack_json(
+                    {"message": f"request id {rid} is already active"}))
+                return
+            self._sessions[key] = _Session(conn, rid, stream)
+        try:
+            self.engine.submit(key, prompt, max_new)
+        except (ValueError, RuntimeError) as e:
+            with self._lock:
+                self._sessions.pop(key, None)
+            conn.send(P.ERROR, rid, P.pack_json({"message": str(e)}))
+
+    def _poll(self, conn: FrameConn, rid: int) -> None:
+        key = (conn.id, rid)
+        reply = None
+        with self._lock:
+            sess = self._sessions.get(key)
+            if sess is None:
+                reply = (P.ERROR, P.pack_json(
+                    {"message": f"unknown request id {rid}"}))
+            elif sess.buffer:
+                toks, sess.buffer = sess.buffer, []
+                reply = (P.TOKENS, P.pack_tokens(toks))
+            elif sess.retired is not None:
+                reason, n = sess.retired
+                del self._sessions[key]
+                reply = (P.RETIRED,
+                         P.pack_json({"reason": reason, "tokens": n}))
+            else:
+                sess.poll_pending = True    # answered when data lands
+        if reply is not None:
+            conn.send(reply[0], rid, reply[1])
+
+    def _on_conn_closed(self, conn: FrameConn) -> None:
+        """A disconnected client's requests are cancelled — their slots
+        free at the next consumed chunk and readmit from the queue."""
+        with self._lock:
+            doomed = [key for key, s in self._sessions.items()
+                      if s.conn is conn]
+            for key in doomed:
+                del self._sessions[key]
+        for key in doomed:
+            self.engine.cancel(key)
+
+    # -- engine callbacks (engine thread; cancels: any thread) --------------
+    def _on_delta(self, key, toks) -> None:
+        reply = None
+        with self._lock:
+            sess = self._sessions.get(key)
+            if sess is None:
+                return                      # late delta for a dead session
+            if sess.stream:
+                reply = (sess.conn, P.TOKENS, sess.rid,
+                         P.pack_tokens(toks))
+            else:
+                sess.buffer.extend(int(t) for t in toks)
+                if sess.poll_pending:
+                    sess.poll_pending = False
+                    buf, sess.buffer = sess.buffer, []
+                    reply = (sess.conn, P.TOKENS, sess.rid,
+                             P.pack_tokens(buf))
+        if reply is not None and not reply[0].send(*reply[1:]):
+            self._drop_dead_conn(reply[0])
+
+    def _on_retired(self, key, reason: str, n_tokens: int,
+                    final_tokens) -> None:
+        conn = None
+        frames: list = []
+        with self._lock:
+            sess = self._sessions.get(key)
+            if sess is None:
+                return
+            conn = sess.conn
+            body = P.pack_json({"reason": reason, "tokens": n_tokens})
+            if sess.stream:
+                del self._sessions[key]
+                # the final delta and the retirement go out in ONE
+                # write (see FrameConn.send_many)
+                if final_tokens:
+                    frames.append((P.TOKENS, sess.rid,
+                                   P.pack_tokens(final_tokens)))
+                frames.append((P.RETIRED, sess.rid, body))
+            else:
+                sess.buffer.extend(int(t) for t in final_tokens)
+                sess.retired = (reason, n_tokens)
+                if sess.poll_pending:
+                    sess.poll_pending = False
+                    if sess.buffer:
+                        buf, sess.buffer = sess.buffer, []
+                        frames.append((P.TOKENS, sess.rid,
+                                       P.pack_tokens(buf)))
+                    else:
+                        del self._sessions[key]
+                        frames.append((P.RETIRED, sess.rid, body))
+        if frames and not conn.send_many(frames):
+            self._drop_dead_conn(conn)
+
+    def _drop_dead_conn(self, conn: FrameConn) -> None:
+        """A send failed mid-stream: the peer is gone. Close the socket
+        so its reader thread unblocks and runs the disconnect cleanup
+        (cancel + slot free)."""
+        conn.close()
