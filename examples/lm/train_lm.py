@@ -38,11 +38,11 @@ import jax.numpy as jnp
 import numpy as np
 
 import tony_tpu.runtime as rt
-from tony_tpu.io.prefetch import (DevicePrefetcher, reader_epochs,
-                                   synchronous_batches)
+from tony_tpu.io.prefetch import (DevicePrefetcher, elastic_epochs,
+                                   reader_epochs, synchronous_batches)
 from tony_tpu.models import transformer as T
 from tony_tpu.models.checkpoint import CheckpointManager, attempt_number
-from tony_tpu.models.loop import run_training
+from tony_tpu.models.loop import GangLostError, run_training
 from tony_tpu.models.train import (batch_sharding, data_parallel_rank,
                                    default_optimizer, init_state,
                                    make_train_step)
@@ -57,6 +57,25 @@ def synthetic_source(seed: int, batch: int, seq: int, vocab: int):
     while True:
         tokens = rs.randint(0, vocab, size=(batch, seq + 1)).astype(np.int32)
         yield {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
+
+
+def elastic_file_source(paths, global_batch: int, seq: int, seed: int,
+                        start_step: int):
+    """World-size-invariant feed for ELASTIC jobs (tony.elastic.enabled):
+    the canonical single-reader stream is sliced per process, so the
+    global batch at step s is identical before and after a shrink/regrow
+    and the resumed loss curve continues exactly where the checkpoint
+    left it (tony_tpu.io.prefetch.elastic_epochs; tradeoff: every process
+    reads the whole dataset)."""
+    rows, per_epoch = elastic_epochs(paths, global_batch, np.int32,
+                                     (seq + 1,), shuffle=True, seed=seed,
+                                     start_step=start_step)
+
+    def batches():
+        for tokens in rows:
+            yield {"inputs": tokens[:, :seq], "targets": tokens[:, 1:]}
+
+    return batches()
 
 
 def file_source(paths, batch: int, seq: int, seed: int):
@@ -114,6 +133,19 @@ def main() -> int:
                              "attends its N most recent positions "
                              "(0 = full causal); attention cost goes "
                              "O(seq*window) instead of O(seq^2)")
+    parser.add_argument("--elastic_data", type=int, default=0,
+                        metavar="GLOBAL_BATCH",
+                        help="feed --data_files through the world-size-"
+                             "invariant elastic source with this FIXED "
+                             "global batch (must divide evenly over every "
+                             "world size the job can shrink to; each "
+                             "process feeds global/N rows) — required for "
+                             "loss-curve continuity under "
+                             "tony.elastic.enabled shrink/regrow. The "
+                             "value is deliberately explicit: deriving it "
+                             "from the live process count would change "
+                             "the canonical stream across the very "
+                             "transitions it exists to survive")
     parser.add_argument("--prefetch_depth", type=int, default=2,
                         help="device-prefetch queue depth (batches decoded "
                              "+ transferred ahead of the step loop); 0 = "
@@ -167,13 +199,20 @@ def main() -> int:
     # identical data. Each process contributes its LOCAL shard; the
     # prefetcher assembles global sharded arrays on its producer thread so
     # decode + H2D overlap device compute.
-    source = (file_source(args.data_files, args.batch_size, args.seq_len,
-                          seed=attempt_number())
-              if args.data_files else
-              synthetic_source(data_parallel_rank(mesh)
-                               + 1000 * attempt_number(),
-                               args.batch_size, args.seq_len,
-                               cfg.vocab_size))
+    if args.elastic_data:
+        if not args.data_files:
+            raise SystemExit("--elastic_data requires --data_files")
+        source = elastic_file_source(
+            args.data_files, args.elastic_data,
+            args.seq_len, seed=0, start_step=start_step)
+    elif args.data_files:
+        source = file_source(args.data_files, args.batch_size,
+                             args.seq_len, seed=attempt_number())
+    else:
+        source = synthetic_source(data_parallel_rank(mesh)
+                                  + 1000 * attempt_number(),
+                                  args.batch_size, args.seq_len,
+                                  cfg.vocab_size)
     if args.prefetch_depth > 0:
         data = DevicePrefetcher(source, sharding=b_sharding,
                                 depth=args.prefetch_depth)
@@ -198,6 +237,11 @@ def main() -> int:
             step_fn, state, data, args.steps, start_step=start_step,
             checkpoint=mgr, log_every=20, log_fn=log_fn,
             step_hook=tracer.step)
+    except GangLostError as e:
+        # elastic contract: the executor holds this distinguished exit and
+        # relaunches us against the resized gang (checkpoints are flushed)
+        print(f"gang lost: {e}", flush=True)
+        return e.exit_code
     finally:
         tracer.close()
     if mgr:

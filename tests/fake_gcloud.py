@@ -16,6 +16,16 @@ $FAKE_GCLOUD_ROOT/calls.log for assertions. One fake-ism: hosts share
 this machine's /tmp, so the staging path /tmp/tony-stage.tgz is rewritten
 to a per-worker location in both scp and ssh commands.
 
+Deterministic preemption (the elastic suite's TPU-side kill switch):
+  FAKE_PREEMPT_<GANG>=1|<marker>  flips the slice's state to PREEMPTED on
+      its next describe (and SIGKILLs its host processes, like a real
+      preemption) — <GANG> is the slice name uppercased with non-
+      alphanumerics mapped to "_". A value other than "1" is a marker
+      path: the flip waits until that file exists. One-shot per slice
+      generation (delete + recreate rearms it). There is also an explicit
+      verb: ``gcloud compute tpus tpu-vm preempt <name>`` flips the state
+      immediately.
+
 Scripted failures (the MiniYARN-style failure repertoire — file-backed
 counters so they work across fake invocations):
   FAKE_FAIL_CREATE_N=k    first k creates exit 1 with RESOURCE_EXHAUSTED
@@ -95,6 +105,38 @@ def rewrite_tmp(cmd: str, home: str) -> str:
                        os.path.join(home, ".tony-stage.tgz"))
 
 
+def preempt_slice(name: str) -> bool:
+    """Flip ``name`` to PREEMPTED and SIGKILL its hosts' processes (a
+    real preemption takes the VMs down, not just the API state). Returns
+    False when the slice does not exist."""
+    state_path = os.path.join(slice_dir(name), "state")
+    if not os.path.exists(state_path):
+        return False
+    with open(state_path, "w") as f:
+        f.write("PREEMPTED")
+    # best-effort host kill: every process whose cwd/HOME is a worker dir
+    subprocess.run(["pkill", "-9", "-f", slice_dir(name)],
+                   capture_output=True)
+    return True
+
+
+def maybe_env_preempt(name: str) -> None:
+    """FAKE_PREEMPT_<GANG>: one-shot marker-gated preemption, checked on
+    describe (the state poller's code path, like the real API)."""
+    key = "FAKE_PREEMPT_" + "".join(
+        c if c.isalnum() else "_" for c in name).upper()
+    val = os.environ.get(key)
+    if not val:
+        return
+    fired = os.path.join(slice_dir(name), ".preempt-fired")
+    if os.path.exists(fired):
+        return
+    if val != "1" and not os.path.exists(val):
+        return      # marker-gated: wait for the trainer to reach the step
+    if preempt_slice(name):
+        open(fired, "w").close()
+
+
 def main(argv):
     if argv[:2] == ["auth", "print-access-token"]:
         # per-job scoped identity mint (tony.gcs.service-account)
@@ -151,10 +193,15 @@ def main(argv):
             f.write(os.environ.get("FAKE_NUM_WORKERS", "1"))
         return 0
 
+    if verb == "preempt":
+        # test-only verb: immediate deterministic preemption
+        return 0 if preempt_slice(name) else 1
+
     if verb == "describe":
         if scripted_failure("DESCRIBE"):
             print("ERROR: backend error: please retry", file=sys.stderr)
             return 1
+        maybe_env_preempt(name)
         state_path = os.path.join(slice_dir(name), "state")
         if not os.path.exists(state_path):
             print("NOT_FOUND", file=sys.stderr)

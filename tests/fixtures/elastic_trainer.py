@@ -1,0 +1,134 @@
+"""Tiny REAL trainer for the elastic e2e suite: linear model, the full
+framework stack (runtime bootstrap → mesh → elastic_epochs →
+DevicePrefetcher → make_train_step → run_training → CheckpointManager),
+compiling in well under a second so gang-loss recovery is testable in
+tier-1 wall budgets.
+
+Prints ``step <i> loss <v>`` EVERY step. Because the data source is
+:func:`tony_tpu.io.prefetch.elastic_epochs` (world-size-invariant global
+batches, stream aligned to the restored step), the loss at global step i
+is a pure function of (init seed, data seed, i) — identical across world
+sizes and across kill/resume boundaries — which is what the e2e pins.
+
+Flags:
+  --steps N --ckpt_dir D --ckpt_every K --global_batch B --dim F
+  --data f1 [f2 ...]    binary int32 token files, rows of dim+1 ids
+  --step_wait S         host sleep per step (makes the kill window real)
+  --touch PATH --touch_at STEP --touch_index IDX
+                        task IDX touches PATH when it STARTS step STEP —
+                        the TEST_PREEMPT_TASKS marker handshake
+
+Standalone (no cluster env) it runs single-process — the uninterrupted
+baseline the e2e compares loss curves against.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+import jax
+import numpy as np
+import optax
+
+import tony_tpu.runtime as rt
+from tony_tpu.io.prefetch import DevicePrefetcher, elastic_epochs
+from tony_tpu.models.checkpoint import CheckpointManager
+from tony_tpu.models.loop import GangLostError, run_training
+from tony_tpu.models.train import batch_sharding, init_state, make_train_step
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=12)
+    p.add_argument("--ckpt_dir", required=True)
+    p.add_argument("--ckpt_every", type=int, default=2)
+    p.add_argument("--global_batch", type=int, default=8)
+    p.add_argument("--dim", type=int, default=4)
+    p.add_argument("--data", nargs="+", required=True)
+    p.add_argument("--step_wait", type=float, default=0.0)
+    p.add_argument("--touch", default="")
+    p.add_argument("--touch_at", type=int, default=-1)
+    p.add_argument("--touch_index", type=int, default=1)
+    args = p.parse_args()
+
+    info = rt.initialize()
+    mesh = rt.mesh()
+    print(f"[{info.job_name}:{info.task_index}] epoch="
+          f"{os.environ.get('TONY_CLUSTER_EPOCH', '0')} "
+          f"procs={info.num_processes} devices={len(jax.devices())}",
+          flush=True)
+
+    def loss_fn(params, batch):
+        pred = batch["x"] @ params["w"] + params["b"]
+        return ((pred - batch["y"]) ** 2).mean()
+
+    opt = optax.sgd(0.05)
+    params = {"w": np.zeros((args.dim,), np.float32),
+              "b": np.zeros((), np.float32)}
+    # mesh=None: plain jit — the batch arrives as a GLOBAL sharded array
+    # (DevicePrefetcher assembles it against batch_sharding below), so
+    # jit runs SPMD via compute-follows-data without an ambient mesh.
+    step_fn = make_train_step(loss_fn, opt)
+
+    mgr = CheckpointManager(args.ckpt_dir,
+                            save_interval_steps=args.ckpt_every)
+    # Replicated-template init: restored arrays must come back as GLOBAL
+    # (mesh-replicated) jax.Arrays, or jit refuses to mix them with the
+    # globally-sharded batch in multi-process worlds. device_put of the
+    # (identical-everywhere) init values onto the replicated sharding is
+    # the standard multi-host recipe.
+    rep = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec())
+    state = mgr.restore_or_init(
+        lambda: jax.tree.map(lambda x: jax.device_put(x, rep),
+                             init_state(params, opt)))
+    start_step = int(state["step"])
+    print(f"starting at step {start_step}", flush=True)
+
+    rows, per_epoch = elastic_epochs(
+        args.data, args.global_batch, np.int32, (args.dim + 1,),
+        shuffle=True, seed=7, start_step=start_step,
+        process_index=info.process_id if info.is_distributed else 0,
+        process_count=info.num_processes if info.is_distributed else 1)
+
+    def batches():
+        for r in rows:
+            f = r.astype(np.float32) / 1024.0
+            yield {"x": f[:, :args.dim], "y": f[:, args.dim]}
+
+    sharding = batch_sharding(mesh, logical=("batch",))
+
+    def step_hook(step: int) -> None:
+        if (args.touch and step == args.touch_at
+                and info.task_index == args.touch_index):
+            open(args.touch, "w").close()
+            print(f"touched kill marker at step {step}", flush=True)
+        if args.step_wait:
+            time.sleep(args.step_wait)
+
+    def log_fn(step, metrics, batch):
+        print(f"step {step} loss {float(metrics['loss']):.6f}", flush=True)
+
+    try:
+        with DevicePrefetcher(batches(), sharding=sharding, depth=2) as data:
+            state, metrics = run_training(
+                step_fn, state, data, args.steps, start_step=start_step,
+                checkpoint=mgr, log_every=1, log_fn=log_fn,
+                step_hook=step_hook)
+    except GangLostError as e:
+        # the elastic contract: distinguished exit, executor relaunches
+        # us against the resized gang (checkpoints already flushed by
+        # run_training's finally)
+        print(f"gang lost: {e}", flush=True)
+        return e.exit_code
+    mgr.close()
+    loss = float(metrics["loss"]) if metrics else float("nan")
+    print(f"done: final loss {loss:.6f}", flush=True)
+    return 0 if np.isfinite(loss) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

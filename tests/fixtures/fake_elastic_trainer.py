@@ -1,0 +1,60 @@
+"""jax-free stand-in trainer for orchestration-level elastic benchmarks
+and chaos smokes: counts steps at a fixed host cadence, "checkpoints"
+progress to an atomically-renamed file every K steps, resumes from it on
+relaunch, and can touch a kill marker at a given step — so recovery wall
+and replayed-step counts measure the ORCHESTRATION (detection, resync,
+relaunch), not model compile time.
+
+Prints ``step <i>`` per step; the same line set is what the bench arm
+diffs to count replayed steps.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=16)
+    p.add_argument("--ckpt", required=True,
+                   help="progress-file stem (per-task suffix appended)")
+    p.add_argument("--ckpt_every", type=int, default=2)
+    p.add_argument("--step_wait", type=float, default=0.1)
+    p.add_argument("--kill", action="append", default=[],
+                   help="marker_path:step:task_index — task_index touches "
+                        "marker_path when it STARTS that step (repeatable; "
+                        "the TEST_PREEMPT_TASKS handshake)")
+    args = p.parse_args()
+
+    idx = int(os.environ.get("TASK_INDEX", "0"))
+    kills = []
+    for clause in args.kill:
+        marker, step, who = clause.rsplit(":", 2)
+        if int(who) == idx:
+            kills.append((int(step), marker))
+    path = f"{args.ckpt}-{os.environ.get('JOB_NAME', 'worker')}-{idx}"
+    start = 0
+    if os.path.exists(path):
+        start = int(open(path).read().strip() or 0)
+    print(f"starting at step {start} "
+          f"(epoch {os.environ.get('TONY_CLUSTER_EPOCH', '0')}, "
+          f"session {os.environ.get('SESSION_ID', '0')})", flush=True)
+    for step in range(start, args.steps):
+        for kill_step, marker in kills:
+            if step == kill_step:
+                open(marker, "w").close()
+        time.sleep(args.step_wait)
+        print(f"step {step}", flush=True)
+        if (step + 1) % args.ckpt_every == 0:
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                f.write(str(step + 1))
+            os.replace(tmp, path)       # atomic: a kill never corrupts it
+    print("done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,95 @@
+"""Seeded chaos smokes: randomized-but-reproducible gang-kill schedules.
+
+Tier-1-safe fault injection over the REAL elastic stack: the schedule
+(victim gangs, kill steps) is drawn from a seeded RNG — vary it with
+``TONY_CHAOS_SEED`` — and logged in the failure message, so any red run
+is replayable bit-for-bit. Uses the jax-free fake trainer: the smoke
+exercises detection → shrink → resync → regrow orchestration, not model
+math (tests/test_elastic.py pins the numerics)."""
+
+import os
+import random
+import sys
+
+import pytest
+
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events.events import find_job_files, parse_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "fixtures",
+                       "fake_elastic_trainer.py")
+PY = sys.executable
+
+
+@pytest.mark.chaos
+@pytest.mark.e2e
+def test_seeded_gang_kill_schedule_survives(tmp_path):
+    """3 single-host gangs, elastic on with regrow: kill a seeded-random
+    non-chief gang at a seeded-random step (and, on half the seeds, a
+    second gang later) — the job must still exit 0 without a session
+    reset, and every worker must log its final step."""
+    seed = int(os.environ.get("TONY_CHAOS_SEED", "20260804"))
+    rng = random.Random(seed)
+    steps = 14
+    first_victim = rng.choice([1, 2])
+    first_step = rng.randint(2, 6)
+    second = rng.random() < 0.5
+    second_victim = 3 - first_victim          # the other non-chief gang
+    second_step = rng.randint(first_step + 4, steps - 3)
+    schedule = {"seed": seed,
+                "kills": [(f"worker:{first_victim}", first_step)]
+                + ([(f"worker:{second_victim}", second_step)]
+                   if second else [])}
+
+    markers = {}
+    clauses = []
+    for victim, step in schedule["kills"]:
+        m = tmp_path / f"kill-{victim.replace(':', '-')}.marker"
+        markers[victim] = (m, step)
+        clauses.append(f"{victim}@{m}")
+    # every victim touches its own marker at its scheduled step (the
+    # trainer's repeatable --kill clauses filter by task index)
+    kill_flags = " ".join(
+        f"--kill {m}:{s}:{v.split(':')[1]}"
+        for v, (m, s) in markers.items())
+    cmd = (f"{PY} {TRAINER} --steps {steps} "
+           f"--ckpt {tmp_path / 'progress'} --ckpt_every 2 "
+           f"--step_wait 0.2 {kill_flags}")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": str(tmp_path / "hist"),
+        "tony.application.timeout": "120000",
+        "tony.worker.instances": "3",
+        "tony.worker.slices": "3",
+        "tony.task.heartbeat-interval-ms": "250",
+        "tony.elastic.enabled": "true",
+        "tony.elastic.regrow": "true",
+        "tony.elastic.regrow-backoff-ms": "500",
+    })
+    client = TonyClient(conf, cmd, shell_env={
+        "TEST_PREEMPT_TASKS": ";".join(clauses),
+        "TONY_RESYNC_KILL_GRACE_S": "3",
+    })
+    rc = client.run()
+    files = find_job_files(conf.get("tony.history.location"))
+    types = [e.event_type for e in parse_events(files[0])] if files else []
+    detail = (f"chaos schedule {schedule} → rc={rc}, events={types} — "
+              f"reproduce with TONY_CHAOS_SEED={seed}")
+    assert rc == 0, detail
+    assert "SESSION_RESET" not in types, detail
+    assert types.count("ELASTIC_SHRINK") == len(schedule["kills"]), detail
+    log_dir = os.path.join(client.job_dir, "logs")
+    # the chief is never detachable and its completion is the job verdict
+    # — it must have run the whole schedule out
+    chief = open(os.path.join(log_dir, "worker-0.stdout")).read()
+    assert f"step {steps - 1}" in chief, detail + " (chief log)"
+    # every victim's gang came back: a second trainer generation started
+    # (the fake trainer has no collectives, so a regrown straggler may
+    # legitimately be cut off when the chief's completion ends the job)
+    for victim, _ in schedule["kills"]:
+        body = open(os.path.join(
+            log_dir, f"worker-{victim.split(':')[1]}.stdout")).read()
+        assert body.count("starting at step") >= 2, (
+            detail + f" ({victim} never relaunched)")

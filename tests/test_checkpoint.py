@@ -77,6 +77,63 @@ class TestCheckpointManager:
                                       np.asarray(w))
 
 
+class TestRestoreVsInFlightSaveFence:
+    """restore/latest_step must never observe a partially-written async
+    save: both fence on wait_until_finished BEFORE consulting the step
+    index (the elastic degraded-resume path restores immediately after a
+    kill that may have interrupted a save mid-commit)."""
+
+    class _Tracking:
+        """Proxy over the real orbax manager recording call order."""
+
+        def __init__(self, real, calls):
+            self.__dict__["_real"] = real
+            self.__dict__["calls"] = calls
+
+        def __getattr__(self, name):
+            if name in ("wait_until_finished", "latest_step", "restore"):
+                def wrapped(*a, **k):
+                    self.calls.append(name)
+                    return getattr(self._real, name)(*a, **k)
+                return wrapped
+            return getattr(self._real, name)
+
+    def test_latest_step_fences_first(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "c"))
+        calls = []
+        mgr._mgr = self._Tracking(mgr._mgr, calls)
+        mgr.save(3, _state(5.0))         # async — commit in flight
+        assert mgr.latest_step() == 3    # fenced: never a partial view
+        assert "wait_until_finished" in calls
+        assert calls.index("wait_until_finished") \
+            < calls.index("latest_step")
+        mgr.close()
+
+    def test_restore_during_in_flight_save_sees_committed_state(
+            self, tmp_path):
+        """Save → IMMEDIATE restore with no explicit wait, repeatedly:
+        the fence makes every restore read the just-accepted save's
+        committed bytes, never an older step or a torn directory."""
+        with CheckpointManager(str(tmp_path / "c"), max_to_keep=2) as mgr:
+            for s in range(4):
+                mgr.save(s, _state(float(s)))
+                restored = mgr.restore(template=_state(0.0))
+                np.testing.assert_array_equal(
+                    restored["params"]["w"][0, 0], float(s))
+                assert mgr.latest_step() == s
+
+    def test_restore_or_init_fences(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path / "c"))
+        calls = []
+        mgr._mgr = self._Tracking(mgr._mgr, calls)
+        mgr.save(1, _state(2.0))
+        state = mgr.restore_or_init(lambda: _state(0.0))
+        np.testing.assert_array_equal(state["params"]["w"][0, 0], 2.0)
+        assert calls.index("wait_until_finished") \
+            < calls.index("latest_step")
+        mgr.close()
+
+
 def test_attempt_number_env(monkeypatch):
     from tony_tpu import constants
     assert attempt_number() == 0

@@ -1,0 +1,636 @@
+"""Elastic preemption-tolerant training (tier-1).
+
+The acceptance suite for the degraded-resume loop: a REAL job (client →
+coordinator → 2 worker processes → jax.distributed over the gang
+barrier) loses one gang mid-train to an injected preemption and KEEPS
+RUNNING — the survivor checkpoint-syncs, re-handshakes over a bumped
+cluster-spec epoch, restores from the latest completed async checkpoint
+and resumes, with the loss curve pinned step-continuous against an
+uninterrupted single-process run (the elastic_epochs source makes global
+batches world-size invariant, so the losses match to float noise). A
+second e2e regrows the lost gang and pins continuity across BOTH
+transitions. The stop-the-world session-rerun path stays pinned for
+non-preemption failures and for losses the eligibility gate rejects
+(the chief's gang)."""
+
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from tony_tpu.backend.base import LaunchSpec
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events.events import find_job_files, parse_events
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures")
+PY = sys.executable
+TRAINER = os.path.join(FIXTURES, "elastic_trainer.py")
+
+#: observed cross-world-size drift is 0 (bit-identical); the tolerance
+#: only absorbs float-print rounding
+LOSS_TOL = 1e-4
+
+
+def _parse_losses(text: str) -> dict[int, list[float]]:
+    out: dict[int, list[float]] = {}
+    for m in re.finditer(r"^step (\d+) loss ([\d.]+)$", text, re.M):
+        out.setdefault(int(m.group(1)), []).append(float(m.group(2)))
+    return out
+
+
+@pytest.fixture(scope="module")
+def elastic_data(tmp_path_factory):
+    d = tmp_path_factory.mktemp("elastic-data")
+    rows = np.random.RandomState(0).randint(
+        0, 1024, size=(64, 5)).astype(np.int32)
+    path = d / "data.bin"
+    rows.tofile(path)
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def baseline_losses(elastic_data, tmp_path_factory):
+    """Uninterrupted single-process run: THE loss curve. Elastic runs at
+    any world size / any kill schedule must reproduce it exactly."""
+    ck = tmp_path_factory.mktemp("elastic-baseline") / "ck"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "",
+                "PYTHONPATH": REPO})
+    res = subprocess.run(
+        [PY, TRAINER, "--steps", "16", "--ckpt_dir", str(ck),
+         "--ckpt_every", "2", "--data", elastic_data,
+         "--global_batch", "8"],
+        env=env, capture_output=True, text=True, timeout=180)
+    assert res.returncode == 0, res.stdout[-2000:] + res.stderr[-2000:]
+    losses = _parse_losses(res.stdout)
+    assert sorted(losses) == list(range(16)), sorted(losses)
+    return {k: v[0] for k, v in losses.items()}
+
+
+def _trainer_cmd(steps, ck, data, marker, touch_at, touch_index=1):
+    return (f"{PY} {TRAINER} --steps {steps} --ckpt_dir {ck} "
+            f"--ckpt_every 2 --data {data} --global_batch 8 "
+            f"--step_wait 0.25 --touch {marker} --touch_at {touch_at} "
+            f"--touch_index {touch_index}")
+
+
+def _make_client(tmp_path, cmd, confs, shell_env):
+    base = {
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": str(tmp_path / "hist"),
+        "tony.application.timeout": "150000",
+    }
+    base.update(confs)
+    env = {"JAX_PLATFORMS": "cpu", "XLA_FLAGS": "", "PYTHONPATH": REPO,
+           "TONY_RESYNC_KILL_GRACE_S": "3"}
+    env.update(shell_env)
+    return TonyClient(TonyConfig(base), cmd, shell_env=env)
+
+
+def _job_events(client):
+    files = find_job_files(client.conf.get("tony.history.location"))
+    assert len(files) == 1, files
+    return list(parse_events(files[0]))
+
+
+def _logged_losses(client) -> dict[int, list[float]]:
+    merged: dict[int, list[float]] = {}
+    log_dir = os.path.join(client.job_dir, "logs")
+    for name in sorted(os.listdir(log_dir)):
+        if name.startswith("worker-") and name.endswith(".stdout"):
+            for step, vals in _parse_losses(
+                    open(os.path.join(log_dir, name)).read()).items():
+                merged.setdefault(step, []).extend(vals)
+    return merged
+
+
+def _assert_continuous(client, baseline, last_step):
+    """Every loss any worker EVER printed — before the kill, replayed
+    after the restore, post-regrow — must equal the uninterrupted run's
+    loss at that global step."""
+    losses = _logged_losses(client)
+    assert max(losses) == last_step, sorted(losses)
+    for step, vals in losses.items():
+        for v in vals:
+            assert abs(v - baseline[step]) <= LOSS_TOL, (
+                f"step {step}: got {v}, uninterrupted run had "
+                f"{baseline[step]}")
+
+
+@pytest.mark.e2e
+class TestElasticE2E:
+    def test_shrink_survives_gang_loss_with_loss_continuity(
+            self, tmp_path, elastic_data, baseline_losses):
+        """Kill gang worker:1 (slice 1) at step 6; the session must NOT
+        reset — worker:0 re-handshakes over the shrunk world, restores
+        from the latest completed checkpoint, and finishes all 12 steps
+        with the loss curve pinned to the uninterrupted run."""
+        marker = tmp_path / "kill.marker"
+        client = _make_client(
+            tmp_path,
+            _trainer_cmd(12, tmp_path / "ck", elastic_data, marker, 6),
+            {"tony.worker.instances": "2", "tony.worker.slices": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.elastic.enabled": "true",
+             "tony.elastic.regrow": "false"},
+            {"TEST_PREEMPT_TASKS": f"worker:1@{marker}"})
+        assert client.run() == 0
+        _assert_continuous(client, baseline_losses, last_step=11)
+        # the survivor demonstrably ran the shrunk world
+        w0 = open(os.path.join(client.job_dir, "logs",
+                               "worker-0.stdout")).read()
+        assert "procs=1" in w0 and "procs=2" in w0
+        types = [e.event_type for e in _job_events(client)]
+        assert "ELASTIC_SHRINK" in types
+        assert "ELASTIC_RESUMED" in types
+        assert "SESSION_RESET" not in types
+        ev = {e.event_type: e.payload for e in _job_events(client)}
+        assert ev["ELASTIC_SHRINK"]["lost"] == ["worker:1"]
+        assert ev["ELASTIC_SHRINK"]["epoch"] == 1
+        assert ev["ELASTIC_RESUMED"]["recovery_wall_s"] > 0
+        finished = [e.payload for e in _job_events(client)
+                    if e.event_type == "TASK_FINISHED"
+                    and e.payload["task"] == "worker:1"]
+        assert finished[0]["preempted"] and finished[0]["detached"]
+
+    def test_regrow_expands_back_and_keeps_training(
+            self, tmp_path, elastic_data, baseline_losses):
+        """Same kill, regrow on: the survivor first trains ALONE (epoch 1,
+        procs=1), then the relaunched gang folds back in (epoch 2,
+        procs=2) and both run to step 16 — loss curve continuous across
+        BOTH elastic transitions."""
+        marker = tmp_path / "kill.marker"
+        client = _make_client(
+            tmp_path,
+            _trainer_cmd(16, tmp_path / "ck", elastic_data, marker, 5),
+            {"tony.worker.instances": "2", "tony.worker.slices": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.elastic.enabled": "true",
+             "tony.elastic.regrow": "true",
+             # long enough that the survivor demonstrably trains alone
+             # before the replacement lands
+             "tony.elastic.regrow-backoff-ms": "6000"},
+            {"TEST_PREEMPT_TASKS": f"worker:1@{marker}"})
+        assert client.run() == 0
+        _assert_continuous(client, baseline_losses, last_step=15)
+        types = [e.event_type for e in _job_events(client)]
+        assert "ELASTIC_SHRINK" in types
+        assert "ELASTIC_REGROW" in types
+        assert "SESSION_RESET" not in types
+        regrow = [e.payload for e in _job_events(client)
+                  if e.event_type == "ELASTIC_REGROW"][0]
+        assert regrow["regrown"] == ["worker:1"] and regrow["active"] == 2
+        w0 = open(os.path.join(client.job_dir, "logs",
+                               "worker-0.stdout")).read()
+        assert "procs=1" in w0          # the degraded interlude happened
+        # the regrown gang really trained again after its loss
+        w1 = open(os.path.join(client.job_dir, "logs",
+                               "worker-1.stdout")).read()
+        assert "step 15" in w1 and "done:" in w1
+
+    def test_user_failure_keeps_stop_the_world(self, tmp_path):
+        """Elastic ON, but a plain user failure (exit 1, not preemption):
+        the session-rerun path must fire exactly as before — elastic only
+        absorbs infrastructure loss."""
+        client = _make_client(
+            tmp_path,
+            f"{PY} {os.path.join(FIXTURES, 'fail_once.py')}",
+            {"tony.worker.instances": "2",
+             "tony.elastic.enabled": "true",
+             "tony.am.retry-count": "1"},
+            {})
+        assert client.run() == 0
+        types = [e.event_type for e in _job_events(client)]
+        assert "SESSION_RESET" in types
+        assert "ELASTIC_SHRINK" not in types
+
+    def test_chief_gang_loss_falls_back_to_session_rerun(
+            self, tmp_path, elastic_data):
+        """The chief's gang is never detachable: killing it routes to the
+        stop-the-world preemption budget, which re-runs the session (and
+        the rerun resumes from the shared checkpoint dir)."""
+        marker = tmp_path / "kill.marker"
+        client = _make_client(
+            tmp_path,
+            _trainer_cmd(10, tmp_path / "ck", elastic_data, marker, 4,
+                         touch_index=0),
+            {"tony.worker.instances": "2", "tony.worker.slices": "2",
+             "tony.application.mesh": "dp=-1",
+             "tony.elastic.enabled": "true",
+             "tony.am.retry-count": "0"},      # preemption budget only
+            {"TEST_PREEMPT_TASKS": f"worker:0@{marker}"})
+        assert client.run() == 0
+        types = [e.event_type for e in _job_events(client)]
+        assert "SESSION_RESET" in types
+        assert "ELASTIC_SHRINK" not in types
+
+    def test_preempt_tasks_hook_drives_preemption_budget(self, tmp_path):
+        """The new kill-gang hook composes with the EXISTING stop-the-world
+        machinery when elastic is off: an immediate (marker-less) clause
+        preempts the task once and the job recovers from the preemption
+        budget without consuming a user retry."""
+        client = _make_client(
+            tmp_path,
+            f"{PY} {os.path.join(FIXTURES, 'sleep_briefly.py')} 3",
+            {"tony.worker.instances": "1",
+             "tony.am.retry-count": "0"},
+            {"TEST_PREEMPT_TASKS": "worker:0"})
+        assert client.run() == 0
+        types = [e.event_type for e in _job_events(client)]
+        assert "SESSION_RESET" in types
+
+
+# ---------------------------------------------------------------------------
+# elastic_epochs: world-size-invariant data positions (no cluster)
+# ---------------------------------------------------------------------------
+class TestElasticEpochs:
+    DIM = 3
+
+    def _data(self, tmp_path, rows=40):
+        arr = np.arange(rows * (self.DIM + 1),
+                        dtype=np.int32).reshape(rows, self.DIM + 1)
+        path = tmp_path / "rows.bin"
+        arr.tofile(path)
+        return str(path), arr
+
+    def _take(self, path, steps, *, pid, pcount, start_step=0):
+        from tony_tpu.io.prefetch import elastic_epochs
+        it, per_epoch = elastic_epochs(
+            [path], 8, np.int32, (self.DIM + 1,), shuffle=True, seed=3,
+            start_step=start_step, process_index=pid,
+            process_count=pcount)
+        out = [next(it) for _ in range(steps)]
+        return out, per_epoch
+
+    def test_global_batches_world_size_invariant(self, tmp_path):
+        path, _ = self._data(tmp_path)
+        canon, per_epoch = self._take(path, 10, pid=0, pcount=1)
+        assert per_epoch == 5            # 40 rows / global batch 8
+        for pcount in (2, 4):
+            slices = [self._take(path, 10, pid=p, pcount=pcount)[0]
+                      for p in range(pcount)]
+            for step in range(10):
+                got = np.concatenate([s[step] for s in slices])
+                np.testing.assert_array_equal(got, canon[step])
+
+    def test_mid_epoch_shrink_no_duplicates_no_gaps(self, tmp_path):
+        """Shrink N=2 → N-1 mid-epoch: 2 processes feed steps 0..2, the
+        kill lands at step 3 with the checkpoint at step 2, and the
+        survivor resumes at start_step=2 alone. The union of batches fed
+        across all survivors IS the deterministic canonical stream —
+        every global step's batch fed exactly by its canonical rows,
+        none skipped, none double-fed (the replayed step 2 is the SAME
+        batch, re-fed to recompute the same update)."""
+        path, arr = self._data(tmp_path)
+        canon, per_epoch = self._take(path, 5, pid=0, pcount=1)
+        pre = [self._take(path, 3, pid=p, pcount=2)[0] for p in range(2)]
+        post, _ = self._take(path, 3, pid=0, pcount=1, start_step=2)
+        fed = {}
+        for step in range(3):            # the 2-process prefix
+            fed[step] = np.concatenate([pre[0][step], pre[1][step]])
+        for i, batch in enumerate(post):  # the survivor, from the ckpt
+            fed[2 + i] = batch
+        assert sorted(fed) == list(range(5))      # no gaps
+        for step in range(5):                     # no foreign/dup rows
+            np.testing.assert_array_equal(fed[step], canon[step])
+        # one full epoch's coverage is exactly the file's rows
+        rows = np.concatenate([fed[s] for s in range(5)])
+        assert sorted(map(tuple, rows)) == sorted(map(tuple, arr))
+
+    def test_start_step_skips_into_later_epochs(self, tmp_path):
+        path, _ = self._data(tmp_path)
+        canon, _ = self._take(path, 13, pid=0, pcount=1)
+        tail, _ = self._take(path, 2, pid=0, pcount=1, start_step=11)
+        np.testing.assert_array_equal(tail[0], canon[11])
+        np.testing.assert_array_equal(tail[1], canon[12])
+
+    def test_indivisible_global_batch_rejected(self, tmp_path):
+        from tony_tpu.io.prefetch import elastic_epochs
+        path, _ = self._data(tmp_path)
+        with pytest.raises(ValueError, match="divide"):
+            elastic_epochs([path], 8, np.int32, (self.DIM + 1,),
+                           process_index=0, process_count=3)
+
+    def test_too_small_data_rejected(self, tmp_path):
+        from tony_tpu.io.prefetch import elastic_epochs
+        path, _ = self._data(tmp_path, rows=4)
+        with pytest.raises(ValueError, match="global batch"):
+            elastic_epochs([path], 8, np.int32, (self.DIM + 1,),
+                           process_index=0, process_count=1)
+
+
+# ---------------------------------------------------------------------------
+# Session elastic state machine (no processes)
+# ---------------------------------------------------------------------------
+class TestSessionElastic:
+    def _session(self):
+        from tony_tpu.cluster.session import Session
+        return Session(TonyConfig({"tony.worker.instances": "4",
+                                   "tony.worker.slices": "2",
+                                   "tony.application.mesh": "dp=-1"}))
+
+    def test_shrink_holds_barrier_and_shrinks_payload(self):
+        s = self._session()
+        for i in range(4):
+            payload = s.register_task_spec(f"worker:{i}", f"h{i}:1")
+        assert payload["num_processes"] == 4
+        assert payload["cluster_epoch"] == 0
+        assert s.gang_task_ids("worker:3") == ["worker:2", "worker:3"]
+        for tid in s.gang_task_ids("worker:2"):
+            s.detach_for_preemption(tid)
+        assert s.begin_elastic_resync() == 1
+        assert not s.barrier_released()
+        assert s.register_task_spec("worker:0", "h0:1") is None
+        payload = s.register_task_spec("worker:1", "h1:1")
+        assert payload["num_processes"] == 2
+        assert payload["cluster_epoch"] == 1
+        spec = json.loads(payload["cluster_spec"])
+        assert spec["worker"] == ["h0:1", "h1:1"]
+        mesh = json.loads(payload["mesh_spec"])
+        assert mesh["slice_spec"]["worker"] == {
+            "slices": 1, "hosts_per_slice": 2, "active_slices": [0]}
+        # detached tasks are not a job verdict
+        assert s.update_session_status().value == "RUNNING"
+
+    def test_regrow_round_trip(self):
+        s = self._session()
+        for i in range(4):
+            s.register_task_spec(f"worker:{i}", f"h{i}:1")
+        for tid in ("worker:2", "worker:3"):
+            s.detach_for_preemption(tid)
+        s.begin_elastic_resync()
+        s.register_task_spec("worker:0", "h0:1")
+        s.register_task_spec("worker:1", "h1:1")
+        armed = s.arm_regrow(["worker:2", "worker:3"])
+        assert [t.task_id for t in armed] == ["worker:2", "worker:3"]
+        assert not s.regrow_ready()
+        # a replacement's registration never releases the degraded barrier
+        assert s.register_task_spec("worker:2", "h2:2") is None
+        assert s.barrier_released()      # survivors unaffected
+        s.register_task_spec("worker:3", "h3:2")
+        assert s.regrow_ready()
+        assert s.activate_regrow() == 2
+        assert not s.barrier_released()  # survivors must resync
+        s.register_task_spec("worker:0", "h0:1")
+        payload = s.register_task_spec("worker:1", "h1:1")
+        assert payload["num_processes"] == 4
+        assert payload["cluster_epoch"] == 2
+        mesh = json.loads(payload["mesh_spec"])
+        assert mesh["slice_spec"]["worker"] == {
+            "slices": 2, "hosts_per_slice": 2}
+        assert [t.process_id for t in s.all_tasks()] == [0, 1, 2, 3]
+        assert s.all_tasks()[2].regrows == 1
+
+    def test_abort_regrow_unarms(self):
+        s = self._session()
+        for i in range(4):
+            s.register_task_spec(f"worker:{i}", f"h{i}:1")
+        for tid in ("worker:2", "worker:3"):
+            s.detach_for_preemption(tid)
+        s.begin_elastic_resync()
+        s.arm_regrow(["worker:2", "worker:3"])
+        s.register_task_spec("worker:2", "h2:2")
+        s.abort_regrow("worker:2", exit_code=9)
+        assert not s.regrow_ready()      # half-dead regrow cannot gate
+        assert s.regrow_pending_ids() == {"worker:3"}
+        t = s.get_task_by_id("worker:2")
+        assert t.detached and t.exit_code == 9
+
+
+# ---------------------------------------------------------------------------
+# Coordinator routing: liveness expiry and failure triage (no processes)
+# ---------------------------------------------------------------------------
+class TestCoordinatorElasticRouting:
+    def _coordinator(self, tmp_path, extra=None):
+        from tony_tpu.cluster.coordinator import Coordinator
+        conf = {"tony.worker.instances": "2", "tony.worker.slices": "2",
+                "tony.elastic.enabled": "true",
+                "tony.elastic.regrow": "false",
+                "tony.elastic.quiesce-ms": "0",
+                "tony.history.location": str(tmp_path / "hist")}
+        conf.update(extra or {})
+        return Coordinator(TonyConfig(conf), "app_route",
+                           str(tmp_path / "job"))
+
+    def test_liveness_expiry_absorbed_as_gang_loss(self, tmp_path):
+        """A tracked task going silent with elastic ON detaches its gang
+        instead of failing the job (the 'liveness reports a gang lost'
+        entry point of the tentpole)."""
+        from tony_tpu.cluster.session import SessionStatus
+        co = self._coordinator(tmp_path)
+        try:
+            co.session.register_task_spec("worker:0", "h0:1")
+            co.session.register_task_spec("worker:1", "h1:1")
+            co._on_task_dead("worker:1")
+            assert not co.task_missed_hb.is_set()
+            time.sleep(0.01)
+            co._elastic_tick()
+            t = co.session.get_task_by_id("worker:1")
+            assert t.detached and t.completed
+            assert co.session.cluster_epoch == 1
+            assert co.session.status is SessionStatus.RUNNING
+            assert co.elastic_budget_left == 2      # one shrink consumed
+        finally:
+            co.rpc_server.stop(0)
+
+    def test_liveness_expiry_without_elastic_fails_job(self, tmp_path):
+        co = self._coordinator(tmp_path,
+                               {"tony.elastic.enabled": "false"})
+        try:
+            co.session.register_task_spec("worker:0", "h0:1")
+            co.session.register_task_spec("worker:1", "h1:1")
+            co._on_task_dead("worker:1")
+            assert co.task_missed_hb.is_set()
+        finally:
+            co.rpc_server.stop(0)
+
+    def test_collateral_failure_charged_to_incident(self, tmp_path):
+        """An abnormal exit landing in the same quiesce window as a
+        preemption is collateral: the shrink detaches the preempted gang,
+        and the collateral task (whose gang = itself here) rides the same
+        incident instead of failing the session."""
+        from tony_tpu.cluster.session import SessionStatus
+        co = self._coordinator(
+            tmp_path, {"tony.worker.instances": "3",
+                       "tony.worker.slices": "3",
+                       "tony.elastic.quiesce-ms": "200"})
+        try:
+            for i in range(3):
+                co.session.register_task_spec(f"worker:{i}", f"h{i}:1")
+            co.record_completion("worker", 1, 0, preempted=True)
+            # worker:2 crashes on the dead gang's collectives (exit 1,
+            # NOT preempted) inside the window
+            co.record_completion("worker", 2, 1)
+            time.sleep(0.25)
+            co._elastic_tick()
+            assert co.session.get_task_by_id("worker:1").detached
+            assert co.session.get_task_by_id("worker:2").detached
+            assert co.session.status is SessionStatus.RUNNING
+        finally:
+            co.rpc_server.stop(0)
+
+    def test_pure_user_failure_replays_through_normal_path(self, tmp_path):
+        """No preemption in the window → the held failure replays as the
+        ordinary user failure it was: session FAILED, nothing detached."""
+        from tony_tpu.cluster.session import SessionStatus
+        co = self._coordinator(tmp_path,
+                               {"tony.elastic.quiesce-ms": "0"})
+        try:
+            co.session.register_task_spec("worker:0", "h0:1")
+            co.session.register_task_spec("worker:1", "h1:1")
+            co.record_completion("worker", 1, 1)      # plain exit 1
+            assert co.session.status is SessionStatus.RUNNING  # held
+            time.sleep(0.01)
+            co._elastic_tick()
+            assert co.session.status is SessionStatus.FAILED
+            assert not co.session.get_task_by_id("worker:1").detached
+        finally:
+            co.rpc_server.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat epoch piggyback (wire level)
+# ---------------------------------------------------------------------------
+class TestEpochPiggyback:
+    def _serve(self, impl):
+        from tony_tpu.rpc.client import ApplicationRpcClient
+        from tony_tpu.rpc.server import ApplicationRpcServer
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        return srv, ApplicationRpcClient(f"localhost:{srv.port}")
+
+    def test_epoch_rides_heartbeat_ack(self):
+        from tony_tpu.rpc.service import HeartbeatAck
+        from tests.test_rpc import FakeImpl
+
+        class Impl(FakeImpl):
+            def task_executor_heartbeat(self, task_id, metrics=""):
+                super().task_executor_heartbeat(task_id, metrics)
+                return HeartbeatAck(gcs_token="tok", cluster_epoch=7)
+
+        srv, client = self._serve(Impl())
+        try:
+            ack = client.task_executor_heartbeat("worker:0")
+            assert ack.gcs_token == "tok" and ack.cluster_epoch == 7
+        finally:
+            client.close()
+            srv.stop(0)
+
+    def test_pre_elastic_impl_maps_to_epoch_zero(self):
+        """An impl returning a bare token string (the pre-elastic shape)
+        still serves; clients see epoch 0 — never a spurious resync."""
+        from tests.test_rpc import FakeImpl
+
+        class Impl(FakeImpl):
+            def task_executor_heartbeat(self, task_id, metrics=""):
+                super().task_executor_heartbeat(task_id, metrics)
+                return "bare-token"
+
+        srv, client = self._serve(Impl())
+        try:
+            ack = client.task_executor_heartbeat("worker:0")
+            assert ack.gcs_token == "bare-token"
+            assert ack.cluster_epoch == 0
+        finally:
+            client.close()
+            srv.stop(0)
+
+
+# ---------------------------------------------------------------------------
+# Bench arm: deterministic tier-1 variant (jax-free fake trainer)
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+def test_elastic_bench_arm_deterministic():
+    """bench._elastic_arm drives the SAME injected kill through the
+    elastic and stop-the-world paths and emits recovery wall + replay
+    counts. Pins: the elastic run genuinely shrank and recovered, its
+    replays never exceed the stop-the-world run's by more than one
+    checkpoint interval per worker (both strategies lose at most
+    ckpt_every steps per affected worker), and the headline keys exist
+    for BENCH json."""
+    sys.path.insert(0, REPO)
+    import bench
+    res = bench._elastic_arm()
+    assert res["elastic_recovery_wall_s"] > 0
+    assert res["elastic_steps_replayed"] <= \
+        res["restart_steps_replayed"] + 2 * 2
+    assert res["elastic_goodput_vs_restart"] > 0
+    assert res["elastic_wall_s"] > 0 and res["restart_wall_s"] > 0
+
+
+# ---------------------------------------------------------------------------
+# TPU backend: deterministic preemption + reprovision-on-regrow (fake gcloud)
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+def test_tpu_backend_fake_preempt_and_regrow_reprovisions(
+        tmp_path, monkeypatch):
+    """FAKE_PREEMPT_<GANG> drives the backend's preemption detection
+    deterministically: the marked slice reports its tasks preempted, and
+    a subsequent launch of the same task (the elastic regrow) deletes the
+    dead slice and provisions a fresh one, while the untouched gang keeps
+    its slice (adopt semantics)."""
+    from tony_tpu.backend.tpu import TpuSliceBackend, slice_name
+
+    fleet = tmp_path / "fleet"
+    fleet.mkdir()
+    bindir = tmp_path / "bin"
+    bindir.mkdir()
+    gcloud = bindir / "gcloud"
+    gcloud.write_text(f"#!/bin/bash\nexec {PY} "
+                      f"{os.path.join(REPO, 'tests', 'fake_gcloud.py')} "
+                      f"\"$@\"\n")
+    gcloud.chmod(0o755)
+    monkeypatch.setenv("PATH", f"{bindir}:{os.environ['PATH']}")
+    monkeypatch.setenv("FAKE_GCLOUD_ROOT", str(fleet))
+    monkeypatch.setenv("FAKE_NUM_WORKERS", "1")
+
+    job_dir = tmp_path / "job"
+    log_dir = job_dir / "logs"
+    log_dir.mkdir(parents=True)
+    (job_dir / "tony-final.xml").write_text("<configuration/>\n")
+    conf = TonyConfig({
+        "tony.scheduler.backend": "tpu",
+        "tony.tpu.project": "p", "tony.tpu.zone": "z",
+        "tony.tpu.accelerator-type": "v5litepod",
+        "tony.tpu.state-refresh-ms": "100",
+        "tony.worker.instances": "2", "tony.worker.slices": "2",
+    })
+    backend = TpuSliceBackend(conf, app_id="app_1_2")
+    victim = slice_name("app_1_2", "worker", 1, 2)
+    monkeypatch.setenv(
+        "FAKE_PREEMPT_" + "".join(
+            c if c.isalnum() else "_" for c in victim).upper(), "1")
+    try:
+        for i in range(2):
+            backend.launch_task(LaunchSpec(
+                task_id=f"worker:{i}", command="sleep 30", env={},
+                log_dir=str(log_dir), cwd=str(job_dir),
+                tpu_topology="2x4"))
+        deadline = time.monotonic() + 30
+        events = []
+        while time.monotonic() < deadline and not events:
+            events = [e for e in backend.poll_completed() if e.preempted]
+            time.sleep(0.05)
+        assert [e.task_id for e in events] == ["worker:1"]
+        creates_before = sum(
+            1 for c in open(fleet / "calls.log")
+            if c.split()[3:4] == ["create"])
+        # regrow: relaunching the lost task must delete + re-create ITS
+        # slice only
+        backend.launch_task(LaunchSpec(
+            task_id="worker:1", command="true", env={},
+            log_dir=str(log_dir), cwd=str(job_dir), tpu_topology="2x4"))
+        lines = [c.split() for c in open(fleet / "calls.log")]
+        creates = [c[4] for c in lines if c[3] == "create"]
+        deletes = [c[4] for c in lines if c[3] == "delete"]
+        assert len(creates) == creates_before + 1
+        assert creates[-1] == victim and victim in deletes
+    finally:
+        backend.stop()

@@ -36,6 +36,8 @@ class LocalBackend(SchedulerBackend):
         self._killed: set[str] = set()
         self._preempted: set[str] = set()
         self._preemption_simulated = False
+        #: TEST_PREEMPT_TASKS clauses already fired (one-shot each)
+        self._preempt_clauses_fired: set[str] = set()
         self._lock = threading.Lock()
         #: drained by the coordinator via take_launch_timings(); local
         #: launches have no provision/stage phase, only process dispatch
@@ -105,10 +107,52 @@ class LocalBackend(SchedulerBackend):
             except (ProcessLookupError, PermissionError):
                 pass
 
+    def _maybe_kill_gang_at_marker(self) -> None:
+        """TEST_PREEMPT_TASKS chaos: ';'-separated ONE-SHOT clauses of
+        "task_id[,task_id...][@marker_path]" — SIGKILL the listed tasks
+        and report them preempted, immediately or once the marker file
+        exists. Trainers touch the marker from a step hook, so "lose gang
+        G at step K" is exactly reproducible without real TPUs (the
+        elastic suite's kill-gang-at-step hook; fake_gcloud's
+        FAKE_PREEMPT_<GANG> is the TPU-backend twin)."""
+        spec = os.environ.get(constants.TEST_PREEMPT_TASKS)
+        if not spec:
+            return
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause or clause in self._preempt_clauses_fired:
+                continue
+            tasks, _, marker = clause.partition("@")
+            if marker and not os.path.exists(marker):
+                continue
+            task_ids = [t.strip() for t in tasks.split(",") if t.strip()]
+            # A clause must not burn before its tasks have even launched
+            # (launches fan out concurrently with this poll): stay armed
+            # until EVERY listed task is known to the backend — a partial
+            # kill would turn an intended whole-gang preemption into a
+            # different scenario. A clause naming a never-launched task
+            # simply stays armed (and inert) for the backend's life.
+            if not all(tid in self._procs for tid in task_ids):
+                continue
+            self._preempt_clauses_fired.add(clause)
+            for task_id in task_ids:
+                proc = self._procs.get(task_id)
+                if proc is None or task_id in self._reported \
+                        or proc.poll() is not None:
+                    continue
+                log.info("chaos: TEST_PREEMPT_TASKS killing %s (marker %s)",
+                         task_id, marker or "<immediate>")
+                self._preempted.add(task_id)
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
     def poll_completed(self) -> list[CompletionEvent]:
         events = []
         with self._lock:
             self._maybe_simulate_preemption()
+            self._maybe_kill_gang_at_marker()
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue

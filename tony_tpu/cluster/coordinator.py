@@ -47,8 +47,8 @@ from tony_tpu.events import events as ev
 from tony_tpu.rpc.server import ApplicationRpcServer
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.utils.docker import docker_wrap
-from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
-                                  WorkerSpecResponse)
+from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus,
+                                  HeartbeatAck, TaskUrl, WorkerSpecResponse)
 
 log = logging.getLogger("tony_tpu.coordinator")
 
@@ -112,7 +112,8 @@ class CoordinatorRpc(ApplicationRpc):
         self.co.client_signalled_finish.set()
         return self.co.final_status or "RUNNING"
 
-    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
+    def task_executor_heartbeat(self, task_id: str,
+                                metrics: str = "") -> HeartbeatAck:
         self.co.hb_monitor.ping(task_id)
         if metrics:
             # Telemetry rides the liveness channel but must never break
@@ -120,7 +121,13 @@ class CoordinatorRpc(ApplicationRpc):
             # the task's previous good one) instead of raising into the
             # RPC handler.
             self.co.metrics_table.ingest(task_id, metrics)
-        return os.environ.get(constants.TONY_GCS_TOKEN, "")
+        # The ack fans out BOTH slow-moving control values: the current
+        # GCS token (renewal) and the cluster-spec epoch — an executor
+        # seeing an epoch ahead of its own stops its user process and
+        # re-runs the registration handshake (the elastic resync path).
+        return HeartbeatAck(
+            gcs_token=os.environ.get(constants.TONY_GCS_TOKEN, ""),
+            cluster_epoch=self.co.session.cluster_epoch)
 
     def renew_gcs_token(self, token: str) -> None:
         # Client-pushed replacement for the expiring impersonation token:
@@ -164,6 +171,48 @@ class Coordinator:
         # meaning (SURVEY.md §7 hard part (d)).
         self.preemption_retries_left = conf.get_int(
             K.TPU_PREEMPTION_RETRIES_KEY, 3)
+        # Elastic training (tony.elastic.*): a gang lost to preemption (or
+        # liveness expiry) is DETACHED instead of failing the session —
+        # survivors checkpoint-sync, re-handshake over a bumped
+        # cluster-spec epoch, and resume from the latest completed async
+        # checkpoint while the lost capacity reprovisions in the
+        # background. Losses accumulate for a quiesce window (a preempted
+        # slice surfaces as several per-task events) before ONE shrink
+        # epoch is cut; losses that fail the eligibility gate (chief gang,
+        # minimum survivors, exhausted elastic budget) fall back to the
+        # stop-the-world preemption retry path unchanged.
+        self.elastic_enabled = conf.get_bool(K.ELASTIC_ENABLED_KEY, False)
+        self.elastic_min_tasks = conf.get_int(K.ELASTIC_MIN_TASKS_KEY, 1)
+        self.elastic_budget_left = conf.get_int(K.ELASTIC_BUDGET_KEY, 3)
+        self.elastic_regrow = conf.get_bool(K.ELASTIC_REGROW_KEY, True)
+        self._elastic_regrow_backoff_s = conf.get_int(
+            K.ELASTIC_REGROW_BACKOFF_KEY, 1000) / 1000.0
+        self._elastic_quiesce_s = conf.get_int(
+            K.ELASTIC_QUIESCE_KEY, 300) / 1000.0
+        #: task_id → (exit code, preemption-sourced) of completions held
+        #: for the quiesce window (guarded by _completion_lock; drained by
+        #: the monitor tick). With elastic on, abnormal exits are held too
+        #: and triaged as a SET: collateral deaths racing a preemption
+        #: event (a survivor crashing on the dead gang's collective) are
+        #: charged to the incident, not to user code.
+        self._elastic_pending: dict[str, tuple[int, bool]] = {}
+        self._elastic_pending_since = 0.0
+        #: barrier re-release watch after a shrink/regrow epoch
+        self._elastic_awaiting_resume = False
+        self._elastic_resume_t0 = 0.0
+        #: lost task ids queued for a background regrow relaunch
+        self._elastic_regrow_queue: list[str] = []
+        self._elastic_regrow_deadline = 0.0
+        self._elastic_regrow_attempts: dict[str, int] = {}
+        #: losses routed back to stop-the-world: their re-recorded
+        #: completions must not re-enter the elastic absorption gate
+        self._elastic_bypass: set[str] = set()
+        #: detached tasks whose OLD generation's exit report is still in
+        #: flight (liveness-absorbed losses and gang-mates seeded without
+        #: a completion event): the first post-detach report is that
+        #: straggler, not a regrow replacement dying — swallowed exactly
+        #: once so it can never abort a healthy regrow
+        self._elastic_awaiting_exit: set[str] = set()
         # In-session single-task relaunch budget (tony.task.restart-count):
         # the capability the reference marks TODO and answers with a
         # whole-job kill (TonyApplicationMaster.java:1158-1159).
@@ -232,13 +281,23 @@ class Coordinator:
         except (KeyError, IndexError):
             log.warning("registration from unknown task %r ignored", worker)
             return WorkerSpecResponse()
-        first_registration = not task.registered
+        # First registration of this task GENERATION: keyed on
+        # registered_at (reset by restart/regrow arming), not on the spec
+        # — elastic resyncs clear every survivor's spec to re-hold the
+        # barrier, and re-running the first-registration side effects
+        # (TASK_REGISTERED events, monitor registration) once per epoch
+        # would double-count registrations in the history timeline.
+        first_registration = task.registered_at == 0.0
         # The relaunched generation is registering: its predecessor's twin
         # report either arrived already or was discarded by the backend on
         # relaunch — retire the marker so it can never swallow THIS
         # generation's own failure report.
         with self._completion_lock:
             self._restart_dup.pop(worker, None)
+            # a restarted/regrown generation starts with a clean elastic
+            # slate — its earlier replayed failure must not block a later
+            # genuine absorption
+            self._elastic_bypass.discard(worker)
         payload = self.session.register_task_spec(worker, spec)
         if not first_registration:
             # Barrier re-polls count as liveness: an executor waiting at the
@@ -270,7 +329,8 @@ class Coordinator:
             coordinator_address=payload["coordinator_address"],
             process_id=self.session.process_id_of(worker),
             num_processes=payload["num_processes"],
-            mesh_spec=payload["mesh_spec"])
+            mesh_spec=payload["mesh_spec"],
+            cluster_epoch=payload.get("cluster_epoch", 0))
 
     def _terminate_workers(self) -> None:
         time.sleep(0.5)
@@ -281,9 +341,306 @@ class Coordinator:
                 self.backend.kill_task(task.task_id)
 
     def _on_task_dead(self, task_id: str) -> None:
-        """Missed-heartbeat expiry (reference: onTaskDeemedDead:1155-1165)."""
+        """Missed-heartbeat expiry (reference: onTaskDeemedDead:1155-1165).
+        With elastic training on, a tracked task going silent is treated
+        as its GANG being lost (a slice dies as a unit — the silent host
+        took its co-hosts' ICI domain with it): the whole gang is killed
+        and absorbed into the shrink path instead of failing the job."""
+        with self._completion_lock:
+            absorb = self._elastic_can_absorb(task_id)
+            if absorb:
+                self._elastic_note_gang_loss(task_id, exit_code=-1,
+                                             from_completion=False)
+        if absorb:
+            # kills run OUTSIDE the lock (backend kill paths can block)
+            for tid in self.session.gang_task_ids(task_id):
+                self.backend.kill_task(tid)
+            return
         self.session.on_task_deemed_dead(task_id)
         self.task_missed_hb.set()
+
+    # ------------------------------------------------------------------
+    # Elastic shrink / regrow
+    # ------------------------------------------------------------------
+    def _elastic_can_absorb(self, task_id: str) -> bool:
+        """Cheap gate at loss-report time (callers hold _completion_lock);
+        the full eligibility check (chief gang, per-type survivors,
+        minimum tasks) runs once per shrink epoch over the accumulated
+        set, falling back to stop-the-world when it fails."""
+        if not self.elastic_enabled or self.elastic_budget_left <= 0:
+            return False
+        try:
+            task = self.session.get_task_by_id(task_id)
+        except (KeyError, IndexError, ValueError):
+            return False
+        return (self.session.is_tracked(task.job_type)
+                and not task.completed and not task.detached
+                and self.session.status is SessionStatus.RUNNING
+                and not self.task_missed_hb.is_set()
+                and self.final_status is None
+                and not self.client_signalled_finish.is_set())
+
+    def _elastic_note_gang_loss(self, task_id: str, exit_code: int,
+                                from_completion: bool = True) -> None:
+        """Queue the whole gang of ``task_id`` for the next shrink epoch
+        (callers hold _completion_lock). Gang-mates' own completion events
+        land here too and just refresh their recorded exit code. Tasks
+        queued WITHOUT a consumed completion event (liveness expiries,
+        seeded gang-mates) are marked awaiting-exit: their old
+        generation's report is still in flight and must not be mistaken
+        for a regrow replacement dying later."""
+        if not self._elastic_pending:
+            self._elastic_pending_since = time.monotonic()
+        for tid in self.session.gang_task_ids(task_id):
+            try:
+                t = self.session.get_task_by_id(tid)
+            except (KeyError, IndexError):
+                continue
+            if t.detached or t.completed:
+                continue
+            if tid not in self._elastic_pending:
+                self._elastic_pending[tid] = (exit_code, True)
+                self._elastic_awaiting_exit.add(tid)
+            self.hb_monitor.unregister(tid)
+        if task_id in self._elastic_pending:
+            # the reporting task's own exit code wins over the placeholder
+            # its gang-mate's report seeded
+            self._elastic_pending[task_id] = (exit_code, True)
+            if from_completion:
+                self._elastic_awaiting_exit.discard(task_id)
+
+    def _elastic_note_abnormal(self, task_id: str, exit_code: int) -> None:
+        """Hold a NON-preempted abnormal exit for the quiesce window
+        (callers hold _completion_lock): if a preemption incident
+        materializes in the same window, this death was collateral (the
+        survivor's collectives failed on the dead gang) and is charged to
+        the incident; otherwise the tick replays it as the ordinary user
+        failure it was, delayed by at most the quiesce interval. Only the
+        task itself is held — a PURE user failure must not take its
+        healthy gang-mates with it (if the window does turn into an
+        incident, the shrink expands every loss to its gang closure:
+        slices are atomic)."""
+        if not self._elastic_pending:
+            self._elastic_pending_since = time.monotonic()
+        self._elastic_pending[task_id] = (exit_code, False)
+        self.hb_monitor.unregister(task_id)
+
+    def _on_detached_completion(self, task, exit_code: int) -> None:
+        """A detached task completed (callers hold _completion_lock): if it
+        was a regrow replacement dying before activation, un-arm it and
+        requeue the regrow with backoff (bounded — after 3 failed
+        replacement launches the job just keeps running degraded)."""
+        if task.task_id in self._elastic_awaiting_exit:
+            # the killed OLD generation's exit report finally landing —
+            # expected exactly once per detach; it must not be mistaken
+            # for the regrow replacement dying (which would abort a
+            # healthy regrow and burn a give-up attempt)
+            self._elastic_awaiting_exit.discard(task.task_id)
+            return
+        if task.task_id not in self.session.regrow_pending_ids():
+            return      # straggler report of the already-detached loss
+        self.session.abort_regrow(task.task_id, exit_code)
+        attempts = self._elastic_regrow_attempts.get(task.task_id, 0) + 1
+        self._elastic_regrow_attempts[task.task_id] = attempts
+        if attempts >= 3:
+            log.warning("elastic regrow of %s failed %d times — giving up; "
+                        "the job continues on the shrunk gang",
+                        task.task_id, attempts)
+            return
+        log.warning("elastic regrow replacement %s died with exit %d — "
+                    "requeueing (attempt %d)", task.task_id, exit_code,
+                    attempts)
+        self._elastic_regrow_queue.append(task.task_id)
+        self._elastic_regrow_deadline = (time.monotonic()
+                                         + self._elastic_regrow_backoff_s)
+
+    def _elastic_tick(self) -> None:
+        """Monitor-loop driver for the elastic state machine: cut a shrink
+        epoch once the loss quiesce window closes, watch the barrier for
+        resume, launch background regrows after their backoff, and
+        activate a regrow once every replacement has registered."""
+        now = time.monotonic()
+        with self._completion_lock:
+            cut = (self._elastic_pending
+                   and now - self._elastic_pending_since
+                   >= self._elastic_quiesce_s)
+            # snapshot WITHOUT clearing: the entries stay held until the
+            # transition finishes, so a completion report racing the
+            # shrink refreshes its held entry instead of slipping through
+            # the gate as a spurious second incident
+            lost = dict(self._elastic_pending) if cut else None
+        if lost:
+            if any(p for _, p in lost.values()):
+                self._elastic_shrink(lost)
+            else:
+                # no preemption materialized in the window: these were
+                # ordinary failures — replay them through the normal
+                # completion path (restart budgets, chief short-circuit,
+                # session retries all behave exactly as without elastic)
+                with self._completion_lock:
+                    self._elastic_bypass.update(lost)
+                    self._elastic_retire_pending(lost)
+                for tid, (code, _) in lost.items():
+                    jt, _, idx = tid.partition(":")
+                    self.record_completion(jt, idx, code)
+        if self._elastic_awaiting_resume and self.session.barrier_released():
+            self._elastic_awaiting_resume = False
+            wall = time.monotonic() - self._elastic_resume_t0
+            active = len([t for t in self.session.participants()
+                          if not t.completed])
+            log.info("elastic: barrier re-released at epoch %d after %.2fs "
+                     "(%d active tasks)", self.session.cluster_epoch, wall,
+                     active)
+            metrics_mod.get_default().gauge(
+                "tony_elastic_recovery_seconds",
+                help="wall seconds from gang loss to the survivors' "
+                     "barrier re-releasing (last transition)").set(wall)
+            self.events.emit(ev.ELASTIC_RESUMED,
+                             epoch=self.session.cluster_epoch,
+                             active=active,
+                             recovery_wall_s=round(wall, 3),
+                             session_id=self.session.session_id)
+        if (self._elastic_regrow_queue
+                and now >= self._elastic_regrow_deadline):
+            queue, self._elastic_regrow_queue = \
+                self._elastic_regrow_queue, []
+            self._elastic_launch_regrow(queue)
+        if self.session.regrow_ready():
+            regrown = sorted(self.session.regrow_pending_ids())
+            epoch = self.session.activate_regrow()
+            for tid in regrown:
+                # a successful regrow wipes the task's attempt history —
+                # the give-up counter is per INCIDENT, not per job
+                self._elastic_regrow_attempts.pop(tid, None)
+            active = len(self.session.participants())
+            log.info("elastic: regrow activated — epoch %d, %s rejoined "
+                     "(%d active tasks)", epoch, regrown, active)
+            metrics_mod.get_default().counter(
+                "tony_elastic_regrows_total",
+                help="elastic grow-back epochs activated").inc()
+            metrics_mod.get_default().gauge(
+                "tony_elastic_active_tasks",
+                help="participant tasks in the current cluster epoch"
+                ).set(active)
+            self.events.emit(ev.ELASTIC_REGROW, epoch=epoch,
+                             regrown=regrown, active=active,
+                             session_id=self.session.session_id)
+            self._elastic_resume_t0 = time.monotonic()
+            self._elastic_awaiting_resume = True
+
+    def _elastic_retire_pending(self, keys) -> None:
+        """Drop transitioned losses from the pending table (callers hold
+        _completion_lock); entries noted DURING the transition keep their
+        own quiesce window, restarted from now."""
+        for tid in keys:
+            self._elastic_pending.pop(tid, None)
+        if self._elastic_pending:
+            self._elastic_pending_since = time.monotonic()
+
+    def _elastic_shrink(self, lost: dict[str, tuple[int, bool]]) -> None:
+        """Cut one shrink epoch over the accumulated losses (monitor
+        thread). At least one entry is preemption-sourced; non-preempted
+        entries in the same window are collateral and charged to the
+        incident. Ineligible loss sets fall back to the stop-the-world
+        preemption path: every loss is recorded as an ordinary preempted
+        completion and the session retry machinery takes over."""
+        # Gang atomicity: a collateral abnormal exit was held as a single
+        # task, but a slice cannot lose one host and keep the rest — the
+        # detach set is the gang CLOSURE of every loss, so the resized
+        # mesh's slice topology stays consistent with its participants.
+        # Closure-added mates are still ALIVE (killed below): their exit
+        # report is outstanding, so mark them awaiting-exit like any
+        # eventless loss.
+        with self._completion_lock:
+            for tid in list(lost):
+                code, preempted = lost[tid]
+                for mate in self.session.gang_task_ids(tid):
+                    try:
+                        t = self.session.get_task_by_id(mate)
+                    except (KeyError, IndexError):
+                        continue
+                    if mate not in lost and not t.detached \
+                            and not t.completed:
+                        lost[mate] = (-1, preempted)
+                        self._elastic_awaiting_exit.add(mate)
+        with self._completion_lock:
+            survivors = [t for t in self.session.participants()
+                         if t.task_id not in lost and not t.completed
+                         and self.session.is_tracked(t.job_type)]
+            chief_lost = any(
+                self.session.is_chief(*tid.split(":", 1)) for tid in lost)
+            type_starved = any(
+                not any(t.job_type == jt for t in survivors)
+                for jt in {tid.split(":", 1)[0] for tid in lost}
+                if self.session.is_tracked(jt))
+            eligible = (self.elastic_budget_left > 0
+                        and not chief_lost and not type_starved
+                        and len(survivors) >= max(1, self.elastic_min_tasks)
+                        and self.session.status is SessionStatus.RUNNING
+                        and self.final_status is None
+                        and not self.client_signalled_finish.is_set())
+        if not eligible:
+            log.warning(
+                "elastic: loss of %s not absorbable (chief_lost=%s, "
+                "survivors=%d, budget=%d) — falling back to stop-the-world "
+                "preemption handling", sorted(lost), chief_lost,
+                len(survivors), self.elastic_budget_left)
+            metrics_mod.get_default().counter(
+                "tony_elastic_fallbacks_total",
+                help="gang losses routed back to stop-the-world").inc()
+            with self._completion_lock:
+                self._elastic_bypass.update(lost)
+                self._elastic_retire_pending(lost)
+            for tid, (code, _) in lost.items():
+                jt, _, idx = tid.partition(":")
+                self.record_completion(jt, idx, code, preempted=True)
+            return
+        self.elastic_budget_left -= 1
+        for tid, (code, _) in lost.items():
+            self.backend.kill_task(tid)      # straggler processes
+            self.hb_monitor.unregister(tid)
+            self.session.detach_for_preemption(tid, code)
+            self.events.emit(ev.TASK_FINISHED, task=tid, exit_code=code,
+                             preempted=True, detached=True,
+                             session_id=self.session.session_id)
+        with self._completion_lock:
+            self._elastic_retire_pending(lost)
+        epoch = self.session.begin_elastic_resync()
+        active = len([t for t in self.session.participants()
+                      if not t.completed])
+        log.warning("elastic: gang(s) %s lost — shrinking to %d task(s), "
+                    "cluster epoch %d (%d elastic shrinks left)",
+                    sorted(lost), active, epoch, self.elastic_budget_left)
+        reg = metrics_mod.get_default()
+        reg.counter("tony_elastic_shrinks_total",
+                    help="elastic shrink epochs cut").inc()
+        reg.gauge("tony_elastic_active_tasks",
+                  help="participant tasks in the current cluster epoch"
+                  ).set(active)
+        self.events.emit(ev.ELASTIC_SHRINK, epoch=epoch,
+                         lost=sorted(lost), active=active,
+                         session_id=self.session.session_id)
+        self._elastic_resume_t0 = time.monotonic()
+        self._elastic_awaiting_resume = True
+        if self.elastic_regrow:
+            self._elastic_regrow_queue.extend(sorted(lost))
+            self._elastic_regrow_deadline = (
+                time.monotonic() + self._elastic_regrow_backoff_s)
+
+    def _elastic_launch_regrow(self, task_ids: list[str]) -> None:
+        """Relaunch lost tasks in the background (the backend reprovisions
+        a dead gang's slice on launch — tpu.py's dead-gang path — or
+        adopts a surviving one via ALREADY_EXISTS). The relaunched
+        executors register as still-detached tasks; activation happens in
+        the tick once all of them are in."""
+        armed = self.session.arm_regrow(task_ids)
+        if not armed:
+            return
+        log.info("elastic: relaunching %s for regrow",
+                 [t.task_id for t in armed])
+        for t in armed:
+            self._submit_launch(t, self.session.requests[t.job_type],
+                                self._user_command)
 
     # ------------------------------------------------------------------
     # Scheduling
@@ -526,6 +883,35 @@ class Coordinator:
                 return
             if session_id is not None and session_id != self.session.session_id:
                 return
+            # Elastic absorption: a loss already queued for a shrink epoch
+            # just refreshes its recorded exit code; a DETACHED task's
+            # late report (the dead gang's straggler events, a failed
+            # regrow launch) must neither fail the session nor count as a
+            # verdict. A fresh preempted loss of a tracked task routes
+            # into the pending set instead of the completion reduction —
+            # and so does ANY abnormal tracked exit while elastic is on:
+            # the quiesce tick triages the accumulated set, charging
+            # collateral deaths (a survivor crashing on the lost gang's
+            # collectives) to the incident and replaying genuine user
+            # failures through the ordinary path.
+            if task.task_id in self._elastic_pending:
+                code, was_preempted = self._elastic_pending[task.task_id]
+                self._elastic_pending[task.task_id] = (
+                    exit_code, was_preempted or preempted)
+                # its generation's exit report has now been consumed
+                self._elastic_awaiting_exit.discard(task.task_id)
+                return
+            if task.detached:
+                self._on_detached_completion(task, exit_code)
+                return
+            if task.task_id not in self._elastic_bypass \
+                    and self._elastic_can_absorb(task.task_id):
+                if preempted:
+                    self._elastic_note_gang_loss(task.task_id, exit_code)
+                    return
+                if exit_code != 0:
+                    self._elastic_note_abnormal(task.task_id, exit_code)
+                    return
             # Twin report of a restart-consumed failure: the SAME process
             # exit reaches us twice (executor RPC + backend process exit),
             # so after a restart the matching-code report from the OTHER
@@ -684,6 +1070,7 @@ class Coordinator:
         while True:
             time.sleep(self.MONITOR_PERIOD_S)
             self._apply_completions(self.backend.poll_completed())
+            self._elastic_tick()
             self._drain_launch_timings()
             self._maybe_emit_metrics()
             if self.timeout_s > 0 and time.monotonic() - started_at > self.timeout_s:
@@ -937,6 +1324,16 @@ class Coordinator:
             self.task_missed_hb.clear()
             self._session_preempted = False
             self._session_real_failure = False
+            # elastic state belongs to the dead session: pending losses,
+            # barrier watches and queued regrows must not leak into the
+            # rebuilt gang (the elastic BUDGET is job-scoped and persists)
+            with self._completion_lock:
+                self._elastic_pending.clear()
+                self._elastic_bypass.clear()
+                self._elastic_awaiting_exit.clear()
+            self._elastic_awaiting_resume = False
+            self._elastic_regrow_queue.clear()
+            self._elastic_regrow_attempts.clear()
             # stale twin-report markers must not swallow the new session's
             # completions (session-id filtering already drops cross-session
             # RPC reports, but process-exit reports carry no session id)

@@ -72,7 +72,7 @@ class Heartbeater(threading.Thread):
 
     def __init__(self, rpc: ApplicationRpcClient, task_id: str,
                  interval_s: float, gcs_token_file: str | None = None,
-                 snapshot_fn=None) -> None:
+                 snapshot_fn=None, on_epoch=None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
@@ -81,6 +81,11 @@ class Heartbeater(threading.Thread):
         #: (None = old-style liveness-only heartbeats). A provider error
         #: must never cost a ping — collection is wrapped below.
         self.snapshot_fn = snapshot_fn
+        #: epoch observer (elastic resync): called with the coordinator's
+        #: cluster epoch from every ack; the executor compares it to the
+        #: epoch its user process was launched under and resyncs on a
+        #: bump. Errors in the observer must never cost a ping.
+        self.on_epoch = on_epoch
         self.stop_event = threading.Event()
         self.skip_remaining = int(
             os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
@@ -124,10 +129,16 @@ class Heartbeater(threading.Thread):
                          self.skip_remaining)
                 continue
             try:
-                tok = self.rpc.task_executor_heartbeat(self.task_id,
+                ack = self.rpc.task_executor_heartbeat(self.task_id,
                                                        self._snapshot())
                 self._failures = 0
-                self._republish_token(tok)
+                self._republish_token(ack.gcs_token)
+                if self.on_epoch is not None:
+                    try:
+                        self.on_epoch(ack.cluster_epoch)
+                    except Exception:
+                        log.warning("cluster-epoch observer failed",
+                                    exc_info=True)
             except Exception:  # any send failure counts
                 self._failures += 1
                 log.warning("heartbeat send failure %d/%d", self._failures,
@@ -161,6 +172,65 @@ class TaskExecutor:
             K.TASK_REGISTRATION_TIMEOUT_KEY, 300000) / 1000.0
         self.bootstrap: dict | None = None
         self._started_at = time.monotonic()
+        #: elastic resync: set by the heartbeat epoch observer when the
+        #: coordinator cuts a new cluster-spec epoch; the run loop stops
+        #: the user process, re-runs the registration handshake and
+        #: relaunches instead of exiting
+        self._resync = threading.Event()
+        self._resync_target = 0          # highest epoch the observer saw
+        self._user_proc: subprocess.Popen | None = None
+        self._user_proc_lock = threading.Lock()
+
+    #: grace between the resync SIGINT (which lets run_training's finally
+    #: close the prefetcher and wait out in-flight async checkpoint saves
+    #: — the checkpoint-sync step) and the SIGKILL escalation. A trainer
+    #: blocked in a collective on the DEAD gang never feels the SIGINT,
+    #: so this grace bounds the recovery wall — overridable via env for
+    #: jobs whose checkpoint flush genuinely needs longer (or tests that
+    #: need it shorter).
+    RESYNC_KILL_GRACE_S = float(
+        os.environ.get("TONY_RESYNC_KILL_GRACE_S", "10"))
+
+    def _on_cluster_epoch(self, epoch: int) -> None:
+        """Heartbeat-ack epoch observer (runs on the Heartbeater thread):
+        an epoch ahead of the one the user process was launched under
+        means the gang changed shape — interrupt the user process (SIGINT
+        first: trainers exit through their KeyboardInterrupt-safe finally,
+        completing in-flight checkpoint saves) and arm the resync loop."""
+        if self.bootstrap is None \
+                or epoch <= self.bootstrap.get("cluster_epoch", 0) \
+                or self._resync.is_set():
+            return
+        log.warning("cluster epoch moved to %d (ours: %d) — stopping the "
+                    "user process for an elastic resync", epoch,
+                    self.bootstrap.get("cluster_epoch", 0))
+        self._resync_target = max(self._resync_target, epoch)
+        self._resync.set()
+        self._interrupt_user_process()
+
+    def _interrupt_user_process(self) -> None:
+        with self._user_proc_lock:
+            proc = self._user_proc
+        if proc is None or proc.poll() is not None:
+            return
+        try:
+            os.killpg(proc.pid, signal.SIGINT)
+        except (ProcessLookupError, PermissionError):
+            return
+
+        def _escalate():
+            if proc.poll() is None:
+                log.warning("user process ignored resync SIGINT for %.0fs "
+                            "— escalating to SIGKILL",
+                            self.RESYNC_KILL_GRACE_S)
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+
+        t = threading.Timer(self.RESYNC_KILL_GRACE_S, _escalate)
+        t.daemon = True
+        t.start()
 
     # ------------------------------------------------------------------
     def metrics_snapshot(self) -> str:
@@ -194,6 +264,7 @@ class TaskExecutor:
                     "process_id": resp.process_id,
                     "num_processes": resp.num_processes,
                     "mesh_spec": resp.mesh_spec,
+                    "cluster_epoch": resp.cluster_epoch,
                 }
                 return self.bootstrap
             if time.monotonic() > deadline:
@@ -239,15 +310,26 @@ class TaskExecutor:
             # env alone would freeze the submit-time token into a child
             # that may outlive it
             env[constants.TONY_GCS_TOKEN_FILE] = self._gcs_token_file
+        env[constants.CLUSTER_EPOCH] = str(
+            self.bootstrap.get("cluster_epoch", 0))
         cluster = json.loads(self.bootstrap["cluster_spec"])
         # Multi-slice identity: which gang of the job type this host is in
         # (tony.{job}.slices > 1). Index order is slice-major (session.py).
+        # After an elastic shrink the mesh spec carries the SURVIVING
+        # gangs' original slice ids in active_slices; this host's slice id
+        # becomes its dense rank among them (so e.g. losing slice 0 of 3
+        # leaves survivors as slices 0..1 of 2, not 1..2 of 2).
         slice_spec = json.loads(
             self.bootstrap["mesh_spec"] or "{}").get("slice_spec", {})
         mine = slice_spec.get(self.job_name)
         if mine:
-            env[constants.SLICE_ID] = str(
-                self.task_index // int(mine["hosts_per_slice"]))
+            orig = self.task_index // int(mine["hosts_per_slice"])
+            active = mine.get("active_slices")
+            try:
+                sid = active.index(orig) if active else orig
+            except ValueError:      # defensive: not listed — keep static id
+                sid = orig
+            env[constants.SLICE_ID] = str(sid)
             env[constants.NUM_SLICES] = str(mine["slices"])
         if self.conf.get_bool(K.TASK_PROFILE_ENABLED_KEY, False):
             env[constants.TONY_PROFILE_ENABLED] = "true"
@@ -315,6 +397,15 @@ class TaskExecutor:
         log.info("launching user process: %s", self.task_command)
         proc = subprocess.Popen(["bash", "-c", self.task_command], env=env,
                                 preexec_fn=self._user_process_preexec)
+        # Publish the live proc for the resync interrupter, then re-check
+        # the flag: an epoch bump landing between the resync check in
+        # run() and the Popen above would otherwise leave a stale-epoch
+        # process running forever (the observer only fires on CHANGES).
+        with self._user_proc_lock:
+            self._user_proc = proc
+            resync_raced = self._resync.is_set()
+        if resync_raced:
+            self._interrupt_user_process()
 
         def _forward_kill(signum, frame):
             # Backend kills send SIGTERM to the executor's group; the user
@@ -336,6 +427,8 @@ class TaskExecutor:
             return constants.EXIT_FAILURE
         finally:
             signal.signal(signal.SIGTERM, prev)
+            with self._user_proc_lock:
+                self._user_proc = None
 
     # ------------------------------------------------------------------
     def apply_chaos_after_training(self) -> None:
@@ -425,7 +518,8 @@ class TaskExecutor:
                       if os.environ.get(constants.TONY_GCS_TOKEN) else None)
         heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s,
                                   gcs_token_file=token_file,
-                                  snapshot_fn=self.metrics_snapshot)
+                                  snapshot_fn=self.metrics_snapshot,
+                                  on_epoch=self._on_cluster_epoch)
         heartbeater.start()
         if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
             try:
@@ -443,16 +537,60 @@ class TaskExecutor:
                     f"http://{host}:{self.notebook_port}")
             except Exception:
                 log.warning("notebook URL registration failed", exc_info=True)
-        extra_env = self.framework_env()
         venv_bin = self._prepare_venv()
-        if venv_bin:
-            # venv binaries take precedence; the base PATH must honor a
-            # user-provided --shell_env PATH (it wins over os.environ in
-            # run_user_process's merge).
-            base_path = self.shell_env.get("PATH") or os.environ.get(
-                "PATH", "")
-            extra_env["PATH"] = venv_bin + os.pathsep + base_path
-        exit_code = self.run_user_process(extra_env)
+
+        def user_env() -> dict[str, str]:
+            extra_env = self.framework_env()
+            if venv_bin:
+                # venv binaries take precedence; the base PATH must honor
+                # a user-provided --shell_env PATH (it wins over
+                # os.environ in run_user_process's merge).
+                base_path = self.shell_env.get("PATH") or os.environ.get(
+                    "PATH", "")
+                extra_env["PATH"] = venv_bin + os.pathsep + base_path
+            return extra_env
+
+        # The elastic resync loop: a cluster-epoch bump (observed on the
+        # heartbeat ack) interrupts the user process, re-runs the gang
+        # handshake — the barrier holds until every survivor has torn its
+        # old jax.distributed world down — and relaunches the user command
+        # under the new cluster spec; the trainer restores from its latest
+        # completed checkpoint and resumes. The EXECUTOR never exits for a
+        # resync, so the slice keeps its staged state and the coordinator
+        # keeps its liveness view.
+        while True:
+            exit_code = self.run_user_process(user_env())
+            if exit_code == constants.EXIT_GANG_LOST \
+                    and not self._resync.is_set():
+                # The trainer observed its gang die (collective failure)
+                # possibly BEFORE the coordinator's resync directive
+                # reached us. Hold the report: under elastic training the
+                # epoch bump arrives within a heartbeat or two and we
+                # relaunch instead of failing the job; without it (elastic
+                # off, or the loss was not absorbable) the wait expires
+                # and the exit reports normally — the coordinator has
+                # usually decided the session by then anyway.
+                wait_s = float(os.environ.get("TONY_GANG_LOST_WAIT_S", "30"))
+                log.warning("user process reports gang lost (exit %d) — "
+                            "holding up to %.0fs for an elastic resync",
+                            exit_code, wait_s)
+                self._resync.wait(timeout=wait_s)
+            if not self._resync.is_set():
+                break
+            self._resync.clear()
+            log.info("elastic resync: user process stopped (exit %d) — "
+                     "re-running the registration handshake", exit_code)
+            self.register_and_get_cluster_spec()
+            log.info("elastic resync: re-registered at epoch %d "
+                     "(%d processes)",
+                     self.bootstrap.get("cluster_epoch", 0),
+                     self.bootstrap["num_processes"])
+            # A resync raised for an epoch the fresh payload already
+            # covers is satisfied — clearing it here stops the loop from
+            # killing the about-to-launch process over a stale signal.
+            if self._resync.is_set() and self._resync_target <= \
+                    self.bootstrap.get("cluster_epoch", 0):
+                self._resync.clear()
         metrics_mod.get_default().counter(
             "tony_executor_child_exits_total",
             help="user-process exits by code",

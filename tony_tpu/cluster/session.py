@@ -70,7 +70,15 @@ class SessionTask:
     registered_at: float = 0.0      # monotonic time of first registration
     completed_at: float = 0.0       # monotonic time of completion report
     restarts: int = 0               # in-session single-task relaunches
+    regrows: int = 0                # elastic regrow relaunches
     prior_uptime_s: float = 0.0     # uptime accumulated before restarts
+    #: lost to preemption while the session keeps running elastically:
+    #: excluded from the cluster spec, the gang barrier, process-id
+    #: assignment and the completion reduction — but kept in the task
+    #: table (indices are identities) and in uptime accounting, so the
+    #: lost capacity stays visible. A regrow re-arms the task and clears
+    #: the flag once its replacement registers.
+    detached: bool = False
 
     @property
     def task_id(self) -> str:
@@ -103,31 +111,56 @@ class Session:
             jt: [SessionTask(jt, i, session_id) for i in range(req.instances)]
             for jt, req in self.requests.items()
         }
-        # Mesh layout + multi-slice topology, shipped opaquely to every task
-        # (mesh_spec is a JSON string end to end, so slice metadata rides
-        # the existing RPC field). Task index i of a job type with S slices
-        # of H hosts each belongs to slice i // H — index order is
-        # slice-major, matching the dense process-id assignment below, so
-        # in-slice processes are contiguous and ICI-minor mesh axes land on
-        # ICI neighbors.
-        slice_spec = {
-            jt: {"slices": req.slices,
-                 "hosts_per_slice": req.instances // req.slices}
-            for jt, req in self.requests.items() if req.slices > 1
-        }
-        self._mesh_spec = json.dumps({
-            "axes": conf.mesh_axes(),
-            "dcn_axes": conf.mesh_dcn_axes(),
-            **({"slice_spec": slice_spec} if slice_spec else {}),
-        })
+        #: cluster-spec generation: bumped on every elastic shrink/regrow;
+        #: the heartbeat plane fans the current value out and executors
+        #: resync (kill the user process, re-run the handshake) on a bump
+        self.cluster_epoch = 0
+        #: detached tasks armed for an elastic regrow, awaiting their
+        #: replacement's registration before activation
+        self._regrow_pending: set[str] = set()
+        self._mesh_spec = self._build_mesh_spec()
         # allocation-id → task binding (getAndInitMatchingTask:209 analog)
         self._next_allocation_id = 0
+
+    def _build_mesh_spec(self) -> str:
+        """Mesh layout + multi-slice topology, shipped opaquely to every
+        task (mesh_spec is a JSON string end to end, so slice metadata
+        rides the existing RPC field). Task index i of a job type with S
+        slices of H hosts each belongs to slice i // H — index order is
+        slice-major, matching the dense process-id assignment, so
+        in-slice processes are contiguous and ICI-minor mesh axes land on
+        ICI neighbors. After an elastic shrink, ``slices`` counts only
+        the SURVIVING gangs and ``active_slices`` lists their original
+        slice ids (executors map their static index-derived slice id to a
+        dense rank among survivors); both recompute on every epoch."""
+        slice_spec = {}
+        for jt, req in self.requests.items():
+            if req.slices <= 1:
+                continue
+            h = req.instances // req.slices
+            active = sorted({t.index // h for t in self.tasks.get(jt, ())
+                             if not t.detached})
+            entry = {"slices": len(active), "hosts_per_slice": h}
+            if active != list(range(req.slices)):
+                entry["active_slices"] = active
+            slice_spec[jt] = entry
+        return json.dumps({
+            "axes": self.conf.mesh_axes(),
+            "dcn_axes": self.conf.mesh_dcn_axes(),
+            **({"slice_spec": slice_spec} if slice_spec else {}),
+        })
 
     # ------------------------------------------------------------------
     # Introspection
     # ------------------------------------------------------------------
     def all_tasks(self) -> list[SessionTask]:
         return [t for tasks in self.tasks.values() for t in tasks]
+
+    def participants(self) -> list[SessionTask]:
+        """Tasks that make up the CURRENT gang: everything not detached by
+        an elastic shrink. The cluster spec, the gang barrier, process-id
+        assignment and the completion reduction all run over this set."""
+        return [t for t in self.all_tasks() if not t.detached]
 
     def get_task(self, job_type: str, index: int | str) -> SessionTask:
         return self.tasks[job_type][int(index)]
@@ -156,45 +189,56 @@ class Session:
     # Registration / gang barrier
     # ------------------------------------------------------------------
     def register_task_spec(self, task_id: str, spec: str) -> dict | None:
-        """Record a task's data-plane endpoint. Returns None until ALL tasks
-        registered; then a dict with cluster spec + JAX bootstrap. Idempotent:
-        re-registration overwrites the spec and re-returns the payload."""
+        """Record a task's data-plane endpoint. Returns None until ALL
+        participant tasks registered; then a dict with cluster spec + JAX
+        bootstrap. Idempotent: re-registration overwrites the spec and
+        re-returns the payload. A DETACHED task's registration (its
+        elastic-regrow replacement coming up) records the spec but never
+        releases a barrier — the coordinator activates the regrow (new
+        epoch, everyone re-registers) once every replacement is in."""
         with self._lock:
             task = self.get_task_by_id(task_id)
             task.spec = spec
             if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
                 task.status = TaskStatus.REGISTERED
                 task.registered_at = time.monotonic()
-            if not self.barrier_released():
+            if task.detached or not self.barrier_released():
                 return None
             self._assign_process_ids()
-            for t in self.all_tasks():
+            for t in self.participants():
                 if t.status is TaskStatus.REGISTERED:
                     t.status = TaskStatus.RUNNING
             return self.bootstrap_payload()
 
     def barrier_released(self) -> bool:
-        return all(t.registered for t in self.all_tasks())
+        return all(t.registered for t in self.participants())
 
     def _assign_process_ids(self) -> None:
-        """Dense, deterministic process ids: chief task first (JAX process 0
-        hosts the distributed coordinator service), then remaining tasks in
-        (job_type, index) order. Stable across re-registration."""
+        """Dense, deterministic process ids over the CURRENT participants:
+        chief task first (JAX process 0 hosts the distributed coordinator
+        service), then remaining tasks in (job_type, index) order. Stable
+        across re-registration; reassigned on elastic epoch changes so the
+        shrunk/regrown gang stays dense. Detached tasks hold -1."""
         ordered = sorted(
-            self.all_tasks(),
+            self.participants(),
             key=lambda t: (not self.is_chief(t.job_type, t.index),
                            t.job_type, t.index))
         for pid, task in enumerate(ordered):
             task.process_id = pid
+        for task in self.all_tasks():
+            if task.detached:
+                task.process_id = -1
 
     def cluster_spec(self) -> dict[str, list[str]]:
-        """{"worker": ["host:port", ...], ...} (getClusterSpec:227)."""
-        return {jt: [t.spec for t in tasks] for jt, tasks in self.tasks.items()}
+        """{"worker": ["host:port", ...], ...} (getClusterSpec:227) —
+        detached tasks' dead endpoints are excluded."""
+        return {jt: [t.spec for t in tasks if not t.detached]
+                for jt, tasks in self.tasks.items()}
 
     def coordinator_address(self) -> str:
         """The jax.distributed coordinator endpoint = process 0's registered
         spec (that process starts the coordination service)."""
-        for t in self.all_tasks():
+        for t in self.participants():
             if t.process_id == 0:
                 return t.spec
         return ""
@@ -203,8 +247,9 @@ class Session:
         return {
             "cluster_spec": json.dumps(self.cluster_spec()),
             "coordinator_address": self.coordinator_address(),
-            "num_processes": self.total_tasks(),
+            "num_processes": len(self.participants()),
             "mesh_spec": self._mesh_spec,
+            "cluster_epoch": self.cluster_epoch,
         }
 
     def process_id_of(self, task_id: str) -> int:
@@ -303,6 +348,123 @@ class Session:
             t.completed_at = 0.0
             return t
 
+    # ------------------------------------------------------------------
+    # Elastic shrink / regrow (epoch transitions)
+    # ------------------------------------------------------------------
+    def gang_task_ids(self, task_id: str) -> list[str]:
+        """Every task id of ``task_id``'s gang (same job type, same slice).
+        The slice is the preemption unit — a gang cannot lose one host and
+        keep the rest, so elastic detach always operates on this set."""
+        jt, _, idx = task_id.partition(":")
+        req = self.requests.get(jt)
+        if req is None:
+            return [task_id]
+        h = max(1, req.instances // max(1, req.slices))
+        s = int(idx) // h
+        return [t.task_id for t in self.tasks.get(jt, ())
+                if t.index // h == s]
+
+    def detach_for_preemption(self, task_id: str, exit_code: int = -1) -> None:
+        """Record a task as lost to preemption WITHOUT failing the session:
+        it leaves the participant set (cluster spec, barrier, reduction)
+        but keeps its FAILED status and uptime so the loss stays visible
+        in history. The caller owns eligibility (budget, chief, minimum
+        survivors) and the subsequent epoch bump."""
+        with self._lock:
+            task = self.get_task_by_id(task_id)
+            if not task.completed:
+                task.exit_code = exit_code
+                task.status = TaskStatus.FAILED
+                task.completed_at = time.monotonic()
+            task.detached = True
+            task.spec = ""
+            self._mesh_spec = self._build_mesh_spec()
+
+    def begin_elastic_resync(self) -> int:
+        """Cut a new cluster-spec epoch over the current participants:
+        bump the epoch and re-hold the gang barrier by clearing every
+        live participant's spec, so no one receives the new payload until
+        ALL survivors have stopped their old user process and
+        re-registered (their endpoints don't change — the executor keeps
+        its reserved data port — but the re-registration IS the proof the
+        old jax.distributed world is torn down, so process 0's service
+        port is free to rebind). Returns the new epoch."""
+        with self._lock:
+            self.cluster_epoch += 1
+            for t in self.participants():
+                if not t.completed:
+                    t.spec = ""
+            self._mesh_spec = self._build_mesh_spec()
+            return self.cluster_epoch
+
+    def arm_regrow(self, task_ids: list[str]) -> list[SessionTask]:
+        """Arm detached tasks for relaunch: fresh allocation, cleared
+        registration, still DETACHED (their registration must not gate
+        the degraded gang's barrier) until :meth:`activate_regrow`."""
+        armed = []
+        with self._lock:
+            for task_id in task_ids:
+                t = self.get_task_by_id(task_id)
+                if not t.detached:
+                    continue
+                if t.registered_at:
+                    t.prior_uptime_s += ((t.completed_at or time.monotonic())
+                                         - t.registered_at)
+                t.regrows += 1
+                t.status = TaskStatus.SCHEDULED
+                t.allocation_id = self._next_allocation_id
+                self._next_allocation_id += 1
+                t.spec = ""
+                t.exit_code = None
+                t.registered_at = 0.0
+                t.completed_at = 0.0
+                self._regrow_pending.add(t.task_id)
+                armed.append(t)
+        return armed
+
+    def regrow_ready(self) -> bool:
+        """True once every armed replacement has registered its spec —
+        the moment the coordinator can activate the grow-back epoch."""
+        with self._lock:
+            if not self._regrow_pending:
+                return False
+            return all(self.get_task_by_id(tid).registered
+                       for tid in self._regrow_pending)
+
+    def activate_regrow(self) -> int:
+        """Fold the registered replacements back into the participant set
+        and cut the grow-back epoch: replacements keep their fresh specs
+        (they are already parked at the barrier, polling), survivors'
+        specs clear so they resync — the barrier releases as soon as
+        every survivor re-registers. Returns the new epoch."""
+        with self._lock:
+            pending = self._regrow_pending
+            self._regrow_pending = set()
+            for tid in pending:
+                self.get_task_by_id(tid).detached = False
+            self.cluster_epoch += 1
+            for t in self.participants():
+                if t.task_id not in pending and not t.completed:
+                    t.spec = ""
+            self._mesh_spec = self._build_mesh_spec()
+            return self.cluster_epoch
+
+    def regrow_pending_ids(self) -> set[str]:
+        with self._lock:
+            return set(self._regrow_pending)
+
+    def abort_regrow(self, task_id: str, exit_code: int = -1) -> None:
+        """A replacement died before activation: un-arm it (still
+        detached, FAILED again) so a half-dead regrow can never gate the
+        grow-back barrier. The coordinator owns requeue/give-up policy."""
+        with self._lock:
+            self._regrow_pending.discard(task_id)
+            t = self.get_task_by_id(task_id)
+            t.exit_code = exit_code
+            t.status = TaskStatus.FAILED
+            t.completed_at = time.monotonic()
+            t.spec = ""
+
     def on_task_deemed_dead(self, task_id: str) -> None:
         """Missed-heartbeat expiry fails the task and thus the session
         (reference: onTaskDeemedDead:1155-1165 — 'we just kill the job')."""
@@ -359,6 +521,10 @@ class Session:
                         if t.restarts}
             if restarts:
                 metrics["task_restarts"] = restarts
+            regrows = {t.task_id: t.regrows for t in self.all_tasks()
+                       if t.regrows}
+            if regrows:
+                metrics["task_regrows"] = regrows
             # Single-node/notebook jobs schedule no tracked tasks; a
             # fraction of 0.0 would render as a misleading "0.0%" uptime
             # for a succeeded job, so the metric is omitted entirely.
@@ -372,7 +538,11 @@ class Session:
         with self._lock:
             if self.status is not SessionStatus.RUNNING:
                 return self.status
-            tracked = [t for t in self.all_tasks() if self.is_tracked(t.job_type)]
+            # Detached tasks (lost to preemption, absorbed elastically) are
+            # excluded: their FAILED status is capacity accounting, not a
+            # job verdict — the surviving participants decide the outcome.
+            tracked = [t for t in self.participants()
+                       if self.is_tracked(t.job_type)]
             if tracked and all(t.completed for t in tracked):
                 failed = [t for t in tracked if t.status is TaskStatus.FAILED]
                 self.status = (SessionStatus.FAILED if failed

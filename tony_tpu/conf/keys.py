@@ -77,6 +77,36 @@ TASK_PROFILE_DIR_KEY = "tony.task.profile.dir"                    # trace output
 LAUNCH_MAX_CONCURRENT_KEY = "tony.launch.max-concurrent"
 
 # ---------------------------------------------------------------------------
+# Elastic training ("tony.elastic.*"): on gang loss to preemption (backend
+# report or liveness expiry), keep the session alive — detach the lost
+# gang, bump the cluster-spec epoch so survivors checkpoint-sync and
+# re-handshake over the shrunk world, and (optionally) reprovision the
+# lost capacity in the background and grow back at the next barrier.
+# Off by default: stop-the-world session re-runs (tony.tpu.preemption-
+# retries) remain the behavior unless a job opts in.
+# ---------------------------------------------------------------------------
+ELASTIC_ENABLED_KEY = "tony.elastic.enabled"
+# Minimum surviving TRACKED tasks required to degrade instead of falling
+# back to the stop-the-world preemption retry (each tracked job type must
+# also keep >= 1 task, and the chief's gang is never detachable).
+ELASTIC_MIN_TASKS_KEY = "tony.elastic.min-tasks"
+# How many shrink EVENTS (gang-loss epochs, not individual tasks) the
+# session absorbs elastically; losses beyond it fall back to the
+# stop-the-world preemption budget.
+ELASTIC_BUDGET_KEY = "tony.elastic.budget"
+# Reprovision replacement capacity in the background and expand the mesh
+# back once every replacement has registered.
+ELASTIC_REGROW_KEY = "tony.elastic.regrow"
+# Delay before the background relaunch of lost tasks (real capacity takes
+# time to come back; the first re-create usually hits the same stockout).
+ELASTIC_REGROW_BACKOFF_KEY = "tony.elastic.regrow-backoff-ms"
+# How long losses are accumulated before ONE shrink epoch is cut: a
+# preempted slice surfaces as several per-task completion events (and
+# possibly a liveness expiry racing them), and resyncing the survivors
+# once per event would thrash the barrier.
+ELASTIC_QUIESCE_KEY = "tony.elastic.quiesce-ms"
+
+# ---------------------------------------------------------------------------
 # Metrics plane ("tony.metrics.*" — the TaskMonitor/MetricsRpc analog):
 # executors piggyback a registry snapshot on every heartbeat; the
 # coordinator folds its per-task last-snapshot table into a
@@ -203,6 +233,12 @@ DEFAULTS: dict[str, str] = {
     TASK_PROFILE_ENABLED_KEY: "false",
     TASK_PROFILE_DIR_KEY: "",
     LAUNCH_MAX_CONCURRENT_KEY: "8",
+    ELASTIC_ENABLED_KEY: "false",
+    ELASTIC_MIN_TASKS_KEY: "1",
+    ELASTIC_BUDGET_KEY: "3",
+    ELASTIC_REGROW_KEY: "true",
+    ELASTIC_REGROW_BACKOFF_KEY: "1000",
+    ELASTIC_QUIESCE_KEY: "300",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
@@ -251,7 +287,7 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 # Keys that never denote a job type even though they match the shape.
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
-                                "launch"})
+                                "launch", "elastic", "metrics"})
 
 
 def instances_key(job_type: str) -> str:

@@ -89,6 +89,11 @@ INIT_METHOD = "INIT_METHOD"
 JAX_COORDINATOR_ADDRESS = "TONY_JAX_COORDINATOR_ADDRESS"
 JAX_PROCESS_ID = "TONY_JAX_PROCESS_ID"
 JAX_NUM_PROCESSES = "TONY_JAX_NUM_PROCESSES"
+# Cluster-spec generation the user process was launched under: bumped by
+# the coordinator on every elastic shrink/regrow, so a resumed user
+# process can tell "same gang, new world size" apart from a coordinator
+# retry (ATTEMPT_NUMBER) and a session re-run (SESSION_ID).
+CLUSTER_EPOCH = "TONY_CLUSTER_EPOCH"
 TPU_TOPOLOGY = "TONY_TPU_TOPOLOGY"
 TPU_CHIPS_PER_HOST = "TONY_TPU_CHIPS_PER_HOST"
 MESH_SPEC = "TONY_MESH_SPEC"           # JSON: {"axes": {...}, "dcn_axes": {...}, "slice_spec": {...}}
@@ -147,6 +152,13 @@ TEST_TASK_EXECUTOR_HANG = "TEST_TASK_EXECUTOR_HANG"          # executor sleeps 2
 TEST_TASK_EXECUTOR_NUM_HB_MISS = "TEST_TASK_EXECUTOR_NUM_HB_MISS"  # heartbeater skips N pings
 TEST_TASK_EXECUTOR_SKEW = "TEST_TASK_EXECUTOR_SKEW"          # "job#idx#ms" sleep after training
 TEST_PREEMPT_SLICE = "TEST_PREEMPT_SLICE"                    # TPU-only: simulate slice preemption
+# Deterministic gang-loss injection for the local backend (the elastic
+# suite's kill-gang-at-step hook): ';'-separated one-shot clauses of
+# "task_id[,task_id...][@marker_path]". Without a marker the listed tasks
+# are SIGKILLed (and reported preempted) as soon as they run; with one,
+# the kill fires when the marker file exists — trainers touch the marker
+# from a step hook, making "kill gang G at step K" exactly reproducible.
+TEST_PREEMPT_TASKS = "TEST_PREEMPT_TASKS"
 
 # ---------------------------------------------------------------------------
 # Exit codes / misc
@@ -159,6 +171,13 @@ EXIT_FAILURE = -1
 # checks delivery channel: a result that ARRIVED over RPC proves
 # executor->coordinator connectivity and is never labeled a loss.
 EXIT_LOST_COORDINATOR = 75
+# Trainer suicide after a COLLECTIVE/distributed-runtime failure (gang
+# peers vanished under it): run_training raises GangLostError, trainers
+# exit with this code, and the executor holds the report briefly —
+# an elastic resync directive usually arrives within a heartbeat, in
+# which case the executor relaunches the trainer against the new world
+# instead of reporting a failure at all.
+EXIT_GANG_LOST = 76
 COORDINATOR_RPC_PORT_RANGE = (10000, 15000)  # ApplicationRpcServer.java:36
 
 # Framework adapters (MLFramework enum, TonyConfigurationKeys.java:8-11,

@@ -40,7 +40,8 @@ _LAZY = {name: "tony_tpu.io.jax_feed"
                       "records_to_array", "to_global_array")}
 _LAZY.update({name: "tony_tpu.io.prefetch"
               for name in ("DevicePrefetcher", "PrefetchShapeError",
-                           "reader_epochs", "synchronous_batches")})
+                           "elastic_epochs", "reader_epochs",
+                           "synchronous_batches")})
 
 __all__ = [
     "FileSegment", "compute_read_info", "full_records_in_split",

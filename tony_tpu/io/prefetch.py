@@ -328,3 +328,87 @@ def reader_epochs(paths: list[str], batch_size_per_process: int, dtype,
             reader.close()
 
     return epoch_fn, per_epoch
+
+
+def elastic_epochs(paths: list[str], global_batch: int, dtype,
+                   row_shape: tuple[int, ...], *, shuffle: bool = True,
+                   seed: int = 0, start_step: int = 0,
+                   process_index: int | None = None,
+                   process_count: int | None = None,
+                   ) -> tuple[Iterator, int]:
+    """World-size-invariant epochal stream for ELASTIC training.
+
+    :func:`reader_epochs` partitions by byte range, so the per-process
+    stream depends on the process COUNT — after an elastic shrink the
+    survivors' splits reshuffle and a mid-epoch resume would silently
+    drop some examples and double-feed others. This source instead fixes
+    ONE canonical stream — the single-reader pass over all files
+    (``task_num=1``), reshuffled with ``seed + epoch``, chunked into
+    ``global_batch``-row global batches — and hands process ``p`` of
+    ``P`` rows ``[p*B/P, (p+1)*B/P)`` of every global batch. The global
+    batch at step ``s`` is therefore IDENTICAL at any world size: a
+    training run that shrinks from N to N-1 processes (or grows back)
+    replays exactly the canonical sequence, which is what pins loss-curve
+    continuity across elastic transitions.
+
+    ``start_step`` aligns the stream with a restored checkpoint: the
+    first yielded batch is the one for global step ``start_step``
+    (``epoch = s // batches_per_epoch``, position ``s %
+    batches_per_epoch``; the skipped prefix of the resume epoch is
+    decoded and discarded — shuffled streams have no seek).
+
+    Returns ``(iterator, batches_per_epoch)``; the iterator is infinite
+    (cycles epochs) and yields this process's LOCAL ``[B/P, *row_shape]``
+    ndarray slice. Tradeoff vs ``reader_epochs``: every process reads
+    the WHOLE dataset (the invariance cost) — right for elastic jobs
+    whose per-epoch bytes fit host IO comfortably; keep the byte-range
+    splits for fixed-gang jobs with very large inputs.
+    """
+    import itertools as _it
+
+    from tony_tpu.io.jax_feed import array_batches, record_size_for
+    from tony_tpu.io.reader import FileSplitReader
+    from tony_tpu.io.split import full_records_in_split
+    from tony_tpu.storage import ssize
+
+    if process_index is None or process_count is None:
+        import jax
+        pid = jax.process_index() if process_index is None else process_index
+        pcount = (jax.process_count() if process_count is None
+                  else process_count)
+    else:
+        pid, pcount = process_index, process_count
+    if global_batch % pcount != 0:
+        raise ValueError(
+            f"elastic_epochs: global_batch={global_batch} must divide "
+            f"evenly over {pcount} process(es) — choose a global batch "
+            f"divisible by every world size the job can shrink to")
+    local = global_batch // pcount
+    record_size = record_size_for(dtype, row_shape)
+    sizes = [ssize(p) for p in paths]
+    per_epoch = (full_records_in_split(paths, 0, 1, record_size,
+                                       sizes=sizes) // global_batch)
+    if per_epoch == 0:
+        raise ValueError(
+            f"data files hold fewer than one global batch "
+            f"(global_batch={global_batch}) — nothing to train on")
+
+    def stream() -> Iterator:
+        step = start_step
+        for epoch in _it.count(start_step // per_epoch):
+            reader = FileSplitReader(
+                paths, task_index=0, task_num=1, record_size=record_size,
+                shuffle=shuffle, seed=seed + epoch, sizes=sizes)
+            try:
+                it = array_batches(reader, global_batch, dtype, row_shape)
+                skip = step % per_epoch
+                for pos in range(per_epoch):
+                    g = next(it)
+                    if pos < skip:
+                        continue    # decoded + discarded resume prefix
+                    step += 1
+                    yield g[pid * local:(pid + 1) * local]
+            finally:
+                reader.close()
+
+    return stream(), per_epoch

@@ -66,7 +66,25 @@ class CheckpointManager:
             log.info("checkpoint saved at step %d → %s", step, self.directory)
         return saved
 
+    def _fence_in_flight_saves(self) -> None:
+        """Read barrier for the async save pipeline: ``save`` returns
+        before the checkpoint is committed, so ``latest_step``/``restore``
+        must never run concurrently with this process's own in-flight
+        write — an elastic resume that restores right after offering a
+        save would otherwise race the commit (orbax keeps uncommitted
+        steps out of ``latest_step`` via tmp-dir + atomic finalize, but
+        only the fence makes "restore sees every save this process
+        already accepted" a guarantee rather than a filesystem property).
+        Never raises into the read path: a failed async save surfaces on
+        the next save/close, not as a broken restore of older steps."""
+        try:
+            self._mgr.wait_until_finished()
+        except Exception:
+            log.warning("in-flight checkpoint save failed; restoring from "
+                        "the latest COMMITTED step", exc_info=True)
+
     def latest_step(self) -> int | None:
+        self._fence_in_flight_saves()
         return self._mgr.latest_step()
 
     def restore(self, template: Any | None = None,
@@ -74,7 +92,9 @@ class CheckpointManager:
         """Restore the given (or latest) step. ``template`` is a matching
         pytree (abstract or concrete) guiding sharding/dtype placement —
         pass the freshly-initialized state so arrays land on the same mesh
-        layout they were saved from."""
+        layout they were saved from. Fenced against in-flight async
+        saves: a partially-written checkpoint is never observed."""
+        self._fence_in_flight_saves()
         step = self._mgr.latest_step() if step is None else int(step)
         if step is None:
             raise FileNotFoundError(f"no checkpoint in {self.directory}")
@@ -91,7 +111,7 @@ class CheckpointManager:
         (ATTEMPT_NUMBER > 0) a missing checkpoint is still fine — the job
         may have died before the first save."""
         state = init_fn()
-        step = self._mgr.latest_step()
+        step = self.latest_step()       # fenced against in-flight saves
         if step is None:
             if attempt_number() > 0:
                 log.warning(

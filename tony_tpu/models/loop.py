@@ -28,9 +28,45 @@ import logging
 import time
 from typing import Any, Callable, Iterable
 
+from tony_tpu import constants
 from tony_tpu.runtime import metrics as metrics_mod
 
 log = logging.getLogger(__name__)
+
+
+class GangLostError(RuntimeError):
+    """The step loop died because its GANG did, not because of user code:
+    a collective transport or the distributed runtime failed under the
+    step (a peer process was preempted mid-collective). Trainers should
+    exit with :attr:`exit_code` — the executor recognizes it and, under
+    elastic training, holds the report and relaunches the trainer against
+    the resized gang instead of failing the job."""
+
+    exit_code = constants.EXIT_GANG_LOST
+
+
+#: conservative substrings identifying collective/distributed-runtime
+#: failures across the transports this framework runs on (gloo on CPU,
+#: libtpu/megascale on slices, the jax coordination service everywhere).
+#: Deliberately NOT "unavailable"/"connection" alone — user code talks to
+#: networks too; every marker here names a collectives layer.
+_GANG_LOSS_MARKERS = (
+    "gloo", "coordination service", "nccl", "megascale",
+    "distributed service", "all-reduce failed", "all-gather failed",
+    "collective", "preempted",
+)
+
+
+def _looks_like_gang_loss(e: BaseException) -> bool:
+    seen: set[int] = set()
+    while e is not None and id(e) not in seen:
+        seen.add(id(e))
+        msg = str(e).lower()
+        if any(m in msg for m in _GANG_LOSS_MARKERS):
+            return True
+        e = e.__cause__ or e.__context__
+    return False
+
 
 #: data-wait buckets: the healthy value is ~0 (the prefetcher stays ahead
 #: of the step loop), so sub-millisecond resolution matters more than the
@@ -90,7 +126,20 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
                             "stopping early", step, steps)
                 break
             wait_hist.observe(time.perf_counter() - t0)
-            state, metrics = step_fn(state, batch)
+            try:
+                state, metrics = step_fn(state, batch)
+            except Exception as e:
+                if _looks_like_gang_loss(e):
+                    # the GANG failed, not the user's step: surface the
+                    # distinguished error so elastic executors relaunch
+                    # instead of charging a user failure (the finally
+                    # below still flushes in-flight checkpoint saves —
+                    # the checkpoint-sync step of a degraded resume)
+                    log.warning("step %d failed with a collective/"
+                                "distributed-runtime error — gang lost: %s",
+                                step, e)
+                    raise GangLostError(str(e)) from e
+                raise
             if checkpoint is not None:
                 checkpoint.save(step + 1, state)
             if (eval_fn is not None and eval_every > 0

@@ -19,8 +19,8 @@ import grpc
 from tony_tpu import constants
 from tony_tpu.rpc import tony_pb2 as pb
 from tony_tpu.rpc.server import SERVICE_NAME
-from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus, TaskUrl,
-                                  WorkerSpecResponse)
+from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus,
+                                  HeartbeatAck, TaskUrl, WorkerSpecResponse)
 
 log = logging.getLogger(__name__)
 
@@ -159,7 +159,7 @@ class ApplicationRpcClient(ApplicationRpc):
         return WorkerSpecResponse(
             spec=resp.spec, coordinator_address=resp.coordinator_address,
             process_id=resp.process_id, num_processes=resp.num_processes,
-            mesh_spec=resp.mesh_spec)
+            mesh_spec=resp.mesh_spec, cluster_epoch=resp.cluster_epoch)
 
     def register_tensorboard_url(self, spec: str) -> str:
         resp = self._call(self._register_tb_url,
@@ -180,18 +180,22 @@ class ApplicationRpcClient(ApplicationRpc):
                           retries=retries)
         return resp.message
 
-    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
+    def task_executor_heartbeat(self, task_id: str,
+                                metrics: str = "") -> HeartbeatAck:
         # Heartbeats get a tight retry budget: the executor-side heartbeater
         # counts consecutive failures itself (reference: TaskExecutor.java:
         # 264-268 dies after 5 failed sends). Returns the job's current
-        # GCS token ("" when scoping is off) — the renewal fan-out.
+        # GCS token ("" when scoping is off) — the renewal fan-out — plus
+        # the coordinator's cluster-spec epoch (the elastic resync signal;
+        # an old-wire response leaves it at the proto3 default 0).
         # ``metrics``: optional piggybacked registry snapshot (compact
         # JSON); "" keeps the old-style liveness-only beat.
         resp = self._call(self._heartbeat,
                           pb.HeartbeatRequest(task_id=task_id,
                                               metrics=metrics or ""),
                           retries=2)
-        return resp.gcs_token
+        return HeartbeatAck(gcs_token=resp.gcs_token,
+                            cluster_epoch=resp.cluster_epoch)
 
     def renew_gcs_token(self, token: str) -> None:
         self._call(self._renew_gcs_token,

@@ -101,7 +101,8 @@ class ApplicationRpcServer:
             return pb.RegisterWorkerSpecResponse(
                 spec=r.spec, coordinator_address=r.coordinator_address,
                 process_id=r.process_id, num_processes=r.num_processes,
-                mesh_spec=r.mesh_spec)
+                mesh_spec=r.mesh_spec,
+                cluster_epoch=getattr(r, "cluster_epoch", 0))
 
         def _register_tb_url(req, ctx):
             return pb.RegisterTensorBoardUrlResponse(
@@ -129,10 +130,15 @@ class ApplicationRpcServer:
 
         def _heartbeat(req, ctx):
             if _hb_takes_metrics:
-                tok = impl.task_executor_heartbeat(req.task_id, req.metrics)
+                ack = impl.task_executor_heartbeat(req.task_id, req.metrics)
             else:
-                tok = impl.task_executor_heartbeat(req.task_id)
-            return pb.HeartbeatResponse(gcs_token=tok or "")
+                ack = impl.task_executor_heartbeat(req.task_id)
+            # Impls may return a HeartbeatAck (token + cluster epoch) or a
+            # bare token string / None (pre-elastic shape → epoch 0).
+            if isinstance(ack, str) or ack is None:
+                return pb.HeartbeatResponse(gcs_token=ack or "")
+            return pb.HeartbeatResponse(gcs_token=ack.gcs_token or "",
+                                        cluster_epoch=ack.cluster_epoch)
 
         def _renew_gcs_token(req, ctx):
             impl.renew_gcs_token(req.token)

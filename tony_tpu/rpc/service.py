@@ -39,16 +39,32 @@ class ApplicationStatus:
 class WorkerSpecResponse:
     """Gang-barrier response: empty ``spec`` means "not all registered yet,
     poll again"; once released it carries the cluster spec plus the JAX/TPU
-    bootstrap assignment (the TF_CONFIG replacement)."""
+    bootstrap assignment (the TF_CONFIG replacement). ``cluster_epoch``
+    identifies the cluster-spec GENERATION: elastic shrink/regrow bumps it
+    and re-holds the barrier, so a released payload always carries the
+    epoch its spec belongs to."""
     spec: str = ""
     coordinator_address: str = ""
     process_id: int = -1
     num_processes: int = 0
     mesh_spec: str = ""
+    cluster_epoch: int = 0
 
     @property
     def released(self) -> bool:
         return bool(self.spec)
+
+
+@dataclass(frozen=True)
+class HeartbeatAck:
+    """Heartbeat response payload: the job's current GCS token plus the
+    coordinator's current cluster-spec epoch. An epoch ahead of the
+    executor's own is the elastic resync directive — stop the user
+    process at the next safe point and re-run the registration handshake
+    (implementations may also return a bare token ``str``; the server
+    maps it to epoch 0, the pre-elastic wire shape)."""
+    gcs_token: str = ""
+    cluster_epoch: int = 0
 
 
 class ApplicationRpc(abc.ABC):
@@ -75,10 +91,14 @@ class ApplicationRpc(abc.ABC):
     def finish_application(self) -> str: ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str, metrics: str = "") -> str:
-        """Record the ping; returns the job's CURRENT GCS access token
-        ("" when credential scoping is off) — the heartbeat doubles as
-        the token-renewal fan-out channel.
+    def task_executor_heartbeat(self, task_id: str,
+                                metrics: str = "") -> "HeartbeatAck | str":
+        """Record the ping; returns a :class:`HeartbeatAck` carrying the
+        job's CURRENT GCS access token ("" when credential scoping is
+        off) and the coordinator's cluster-spec epoch — the heartbeat
+        doubles as the token-renewal fan-out AND the elastic resync
+        channel. Implementations may return a bare token ``str`` (the
+        pre-elastic shape); the server maps it to epoch 0.
 
         ``metrics`` optionally carries a compact JSON snapshot of the
         executor's metrics registry (runtime/metrics.py ``to_wire``),
