@@ -279,6 +279,14 @@ def main() -> None:
     # trip and streamed wall within 1.15x of the zero-delay wall.
     out.update(_streaming_arm())
 
+    # cross-slice MPMD pipeline: the overlapped 1F1B schedule (channel
+    # sends ride the bounded window while the device computes the next
+    # microbatch) vs serialized stage execution (every tensor hop waits
+    # for its delivery ack) through an injected-DCN-latency transport.
+    # Deterministic: tiny stage blocks + fixed compute floors; the
+    # tier-1 pin (tests/test_channels.py) asserts overlap >= 1.5x.
+    out.update(_pipeline_arm())
+
     # device-prefetched vs synchronous train feed: with nonzero decode
     # cost the pipelined loop's step wall should approach the
     # pure-compute wall (decode + H2D overlap the device step) while the
@@ -1407,6 +1415,173 @@ def _spec_serving_arm(cfg_t, cfg_d, p_t, p_d, make_data, new, k,
         "serving_spec_cb_vs_greedy_cb": round(t_greedy / t_spec, 2),
         "serving_spec_cb_tokens_per_round": round(
             useful / (slots * spec_b.rounds_executed), 2),
+    }
+
+
+
+
+def _pipeline_arm(num_microbatches: int = 8, one_way_s: float = 0.05,
+                  fwd_floor_s: float = 0.015, bwd_floor_s: float = 0.03,
+                  dim: int = 8, mb_rows: int = 4,
+                  window: int = 10, lookahead: int = 5) -> dict:
+    """Cross-slice 1F1B over DCN: overlapped vs serialized stage
+    execution, deterministically.
+
+    Two in-process stage "gangs" (threads) train one 2-stage model over
+    REAL loopback tensor channels, each hub fronted by a LatencyProxy
+    injecting ``one_way_s`` of one-way link latency (RT = 2x) — the
+    netem technique of the streaming arm, modeling DCN links, not
+    serialization. Device compute is a fixed per-microbatch floor
+    injected AROUND the (tiny) jitted stage programs, so both runs
+    execute the identical schedule on any rig:
+
+    - **overlapped**: channel sends enqueue into the bounded window and
+      return; transport of microbatch m±1 rides the wire while m
+      computes. ``lookahead`` extra in-flight microbatches keep the
+      steady-state loop (2 one-way hops + both stages' compute) full —
+      Little's law: in-flight must exceed cycle/compute for throughput
+      to be compute-bound, the MPMD-paper latency-tolerance knob. Wall
+      ~ pipeline fill + M x max-stage-compute.
+    - **serialized**: every send blocks until the peer's ack
+      (``sync_transport=True``) — each activation/cotangent hop pays
+      the full round trip serialized with compute, the cost model of
+      stage execution WITHOUT a framework transport primitive.
+
+    Loss and both stages' grads are asserted identical across the two
+    runs (the schedule changes walls, never math). Emits
+    ``pipeline_overlap_vs_serialized_wall`` (the tentpole ratio,
+    tier-1-pinned >= 1.5) and ``pipeline_bubble_fraction`` (stage 0's
+    1 - busy/wall under the overlapped run). The latency-realistic
+    variant (tests/test_channels.py @slow) raises the delay and drops
+    the floors."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.channels import open_local_pipeline
+    from tony_tpu.parallel.pipeline import CrossSlicePipeline
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.netem import LatencyProxy
+
+    rs = np.random.RandomState(7)
+
+    def stage_fn(p, x):
+        return x + jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_head(hp, out, tgt):
+        return jnp.mean((out @ hp["wo"] - tgt) ** 2)
+
+    p0 = {"w": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.3),
+          "b": jnp.asarray(rs.randn(dim).astype(np.float32) * 0.1)}
+    p1 = {"w": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.3),
+          "b": jnp.asarray(rs.randn(dim).astype(np.float32) * 0.1)}
+    head = {"wo": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.2)}
+    m = num_microbatches
+    xs = jnp.asarray(rs.randn(m, mb_rows, dim).astype(np.float32))
+    tgts = jnp.asarray(rs.randn(m, mb_rows, dim).astype(np.float32))
+
+    class FloorPipeline(CrossSlicePipeline):
+        """Fixed per-microbatch device-compute floors: the deterministic
+        stand-in for real stage compute (same technique as the
+        streaming arm's FloorFetch)."""
+
+        def _forward_compute(self, params, x):
+            out = super()._forward_compute(params, x)
+            jax.block_until_ready(out)
+            time.sleep(fwd_floor_s)
+            return out
+
+        def _backward_compute(self, params, saved, cot):
+            out = super()._backward_compute(params, saved, cot)
+            jax.block_until_ready(out)
+            time.sleep(bwd_floor_s)
+            return out
+
+        def _last_compute(self, params, head_params, saved, head_mb):
+            out = super()._last_compute(params, head_params, saved,
+                                        head_mb)
+            jax.block_until_ready(out)
+            time.sleep(fwd_floor_s + bwd_floor_s)
+            return out
+
+    def run_mode(sync: bool):
+        reg = M.MetricsRegistry()
+        proxies: list[LatencyProxy] = []
+
+        def endpoint_map(stage_idx: int, port: int) -> str:
+            proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+            proxies.append(proxy)
+            return f"127.0.0.1:{proxy.start()}"
+
+        links = open_local_pipeline(2, window=window, registry=reg,
+                                    endpoint_map=endpoint_map)
+        out: dict = {}
+        try:
+            pls = [
+                FloorPipeline(stage_fn, links[0], registry=reg,
+                              lookahead=lookahead, sync_transport=sync),
+                FloorPipeline(stage_fn, links[1], loss_head=loss_head,
+                              registry=reg, lookahead=lookahead,
+                              sync_transport=sync),
+            ]
+
+            def run0():
+                out[0] = pls[0].value_and_grad(
+                    p0, num_microbatches=m, microbatches=xs)
+
+            def run1():
+                out[1] = pls[1].value_and_grad(
+                    p1, num_microbatches=m, head_params=head,
+                    head_batches=tgts)
+
+            def one_round():
+                ts = [threading.Thread(target=run0),
+                      threading.Thread(target=run1)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                return time.perf_counter() - t0
+
+            one_round()                     # compile + connect warmup
+            wall = one_round()
+            bubble = reg.gauge("tony_pipeline_bubble_fraction",
+                               stage="0").value
+            return wall, out, bubble, reg
+        finally:
+            for link in links:
+                link.close()
+            for proxy in proxies:
+                proxy.stop()
+
+    wall_ov, out_ov, bubble, reg_ov = run_mode(sync=False)
+    wall_sr, out_sr, _, _ = run_mode(sync=True)
+
+    def flat(res):
+        loss = res[1][0]
+        return ([np.asarray(loss)]
+                + [np.asarray(v) for v in jax.tree.leaves(res[0][1])]
+                + [np.asarray(v) for v in jax.tree.leaves(res[1][1])])
+
+    for a, b in zip(flat(out_ov), flat(out_sr)):
+        assert np.array_equal(a, b), \
+            "overlapped vs serialized produced different math"
+    # channel walls + queue depths must be VISIBLE on the metrics plane
+    wire = reg_ov.to_wire()
+    series = {name for name, _, _ in wire["h"]} \
+        | {name for name, _, _ in wire["g"]}
+    assert {"tony_channel_send_seconds", "tony_channel_recv_wait_seconds",
+            "tony_channel_send_queue_depth",
+            "tony_pipeline_step_seconds"} <= series, series
+    return {
+        "pipeline_one_way_delay_s": one_way_s,
+        "pipeline_microbatches": m,
+        "pipeline_overlap_wall_s": round(wall_ov, 3),
+        "pipeline_serialized_wall_s": round(wall_sr, 3),
+        # the tentpole ratio: DCN round trips overlapped under compute
+        "pipeline_overlap_vs_serialized_wall": round(wall_sr / wall_ov, 2),
+        "pipeline_bubble_fraction": round(float(bubble), 3),
     }
 
 

@@ -1,0 +1,158 @@
+"""Cross-slice MPMD pipeline-parallel training: one stage gang's program.
+
+The per-gang PROGRAM of a pipeline job (``tony.pipeline.stages`` +
+``tony.{job}.program``): every stage gang runs THIS script; the stage it
+plays, how many stages exist, and where its neighbor gangs' tensor-
+channel hubs listen all arrive through the executor environment
+(``TONY_PIPELINE_*`` / ``TONY_CHANNEL_*``), exported from the
+coordinator's channel registry at gang-barrier release.
+
+The model is a compact residual-MLP LM stand-in split layer-wise across
+stages — stage s holds stage s's block params, the LAST stage holds the
+loss head — sized so the tier-1 e2e suite can train it across two local
+gangs in seconds. Per step, every stage runs its share of the
+cross-slice 1F1B schedule (:class:`tony_tpu.parallel.pipeline
+.CrossSlicePipeline`): activations stream to stage+1 and cotangents back
+to stage-1 over DCN channels while the local device computes the
+adjacent microbatches. Losses/params land in ``--out`` as an npz so the
+harness can pin them bit-identical to the in-slice
+``pipeline_value_and_grad`` schedule on the same params and batches.
+
+Submit shape (stage gangs are ordinary job types)::
+
+    tony submit \
+      --conf tony.stage0.instances=1 --conf tony.stage1.instances=1 \
+      --conf tony.pipeline.stages=stage0,stage1 \
+      --conf tony.stage0.program='python examples/lm/train_pipeline.py ...' \
+      --conf tony.stage1.program='python examples/lm/train_pipeline.py ...' \
+      --executes 'python examples/lm/train_pipeline.py'
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tony_tpu.channels import open_stage_links_from_env
+from tony_tpu.models.loop import run_training
+from tony_tpu.parallel.pipeline import CrossSlicePipeline
+
+
+def stage_fn(p, x):
+    """One stage's block: residual tanh MLP, shape-preserving (the
+    pipeline stage contract)."""
+    return x + jnp.tanh(x @ p["w"] + p["b"])
+
+
+def loss_head(hp, out, tgt):
+    """Mean-squared regression head — the per-microbatch scalar the last
+    stage seeds its backward from."""
+    return jnp.mean((out @ hp["wo"] - tgt) ** 2)
+
+
+def init_stage_params(stage: int, dim: int, seed: int = 0):
+    """Deterministic per-stage block params: seeded by (seed, stage), so
+    the in-slice reference can rebuild the full stacked tree."""
+    rs = np.random.RandomState(seed * 1000 + stage)
+    return {
+        "w": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.3),
+        "b": jnp.asarray(rs.randn(dim).astype(np.float32) * 0.1),
+    }
+
+
+def init_head_params(dim: int, seed: int = 0):
+    rs = np.random.RandomState(seed * 1000 + 999)
+    return {"wo": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.2)}
+
+
+def batch_for(step: int, m: int, mb: int, dim: int, seed: int = 0):
+    """(inputs [M, mb, dim], targets [M, mb, dim]) for one step — pure
+    function of (seed, step): stage 0 feeds the inputs, the last stage
+    the targets, and the reference harness reproduces both."""
+    rs = np.random.RandomState(seed * 100_000 + step)
+    x = rs.randn(m, mb, dim).astype(np.float32)
+    tgt = rs.randn(m, mb, dim).astype(np.float32)
+    return x, tgt
+
+
+def sgd(params, grads, lr: float):
+    return jax.tree.map(lambda p, g: p - lr * g, params, grads)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="train_pipeline")
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--microbatches", type=int, default=4)
+    ap.add_argument("--mb_rows", type=int, default=4)
+    ap.add_argument("--dim", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--out", default="", help="npz with losses + final "
+                    "params (filename gains a -stage<k> suffix)")
+    args = ap.parse_args(argv)
+
+    links = open_stage_links_from_env(window=args.window)
+    if links is None:
+        print("train_pipeline.py must run as a pipeline stage "
+              "(tony.pipeline.stages): no TONY_PIPELINE_STAGE in env",
+              file=sys.stderr)
+        return 2
+    m, mb, dim = args.microbatches, args.mb_rows, args.dim
+    params = init_stage_params(links.stage, dim, args.seed)
+    head = init_head_params(dim, args.seed) if links.is_last else None
+    pipe = CrossSlicePipeline(stage_fn, links,
+                              loss_head=loss_head if links.is_last
+                              else None)
+    losses: list[float] = []
+
+    def feed():
+        """This stage's input feed: inputs at stage 0, targets at the
+        last stage — mid stages consume nothing (data=None below)."""
+        step = 0
+        while True:
+            x, tgt = batch_for(step, m, mb, dim, args.seed)
+            yield {"x": jnp.asarray(x)} if links.is_first \
+                else {"tgt": jnp.asarray(tgt)}
+            step += 1
+
+    def step_fn(state, batch):
+        params, head = state
+        loss, grads, hgrads, _ = pipe.value_and_grad(
+            params, num_microbatches=m,
+            microbatches=batch["x"] if links.is_first else None,
+            head_params=head,
+            head_batches=batch["tgt"] if links.is_last else None)
+        params = sgd(params, grads, args.lr)
+        metrics = {}
+        if links.is_last:
+            head = sgd(head, hgrads, args.lr)
+            losses.append(float(loss))
+            metrics["loss"] = float(loss)
+        return (params, head), metrics
+
+    data = feed() if (links.is_first or links.is_last) else None
+    try:
+        (params, head), _ = run_training(
+            step_fn, (params, head), data, args.steps,
+            log_fn=lambda s, mtr, b: print(
+                f"step {s} loss {mtr['loss']:.6f}" if "loss" in mtr
+                else f"step {s}", flush=True),
+            log_every=1)
+    finally:
+        links.close()
+    if args.out:
+        out = {f"p_{k}": np.asarray(v) for k, v in params.items()}
+        if links.is_last:
+            out.update({f"h_{k}": np.asarray(v) for k, v in head.items()})
+            out["losses"] = np.asarray(losses, np.float32)
+        np.savez(f"{args.out}-stage{links.stage}.npz", **out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1269,3 +1269,94 @@ def test_distributed_moe_lm_trains(tmp_path):
     # the ep axis must actually be live (a dense dp-only run would also
     # print "done:" — same guard as the pp e2e)
     assert "'ep': 2" in out
+
+
+@pytest.mark.e2e
+class TestPipelineE2E:
+    """Cross-slice MPMD pipeline job: two stage GANGS (real executor
+    subprocesses under the local backend) cooperate on one model over
+    DCN tensor channels, each running its per-gang PROGRAM
+    (tony.{job}.program), wired by the coordinator's channel registry.
+    The trained losses and final params are pinned BIT-IDENTICAL to the
+    in-slice 1F1B schedule (`pipeline_value_and_grad`) on the same
+    params and batches — the tentpole's numerical acceptance."""
+
+    STEPS, M, MB, DIM = 3, 4, 4, 8
+
+    def _reference(self, trainer_mod):
+        """In-process in-slice 1F1B training run on identical
+        params/batches (pp=2 over two virtual CPU devices)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from tony_tpu.parallel.pipeline import pipeline_value_and_grad
+        from jax.sharding import Mesh
+        m, mb, dim = self.M, self.MB, self.DIM
+        stacked = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves),
+            trainer_mod.init_stage_params(0, dim),
+            trainer_mod.init_stage_params(1, dim))
+        head = trainer_mod.init_head_params(dim)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("pp",))
+        losses = []
+        for step in range(self.STEPS):
+            x, tgt = trainer_mod.batch_for(step, m, mb, dim)
+            loss, g_sp, g_hp, _ = pipeline_value_and_grad(
+                trainer_mod.stage_fn, stacked,
+                jnp.asarray(x.reshape(m * mb, dim)), head,
+                jnp.asarray(tgt.reshape(m * mb, dim)), mesh,
+                loss_head=trainer_mod.loss_head, num_microbatches=m)
+            stacked = trainer_mod.sgd(stacked, g_sp, 0.1)
+            head = trainer_mod.sgd(head, g_hp, 0.1)
+            losses.append(float(loss))
+        return stacked, head, losses
+
+    def test_pipeline_job_bit_identical_to_in_slice(self, tmp_path):
+        import importlib.util
+
+        import numpy as np
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        trainer = os.path.join(repo, "examples", "lm", "train_pipeline.py")
+        out = tmp_path / "pipe"
+        prog = (f"{PY} {trainer} --steps {self.STEPS} "
+                f"--microbatches {self.M} --mb_rows {self.MB} "
+                f"--dim {self.DIM} --lr 0.1 --out {out}")
+        client = make_client(
+            tmp_path, f"{PY} -c 'raise SystemExit(7)'",   # must be unused
+            {"tony.stage0.instances": "1",
+             "tony.stage1.instances": "1",
+             "tony.pipeline.stages": "stage0,stage1",
+             # per-gang PROGRAMS override the job-wide command
+             "tony.stage0.program": prog,
+             "tony.stage1.program": prog,
+             "tony.application.timeout": "150000"},
+            shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": repo,
+                       "XLA_FLAGS": ""})
+        assert client.run() == 0
+
+        spec = importlib.util.spec_from_file_location("train_pipeline",
+                                                      trainer)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        ref_stacked, ref_head, ref_losses = self._reference(mod)
+
+        got1 = np.load(f"{out}-stage1.npz")
+        got0 = np.load(f"{out}-stage0.npz")
+        assert np.array_equal(
+            got1["losses"], np.asarray(ref_losses, np.float32)), (
+                list(got1["losses"]), ref_losses)
+        for stage, got in ((0, got0), (1, got1)):
+            for k in ("w", "b"):
+                assert np.array_equal(got[f"p_{k}"],
+                                      np.asarray(ref_stacked[k][stage])), \
+                    (stage, k)
+        assert np.array_equal(got1["h_wo"], np.asarray(ref_head["wo"]))
+        # the stage identity env must have reached both gangs
+        log0 = open(os.path.join(client.job_dir, "logs",
+                                 "stage0-0.stdout")).read()
+        log1 = open(os.path.join(client.job_dir, "logs",
+                                 "stage1-0.stdout")).read()
+        assert "loss" not in log0        # stage 0 owns no loss head
+        assert f"step {self.STEPS - 1} loss" in log1

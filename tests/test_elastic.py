@@ -476,6 +476,34 @@ class TestCoordinatorElasticRouting:
         finally:
             co.rpc_server.stop(0)
 
+    def test_pipeline_stage_gang_loss_falls_back_to_stop_the_world(
+            self, tmp_path):
+        """A pipeline STAGE gang is not a shrinkable data-parallel
+        replica — it holds layers. Losing one with elastic ON must route
+        through the stop-the-world preemption retry path (session
+        preempted, NOTHING detached, no shrink epoch), never a shrink."""
+        from tony_tpu.cluster.session import SessionStatus
+        co = self._coordinator(
+            tmp_path, {"tony.worker.instances": "0",
+                       "tony.worker.slices": "1",
+                       "tony.stage0.instances": "1",
+                       "tony.stage1.instances": "1",
+                       "tony.pipeline.stages": "stage0,stage1"})
+        try:
+            co.session.register_task_spec("stage0:0", "h0:1", 7000)
+            co.session.register_task_spec("stage1:0", "h1:1", 7001)
+            co.record_completion("stage0", 0, 143, preempted=True)
+            assert co.session.status is SessionStatus.RUNNING  # quiescing
+            time.sleep(0.01)
+            co._elastic_tick()
+            t = co.session.get_task_by_id("stage0:0")
+            assert not t.detached and t.completed
+            assert co.session.cluster_epoch == 0       # no shrink cut
+            assert co._session_preempted               # retry-budget path
+            assert co.session.status is SessionStatus.FAILED
+        finally:
+            co.rpc_server.stop(0)
+
     def test_pure_user_failure_replays_through_normal_path(self, tmp_path):
         """No preemption in the window → the held failure replays as the
         ordinary user failure it was: session FAILED, nothing detached."""

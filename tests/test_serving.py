@@ -124,6 +124,67 @@ class TestProtocol:
         finally:
             b.close()
 
+    def test_large_payload_zero_copy_path_round_trips(self):
+        """Payloads >= LARGE_PAYLOAD_BYTES ship as header-then-payload
+        writes (memoryview accepted, no concatenated copy); the wire is
+        byte-identical — recv_frame sees one ordinary frame."""
+        a, b = socket.socketpair()
+        try:
+            blob = bytes(range(256)) * (P.LARGE_PAYLOAD_BYTES // 256 + 1)
+            assert len(blob) >= P.LARGE_PAYLOAD_BYTES
+            got = {}
+            t = threading.Thread(
+                target=lambda: got.update(frame=P.recv_frame(b)))
+            t.start()        # concurrent reader: blob exceeds socket buf
+            P.send_frame(a, P.TOKENS, 5, memoryview(blob))
+            t.join(timeout=10)
+            ftype, rid, payload = got["frame"]
+            assert (ftype, rid) == (P.TOKENS, 5)
+            assert payload == blob
+        finally:
+            a.close()
+            b.close()
+
+    def test_memoryview_payload_small_frame(self):
+        assert P.encode_frame(P.TOKENS, 3, memoryview(b"abc")) \
+            == P.encode_frame(P.TOKENS, 3, b"abc")
+
+    def test_non_byte_memoryview_uses_nbytes(self):
+        """A float32 view's len() counts ELEMENTS; the frame length must
+        be its byte size or the receiver desyncs."""
+        arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+        frame = P.encode_frame(P.TOKENS, 1, memoryview(arr))
+        assert frame == P.encode_frame(P.TOKENS, 1, arr.tobytes())
+        a, b = socket.socketpair()
+        try:
+            P.send_frame(a, P.TOKENS, 1, memoryview(arr))
+            ftype, rid, payload = P.recv_frame(b)
+            assert (ftype, rid) == (P.TOKENS, 1)
+            assert payload == arr.tobytes()
+        finally:
+            a.close()
+            b.close()
+
+    def test_frame_header_size_guard(self):
+        with pytest.raises(P.ProtocolError, match="too large"):
+            P.frame_header(P.TOKENS, 1, P.MAX_FRAME_BYTES)
+
+    def test_recv_exact_short_read_contract(self):
+        """recv_into rewrite keeps the contract: None on clean EOF at a
+        boundary, ProtocolError on EOF mid-read."""
+        a, b = socket.socketpair()
+        a.close()
+        assert P.recv_exact(b, 4) is None
+        b.close()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(b"\x01\x02")
+            a.close()
+            with pytest.raises(P.ProtocolError, match="truncated"):
+                P.recv_exact(b, 4)
+        finally:
+            b.close()
+
     def test_tokens_payload_must_be_u32s(self):
         with pytest.raises(P.ProtocolError, match="u32"):
             P.unpack_tokens(b"\x01\x02\x03")

@@ -1,0 +1,719 @@
+"""Typed inter-gang tensor channels: persistent point-to-point transport
+over DCN.
+
+The data path that lets two gangs cooperate on ONE model: a pipeline
+stage gang streams activations forward (and cotangents backward) to its
+neighbor stage's gang without the coordinator in the loop. The wire
+reuses the TONYS1 length-prefixed framing discipline from
+``tony_tpu/serving/protocol.py`` (magic preamble, explicit length
+prefix, JSON handshake) with its own magic and frame set — this is a
+tensor plane, not a token plane, and a stray cross-plane connection must
+fail at the first byte.
+
+Connection handshake (the SENDER dials the receiving task's hub)::
+
+    sender   -> receiver   magic  b"TONYC1\\0"
+    sender   -> receiver   HELLO frame, JSON {"v": 1, "channel": name}
+    receiver -> sender     HELLO frame, JSON {"v": 1, "resume": seq}
+
+``resume`` is the receiver's next expected sequence number — on a fresh
+channel it is 0; after a transient socket loss the sender reconnects,
+learns where the receiver actually is, drops everything already
+delivered and resends the rest. Sequence numbers ride the frame's
+``rid`` field, so TENSOR frames need no extra header field for them.
+
+Frame types (framing itself is protocol.py's: u32 length, u8 type,
+u64 rid):
+
+======== ============ =========================================
+ type     direction    payload
+======== ============ =========================================
+CH_HELLO  both         JSON (see handshake above)
+CH_TENSOR s -> r       u32 header_len + JSON header
+                       ``{"dtype": str, "shape": [ints]}`` + raw
+                       C-contiguous buffer bytes
+CH_ACK    r -> s       (empty) — ``rid`` = highest in-order seq
+                       consumed; advances the sender's window
+CH_ERROR  r -> s       JSON ``{"message": str}`` — the receiver is
+                       closing THIS connection (garbage frame, seq
+                       gap); channel state survives, the sender
+                       reconnects and resumes
+======== ============ =========================================
+
+Reliability/backpressure contract:
+
+- **Bounded send window**: at most ``window`` unacked TENSOR frames in
+  flight; ``send`` blocks past that instead of buffering unboundedly —
+  a stalled consumer stage backpressures its producer stage through
+  TCP + the window, never through host memory.
+- **Exactly-once delivery to the consumer**: the receiver acks in
+  order and drops duplicates below its resume point, so a reconnect
+  never duplicates or drops a microbatch.
+- **Channel-scoped failure**: a truncated or garbage frame costs only
+  the offending connection (best-effort CH_ERROR, close); the hub
+  keeps serving its other channels and the peer reconnects with seq
+  resume.
+
+Everything here is transport-only (stdlib + numpy, no jax): importable
+by trainers, the coordinator's registry, the bench, and tests alike.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict, deque
+
+import numpy as np
+
+from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.serving.protocol import (BODY_HEADER_BYTES, ProtocolError,
+                                       frame_header, pack_json, recv_exact,
+                                       recv_frame, send_frame, set_nodelay,
+                                       unpack_json)
+
+CH_MAGIC = b"TONYC1\0"
+
+CH_HELLO = 1
+CH_TENSOR = 2
+CH_ACK = 3
+CH_ERROR = 4
+
+_HLEN = struct.Struct("<I")     # tensor-header length prefix
+
+#: the tensor plane's own frame cap — far above the serving plane's
+#: MAX_FRAME_BYTES (16 MiB of tokens is corruption; 16 MiB of
+#: activations is a small microbatch). One frame = one microbatch
+#: tensor; past this the SENDER fails fast with ChannelError rather
+#: than shipping something the peer will reject.
+MAX_TENSOR_BYTES = 1 << 31
+
+#: send/recv wait buckets: DCN one-way latencies are milliseconds, a
+#: window stall can reach seconds — finer than the generic time ladder
+#: at the low end.
+CHANNEL_WAIT_BUCKETS_S: tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0)
+
+
+class ChannelError(ConnectionError):
+    """The channel is unusable from this endpoint's point of view:
+    closed, past its reconnect budget, or a wait timed out. Transient
+    socket loss is NOT surfaced as this — senders reconnect and resume
+    internally."""
+
+
+def encode_tensor(arr: np.ndarray) -> tuple[bytes, bytes]:
+    """-> (tensor header bytes, raw payload bytes). The raw buffer is
+    ``tobytes()`` of the C-contiguous array — one copy, retained for
+    resend-after-reconnect (window × tensor size of host memory)."""
+    arr = np.asarray(arr)
+    # shape captured FIRST: ascontiguousarray promotes 0-d to 1-d
+    shape = list(arr.shape)
+    if not arr.flags["C_CONTIGUOUS"]:
+        arr = np.ascontiguousarray(arr)
+    head = pack_json({"dtype": str(arr.dtype), "shape": shape})
+    return _HLEN.pack(len(head)) + head, arr.tobytes()
+
+
+def decode_tensor(payload: bytes) -> np.ndarray:
+    """Parse a CH_TENSOR payload back into an ndarray. Anything
+    structurally off is a ProtocolError (channel-scoped)."""
+    if len(payload) < _HLEN.size:
+        raise ProtocolError("TENSOR frame shorter than its header prefix")
+    (hlen,) = _HLEN.unpack_from(payload, 0)
+    if _HLEN.size + hlen > len(payload):
+        raise ProtocolError(f"TENSOR header length {hlen} exceeds frame")
+    head = unpack_json(payload[_HLEN.size:_HLEN.size + hlen])
+    shape = head.get("shape")
+    dtype = head.get("dtype")
+    if not isinstance(shape, list) or not all(
+            isinstance(d, int) and not isinstance(d, bool) and d >= 0
+            for d in shape) or not isinstance(dtype, str):
+        raise ProtocolError(f"malformed TENSOR header: {head!r}")
+    try:
+        dt = np.dtype(dtype)
+    except TypeError as e:
+        raise ProtocolError(f"unknown TENSOR dtype {dtype!r}") from e
+    raw = payload[_HLEN.size + hlen:]
+    want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    if len(raw) != want:
+        raise ProtocolError(
+            f"TENSOR payload {len(raw)} bytes, header promises {want}")
+    return np.frombuffer(raw, dtype=dt).reshape(shape)
+
+
+def _send_tensor_frame(sock: socket.socket, seq: int, head: bytes,
+                       raw: bytes) -> None:
+    """Frame header + tensor header in one small write, the raw buffer
+    in a second — the zero-copy discipline of protocol.send_frame's
+    large path, without concatenating megabytes per microbatch."""
+    sock.sendall(frame_header(CH_TENSOR, seq, len(head) + len(raw),
+                              limit=MAX_TENSOR_BYTES) + head)
+    sock.sendall(raw)
+
+
+# ---------------------------------------------------------------------------
+# Sender
+# ---------------------------------------------------------------------------
+class ChannelSender:
+    """Dial a peer task's :class:`ChannelHub` and stream tensors with a
+    bounded in-flight window and reconnect-with-seq-resume.
+
+    One producer thread calls :meth:`send`; a background reader thread
+    consumes acks. ``send`` hands the frame to the OS send buffer and
+    returns — the window (not the call) is what overlaps DCN transport
+    with the caller's device compute. ``sync=True`` additionally blocks
+    until the peer acked the frame (the serialized-baseline mode the
+    bench contrasts against)."""
+
+    def __init__(self, address: str, channel: str, *, window: int = 8,
+                 connect_timeout_s: float = 10.0, max_retries: int = 30,
+                 backoff_s: float = 0.05, max_backoff_s: float = 2.0,
+                 registry: metrics_mod.MetricsRegistry | None = None) -> None:
+        if window < 1:
+            raise ValueError(f"channel window must be >= 1, got {window}")
+        host, _, port = address.rpartition(":")
+        self.address = (host, int(port))
+        self.channel = channel
+        self.window = window
+        self.connect_timeout_s = connect_timeout_s
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._cv = threading.Condition()
+        self._sock: socket.socket | None = None
+        self._reader: threading.Thread | None = None
+        self._broken = True             # no connection yet
+        self._closed = False
+        self._next_seq = 0
+        self._acked_through = -1
+        self._unacked: OrderedDict[int, tuple[bytes, bytes]] = OrderedDict()
+        self._connected_once = False
+        reg = registry or metrics_mod.get_default()
+        self._send_hist = reg.histogram(
+            "tony_channel_send_seconds",
+            help="host wall a channel send spent blocked (serialize + "
+                 "window backpressure + socket write)",
+            buckets=CHANNEL_WAIT_BUCKETS_S, channel=channel)
+        self._depth_gauge = reg.gauge(
+            "tony_channel_send_queue_depth",
+            help="unacked tensor frames in the sender's window",
+            channel=channel)
+        self._reconnects = reg.counter(
+            "tony_channel_reconnects_total",
+            help="sender reconnects after transient socket loss",
+            channel=channel)
+        self._bytes = reg.counter(
+            "tony_channel_bytes_total",
+            help="tensor payload bytes moved", channel=channel,
+            direction="send")
+
+    # -- connection management ---------------------------------------------
+    def _teardown_locked(self) -> None:
+        sock, self._sock = self._sock, None
+        self._broken = True
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _reconnect(self, deadline: float | None = None) -> None:
+        """(Re)dial, handshake, fold the receiver's resume point into the
+        ack state, resend what it has not seen. Runs on the producer
+        thread (the only writer); raises ChannelError past the budget —
+        or past ``deadline`` (monotonic), so a caller's send timeout
+        bounds the repair attempt too instead of stacking 30 connect
+        timeouts on top of it."""
+        backoff = self.backoff_s
+        last_err: Exception | None = None
+        for attempt in range(self.max_retries):
+            if deadline is not None and time.monotonic() >= deadline:
+                raise ChannelError(
+                    f"channel {self.channel!r} reconnect to "
+                    f"{self.address} timed out: {last_err}")
+            with self._cv:
+                if self._closed:
+                    raise ChannelError(f"channel {self.channel!r} closed")
+                if not self._broken:    # another path already fixed it
+                    return
+            try:
+                sock = socket.create_connection(
+                    self.address, timeout=self.connect_timeout_s)
+            except OSError as e:
+                last_err = e
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.max_backoff_s)
+                continue
+            try:
+                set_nodelay(sock)
+                sock.sendall(CH_MAGIC)
+                send_frame(sock, CH_HELLO, 0,
+                           pack_json({"v": 1, "channel": self.channel}))
+                fr = recv_frame(sock)
+                if fr is None or fr[0] != CH_HELLO:
+                    raise ProtocolError("channel handshake refused")
+                resume = unpack_json(fr[2]).get("resume")
+                if not isinstance(resume, int) or resume < 0:
+                    raise ProtocolError(f"bad resume seq {resume!r}")
+                sock.settimeout(None)
+            except (OSError, ProtocolError) as e:
+                last_err = e
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                time.sleep(backoff)
+                backoff = min(backoff * 2, self.max_backoff_s)
+                continue
+            with self._cv:
+                # everything below the resume point was delivered before
+                # the cut — retire it; the rest goes out again below
+                self._acked_through = max(self._acked_through, resume - 1)
+                for seq in [s for s in self._unacked if s < resume]:
+                    del self._unacked[seq]
+                to_resend = list(self._unacked.items())
+                self._sock = sock
+                self._broken = False
+                if self._connected_once:
+                    self._reconnects.inc()
+                self._connected_once = True
+                self._depth_gauge.set(len(self._unacked))
+                self._cv.notify_all()
+            try:
+                for seq, (head, raw) in to_resend:
+                    _send_tensor_frame(sock, seq, head, raw)
+            except OSError:
+                with self._cv:
+                    self._teardown_locked()
+                continue
+            reader = threading.Thread(
+                target=self._reader_loop, args=(sock,),
+                name=f"tony-channel-ack-{self.channel}", daemon=True)
+            reader.start()
+            self._reader = reader
+            return
+        raise ChannelError(
+            f"channel {self.channel!r} to {self.address} unreachable "
+            f"after {self.max_retries} attempts: {last_err}")
+
+    def _reader_loop(self, sock: socket.socket) -> None:
+        """Consume acks until this connection dies; advancing the ack
+        watermark is what releases blocked senders."""
+        while True:
+            try:
+                fr = recv_frame(sock)
+            except (ProtocolError, OSError):
+                fr = None
+            with self._cv:
+                if fr is None:
+                    if self._sock is sock:      # not already superseded
+                        self._teardown_locked()
+                    self._cv.notify_all()
+                    return
+                ftype, seq, payload = fr
+                if ftype == CH_ACK:
+                    if seq > self._acked_through:
+                        self._acked_through = seq
+                        for s in [k for k in self._unacked if k <= seq]:
+                            del self._unacked[s]
+                        self._depth_gauge.set(len(self._unacked))
+                        self._cv.notify_all()
+                elif ftype == CH_ERROR:
+                    # receiver-scoped close (seq gap, decode error): drop
+                    # this connection; the producer reconnects + resumes
+                    if self._sock is sock:
+                        self._teardown_locked()
+                    self._cv.notify_all()
+                    return
+
+    def _wait(self, pred, timeout: float | None) -> None:
+        """Wait under the cv for ``pred``; transparently reconnects when
+        the link is down (acks cannot arrive on a dead socket)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cv:
+            while not pred():
+                if self._closed:
+                    raise ChannelError(f"channel {self.channel!r} closed")
+                if self._broken:
+                    self._cv.release()
+                    try:
+                        self._reconnect(deadline)
+                    finally:
+                        self._cv.acquire()
+                    continue
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ChannelError(
+                        f"channel {self.channel!r} send wait timed out")
+                self._cv.wait(timeout=remaining)
+
+    # -- the producer API ---------------------------------------------------
+    def send(self, arr: np.ndarray, *, sync: bool = False,
+             timeout: float | None = None) -> int:
+        """Queue one tensor; returns its sequence number. Blocks while
+        the in-flight window is full (backpressure), and — with
+        ``sync=True`` — until the peer acked this frame."""
+        t0 = time.perf_counter()
+        head, raw = encode_tensor(arr)
+        # mirrors frame_header's limit check exactly (incl. the frame's
+        # own header bytes): an oversize frame must fail HERE, before a
+        # seq exists — once in _unacked it would poison every reconnect
+        if BODY_HEADER_BYTES + len(head) + len(raw) > MAX_TENSOR_BYTES:
+            raise ChannelError(
+                f"tensor of {len(raw)} bytes exceeds the "
+                f"{MAX_TENSOR_BYTES}-byte frame cap — split the "
+                f"microbatch")
+        # window backpressure BEFORE a sequence number exists: a wait
+        # that times out here leaves no hole in the seq space (a burned
+        # seq would wedge the channel in a permanent gap/reconnect loop)
+        self._wait(lambda: len(self._unacked) < self.window, timeout)
+        with self._cv:
+            if self._closed:
+                raise ChannelError(f"channel {self.channel!r} closed")
+            seq = self._next_seq
+            self._next_seq += 1
+            self._unacked[seq] = (head, raw)
+            self._depth_gauge.set(len(self._unacked))
+            sock = self._sock if not self._broken else None
+        if sock is not None:
+            try:
+                _send_tensor_frame(sock, seq, head, raw)
+            except OSError:
+                with self._cv:
+                    if self._sock is sock:
+                        self._teardown_locked()
+                # delivery now rides the reconnect resend path — for an
+                # async send that is enough; sync waits below
+                if not sync:
+                    self._reconnect()
+        else:
+            self._reconnect()   # resends the queued frame post-handshake
+        if sync:
+            self._wait(lambda: self._acked_through >= seq, timeout)
+        self._bytes.inc(len(raw))
+        self._send_hist.observe(time.perf_counter() - t0)
+        return seq
+
+    def drain(self, timeout: float | None = None) -> None:
+        """Block until every sent frame is acked."""
+        with self._cv:
+            last = self._next_seq - 1
+        if last >= 0:
+            self._wait(lambda: self._acked_through >= last, timeout)
+
+    def unacked(self) -> int:
+        with self._cv:
+            return len(self._unacked)
+
+    def close(self, drain: bool = True,
+              timeout: float | None = 30.0) -> None:
+        if drain and not self._closed:
+            try:
+                self.drain(timeout)
+            except ChannelError:
+                pass            # best-effort: closing anyway
+        with self._cv:
+            self._closed = True
+            self._teardown_locked()
+            self._cv.notify_all()
+
+
+# ---------------------------------------------------------------------------
+# Receiver hub
+# ---------------------------------------------------------------------------
+class _RecvState:
+    """Per-channel receive state: survives connections, so a reconnecting
+    sender resumes exactly where the consumer is."""
+
+    def __init__(self, capacity: int) -> None:
+        self.capacity = capacity
+        self.next_seq = 0
+        self.queue: deque[np.ndarray] = deque()
+        self.cv = threading.Condition()
+        self.closed = False
+        #: ONE delivering connection at a time (held from the resume
+        #: reply through the read loop): a predecessor connection still
+        #: blocked mid-``put`` must finish — settling ``next_seq`` —
+        #: before a reconnecting sender is told where to resume, or its
+        #: seq would be delivered twice and the following one dropped.
+        self.conn_lock = threading.Lock()
+        #: the connection currently entitled to deliver. A NEW
+        #: connection for the channel PREEMPTS the old one (closes its
+        #: socket so a half-open predecessor's blocked read errors out
+        #: and releases conn_lock) instead of queueing behind it forever.
+        self.active_sock: object = None
+        self.active_lock = threading.Lock()
+
+    def put(self, arr: np.ndarray) -> bool:
+        """Enqueue one in-order tensor; blocks while the consumer is
+        ``capacity`` behind (the ack is withheld too, so the sender's
+        window backpressures through here). False once closed."""
+        with self.cv:
+            while len(self.queue) >= self.capacity and not self.closed:
+                self.cv.wait()
+            if self.closed:
+                return False
+            self.queue.append(arr)
+            self.next_seq += 1
+            self.cv.notify_all()
+            return True
+
+    def get(self, timeout: float | None) -> np.ndarray:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self.cv:
+            while not self.queue:
+                if self.closed:
+                    raise ChannelError("channel hub stopped")
+                remaining = None if deadline is None \
+                    else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    raise ChannelError("channel recv timed out")
+                self.cv.wait(timeout=remaining)
+            arr = self.queue.popleft()
+            self.cv.notify_all()
+            return arr
+
+    def close(self) -> None:
+        with self.cv:
+            self.closed = True
+            self.cv.notify_all()
+
+
+class ChannelReceiver:
+    """Consumer facade over one named channel of a :class:`ChannelHub`."""
+
+    def __init__(self, hub: "ChannelHub", name: str,
+                 state: _RecvState) -> None:
+        self._hub = hub
+        self.name = name
+        self._state = state
+        reg = hub._registry
+        self._wait_hist = reg.histogram(
+            "tony_channel_recv_wait_seconds",
+            help="host wall a channel recv spent blocked on the wire",
+            buckets=CHANNEL_WAIT_BUCKETS_S, channel=name)
+        self._depth_gauge = reg.gauge(
+            "tony_channel_recv_queue_depth",
+            help="tensors buffered ahead of the consumer", channel=name)
+
+    def recv(self, timeout: float | None = None) -> np.ndarray:
+        t0 = time.perf_counter()
+        arr = self._state.get(timeout)
+        self._wait_hist.observe(time.perf_counter() - t0)
+        with self._state.cv:
+            self._depth_gauge.set(len(self._state.queue))
+        return arr
+
+    def qsize(self) -> int:
+        with self._state.cv:
+            return len(self._state.queue)
+
+
+class ChannelHub:
+    """One listening endpoint per task, multiplexing every inbound
+    channel by name. Senders dial it; a connection's HELLO names the
+    channel it carries. Connection loss (or a garbage frame) never
+    touches channel state — the reconnecting sender's handshake learns
+    ``next_seq`` and resumes."""
+
+    def __init__(self, port: int = 0, *, capacity: int = 8,
+                 bind_host: str = "",
+                 registry: metrics_mod.MetricsRegistry | None = None) -> None:
+        self.port = port
+        self.capacity = capacity
+        self.bind_host = bind_host
+        self._registry = registry or metrics_mod.get_default()
+        self._server: socket.socket | None = None
+        self._accept_thread: threading.Thread | None = None
+        self._states: dict[str, _RecvState] = {}
+        self._states_lock = threading.Lock()
+        self._conns: set[socket.socket] = set()
+        self._conns_lock = threading.Lock()
+        self._stopping = threading.Event()
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> int:
+        server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        server.bind((self.bind_host, self.port))
+        server.listen(16)
+        self.port = server.getsockname()[1]
+        self._server = server
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="tony-channel-hub", daemon=True)
+        self._accept_thread.start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._server is not None:
+            try:
+                self._server.close()
+            except OSError:
+                pass
+        self.disconnect_all()
+        with self._states_lock:
+            for state in self._states.values():
+                state.close()
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def disconnect_all(self) -> None:
+        """Sever every live connection WITHOUT touching channel state —
+        the fault-injection hook behind the reconnect/resume tests (and
+        a chaos lever for drills): senders see a socket error, reconnect
+        and resume at the receiver's seq."""
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def receiver(self, name: str) -> ChannelReceiver:
+        return ChannelReceiver(self, name, self._state_for(name))
+
+    def _state_for(self, name: str) -> _RecvState:
+        with self._states_lock:
+            state = self._states.get(name)
+            if state is None:
+                state = self._states[name] = _RecvState(self.capacity)
+            return state
+
+    # -- connection plumbing ------------------------------------------------
+    def _accept_loop(self) -> None:
+        assert self._server is not None
+        while not self._stopping.is_set():
+            try:
+                sock, _ = self._server.accept()
+            except OSError:
+                return
+            set_nodelay(sock)
+            with self._conns_lock:
+                self._conns.add(sock)
+            threading.Thread(target=self._serve_conn, args=(sock,),
+                             name="tony-channel-conn", daemon=True).start()
+
+    def _serve_conn(self, sock: socket.socket) -> None:
+        try:
+            self._handle_conn(sock)
+        finally:
+            with self._conns_lock:
+                self._conns.discard(sock)
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    def _handle_conn(self, sock: socket.socket) -> None:
+        try:
+            got = recv_exact(sock, len(CH_MAGIC))
+        except ProtocolError:
+            return
+        if got != CH_MAGIC:
+            return                          # stray peer: fail at byte 0
+        try:
+            fr = recv_frame(sock)
+            if fr is None or fr[0] != CH_HELLO:
+                raise ProtocolError("expected channel HELLO")
+            hello = unpack_json(fr[2])
+            name = hello.get("channel")
+            if not isinstance(name, str) or not name:
+                raise ProtocolError(f"bad channel name {name!r}")
+        except ProtocolError:
+            self._best_effort_error(sock, "malformed channel handshake")
+            return
+        state = self._state_for(name)
+        recv_bytes = self._registry.counter(
+            "tony_channel_bytes_total",
+            help="tensor payload bytes moved", channel=name,
+            direction="recv")
+        # preempt the predecessor: closing its socket makes a half-open
+        # connection's blocked read fail NOW, so conn_lock frees instead
+        # of this handshake queueing behind a dead peer forever
+        with state.active_lock:
+            old, state.active_sock = state.active_sock, sock
+        if old is not None and old is not sock:
+            try:
+                old.close()
+            except OSError:
+                pass
+        with state.conn_lock:
+            with state.active_lock:
+                if state.active_sock is not sock:
+                    return          # superseded while waiting our turn
+            self._deliver(sock, state, recv_bytes)
+
+    def _deliver(self, sock: socket.socket, state: _RecvState,
+                 recv_bytes) -> None:
+        """One connection's delivery loop, serialized per channel by
+        ``state.conn_lock`` — the resume value below is only correct
+        once no predecessor connection can still advance next_seq."""
+        try:
+            send_frame(sock, CH_HELLO, 0,
+                       pack_json({"v": 1, "resume": state.next_seq}))
+        except OSError:
+            return
+        while not self._stopping.is_set():
+            try:
+                fr = recv_frame(sock, max_bytes=MAX_TENSOR_BYTES)
+            except ProtocolError:
+                # truncated/garbage frame: channel-SCOPED — this
+                # connection dies, the hub keeps serving, the channel
+                # state is intact for the sender's resume
+                self._best_effort_error(sock, "malformed tensor frame")
+                return
+            if fr is None:
+                return                      # clean close
+            ftype, seq, payload = fr
+            if ftype != CH_TENSOR:
+                self._best_effort_error(sock, f"unexpected frame {ftype}")
+                return
+            if seq < state.next_seq:
+                # duplicate of something already consumed (resend racing
+                # the ack): re-ack so the sender's window advances
+                self._best_effort_ack(sock, state.next_seq - 1)
+                continue
+            if seq > state.next_seq:
+                self._best_effort_error(
+                    sock, f"seq gap: got {seq}, expected {state.next_seq}")
+                return
+            try:
+                arr = decode_tensor(payload)
+            except ProtocolError:
+                self._best_effort_error(sock, "undecodable tensor payload")
+                return
+            if not state.put(arr):
+                return                      # hub stopping
+            recv_bytes.inc(arr.nbytes)
+            try:
+                send_frame(sock, CH_ACK, seq)
+            except OSError:
+                return
+
+    @staticmethod
+    def _best_effort_error(sock: socket.socket, message: str) -> None:
+        try:
+            send_frame(sock, CH_ERROR, 0, pack_json({"message": message}))
+        except OSError:
+            pass
+
+    @staticmethod
+    def _best_effort_ack(sock: socket.socket, seq: int) -> None:
+        if seq < 0:
+            return
+        try:
+            send_frame(sock, CH_ACK, seq)
+        except OSError:
+            pass

@@ -1,0 +1,215 @@
+"""Coordinator-owned channel registry + trainer-side link discovery.
+
+The coordinator is the only process that sees every gang's registered
+endpoint, so IT owns the wiring diagram: at gang-barrier release it
+folds the pipeline declaration (``tony.pipeline.stages`` — job types in
+stage order) and the per-task channel ports (registered alongside the
+data-plane spec) into one per-task **channel spec** shipped back on the
+registration response (additive RPC field, the same wire-evolution
+precedent as the heartbeat metrics/epoch piggybacks).
+
+Per-task channel spec (JSON on the wire)::
+
+    {"stage": 1, "num_stages": 2, "rank": 0, "ranks": 1,
+     "prev": "hostA:chportA",     # stage-1 peer's hub ("" at stage 0)
+     "next": "hostC:chportC"}     # stage+1 peer's hub ("" at the last)
+
+Tasks are paired RANK-to-RANK across adjacent stages (rank = position
+among the stage job type's participant tasks, index order), which is why
+``pipeline_stages()`` validation requires equal instance counts across
+stages. The executor turns the spec into ``TONY_PIPELINE_*`` /
+``TONY_CHANNEL_*`` env vars; :func:`open_stage_links` turns those back
+into live transport objects for the trainer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+from tony_tpu import constants
+from tony_tpu.channels.channel import ChannelHub, ChannelReceiver, \
+    ChannelSender
+
+#: channel names on a task's hub: activations flowing INTO this stage,
+#: cotangents flowing back INTO this stage.
+ACT_CHANNEL = "act"
+GRAD_CHANNEL = "grad"
+
+
+def build_channel_specs(stages: list[str],
+                        tasks_of) -> dict[str, dict]:
+    """task_id → channel-spec dict for every task of a pipeline job.
+
+    ``stages``: job types in stage order. ``tasks_of(job_type)`` yields
+    that type's participant tasks as ``(task_id, host, channel_port)``
+    in index order. A task that registered no channel port (0) gets no
+    entry — its stage neighbors' specs then carry "" for that side, and
+    the trainer fails fast rather than dialing port 0.
+    """
+    per_stage: list[list[tuple[str, str, int]]] = [
+        list(tasks_of(jt)) for jt in stages]
+    specs: dict[str, dict] = {}
+    s_count = len(stages)
+    for k, members in enumerate(per_stage):
+        for rank, (task_id, host, port) in enumerate(members):
+            def _peer(stage_members, r):
+                if not stage_members or r >= len(stage_members):
+                    return ""
+                _, h, p = stage_members[r]
+                return f"{h}:{p}" if p else ""
+            specs[task_id] = {
+                "stage": k,
+                "num_stages": s_count,
+                "rank": rank,
+                "ranks": len(members),
+                "prev": _peer(per_stage[k - 1], rank) if k > 0 else "",
+                "next": _peer(per_stage[k + 1], rank)
+                        if k < s_count - 1 else "",
+            }
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Trainer side
+# ---------------------------------------------------------------------------
+@dataclass
+class StageLinks:
+    """A stage gang member's live transport endpoints, as consumed by
+    :class:`tony_tpu.parallel.pipeline.CrossSlicePipeline`:
+
+    - ``act_in`` / ``grad_in``: receivers on this task's own hub
+      (activations from stage-1, cotangents from stage+1)
+    - ``act_out`` / ``grad_out``: senders dialing the neighbors' hubs
+
+    Boundary stages hold ``None`` on the missing side. ``close`` drains
+    senders (so the last microbatch's grads land) then stops the hub.
+    """
+    stage: int
+    num_stages: int
+    rank: int = 0
+    hub: ChannelHub | None = None
+    act_in: ChannelReceiver | None = None
+    act_out: ChannelSender | None = None
+    grad_in: ChannelReceiver | None = None
+    grad_out: ChannelSender | None = None
+
+    @property
+    def is_first(self) -> bool:
+        return self.stage == 0
+
+    @property
+    def is_last(self) -> bool:
+        return self.stage == self.num_stages - 1
+
+    def close(self) -> None:
+        for sender in (self.act_out, self.grad_out):
+            if sender is not None:
+                sender.close(drain=True)
+        if self.hub is not None:
+            self.hub.stop()
+
+
+def open_stage_links(*, stage: int, num_stages: int, rank: int = 0,
+                     prev: str = "", next: str = "",
+                     hub_port: int = 0, window: int = 8,
+                     capacity: int = 8, registry=None) -> StageLinks:
+    """Stand up this task's hub and dial its neighbors. ``prev``/``next``
+    are the neighbor hubs' ``host:port`` endpoints ("" at the pipeline
+    boundary). Senders dial lazily — a neighbor whose hub is still
+    coming up is absorbed by the sender's connect retry."""
+    if not 0 <= stage < num_stages:
+        raise ValueError(f"stage {stage} outside 0..{num_stages - 1}")
+    if stage > 0 and not prev:
+        raise ValueError(f"stage {stage} has no upstream channel endpoint")
+    if stage < num_stages - 1 and not next:
+        raise ValueError(f"stage {stage} has no downstream channel endpoint")
+    hub = ChannelHub(port=hub_port, capacity=capacity, registry=registry)
+    hub.start()
+    links = StageLinks(stage=stage, num_stages=num_stages, rank=rank,
+                       hub=hub)
+    if stage > 0:
+        links.act_in = hub.receiver(ACT_CHANNEL)
+        links.grad_out = ChannelSender(prev, GRAD_CHANNEL, window=window,
+                                       registry=registry)
+    if stage < num_stages - 1:
+        links.grad_in = hub.receiver(GRAD_CHANNEL)
+        links.act_out = ChannelSender(next, ACT_CHANNEL, window=window,
+                                      registry=registry)
+    return links
+
+
+def open_local_pipeline(num_stages: int, *, window: int = 8,
+                        capacity: int = 8, registry=None,
+                        endpoint_map=None) -> list[StageLinks]:
+    """Wire ``num_stages`` in-process stages over loopback — the bench
+    and test harness for the cross-slice schedule (each "gang" is a
+    thread). All hubs start first, so there is no bring-up ordering
+    problem; ``endpoint_map(stage, port) -> "host:port"`` lets a harness
+    interpose a latency proxy in front of any stage's hub."""
+    hubs = [ChannelHub(capacity=capacity, registry=registry)
+            for _ in range(num_stages)]
+    ports = [hub.start() for hub in hubs]
+
+    def addr(k: int) -> str:
+        if endpoint_map is not None:
+            return endpoint_map(k, ports[k])
+        return f"127.0.0.1:{ports[k]}"
+
+    links = []
+    for k in range(num_stages):
+        link = StageLinks(stage=k, num_stages=num_stages, hub=hubs[k])
+        if k > 0:
+            link.act_in = hubs[k].receiver(ACT_CHANNEL)
+            link.grad_out = ChannelSender(addr(k - 1), GRAD_CHANNEL,
+                                          window=window, registry=registry)
+        if k < num_stages - 1:
+            link.grad_in = hubs[k].receiver(GRAD_CHANNEL)
+            link.act_out = ChannelSender(addr(k + 1), ACT_CHANNEL,
+                                         window=window, registry=registry)
+        links.append(link)
+    return links
+
+
+def stage_env(environ=None) -> dict | None:
+    """Parse the executor-exported pipeline env (None when this process
+    is not a pipeline stage)."""
+    env = os.environ if environ is None else environ
+    stage = env.get(constants.PIPELINE_STAGE)
+    if stage is None or stage == "":
+        return None
+    return {
+        "stage": int(stage),
+        "num_stages": int(env.get(constants.PIPELINE_NUM_STAGES, "1")),
+        "rank": int(env.get(constants.PIPELINE_RANK, "0")),
+        "prev": env.get(constants.CHANNEL_PREV, ""),
+        "next": env.get(constants.CHANNEL_NEXT, ""),
+        "hub_port": int(env.get(constants.CHANNEL_PORT, "0")),
+    }
+
+
+def open_stage_links_from_env(environ=None, *, window: int = 8,
+                              capacity: int = 8,
+                              registry=None) -> StageLinks | None:
+    """One-call trainer bootstrap: env → live :class:`StageLinks`.
+    The hub binds the port the EXECUTOR reserved and advertised to the
+    coordinator — peers are already dialing it."""
+    env = stage_env(environ)
+    if env is None:
+        return None
+    return open_stage_links(window=window, capacity=capacity,
+                            registry=registry, **env)
+
+
+def parse_channel_spec(spec_json: str) -> dict | None:
+    """Decode the wire channel spec; None for non-pipeline workers
+    (empty string) or malformed payloads (fail soft: the trainer then
+    simply is not a pipeline stage)."""
+    if not spec_json:
+        return None
+    try:
+        obj = json.loads(spec_json)
+    except json.JSONDecodeError:
+        return None
+    return obj if isinstance(obj, dict) and "stage" in obj else None

@@ -90,8 +90,9 @@ class CoordinatorRpc(ApplicationRpc):
             return ""
         return self.co.session.bootstrap_payload()["cluster_spec"]
 
-    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
-        return self.co.on_register_worker_spec(worker, spec)
+    def register_worker_spec(self, worker: str, spec: str,
+                             channel_port: int = 0) -> WorkerSpecResponse:
+        return self.co.on_register_worker_spec(worker, spec, channel_port)
 
     def register_tensorboard_url(self, spec: str) -> str:
         self.co.tensorboard_url = spec
@@ -275,7 +276,8 @@ class Coordinator:
     # ------------------------------------------------------------------
     # RPC-driven hooks
     # ------------------------------------------------------------------
-    def on_register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
+    def on_register_worker_spec(self, worker: str, spec: str,
+                                channel_port: int = 0) -> WorkerSpecResponse:
         try:
             task = self.session.get_task_by_id(worker)
         except (KeyError, IndexError):
@@ -298,7 +300,8 @@ class Coordinator:
             # slate — its earlier replayed failure must not block a later
             # genuine absorption
             self._elastic_bypass.discard(worker)
-        payload = self.session.register_task_spec(worker, spec)
+        payload = self.session.register_task_spec(worker, spec,
+                                                  channel_port)
         if not first_registration:
             # Barrier re-polls count as liveness: an executor waiting at the
             # gang barrier has no Heartbeater yet, and slow allocations
@@ -330,7 +333,8 @@ class Coordinator:
             process_id=self.session.process_id_of(worker),
             num_processes=payload["num_processes"],
             mesh_spec=payload["mesh_spec"],
-            cluster_epoch=payload.get("cluster_epoch", 0))
+            cluster_epoch=payload.get("cluster_epoch", 0),
+            channel_spec=self.session.channel_spec_for(worker))
 
     def _terminate_workers(self) -> None:
         time.sleep(0.5)
@@ -573,8 +577,17 @@ class Coordinator:
                 not any(t.job_type == jt for t in survivors)
                 for jt in {tid.split(":", 1)[0] for tid in lost}
                 if self.session.is_tracked(jt))
+            # A pipeline STAGE gang is never shrinkable: it holds layers,
+            # not a data-parallel replica — the survivors cannot compute
+            # the model without it. Losing one falls back to the
+            # stop-the-world preemption retry (reprovision + session
+            # re-run), which CAN bring the stage back.
+            stage_types = set(self.session.pipeline_stages)
+            stage_lost = any(tid.split(":", 1)[0] in stage_types
+                             for tid in lost)
             eligible = (self.elastic_budget_left > 0
                         and not chief_lost and not type_starved
+                        and not stage_lost
                         and len(survivors) >= max(1, self.elastic_min_tasks)
                         and self.session.status is SessionStatus.RUNNING
                         and self.final_status is None
@@ -582,9 +595,10 @@ class Coordinator:
         if not eligible:
             log.warning(
                 "elastic: loss of %s not absorbable (chief_lost=%s, "
-                "survivors=%d, budget=%d) — falling back to stop-the-world "
-                "preemption handling", sorted(lost), chief_lost,
-                len(survivors), self.elastic_budget_left)
+                "stage_lost=%s, survivors=%d, budget=%d) — falling back to "
+                "stop-the-world preemption handling", sorted(lost),
+                chief_lost, stage_lost, len(survivors),
+                self.elastic_budget_left)
             metrics_mod.get_default().counter(
                 "tony_elastic_fallbacks_total",
                 help="gang losses routed back to stop-the-world").inc()
@@ -805,7 +819,12 @@ class Coordinator:
 
     def _launch_task(self, task, request, user_command: str) -> None:
         """Launch one bound task (shared by initial scheduling and
-        in-session per-task restart)."""
+        in-session per-task restart). Per-gang PROGRAMS: a job type with
+        tony.{job}.program runs THAT command instead of the job-wide one
+        — how an MPMD pipeline job gives each stage gang its own trainer
+        entry point on its own device set."""
+        if request.program:
+            user_command = request.program
         env = {
             constants.JOB_NAME: task.job_type,
             constants.TASK_INDEX: str(task.index),

@@ -163,6 +163,13 @@ class TaskExecutor:
         self.task_id = f"{self.job_name}:{self.task_index}"
         self.data_port = reserve_port()
         self.tb_port = reserve_port()
+        # Inter-gang tensor channels (cross-slice pipeline): reserve the
+        # hub's listen port up front — like data_port it survives elastic
+        # resyncs (the executor never exits for one), so peers can keep
+        # dialing the same endpoint across user-process relaunches. Only
+        # pipeline jobs advertise one.
+        self.channel_port = (reserve_port()
+                             if conf.get(K.PIPELINE_STAGES_KEY) else 0)
         self.notebook_port = (reserve_port()
                               if self.job_name == constants.NOTEBOOK_JOB_NAME
                               else 0)
@@ -256,7 +263,8 @@ class TaskExecutor:
         deadline = time.monotonic() + self.registration_timeout_s
         backoff = 0.1
         while True:
-            resp = self.rpc.register_worker_spec(self.task_id, spec)
+            resp = self.rpc.register_worker_spec(self.task_id, spec,
+                                                 self.channel_port)
             if resp.released:
                 self.bootstrap = {
                     "cluster_spec": resp.spec,
@@ -265,6 +273,7 @@ class TaskExecutor:
                     "num_processes": resp.num_processes,
                     "mesh_spec": resp.mesh_spec,
                     "cluster_epoch": resp.cluster_epoch,
+                    "channel_spec": getattr(resp, "channel_spec", ""),
                 }
                 return self.bootstrap
             if time.monotonic() > deadline:
@@ -312,6 +321,20 @@ class TaskExecutor:
             env[constants.TONY_GCS_TOKEN_FILE] = self._gcs_token_file
         env[constants.CLUSTER_EPOCH] = str(
             self.bootstrap.get("cluster_epoch", 0))
+        # Cross-slice pipeline identity + channel endpoints: the
+        # coordinator's channel registry told us which stage gang this
+        # task belongs to and where its neighbor stages' hubs listen;
+        # the trainer opens its tensor channels straight from these
+        # (channels.open_stage_links_from_env) — no RPC on the data path.
+        from tony_tpu.channels.registry import parse_channel_spec
+        ch = parse_channel_spec(self.bootstrap.get("channel_spec", ""))
+        if ch is not None:
+            env[constants.PIPELINE_STAGE] = str(ch["stage"])
+            env[constants.PIPELINE_NUM_STAGES] = str(ch["num_stages"])
+            env[constants.PIPELINE_RANK] = str(ch.get("rank", 0))
+            env[constants.CHANNEL_PORT] = str(self.channel_port)
+            env[constants.CHANNEL_PREV] = ch.get("prev", "")
+            env[constants.CHANNEL_NEXT] = ch.get("next", "")
         cluster = json.loads(self.bootstrap["cluster_spec"])
         # Multi-slice identity: which gang of the job type this host is in
         # (tony.{job}.slices > 1). Index order is slice-major (session.py).

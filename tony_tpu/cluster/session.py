@@ -62,6 +62,7 @@ class SessionTask:
     index: int
     session_id: int
     spec: str = ""                  # "host:port" registered by the executor
+    channel_port: int = 0           # inter-gang tensor-channel hub port (0 = none)
     status: TaskStatus = TaskStatus.NEW
     exit_code: int | None = None
     url: str = ""
@@ -118,6 +119,15 @@ class Session:
         #: detached tasks armed for an elastic regrow, awaiting their
         #: replacement's registration before activation
         self._regrow_pending: set[str] = set()
+        #: cross-slice MPMD pipeline: job types in stage order
+        #: (tony.pipeline.stages); the channel registry wires their
+        #: gangs' tensor channels at every barrier release
+        self.pipeline_stages: list[str] = conf.pipeline_stages() \
+            if hasattr(conf, "pipeline_stages") else []
+        #: task_id → channel-spec dict, rebuilt at each barrier release
+        #: (endpoints are only knowable once every stage task registered
+        #: its hub port)
+        self._channel_specs: dict[str, dict] = {}
         self._mesh_spec = self._build_mesh_spec()
         # allocation-id → task binding (getAndInitMatchingTask:209 analog)
         self._next_allocation_id = 0
@@ -188,27 +198,58 @@ class Session:
     # ------------------------------------------------------------------
     # Registration / gang barrier
     # ------------------------------------------------------------------
-    def register_task_spec(self, task_id: str, spec: str) -> dict | None:
-        """Record a task's data-plane endpoint. Returns None until ALL
-        participant tasks registered; then a dict with cluster spec + JAX
-        bootstrap. Idempotent: re-registration overwrites the spec and
-        re-returns the payload. A DETACHED task's registration (its
-        elastic-regrow replacement coming up) records the spec but never
-        releases a barrier — the coordinator activates the regrow (new
-        epoch, everyone re-registers) once every replacement is in."""
+    def register_task_spec(self, task_id: str, spec: str,
+                           channel_port: int = 0) -> dict | None:
+        """Record a task's data-plane endpoint (and, for pipeline jobs,
+        its tensor-channel hub port). Returns None until ALL participant
+        tasks registered; then a dict with cluster spec + JAX bootstrap.
+        Idempotent: re-registration overwrites the spec and re-returns
+        the payload. A DETACHED task's registration (its elastic-regrow
+        replacement coming up) records the spec but never releases a
+        barrier — the coordinator activates the regrow (new epoch,
+        everyone re-registers) once every replacement is in."""
         with self._lock:
             task = self.get_task_by_id(task_id)
             task.spec = spec
+            if channel_port:
+                task.channel_port = channel_port
             if task.status in (TaskStatus.NEW, TaskStatus.SCHEDULED):
                 task.status = TaskStatus.REGISTERED
                 task.registered_at = time.monotonic()
             if task.detached or not self.barrier_released():
                 return None
             self._assign_process_ids()
+            # every endpoint is now known — (re)wire the channel registry
+            # for this epoch's participant set
+            self._channel_specs = self._build_channel_specs()
             for t in self.participants():
                 if t.status is TaskStatus.REGISTERED:
                     t.status = TaskStatus.RUNNING
             return self.bootstrap_payload()
+
+    def _build_channel_specs(self) -> dict[str, dict]:
+        """The coordinator-owned channel registry: per-task stage
+        identity + peer hub endpoints, derived from the registered specs
+        (host) and channel ports — see channels/registry.py for the
+        pairing rules."""
+        if not self.pipeline_stages:
+            return {}
+        from tony_tpu.channels.registry import build_channel_specs
+
+        def tasks_of(jt: str):
+            for t in sorted(self.tasks.get(jt, ()), key=lambda t: t.index):
+                if t.detached:
+                    continue
+                host = t.spec.rsplit(":", 1)[0] if t.spec else ""
+                yield t.task_id, host, t.channel_port
+        return build_channel_specs(self.pipeline_stages, tasks_of)
+
+    def channel_spec_for(self, task_id: str) -> str:
+        """This worker's channel-registry entry as wire JSON ("" when the
+        job has no pipeline or the task is not a stage member)."""
+        with self._lock:
+            entry = self._channel_specs.get(task_id)
+            return json.dumps(entry) if entry else ""
 
     def barrier_released(self) -> bool:
         return all(t.registered for t in self.participants())

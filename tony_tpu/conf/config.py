@@ -74,6 +74,7 @@ class TaskRequest:
     tpus: int = 0                 # TPU chips per task (tony.{job}.tpus)
     tpu_topology: str = ""        # pod-slice topology, e.g. "2x4" (tony.{job}.tpu.topology)
     slices: int = 1               # pod slices (gangs) backing this job type (tony.{job}.slices)
+    program: str = ""             # per-gang PROGRAM overriding the job command (tony.{job}.program)
     resources: str = ""           # extra localized resources (comma-sep paths)
     env: dict[str, str] = field(default_factory=dict)
     priority: int = 0             # unique per job type (Utils.java:330-336, YARN-7631)
@@ -262,12 +263,47 @@ class TonyConfig:
                 tpus=self.get_int(K.tpus_key(jt), 0),
                 tpu_topology=topology,
                 slices=slices,
+                program=self.get(K.program_key(jt), "") or "",
                 resources=self.get(K.resources_key(jt), "") or "",
                 env=env,
                 priority=priority,
             )
         self._validate_dcn(requests)
+        self._validate_pipeline(requests)
         return requests
+
+    def pipeline_stages(self) -> list[str]:
+        """Job types in PIPELINE STAGE ORDER (tony.pipeline.stages), []
+        when the job declares no cross-slice pipeline."""
+        return self.get_list(K.PIPELINE_STAGES_KEY)
+
+    def _validate_pipeline(self, requests: dict[str, TaskRequest]) -> None:
+        """Fail at parse time when the stage declaration cannot wire up:
+        every stage must be a declared job type, stages must be distinct,
+        and adjacent stages need matching host counts (the channel
+        registry pairs tasks rank-to-rank across stages)."""
+        stages = self.pipeline_stages()
+        if not stages:
+            return
+        if len(stages) < 2:
+            raise ValueError(
+                f"{K.PIPELINE_STAGES_KEY}={stages} — a pipeline needs at "
+                f"least 2 stage job types")
+        if len(set(stages)) != len(stages):
+            raise ValueError(
+                f"{K.PIPELINE_STAGES_KEY}={stages} repeats a job type; "
+                f"each stage gang is a distinct type")
+        for jt in stages:
+            if jt not in requests:
+                raise ValueError(
+                    f"{K.PIPELINE_STAGES_KEY} names {jt!r} but "
+                    f"tony.{jt}.instances is not declared (> 0)")
+        counts = {jt: requests[jt].instances for jt in stages}
+        if len(set(counts.values())) != 1:
+            raise ValueError(
+                f"pipeline stages have mismatched host counts {counts}; "
+                f"the channel registry pairs stage tasks rank-to-rank, so "
+                f"every stage needs the same tony.{{job}}.instances")
 
     def _validate_dcn(self, requests: dict[str, TaskRequest]) -> None:
         """Fail at parse time when tony.application.mesh.dcn cannot build a

@@ -107,6 +107,16 @@ ELASTIC_REGROW_BACKOFF_KEY = "tony.elastic.regrow-backoff-ms"
 ELASTIC_QUIESCE_KEY = "tony.elastic.quiesce-ms"
 
 # ---------------------------------------------------------------------------
+# Cross-slice MPMD pipeline ("tony.pipeline.*"): job types in STAGE ORDER,
+# e.g. "stage0,stage1" — each named job type's gang runs one pipeline
+# stage of the model, its own PROGRAM (tony.{job}.program), and exchanges
+# activations/cotangents with its neighbor stages over typed inter-gang
+# tensor channels (tony_tpu.channels) whose endpoints the coordinator's
+# channel registry assigns at gang-barrier release. Empty = no pipeline.
+# ---------------------------------------------------------------------------
+PIPELINE_STAGES_KEY = "tony.pipeline.stages"
+
+# ---------------------------------------------------------------------------
 # Metrics plane ("tony.metrics.*" — the TaskMonitor/MetricsRpc analog):
 # executors piggyback a registry snapshot on every heartbeat; the
 # coordinator folds its per-task last-snapshot table into a
@@ -239,6 +249,7 @@ DEFAULTS: dict[str, str] = {
     ELASTIC_REGROW_KEY: "true",
     ELASTIC_REGROW_BACKOFF_KEY: "1000",
     ELASTIC_QUIESCE_KEY: "300",
+    PIPELINE_STAGES_KEY: "",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
@@ -287,7 +298,7 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 # Keys that never denote a job type even though they match the shape.
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
-                                "launch", "elastic", "metrics"})
+                                "launch", "elastic", "metrics", "pipeline"})
 
 
 def instances_key(job_type: str) -> str:
@@ -325,6 +336,14 @@ def slices_key(job_type: str) -> str:
     return f"tony.{job_type}.slices"
 
 
+def program_key(job_type: str) -> str:
+    """Per-gang PROGRAM: the user command THIS job type's executors run,
+    overriding the job-wide command — how an MPMD pipeline job gives each
+    stage gang its own trainer entry point (one model, different stage
+    programs on disjoint device sets)."""
+    return f"tony.{job_type}.program"
+
+
 def resources_key(job_type: str) -> str:
     return f"tony.{job_type}.resources"
 
@@ -343,6 +362,7 @@ JOB_TYPE_DEFAULTS: dict[str, str] = {
     "tpus": "0",
     "tpu.topology": "",
     "slices": "1",
+    "program": "",
     "resources": "",
     "env": "",
 }

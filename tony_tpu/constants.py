@@ -107,6 +107,18 @@ MEGASCALE_COORDINATOR_ADDRESS = "MEGASCALE_COORDINATOR_ADDRESS"
 MEGASCALE_NUM_SLICES = "MEGASCALE_NUM_SLICES"
 MEGASCALE_SLICE_ID = "MEGASCALE_SLICE_ID"
 
+# Cross-slice MPMD pipeline (tony.pipeline.stages + per-gang PROGRAMS):
+# the executor exports this gang's stage identity and the inter-gang
+# channel endpoints the coordinator's channel registry assigned, so the
+# trainer can stand up its tensor channels (tony_tpu.channels) without
+# any coordinator RPC on the data path.
+PIPELINE_STAGE = "TONY_PIPELINE_STAGE"            # this gang's stage id
+PIPELINE_NUM_STAGES = "TONY_PIPELINE_NUM_STAGES"
+PIPELINE_RANK = "TONY_PIPELINE_RANK"              # rank within the stage
+CHANNEL_PORT = "TONY_CHANNEL_PORT"                # own hub's listen port
+CHANNEL_PREV = "TONY_CHANNEL_PREV"                # upstream peer hub host:port
+CHANNEL_NEXT = "TONY_CHANNEL_NEXT"                # downstream peer hub host:port
+
 # Data-feed handshake (replaces the reference's PY4J_GATEWAY_PORT,
 # Constants.java / TaskExecutor.java:87 — pure-Python executor needs no py4j).
 DATA_FEED_SPEC = "TONY_DATA_FEED_SPEC"

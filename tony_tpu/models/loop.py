@@ -24,6 +24,7 @@ waiting out pending checkpoint saves.
 
 from __future__ import annotations
 
+import itertools
 import logging
 import time
 from typing import Any, Callable, Iterable
@@ -94,6 +95,10 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
       at exit if it has a ``close()``. A batch is fetched per step and
       the blocked wall observed into ``tony_data_wait_seconds``. If the
       iterator runs dry early the loop stops cleanly (finite datasets).
+      ``None`` means this process consumes NO input feed — the shape of
+      a cross-slice pipeline stage gang past stage 0, whose "input" is
+      activations arriving on its tensor channel inside ``step_fn``;
+      the loop then passes ``batch=None`` every step.
     - ``checkpoint`` — a :class:`~tony_tpu.models.checkpoint
       .CheckpointManager`; ``save(step+1, state)`` is offered every step
       (the manager's ``save_interval_steps`` decides), and the pipeline
@@ -106,6 +111,8 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
       global examples/step from the assembled shape).
     - ``step_hook(step)`` runs first each iteration (profiler tracers).
     """
+    if data is None:
+        data = itertools.repeat(None)
     it = iter(data)
     reg = metrics_mod.get_default()
     wait_hist = reg.histogram(

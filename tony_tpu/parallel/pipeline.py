@@ -1,4 +1,5 @@
-"""Pipeline parallelism over ``pp``: GPipe and 1F1B schedules.
+"""Pipeline parallelism: in-slice GPipe/1F1B over ``pp``, and
+cross-slice MPMD 1F1B over DCN tensor channels.
 
 Green-field for the TPU build (SURVEY.md §2.3: PP absent from the reference).
 Stages live on different devices along the mesh's ``pp`` axis; activations
@@ -25,6 +26,16 @@ Two schedules:
   stage forward from the saved input (one extra forward per microbatch —
   the same trade a remat'd GPipe stage makes).
 
+* **Cross-slice 1F1B** (:class:`CrossSlicePipeline`): the MPMD variant —
+  each STAGE runs in its own gang (its own process set, its own slice's
+  ICI domain), with activations and cotangents hopping between gangs
+  over the typed DCN tensor channels (``tony_tpu.channels``) instead of
+  ``lax.ppermute``. The host drives the same non-interleaved 1F1B
+  schedule per stage; channel send/recv threads keep microbatch m±1's
+  transport in flight while microbatch m computes on the devices — the
+  same overlap discipline as the DevicePrefetcher. This is what trains
+  models that don't fit one slice's ICI domain.
+
 Constraint (both schedules): the stage function must map activations to
 activations of the same shape/dtype (natural for transformer blocks).
 Per-stage params are stacked on a leading [S, ...] axis, sharded P("pp") —
@@ -34,12 +45,33 @@ each device reads only its own stage's slice.
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
+
+
+def _axis_size(axis_name: str):
+    """lax.axis_size across jax versions (0.4.x predates it): the size
+    of a named mesh axis from inside a shard_map body."""
+    if hasattr(lax, "axis_size"):
+        return lax.axis_size(axis_name)
+    return lax.psum(1, axis_name)
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs, check_vma=False):
+    """jax.shard_map across jax versions: the public alias (with
+    ``check_vma``) landed after 0.4.x, where the same entry point lives
+    in jax.experimental.shard_map with the flag named ``check_rep``."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
@@ -56,7 +88,7 @@ def _pipeline_local(stage_params: Any, microbatches: jax.Array, *,
     garbage state whose aux must not count), summed over stages, and
     averaged over the batch axes.
     """
-    s = lax.axis_size(axis_name)
+    s = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     params = jax.tree.map(lambda x: x[0], stage_params)
     m = microbatches.shape[0]
@@ -139,7 +171,7 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
     yet reduced: loss_sum/head_grads live on the last stage, dxs on stage
     0, stage_grads on their own stage.
     """
-    s_count = lax.axis_size(axis_name)
+    s_count = _axis_size(axis_name)
     stage = lax.axis_index(axis_name)
     params = jax.tree.map(lambda v: v[0], stage_params)
     m = microbatches.shape[0]
@@ -217,7 +249,7 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
                 # weight via the vjp's aux output
                 denom = 1
                 for _ax in head_reduce_axes:
-                    denom = denom * lax.axis_size(_ax)
+                    denom = denom * _axis_size(_ax)
                 w_eff = aux_weight / denom
 
                 def last_fn(p, hp, x):
@@ -335,7 +367,7 @@ def _pipeline_1f1b_local(stage_params: Any, head_params: Any,
     # cotangent of THIS shard's tokens — scaled, not summed
     d_total = 1
     for a in batch_axes:
-        d_total *= lax.axis_size(a)
+        d_total *= _axis_size(a)
         loss = lax.pmean(loss, a)
         grads = jax.tree.map(lambda g, _a=a: lax.pmean(g, _a), grads)
         hgrads = jax.tree.map(lambda g, _a=a: lax.pmean(g, _a), hgrads)
@@ -444,7 +476,7 @@ def pipeline_value_and_grad(stage_fn: Callable[[Any, jax.Array], jax.Array],
                            stage_specs=param_specs,
                            head_reduce_axes=head_reduce_axes,
                            with_aux=with_aux, aux_weight=aux_weight)
-    loss, g_sp, g_hp, g_xs = jax.shard_map(
+    loss, g_sp, g_hp, g_xs = _shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, head_specs, data_spec, data_spec),
         out_specs=(P(), param_specs, head_specs, data_spec),
@@ -510,7 +542,7 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], Any],
     fn = functools.partial(_pipeline_local, stage_fn=stage_fn,
                            axis_name=axis_name, with_aux=with_aux,
                            batch_axes=live)
-    out = jax.shard_map(
+    out = _shard_map(
         fn, mesh=mesh,
         in_specs=(param_specs, data_spec),
         out_specs=(data_spec, P()) if with_aux else data_spec,
@@ -520,3 +552,217 @@ def pipeline_apply(stage_fn: Callable[[Any, jax.Array], Any],
         # microbatches average: each tick's aux is a per-microbatch mean
         return out.reshape((b,) + out.shape[2:]), aux / num_microbatches
     return out.reshape((b,) + out.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# Cross-slice MPMD 1F1B over DCN channels
+# ---------------------------------------------------------------------------
+class CrossSlicePipeline:
+    """Host-driven 1F1B for ONE stage gang of an MPMD pipeline.
+
+    Each stage gang constructs one of these around its OWN (unstacked)
+    stage function and its :class:`~tony_tpu.channels.StageLinks`; the
+    coordinated effect of every gang running :meth:`value_and_grad` on
+    the same microbatch count is exactly the Megatron non-interleaved
+    1F1B schedule, spread across slices:
+
+    - stage ``s`` runs ``min(S-1-s, M)`` warmup forwards, then steady
+      forward/backward pairs, then drains its remaining backwards;
+    - activations flow to ``stage+1`` and cotangents back to ``stage-1``
+      over the links' channels. Sends enqueue into the sender's bounded
+      window and return — DCN transport of microbatch m±1 overlaps the
+      device compute of microbatch m (``sync_transport=True`` defeats
+      that on purpose: the serialized baseline the bench contrasts).
+    - backward ticks recompute the stage forward from the saved input
+      under ``jax.vjp`` (remat-style), the same per-microbatch math as
+      the in-slice schedule — loss and gradients are BIT-IDENTICAL to
+      :func:`pipeline_value_and_grad` on the same params/microbatches
+      (test-pinned), so moving a model across slices never changes what
+      it learns.
+
+    The LAST stage owns the loss head: its backward seeds from
+    ``loss_head(head_params, stage_fn(params, x), head_mb)`` directly.
+    Activation memory is O(in-flight) = O(S - stage) microbatches per
+    stage, the 1F1B bound. ``with_aux`` stage functions are not
+    supported cross-slice yet (MoE balance losses stay in-slice).
+
+    Observability: per-call wall and bubble fraction land in the default
+    registry (``tony_pipeline_step_seconds``,
+    ``tony_pipeline_bubble_fraction{stage=}``), alongside the channels'
+    own send/recv walls and queue depths.
+    """
+
+    def __init__(self, stage_fn: Callable[[Any, jax.Array], jax.Array],
+                 links, *,
+                 loss_head: Callable[[Any, jax.Array, Any], jax.Array]
+                 | None = None,
+                 lookahead: int = 0,
+                 sync_transport: bool = False,
+                 send_timeout_s: float | None = 120.0,
+                 recv_timeout_s: float | None = 120.0,
+                 registry=None) -> None:
+        from tony_tpu.runtime import metrics as metrics_mod
+        self.links = links
+        self.stage = links.stage
+        self.num_stages = links.num_stages
+        #: extra in-flight microbatches beyond the 1F1B minimum: each
+        #: stage runs that many more warmup forwards, so activations
+        #: already in flight cover the DCN round trip instead of the
+        #: backward stalling on it every microbatch (the MPMD-paper
+        #: latency-tolerance knob). Costs ``lookahead`` extra saved
+        #: microbatch inputs of memory per stage; the accumulation ORDER
+        #: of backwards never changes, so results stay bit-identical at
+        #: any value.
+        self.lookahead = lookahead
+        self.sync_transport = sync_transport
+        self.send_timeout_s = send_timeout_s
+        self.recv_timeout_s = recv_timeout_s
+        if links.is_last and loss_head is None:
+            raise ValueError("the last stage needs the loss head")
+        self._fwd = jax.jit(stage_fn)
+
+        def _bwd(params, saved, cot):
+            _, vjp_fn = jax.vjp(lambda p, x: stage_fn(p, x), params, saved)
+            return vjp_fn(cot)
+        self._bwd = jax.jit(_bwd)
+
+        def _last(params, head_params, saved, head_mb):
+            def last_fn(p, hp, x):
+                return loss_head(hp, stage_fn(p, x), head_mb)
+            lval, vjp_fn = jax.vjp(last_fn, params, head_params, saved)
+            dp, dhp, dx = vjp_fn(jnp.ones((), lval.dtype))
+            return lval.astype(jnp.float32), dp, dhp, dx
+        self._last = jax.jit(_last) if links.is_last else None
+        reg = registry if registry is not None \
+            else metrics_mod.get_default()
+        self._step_hist = reg.histogram(
+            "tony_pipeline_step_seconds",
+            help="wall seconds per cross-slice 1F1B value_and_grad call",
+            stage=str(self.stage))
+        self._bubble_gauge = reg.gauge(
+            "tony_pipeline_bubble_fraction",
+            help="1 - device-busy/wall for the last 1F1B call (this "
+                 "stage's pipeline bubble + transport stall share)",
+            stage=str(self.stage))
+        self._mb_counter = reg.counter(
+            "tony_pipeline_microbatches_total",
+            help="microbatches processed by this stage",
+            stage=str(self.stage))
+
+    # The two compute entry points are methods so instrumentation (and
+    # the bench's deterministic compute stand-in) can wrap them.
+    def _forward_compute(self, params, x):
+        return self._fwd(params, x)
+
+    def _backward_compute(self, params, saved, cot):
+        return self._bwd(params, saved, cot)
+
+    def _last_compute(self, params, head_params, saved, head_mb):
+        return self._last(params, head_params, saved, head_mb)
+
+    def value_and_grad(self, params, *, num_microbatches: int,
+                       microbatches: jax.Array | None = None,
+                       head_params: Any = None, head_batches: Any = None):
+        """Run one global batch through this stage's share of the 1F1B
+        schedule; every stage gang must call this with the same
+        ``num_microbatches``.
+
+        - stage 0 supplies ``microbatches`` ([M, mb, ...]; later stages
+          receive activations off the wire);
+        - the last stage supplies ``head_params`` + ``head_batches``
+          (pytree with leading [M, mb, ...] batch dims).
+
+        Returns ``(loss, grads, head_grads, dxs)``: ``loss`` (f32
+        scalar) and ``head_grads`` are non-None only on the last stage,
+        ``dxs`` ([M, mb, ...] input cotangents) only on stage 0;
+        ``grads`` matches ``params`` everywhere.
+        """
+        import numpy as np
+
+        links = self.links
+        m = num_microbatches
+        if links.is_first:
+            if microbatches is None:
+                raise ValueError("stage 0 must supply microbatches")
+            if microbatches.shape[0] != m:
+                raise ValueError(
+                    f"microbatches leading dim {microbatches.shape[0]} != "
+                    f"num_microbatches {m}")
+        if links.is_last and (head_batches is None or head_params is None):
+            raise ValueError("the last stage must supply head_params and "
+                             "head_batches")
+        t_start = time.perf_counter()
+        busy = 0.0
+        saved: dict[int, jax.Array] = {}
+        grads = jax.tree.map(jnp.zeros_like, params)
+        hgrads = (jax.tree.map(jnp.zeros_like, head_params)
+                  if links.is_last else None)
+        loss_acc = jnp.zeros((), jnp.float32) if links.is_last else None
+        dx_list: list[jax.Array] = []
+
+        def _send(sender, arr):
+            sender.send(np.asarray(arr), sync=self.sync_transport,
+                        timeout=self.send_timeout_s)
+
+        def do_forward(i: int) -> None:
+            nonlocal busy
+            if links.is_first:
+                x = microbatches[i]
+            else:
+                x = jnp.asarray(links.act_in.recv(self.recv_timeout_s))
+            saved[i] = x
+            if links.is_last:
+                return      # the last stage folds its forward into _last
+            t0 = time.perf_counter()
+            out = self._forward_compute(params, x)
+            out_host = np.asarray(out)      # device sync: compute wall ends
+            busy += time.perf_counter() - t0
+            _send(links.act_out, out_host)
+
+        def do_backward(i: int) -> None:
+            nonlocal busy, grads, hgrads, loss_acc
+            if links.is_last:
+                head_mb = jax.tree.map(lambda a: a[i], head_batches)
+                t0 = time.perf_counter()
+                lval, dp, dhp, dx = self._last_compute(
+                    params, head_params, saved.pop(i), head_mb)
+                loss_acc = loss_acc + lval
+                grads = jax.tree.map(jnp.add, grads, dp)
+                hgrads = jax.tree.map(jnp.add, hgrads, dhp)
+                dx_host = np.asarray(dx)
+                busy += time.perf_counter() - t0
+            else:
+                cot = jnp.asarray(links.grad_in.recv(self.recv_timeout_s))
+                t0 = time.perf_counter()
+                dp, dx = self._backward_compute(params, saved.pop(i), cot)
+                grads = jax.tree.map(jnp.add, grads, dp)
+                dx_host = np.asarray(dx)
+                busy += time.perf_counter() - t0
+            if links.is_first:
+                dx_list.append(jnp.asarray(dx_host))
+            else:
+                _send(links.grad_out, dx_host)
+            self._mb_counter.inc()
+
+        # the non-interleaved 1F1B schedule in host form: warmup
+        # forwards, steady F/B pairs, backward drain
+        warmup = min(self.num_stages - 1 - self.stage + self.lookahead, m)
+        for i in range(warmup):
+            do_forward(i)
+        for i in range(m):
+            j = i + warmup
+            if j < m:
+                do_forward(j)
+            do_backward(i)
+
+        grads = jax.tree.map(lambda g: g / m, grads)
+        loss = None
+        if links.is_last:
+            loss = loss_acc / m
+            hgrads = jax.tree.map(lambda g: g / m, hgrads)
+        dxs = jnp.stack(dx_list) / m if links.is_first else None
+        wall = time.perf_counter() - t_start
+        self._step_hist.observe(wall)
+        self._bubble_gauge.set(max(0.0, 1.0 - busy / wall) if wall > 0
+                               else 0.0)
+        return loss, grads, hgrads, dxs

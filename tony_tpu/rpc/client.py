@@ -153,13 +153,17 @@ class ApplicationRpcClient(ApplicationRpc):
                           pb.GetClusterSpecRequest(task_id=task_id))
         return resp.cluster_spec
 
-    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse:
+    def register_worker_spec(self, worker: str, spec: str,
+                             channel_port: int = 0) -> WorkerSpecResponse:
         resp = self._call(self._register_worker_spec,
-                          pb.RegisterWorkerSpecRequest(worker=worker, spec=spec))
+                          pb.RegisterWorkerSpecRequest(
+                              worker=worker, spec=spec,
+                              channel_port=channel_port))
         return WorkerSpecResponse(
             spec=resp.spec, coordinator_address=resp.coordinator_address,
             process_id=resp.process_id, num_processes=resp.num_processes,
-            mesh_spec=resp.mesh_spec, cluster_epoch=resp.cluster_epoch)
+            mesh_spec=resp.mesh_spec, cluster_epoch=resp.cluster_epoch,
+            channel_spec=resp.channel_spec)
 
     def register_tensorboard_url(self, spec: str) -> str:
         resp = self._call(self._register_tb_url,

@@ -96,13 +96,29 @@ class ApplicationRpcServer:
             return pb.GetClusterSpecResponse(
                 cluster_spec=impl.get_cluster_spec(req.task_id))
 
+        # Old-signature compatibility (same precedent as the heartbeat
+        # metrics piggyback below): a pre-channel impl whose
+        # register_worker_spec still takes only (worker, spec) keeps
+        # working — the channel-port piggyback is dropped, not fatal.
+        try:
+            import inspect as _inspect
+            _reg_takes_port = len(_inspect.signature(
+                impl.register_worker_spec).parameters) >= 3
+        except (TypeError, ValueError):
+            _reg_takes_port = True
+
         def _register_worker_spec(req, ctx):
-            r = impl.register_worker_spec(req.worker, req.spec)
+            if _reg_takes_port:
+                r = impl.register_worker_spec(req.worker, req.spec,
+                                              req.channel_port)
+            else:
+                r = impl.register_worker_spec(req.worker, req.spec)
             return pb.RegisterWorkerSpecResponse(
                 spec=r.spec, coordinator_address=r.coordinator_address,
                 process_id=r.process_id, num_processes=r.num_processes,
                 mesh_spec=r.mesh_spec,
-                cluster_epoch=getattr(r, "cluster_epoch", 0))
+                cluster_epoch=getattr(r, "cluster_epoch", 0),
+                channel_spec=getattr(r, "channel_spec", ""))
 
         def _register_tb_url(req, ctx):
             return pb.RegisterTensorBoardUrlResponse(

@@ -42,13 +42,16 @@ class WorkerSpecResponse:
     bootstrap assignment (the TF_CONFIG replacement). ``cluster_epoch``
     identifies the cluster-spec GENERATION: elastic shrink/regrow bumps it
     and re-holds the barrier, so a released payload always carries the
-    epoch its spec belongs to."""
+    epoch its spec belongs to. ``channel_spec`` is the coordinator's
+    channel-registry entry for THIS worker (JSON: pipeline stage
+    id/count + peer hub endpoints; "" for non-pipeline jobs)."""
     spec: str = ""
     coordinator_address: str = ""
     process_id: int = -1
     num_processes: int = 0
     mesh_spec: str = ""
     cluster_epoch: int = 0
+    channel_spec: str = ""
 
     @property
     def released(self) -> bool:
@@ -78,7 +81,14 @@ class ApplicationRpc(abc.ABC):
     def get_cluster_spec(self, task_id: str) -> str: ...
 
     @abc.abstractmethod
-    def register_worker_spec(self, worker: str, spec: str) -> WorkerSpecResponse: ...
+    def register_worker_spec(self, worker: str, spec: str,
+                             channel_port: int = 0) -> WorkerSpecResponse:
+        """Register the worker's data-plane endpoint (and, for pipeline
+        jobs, the listen port of its inter-gang tensor-channel hub — 0
+        means the worker runs no channel plane). Implementations may
+        keep the pre-channel two-argument signature; the server detects
+        it and drops the piggyback rather than TypeError-ing."""
+        ...
 
     @abc.abstractmethod
     def register_tensorboard_url(self, spec: str) -> str: ...

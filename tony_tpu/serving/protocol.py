@@ -94,6 +94,11 @@ _LEN = struct.Struct("<I")
 _HDR = struct.Struct("<BQ")          # type, rid
 _TOK = struct.Struct("<I")
 
+#: bytes of (type, rid) header inside every frame body — what
+#: :func:`frame_header` adds to a payload length before checking its
+#: limit; exported so other planes' size guards can mirror the check.
+BODY_HEADER_BYTES = _HDR.size
+
 
 class ProtocolError(ValueError):
     """Malformed wire data. Connection-scoped by convention: handlers
@@ -114,52 +119,105 @@ def set_nodelay(sock: socket.socket) -> None:
 def recv_exact(sock: socket.socket, n: int) -> bytes | None:
     """Read exactly ``n`` bytes. Returns None on clean EOF at a frame
     boundary (byte 0); raises ProtocolError on EOF mid-read (a peer
-    that died mid-frame)."""
-    chunks: list[bytes] = []
+    that died mid-frame).
+
+    Accumulates via ``recv_into`` on one preallocated buffer: the old
+    ``bytes``-list + join path copied every chunk twice, which starts to
+    matter once frames carry megabyte tensor payloads (the inter-gang
+    channel plane reuses this reader)."""
+    buf = bytearray(n)
+    view = memoryview(buf)
     got = 0
     while got < n:
         try:
-            data = sock.recv(n - got)
+            k = sock.recv_into(view[got:])
         except OSError as e:
-            if chunks:
+            if got:
                 raise ProtocolError(f"connection lost mid-frame: {e}")
             return None
-        if not data:
-            if chunks:
+        if not k:
+            if got:
                 raise ProtocolError("truncated frame (EOF mid-frame)")
             return None
-        chunks.append(data)
-        got += len(data)
-    return b"".join(chunks)
+        got += k
+    return bytes(buf)
 
 
-def encode_frame(ftype: int, rid: int, payload: bytes = b"") -> bytes:
-    body = _HDR.pack(ftype, rid) + payload
-    if len(body) > MAX_FRAME_BYTES:
-        raise ProtocolError(f"frame too large: {len(body)} bytes")
-    return _LEN.pack(len(body)) + body
+#: payloads at or above this many bytes skip the concatenated-copy encode
+#: and go out as header + payload writes (two sendalls). Below it, one
+#: sendall keeps small control frames in a single segment under
+#: TCP_NODELAY (framing on the wire is unchanged either way).
+LARGE_PAYLOAD_BYTES = 1 << 16
+
+
+def frame_header(ftype: int, rid: int, payload_len: int,
+                 limit: int = MAX_FRAME_BYTES) -> bytes:
+    """Length prefix + (type, rid) header for a frame whose payload will
+    be written separately — the zero-copy send path and the channel
+    plane's TENSOR frames build on this. ``limit`` lets a plane with
+    legitimately bigger frames (tensor microbatches) raise the sanity
+    cap without loosening the serving wire's."""
+    body_len = _HDR.size + payload_len
+    if body_len > limit:
+        raise ProtocolError(f"frame too large: {body_len} bytes")
+    return _LEN.pack(body_len) + _HDR.pack(ftype, rid)
+
+
+def _payload_nbytes(payload) -> int:
+    """Byte length of a frame payload. ``len()`` on a non-byte
+    memoryview counts ELEMENTS (a float32 view would understate by 4x
+    and corrupt the length prefix) — nbytes is the wire truth."""
+    return payload.nbytes if isinstance(payload, memoryview) \
+        else len(payload)
+
+
+def encode_frame(ftype: int, rid: int,
+                 payload: bytes | memoryview = b"") -> bytes:
+    return frame_header(ftype, rid, _payload_nbytes(payload)) \
+        + bytes(payload)
 
 
 def send_frame(sock: socket.socket, ftype: int, rid: int,
-               payload: bytes = b"") -> None:
-    sock.sendall(encode_frame(ftype, rid, payload))
+               payload: bytes | memoryview = b"") -> None:
+    """Write one frame. Large payloads (tensor-sized) are sent as
+    header-then-payload without an intermediate concatenated copy —
+    ``payload`` may be a ``memoryview`` straight over a device buffer's
+    host copy (any element format; byte length is taken from
+    ``nbytes``); small control frames keep the single-sendall
+    behavior."""
+    n = _payload_nbytes(payload)
+    if n >= LARGE_PAYLOAD_BYTES:
+        sock.sendall(frame_header(ftype, rid, n))
+        sock.sendall(payload)
+    else:
+        sock.sendall(encode_frame(ftype, rid, payload))
 
 
-def recv_frame(sock: socket.socket):
+def recv_frame(sock: socket.socket, max_bytes: int = MAX_FRAME_BYTES):
     """Read one frame; returns ``(type, rid, payload)`` or None on clean
     EOF. Raises ProtocolError on truncation or an implausible length
-    prefix — the reader can then close without ever losing sync."""
+    prefix — the reader can then close without ever losing sync.
+    ``max_bytes`` mirrors :func:`frame_header`'s ``limit``.
+
+    The (type, rid) header and the payload are read separately so the
+    payload is handed back exactly as received — no full-body slice
+    copy for megabyte tensor frames."""
     head = recv_exact(sock, _LEN.size)
     if head is None:
         return None
     (length,) = _LEN.unpack(head)
-    if length < _HDR.size or length > MAX_FRAME_BYTES:
+    if length < _HDR.size or length > max_bytes:
         raise ProtocolError(f"implausible frame length {length}")
-    body = recv_exact(sock, length)
-    if body is None:
+    hdr = recv_exact(sock, _HDR.size)
+    if hdr is None:
         raise ProtocolError("truncated frame (EOF after length prefix)")
-    ftype, rid = _HDR.unpack_from(body, 0)
-    return ftype, rid, body[_HDR.size:]
+    ftype, rid = _HDR.unpack(hdr)
+    if length == _HDR.size:
+        return ftype, rid, b""
+    payload = recv_exact(sock, length - _HDR.size)
+    if payload is None:
+        raise ProtocolError("truncated frame (EOF after length prefix)")
+    return ftype, rid, payload
 
 
 def read_magic(sock: socket.socket) -> bool:
