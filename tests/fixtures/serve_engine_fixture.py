@@ -1,0 +1,47 @@
+"""Tiny serving host for the trace e2e: a real ContinuousBatcher behind
+a ServingServer. Writes its bound port to --port_file (atomic) and
+serves until --done_file appears, then drains and exits 0. Runs as the
+"engine" job type's per-gang PROGRAM; its engine-side request spans
+(engine.request / engine.queued / engine.first_token — the TTFT
+decomposition) spool to the executor and ride heartbeats to the
+coordinator."""
+
+import argparse
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port_file", default=".engine-port")
+    ap.add_argument("--done_file", default=".client-done")
+    ap.add_argument("--timeout_s", type=float, default=120.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.serving.server import ServingServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    batcher = ContinuousBatcher(params, cfg, batch=2, max_len=32, chunk=3)
+    server = ServingServer(batcher, port=0)
+    port = server.start()
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write(str(port))
+    os.replace(tmp, args.port_file)
+    print(f"engine serving on {port}", flush=True)
+    deadline = time.time() + args.timeout_s
+    while not os.path.exists(args.done_file) and time.time() < deadline:
+        time.sleep(0.1)
+    server.stop(drain=True)
+    print("engine done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

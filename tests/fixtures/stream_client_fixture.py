@@ -1,0 +1,53 @@
+"""Jax-free streaming client for the trace e2e: runs as the "driver"
+job type's PROGRAM in a SEPARATE process from the engine, waits for the
+engine's port file, streams one request, and touches --done_file. Its
+client.request / client.ttft spans root the request's trace; the span
+context rides the ADMIT frame, so the engine process's spans join the
+SAME trace id — the cross-process causal chain the e2e asserts."""
+
+import argparse
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port_file", default=".engine-port")
+    ap.add_argument("--done_file", default=".client-done")
+    ap.add_argument("--timeout_s", type=float, default=90.0)
+    args = ap.parse_args()
+
+    from tony_tpu.serving.client import StreamingClient
+
+    port = None
+    deadline = time.time() + args.timeout_s
+    while time.time() < deadline:
+        if os.path.exists(args.port_file):
+            try:
+                port = int(open(args.port_file).read().strip())
+                break
+            except ValueError:
+                pass                   # mid-write; retry
+        time.sleep(0.1)
+    if port is None:
+        print("engine port never appeared", flush=True)
+        return 1
+
+    with StreamingClient("127.0.0.1", port) as client:
+        rid = client.submit([1, 2, 3, 4], max_new_tokens=6)
+        tokens, reason = client.result(rid, timeout=60.0)
+    print(f"client streamed {len(tokens)} tokens ({reason})", flush=True)
+
+    tmp = args.done_file + ".tmp"
+    with open(tmp, "w") as f:
+        f.write("done")
+    os.replace(tmp, args.done_file)
+    # give the spool one beat to ship before exiting (the final
+    # heartbeat would carry leftovers anyway; this just keeps the
+    # common path deterministic)
+    time.sleep(0.3)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

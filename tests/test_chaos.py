@@ -7,6 +7,8 @@ is replayable bit-for-bit. Uses the jax-free fake trainer: the smoke
 exercises detection → shrink → resync → regrow orchestration, not model
 math (tests/test_elastic.py pins the numerics)."""
 
+import glob
+import json
 import os
 import random
 import sys
@@ -93,3 +95,29 @@ def test_seeded_gang_kill_schedule_survives(tmp_path):
             log_dir, f"worker-{victim.split(':')[1]}.stdout")).read()
         assert body.count("starting at step") >= 2, (
             detail + f" ({victim} never relaunched)")
+    # Flight recorder: every injected preempt-kill leaves a parseable
+    # postmortem dump. The victims were SIGKILLed (they cannot dump), so
+    # the COORDINATOR's ring is the incident artifact — one dump per
+    # shrink, referenced from the ELASTIC_SHRINK jhist event, whose
+    # final entries record the gang loss itself.
+    events = parse_events(files[0])
+    shrinks = [e for e in events if e.event_type == "ELASTIC_SHRINK"]
+    assert len(shrinks) == len(schedule["kills"]), detail
+    for shrink in shrinks:
+        dump_path = shrink.payload.get("flight_dump")
+        assert dump_path, detail + " (ELASTIC_SHRINK without flight_dump)"
+        assert os.path.exists(dump_path), detail + f" ({dump_path} gone)"
+        doc = json.load(open(dump_path))
+        assert doc["reason"] == "elastic_shrink", doc["reason"]
+        kinds = [e["kind"] for e in doc["events"]]
+        # back-to-front: the dump marker, then the incident it records
+        assert kinds[-1] == "flight_dump", kinds
+        assert "gang_lost" in kinds, detail + f" (kinds={kinds})"
+        lost_entry = next(e for e in reversed(doc["events"])
+                          if e["kind"] == "gang_lost")
+        victim = shrink.payload["lost"][0]
+        assert victim in lost_entry["lost"], (lost_entry, shrink.payload)
+    # dumps live under the job dir, named by the dumping process
+    am_dumps = glob.glob(os.path.join(client.job_dir, "flight-am-0-*.json"))
+    assert len(am_dumps) >= len(schedule["kills"]), (
+        detail + f" (dumps={am_dumps})")

@@ -239,6 +239,101 @@ class TestHeartbeatMetricsPiggyback:
 
 
 # ---------------------------------------------------------------------------
+# Heartbeat trace piggyback (spans + clock fields, strictly additive —
+# the same wire-evolution precedent as the metrics piggyback above)
+# ---------------------------------------------------------------------------
+
+class TraceFakeImpl(FakeImpl):
+    """New-style impl: accepts the trace piggyback."""
+
+    def __init__(self, expected=2):
+        super().__init__(expected)
+        self.heartbeat_spans = []
+        self.heartbeat_clocks = []
+
+    def task_executor_heartbeat(self, task_id, metrics="", spans="",
+                                client_time=0.0, client_rtt=0.0):
+        self.heartbeats.append(task_id)
+        self.heartbeat_snapshots.append(metrics)
+        self.heartbeat_spans.append(spans)
+        self.heartbeat_clocks.append((client_time, client_rtt))
+
+
+class TestHeartbeatTracePiggyback:
+    def test_old_wire_message_defaults_to_no_spans(self):
+        """A HeartbeatRequest serialized WITHOUT the trace fields (an
+        old binary's wire bytes) reaches a new impl as ""/0 — a plain
+        beat, accepted end to end."""
+        import grpc
+        from tony_tpu.rpc import tony_pb2 as pb
+        from tony_tpu.rpc.server import SERVICE_NAME
+        impl = TraceFakeImpl(expected=1)
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        try:
+            # proto3 omits unset fields entirely, so serializing only
+            # task_id+metrics IS the old binary's wire shape; sanity:
+            # it reparses with the trace fields at their defaults
+            raw = pb.HeartbeatRequest(task_id="worker:0",
+                                      metrics="{}").SerializeToString()
+            reparsed = pb.HeartbeatRequest.FromString(raw)
+            assert reparsed.spans == "" and reparsed.client_unix_time == 0.0
+            channel = grpc.insecure_channel(f"localhost:{srv.port}")
+            stub = channel.unary_unary(
+                f"/{SERVICE_NAME}/TaskExecutorHeartbeat",
+                request_serializer=lambda m: m,
+                response_deserializer=pb.HeartbeatResponse.FromString)
+            stub(raw, timeout=10.0)
+            channel.close()
+            assert impl.heartbeat_spans == [""]
+            assert impl.heartbeat_clocks == [(0.0, 0.0)]
+        finally:
+            srv.stop(0)
+
+    def test_old_impl_still_served_piggyback_dropped(self, server):
+        """An impl with the pre-trace signature (metrics-only, the
+        FakeImpl above) keeps working against a NEW client sending
+        spans + clock fields — the server detects the signature and
+        drops the piggyback instead of TypeError-ing every beat."""
+        impl, srv = server
+        client = ApplicationRpcClient(f"localhost:{srv.port}")
+        ack = client.task_executor_heartbeat(
+            "worker:0", "", spans='{"s":[]}', client_rtt=0.25)
+        assert ack is not None
+        assert impl.heartbeats == ["worker:0"]
+        client.close()
+
+    def test_span_batch_and_clock_round_trip(self):
+        """A span batch arrives byte-identical; the request stamps the
+        sender's wall clock at send and carries the caller's RTT."""
+        import time as _time
+
+        from tony_tpu.runtime import tracing as T
+        impl = TraceFakeImpl(expected=1)
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        try:
+            tr = T.Tracer(proc="w:0", sample_rate=1.0)
+            with tr.span("unit.work", k="v"):
+                pass
+            batch = T.encode_batch(tr.drain())
+            client = ApplicationRpcClient(f"localhost:{srv.port}")
+            before = _time.time()
+            client.task_executor_heartbeat("worker:0", "", spans=batch,
+                                           client_rtt=0.125)
+            after = _time.time()
+            client.close()
+            assert impl.heartbeat_spans == [batch]          # bit-exact
+            decoded = T.parse_batch_json(impl.heartbeat_spans[0])
+            assert decoded["s"][0]["n"] == "unit.work"
+            stamped, rtt = impl.heartbeat_clocks[0]
+            assert before <= stamped <= after
+            assert abs(rtt - 0.125) < 1e-9
+        finally:
+            srv.stop(0)
+
+
+# ---------------------------------------------------------------------------
 # Control-plane auth (ClientToAMToken analog)
 # ---------------------------------------------------------------------------
 

@@ -1,0 +1,215 @@
+"""End-to-end distributed tracing plane: real local-backend jobs whose
+spans cross processes, ride heartbeats into TRACE_SPAN jhist events, and
+export as Chrome-trace JSON — plus the flight recorder's postmortem
+artifacts (the acceptance path of the tracing issue)."""
+
+import json
+import glob
+import os
+import sys
+import urllib.request
+
+import pytest
+
+from tony_tpu.client.client import TonyClient
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events import events as ev
+from tony_tpu.history.server import HistoryServer
+from tony_tpu.runtime import tracing
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "fixtures")
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+def _make_client(tmp_path, command, confs=None, shell_env=None):
+    base = {
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": str(tmp_path / "tony-history"),
+        "tony.application.timeout": "150000",
+        "tony.task.heartbeat-interval-ms": "100",
+        "tony.metrics.snapshot-interval-ms": "200",
+    }
+    base.update(confs or {})
+    return TonyClient(TonyConfig(base), command, shell_env=shell_env)
+
+
+def _job_spans(hist_dir):
+    """Every span from every TRACE_SPAN event across the job's jhist,
+    annotated with the emitting task."""
+    spans = []
+    for path in ev.find_job_files(hist_dir):
+        for e in ev.parse_events(path):
+            if e.event_type != ev.TRACE_SPAN:
+                continue
+            for s in e.payload.get("spans", []):
+                tracing.validate_span(s)
+                spans.append({**s, "_task": e.payload.get("task")})
+    return spans
+
+
+def _http_json(port, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{port}{path}", timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read().decode("utf-8"))
+
+
+@pytest.mark.e2e
+def test_serving_request_trace_crosses_processes(tmp_path):
+    """A streaming serving request traced end to end across two real
+    processes: the jax-free client (driver task) roots the trace, its
+    context rides the ADMIT frame, and the engine task's TTFT
+    decomposition (engine.queued -> engine.first_token within
+    engine.request) lands under the SAME 128-bit trace id — exported as
+    valid Chrome trace JSON by the history server."""
+    hist = str(tmp_path / "tony-history")
+    engine = os.path.join(FIXTURES, "serve_engine_fixture.py")
+    driver = os.path.join(FIXTURES, "stream_client_fixture.py")
+    client = _make_client(
+        tmp_path, "echo unused-job-wide-command",
+        {"tony.engine.instances": "1",
+         "tony.driver.instances": "1",
+         "tony.engine.program": f"{PY} {engine}",
+         "tony.driver.program": f"{PY} {driver}"},
+        shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                   "XLA_FLAGS": ""})
+    assert client.run() == 0
+
+    spans = _job_spans(hist)
+    by_tid = {}
+    for s in spans:
+        by_tid.setdefault(s["tid"], []).append(s)
+    roots = [s for s in spans if s["n"] == "client.request"]
+    assert roots, f"no client.request span in {sorted({s['n'] for s in spans})}"
+    trace = by_tid[roots[0]["tid"]]
+    names = {s["n"] for s in trace}
+    # the TTFT decomposition, one trace id, >= 2 processes
+    assert {"client.request", "client.ttft", "engine.request",
+            "engine.queued", "engine.first_token"} <= names, names
+    procs = {s["proc"] for s in trace}
+    assert len(procs) >= 2, procs
+    assert any(p.startswith("driver:0") for p in procs), procs
+    assert any(p.startswith("engine:0") for p in procs), procs
+    # parent links: engine.request is a child of the client's span
+    by_sid = {s["sid"]: s for s in trace}
+    eng_req = next(s for s in trace if s["n"] == "engine.request")
+    assert by_sid[eng_req["pid"]]["n"] == "client.request"
+
+    # export: GET /api/jobs/<id>/trace is Chrome-trace JSON carrying
+    # the same cross-process request
+    server = HistoryServer(TonyConfig({"tony.history.location": hist}),
+                           port=0)
+    server.start()
+    try:
+        chrome = _http_json(server.port,
+                            f"/api/jobs/{client.app_id}/trace")
+        events = chrome["traceEvents"]
+        assert events and chrome.get("displayTimeUnit") == "ms"
+        xs = [e for e in events if e["ph"] == "X"]
+        for e in xs:
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float))
+            assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        tid0 = roots[0]["tid"]
+        exported = [e for e in xs if e["args"].get("trace_id") == tid0]
+        assert {"client.request", "engine.first_token"} <= {
+            e["name"] for e in exported}
+        # process metadata names both processes
+        meta = {e["args"]["name"] for e in events
+                if e["ph"] == "M" and e["name"] == "process_name"}
+        assert any(m.startswith("driver:0") for m in meta), meta
+        assert any(m.startswith("engine:0") for m in meta), meta
+    finally:
+        server.stop()
+
+
+@pytest.mark.e2e
+def test_pipeline_step_spans_share_one_trace_id(tmp_path):
+    """A 2-gang cross-slice pipeline job (per-gang PROGRAMS over real
+    DCN channels): each step's per-stage microbatch spans — recorded in
+    SEPARATE processes — share one deterministic trace id derived from
+    the job trace + step ordinal, tagged with the channel seq, with no
+    extra channel frames."""
+    steps, m = 2, 2
+    hist = str(tmp_path / "tony-history")
+    trainer = os.path.join(REPO, "examples", "lm", "train_pipeline.py")
+    out = tmp_path / "pipe"
+    prog = (f"{PY} {trainer} --steps {steps} --microbatches {m} "
+            f"--mb_rows 2 --dim 4 --lr 0.1 --out {out}")
+    client = _make_client(
+        tmp_path, f"{PY} -c 'raise SystemExit(7)'",     # must be unused
+        {"tony.stage0.instances": "1",
+         "tony.stage1.instances": "1",
+         "tony.pipeline.stages": "stage0,stage1",
+         "tony.stage0.program": prog,
+         "tony.stage1.program": prog},
+        shell_env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO,
+                   "XLA_FLAGS": ""})
+    assert client.run() == 0
+
+    spans = _job_spans(hist)
+    stage_spans = [s for s in spans if s["n"] == "pipeline.stage"]
+    assert stage_spans, sorted({s["n"] for s in spans})
+    by_tid = {}
+    for s in stage_spans:
+        by_tid.setdefault(s["tid"], set()).add(s["proc"])
+    # at least one step's stage spans arrived from BOTH stage processes
+    # under one trace id
+    both = [tid for tid, procs in by_tid.items()
+            if any(p.startswith("stage0:0") for p in procs)
+            and any(p.startswith("stage1:0") for p in procs)]
+    assert both, by_tid
+    tid = both[0]
+    mbs = [s for s in spans
+           if s["tid"] == tid and s["n"] in ("pipeline.forward",
+                                             "pipeline.backward")]
+    assert {s["proc"].split("/")[0] for s in mbs} >= {"stage0:0",
+                                                     "stage1:0"}
+    # microbatch journeys reconstruct off the channel seq: stage 0's
+    # forward SEND seq matches stage 1's forward RECV seq per mb
+    f0 = {s["a"]["mb"]: s["a"].get("seq") for s in mbs
+          if s["n"] == "pipeline.forward" and s["a"]["stage"] == 0}
+    f1 = {s["a"]["mb"]: s["a"].get("seq") for s in mbs
+          if s["n"] == "pipeline.forward" and s["a"]["stage"] == 1}
+    assert f0 and f0 == f1, (f0, f1)
+    # every stage span parents onto the shared deterministic step root
+    root_sid = tracing.deterministic_span_id(f"{tid}:root")
+    assert all(s["pid"] == root_sid for s in stage_spans
+               if s["tid"] == tid)
+
+
+@pytest.mark.e2e
+def test_abnormal_exit_leaves_flight_dump_and_jhist_tail(tmp_path):
+    """An abnormal child exit dumps the executor's flight ring to the
+    job dir (a parseable postmortem whose final entries record the
+    incident) and ships the tail on the final beat — the incident's
+    TASK_FINISHED event carries it."""
+    hist = str(tmp_path / "tony-history")
+    client = _make_client(
+        tmp_path, f"{PY} {os.path.join(FIXTURES, 'exit_1.py')}",
+        {"tony.worker.instances": "1"})
+    assert client.run() == 1
+
+    dumps = glob.glob(os.path.join(client.job_dir, "flight-*.json"))
+    assert dumps, os.listdir(client.job_dir)
+    executor_dumps = [d for d in dumps if "worker-0" in d]
+    assert executor_dumps, dumps
+    doc = json.load(open(executor_dumps[0]))
+    assert doc["reason"].startswith("child_exit")
+    kinds = [e["kind"] for e in doc["events"]]
+    # the FINAL entries record the incident itself
+    assert kinds[-1] == "flight_dump" and "child_exit" in kinds, kinds
+
+    finished = [e for path in ev.find_job_files(hist)
+                for e in ev.parse_events(path)
+                if e.event_type == ev.TASK_FINISHED
+                and e.payload.get("task") == "worker:0"]
+    assert finished, "no TASK_FINISHED for worker:0"
+    tail = finished[0].payload.get("flight")
+    assert tail is not None, finished[0].payload
+    assert tail["reason"].startswith("child_exit")
+    assert any(e["kind"] == "child_exit" and e.get("code") == 1
+               for e in tail["events"]), tail
+    # the jhist event references the on-disk dump
+    assert tail["dump"] in executor_dumps, (tail["dump"], executor_dumps)

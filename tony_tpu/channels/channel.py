@@ -493,6 +493,15 @@ class ChannelReceiver:
         self._hub = hub
         self.name = name
         self._state = state
+        #: seq of the most recently consumed tensor, derived from the
+        #: SHARED channel state at recv time (next_seq counts delivered-
+        #: to-queue; minus what is still queued = consumed) — the same
+        #: number the sender's ``send`` returned for that tensor, which
+        #: is what lets both ends tag one microbatch's trace spans with
+        #: one shared seq without any extra frames. Derived, not a
+        #: per-facade counter: a second facade over the same channel
+        #: state stays correct.
+        self._last_seq = -1
         reg = hub._registry
         self._wait_hist = reg.histogram(
             "tony_channel_recv_wait_seconds",
@@ -508,7 +517,16 @@ class ChannelReceiver:
         self._wait_hist.observe(time.perf_counter() - t0)
         with self._state.cv:
             self._depth_gauge.set(len(self._state.queue))
+            # consumed = delivered-to-queue minus still-queued; -1 for
+            # "seq of the one just popped"
+            self._last_seq = self._state.next_seq \
+                - len(self._state.queue) - 1
         return arr
+
+    @property
+    def last_seq(self) -> int:
+        """Seq of the most recently consumed tensor (-1 before any)."""
+        return self._last_seq
 
     def qsize(self) -> int:
         with self._state.cv:
@@ -668,10 +686,13 @@ class ChannelHub:
         while not self._stopping.is_set():
             try:
                 fr = recv_frame(sock, max_bytes=MAX_TENSOR_BYTES)
-            except ProtocolError:
+            except ProtocolError as e:
                 # truncated/garbage frame: channel-SCOPED — this
                 # connection dies, the hub keeps serving, the channel
-                # state is intact for the sender's resume
+                # state is intact for the sender's resume. The flight
+                # recorder dumps a postmortem scoped to THIS connection
+                # (healthy channels on the same hub dump nothing).
+                self._flight_incident(sock, str(e))
                 self._best_effort_error(sock, "malformed tensor frame")
                 return
             if fr is None:
@@ -691,7 +712,8 @@ class ChannelHub:
                 return
             try:
                 arr = decode_tensor(payload)
-            except ProtocolError:
+            except ProtocolError as e:
+                self._flight_incident(sock, str(e))
                 self._best_effort_error(sock, "undecodable tensor payload")
                 return
             if not state.put(arr):
@@ -701,6 +723,20 @@ class ChannelHub:
                 send_frame(sock, CH_ACK, seq)
             except OSError:
                 return
+
+    def _flight_incident(self, sock: socket.socket, error: str) -> None:
+        """Torn/garbage channel frame: record + dump the flight ring,
+        scoped to the offending connection (its peer address names it).
+        Best-effort by the recorder's own contract."""
+        from tony_tpu.runtime import tracing
+        try:
+            peer = str(sock.getpeername())
+        except OSError:
+            peer = "?"
+        flight = tracing.get_flight()
+        flight.record("channel_protocol_error", peer=peer,
+                      port=self.port, error=error[:500])
+        flight.dump("channel_protocol_error", peer=peer)
 
     @staticmethod
     def _best_effort_error(sock: socket.socket, message: str) -> None:

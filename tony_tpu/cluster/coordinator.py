@@ -46,6 +46,7 @@ from tony_tpu.conf.config import TonyConfig
 from tony_tpu.events import events as ev
 from tony_tpu.rpc.server import ApplicationRpcServer
 from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.runtime import tracing
 from tony_tpu.utils.docker import docker_wrap
 from tony_tpu.rpc.service import (ApplicationRpc, ApplicationStatus,
                                   HeartbeatAck, TaskUrl, WorkerSpecResponse)
@@ -113,8 +114,9 @@ class CoordinatorRpc(ApplicationRpc):
         self.co.client_signalled_finish.set()
         return self.co.final_status or "RUNNING"
 
-    def task_executor_heartbeat(self, task_id: str,
-                                metrics: str = "") -> HeartbeatAck:
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "",
+                                spans: str = "", client_time: float = 0.0,
+                                client_rtt: float = 0.0) -> HeartbeatAck:
         self.co.hb_monitor.ping(task_id)
         if metrics:
             # Telemetry rides the liveness channel but must never break
@@ -122,6 +124,10 @@ class CoordinatorRpc(ApplicationRpc):
             # the task's previous good one) instead of raising into the
             # RPC handler.
             self.co.metrics_table.ingest(task_id, metrics)
+        # Trace piggyback: clock-offset estimate + span batch. The same
+        # discipline — anything malformed is dropped inside, never
+        # raised into the handler; the ping above already counted.
+        self.co.on_trace_beat(task_id, spans, client_time, client_rtt)
         # The ack fans out BOTH slow-moving control values: the current
         # GCS token (renewal) and the cluster-spec epoch — an executor
         # seeing an epoch ahead of its own stops its user process and
@@ -258,6 +264,37 @@ class Coordinator:
         self._metrics_interval_s = conf.get_int(
             K.METRICS_SNAPSHOT_INTERVAL_KEY, 5000) / 1000.0
         self._metrics_last_emit = time.monotonic()
+        # Tracing plane: the coordinator's own tracer (bring-up spans,
+        # elastic incidents, the job root) plus the fold point for every
+        # executor's heartbeat-shipped span batches. Per-task clock
+        # offsets (heartbeat-RTT-midpoint estimates) are applied to span
+        # timestamps AT EXPORT, so the jhist trace is on the
+        # coordinator's clock.
+        try:
+            trace_sample = float(
+                conf.get(K.TRACE_SAMPLE_RATE_KEY) or "1.0")
+        except ValueError:
+            trace_sample = 1.0
+        self.tracer = tracing.configure(
+            proc=f"{constants.COORDINATOR_JOB_NAME}:0",
+            sample_rate=trace_sample,
+            ring_size=conf.get_int(K.TRACE_RING_KEY, 2048),
+            flight_dir=self.job_dir,
+            flight_ring=conf.get_int(K.FLIGHT_RING_KEY, 256))
+        self.job_span: tracing.Span | None = None
+        self._trace_lock = threading.Lock()
+        #: (task_id, [span wire dicts]) batches awaiting a TRACE_SPAN emit
+        self._trace_pending: list[tuple[str, list[dict]]] = []
+        self._trace_pending_spans = 0
+        #: task_id -> last ingested batch id (heartbeat-retry dedup)
+        self._trace_last_batch: dict[str, str] = {}
+        self.clock_offsets: dict[str, float] = {}
+        self.trace_rejects = 0
+        #: task_id -> last flight-recorder tail shipped on a beat; popped
+        #: into the task's incident TASK_FINISHED event
+        self._flight_tails: dict[str, dict] = {}
+        #: open elastic-recovery span (shrink -> barrier re-release)
+        self._elastic_span: tracing.Span | None = None
         # Launch fan-out (tony.launch.max-concurrent): schedule_tasks
         # dispatches backend launches on semaphore-bounded DAEMON threads
         # so an N-gang bring-up costs max-of-gangs wall, not sum. Daemon
@@ -346,10 +383,13 @@ class Coordinator:
 
     def _on_task_dead(self, task_id: str) -> None:
         """Missed-heartbeat expiry (reference: onTaskDeemedDead:1155-1165).
+        Recorded into the coordinator's flight ring either way — expiry
+        is exactly the kind of incident a postmortem wants sequenced.
         With elastic training on, a tracked task going silent is treated
         as its GANG being lost (a slice dies as a unit — the silent host
         took its co-hosts' ICI domain with it): the whole gang is killed
         and absorbed into the shrink path instead of failing the job."""
+        tracing.get_flight().record("missed_heartbeat", task=task_id)
         with self._completion_lock:
             absorb = self._elastic_can_absorb(task_id)
             if absorb:
@@ -499,6 +539,13 @@ class Coordinator:
                 "tony_elastic_recovery_seconds",
                 help="wall seconds from gang loss to the survivors' "
                      "barrier re-releasing (last transition)").set(wall)
+            if self._elastic_span is not None:
+                self._elastic_span.end(epoch=self.session.cluster_epoch,
+                                       active=active)
+                self._elastic_span = None
+            tracing.get_flight().record(
+                "elastic_resumed", epoch=self.session.cluster_epoch,
+                active=active, recovery_wall_s=round(wall, 3))
             self.events.emit(ev.ELASTIC_RESUMED,
                              epoch=self.session.cluster_epoch,
                              active=active,
@@ -610,6 +657,26 @@ class Coordinator:
                 self.record_completion(jt, idx, code, preempted=True)
             return
         self.elastic_budget_left -= 1
+        # The incident's postmortem artifact: the coordinator has the
+        # richest causal view of a gang loss (the victims were
+        # SIGKILLed and cannot dump their own rings) — its flight ring
+        # dumps to the job dir and the ELASTIC_SHRINK event references
+        # the file.
+        flight = tracing.get_flight()
+        flight.record("gang_lost", lost=",".join(sorted(lost)),
+                      survivors=len(survivors),
+                      budget_left=self.elastic_budget_left)
+        flight_dump = flight.dump("elastic_shrink",
+                                  lost=",".join(sorted(lost)))
+        if self._elastic_span is not None:
+            # a second loss landing before the first recovery's barrier
+            # re-released: close the open span (superseded) so it still
+            # reaches the exported trace — cascading preemptions are
+            # exactly when the postmortem matters
+            self._elastic_span.end(superseded=True)
+        self._elastic_span = self.tracer.start_span(
+            "elastic.recovery", parent=self.job_span, coarse=True,
+            lost=",".join(sorted(lost)))
         for tid, (code, _) in lost.items():
             self.backend.kill_task(tid)      # straggler processes
             self.hb_monitor.unregister(tid)
@@ -633,6 +700,7 @@ class Coordinator:
                   ).set(active)
         self.events.emit(ev.ELASTIC_SHRINK, epoch=epoch,
                          lost=sorted(lost), active=active,
+                         flight_dump=flight_dump or "",
                          session_id=self.session.session_id)
         self._elastic_resume_t0 = time.monotonic()
         self._elastic_awaiting_resume = True
@@ -835,6 +903,12 @@ class Coordinator:
         }
         if self.secret:
             env[constants.TONY_SECRET] = self.secret
+        if self.job_span is not None and self.job_span.recording:
+            # the job root trace context: executors parent their coarse
+            # spans on it, and pipeline stage gangs derive deterministic
+            # per-step trace ids from its trace id
+            env[constants.TONY_TRACE_CTX] = tracing.format_env_ctx(
+                self.job_span.context)
         gcs_token = os.environ.get(constants.TONY_GCS_TOKEN)
         if gcs_token:
             # the job's scoped GCS identity (tony.gcs.service-account),
@@ -974,10 +1048,22 @@ class Coordinator:
                         else:
                             self._session_real_failure = True
                     self.hb_monitor.unregister(task.task_id)
+                    extra = {}
+                    if task.exit_code != 0:
+                        # the incident's jhist event carries the
+                        # executor's final-beat flight tail (its last
+                        # recorded moments), when one arrived
+                        tracing.get_flight().record(
+                            "task_failed", task=task.task_id,
+                            code=task.exit_code, preempted=preempted)
+                        tail = self._pop_flight_tail(task.task_id)
+                        if tail is not None:
+                            extra["flight"] = tail
                     self.events.emit(ev.TASK_FINISHED, task=task.task_id,
                                      exit_code=task.exit_code,
                                      preempted=preempted,
-                                     session_id=self.session.session_id)
+                                     session_id=self.session.session_id,
+                                     **extra)
         # Launch OUTSIDE the completion lock: backend.launch_task can block
         # for seconds (old-process kill-and-wait, docker wrap, ssh), and
         # holding the lock would stall every other completion report.
@@ -1058,8 +1144,102 @@ class Coordinator:
                     help=f"wall seconds this gang's last {phase} took",
                     gang=str(rec.get("gang", ""))).set(
                         float(rec.get("seconds", 0.0)))
+                # bring-up spans under the job root trace: the timeline
+                # the job page renders becomes causal in the exported
+                # trace too (provision → stage → dispatch per gang)
+                try:
+                    self.tracer.record_span(
+                        f"launch.{phase}",
+                        float(rec.get("seconds", 0.0)),
+                        parent=self.job_span,
+                        gang=str(rec.get("gang", "")),
+                        task=str(rec.get("task", "") or ""),
+                        cached=bool(rec.get("cached")))
+                except (TypeError, ValueError):
+                    pass          # a malformed record already renders raw
             self.events.emit(ev.LAUNCH,
                              session_id=self.session.session_id, **rec)
+
+    #: pending-span bound across tasks; past it the OLDEST batches drop
+    #: (the monitor loop normally drains well below this)
+    _TRACE_PENDING_CAP = 20000
+
+    def on_trace_beat(self, task_id: str, spans: str,
+                      client_time: float, client_rtt: float) -> None:
+        """Heartbeat trace piggyback (RPC handler threads): estimate the
+        task's clock offset from the beat's send-time + RTT, and queue
+        its span batch for the next TRACE_SPAN jhist emit. Malformed
+        batches are dropped without costing the ping (the metrics-ingest
+        discipline)."""
+        if client_time > 0:
+            offset = tracing.clock_offset(client_time, client_rtt)
+            self.clock_offsets[task_id] = offset
+            metrics_mod.get_default().gauge(
+                "tony_clock_offset_seconds",
+                help="estimated task clock offset vs the coordinator "
+                     "(heartbeat RTT midpoint; add to task timestamps "
+                     "to express them on the coordinator's clock)",
+                task=task_id).set(offset)
+        if not spans:
+            return
+        try:
+            batch = tracing.parse_batch_json(spans)
+        except (ValueError, TypeError):
+            with self._trace_lock:
+                self.trace_rejects += 1
+            metrics_mod.get_default().counter(
+                "tony_trace_batches_rejected_total",
+                help="malformed heartbeat span batches dropped").inc()
+            log.warning("dropping malformed span batch from %s", task_id,
+                        exc_info=True)
+            return
+        tail = batch.get("f")
+        with self._trace_lock:
+            # retry re-delivery guard: a lost heartbeat ACK makes the
+            # sender retry the SAME request; the batch id spots the
+            # duplicate (batches append here, so it would double every
+            # span — the last-snapshot metrics table is naturally
+            # idempotent, this path is not)
+            bid = batch.get("b", "")
+            if bid and self._trace_last_batch.get(task_id) == bid:
+                return
+            if bid:
+                self._trace_last_batch[task_id] = bid
+            if batch.get("s"):
+                self._trace_pending.append((task_id, batch["s"]))
+                self._trace_pending_spans += len(batch["s"])
+                while self._trace_pending_spans > self._TRACE_PENDING_CAP \
+                        and self._trace_pending:
+                    _, dropped = self._trace_pending.pop(0)
+                    self._trace_pending_spans -= len(dropped)
+            if tail:
+                self._flight_tails[task_id] = tail
+
+    def _pop_flight_tail(self, task_id: str) -> dict | None:
+        """The task's last heartbeat-shipped flight tail, if any —
+        attached to its incident TASK_FINISHED event (callers hold
+        whatever locks they like; the dict op is atomic enough)."""
+        return self._flight_tails.pop(task_id, None)
+
+    def _emit_trace_events(self) -> None:
+        """Fold pending span batches into TRACE_SPAN jhist events, one
+        per (task, batch), with the task's clock-offset estimate applied
+        to every span timestamp — so the exported trace is on the
+        coordinator's clock and cross-process spans line up. The
+        coordinator's own spans ride as pseudo-task am:0 (offset 0)."""
+        own = self.tracer.drain()
+        with self._trace_lock:
+            pending, self._trace_pending = self._trace_pending, []
+            self._trace_pending_spans = 0
+        if own:
+            pending.append((f"{constants.COORDINATOR_JOB_NAME}:0", own))
+        for task_id, spans in pending:
+            offset = self.clock_offsets.get(task_id, 0.0)
+            self.events.emit(
+                ev.TRACE_SPAN, task=task_id,
+                spans=tracing.apply_offset(spans, offset),
+                offset_s=round(offset, 6),
+                session_id=self.session.session_id)
 
     def _maybe_emit_metrics(self, force: bool = False) -> None:
         """Fold the per-task snapshot table (plus the coordinator's own
@@ -1075,6 +1255,8 @@ class Coordinator:
                           < self._metrics_interval_s):
             return
         self._metrics_last_emit = now
+        # trace spans share the snapshot cadence (batched, not per-beat)
+        self._emit_trace_events()
         payload = self.metrics_table.as_payload()
         metrics_mod.sample_host_stats()
         own = metrics_mod.get_default().to_wire()
@@ -1229,6 +1411,12 @@ class Coordinator:
     # ------------------------------------------------------------------
     def run(self, user_command: str) -> int:
         self.events.start()
+        # The job root span: every process's coarse spans (bring-up,
+        # executor lifecycle, incidents) parent onto it via the
+        # TONY_TRACE_CTX env exported into each launch.
+        self.job_span = self.tracer.start_span(
+            "job", coarse=True, app_id=self.app_id,
+            num_tasks=self.session.total_tasks())
         # Frozen per-job config next to the jhist so the history server's
         # /config page can render it (reference: TonyApplicationMaster
         # setupJobDir + writeConfigFile :458-463).
@@ -1445,6 +1633,17 @@ class Coordinator:
         # loop) still get their LAUNCH events and at least one
         # METRICS_SNAPSHOT for the history replay.
         self._drain_launch_timings()
+        # close the job root span (so the exported trace brackets the
+        # whole job) and, on a non-success, dump the coordinator's
+        # flight ring — the job-level postmortem artifact
+        if self.job_span is not None:
+            self.job_span.end(status=self.final_status)
+        if status is not SessionStatus.SUCCEEDED:
+            tracing.get_flight().record(
+                "job_finished", status=self.final_status,
+                message=(self.failure_message or "")[:500])
+            tracing.get_flight().dump(
+                f"job_{(self.final_status or 'failed').lower()}")
         self._maybe_emit_metrics(force=True)
         self.events.emit(
             ev.APPLICATION_FINISHED, app_id=self.app_id,

@@ -37,6 +37,7 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
 from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.runtime import tracing
 
 log = logging.getLogger("tony_tpu.executor")
 
@@ -72,7 +73,7 @@ class Heartbeater(threading.Thread):
 
     def __init__(self, rpc: ApplicationRpcClient, task_id: str,
                  interval_s: float, gcs_token_file: str | None = None,
-                 snapshot_fn=None, on_epoch=None) -> None:
+                 snapshot_fn=None, on_epoch=None, spans_fn=None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
@@ -81,6 +82,22 @@ class Heartbeater(threading.Thread):
         #: (None = old-style liveness-only heartbeats). A provider error
         #: must never cost a ping — collection is wrapped below.
         self.snapshot_fn = snapshot_fn
+        #: () -> compact JSON trace-span batch (tracing.encode_batch) —
+        #: the executor's own spans plus the user process's spool tail.
+        #: Same contract as snapshot_fn: errors never cost a ping.
+        self.spans_fn = spans_fn
+        #: last measured beat RTT — shipped on the NEXT beat as the
+        #: coordinator's clock-offset half-trip estimate
+        self.last_rtt = 0.0
+        # Old-impl compatibility (tests with pre-trace fakes): only pass
+        # the trace piggyback when the RPC surface accepts it — the same
+        # inspect precedent as the server-side handler.
+        try:
+            import inspect
+            self._rpc_takes_trace = "spans" in inspect.signature(
+                rpc.task_executor_heartbeat).parameters
+        except (TypeError, ValueError):
+            self._rpc_takes_trace = True
         #: epoch observer (elastic resync): called with the coordinator's
         #: cluster epoch from every ack; the executor compares it to the
         #: epoch its user process was launched under and resyncs on a
@@ -121,6 +138,16 @@ class Heartbeater(threading.Thread):
                         "plain heartbeat", exc_info=True)
             return ""
 
+    def _spans(self) -> str:
+        if self.spans_fn is None:
+            return ""
+        try:
+            return self.spans_fn() or ""
+        except Exception:
+            log.warning("trace span collection failed; sending span-less "
+                        "heartbeat", exc_info=True)
+            return ""
+
     def run(self) -> None:
         while not self.stop_event.wait(self.interval_s):
             if self.skip_remaining > 0:
@@ -129,8 +156,25 @@ class Heartbeater(threading.Thread):
                          self.skip_remaining)
                 continue
             try:
-                ack = self.rpc.task_executor_heartbeat(self.task_id,
-                                                       self._snapshot())
+                # collect the piggybacks BEFORE the clock starts: the
+                # RTT shipped on the next beat must measure the RPC, not
+                # snapshot assembly
+                snapshot = self._snapshot()
+                spans = self._spans() if self._rpc_takes_trace else ""
+                t0 = time.perf_counter()
+                if self._rpc_takes_trace:
+                    ack = self.rpc.task_executor_heartbeat(
+                        self.task_id, snapshot, spans=spans,
+                        client_rtt=self.last_rtt)
+                else:
+                    ack = self.rpc.task_executor_heartbeat(self.task_id,
+                                                           snapshot)
+                measured = time.perf_counter() - t0
+                # an implausibly large "RTT" spanned the client's
+                # internal retries (deadline + backoff), not one round
+                # trip — shipping it would skew the midpoint estimate;
+                # 0 means "no estimate this beat"
+                self.last_rtt = measured if measured < 5.0 else 0.0
                 self._failures = 0
                 self._republish_token(ack.gcs_token)
                 if self.on_epoch is not None:
@@ -174,6 +218,37 @@ class TaskExecutor:
                               if self.job_name == constants.NOTEBOOK_JOB_NAME
                               else 0)
         self.rpc = ApplicationRpcClient.get_instance(am_address)
+        # Tracing + flight recorder: the executor's tracer holds ITS
+        # spans (lifecycle, incidents); the user process mirrors its own
+        # spans to the SPOOL file, which the heartbeater tails onto each
+        # beat — the bridge from the fork-exec'd child to the
+        # coordinator (metrics stay process-local; spans must not).
+        try:
+            self._trace_sample = float(
+                conf.get(K.TRACE_SAMPLE_RATE_KEY) or "1.0")
+        except ValueError:
+            self._trace_sample = 1.0
+        self._trace_ring = conf.get_int(K.TRACE_RING_KEY, 2048)
+        self._flight_ring = conf.get_int(K.FLIGHT_RING_KEY, 256)
+        self.trace_spool = os.path.join(
+            os.getcwd(), f".trace-{self.job_name}-{self.task_index}.jsonl")
+        try:
+            # a previous executor GENERATION's spool (in-session restart
+            # into the same working dir) must not re-ship its spans as
+            # duplicates through this generation's fresh reader
+            os.unlink(self.trace_spool)
+        except OSError:
+            pass
+        tracing.configure(proc=f"{self.task_id}/executor",
+                          sample_rate=self._trace_sample,
+                          ring_size=self._trace_ring,
+                          flight_dir=os.getcwd(),
+                          flight_ring=self._flight_ring)
+        self._spool_reader = tracing.SpoolReader(self.trace_spool)
+        #: one-shot incident tail attached to the FINAL beat after an
+        #: abnormal child exit, so the coordinator can hang it on the
+        #: incident's jhist event even when nobody can read this host
+        self._flight_tail: dict | None = None
         self.hb_interval_s = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000) / 1000.0
         self.registration_timeout_s = conf.get_int(
             K.TASK_REGISTRATION_TIMEOUT_KEY, 300000) / 1000.0
@@ -253,6 +328,24 @@ class TaskExecutor:
                   help="seconds since this executor started").set(
                       time.monotonic() - self._started_at)
         return reg.to_wire_json()
+
+    def trace_batch(self) -> str:
+        """Span batch for the heartbeat piggyback: the executor's own
+        pending spans, the user process's spool tail, and — on the final
+        beat after an incident — the one-shot flight-recorder tail.
+        Returns "" when there is nothing to ship (the common idle beat:
+        no bytes on the wire)."""
+        tracer = tracing.get_tracer()
+        spans = tracer.drain(tracing.MAX_SPANS_PER_BATCH)
+        spans.extend(self._spool_reader.read_new(
+            tracing.MAX_SPANS_PER_BATCH))
+        # keep the spool FILE bounded: truncate once fully consumed,
+        # skip a runaway backlog (the writer appends forever otherwise)
+        self._spool_reader.maybe_rotate()
+        tail, self._flight_tail = self._flight_tail, None
+        if not spans and not tail:
+            return ""
+        return tracing.encode_batch(spans, flight=tail)
 
     # ------------------------------------------------------------------
     def register_and_get_cluster_spec(self) -> dict:
@@ -354,6 +447,17 @@ class TaskExecutor:
                 sid = orig
             env[constants.SLICE_ID] = str(sid)
             env[constants.NUM_SLICES] = str(mine["slices"])
+        # Tracing plumbing for the user process: spans recorded there
+        # mirror to the spool file (the heartbeater tails it onto beats);
+        # the flight recorder dumps land in the job dir. TONY_TRACE_CTX
+        # (the job root trace) is inherited from this executor's own
+        # launch environment untouched.
+        env[constants.TONY_TRACE_SPOOL] = self.trace_spool
+        env[constants.TONY_TRACE_PROC] = self.task_id
+        env[constants.TONY_TRACE_SAMPLE_RATE] = str(self._trace_sample)
+        env[constants.TONY_TRACE_RING] = str(self._trace_ring)
+        env[constants.TONY_FLIGHT_DIR] = os.getcwd()
+        env[constants.TONY_FLIGHT_RING] = str(self._flight_ring)
         if self.conf.get_bool(K.TASK_PROFILE_ENABLED_KEY, False):
             env[constants.TONY_PROFILE_ENABLED] = "true"
             profile_dir = self.conf.get(K.TASK_PROFILE_DIR_KEY) or ""
@@ -542,7 +646,8 @@ class TaskExecutor:
         heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s,
                                   gcs_token_file=token_file,
                                   snapshot_fn=self.metrics_snapshot,
-                                  on_epoch=self._on_cluster_epoch)
+                                  on_epoch=self._on_cluster_epoch,
+                                  spans_fn=self.trace_batch)
         heartbeater.start()
         if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
             try:
@@ -581,8 +686,20 @@ class TaskExecutor:
         # completed checkpoint and resumes. The EXECUTOR never exits for a
         # resync, so the slice keeps its staged state and the coordinator
         # keeps its liveness view.
+        flight = tracing.get_flight()
+        tracer = tracing.get_tracer()
+        job_ctx = tracing.parse_env_ctx()
         while True:
+            # lifecycle span per user-process GENERATION (elastic
+            # resyncs relaunch): coarse, parented on the job root trace
+            gen_span = tracer.start_span(
+                "executor.user_process", ctx=job_ctx, coarse=True,
+                task=self.task_id,
+                epoch=self.bootstrap.get("cluster_epoch", 0))
             exit_code = self.run_user_process(user_env())
+            gen_span.end(exit_code=exit_code)
+            flight.record("child_exit", task=self.task_id, code=exit_code,
+                          epoch=self.bootstrap.get("cluster_epoch", 0))
             if exit_code == constants.EXIT_GANG_LOST \
                     and not self._resync.is_set():
                 # The trainer observed its gang die (collective failure)
@@ -601,6 +718,9 @@ class TaskExecutor:
             if not self._resync.is_set():
                 break
             self._resync.clear()
+            flight.record("elastic_resync", task=self.task_id,
+                          exit_code=exit_code,
+                          target_epoch=self._resync_target)
             log.info("elastic resync: user process stopped (exit %d) — "
                      "re-running the registration handshake", exit_code)
             self.register_and_get_cluster_spec()
@@ -618,6 +738,15 @@ class TaskExecutor:
             "tony_executor_child_exits_total",
             help="user-process exits by code",
             code=str(exit_code)).inc()
+        if exit_code != 0:
+            # Abnormal exit: dump the flight ring to the job dir (the
+            # postmortem artifact) and stage the tail for the final beat
+            # so the coordinator can attach it to the incident's
+            # TASK_FINISHED event.
+            dump_path = flight.dump(f"child_exit:{exit_code}",
+                                    task=self.task_id, code=exit_code)
+            self._flight_tail = flight.ship_tail(
+                f"child_exit:{exit_code}", dump_path=dump_path)
         self.apply_chaos_after_training()
         heartbeater.stop_event.set()
         # Join before the final beat: an in-flight periodic beat (whose
@@ -625,15 +754,34 @@ class TaskExecutor:
         # final one would overwrite it in the coordinator's last-
         # snapshot table. Bounded wait — the beat's own RPC deadline.
         heartbeater.join(timeout=15)
-        try:
-            # One explicit final beat so the exit-code counter (and the
-            # last host stats) reach the coordinator even though the
-            # periodic heartbeater is stopping — best-effort, like the
-            # result report below.
-            self.rpc.task_executor_heartbeat(self.task_id,
-                                             self.metrics_snapshot())
-        except Exception:
-            log.debug("final metrics heartbeat failed", exc_info=True)
+        # One explicit final beat so the exit-code counter, the last
+        # host stats, the remaining spans AND the incident flight tail
+        # reach the coordinator even though the periodic heartbeater is
+        # stopping — best-effort, like the result report below. The
+        # span batch is drained ONCE and resent verbatim on the second
+        # attempt (the coordinator's batch-id dedup makes a double
+        # delivery safe; rebuilding would lose the popped flight tail
+        # to the first failure — the exact artifact this beat exists to
+        # ship). Same back-compat guard as the periodic path: a
+        # pre-trace RPC surface gets the metrics-only call instead of a
+        # TypeError that would silently lose the beat.
+        final_spans = self.trace_batch() if heartbeater._rpc_takes_trace \
+            else ""
+        for attempt in range(2):
+            try:
+                if heartbeater._rpc_takes_trace:
+                    self.rpc.task_executor_heartbeat(
+                        self.task_id, self.metrics_snapshot(),
+                        spans=final_spans,
+                        client_rtt=heartbeater.last_rtt)
+                else:
+                    self.rpc.task_executor_heartbeat(
+                        self.task_id, self.metrics_snapshot())
+                break
+            except Exception:
+                log.debug("final metrics heartbeat failed (attempt %d)",
+                          attempt + 1, exc_info=True)
+                time.sleep(0.5)
         try:
             self.rpc.register_execution_result(
                 exit_code, self.job_name, str(self.task_index), self.session_id)

@@ -126,6 +126,24 @@ PIPELINE_STAGES_KEY = "tony.pipeline.stages"
 METRICS_SNAPSHOT_INTERVAL_KEY = "tony.metrics.snapshot-interval-ms"
 
 # ---------------------------------------------------------------------------
+# Distributed tracing ("tony.trace.*") + crash flight recorder: producers
+# record causal spans into a per-process ring (runtime/tracing.py), span
+# batches piggyback on heartbeats, the coordinator folds them into
+# TRACE_SPAN jhist events (clock-offset-corrected), and the history
+# server exports GET /api/jobs/<id>/trace as Chrome-trace JSON.
+# ---------------------------------------------------------------------------
+# Head-sampling rate for fine-grained trace roots (per-request,
+# per-step): 0 disables them, 1.0 records everything. Coarse spans (job
+# lifecycle, bring-up, incidents) are always-on regardless.
+TRACE_SAMPLE_RATE_KEY = "tony.trace.sample-rate"
+# Bounded per-process span storage: both the pending-ship buffer and the
+# recent-spans ring the flight recorder dumps.
+TRACE_RING_KEY = "tony.trace.ring-size"
+# Events kept in each process's flight-recorder ring (the postmortem
+# dump's depth).
+FLIGHT_RING_KEY = "tony.flight-recorder.ring-size"
+
+# ---------------------------------------------------------------------------
 # Chief designation (TonyConfigurationKeys: chief name/index)
 # ---------------------------------------------------------------------------
 CHIEF_REGEX_KEY = "tony.application.chief.name"
@@ -251,6 +269,9 @@ DEFAULTS: dict[str, str] = {
     ELASTIC_QUIESCE_KEY: "300",
     PIPELINE_STAGES_KEY: "",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
+    TRACE_SAMPLE_RATE_KEY: "1.0",
+    TRACE_RING_KEY: "2048",
+    FLIGHT_RING_KEY: "256",
     CHIEF_REGEX_KEY: "^(chief|master)$",
     CHIEF_INDEX_KEY: "0",
     HISTORY_LOCATION_KEY: "",
@@ -298,7 +319,8 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 # Keys that never denote a job type even though they match the shape.
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
-                                "launch", "elastic", "metrics", "pipeline"})
+                                "launch", "elastic", "metrics", "pipeline",
+                                "trace"})
 
 
 def instances_key(job_type: str) -> str:

@@ -65,6 +65,21 @@ TONY_TLS_KEY_FILE = ".tony-tls.key"
 TONY_PROFILE_ENABLED = "TONY_PROFILE_ENABLED"
 TONY_PROFILE_DIR = "TONY_PROFILE_DIR"
 
+# Distributed tracing + flight recorder (tony.trace.* / the flight
+# recorder → executor/coordinator env → runtime/tracing.py). The SPOOL
+# file is the bridge from the fork-exec'd user process to the
+# coordinator: the user process's tracer mirrors finished spans to it,
+# the executor tails it onto heartbeats. CTX is the job root trace
+# context ("tid:sid") so every process's coarse spans hang off one job
+# trace; PROC labels the process in exported traces.
+TONY_TRACE_SPOOL = "TONY_TRACE_SPOOL"
+TONY_TRACE_PROC = "TONY_TRACE_PROC"
+TONY_TRACE_CTX = "TONY_TRACE_CTX"
+TONY_TRACE_SAMPLE_RATE = "TONY_TRACE_SAMPLE_RATE"
+TONY_TRACE_RING = "TONY_TRACE_RING"
+TONY_FLIGHT_DIR = "TONY_FLIGHT_DIR"
+TONY_FLIGHT_RING = "TONY_FLIGHT_RING"
+
 # Pseudo job-name under which the coordinator surfaces the tracking
 # (TensorBoard / notebook) URL in get_task_urls — the analog of the YARN
 # application tracking URL the reference sets reflectively

@@ -409,6 +409,30 @@ class HistoryServer:
             "tasks": (latest.payload.get("tasks", {}) if latest else {}),
         }
 
+    def job_trace(self, app_id: str) -> dict | None:
+        """Chrome Trace Event JSON (Perfetto / chrome://tracing
+        loadable) reconstructed purely from the job's TRACE_SPAN jhist
+        events — per-task clock offsets were already applied by the
+        coordinator at export, so cross-process spans line up on one
+        timeline. Works identically for running and finished jobs."""
+        from tony_tpu.runtime import tracing
+        events = self.job_events(app_id)
+        if events is None:
+            return None
+        spans: list[dict] = []
+        for e in events:
+            if e.event_type != ev.TRACE_SPAN:
+                continue
+            batch = e.payload.get("spans", [])
+            if not isinstance(batch, list):
+                continue
+            for s in batch:
+                try:
+                    spans.append(tracing.validate_span(s))
+                except (ValueError, TypeError):
+                    continue    # one bad span must not 404 the trace
+        return tracing.to_chrome(spans)
+
     def render_prometheus(self) -> str:
         """Prometheus text exposition of LIVE series: every running job's
         latest coordinator-aggregated METRICS_SNAPSHOT (read from the
@@ -471,12 +495,13 @@ class HistoryServer:
         events = self.job_events(app_id)
         if events is None:
             return None
-        # METRICS_SNAPSHOT and per-phase LAUNCH events render as their own
-        # sections below — inlining each multi-task wire blob / per-gang
-        # timing record into the timeline would bury the lifecycle events
-        # it exists to show.
+        # METRICS_SNAPSHOT / LAUNCH events render as their own sections
+        # below, and TRACE_SPAN batches export through the trace link —
+        # inlining each multi-task wire blob / span batch into the
+        # timeline would bury the lifecycle events it exists to show.
         timeline = [e for e in events
-                    if e.event_type not in (ev.METRICS_SNAPSHOT, ev.LAUNCH)]
+                    if e.event_type not in (ev.METRICS_SNAPSHOT, ev.LAUNCH,
+                                            ev.TRACE_SPAN)]
         rows = "".join(
             f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
             f"<td>{html.escape(e.event_type)}</td>"
@@ -485,6 +510,13 @@ class HistoryServer:
         body = ("<table><tr><th>Time (UTC)</th><th>Event</th><th>Payload</th>"
                 "</tr>" + rows + "</table>") if timeline \
             else "<p>No events.</p>"
+        if any(e.event_type == ev.TRACE_SPAN for e in events):
+            n_spans = sum(len(e.payload.get("spans", []))
+                          for e in events
+                          if e.event_type == ev.TRACE_SPAN)
+            body += (f"<p><a href='/api/jobs/{html.escape(app_id)}/trace'>"
+                     f"Trace ({n_spans} spans, Chrome/Perfetto JSON)"
+                     f"</a></p>")
         body += self._render_startup_section(events)
         body += self._render_metrics_section(events)
         return _PAGE.format(title=f"Events — {html.escape(app_id)}", body=body)
@@ -636,6 +668,11 @@ class HistoryServer:
                     app_id = path[len("/api/jobs/"):-len("/metrics")]
                     m = server.job_metrics(app_id)
                     self._not_found() if m is None else self._json(m)
+                elif path.startswith("/api/jobs/") and \
+                        path.endswith("/trace"):
+                    app_id = path[len("/api/jobs/"):-len("/trace")]
+                    t = server.job_trace(app_id)
+                    self._not_found() if t is None else self._json(t)
                 elif path.startswith("/api/jobs/") and \
                         path.endswith("/events"):
                     app_id = path[len("/api/jobs/"):-len("/events")]

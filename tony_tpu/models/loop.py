@@ -31,6 +31,7 @@ from typing import Any, Callable, Iterable
 
 from tony_tpu import constants
 from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.runtime import tracing
 
 log = logging.getLogger(__name__)
 
@@ -121,43 +122,62 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
         buckets=DATA_WAIT_BUCKETS_S)
     metrics: dict = {}
     last_eval = None
+    tracer = tracing.get_tracer()
+    flight = tracing.get_flight()
     try:
         for step in range(start_step, steps):
             if step_hook is not None:
                 step_hook(step)
-            t0 = time.perf_counter()
-            try:
-                batch = next(it)
-            except StopIteration:
-                log.warning("data exhausted at step %d (wanted %d); "
-                            "stopping early", step, steps)
-                break
-            wait_hist.observe(time.perf_counter() - t0)
-            try:
-                state, metrics = step_fn(state, batch)
-            except Exception as e:
-                if _looks_like_gang_loss(e):
-                    # the GANG failed, not the user's step: surface the
-                    # distinguished error so elastic executors relaunch
-                    # instead of charging a user failure (the finally
-                    # below still flushes in-flight checkpoint saves —
-                    # the checkpoint-sync step of a degraded resume)
-                    log.warning("step %d failed with a collective/"
-                                "distributed-runtime error — gang lost: %s",
-                                step, e)
-                    raise GangLostError(str(e)) from e
-                raise
-            if checkpoint is not None:
-                checkpoint.save(step + 1, state)
-            if (eval_fn is not None and eval_every > 0
-                    and (step + 1) % eval_every == 0):
-                last_eval = eval_fn(state)
-            if last_eval is not None:
-                metrics = dict(metrics)
-                metrics["eval"] = last_eval
-            if log_fn is not None and (step % max(1, log_every) == 0
-                                       or step == steps - 1):
-                log_fn(step, metrics, batch)
+            # Per-step trace (head-sampled via tony.trace.sample-rate):
+            # the step root with its phases as children — the causal
+            # view behind the tony_data_wait/step-wall aggregates.
+            with tracer.span("train.step", step=step) as step_span:
+                t0 = time.perf_counter()
+                try:
+                    batch = next(it)
+                except StopIteration:
+                    log.warning("data exhausted at step %d (wanted %d); "
+                                "stopping early", step, steps)
+                    break
+                wait = time.perf_counter() - t0
+                wait_hist.observe(wait)
+                tracer.record_span("train.data_wait", wait,
+                                   parent=step_span)
+                try:
+                    with tracer.span("train.dispatch"):
+                        state, metrics = step_fn(state, batch)
+                except Exception as e:
+                    if _looks_like_gang_loss(e):
+                        # the GANG failed, not the user's step: surface
+                        # the distinguished error so elastic executors
+                        # relaunch instead of charging a user failure
+                        # (the finally below still flushes in-flight
+                        # checkpoint saves — the checkpoint-sync step of
+                        # a degraded resume). The flight ring dumps
+                        # first: the step-level postmortem of WHAT died
+                        # mid-collective survives the process.
+                        log.warning(
+                            "step %d failed with a collective/"
+                            "distributed-runtime error — gang lost: %s",
+                            step, e)
+                        flight.record("gang_lost", step=step,
+                                      error=str(e)[:500])
+                        flight.dump("gang_lost", step=step)
+                        raise GangLostError(str(e)) from e
+                    raise
+                if checkpoint is not None:
+                    with tracer.span("train.checkpoint"):
+                        checkpoint.save(step + 1, state)
+                if (eval_fn is not None and eval_every > 0
+                        and (step + 1) % eval_every == 0):
+                    with tracer.span("train.eval"):
+                        last_eval = eval_fn(state)
+                if last_eval is not None:
+                    metrics = dict(metrics)
+                    metrics["eval"] = last_eval
+                if log_fn is not None and (step % max(1, log_every) == 0
+                                           or step == steps - 1):
+                    log_fn(step, metrics, batch)
     finally:
         close = getattr(data, "close", None)
         if close is not None:

@@ -125,6 +125,7 @@ from tony_tpu.models.decode import (_check_draft_vocab, _check_no_ring,
                                     init_kv_cache, place_rows, prefill,
                                     prefill_rows)
 from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.runtime import tracing
 from tony_tpu.runtime.profiler import PhaseTimes
 
 #: Trace-time program counters keyed by (program name, static shape):
@@ -1019,7 +1020,8 @@ class _EngineRequest:
     streams exactly); ``budget`` counts REMAINING tokens."""
 
     __slots__ = ("rid", "prompt", "budget", "stream", "emitted", "done",
-                 "reason", "t_submit", "t_last")
+                 "reason", "t_submit", "t_last", "span", "queued_span",
+                 "first_span")
 
     def __init__(self, rid, prompt, budget: int, stream: int,
                  t_submit: float) -> None:
@@ -1032,6 +1034,13 @@ class _EngineRequest:
         self.reason: str | None = None
         self.t_submit = t_submit
         self.t_last = t_submit
+        # TTFT-decomposition spans (tracing.NOOP_SPAN when unsampled):
+        # engine.request (submit→retire) with children engine.queued
+        # (submit→slot admit) and engine.first_token (admit→first
+        # consumed delta)
+        self.span = tracing.NOOP_SPAN
+        self.queued_span = tracing.NOOP_SPAN
+        self.first_span = tracing.NOOP_SPAN
 
 
 class ServeEngine:
@@ -1138,12 +1147,18 @@ class ServeEngine:
 
     # --- thread-safe control surface ---
 
-    def submit(self, rid, prompt, max_new_tokens: int) -> None:
+    def submit(self, rid, prompt, max_new_tokens: int,
+               trace_ctx: dict | None = None) -> None:
         """Enqueue a request under caller-chosen id ``rid`` (any
         hashable; must not collide with a LIVE request's). Raises
         ``ValueError`` for un-servable requests (validated up front, so
         a bad request never strands engine state) and ``RuntimeError``
-        once draining/stopped."""
+        once draining/stopped.
+
+        ``trace_ctx`` is the submitter's span context (``{"tid", "sid"}``
+        off the ADMIT frame): the request's engine-side spans — the TTFT
+        decomposition — join that trace; without one the engine
+        head-samples a fresh trace per ``tony.trace.sample-rate``."""
         prompt = [int(t) for t in prompt]
         max_new_tokens = int(max_new_tokens)
         self.b._validate_request(prompt, max_new_tokens)
@@ -1155,6 +1170,12 @@ class ServeEngine:
                 raise ValueError(f"request id {rid!r} is already active")
             req = _EngineRequest(rid, prompt, max_new_tokens,
                                  self._next_stream, time.perf_counter())
+            tr = tracing.get_tracer()
+            req.span = tr.start_span("engine.request", ctx=trace_ctx,
+                                     prompt_tokens=len(prompt),
+                                     budget=max_new_tokens)
+            req.queued_span = tr.start_span("engine.queued",
+                                            parent=req.span)
             self._next_stream += 1
             self._reqs[rid] = req
             self._wait.append(rid)
@@ -1179,6 +1200,9 @@ class ServeEngine:
             self._qdepth_g.set(len(self._wait))
             self._work.notify_all()
         self._cancelled_c.inc()
+        req.queued_span.end()
+        req.first_span.end()
+        req.span.end(reason="cancelled", tokens=req.emitted)
         self._emit_retired(req)
 
     def drain(self) -> None:
@@ -1249,6 +1273,9 @@ class ServeEngine:
             self._occupant = [None] * self.b.batch
             self._qdepth_g.set(0)
         for req in doomed:
+            req.queued_span.end()
+            req.first_span.end()
+            req.span.end(reason=reason, tokens=req.emitted)
             self._emit_retired(req)
 
     def _wait_for_work(self) -> bool:
@@ -1291,6 +1318,14 @@ class ServeEngine:
             if admitted:
                 self._qdepth_g.set(len(self._wait))
         if admitted:
+            tr = tracing.get_tracer()
+            for req in admitted:
+                req.queued_span.end()
+                if req.span.recording:
+                    # admit → first consumed delta: the prefill+decode
+                    # share of TTFT, next to engine.queued's queue share
+                    req.first_span = tr.start_span("engine.first_token",
+                                                   parent=req.span)
             self.b._admit_batch(pairs, prompts)
             self._admitted_c.inc(len(admitted))
 
@@ -1337,6 +1372,7 @@ class ServeEngine:
             appended += len(new)
             if req.emitted == len(new):      # this is the first delta
                 self._ttft_h.observe(now - req.t_submit)
+                req.first_span.end()
             else:
                 self._itl_h.observe((now - req.t_last) / len(new))
             req.t_last = now
@@ -1353,6 +1389,8 @@ class ServeEngine:
         if retired:
             self._retired_c.inc(len(retired))
             for req in retired:
+                req.first_span.end()     # eos on the very first delta
+                req.span.end(reason=req.reason, tokens=req.emitted)
                 self._emit_retired(req, finals.get(id(req), ()))
 
     def _settle(self) -> None:

@@ -602,6 +602,26 @@ class CrossSlicePipeline:
                  recv_timeout_s: float | None = 120.0,
                  registry=None) -> None:
         from tony_tpu.runtime import metrics as metrics_mod
+        from tony_tpu.runtime import tracing
+        self._tracing = tracing
+        self._tracer = tracing.get_tracer()
+        # Deterministic per-step trace ids: every stage gang derives the
+        # SAME 128-bit id from the job trace (TONY_TRACE_CTX) + its own
+        # call ordinal — one microbatch's journey across gangs
+        # reconstructs under one trace id with NO new channel frames
+        # (the channel seq tags the hops). The session id + cluster
+        # epoch join the seed so a RELAUNCHED trainer (stop-the-world
+        # retry resuming from checkpoint, ordinal back at 1) can never
+        # re-mint a previous generation's ids — every value here is
+        # identical across the stage gangs of one generation.
+        import os as _os
+        from tony_tpu import constants as _c
+        _job_ctx = tracing.parse_env_ctx()
+        self._trace_seed = (
+            (_job_ctx["tid"] if _job_ctx else "local-pipeline")
+            + f":{_os.environ.get(_c.SESSION_ID, '0')}"
+            + f":{_os.environ.get(_c.CLUSTER_EPOCH, '0')}")
+        self._calls = 0
         self.links = links
         self.stage = links.stage
         self.num_stages = links.num_stages
@@ -691,6 +711,16 @@ class CrossSlicePipeline:
         if links.is_last and (head_batches is None or head_params is None):
             raise ValueError("the last stage must supply head_params and "
                              "head_batches")
+        self._calls += 1
+        step_tid = self._tracing.deterministic_trace_id(
+            f"{self._trace_seed}:step:{self._calls}")
+        root_sid = self._tracing.deterministic_span_id(
+            f"{step_tid}:root")
+        stage_sid = self._tracing.deterministic_span_id(
+            f"{step_tid}:s{self.stage}")
+        traced = (self._tracer.enabled
+                  and self._tracing.deterministic_sample(
+                      step_tid, self._tracer.sample_rate))
         t_start = time.perf_counter()
         busy = 0.0
         saved: dict[int, jax.Array] = {}
@@ -701,26 +731,50 @@ class CrossSlicePipeline:
         dx_list: list[jax.Array] = []
 
         def _send(sender, arr):
-            sender.send(np.asarray(arr), sync=self.sync_transport,
-                        timeout=self.send_timeout_s)
+            return sender.send(np.asarray(arr), sync=self.sync_transport,
+                               timeout=self.send_timeout_s)
+
+        def _mb_span(name: str, i: int, t0: float, seq_in: int,
+                     seq_out: int) -> None:
+            # per-microbatch span under this stage's step span: the hop
+            # seq(s) let a cross-gang reader stitch microbatch i's
+            # journey (sender and receiver tag the SAME seq)
+            attrs = {"stage": self.stage, "mb": i}
+            if seq_in >= 0:
+                attrs["seq"] = seq_in
+            if seq_out >= 0:
+                attrs.setdefault("seq", seq_out)
+                attrs["seq_out"] = seq_out
+            self._tracer.record_span(
+                name, time.perf_counter() - t0, trace_id=step_tid,
+                parent_id=stage_sid, **attrs)
 
         def do_forward(i: int) -> None:
             nonlocal busy
+            tmb = time.perf_counter()
+            seq_in = seq_out = -1
             if links.is_first:
                 x = microbatches[i]
             else:
                 x = jnp.asarray(links.act_in.recv(self.recv_timeout_s))
+                seq_in = links.act_in.last_seq
             saved[i] = x
             if links.is_last:
+                if traced:
+                    _mb_span("pipeline.forward", i, tmb, seq_in, seq_out)
                 return      # the last stage folds its forward into _last
             t0 = time.perf_counter()
             out = self._forward_compute(params, x)
             out_host = np.asarray(out)      # device sync: compute wall ends
             busy += time.perf_counter() - t0
-            _send(links.act_out, out_host)
+            seq_out = _send(links.act_out, out_host)
+            if traced:
+                _mb_span("pipeline.forward", i, tmb, seq_in, seq_out)
 
         def do_backward(i: int) -> None:
             nonlocal busy, grads, hgrads, loss_acc
+            tmb = time.perf_counter()
+            seq_in = seq_out = -1
             if links.is_last:
                 head_mb = jax.tree.map(lambda a: a[i], head_batches)
                 t0 = time.perf_counter()
@@ -733,6 +787,7 @@ class CrossSlicePipeline:
                 busy += time.perf_counter() - t0
             else:
                 cot = jnp.asarray(links.grad_in.recv(self.recv_timeout_s))
+                seq_in = links.grad_in.last_seq
                 t0 = time.perf_counter()
                 dp, dx = self._backward_compute(params, saved.pop(i), cot)
                 grads = jax.tree.map(jnp.add, grads, dp)
@@ -741,7 +796,9 @@ class CrossSlicePipeline:
             if links.is_first:
                 dx_list.append(jnp.asarray(dx_host))
             else:
-                _send(links.grad_out, dx_host)
+                seq_out = _send(links.grad_out, dx_host)
+            if traced:
+                _mb_span("pipeline.backward", i, tmb, seq_in, seq_out)
             self._mb_counter.inc()
 
         # the non-interleaved 1F1B schedule in host form: warmup
@@ -763,6 +820,20 @@ class CrossSlicePipeline:
         dxs = jnp.stack(dx_list) / m if links.is_first else None
         wall = time.perf_counter() - t_start
         self._step_hist.observe(wall)
-        self._bubble_gauge.set(max(0.0, 1.0 - busy / wall) if wall > 0
-                               else 0.0)
+        bubble = max(0.0, 1.0 - busy / wall) if wall > 0 else 0.0
+        self._bubble_gauge.set(bubble)
+        if traced:
+            # this stage's step span (deterministic span id, parented on
+            # the shared per-step root so every gang's spans nest under
+            # ONE trace); stage 0 also emits the root itself
+            self._tracer.record_span(
+                "pipeline.stage", wall, trace_id=step_tid,
+                span_id=stage_sid, parent_id=root_sid,
+                stage=self.stage, microbatches=m,
+                bubble=round(bubble, 4))
+            if links.is_first:
+                self._tracer.record_span(
+                    "pipeline.step", wall, trace_id=step_tid,
+                    span_id=root_sid, step=self._calls,
+                    num_stages=self.num_stages, microbatches=m)
         return loss, grads, hgrads, dxs

@@ -124,13 +124,19 @@ class ApplicationRpcClient(ApplicationRpc):
         a serving coordinator). DEADLINE_EXCEEDED may mean the server *did*
         process the call, so it only retries for idempotent methods — the
         coordinator's register_worker_spec/heartbeat are idempotent by
-        contract (keyed on task id); register_execution_result is not."""
+        contract (keyed on task id); register_execution_result is not.
+
+        ``request`` may be a zero-arg callable, rebuilt PER ATTEMPT —
+        for requests carrying a send timestamp (the heartbeat's
+        clock-offset stamp), where resending stale bytes after a 10s
+        deadline + backoff would corrupt the estimate by that delay."""
         retries = self.max_retries if retries is None else retries
         backoff = self.base_backoff_s
         last_err: Exception | None = None
         for _ in range(retries):
             try:
-                return stub(request, timeout=10.0, metadata=self._metadata)
+                req = request() if callable(request) else request
+                return stub(req, timeout=10.0, metadata=self._metadata)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
                 retryable = code == grpc.StatusCode.UNAVAILABLE or (
@@ -184,8 +190,9 @@ class ApplicationRpcClient(ApplicationRpc):
                           retries=retries)
         return resp.message
 
-    def task_executor_heartbeat(self, task_id: str,
-                                metrics: str = "") -> HeartbeatAck:
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "",
+                                spans: str = "", client_time: float = 0.0,
+                                client_rtt: float = 0.0) -> HeartbeatAck:
         # Heartbeats get a tight retry budget: the executor-side heartbeater
         # counts consecutive failures itself (reference: TaskExecutor.java:
         # 264-268 dies after 5 failed sends). Returns the job's current
@@ -193,11 +200,25 @@ class ApplicationRpcClient(ApplicationRpc):
         # the coordinator's cluster-spec epoch (the elastic resync signal;
         # an old-wire response leaves it at the proto3 default 0).
         # ``metrics``: optional piggybacked registry snapshot (compact
-        # JSON); "" keeps the old-style liveness-only beat.
-        resp = self._call(self._heartbeat,
-                          pb.HeartbeatRequest(task_id=task_id,
-                                              metrics=metrics or ""),
-                          retries=2)
+        # JSON); "" keeps the old-style liveness-only beat. ``spans``:
+        # optional trace-span batch (tracing.encode_batch). The request
+        # stamps the sender's wall clock at send unless the caller passed
+        # one explicitly (client_time=0 means "stamp now"; pass a
+        # negative value to suppress the stamp entirely) — with
+        # ``client_rtt`` (the caller's last measured beat RTT) it feeds
+        # the coordinator's RTT-midpoint clock-offset estimate.
+        def build():
+            # stamped per ATTEMPT: a retried beat must carry the retry's
+            # send time, not bytes stamped before a 10s deadline expiry
+            now = time.time() if client_time == 0.0 \
+                else (0.0 if client_time < 0 else client_time)
+            return pb.HeartbeatRequest(task_id=task_id,
+                                       metrics=metrics or "",
+                                       spans=spans or "",
+                                       client_unix_time=now,
+                                       client_rtt=max(0.0, client_rtt))
+
+        resp = self._call(self._heartbeat, build, retries=2)
         return HeartbeatAck(gcs_token=resp.gcs_token,
                             cluster_epoch=resp.cluster_epoch)
 

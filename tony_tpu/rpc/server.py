@@ -132,20 +132,29 @@ class ApplicationRpcServer:
         def _finish(req, ctx):
             return pb.FinishApplicationResponse(message=impl.finish_application())
 
-        # Old-signature compatibility, both directions: req.metrics is ""
-        # for old-style SENDERS (proto3 default), and an old-style IMPL
-        # whose task_executor_heartbeat still takes only task_id keeps
-        # working — the piggyback is dropped rather than TypeError-ing
-        # every beat. Decided once at handler build, not per call.
+        # Old-signature compatibility, both directions: req.metrics /
+        # req.spans are "" for old-style SENDERS (proto3 default), and an
+        # old-style IMPL whose task_executor_heartbeat takes only task_id
+        # (or task_id+metrics, the pre-trace shape) keeps working — the
+        # piggyback is dropped rather than TypeError-ing every beat.
+        # Decided once at handler build, not per call.
         try:
             import inspect
-            _hb_takes_metrics = len(inspect.signature(
-                impl.task_executor_heartbeat).parameters) >= 2
+            _hb_params = inspect.signature(
+                impl.task_executor_heartbeat).parameters
+            _hb_takes_metrics = len(_hb_params) >= 2
+            _hb_takes_trace = "spans" in _hb_params
         except (TypeError, ValueError):
             _hb_takes_metrics = True
+            _hb_takes_trace = True
 
         def _heartbeat(req, ctx):
-            if _hb_takes_metrics:
+            if _hb_takes_trace:
+                ack = impl.task_executor_heartbeat(
+                    req.task_id, req.metrics, spans=req.spans,
+                    client_time=req.client_unix_time,
+                    client_rtt=req.client_rtt)
+            elif _hb_takes_metrics:
                 ack = impl.task_executor_heartbeat(req.task_id, req.metrics)
             else:
                 ack = impl.task_executor_heartbeat(req.task_id)

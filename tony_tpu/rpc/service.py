@@ -101,8 +101,10 @@ class ApplicationRpc(abc.ABC):
     def finish_application(self) -> str: ...
 
     @abc.abstractmethod
-    def task_executor_heartbeat(self, task_id: str,
-                                metrics: str = "") -> "HeartbeatAck | str":
+    def task_executor_heartbeat(self, task_id: str, metrics: str = "",
+                                spans: str = "", client_time: float = 0.0,
+                                client_rtt: float = 0.0,
+                                ) -> "HeartbeatAck | str":
         """Record the ping; returns a :class:`HeartbeatAck` carrying the
         job's CURRENT GCS access token ("" when credential scoping is
         off) and the coordinator's cluster-spec epoch — the heartbeat
@@ -115,7 +117,20 @@ class ApplicationRpc(abc.ABC):
         piggybacked on the beat — the TaskMonitor/MetricsRpc analog. ""
         (an old-style heartbeat) must always be accepted, and a
         malformed snapshot must never fail the ping: liveness and
-        telemetry share the channel but only liveness is load-bearing."""
+        telemetry share the channel but only liveness is load-bearing.
+
+        ``spans`` optionally carries a compact trace-span batch
+        (runtime/tracing.py ``encode_batch``: recent spans, plus a
+        flight-recorder tail on the final beat after an incident), and
+        ``client_time``/``client_rtt`` the sender's wall clock at send
+        and its last measured heartbeat RTT — the inputs to the
+        coordinator's RTT-midpoint clock-offset estimate
+        (``tony_clock_offset_seconds``). All three follow the metrics
+        discipline: ""/0 from old-style senders is a plain beat, and a
+        malformed span batch is dropped without costing the ping.
+        Implementations may keep any older signature (metrics-only or
+        task-id-only); the server detects it and drops the piggyback
+        rather than TypeError-ing."""
         ...
 
     def renew_gcs_token(self, token: str) -> None:

@@ -27,6 +27,7 @@ import queue
 import socket
 import threading
 
+from tony_tpu.runtime import tracing
 from tony_tpu.serving import protocol as P
 
 
@@ -45,6 +46,11 @@ class StreamingClient:
         self._send_lock = threading.Lock()
         self._lock = threading.Lock()
         self._queues: dict[int, queue.Queue] = {}
+        #: rid -> (client.request span, client.ttft span) — the
+        #: client-side leg of the end-to-end request trace; the span
+        #: context rides the ADMIT frame so the router's and engine's
+        #: spans join the same trace
+        self._spans: dict[int, tuple] = {}
         self._stats_q: queue.Queue = queue.Queue()
         self._next_rid = itertools.count(1)
         self._closed = False
@@ -84,10 +90,14 @@ class StreamingClient:
                     break
                 ftype, rid, payload = frame
                 if ftype == P.TOKENS:
+                    self._end_span(rid, ttft_only=True)
                     self._dispatch(rid, ("tokens",
                                          P.unpack_tokens(payload)))
                 elif ftype == P.RETIRED:
                     obj = P.unpack_json(payload)
+                    self._end_span(rid,
+                                   reason=obj.get("reason", "unknown"),
+                                   tokens=obj.get("tokens", 0))
                     self._dispatch(rid, ("retired",
                                          obj.get("reason", "unknown"),
                                          obj.get("tokens", 0)))
@@ -96,6 +106,7 @@ class StreamingClient:
                     if rid == 0:
                         error = f"server error: {msg}"
                         break               # connection-scoped: fatal
+                    self._end_span(rid, reason="error")
                     self._dispatch(rid, ("error", msg))
                 elif ftype == P.STATS:
                     self._stats_q.put(P.unpack_json(payload))
@@ -110,6 +121,22 @@ class StreamingClient:
         for q in queues:
             q.put(fatal)
         self._stats_q.put({"error": error})
+        with self._lock:
+            spans = list(self._spans)
+        for rid in spans:
+            self._end_span(rid, reason="connection_lost")
+
+    def _end_span(self, rid: int, ttft_only: bool = False,
+                  **attrs) -> None:
+        with self._lock:
+            pair = self._spans.get(rid)
+            if pair is None:
+                return
+            if not ttft_only:
+                del self._spans[rid]
+        pair[1].end()                      # first TOKENS frame = TTFT
+        if not ttft_only:
+            pair[0].end(**attrs)
 
     def _dispatch(self, rid: int, event: tuple) -> None:
         with self._lock:
@@ -123,14 +150,29 @@ class StreamingClient:
         """Admit a request; returns its (client-chosen or auto) rid."""
         if rid is None:
             rid = next(self._next_rid)
+        tr = tracing.get_tracer()
+        sp = tr.start_span("client.request", rid=rid,
+                           prompt_tokens=len(prompt))
+        body = {"prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens), "stream": stream}
+        if sp.recording:
+            # propagate the client's span context so the router's and
+            # engine's spans join this trace (the end-to-end TTFT
+            # decomposition)
+            body["trace"] = sp.context
         with self._lock:
             if self._closed:
+                sp.end(reason="closed")
                 raise ServingConnectionError(
                     self._conn_error or "client is closed")
             self._queues[rid] = queue.Queue()
-        self._send(P.ADMIT, rid, P.pack_json(
-            {"prompt": [int(t) for t in prompt],
-             "max_new_tokens": int(max_new_tokens), "stream": stream}))
+            self._spans[rid] = (sp, tr.start_span("client.ttft",
+                                                  parent=sp))
+        try:
+            self._send(P.ADMIT, rid, P.pack_json(body))
+        except ServingConnectionError:
+            self._end_span(rid, reason="send_failed")
+            raise
         return rid
 
     def cancel(self, rid: int) -> None:

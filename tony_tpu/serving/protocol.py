@@ -256,10 +256,33 @@ def unpack_tokens(payload: bytes) -> list[int]:
     return [t[0] for t in _TOK.iter_unpack(payload)]
 
 
+def parse_trace_ctx(payload_or_obj) -> dict | None:
+    """Extract the OPTIONAL ``trace`` context from an ADMIT payload:
+    ``{"trace": {"tid": <hex>, "sid": <hex>}}`` — the client's (or the
+    router's forwarded) span context, so a request's engine-side spans
+    join the submitter's trace. Tracing is never load-bearing: anything
+    missing or malformed is simply ``None`` (the request still serves;
+    the engine head-samples a fresh trace instead)."""
+    try:
+        obj = payload_or_obj if isinstance(payload_or_obj, dict) \
+            else unpack_json(payload_or_obj)
+        ctx = obj.get("trace")
+        if (isinstance(ctx, dict)
+                and isinstance(ctx.get("tid"), str)
+                and isinstance(ctx.get("sid"), str)
+                and 0 < len(ctx["tid"]) <= 64
+                and 0 < len(ctx["sid"]) <= 64):
+            return {"tid": ctx["tid"], "sid": ctx["sid"]}
+    except ProtocolError:
+        pass
+    return None
+
+
 def parse_admit(payload: bytes) -> tuple[list[int], int, bool]:
     """Validate an ADMIT payload -> (prompt, max_new_tokens, stream).
     Anything structurally off is a ProtocolError (connection-scoped),
-    NOT a crash in the engine."""
+    NOT a crash in the engine. The optional ``trace`` context rides
+    alongside (see :func:`parse_trace_ctx`)."""
     obj = unpack_json(payload)
     prompt = obj.get("prompt")
     max_new = obj.get("max_new_tokens")

@@ -139,10 +139,10 @@ class _ReplicaLink:
 
 class _RouterSession:
     __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
-                 "rrid", "cancelled")
+                 "rrid", "cancelled", "trace_ctx")
 
     def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
-                 budget: int) -> None:
+                 budget: int, trace_ctx: dict | None = None) -> None:
         self.conn = conn
         self.crid = crid
         self.prompt = prompt
@@ -153,6 +153,10 @@ class _RouterSession:
         #: the client asked for this session's death; a failover must
         #: finish it as cancelled, never resurrect it on a survivor
         self.cancelled = False
+        #: the client's span context, forwarded on every replica ADMIT
+        #: (including failover re-placements) so the engine's spans join
+        #: the client's trace across the router hop
+        self.trace_ctx = trace_ctx
 
 
 class ServingRouter(FrameServerBase):
@@ -300,7 +304,8 @@ class ServingRouter(FrameServerBase):
                 conn.send(P.ERROR, rid, P.pack_json(
                     {"message": f"request id {rid} is already active"}))
                 return
-            sess = _RouterSession(conn, rid, prompt, max_new)
+            sess = _RouterSession(conn, rid, prompt, max_new,
+                                  trace_ctx=P.parse_trace_ctx(payload))
             self._sessions[key] = sess
         if not self._place(sess, exclude=None):
             with self._lock:
@@ -342,10 +347,20 @@ class ServingRouter(FrameServerBase):
                 {"reason": "cancelled", "tokens": len(sess.streamed)}))
             return True
         self._placed_c[link.addr].inc()
-        ok = link.send(P.ADMIT, rrid, P.pack_json(
-            {"prompt": sess.prompt + sess.streamed,
-             "max_new_tokens": sess.budget - len(sess.streamed),
-             "stream": True}))
+        # the router's hop in the request trace: placement decision +
+        # forwarded ADMIT, as a child of the client's span (only traced
+        # requests — an orphan root per placement would be noise)
+        if sess.trace_ctx is not None:
+            from tony_tpu.runtime import tracing
+            tracing.get_tracer().record_span(
+                "router.place", 0.0, ctx=sess.trace_ctx,
+                replica=link.addr, failover=bool(sess.streamed))
+        body = {"prompt": sess.prompt + sess.streamed,
+                "max_new_tokens": sess.budget - len(sess.streamed),
+                "stream": True}
+        if sess.trace_ctx is not None:
+            body["trace"] = sess.trace_ctx
+        ok = link.send(P.ADMIT, rrid, P.pack_json(body))
         if not ok:
             # re-place ONLY if this placement still owns the session:
             # the link's down-sweep may have re-placed it already (it

@@ -162,9 +162,17 @@ class FrameServerBase:
                 self._handle_frame(conn, *frame)
         except P.ProtocolError as e:
             # connection-scoped: report, close THIS connection, keep
-            # serving everyone else
+            # serving everyone else — and leave a postmortem artifact
+            # scoped to the OFFENDING connection (the flight recorder's
+            # final entries name it; healthy connections dump nothing)
             log.warning("serving: protocol error from %s: %s",
                         conn.addr, e)
+            from tony_tpu.runtime import tracing
+            flight = tracing.get_flight()
+            flight.record("protocol_error", conn=conn.id,
+                          addr=str(conn.addr), error=str(e)[:500])
+            flight.dump("protocol_error", conn=conn.id,
+                        addr=str(conn.addr))
             conn.send(P.ERROR, 0, P.pack_json({"message": str(e)}))
         except OSError:
             pass                            # connection reset under us
@@ -303,6 +311,7 @@ class ServingServer(FrameServerBase):
         # structural violations are connection-scoped (raise), an
         # un-servable request is request-scoped (ERROR with its rid)
         prompt, max_new, stream = P.parse_admit(payload)
+        trace_ctx = P.parse_trace_ctx(payload)
         if rid == 0:
             raise P.ProtocolError("ADMIT rid must be nonzero")
         key = (conn.id, rid)
@@ -313,7 +322,7 @@ class ServingServer(FrameServerBase):
                 return
             self._sessions[key] = _Session(conn, rid, stream)
         try:
-            self.engine.submit(key, prompt, max_new)
+            self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx)
         except (ValueError, RuntimeError) as e:
             with self._lock:
                 self._sessions.pop(key, None)
