@@ -285,6 +285,16 @@ def main() -> None:
     # trip and streamed wall within 1.15x of the zero-delay wall.
     out.update(_streaming_arm())
 
+    # disaggregated prefill/decode: a prefill gang ships KV packages to
+    # a decode gang over a tensor channel, so concurrent admissions
+    # never stall in-flight decode chunks — decode ITL p99 under
+    # admission churn vs the colocated engine at equal slots, with
+    # token-identical output asserted. Deterministic: injected prefill/
+    # decode compute floors (the streaming arm's technique); tier-1
+    # pins serving_disagg_itl_p99_vs_colocated >= 2 and the handoff
+    # wall visible on the metrics plane (tests/test_disagg.py).
+    out.update(_disagg_arm())
+
     # cross-slice MPMD pipeline: the overlapped 1F1B schedule (channel
     # sends ride the bounded window while the device computes the next
     # microbatch) vs serialized stage execution (every tensor hop waits
@@ -809,6 +819,204 @@ def _streaming_arm(slots: int = 3, n_req: int = 6, prompt_len: int = 8,
         # the tentpole ratio: >= 2 at a 50 ms round trip (tier-1-pinned)
         "serving_stream_vs_rr_wall": round(t_rr / t_sd, 2),
         "serving_stream_ttft_s": round(sum(ttfts) / len(ttfts), 3),
+    }
+
+
+def _disagg_arm(slots: int = 4, n_streams: int = 2, n_admits: int = 6,
+                prompt_len: int = 12, stream_budget: int = 60,
+                admit_budget: int = 4, chunk: int = 2,
+                prefill_floor_s: float = 0.05,
+                fetch_floor_s: float = 0.015,
+                one_way_s: float = 0.0) -> dict:
+    """Disaggregated prefill/decode vs the colocated engine: decode
+    inter-token latency under CONCURRENT ADMISSIONS, at equal slot
+    count and with token-identical output (asserted).
+
+    The colocated engine interleaves prefill and decode dispatches on
+    one device queue: every admission wave's prefill
+    (``prefill_floor_s`` — the injected stand-in for real prefill
+    compute, tens of ms on hardware) lands between two decode chunks,
+    so the live streams' inter-token gap spikes to
+    ``fetch_floor_s + prefill_floor_s`` whenever anything is admitted.
+    Disaggregated, the SAME floors apply — but prefill burns on the
+    prefill gang while the decode gang only scatters the shipped KV
+    into a freed slot, so the live streams' p99 gap stays at the
+    decode floor. The workload: ``n_streams`` long streams occupy part
+    of the slot pool; once all are streaming, ``n_admits`` short
+    requests churn through the remaining slots.
+
+    Deterministic: a tiny CPU model plus the injected floors dominate
+    scheduling noise; both paths run the same floors, the same ladder,
+    and the same greedy workload, and their outputs are asserted
+    identical request-for-request. ``one_way_s`` (the @slow variant)
+    additionally routes the client connection through a LatencyProxy —
+    ITL is produced by push cadence, so an injected WAN hop must not
+    change the p99 contrast. ``serving_disagg_handoff_wall_s`` is the
+    mean prefill-side KV handoff wall (extract + serialize + channel
+    send) off the ``tony_kv_ship_seconds`` histogram — the metrics
+    plane's view of the handoff, which the tier-1 test also asserts
+    appears in the request trace as the ``kv.ship`` span."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.disagg import DecodeServer, PrefillServer
+    from tony_tpu.serving.netem import LatencyProxy
+    from tony_tpu.serving.router import ServingRouter
+    from tony_tpu.serving.server import ServingServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    class FloorFetch(ContinuousBatcher):
+        """Fixed per-sync fetch wall: the decode-chunk compute floor."""
+
+        def _fetch(self, handle):
+            if fetch_floor_s > 0:
+                time.sleep(fetch_floor_s)
+            return super()._fetch(handle)
+
+    class ColocatedFloor(FloorFetch):
+        """Colocated admission pays the prefill floor INSIDE the serve
+        loop — the dispatch-interleaving cost disaggregation removes."""
+
+        def _admit_prompts(self, pairs, prompts):
+            if prefill_floor_s > 0:
+                time.sleep(prefill_floor_s)
+            super()._admit_prompts(pairs, prompts)
+
+    class FloorPrefill(PrefillServer):
+        """The SAME prefill floor, burned on the prefill gang."""
+
+        def _prefill_group(self, grp, bucket):
+            if prefill_floor_s > 0:
+                time.sleep(prefill_floor_s)
+            super()._prefill_group(grp, bucket)
+
+    rs = np.random.RandomState(17)
+    stream_prompts = [[int(t) for t in rs.randint(
+        0, cfg.vocab_size, size=prompt_len)] for _ in range(n_streams)]
+    admit_prompts = [[int(t) for t in rs.randint(
+        0, cfg.vocab_size, size=prompt_len)] for _ in range(n_admits)]
+    max_len = prompt_len + stream_budget
+
+    def run_workload(port):
+        """Streams first (wait until every one delivered a delta —
+        measurement starts with the pool provably mid-decode), then the
+        admission churn; returns (outputs, long-stream per-token
+        gaps)."""
+        outs: dict = {}
+        gaps: list[float] = []
+        with StreamingClient("127.0.0.1", port) as c:
+            # warm every program (admit/land bucket, step chunk) so no
+            # compile lands inside a measured gap
+            toks, _ = c.result(c.submit(stream_prompts[0], admit_budget),
+                               timeout=120)
+            srids = [c.submit(p, stream_budget) for p in stream_prompts]
+            events = {r: c.next_event(r, timeout=120) for r in srids}
+
+            def drain(rid, first_ev):
+                toks = list(first_ev[1])
+                last = time.perf_counter()
+                while True:
+                    ev = c.next_event(rid, timeout=120)
+                    if ev[0] == "retired":
+                        break
+                    assert ev[0] == "tokens", ev
+                    now = time.perf_counter()
+                    gaps.append((now - last) / len(ev[1]))
+                    last = now
+                    toks.extend(ev[1])
+                outs[rid] = toks
+
+            threads = [threading.Thread(target=drain, args=(r, events[r]))
+                       for r in srids]
+            for th in threads:
+                th.start()
+            arids = []
+            for p in admit_prompts:
+                arids.append(c.submit(p, admit_budget))
+                time.sleep(2 * fetch_floor_s)   # churn, not one burst
+            for r in arids:
+                outs[r] = c.result(r, timeout=120)[0]
+            for th in threads:
+                th.join()
+            ordered = ([outs[r] for r in srids]
+                       + [outs[r] for r in arids])
+        return ordered, gaps
+
+    def p99(xs):
+        xs = sorted(xs)
+        return xs[min(len(xs) - 1, int(0.99 * len(xs)))]
+
+    def run_colocated():
+        srv = ServingServer(
+            ColocatedFloor(params, cfg, batch=slots, max_len=max_len,
+                           chunk=chunk),
+            registry=M.MetricsRegistry())
+        proxy = None
+        try:
+            port = srv.start()
+            if one_way_s > 0:
+                proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+                port = proxy.start()
+            return run_workload(port)
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            srv.stop()
+
+    def run_disagg():
+        regp = M.MetricsRegistry()
+        pre = FloorPrefill(params, cfg, max_len=max_len,
+                           max_batch=slots, registry=regp)
+        dec = DecodeServer(
+            FloorFetch(params, cfg, batch=slots, max_len=max_len,
+                       chunk=chunk),
+            registry=M.MetricsRegistry())
+        router = ServingRouter([f"127.0.0.1:{pre.start()}"],
+                               decode_replicas=[f"127.0.0.1:{dec.start()}"],
+                               registry=M.MetricsRegistry())
+        proxy = None
+        try:
+            port = router.start()
+            if one_way_s > 0:
+                proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+                port = proxy.start()
+            outs, gaps = run_workload(port)
+            ship = regp.histogram("tony_kv_ship_seconds")
+            assert ship.count > 0, \
+                "kv handoff wall missing from the metrics plane"
+            return outs, gaps, ship.sum / ship.count, ship.count
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            router.stop()
+            pre.stop()
+            dec.stop()
+
+    outs_colo, gaps_colo = run_colocated()
+    outs_dis, gaps_dis, handoff_wall, handoffs = run_disagg()
+    assert outs_colo == outs_dis, (
+        "disaggregated serving diverged from the colocated engine — "
+        "KV shipment corruption")
+    itl_colo, itl_dis = p99(gaps_colo), p99(gaps_dis)
+    return {
+        "serving_disagg_prefill_floor_s": prefill_floor_s,
+        "serving_disagg_fetch_floor_s": fetch_floor_s,
+        "serving_colocated_itl_p99_s": round(itl_colo, 4),
+        "serving_disagg_itl_p99_s": round(itl_dis, 4),
+        # the tentpole ratio: admissions stall colocated decode chunks
+        # by the prefill floor; disaggregated decode never sees it
+        # (>= 2 tier-1-pinned)
+        "serving_disagg_itl_p99_vs_colocated": round(
+            itl_colo / max(itl_dis, 1e-9), 2),
+        "serving_disagg_handoff_wall_s": round(handoff_wall, 4),
+        "serving_disagg_handoffs": handoffs,
     }
 
 

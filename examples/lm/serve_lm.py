@@ -32,6 +32,23 @@ live admission queue over the persistent TONYS1 token-push protocol —
     python examples/lm/serve_lm.py --preset tiny --requests 12 \
         --connect host1:7000
 
+Disaggregated prefill/decode (docs/serving.md): prefill gangs ship KV
+packages to decode gangs over tensor channels, so admissions never
+stall in-flight decode chunks —
+
+    # one prefill host + one decode host (real multi-host shape)
+    python examples/lm/serve_lm.py --preset tiny --role prefill \
+        --listen 0.0.0.0:7071
+    python examples/lm/serve_lm.py --preset tiny --slots 4 \
+        --role decode --listen 0.0.0.0:7072
+    # the router splits placement: ADMIT -> prefill tier,
+    # TOKENS <- decode tier
+    python examples/lm/serve_lm.py --listen 0.0.0.0:7000 \
+        --route host1:7071 --route_decode host2:7072
+    # or spawn all three locally and run the synthetic workload:
+    python examples/lm/serve_lm.py --preset tiny --requests 12 \
+        --slots 4 --disaggregate
+
 The reference framework has no serving path (it delegates all compute —
 SURVEY.md §2.3); this example exists so a user migrating from it can see
 the green-field serving stack end to end.
@@ -80,20 +97,138 @@ def _run_server(args, batcher) -> int:
 
 
 def _run_router(args) -> int:
-    """--listen + --route: the model-free front door."""
+    """--listen + --route: the model-free front door. With
+    --route_decode the router runs DISAGGREGATED placement — --route
+    names the prefill tier, --route_decode the decode tier."""
     from tony_tpu.serving.router import ServingRouter
 
     host, port = _parse_addr(args.listen)
     replicas = [a.strip() for a in args.route.split(",") if a.strip()]
-    router = ServingRouter(replicas, bind_host=host, port=port)
+    decodes = [a.strip() for a in args.route_decode.split(",")
+               if a.strip()]
+    router = ServingRouter(replicas, bind_host=host, port=port,
+                           decode_replicas=decodes or None)
     bound = router.start()
-    print(f"routing on {host}:{bound} over {len(replicas)} replicas "
-          f"— ^C exits", flush=True)
+    shape = (f"{len(replicas)} prefill + {len(decodes)} decode replicas"
+             if decodes else f"{len(replicas)} replicas")
+    print(f"routing on {host}:{bound} over {shape} — ^C exits",
+          flush=True)
     try:
         while True:
             time.sleep(3600)
     except KeyboardInterrupt:
         router.stop()
+    return 0
+
+
+def _run_prefill(args, params, cfg) -> int:
+    """--role prefill --listen: the stateless prefill tier — no cache
+    slots, no decode loop; prompts in, KV shipments out."""
+    from tony_tpu.serving.disagg import PrefillServer
+
+    host, port = _parse_addr(args.listen)
+    server = PrefillServer(params, cfg,
+                           max_len=args.prompt_len + args.max_new_tokens,
+                           seed=args.seed, max_batch=args.slots,
+                           bind_host=host, port=port)
+    bound = server.start()
+    print(f"prefill tier ({args.preset}) on {host}:{bound} "
+          f"({args.slots}-row waves) — ^C exits", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        server.stop()
+    return 0
+
+
+def _run_decode(args, batcher) -> int:
+    """--role decode --listen: the decode tier — admissions arrive as
+    KV shipments on the channel hub, never as prompts."""
+    from tony_tpu.serving.disagg import DecodeServer
+
+    host, port = _parse_addr(args.listen)
+    server = DecodeServer(batcher, bind_host=host, port=port)
+    bound = server.start()
+    mode = "sampled" if args.temperature > 0 else "greedy"
+    print(f"decode tier ({args.preset}, {mode}) on {host}:{bound} with "
+          f"{args.slots} slots; kv channel on :{server.hub.port} — ^C "
+          f"drains and exits", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        print("draining in-flight requests ...", flush=True)
+        server.stop(drain=True)
+    return 0
+
+
+def _run_disaggregate(args, params, cfg, batcher, prompts,
+                      budgets) -> int:
+    """--disaggregate: spawn both tiers + the router in-process and
+    stream the synthetic workload through the split — the one-command
+    demo of the topology (--role is the real multi-host shape)."""
+    import threading
+
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.disagg import DecodeServer, PrefillServer
+    from tony_tpu.serving.router import ServingRouter
+
+    max_len = args.prompt_len + args.max_new_tokens
+    reg = M.get_default()
+    pre = PrefillServer(params, cfg, max_len=max_len, seed=args.seed,
+                        max_batch=args.slots)
+    dec = DecodeServer(batcher)
+    router = ServingRouter([f"127.0.0.1:{pre.start()}"],
+                           decode_replicas=[f"127.0.0.1:{dec.start()}"])
+    rport = router.start()
+    print(f"disaggregated: prefill :{pre.port} -> decode :{dec.port} "
+          f"(kv channel :{dec.hub.port}), router :{rport}", flush=True)
+    outs: list = [None] * args.requests
+    ttfts: list = [0.0] * args.requests
+    gaps: list[float] = []
+    try:
+        with StreamingClient("127.0.0.1", rport) as client:
+            def drain(i, rid, t_submit):
+                toks, last = [], None
+                for delta in client.deltas(rid):
+                    now = time.perf_counter()
+                    if last is None:
+                        ttfts[i] = now - t_submit
+                    else:
+                        gaps.append((now - last) / len(delta))
+                    last = now
+                    toks.extend(delta)
+                outs[i] = toks
+
+            t0 = time.perf_counter()
+            threads = []
+            for i, p in enumerate(prompts):
+                rid = client.submit(p, budgets[i])
+                th = threading.Thread(target=drain,
+                                      args=(i, rid, time.perf_counter()))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            dt = time.perf_counter() - t0
+    finally:
+        router.stop()
+        pre.stop()
+        dec.stop()
+    useful = sum(len(o) for o in outs if o)
+    ship = reg.histogram("tony_kv_ship_seconds")
+    print(f"streamed {args.requests} requests ({useful} tokens) in "
+          f"{dt:.2f}s — {useful / max(dt, 1e-9):.1f} tok/s")
+    ttfts_s = sorted(ttfts)
+    print(f"ttft: p50 {ttfts_s[len(ttfts_s) // 2] * 1e3:.0f} ms  "
+          f"max {ttfts_s[-1] * 1e3:.0f} ms;  inter-token mean "
+          f"{(sum(gaps) / len(gaps) * 1e3) if gaps else 0.0:.1f} ms")
+    if ship.count:
+        print(f"kv handoff: {ship.count} shipments, mean wall "
+              f"{ship.sum / ship.count * 1e3:.1f} ms")
+    print("first request tokens:", (outs[0] or [])[:12])
     return 0
 
 
@@ -215,14 +350,38 @@ def main() -> int:
                         help="with --listen: route sessions across "
                              "these replica servers by queue depth "
                              "(no local model)")
+    parser.add_argument("--route_decode", default="",
+                        metavar="HOST:PORT,HOST:PORT",
+                        help="with --route: DISAGGREGATED placement — "
+                             "--route names the prefill tier, this the "
+                             "decode tier (ADMIT to prefill, TOKENS "
+                             "from decode)")
+    parser.add_argument("--role", default="", choices=("", "prefill",
+                                                       "decode"),
+                        help="with --listen: run ONE tier of "
+                             "disaggregated serving on this host "
+                             "instead of a colocated replica")
+    parser.add_argument("--disaggregate", action="store_true",
+                        help="spawn prefill + decode + router locally "
+                             "and stream the synthetic workload "
+                             "through the split (the one-command demo; "
+                             "--role is the real multi-host shape)")
     args = parser.parse_args()
 
     if args.connect:
         return _run_client(args)
-    if args.route:
+    if args.route or args.route_decode:
         if not args.listen:
             parser.error("--route requires --listen")
+        if args.route_decode and not args.route:
+            parser.error("--route_decode requires --route")
         return _run_router(args)
+    if (args.role or args.disaggregate) and args.draft_preset:
+        parser.error("speculative serving is not supported "
+                     "disaggregated (the KV shipment carries no "
+                     "draft-model cache)")
+    if args.role and not args.listen:
+        parser.error("--role requires --listen")
 
     on_tpu = jax.default_backend() == "tpu"
     cfg = T.PRESETS[args.preset].scaled(
@@ -242,6 +401,9 @@ def main() -> int:
         from tony_tpu.models.quantize import quantize_weights_int8
         params = quantize_weights_int8(params)
         print("serving with weight-only int8 matmul weights")
+
+    if args.role == "prefill":
+        return _run_prefill(args, params, cfg)
 
     rs = np.random.RandomState(args.seed)
     # mixed lengths and budgets — the workload shape slot reuse exists for
@@ -275,6 +437,11 @@ def main() -> int:
     else:
         batcher = ContinuousBatcher(params, cfg, **kw)
 
+    if args.role == "decode":
+        return _run_decode(args, batcher)
+    if args.disaggregate:
+        return _run_disaggregate(args, params, cfg, batcher, prompts,
+                                 budgets)
     if args.listen:
         return _run_server(args, batcher)
 

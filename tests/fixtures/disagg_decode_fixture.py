@@ -1,0 +1,55 @@
+"""Decode-tier host for the disaggregated-serving e2e: TWO real
+DecodeServers over one process — a greedy engine and a sampled one
+(temperature/top_k/top_p/seed matching the driver's colocated
+reference batchers) — so ONE extra process covers both token-identity
+modes. Admissions arrive only as KV shipments on each server's channel
+hub; the driver's routers BIND themselves as the delta sinks. Writes
+{"greedy": port, "sampled": port} to --port_file (atomic) and serves
+until --done_file appears."""
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port_file", default=".decode-ports")
+    ap.add_argument("--done_file", default=".disagg-done")
+    ap.add_argument("--timeout_s", type=float, default=180.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.serving.disagg import DecodeServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    servers = {
+        "greedy": DecodeServer(ContinuousBatcher(
+            params, cfg, batch=2, max_len=48, chunk=3, seed=7)),
+        "sampled": DecodeServer(ContinuousBatcher(
+            params, cfg, batch=2, max_len=48, chunk=3, temperature=0.8,
+            top_k=20, top_p=0.9, seed=7)),
+    }
+    ports = {name: s.start() for name, s in servers.items()}
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ports, f)
+    os.replace(tmp, args.port_file)
+    print(f"decode tier serving on {ports}", flush=True)
+    deadline = time.time() + args.timeout_s
+    while not os.path.exists(args.done_file) and time.time() < deadline:
+        time.sleep(0.1)
+    for s in servers.values():
+        s.stop(drain=True)
+    print("decode tier done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,50 @@
+"""Prefill-tier host for the disaggregated-serving e2e: TWO real
+PrefillServers (one paired with the decode fixture's greedy engine,
+one with its sampled engine — separate servers so each pairing's
+stream-index assignment starts at 0, which is what makes the sampled
+run comparable to an in-driver colocated reference). Writes the bound
+ports to --port_file as JSON (atomic) and serves until --done_file
+appears. Model/config/seed are pinned to match the driver's reference
+batchers bit-for-bit."""
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port_file", default=".prefill-ports")
+    ap.add_argument("--done_file", default=".disagg-done")
+    ap.add_argument("--timeout_s", type=float, default=180.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.serving.disagg import PrefillServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    servers = {name: PrefillServer(params, cfg, max_len=48, max_batch=2,
+                                   seed=7)
+               for name in ("greedy", "sampled")}
+    ports = {name: s.start() for name, s in servers.items()}
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ports, f)
+    os.replace(tmp, args.port_file)
+    print(f"prefill tier serving on {ports}", flush=True)
+    deadline = time.time() + args.timeout_s
+    while not os.path.exists(args.done_file) and time.time() < deadline:
+        time.sleep(0.1)
+    for s in servers.values():
+        s.stop()
+    print("prefill tier done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -658,8 +658,8 @@ class TestBucketedAdmission:
             assert outs[i] == _reference(params, p, 6), i
 
     def test_legacy_admission_still_exact(self, params):
-        """bucketed_admission=False keeps the per-length admit_row path
-        (the ring-cache fallback) working and exact."""
+        """bucketed_admission=False keeps the batch-1 admit_row path
+        working and exact."""
         rng = np.random.RandomState(0)
         prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
                    for n in (5, 3, 7, 4)]
@@ -668,6 +668,25 @@ class TestBucketedAdmission:
         outs = batcher.serve(prompts, max_new_tokens=6)
         for i, p in enumerate(prompts):
             assert outs[i] == _reference(params, p, 6), i
+
+    def test_batch1_admission_pads_to_buckets_too(self, params,
+                                                  retrace_guard):
+        """The batch-1 admission retrace cap: with bucketed (batched)
+        admission OFF, eight distinct prompt lengths in one 16-token
+        bucket still compile at most ONE admit_row program — the old
+        monolithic-prefill body retraced once per distinct length.
+        Outputs stay per-request exact."""
+        rng = np.random.RandomState(35)
+        prompts = [list(rng.randint(0, CFG.vocab_size, size=n))
+                   for n in (3, 4, 5, 6, 7, 8, 9, 10)]
+        batcher = ContinuousBatcher(params, CFG, batch=2, max_len=48,
+                                    chunk=3, bucketed_admission=False)
+        outs = batcher.serve(prompts, max_new_tokens=4)
+        retrace_guard.assert_max("admit_row", 1)
+        retrace_guard.assert_max("admit_rows", 0)
+        assert outs[0] == _reference(params, prompts[0], 4)
+        assert outs[7] == _reference(params, prompts[7], 4)
+        assert all(len(o) == 4 for o in outs)
 
     def test_ring_cache_falls_back_to_per_length_admission(
             self, params, retrace_guard):

@@ -1,15 +1,19 @@
 """Typed inter-gang tensor channels over DCN.
 
 The reusable framework primitive behind cross-slice pipeline training
-(and, next, disaggregated prefill/decode serving): persistent
-point-to-point tensor transport between gangs, with bounded send
-windows, reconnect-with-seq-resume, and coordinator-owned endpoint
-discovery. See ``docs/pipeline.md``.
+AND disaggregated prefill/decode serving (prefill gangs ship KV
+packages to decode gangs as byte-blob frames —
+``ChannelSender.send_bytes`` / ``ChannelReceiver.recv_bytes``):
+persistent point-to-point tensor transport between gangs, with bounded
+send windows, reconnect-with-seq-resume, and coordinator-owned
+endpoint discovery. See ``docs/pipeline.md`` and docs/serving.md
+§Disaggregated prefill/decode.
 """
 
-from tony_tpu.channels.channel import (ChannelError, ChannelHub,
-                                       ChannelReceiver, ChannelSender,
-                                       decode_tensor, encode_tensor)
+from tony_tpu.channels.channel import (ChannelClosed, ChannelError,
+                                       ChannelHub, ChannelReceiver,
+                                       ChannelSender, decode_tensor,
+                                       encode_tensor)
 from tony_tpu.channels.registry import (ACT_CHANNEL, GRAD_CHANNEL,
                                         StageLinks, build_channel_specs,
                                         open_local_pipeline,
@@ -18,7 +22,8 @@ from tony_tpu.channels.registry import (ACT_CHANNEL, GRAD_CHANNEL,
                                         parse_channel_spec, stage_env)
 
 __all__ = [
-    "ChannelError", "ChannelHub", "ChannelReceiver", "ChannelSender",
+    "ChannelClosed", "ChannelError", "ChannelHub", "ChannelReceiver",
+    "ChannelSender",
     "decode_tensor", "encode_tensor", "ACT_CHANNEL", "GRAD_CHANNEL",
     "StageLinks", "build_channel_specs", "open_local_pipeline",
     "open_stage_links", "open_stage_links_from_env", "parse_channel_spec",

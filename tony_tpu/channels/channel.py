@@ -61,6 +61,7 @@ by trainers, the coordinator's registry, the bench, and tests alike.
 from __future__ import annotations
 
 import json
+import math
 import socket
 import struct
 import threading
@@ -106,6 +107,12 @@ class ChannelError(ConnectionError):
     internally."""
 
 
+class ChannelClosed(ChannelError):
+    """The receiving hub has STOPPED: no delivery can ever succeed on
+    this endpoint again — distinct from a recv timeout, so a consumer
+    loop can exit instead of hot-spinning on instant failures."""
+
+
 def encode_tensor(arr: np.ndarray) -> tuple[bytes, bytes]:
     """-> (tensor header bytes, raw payload bytes). The raw buffer is
     ``tobytes()`` of the C-contiguous array — one copy, retained for
@@ -139,7 +146,9 @@ def decode_tensor(payload: bytes) -> np.ndarray:
     except TypeError as e:
         raise ProtocolError(f"unknown TENSOR dtype {dtype!r}") from e
     raw = payload[_HLEN.size + hlen:]
-    want = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+    # python-int math: np.prod wraps on adversarial shapes, letting a
+    # bogus length claim past the check into a reshape crash
+    want = math.prod(shape) * dt.itemsize
     if len(raw) != want:
         raise ProtocolError(
             f"TENSOR payload {len(raw)} bytes, header promises {want}")
@@ -360,6 +369,7 @@ class ChannelSender:
         the in-flight window is full (backpressure), and — with
         ``sync=True`` — until the peer acked this frame."""
         t0 = time.perf_counter()
+        deadline = None if timeout is None else time.monotonic() + timeout
         head, raw = encode_tensor(arr)
         # mirrors frame_header's limit check exactly (incl. the frame's
         # own header bytes): an oversize frame must fail HERE, before a
@@ -391,14 +401,28 @@ class ChannelSender:
                 # delivery now rides the reconnect resend path — for an
                 # async send that is enough; sync waits below
                 if not sync:
-                    self._reconnect()
+                    self._reconnect(deadline)
         else:
-            self._reconnect()   # resends the queued frame post-handshake
+            # resends the queued frame post-handshake; the caller's
+            # timeout bounds the dial too — without the deadline a dead
+            # endpoint holds this send for the full retry budget
+            self._reconnect(deadline)
         if sync:
             self._wait(lambda: self._acked_through >= seq, timeout)
         self._bytes.inc(len(raw))
         self._send_hist.observe(time.perf_counter() - t0)
         return seq
+
+    def send_bytes(self, data, *, sync: bool = False,
+                   timeout: float | None = None) -> int:
+        """Ship an opaque byte blob as a 1-D uint8 tensor frame — the
+        lane structured multi-buffer payloads (the serving KV shipment,
+        ``tony_tpu/serving/kvship.py``) ride without teaching the
+        tensor plane their schema. Same window/reconnect/ordering
+        contract as :meth:`send`; pair with
+        :meth:`ChannelReceiver.recv_bytes`."""
+        return self.send(np.frombuffer(data, dtype=np.uint8), sync=sync,
+                         timeout=timeout)
 
     def drain(self, timeout: float | None = None) -> None:
         """Block until every sent frame is acked."""
@@ -469,7 +493,7 @@ class _RecvState:
         with self.cv:
             while not self.queue:
                 if self.closed:
-                    raise ChannelError("channel hub stopped")
+                    raise ChannelClosed("channel hub stopped")
                 remaining = None if deadline is None \
                     else deadline - time.monotonic()
                 if remaining is not None and remaining <= 0:
@@ -522,6 +546,19 @@ class ChannelReceiver:
             self._last_seq = self._state.next_seq \
                 - len(self._state.queue) - 1
         return arr
+
+    def recv_bytes(self, timeout: float | None = None) -> bytes:
+        """Consume one opaque byte blob (the :meth:`ChannelSender.
+        send_bytes` counterpart). A frame that is not a 1-D uint8
+        tensor is a peer speaking the wrong sub-protocol — surfaced as
+        ProtocolError so the consumer can scope it, never silently
+        reinterpreted bytes."""
+        arr = self.recv(timeout)
+        if arr.dtype != np.uint8 or arr.ndim != 1:
+            raise ProtocolError(
+                f"expected a byte-blob frame (1-D uint8), got "
+                f"{arr.dtype}{list(arr.shape)}")
+        return arr.tobytes()
 
     @property
     def last_seq(self) -> int:
@@ -616,6 +653,15 @@ class ChannelHub:
             try:
                 sock, _ = self._server.accept()
             except OSError:
+                return
+            if self._stopping.is_set():
+                # accept can still return a queued connection while the
+                # listener is being torn down — a handshake served now
+                # would let a sender "deliver" into a dead hub
+                try:
+                    sock.close()
+                except OSError:
+                    pass
                 return
             set_nodelay(sock)
             with self._conns_lock:

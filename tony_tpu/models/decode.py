@@ -772,6 +772,28 @@ def place_rows(cache: dict, mini: dict, rows: jax.Array,
         lengths.astype(jnp.int32), mode="drop", unique_indices=True))
 
 
+def extract_kv_rows(mini: dict, widths) -> list[dict]:
+    """Per-row HOST copies of a K-row mini cache's buffers — the
+    extraction half of disaggregated serving's KV shipment
+    (:func:`place_rows` is the landing half). Row ``i`` ships its first
+    ``widths[i]`` positions of every buffer (k/v plus int8 scales when
+    the cache is quantized — quantized caches ship their int8 payload
+    as-is, never dequantized): for linear caches that is the true
+    prompt length — the bucket-padding tail past the frontier is
+    unreachable garbage, so shipping it would double the bytes of a
+    short prompt for nothing — and for rolling (ring) caches the full
+    capacity, whose positional wrap only a whole-slot landing
+    preserves. Returns one ``{name: np [L, 1, w, KV, hd]}`` dict per
+    row (every layer's slice in one device fetch per row)."""
+    bufs = _kv_bufs(mini)
+    out = []
+    for i, w in enumerate(widths):
+        w = int(w)
+        out.append(jax.device_get(
+            {n: b[:, i:i + 1, :w] for n, b in bufs.items()}))
+    return out
+
+
 def _filter_logits(logits, temperature: float, top_k: int, top_p: float):
     """The sampling filter stack on [..., V] f32 logits: top-k mask →
     temperature → top-p nucleus mask (keep the smallest prefix of the

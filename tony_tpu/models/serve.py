@@ -21,9 +21,11 @@ shared padded cache. On top of that, the device programs:
   land in K freed cache slots (one scatter per buffer), compiling once
   per bucket instead of once per distinct prompt length and paying one
   transport dispatch however many slots freed in the chunk;
-- :func:`admit_row` — the single-slot, exact-length admission the
-  batched path replaced; kept for rolling (ring) caches, whose wrapped
-  writes cannot take padded prompts, and for direct API use;
+- :func:`admit_row` — the single-slot admission the batched path
+  replaced, kept for direct API use and the ``bucketed_admission=False``
+  A/B arm; it pads to the same buckets (one program per bucket, not per
+  length). Rolling (ring) caches, whose wrapped writes cannot take
+  padded prompts, keep the exact-length :func:`admit_row_ring`;
 - :func:`step_rows` — a ``lax.scan`` of ``n`` per-row decode steps over
   the whole batch (one dispatch per chunk, not per token; greedy by
   default, or sampled through the same top-k/temperature/nucleus stack
@@ -98,6 +100,24 @@ engine — submit everything, drain, collect — and remains token-identical
 (and ``steps_executed``-identical) to the pre-engine loop in every mode
 (test-enforced).
 
+DISAGGREGATED serving splits the two device workloads above across two
+GANGS: a prefill gang runs :func:`prefill_ship_rows` on admitted
+prompts and ships each row's K/V + last-real logits + rng stream state
+as a :class:`KVPackage` (``tony_tpu/serving/kvship.py`` is the wire
+codec, ``tony_tpu/serving/disagg.py`` the servers); the decode gang's
+engine adopts packages through :meth:`ServeEngine.submit_prefilled`,
+lands them with :func:`land_kv_rows` (a
+:func:`~tony_tpu.models.decode.place_rows` scatter — no model forward),
+and runs pure :func:`step_rows` chunks that are never preempted by
+prefill compute. Token-identity argument: the shipped buffers are the
+SAME mini cache ``admit_rows`` would have landed (truncated at the true
+length — the padding tail beyond each frontier is unreachable by
+construction, see the slot-reuse argument above), the logits are the
+same last-real-position logits, and the shipped per-request rng key +
+stream position reproduce the sampled stream exactly, so disaggregated
+outputs match the colocated engine bit-for-bit (greedy AND sampled;
+test-pinned end-to-end across two processes).
+
 ``TRACE_COUNTS`` records one entry per (program, static shape) TRACE —
 a Python side effect inside the jitted bodies, executed at trace time
 only — so tests (and the conftest retrace guard) can pin "bucketed
@@ -147,6 +167,27 @@ def _count_trace(name: str, shape) -> None:
     TRACE_COUNTS[(name, tuple(shape))] += 1
 
 
+def bucket_for(n: int, cap: int,
+               ladder: Sequence[int] | None = None) -> int:
+    """Padded admission length for an ``n``-token prompt: the smallest
+    power-of-two (or custom ``ladder``) bucket >= n, clamped to ``cap``
+    (the cache's admissible length). Powers of two are
+    flash-block-aligned at every size, so TPU prefill never re-pads a
+    bucket. THE bucket ladder — shared by the batcher's admission, the
+    batch-1 legacy path, the disaggregated prefill gang, and the decode
+    gang's KV landing, so two gangs padding independently agree on the
+    compiled-program set."""
+    if ladder is not None:
+        for b in ladder:
+            if b >= n:
+                return min(b, cap)
+        return cap
+    b = _MIN_ADMIT_BUCKET
+    while b < n:
+        b <<= 1
+    return min(b, cap)
+
+
 def _row_samples(logits, keys, temperature, top_k, top_p):
     """One sampling decision per row from PER-ROW keys [B, 2] — argmax
     at ``temperature == 0`` (keys unused; pass None), otherwise the same
@@ -175,17 +216,41 @@ def _place_prefill(cache, mini, row, s_p):
 
 @functools.partial(jax.jit, static_argnames=("cfg",),
                    donate_argnames=("cache", "logits"))
-def admit_row(params, cache, logits, row, prompt, cfg):
-    """Admit a request into cache slot ``row`` at its EXACT length.
+def admit_row(params, cache, logits, row, prompt, length, cfg):
+    """Admit ONE request into cache slot ``row`` with the prompt padded
+    to an admission bucket.
 
-    prompt: [1, S_p] (batch-1 prefill; retraces per distinct prompt
-    length). The batcher's default admission path is the bucketed
-    :func:`admit_rows`; this per-length program remains for rolling
-    (ring) caches — whose wrapped writes cannot take padded prompts —
-    and for direct API use. Returns (cache, logits) with the row's K/V
-    filled, its frontier at S_p, and its next-step logits seeded.
-    """
+    prompt: [1, S_b] right-padded to a :func:`bucket_for` rung;
+    ``length`` the TRACED true prompt length — the batch-1 counterpart
+    of :func:`admit_rows`, compiling once per bucket instead of once
+    per distinct prompt length (the old monolithic-``prefill`` body
+    retraced per length, which made the legacy/batch-1 admission path a
+    compile sink on mixed-length workloads). The padding-tail K/V land
+    beyond the frontier and are unreachable (the bucketed-admission
+    argument). Rolling (ring) caches cannot take padded prompts —
+    :func:`admit_row_ring` keeps the exact-length program for them.
+    Returns (cache, logits) with the row's K/V filled, its frontier at
+    ``length``, and its next-step logits seeded from the true last
+    position."""
     _count_trace("admit_row", prompt.shape)
+    lengths = jnp.reshape(jnp.asarray(length, jnp.int32), (1,))
+    lg, mini = prefill_rows(params, prompt, lengths, cfg)
+    return (_place_prefill(cache, mini, row, lengths[0]),
+            logits.at[row].set(lg[0]))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",),
+                   donate_argnames=("cache", "logits"))
+def admit_row_ring(params, cache, logits, row, prompt, cfg):
+    """Admit a request into cache slot ``row`` at its EXACT length —
+    the rolling (ring) cache admission: wrapped writes cannot take
+    padded prompts (padding at position p would land on ring row
+    ``p % capacity``, clobbering real history), so ring admission keeps
+    one compiled program per distinct prompt length by construction.
+
+    prompt: [1, S_p]. Returns (cache, logits) with the row's K/V
+    filled, its frontier at S_p, and its next-step logits seeded."""
+    _count_trace("admit_row_ring", prompt.shape)
     lg1, mini = prefill(params, prompt, cfg, max_len=prompt.shape[1])
     return (_place_prefill(cache, mini, row, prompt.shape[1]),
             logits.at[row].set(lg1[0]))
@@ -341,6 +406,100 @@ def retire_rows(cache, mask):
     """Reset retired rows' frontiers to 0 (mask: [B] bool). Keeps idle
     slots from marching their garbage frontier into the cache end."""
     return dict(cache, length=jnp.where(mask, 0, cache["length"]))
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode (the KV-shipping device programs)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_ship_rows(params, prompts, lengths, cfg):
+    """Prefill one admission bucket of prompts FOR SHIPMENT: the exact
+    :func:`~tony_tpu.models.decode.prefill_rows` program the colocated
+    ``admit_rows`` runs, minus the landing — the prefill gang has no
+    persistent cache to land into; each row's mini-cache K/V, last-real
+    logits, and frontier ship to a decode gang instead
+    (``tony_tpu/serving/disagg.py``). Because both sides run the same
+    bucket ladder and the same prefill program, the shipped buffers are
+    bit-identical to what colocated admission would have written —
+    which is what makes disaggregated serving token-identical. prompts:
+    [K, S_bucket] right-padded, lengths: [K] TRACED; one compiled
+    program per (K, bucket) — the worker pads K to its wave size."""
+    _count_trace("prefill_ship_rows", prompts.shape)
+    return prefill_rows(params, prompts, lengths, cfg)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefill_ship_row(params, prompt, cfg):
+    """Exact-length batch-1 prefill for shipment — the rolling (ring)
+    cache path, whose wrapped writes cannot take padded prompts; the
+    FULL capacity-row ring ships (the wrap is positional — landing is a
+    whole-slot write). Retraces per distinct prompt length, exactly as
+    colocated ring admission does. prompt: [1, S_p]."""
+    _count_trace("prefill_ship_row", prompt.shape)
+    return prefill(params, prompt, cfg, max_len=prompt.shape[1])
+
+
+@functools.partial(jax.jit, donate_argnames=("cache", "logits"))
+def land_kv_rows(cache, logits, rows, mini, lengths, row_logits, keys,
+                 row_keys):
+    """Land K shipped-and-bucket-padded KV rows into freed cache slots:
+    pure scatters — :func:`~tony_tpu.models.decode.place_rows` on the
+    buffers plus the logits and rng-key rebinds — with NO model
+    forward, which is the entire point of disaggregation: admission on
+    the decode gang costs a memcpy, never a prefill that stalls the
+    in-flight decode chunk. Takes the ``admit_rows`` sentinel contract
+    (``rows`` padded to the slot count with out-of-range entries whose
+    scatters drop), so each bucket compiles exactly one program.
+    ``row_keys``: [B, 2] shipped per-request rng stream keys landing
+    into the batcher's key table ``keys`` — the decode gang samples
+    from the SAME per-request stream the prefill gang derived. Returns
+    (cache, logits, keys)."""
+    _count_trace("land_kv_rows", mini["k"].shape)
+    return (place_rows(cache, mini, rows, lengths),
+            logits.at[rows].set(row_logits, mode="drop",
+                                unique_indices=True),
+            keys.at[rows].set(row_keys, mode="drop",
+                              unique_indices=True))
+
+
+class KVPackage:
+    """One prefilled request's device state, shipped from a prefill
+    gang to a decode gang (disaggregated serving).
+
+    - ``bufs``: host copies of the row's cache buffers (``k``/``v``
+      plus int8 ``k_scale``/``v_scale`` when the cache is quantized —
+      int8 caches ship QUANTIZED, ~half the bytes of a dequantized
+      ship), each ``[L, 1, S, KV, hd]``-shaped, truncated at the true
+      prompt length for linear caches (the padding tail past the
+      frontier is unreachable garbage — why ship it) or the full
+      capacity ring for rolling caches;
+    - ``length``: the row's frontier (true prompt length; for rings the
+      absolute position, which may exceed the capacity);
+    - ``logits``: [V] last-REAL-position logits seeding the first
+      decode step;
+    - ``rng_key``: [2] uint32 per-request stream key and ``rng_off``
+      stream position — the rng stream state that makes SAMPLED
+      disaggregated output identical to colocated serving.
+
+    The wire form lives in ``tony_tpu/serving/kvship.py`` (jax-free);
+    this class is the decode-side landing record
+    (:meth:`ServeEngine.submit_prefilled`)."""
+
+    __slots__ = ("bufs", "length", "logits", "rng_key", "rng_off")
+
+    def __init__(self, bufs: dict, length: int, logits, rng_key,
+                 rng_off: int = 0) -> None:
+        self.bufs = bufs
+        self.length = int(length)
+        self.logits = logits
+        self.rng_key = rng_key
+        self.rng_off = int(rng_off)
+
+    @property
+    def width(self) -> int:
+        """Shipped cache positions per layer (the buffers' S extent)."""
+        return self.bufs["k"].shape[2]
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "draft_cfg",
@@ -550,8 +709,8 @@ class ContinuousBatcher:
     power-of-two length buckets (compile once per bucket, not once per
     distinct prompt length) and every slot freed in the same chunk lands
     in one :func:`admit_rows` dispatch. Rolling (ring) caches fall back
-    to the per-length :func:`admit_row` path — padded prompts cannot
-    take wrapped writes.
+    to the exact-length :func:`admit_row_ring` path — padded prompts
+    cannot take wrapped writes.
     """
 
     #: first per-request stream position consumed by step_rows sampling
@@ -652,21 +811,11 @@ class ContinuousBatcher:
 
     def _bucket_for(self, n: int) -> int:
         """Padded admission length for an n-token prompt (suffix, when a
-        shared prefix is set): the smallest power-of-two (or custom
-        ladder) bucket >= n, clamped to the cache's admissible length.
-        Powers of two are flash-block-aligned at every size, so TPU
-        prefill never re-pads a bucket."""
+        shared prefix is set): :func:`bucket_for` against the cache's
+        admissible length."""
         cap = self.max_len - (len(self.shared_prefix)
                               if self.shared_prefix else 0)
-        if self.admission_buckets is not None:
-            for b in self.admission_buckets:
-                if b >= n:
-                    return min(b, cap)
-            return cap
-        b = _MIN_ADMIT_BUCKET
-        while b < n:
-            b <<= 1
-        return min(b, cap)
+        return bucket_for(n, cap, self.admission_buckets)
 
     def _marshal_wave(self, pairs):
         """THE home of the sentinel scheme: ([batch] row targets, [batch,
@@ -699,11 +848,127 @@ class ContinuousBatcher:
         return jnp.asarray(toks, jnp.int32), jnp.asarray(lens)
 
     def _admit_batch(self, pairs, prompts) -> None:
-        """Admit (row, request-index) pairs: group by length bucket and
-        land each group in ONE device dispatch (legacy per-row programs
-        when bucketing is off/ring). Also rebinds each row's rng stream
-        to its new occupant — one scatter of the wave's marshalled keys,
-        not a dispatch per row."""
+        """Admit (row, request-index) pairs. ``prompts`` maps each
+        request index to EITHER a token sequence (the colocated path —
+        prefill here) or a :class:`KVPackage` (disaggregated serving —
+        the prefill already ran on another gang; landing is a scatter).
+        The engine's admission sweep feeds both through one seam, so
+        the slot/occupancy machinery cannot diverge between modes."""
+        pkg, toks = [], []
+        for pair in pairs:
+            (pkg if isinstance(prompts[pair[1]], KVPackage)
+             else toks).append(pair)
+        if pkg:
+            self._admit_packages(pkg, prompts)
+        if toks:
+            self._admit_prompts(toks, prompts)
+
+    def _admit_packages(self, pairs, pkgs) -> None:
+        """Land shipped-KV admissions: group by the landing bucket
+        (:func:`bucket_for` over each package's shipped width — the
+        SAME ladder as prompt admission, so the decode gang compiles
+        one :func:`land_kv_rows` program per bucket) and land each
+        group in one scatter dispatch. The host stages each group into
+        a slot-count-wide, zero-padded buffer set (sentinel rows drop
+        on device); zero padding differs from the colocated path's
+        prefill-garbage padding only beyond the frontiers, where no
+        query can reach — token outputs are identical."""
+        if not pairs:
+            return
+        with self.phase_times.phase("admit"):
+            groups: dict[int, list] = {}
+            for row, req in pairs:
+                w = pkgs[req].width
+                s_b = w if self._ring else bucket_for(
+                    w, self.max_len, self.admission_buckets)
+                groups.setdefault(s_b, []).append((row, req))
+            for s_b in sorted(groups):
+                self._land_group(groups[s_b], pkgs, s_b)
+
+    def _land_group(self, grp, pkgs, s_b: int) -> None:
+        b = self.batch
+        proto = pkgs[grp[0][1]].bufs
+        rows = b + np.arange(b, dtype=np.int32)
+        lens = np.zeros((b,), np.int32)
+        lgs = np.zeros((b, self.cfg.vocab_size),
+                       pkgs[grp[0][1]].logits.dtype)
+        keys = np.zeros((b, 2), np.uint32)
+        mini = {n: np.zeros((a.shape[0], b, s_b) + a.shape[3:], a.dtype)
+                for n, a in proto.items()}
+        for i, (row, req) in enumerate(grp):
+            pkg = pkgs[req]
+            rows[i] = row
+            lens[i] = pkg.length
+            lgs[i] = pkg.logits
+            keys[i] = pkg.rng_key
+            for n, a in pkg.bufs.items():
+                mini[n][:, i:i + 1, :a.shape[2]] = a
+        self.cache, self.logits, self._row_keys = land_kv_rows(
+            self.cache, self.logits, jnp.asarray(rows),
+            {n: jnp.asarray(a) for n, a in mini.items()},
+            jnp.asarray(lens), jnp.asarray(lgs), self._row_keys,
+            jnp.asarray(keys))
+        for row, req in grp:
+            self._row_off[row] = pkgs[req].rng_off
+
+    def _validate_package(self, pkg, max_new: int) -> None:
+        """Reject a shipped-KV admission the decode batcher could not
+        land: wrong buffer set/dtypes/trailing dims vs this cache, a
+        width past the cache extent, or frontier + budget past
+        ``max_len`` (linear caches). Raises ``ValueError`` naming the
+        mismatch — request-scoped at the decode server, exactly like
+        prompt validation."""
+        if not isinstance(pkg, KVPackage):
+            raise ValueError(f"expected a KVPackage, got {type(pkg)}")
+        if max_new <= 0:
+            raise ValueError(f"max_new_tokens must be positive, "
+                             f"got {max_new}")
+        if pkg.length < 1:
+            raise ValueError(f"package frontier must be >= 1, "
+                             f"got {pkg.length}")
+        lg = np.asarray(pkg.logits)
+        if lg.ndim != 1 or lg.shape[0] != self.cfg.vocab_size:
+            raise ValueError(
+                f"package logits shape {list(lg.shape)} != "
+                f"[{self.cfg.vocab_size}] (vocab mismatch between the "
+                f"prefill and decode gangs?)")
+        want = _kv_bufs(self.cache)
+        if set(pkg.bufs) != set(want):
+            raise ValueError(
+                f"package buffers {sorted(pkg.bufs)} do not match this "
+                f"cache's layout {sorted(want)} (quantization mismatch "
+                f"between the prefill and decode gangs?)")
+        rows = self.cache["k"].shape[2]
+        for n, a in pkg.bufs.items():
+            c = want[n]
+            if a.dtype != c.dtype:
+                raise ValueError(f"package buffer {n!r} dtype {a.dtype} "
+                                 f"!= cache dtype {c.dtype}")
+            if (a.shape[0] != c.shape[0] or a.shape[1] != 1
+                    or a.shape[3:] != c.shape[3:]):
+                raise ValueError(f"package buffer {n!r} shape {a.shape} "
+                                 f"does not fit cache {c.shape}")
+            if a.shape[2] > rows:
+                raise ValueError(f"package width {a.shape[2]} exceeds "
+                                 f"the cache's {rows} rows")
+        if self._ring:
+            if pkg.width != rows:
+                raise ValueError(
+                    f"ring landings must ship the full {rows}-row "
+                    f"capacity, got {pkg.width}")
+        elif pkg.length + max_new > self.max_len:
+            raise ValueError(f"frontier {pkg.length} + {max_new} new "
+                             f"tokens exceeds max_len {self.max_len}")
+        if pkg.length > pkg.width and not self._ring:
+            raise ValueError(f"frontier {pkg.length} exceeds shipped "
+                             f"width {pkg.width}")
+
+    def _admit_prompts(self, pairs, prompts) -> None:
+        """Admit prompt (row, request-index) pairs: group by length
+        bucket and land each group in ONE device dispatch (legacy
+        per-row programs when bucketing is off/ring). Also rebinds each
+        row's rng stream to its new occupant — one scatter of the
+        wave's marshalled keys, not a dispatch per row."""
         if not pairs:
             return
         with self.phase_times.phase("admit"):
@@ -747,15 +1012,25 @@ class ContinuousBatcher:
                 self.cfg)
 
     def _admit_legacy(self, row, req, prompts) -> None:
-        tokens = jnp.asarray(prompts[req], jnp.int32)[None]
         if self._prefix_template is not None:
             self.cache, self.logits = prefix_admit_row(
                 self.params, self.cache, self.logits, row,
-                self._prefix_template, tokens, self.cfg)
+                self._prefix_template,
+                jnp.asarray(prompts[req], jnp.int32)[None], self.cfg)
+        elif self._ring:
+            self.cache, self.logits = admit_row_ring(
+                self.params, self.cache, self.logits, row,
+                jnp.asarray(prompts[req], jnp.int32)[None], self.cfg)
         else:
+            # batch-1 admissions pad to the bucket ladder too: one
+            # compiled program per bucket, not one per distinct length
+            n = len(prompts[req])
+            padded = np.zeros((1, self._bucket_for(n)), np.int64)
+            padded[0, :n] = prompts[req]
             self.cache, self.logits = admit_row(
-                self.params, self.cache, self.logits, row, tokens,
-                self.cfg)
+                self.params, self.cache, self.logits, row,
+                jnp.asarray(padded, jnp.int32),
+                jnp.asarray(n, jnp.int32), self.cfg)
 
     # --- dispatch/fetch seams (overridden by the speculative batcher) ---
 
@@ -967,6 +1242,15 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
                 self.pending, rows, toks, lens, seed_keys, self.cfg,
                 self.draft_cfg, self.temperature, self.top_k, self.top_p)
 
+    def _admit_packages(self, pairs, pkgs) -> None:
+        # EXPLICIT disaggregation exclusion (not an oversight): a
+        # speculative slot needs the DRAFT model's per-slot K/V history
+        # too, which the KV shipment does not carry. Serve speculative
+        # colocated, or disaggregate the greedy/sampled batcher.
+        raise NotImplementedError(
+            "speculative serving is not supported in disaggregated "
+            "mode (the shipment carries no draft-model cache)")
+
     def _admit_legacy(self, row, req, prompts) -> None:
         tokens = jnp.asarray(prompts[req], jnp.int32)[None]
         sub = jax.random.fold_in(self._req_key(req), 0)
@@ -1162,18 +1446,46 @@ class ServeEngine:
         prompt = [int(t) for t in prompt]
         max_new_tokens = int(max_new_tokens)
         self.b._validate_request(prompt, max_new_tokens)
+        self._enqueue(rid, prompt, max_new_tokens, trace_ctx,
+                      prompt_tokens=len(prompt))
+
+    def submit_prefilled(self, rid, package: KVPackage,
+                         max_new_tokens: int,
+                         trace_ctx: dict | None = None) -> None:
+        """Enqueue an ALREADY-PREFILLED request (disaggregated serving):
+        ``package`` is the :class:`KVPackage` a prefill gang shipped —
+        admission lands it with :func:`land_kv_rows` (a scatter, no
+        model forward), so adopting a row never preempts the in-flight
+        decode chunk. Same contract as :meth:`submit` otherwise:
+        caller-chosen ``rid``, up-front validation
+        (:meth:`ContinuousBatcher._validate_package`),
+        ``RuntimeError`` once draining. The shipped rng stream state
+        rides the package, so sampled output matches the colocated
+        engine serving the same request index."""
+        max_new_tokens = int(max_new_tokens)
+        self.b._validate_package(package, max_new_tokens)
+        self._enqueue(rid, package, max_new_tokens, trace_ctx,
+                      prompt_tokens=package.length, prefilled=True)
+
+    def _enqueue(self, rid, payload, max_new_tokens: int,
+                 trace_ctx: dict | None, *, prompt_tokens: int,
+                 **span_attrs) -> None:
+        """The shared admission-queue push behind :meth:`submit` and
+        :meth:`submit_prefilled`: drain/duplicate checks, request
+        registration, the engine-side span pair, and the wakeup — ONE
+        place, so the two admission paths cannot drift."""
         with self._work:
             if self._draining or self._stopped:
                 raise RuntimeError(
                     "engine is draining; not accepting new requests")
             if rid in self._reqs:
                 raise ValueError(f"request id {rid!r} is already active")
-            req = _EngineRequest(rid, prompt, max_new_tokens,
+            req = _EngineRequest(rid, payload, max_new_tokens,
                                  self._next_stream, time.perf_counter())
             tr = tracing.get_tracer()
             req.span = tr.start_span("engine.request", ctx=trace_ctx,
-                                     prompt_tokens=len(prompt),
-                                     budget=max_new_tokens)
+                                     prompt_tokens=prompt_tokens,
+                                     budget=max_new_tokens, **span_attrs)
             req.queued_span = tr.start_span("engine.queued",
                                             parent=req.span)
             self._next_stream += 1
@@ -1219,6 +1531,13 @@ class ServeEngine:
             self._draining = True
             self._stopped = True
             self._work.notify_all()
+
+    def live_requests(self) -> list:
+        """rids accepted and not yet retired (waiting or admitted) —
+        what a transport sweeps when its delivery path dies (the decode
+        server's sink-loss cancel)."""
+        with self._lock:
+            return [rid for rid, r in self._reqs.items() if not r.done]
 
     def stats(self) -> dict:
         """Live occupancy snapshot (the serving server's STATS payload).

@@ -18,7 +18,15 @@ clients on the network:
   router   — ServingRouter: front door spreading sessions across N
              replica servers by the ``tony_serve_queue_depth`` gauge,
              health-checking them, and draining a lost replica's
-             sessions onto survivors with the streamed prefix trimmed
+             sessions onto survivors with the streamed prefix trimmed;
+             disaggregated placement mode (``decode_replicas=``) splits
+             ADMIT placement (prefill tier) from token streaming
+             (decode tier)
+  disagg   — PrefillServer / DecodeServer: the two tiers of
+             disaggregated serving — prefill gangs ship KV packages to
+             decode gangs over TONYC1 tensor channels (kvship is the
+             jax-free wire codec), so decode chunks are never preempted
+             by prefill compute
   netem    — LatencyProxy: deterministic per-direction latency
              injection for the streamed-vs-request/response bench arm
 
@@ -36,6 +44,8 @@ _LAZY = {
                                "ServingConnectionError"),
     "ServingRouter": ("tony_tpu.serving.router", "ServingRouter"),
     "LatencyProxy": ("tony_tpu.serving.netem", "LatencyProxy"),
+    "PrefillServer": ("tony_tpu.serving.disagg", "PrefillServer"),
+    "DecodeServer": ("tony_tpu.serving.disagg", "DecodeServer"),
 }
 
 __all__ = ["ProtocolError", *_LAZY]

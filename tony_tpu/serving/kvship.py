@@ -1,0 +1,172 @@
+"""KV shipment wire codec: how a prefilled row travels prefill gang →
+decode gang (disaggregated serving).
+
+A shipment is ONE opaque blob — JSON metadata plus the row's named
+cache buffers concatenated raw — that rides the TONYC1 tensor plane as
+a single 1-D uint8 tensor frame (:meth:`ChannelSender.send_bytes`), so
+the channel plane needs no knowledge of cache layouts and the shipment
+inherits the channel's bounded-window backpressure, reconnect-with-
+resume, and exactly-once delivery for free.
+
+Wire layout (little-endian)::
+
+    head_len   4 bytes  u32    JSON header length
+    header     head_len bytes  {"v": 1, "meta": {...},
+                                "bufs": [{"name", "dtype", "shape"}...]}
+    payload    concatenated C-contiguous buffer bytes, in header order
+
+``meta`` carries the adoption record: ``rid`` (the router's request
+id), ``budget`` (remaining new tokens), ``length`` (the row's
+frontier), ``rng`` (two u32 words of the per-request stream key) +
+``rng_off`` (stream position — the state that makes SAMPLED
+disaggregated output identical to colocated serving), and an optional
+``trace`` span context so the decode gang's engine spans join the
+request's trace.
+
+Buffers ship in their STORAGE dtype: an int8-quantized cache ships
+int8 values + f32 scales (~half the bytes of dequantizing to bf16 —
+test-pinned), bf16 ships bf16. numpy alone cannot name ``bfloat16``;
+jax's ``ml_dtypes`` dependency can, so dtype resolution falls back to
+it — this module stays importable without jax (the codec tests and any
+jax-free relay can round-trip shipments).
+
+Anything structurally off raises the serving wire's
+:class:`~tony_tpu.serving.protocol.ProtocolError` (channel-scoped at
+the hub, request-scoped at the decode server's landing thread).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+
+import numpy as np
+
+from tony_tpu.serving.protocol import ProtocolError
+
+_HLEN = struct.Struct("<I")
+
+#: sanity cap on the JSON header alone (buffer entries are dozens of
+#: bytes each; megabytes of "header" is a corrupt length prefix)
+MAX_HEADER_BYTES = 1 << 20
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype string, including the ml_dtypes extensions
+    (bfloat16 et al.) plain numpy cannot name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise ProtocolError(f"unknown shipment dtype {name!r}") from e
+
+
+def pack_shipment(meta: dict, bufs: dict) -> bytes:
+    """-> one shipment blob. ``bufs``: {name: ndarray}; arrays are
+    serialized C-contiguous in sorted-name order (deterministic wire
+    bytes for identical inputs)."""
+    entries, blobs = [], []
+    for name in sorted(bufs):
+        a = np.asarray(bufs[name])
+        shape = list(a.shape)          # before ascontiguousarray: it
+        if not a.flags["C_CONTIGUOUS"]:   # promotes 0-d to 1-d
+            a = np.ascontiguousarray(a)
+        entries.append({"name": name, "dtype": str(a.dtype),
+                        "shape": shape})
+        blobs.append(a.tobytes())
+    head = json.dumps({"v": 1, "meta": meta, "bufs": entries},
+                      separators=(",", ":")).encode("utf-8")
+    return _HLEN.pack(len(head)) + head + b"".join(blobs)
+
+
+def unpack_shipment(blob: bytes) -> tuple[dict, dict]:
+    """Parse a shipment blob -> (meta, {name: ndarray}). Arrays view
+    the blob's memory (frombuffer — no copy); callers that outlive the
+    blob hold a reference through the arrays automatically."""
+    if len(blob) < _HLEN.size:
+        raise ProtocolError("shipment shorter than its header prefix")
+    (hlen,) = _HLEN.unpack_from(blob, 0)
+    if hlen > MAX_HEADER_BYTES or _HLEN.size + hlen > len(blob):
+        raise ProtocolError(f"implausible shipment header length {hlen}")
+    try:
+        head = json.loads(blob[_HLEN.size:_HLEN.size + hlen]
+                          .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed shipment header: {e}") from e
+    if not isinstance(head, dict) or not isinstance(head.get("meta"),
+                                                    dict):
+        raise ProtocolError(f"shipment header is not an object: {head!r}")
+    entries = head.get("bufs")
+    if not isinstance(entries, list):
+        raise ProtocolError("shipment header missing buffer table")
+    bufs: dict = {}
+    off = _HLEN.size + hlen
+    for e in entries:
+        if (not isinstance(e, dict) or not isinstance(e.get("name"), str)
+                or not isinstance(e.get("dtype"), str)
+                or not isinstance(e.get("shape"), list)
+                or not all(isinstance(d, int) and not isinstance(d, bool)
+                           and d >= 0 for d in e["shape"])):
+            raise ProtocolError(f"malformed buffer entry: {e!r}")
+        dt = _np_dtype(e["dtype"])
+        # python-int math: np.prod would WRAP on adversarial shapes
+        # ([2**32, 2**32] -> 0), sneaking a bogus buffer past the
+        # bounds check into a reshape crash
+        count = math.prod(e["shape"])
+        n = count * dt.itemsize
+        if off + n > len(blob):
+            raise ProtocolError(
+                f"shipment truncated: buffer {e['name']!r} promises "
+                f"{n} bytes past the blob end")
+        bufs[e["name"]] = np.frombuffer(
+            blob, dtype=dt, count=count,
+            offset=off).reshape(e["shape"])
+        off += n
+    if off != len(blob):
+        raise ProtocolError(
+            f"shipment carries {len(blob) - off} trailing bytes beyond "
+            f"its buffer table")
+    return head["meta"], bufs
+
+
+def pack_kv_meta(rid: int, budget: int, length: int, rng_key,
+                 rng_off: int = 0,
+                 trace: dict | None = None) -> dict:
+    """The adoption-record meta for one prefilled row (see module
+    docstring); ``rng_key`` is the [2] uint32 per-request stream key."""
+    k = np.asarray(rng_key, np.uint32).reshape(-1)
+    meta = {"rid": int(rid), "budget": int(budget),
+            "length": int(length),
+            "rng": [int(k[0]), int(k[1])], "rng_off": int(rng_off)}
+    if trace is not None:
+        meta["trace"] = trace
+    return meta
+
+
+def parse_kv_meta(meta: dict) -> dict:
+    """Validate an adoption record (the decode server's landing thread
+    calls this before touching the engine); returns the meta with
+    ``rng`` normalized to a [2] uint32 array. Malformed -> ProtocolError
+    (the shipment is dropped; the channel keeps delivering)."""
+    rid = meta.get("rid")
+    budget = meta.get("budget")
+    length = meta.get("length")
+    rng = meta.get("rng")
+    off = meta.get("rng_off", 0)
+    if (isinstance(rid, bool) or not isinstance(rid, int)
+            or isinstance(budget, bool) or not isinstance(budget, int)
+            or isinstance(length, bool) or not isinstance(length, int)
+            or isinstance(off, bool) or not isinstance(off, int)):
+        raise ProtocolError(f"malformed shipment meta: {meta!r}")
+    if (not isinstance(rng, list) or len(rng) != 2
+            or not all(isinstance(w, int) and not isinstance(w, bool)
+                       and 0 <= w < (1 << 32) for w in rng)):
+        raise ProtocolError(f"malformed shipment rng state: {rng!r}")
+    out = dict(meta)
+    out["rng"] = np.asarray(rng, np.uint32)
+    return out

@@ -80,10 +80,23 @@ RETIRED = 5
 ERROR = 6
 STATS = 7
 HELLO = 8
+#: s -> c (prefill tier, disaggregated serving): request ``rid``'s
+#: prefill finished and its KV package shipped to the decode gang named
+#: in the JSON payload ({"decode": "host:port", ...}); the router moves
+#: the session's ownership from the prefill link to the decode link on
+#: this frame (a prefill replica dying AFTER it no longer affects the
+#: stream).
+HANDOFF = 9
+#: c -> s (decode tier, disaggregated serving): this connection is the
+#: DELTA SINK — the decode server pushes every KV-adopted row's TOKENS/
+#: RETIRED frames here (rids are the shipper's, globally unique per
+#: router). Last BIND wins; empty payload.
+BIND = 10
 
 FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
                TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
-               STATS: "STATS", HELLO: "HELLO"}
+               STATS: "STATS", HELLO: "HELLO", HANDOFF: "HANDOFF",
+               BIND: "BIND"}
 
 #: sanity bound on one frame's body (type + rid + payload). A prompt of
 #: a million tokens is ~4 MB; anything past this is a corrupt length
@@ -275,6 +288,24 @@ def parse_trace_ctx(payload_or_obj) -> dict | None:
             return {"tid": ctx["tid"], "sid": ctx["sid"]}
     except ProtocolError:
         pass
+    return None
+
+
+def parse_decode_target(obj: dict) -> str | None:
+    """Extract the OPTIONAL disaggregated-serving ``decode`` target
+    from a parsed ADMIT object: ``{"decode": "host:port"}`` names the
+    decode gang's channel-hub endpoint the prefill tier must ship this
+    request's KV package to. None when absent/malformed — a prefill
+    server treats a target-less ADMIT as request-scoped error, a
+    colocated server ignores the field entirely."""
+    addr = obj.get("decode")
+    if isinstance(addr, str) and 0 < len(addr) <= 256:
+        host, _, port = addr.rpartition(":")
+        # a target that cannot dial (no host, non-numeric port) must be
+        # rejected HERE as malformed — downstream it would detonate in
+        # the channel sender on the prefill tier's worker thread
+        if host and port.isdigit() and 0 < int(port) < 65536:
+            return addr
     return None
 
 

@@ -24,10 +24,20 @@ persistent link per replica. Per session it:
   continuations are token-identical, so the client sees no duplicated
   and no dropped tokens (test-enforced).
 
+**Disaggregated placement mode** (``decode_replicas=``): the replica
+set splits into a prefill tier and a decode tier — ADMIT goes to the
+prefill replica with the shallowest queue (naming a decode replica's
+channel endpoint as the KV shipment target), TOKENS stream from the
+decode replica that adopted the row, and the failover contract above
+extends across the split: a decode loss re-prefills unfinished streams
+through a surviving prefill replica. See
+``tony_tpu/serving/disagg.py`` and docs/serving.md §Disaggregated
+prefill/decode.
+
 Router-side series (default registry): ``tony_router_replica_up`` /
 ``tony_router_replica_queue_depth`` (gauges, ``replica=host:port``),
 ``tony_router_sessions_total{replica=...}``,
-``tony_router_failovers_total``.
+``tony_router_failovers_total``, ``tony_router_handoffs_total``.
 
 The router never touches the model stack — it is deployable on a
 jax-free gateway host.
@@ -50,10 +60,15 @@ log = logging.getLogger(__name__)
 
 class _ReplicaLink:
     """One persistent connection to a replica server, with a reader
-    thread dispatching its pushed frames back into the router."""
+    thread dispatching its pushed frames back into the router.
+    ``role`` is the tier this link fronts: ``"engine"`` (a colocated
+    ServingServer), ``"prefill"``, or ``"decode"`` (disaggregated
+    mode)."""
 
-    def __init__(self, addr: str, router: "ServingRouter") -> None:
+    def __init__(self, addr: str, router: "ServingRouter",
+                 role: str = "engine") -> None:
         self.addr = addr
+        self.role = role
         self._router = router
         host, _, port = addr.rpartition(":")
         self._sock = socket.create_connection((host, int(port)),
@@ -79,6 +94,15 @@ class _ReplicaLink:
             self._sock.close()
             raise ConnectionError(f"replica {addr}: no HELLO")
         self.hello = P.unpack_json(hello[2])
+        #: the decode tier's channel-hub endpoint port (what prefill
+        #: replicas are told to ship this gang's KV packages to)
+        self.channel_port = self.hello.get("channel_port")
+        got_role = self.hello.get("role")
+        if role != "engine" and got_role != role:
+            self._sock.close()
+            raise ConnectionError(
+                f"replica {addr} reports role {got_role!r}; the "
+                f"disaggregated router expected a {role!r} tier there")
         self._reader = threading.Thread(
             target=self._read_loop, name=f"tony-router-link-{addr}",
             daemon=True)
@@ -110,10 +134,16 @@ class _ReplicaLink:
                     router._replica_retired(
                         self, rid, obj.get("reason", "unknown"))
                 elif ftype == P.ERROR:
-                    msg = P.unpack_json(payload).get("message", "error")
+                    obj = P.unpack_json(payload)
+                    msg = obj.get("message", "error")
                     if rid == 0:
                         break               # replica dropped our link
-                    router._replica_error(self, rid, msg)
+                    router._replica_error(self, rid, msg,
+                                          retryable=bool(
+                                              obj.get("retryable")))
+                elif ftype == P.HANDOFF:
+                    router._replica_handoff(self, rid,
+                                            P.unpack_json(payload))
                 elif ftype == P.STATS:
                     obj = P.unpack_json(payload)
                     self.reported_load = (int(obj.get("queue_depth", 0))
@@ -139,7 +169,8 @@ class _ReplicaLink:
 
 class _RouterSession:
     __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
-                 "rrid", "cancelled", "trace_ctx")
+                 "prefill_link", "handed_off", "rrid", "cancelled",
+                 "trace_ctx")
 
     def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
                  budget: int, trace_ctx: dict | None = None) -> None:
@@ -148,7 +179,14 @@ class _RouterSession:
         self.prompt = prompt
         self.budget = budget
         self.streamed: list[int] = []       # every token forwarded
+        #: the link TOKENS flow from: the replica itself (colocated) or
+        #: the DECODE link of a disaggregated placement pair
         self.link: _ReplicaLink | None = None
+        #: disaggregated mode only: the prefill link the ADMIT went to;
+        #: once ``handed_off`` (the HANDOFF frame), losing it no longer
+        #: affects this session — the row lives on the decode gang
+        self.prefill_link: _ReplicaLink | None = None
+        self.handed_off = False
         self.rrid = 0
         #: the client asked for this session's death; a failover must
         #: finish it as cancelled, never resurrect it on a survivor
@@ -162,13 +200,31 @@ class _RouterSession:
 class ServingRouter(FrameServerBase):
     """Front-door spreading streaming sessions across replica serving
     hosts. ``replicas``: ``["host:port", ...]`` of running
-    :class:`~tony_tpu.serving.server.ServingServer` instances."""
+    :class:`~tony_tpu.serving.server.ServingServer` instances.
+
+    DISAGGREGATED placement mode (``decode_replicas=``): ``replicas``
+    becomes the PREFILL tier
+    (:class:`~tony_tpu.serving.disagg.PrefillServer`) and
+    ``decode_replicas`` the decode tier
+    (:class:`~tony_tpu.serving.disagg.DecodeServer`). A placement is
+    then a PAIR — the ADMIT goes to the least-loaded prefill replica
+    (queue depth, the STATS gauge) naming the least-loaded decode
+    replica's channel endpoint as the KV shipment target; TOKENS stream
+    back over the decode replica's link (the router BINDs itself as
+    each decode replica's delta sink). A ``HANDOFF`` frame moves the
+    session's fate off the prefill link; losing a DECODE replica
+    re-admits its unfinished streams through a surviving prefill
+    replica with the streamed prefix folded into the prompt — the same
+    zero-dup/zero-drop failover contract as colocated replica loss
+    (test-pinned)."""
 
     def __init__(self, replicas, bind_host: str = "127.0.0.1",
                  port: int = 0, health_interval_s: float = 0.5,
-                 registry=None) -> None:
+                 decode_replicas=None, registry=None) -> None:
         super().__init__(bind_host, port)
         self._replica_addrs = list(replicas)
+        self._decode_addrs = list(decode_replicas or [])
+        self._disagg = bool(self._decode_addrs)
         if not self._replica_addrs:
             raise ValueError("router needs at least one replica")
         self._lock = threading.Lock()
@@ -184,13 +240,20 @@ class ServingRouter(FrameServerBase):
         self._failovers_c = reg.counter(
             "tony_router_failovers_total",
             help="sessions re-admitted after a replica loss")
+        self._handoffs_c = reg.counter(
+            "tony_router_handoffs_total",
+            help="prefill->decode KV handoffs observed (disaggregated "
+                 "placement mode)")
         self._up_g = {}
         self._depth_g = {}
         self._placed_c = {}
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
-        for addr in self._replica_addrs:
+        roles = ([("prefill" if self._disagg else "engine", a)
+                  for a in self._replica_addrs]
+                 + [("decode", a) for a in self._decode_addrs])
+        for role, addr in roles:
             # gauges BEFORE the link: the link's reader thread may run
             # _replica_down (instant replica death) the moment the link
             # exists, and that path writes these gauges
@@ -205,7 +268,17 @@ class ServingRouter(FrameServerBase):
                 "tony_router_sessions_total",
                 help="sessions placed on the replica", replica=addr)
             self._up_g[addr].set(1)
-            self._links.append(_ReplicaLink(addr, self))
+            link = _ReplicaLink(addr, self, role=role)
+            if role == "decode":
+                if link.channel_port is None:
+                    link.close()
+                    raise ConnectionError(
+                        f"decode replica {addr} advertised no "
+                        f"channel_port — not a DecodeServer?")
+                # we are this gang's delta sink: every KV-adopted row's
+                # TOKENS/RETIRED frames push down this link
+                link.send(P.BIND, 0)
+            self._links.append(link)
         port = super().start()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="tony-router-health",
@@ -227,15 +300,26 @@ class ServingRouter(FrameServerBase):
             self._accept_thread.join(timeout=5)
 
     # -- placement ----------------------------------------------------------
-    def _pick_link(self, exclude: _ReplicaLink | None = None):
+    def _pick_link(self, exclude: _ReplicaLink | None = None,
+                   role: str | None = None):
         with self._lock:
             live = [l for l in self._links
-                    if l.alive and l is not exclude]
+                    if l.alive and l is not exclude
+                    and (role is None or l.role == role)]
             if not live:
                 return None
             # gauge first (the metrics-plane signal), local assignment
             # count second (spreads a burst between stats refreshes)
             return min(live, key=lambda l: (l.reported_load, l.assigned))
+
+    def _unassign_locked(self, sess: _RouterSession) -> None:
+        """Release a session's assignment counts (BOTH halves of a
+        disaggregated pair). Call exactly once per removal from
+        ``_by_rrid`` — the pairing invariant the load tiebreak rests
+        on."""
+        for link in {sess.link, sess.prefill_link}:
+            if link is not None:
+                link.assigned -= 1
 
     def _health_loop(self) -> None:
         while not self._stopping.wait(self.health_interval_s):
@@ -265,17 +349,24 @@ class ServingRouter(FrameServerBase):
         if ftype == P.ADMIT:
             self._admit(conn, rid, payload)
         elif ftype == P.CANCEL:
-            # capture (link, rrid) under the SAME lock that marks the
+            # capture (links, rrid) under the SAME lock that marks the
             # cancel: a failover re-placement assigns them as a pair,
             # and an unlocked read could pair the new link with the old
-            # rrid — a CANCEL the surviving replica would no-op
+            # rrid — a CANCEL the surviving replica would no-op. In
+            # disaggregated mode the CANCEL fans to BOTH tiers: the
+            # prefill tier drops a still-queued prompt, the decode tier
+            # tombstones the rid so a late-arriving shipment is never
+            # adopted into a slot generating into the void.
             with self._lock:
                 sess = self._sessions.get((conn.id, rid))
                 if sess is not None:
                     sess.cancelled = True
-                    link, rrid = sess.link, sess.rrid
-            if sess is not None and link is not None:
-                link.send(P.CANCEL, rrid)
+                    links = [l for l in (sess.link, sess.prefill_link)
+                             if l is not None]
+                    rrid = sess.rrid
+            if sess is not None:
+                for link in links:
+                    link.send(P.CANCEL, rrid)
         elif ftype == P.STATS:
             conn.send(P.STATS, 0, P.pack_json(self.stats()))
         elif ftype == P.POLL:
@@ -317,14 +408,25 @@ class ServingRouter(FrameServerBase):
                exclude: _ReplicaLink | None) -> bool:
         """Assign (or re-assign) a session to the least-loaded replica;
         the replica prompt carries the already-streamed prefix so a
-        failover continues exactly where the stream left off. A failed
-        ADMIT send is handled HERE (tear the link down, retry on the
-        next replica): the link's reader thread may already have run
-        its one-shot ``_replica_down`` sweep before this session was
+        failover continues exactly where the stream left off. In
+        disaggregated mode the placement is a PAIR: the ADMIT goes to a
+        prefill link naming a decode link's channel endpoint, and
+        TOKENS will flow back over the decode link. A failed ADMIT send
+        is handled HERE (tear the link down, retry on the next
+        replica): the link's reader thread may already have run its
+        one-shot ``_replica_down`` sweep before this session was
         registered, so relying on it would strand the session."""
-        link = self._pick_link(exclude=exclude)
-        if link is None:
-            return False
+        if self._disagg:
+            plink = self._pick_link(exclude=exclude, role="prefill")
+            dlink = self._pick_link(exclude=exclude, role="decode")
+            if plink is None or dlink is None:
+                return False
+            admit_link, token_link = plink, dlink
+        else:
+            plink = None
+            admit_link = token_link = self._pick_link(exclude=exclude)
+            if admit_link is None:
+                return False
         rrid = next(self._next_rrid)
         with self._lock:
             # the session may have died while it was between homes: a
@@ -338,29 +440,42 @@ class ServingRouter(FrameServerBase):
                 doomed = True
             else:
                 doomed = False
-                sess.link = link
+                sess.link = token_link
+                sess.prefill_link = plink
+                sess.handed_off = False
                 sess.rrid = rrid
                 self._by_rrid[rrid] = sess
-                link.assigned += 1
+                token_link.assigned += 1
+                if plink is not None:
+                    plink.assigned += 1
         if doomed:
             sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
                 {"reason": "cancelled", "tokens": len(sess.streamed)}))
             return True
-        self._placed_c[link.addr].inc()
+        self._placed_c[admit_link.addr].inc()
+        if plink is not None:
+            self._placed_c[token_link.addr].inc()
         # the router's hop in the request trace: placement decision +
         # forwarded ADMIT, as a child of the client's span (only traced
         # requests — an orphan root per placement would be noise)
         if sess.trace_ctx is not None:
             from tony_tpu.runtime import tracing
+            attrs = {"replica": admit_link.addr,
+                     "failover": bool(sess.streamed)}
+            if plink is not None:
+                attrs["decode"] = token_link.addr
             tracing.get_tracer().record_span(
-                "router.place", 0.0, ctx=sess.trace_ctx,
-                replica=link.addr, failover=bool(sess.streamed))
+                "router.place", 0.0, ctx=sess.trace_ctx, **attrs)
         body = {"prompt": sess.prompt + sess.streamed,
                 "max_new_tokens": sess.budget - len(sess.streamed),
                 "stream": True}
+        if plink is not None:
+            # the KV shipment target: the decode gang's channel hub
+            host = token_link.addr.rpartition(":")[0]
+            body["decode"] = f"{host}:{token_link.channel_port}"
         if sess.trace_ctx is not None:
             body["trace"] = sess.trace_ctx
-        ok = link.send(P.ADMIT, rrid, P.pack_json(body))
+        ok = admit_link.send(P.ADMIT, rrid, P.pack_json(body))
         if not ok:
             # re-place ONLY if this placement still owns the session:
             # the link's down-sweep may have re-placed it already (it
@@ -368,16 +483,16 @@ class ServingRouter(FrameServerBase):
             # and a second placement would double-serve the request
             with self._lock:
                 still_mine = (self._by_rrid.get(rrid) is sess
-                              and sess.link is link)
+                              and sess.link is token_link)
                 if still_mine:
                     self._by_rrid.pop(rrid, None)
-                    link.assigned -= 1
-            link.alive = False
-            link.close()
-            self._replica_down(link)        # idempotent; sweeps others
+                    self._unassign_locked(sess)
+            admit_link.alive = False
+            admit_link.close()
+            self._replica_down(admit_link)  # idempotent; sweeps others
             if not still_mine:
                 return True                 # the sweep owns it now
-            return self._place(sess, exclude=link)
+            return self._place(sess, exclude=admit_link)
         return True
 
     def _on_conn_closed(self, conn: FrameConn) -> None:
@@ -387,11 +502,11 @@ class ServingRouter(FrameServerBase):
             for s in doomed:
                 self._sessions.pop((conn.id, s.crid), None)
                 self._by_rrid.pop(s.rrid, None)
-                if s.link is not None:
-                    s.link.assigned -= 1
+                self._unassign_locked(s)
         for s in doomed:
-            if s.link is not None:
-                s.link.send(P.CANCEL, s.rrid)
+            for link in {s.link, s.prefill_link}:
+                if link is not None:
+                    link.send(P.CANCEL, s.rrid)
 
     # -- replica side (link reader threads) ---------------------------------
     def _replica_delta(self, link: _ReplicaLink, rrid: int,
@@ -407,9 +522,15 @@ class ServingRouter(FrameServerBase):
                          reason: str) -> None:
         with self._lock:
             sess = self._by_rrid.pop(rrid, None)
-            if sess is None or sess.link is not link:
-                if sess is not None:
-                    self._by_rrid[rrid] = sess
+            if sess is None:
+                return
+            # the prefill link speaks for a session it still owns (a
+            # CANCEL caught the prompt queued or mid-wave, pre-HANDOFF);
+            # after the handoff its frames for this rrid are stale
+            owns = (sess.link is link
+                    or (sess.prefill_link is link and not sess.handed_off))
+            if not owns:
+                self._by_rrid[rrid] = sess
                 return
             if reason == "stopped":
                 # replica is draining/dying under us: keep the session,
@@ -417,23 +538,66 @@ class ServingRouter(FrameServerBase):
                 self._by_rrid[rrid] = sess
                 return
             self._sessions.pop((sess.conn.id, sess.crid), None)
-            link.assigned -= 1
+            self._unassign_locked(sess)
         sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
             {"reason": reason, "tokens": len(sess.streamed)}))
 
-    def _replica_error(self, link: _ReplicaLink, rrid: int,
-                       msg: str) -> None:
+    def _replica_handoff(self, link: _ReplicaLink, rrid: int,
+                         obj: dict) -> None:
+        """The prefill tier shipped this session's KV package: its fate
+        now rides the decode link alone — a prefill replica dying after
+        this frame costs the session nothing."""
+        with self._lock:
+            sess = self._by_rrid.get(rrid)
+            if sess is None or sess.prefill_link is not link:
+                return                      # stale (failover re-placed)
+            sess.handed_off = True
+        self._handoffs_c.inc()
+
+    def _replica_error(self, link: _ReplicaLink, rrid: int, msg: str,
+                       retryable: bool = False) -> None:
+        """A replica failed this session. ``retryable`` (the prefill
+        tier's kv-ship-failure marker) means the fault is the session's
+        PLACEMENT, not the request: re-place it away from the decode
+        link the shipment could not reach — the same contract as losing
+        that decode link outright, just noticed by the prefill tier
+        first."""
         with self._lock:
             sess = self._by_rrid.pop(rrid, None)
             if sess is None:
                 return
-            self._sessions.pop((sess.conn.id, sess.crid), None)
-            link.assigned -= 1
+            self._unassign_locked(sess)
+            old_link = sess.link
+            retry = retryable and not sess.cancelled
+            if not retry:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+        if retry:
+            # tombstone the old rrid on the decode link the shipment
+            # could not (verifiably) reach: "unreachable" may be a
+            # delivered frame whose ack timed out, and without the
+            # CANCEL a late adoption would burn a decode slot streaming
+            # into a stale rrid (same contract as _replica_down's sweep
+            # of the surviving half)
+            if (old_link is not None and old_link is not link
+                    and old_link.alive):
+                old_link.send(P.CANCEL, rrid)
+            self._failovers_c.inc()
+            if self._place(sess, exclude=old_link):
+                return
+            with self._lock:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+            msg = "no live replicas"
         sess.conn.send(P.ERROR, sess.crid, P.pack_json({"message": msg}))
 
     def _replica_down(self, link: _ReplicaLink) -> None:
         """Replica loss: drain its sessions onto survivors, streamed
-        prefix trimmed into the prompt, remaining budget only."""
+        prefix trimmed into the prompt, remaining budget only. In
+        disaggregated mode a DECODE loss orphans every session
+        streaming from it (they re-prefill — prompt + streamed prefix —
+        through a surviving prefill replica toward a surviving decode
+        replica); a PREFILL loss orphans only sessions it had NOT yet
+        handed off (post-HANDOFF sessions live on the decode gang and
+        keep streaming)."""
         with self._lock:
             if id(link) in self._downed:
                 return
@@ -443,14 +607,23 @@ class ServingRouter(FrameServerBase):
         self._up_g[link.addr].set(0)
         with self._lock:
             orphans = [s for s in self._by_rrid.values()
-                       if s.link is link]
+                       if s.link is link
+                       or (s.prefill_link is link and not s.handed_off)]
             for s in orphans:
                 self._by_rrid.pop(s.rrid, None)
-                link.assigned -= 1
+                self._unassign_locked(s)
         if orphans:
-            log.warning("router: replica %s down; re-admitting %d "
-                        "sessions", link.addr, len(orphans))
+            log.warning("router: replica %s (%s) down; re-admitting %d "
+                        "sessions", link.addr, link.role, len(orphans))
         for sess in orphans:
+            # the surviving half of a split placement holds stale work
+            # for the old rrid: tell it to drop (the prefill tier
+            # unqueues the prompt; the decode tier tombstones the rid
+            # so a late shipment is never adopted)
+            for other in {sess.link, sess.prefill_link}:
+                if (other is not None and other is not link
+                        and other.alive):
+                    other.send(P.CANCEL, sess.rrid)
             if sess.cancelled:
                 # the client already asked for this session's death —
                 # finishing it as cancelled beats resurrecting it on a
@@ -486,12 +659,17 @@ class ServingRouter(FrameServerBase):
             return {
                 "queue_depth": sum(l.reported_load for l in live),
                 "active": len(self._sessions),
+                # in disaggregated mode only decode slots hold rows —
+                # prefill "slots" are wave widths, not capacity
                 "slots": sum(int(l.hello.get("slots", 0))
-                             for l in live),
+                             for l in live
+                             if not self._disagg or l.role == "decode"),
                 "sessions": len(self._sessions),
+                "disaggregated": self._disagg,
                 "replicas": {
                     l.addr: {"up": int(l.alive),
                              "reported_load": l.reported_load,
-                             "assigned": l.assigned}
+                             "assigned": l.assigned,
+                             "role": l.role}
                     for l in self._links},
             }

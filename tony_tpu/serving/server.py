@@ -291,7 +291,9 @@ class ServingServer(FrameServerBase):
 
     # -- frame handling (reader threads) ------------------------------------
     def _hello_payload(self) -> dict:
-        return {"v": 1, "slots": self.batcher.batch}
+        # "role" lets a disaggregation-aware router sanity-check what
+        # it connected to (a colocated engine serves prompts end-to-end)
+        return {"v": 1, "slots": self.batcher.batch, "role": "engine"}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
