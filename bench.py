@@ -295,6 +295,15 @@ def main() -> None:
     # wall visible on the metrics plane (tests/test_disagg.py).
     out.update(_disagg_arm())
 
+    # prefix-aware routing + shared KV prefix tier: sessions placed
+    # where the prefix KV already lives (one replica computes the
+    # prefix once, the other warms in one template ship), suffix-only
+    # admission vs prefix-blind full prefill at 8x prefix reuse.
+    # Deterministic: a prefill floor per forward token + fetch floors;
+    # tier-1 pins serving_prefix_ttft_vs_blind >= 2 and the FLOPs
+    # reduction (tests/test_prefix.py).
+    out.update(_prefix_arm())
+
     # cross-slice MPMD pipeline: the overlapped 1F1B schedule (channel
     # sends ride the bounded window while the device computes the next
     # microbatch) vs serialized stage execution (every tensor hop waits
@@ -892,10 +901,10 @@ def _disagg_arm(slots: int = 4, n_streams: int = 2, n_admits: int = 6,
     class FloorPrefill(PrefillServer):
         """The SAME prefill floor, burned on the prefill gang."""
 
-        def _prefill_group(self, grp, bucket):
+        def _prefill_group(self, grp, bucket, entry=None):
             if prefill_floor_s > 0:
                 time.sleep(prefill_floor_s)
-            super()._prefill_group(grp, bucket)
+            super()._prefill_group(grp, bucket, entry)
 
     rs = np.random.RandomState(17)
     stream_prompts = [[int(t) for t in rs.randint(
@@ -1017,6 +1026,183 @@ def _disagg_arm(slots: int = 4, n_streams: int = 2, n_admits: int = 6,
             itl_colo / max(itl_dis, 1e-9), 2),
         "serving_disagg_handoff_wall_s": round(handoff_wall, 4),
         "serving_disagg_handoffs": handoffs,
+    }
+
+
+def _prefix_arm(slots: int = 2, n_req: int = 8, prefix_len: int = 40,
+                suffix_len: int = 8, budget: int = 4, chunk: int = 2,
+                prefill_s_per_token: float = 0.002,
+                fetch_floor_s: float = 0.01,
+                one_way_s: float = 0.0) -> dict:
+    """Prefix-aware routing + shared prefix tier vs prefix-blind
+    placement, at ``n_req``x reuse of one shared prefix: time-to-first-
+    token and prefill compute (forward tokens — the FLOPs proxy) across
+    a 2-replica fleet behind the router, with token-identical output
+    asserted between the two placements.
+
+    Deterministic: a tiny CPU model plus injected floors — a prefill
+    floor of ``prefill_s_per_token`` per token RUN THROUGH A FORWARD
+    (so a prefix-hit admission's floor is O(suffix) while a blind
+    admission's is O(prefix+suffix), exactly the compute shape on
+    hardware) and a fixed per-sync fetch floor; a warm-up round
+    compiles every program before anything is measured. The AWARE arm
+    is the full tentpole path: the prefix is registered with the
+    router, computed ONCE on replica A (``install``), and replica B
+    warms in ONE template ship (``publish`` — zero prefix forwards on
+    B, asserted); every session then admits only its suffix. The BLIND
+    arm runs the same fleet with no prefix anywhere — every admission
+    pays the full prefill floor. ``one_way_s`` (the @slow variant)
+    routes the client through a LatencyProxy — the TTFT contrast is
+    produced by admission compute, so a WAN hop shifts both arms
+    equally."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.netem import LatencyProxy
+    from tony_tpu.serving.router import ServingRouter
+    from tony_tpu.serving.server import ServingServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+
+    class FloorBatcher(ContinuousBatcher):
+        """Prefill floor proportional to tokens actually run through a
+        forward (the host-side accounting the engine also folds into
+        the metrics plane), plus a fixed per-sync fetch floor."""
+
+        def _admit_prompts(self, pairs, prompts):
+            before = self.prefill_forward_tokens
+            super()._admit_prompts(pairs, prompts)
+            time.sleep(prefill_s_per_token
+                       * (self.prefill_forward_tokens - before))
+
+        def _fetch(self, handle):
+            if fetch_floor_s > 0:
+                time.sleep(fetch_floor_s)
+            return super()._fetch(handle)
+
+    rs = np.random.RandomState(23)
+    prefix = [int(t) for t in rs.randint(0, cfg.vocab_size,
+                                         size=prefix_len)]
+    prompts = [prefix + [int(t) for t in rs.randint(
+        0, cfg.vocab_size, size=suffix_len)] for _ in range(n_req)]
+    max_len = prefix_len + suffix_len + budget
+
+    def run(aware: bool):
+        regr = M.MetricsRegistry()
+        batchers = [FloorBatcher(params, cfg, batch=slots,
+                                 max_len=max_len, chunk=chunk)
+                    for _ in range(2)]
+        servers = [ServingServer(b, registry=M.MetricsRegistry())
+                   for b in batchers]
+        router = None
+        proxy = None
+        c = None
+        try:
+            addrs = [f"127.0.0.1:{s.start()}" for s in servers]
+            pid = None
+            if aware:
+                # the tentpole path: compute ONCE on A, warm B in one
+                # template ship, register with the router
+                pid = servers[0].install_prefix(prefix, prefix_id="sys")
+                ship_bytes = servers[0].publish_prefix(
+                    pid, f"127.0.0.1:{servers[1].prefix_port}")
+                deadline = time.time() + 10
+                while (pid not in batchers[1].resident_prefixes()
+                       and time.time() < deadline):
+                    time.sleep(0.02)
+                assert pid in batchers[1].resident_prefixes(), \
+                    "template ship did not land"
+            else:
+                ship_bytes = 0
+            router = ServingRouter(addrs, registry=regr,
+                                   health_interval_s=0.1)
+            if aware:
+                router.register_prefix(prefix, prefix_id=pid)
+            port = router.start()
+            if one_way_s > 0:
+                proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+                port = proxy.start()
+            c = StreamingClient("127.0.0.1", port)
+            # warm round: compile every admission/step program on both
+            # replicas before anything is measured
+            for p in prompts:
+                c.result(c.submit(p, budget), timeout=120)
+            fwd0 = sum(b.prefill_forward_tokens for b in batchers)
+            outs: list = [None] * n_req
+            ttfts: list = [0.0] * n_req
+
+            def drain(i, rid, t_submit):
+                toks, first = [], None
+                for delta in c.deltas(rid, timeout=120):
+                    if first is None:
+                        first = time.perf_counter()
+                    toks.extend(delta)
+                outs[i] = toks
+                ttfts[i] = (first or time.perf_counter()) - t_submit
+
+            threads = []
+            for i, p in enumerate(prompts):
+                rid = c.submit(p, budget)
+                th = threading.Thread(target=drain,
+                                      args=(i, rid, time.perf_counter()))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            fwd = sum(b.prefill_forward_tokens for b in batchers) - fwd0
+            hits = regr.counter("tony_router_prefix_hits_total").value
+            misses = regr.counter(
+                "tony_router_prefix_misses_total").value
+            if aware:
+                # B warmed by the SHIP: every B admission was a hit, so
+                # its lifetime forward tokens are suffixes only — zero
+                # prefill forwards for the shipped prefix
+                assert batchers[1].prefill_forward_tokens == \
+                    suffix_len * batchers[1].prefix_admits, \
+                    "cold replica ran a prefix forward despite the ship"
+            return (outs, sum(ttfts) / len(ttfts), fwd, hits, misses,
+                    ship_bytes)
+        finally:
+            if c is not None:
+                c.close()
+            if proxy is not None:
+                proxy.stop()
+            if router is not None:
+                router.stop()
+            for s in servers:
+                s.stop()
+
+    outs_blind, ttft_blind, fwd_blind, _, _, _ = run(aware=False)
+    outs_aware, ttft_aware, fwd_aware, hits, misses, ship_bytes = run(
+        aware=True)
+    assert outs_blind == outs_aware, (
+        "prefix-aware serving diverged from prefix-blind — template "
+        "corruption")
+    return {
+        "serving_prefix_reuse": n_req,
+        "serving_prefix_prefill_s_per_token": prefill_s_per_token,
+        "serving_prefix_ttft_blind_s": round(ttft_blind, 4),
+        "serving_prefix_ttft_aware_s": round(ttft_aware, 4),
+        # the tentpole ratio: at >=8x reuse, placing sessions where the
+        # prefix KV lives cuts TTFT by the prefill share the suffix
+        # no longer pays (>= 2 tier-1-pinned)
+        "serving_prefix_ttft_vs_blind": round(
+            ttft_blind / max(ttft_aware, 1e-9), 2),
+        # the FLOPs story: forward tokens in the measured round
+        "serving_prefix_forward_tokens_blind": int(fwd_blind),
+        "serving_prefix_forward_tokens_aware": int(fwd_aware),
+        "serving_prefix_forward_vs_blind": round(
+            fwd_blind / max(fwd_aware, 1), 2),
+        # every prefix session landed on a resident replica
+        "serving_prefix_hit_rate": round(
+            hits / max(hits + misses, 1), 3),
+        "serving_prefix_ship_bytes": int(ship_bytes),
     }
 
 

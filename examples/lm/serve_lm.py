@@ -49,6 +49,29 @@ stall in-flight decode chunks —
     python examples/lm/serve_lm.py --preset tiny --requests 12 \
         --slots 4 --disaggregate
 
+Prefix-aware routing (docs/serving.md §Prefix-aware routing): register
+a shared system prompt, compute its KV template ONCE, warm the other
+replica in one template ship, and let the router place every session
+where the prefix already lives —
+
+    # replica B first, cold. Size --prompt_len to fit prefix+suffix:
+    # a replica whose max_len leaves no room for the shipped prefix
+    # rejects the template (request-scoped) and serves prefix-blind
+    python examples/lm/serve_lm.py --preset tiny --slots 4 \
+        --prompt_len 96 --listen 0.0.0.0:7071 &
+    # replica A computes the prefix template and warms replica B in
+    # ONE template ship (B runs zero prefix forwards)
+    python examples/lm/serve_lm.py --preset tiny --slots 4 \
+        --prompt_len 96 --listen 0.0.0.0:7070 \
+        --shared_prefix_file sys_prompt.txt \
+        --publish_prefix host2:7071
+    # the router matches prompts against the registered prefix
+    python examples/lm/serve_lm.py --listen 0.0.0.0:7000 \
+        --route host1:7070,host2:7071 --shared_prefix_file sys_prompt.txt
+    # prefix-heavy client traffic (every prompt continues the prefix)
+    python examples/lm/serve_lm.py --preset tiny --requests 12 \
+        --connect host1:7000 --shared_prefix_file sys_prompt.txt
+
 The reference framework has no serving path (it delegates all compute —
 SURVEY.md §2.3); this example exists so a user migrating from it can see
 the green-field serving stack end to end.
@@ -75,6 +98,43 @@ def _parse_addr(addr: str) -> tuple[str, int]:
     return host or "127.0.0.1", int(port)
 
 
+def _load_prefix_tokens(path: str) -> list[int]:
+    """Token ids from a whitespace/comma-separated file — the shared
+    prefix (system prompt) the prefix-aware demo paths register,
+    install, publish, and continue."""
+    with open(path) as f:
+        toks = [int(t) for t in f.read().replace(",", " ").split()]
+    if not toks:
+        raise SystemExit(f"{path}: no tokens")
+    return toks
+
+
+def _install_and_publish(args, server) -> None:
+    """--shared_prefix_file on a serving host: make the prefix resident
+    (ONE local prefill); --publish_prefix additionally warms a peer
+    replica in one template ship over its prefix lane (the peer runs
+    ZERO prefix forwards — docs/serving.md §Prefix-aware routing)."""
+    toks = _load_prefix_tokens(args.shared_prefix_file)
+    pid = server.install_prefix(toks)
+    if pid is None:
+        print("prefix NOT resident (rolling-cache layout); serving "
+              "prefix-blind", flush=True)
+        return
+    print(f"prefix {pid} resident ({len(toks)} tokens)", flush=True)
+    if args.publish_prefix:
+        from tony_tpu.serving.client import StreamingClient
+
+        host, port = _parse_addr(args.publish_prefix)
+        with StreamingClient(host, port) as peer:
+            lane = peer.hello.get("prefix_port")
+        if lane is None:
+            raise SystemExit(f"{args.publish_prefix} advertises no "
+                             f"prefix lane")
+        n = server.publish_prefix(pid, f"{host}:{lane}")
+        print(f"published prefix {pid} to {host}:{lane} ({n} bytes — "
+              f"the peer warmed without recomputing)", flush=True)
+
+
 def _run_server(args, batcher) -> int:
     """--listen: drive the batcher's ServeEngine behind a streaming
     server until interrupted, then drain gracefully."""
@@ -83,6 +143,8 @@ def _run_server(args, batcher) -> int:
     host, port = _parse_addr(args.listen)
     server = ServingServer(batcher, bind_host=host, port=port)
     bound = server.start()
+    if args.shared_prefix_file:
+        _install_and_publish(args, server)
     mode = ("speculative " if args.draft_preset else "") + (
         "sampled" if args.temperature > 0 else "greedy")
     print(f"serving {args.preset} ({mode}) on {host}:{bound} with "
@@ -108,6 +170,11 @@ def _run_router(args) -> int:
                if a.strip()]
     router = ServingRouter(replicas, bind_host=host, port=port,
                            decode_replicas=decodes or None)
+    if args.shared_prefix_file:
+        pid = router.register_prefix(
+            _load_prefix_tokens(args.shared_prefix_file))
+        print(f"prefix {pid} registered for tokenized matching",
+              flush=True)
     bound = router.start()
     shape = (f"{len(replicas)} prefill + {len(decodes)} decode replicas"
              if decodes else f"{len(replicas)} replicas")
@@ -127,11 +194,16 @@ def _run_prefill(args, params, cfg) -> int:
     from tony_tpu.serving.disagg import PrefillServer
 
     host, port = _parse_addr(args.listen)
+    shared = (_load_prefix_tokens(args.shared_prefix_file)
+              if args.shared_prefix_file else [])
     server = PrefillServer(params, cfg,
-                           max_len=args.prompt_len + args.max_new_tokens,
+                           max_len=(len(shared) + args.prompt_len
+                                    + args.max_new_tokens),
                            seed=args.seed, max_batch=args.slots,
                            bind_host=host, port=port)
     bound = server.start()
+    if args.shared_prefix_file:
+        _install_and_publish(args, server)
     print(f"prefill tier ({args.preset}) on {host}:{bound} "
           f"({args.slots}-row waves) — ^C exits", flush=True)
     try:
@@ -175,13 +247,22 @@ def _run_disaggregate(args, params, cfg, batcher, prompts,
     from tony_tpu.serving.disagg import DecodeServer, PrefillServer
     from tony_tpu.serving.router import ServingRouter
 
-    max_len = args.prompt_len + args.max_new_tokens
+    shared = (_load_prefix_tokens(args.shared_prefix_file)
+              if args.shared_prefix_file else [])
+    max_len = len(shared) + args.prompt_len + args.max_new_tokens
     reg = M.get_default()
     pre = PrefillServer(params, cfg, max_len=max_len, seed=args.seed,
                         max_batch=args.slots)
     dec = DecodeServer(batcher)
     router = ServingRouter([f"127.0.0.1:{pre.start()}"],
                            decode_replicas=[f"127.0.0.1:{dec.start()}"])
+    if shared:
+        pid = pre.install_prefix(shared)
+        if pid is not None:
+            router.register_prefix(shared, prefix_id=pid)
+            print(f"prefix {pid} resident at the prefill tier "
+                  f"({len(shared)} tokens); suffix-only prefill waves",
+                  flush=True)
     rport = router.start()
     print(f"disaggregated: prefill :{pre.port} -> decode :{dec.port} "
           f"(kv channel :{dec.hub.port}), router :{rport}", flush=True)
@@ -245,8 +326,14 @@ def _run_client(args) -> int:
     host, port = _parse_addr(args.connect)
     vocab = T.PRESETS[args.preset].vocab_size
     rs = np.random.RandomState(args.seed)
-    prompts = [[int(t) for t in rs.randint(0, vocab,
-                                           size=args.prompt_len)]
+    # with a shared prefix the workload is PREFIX-HEAVY: every prompt
+    # continues the same system prompt (the router's tokenized match
+    # finds it — no prefix id is sent; the prefix-aware fleet places
+    # each session where the prefix KV already lives)
+    shared = (_load_prefix_tokens(args.shared_prefix_file)
+              if args.shared_prefix_file else [])
+    prompts = [shared + [int(t) for t in rs.randint(0, vocab,
+                                                    size=args.prompt_len)]
                for _ in range(args.requests)]
     budgets = [int(b) for b in
                rs.randint(max(1, args.max_new_tokens // 4),
@@ -366,7 +453,27 @@ def main() -> int:
                              "and stream the synthetic workload "
                              "through the split (the one-command demo; "
                              "--role is the real multi-host shape)")
+    parser.add_argument("--shared_prefix_file", default="",
+                        metavar="PATH",
+                        help="token-id file of a shared prefix (system "
+                             "prompt). Server/prefill: install its KV "
+                             "template (prefix-hit admissions run only "
+                             "their suffix); router: register it for "
+                             "tokenized matching; client: prepend it "
+                             "to every synthetic prompt (prefix-heavy "
+                             "traffic)")
+    parser.add_argument("--publish_prefix", default="",
+                        metavar="HOST:PORT",
+                        help="with --listen + --shared_prefix_file: "
+                             "after installing, warm the peer replica "
+                             "at this serving address in ONE template "
+                             "ship over its prefix lane (the peer "
+                             "recomputes nothing)")
     args = parser.parse_args()
+    if args.publish_prefix and not (args.shared_prefix_file
+                                    and args.listen):
+        parser.error("--publish_prefix requires --listen and "
+                     "--shared_prefix_file")
 
     if args.connect:
         return _run_client(args)
@@ -406,14 +513,17 @@ def main() -> int:
         return _run_prefill(args, params, cfg)
 
     rs = np.random.RandomState(args.seed)
-    # mixed lengths and budgets — the workload shape slot reuse exists for
-    prompts = [list(rs.randint(0, cfg.vocab_size,
-                               size=args.prompt_len))
+    shared = (_load_prefix_tokens(args.shared_prefix_file)
+              if args.shared_prefix_file else [])
+    # mixed lengths and budgets — the workload shape slot reuse exists
+    # for; with a shared prefix every prompt continues it
+    prompts = [shared + list(rs.randint(0, cfg.vocab_size,
+                                        size=args.prompt_len))
                for _ in range(args.requests)]
     budgets = [int(b) for b in
                rs.randint(max(1, args.max_new_tokens // 4),
                           args.max_new_tokens + 1, size=args.requests)]
-    max_len = args.prompt_len + args.max_new_tokens
+    max_len = len(shared) + args.prompt_len + args.max_new_tokens
 
     kw = dict(batch=args.slots, max_len=max_len,
               temperature=args.temperature, top_k=args.top_k,
@@ -444,6 +554,14 @@ def main() -> int:
                                  budgets)
     if args.listen:
         return _run_server(args, batcher)
+
+    if shared:
+        # the local demo of the admission fast path: resident template,
+        # suffix-only admissions, token-identical output
+        from tony_tpu.serving.prefix import fingerprint
+        if batcher.install_prefix(fingerprint(shared), shared):
+            print(f"prefix resident locally ({len(shared)} tokens); "
+                  f"prefix-hit admissions run suffix-only")
 
     t0 = time.perf_counter()
     outputs = batcher.serve(prompts, budgets)

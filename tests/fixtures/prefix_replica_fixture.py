@@ -1,0 +1,59 @@
+"""One serving replica for the prefix-aware routing e2e: FOUR real
+ServingServers in one process — (blind, aware) x (greedy, sampled) —
+so the prefix-aware pass and the prefix-blind contrast pass each run
+against engines whose per-request stream indices start at 0 (what
+makes the sampled runs comparable request-for-request). The driver
+installs the shared prefix on replica A's "aware" servers and warms
+replica B's over the template-ship lane; the "blind" servers are never
+touched. Writes {name: {"port": .., "prefix_port": ..}} to --port_file
+(atomic JSON) and serves until --done_file appears, then drains and
+exits 0. Model/config/seed pinned to match the driver's references
+bit-for-bit."""
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--port_file", default=".replica-ports")
+    ap.add_argument("--done_file", default=".prefix-done")
+    ap.add_argument("--timeout_s", type=float, default=240.0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from tony_tpu.models import transformer as T
+    from tony_tpu.models.serve import ContinuousBatcher
+    from tony_tpu.serving.server import ServingServer
+
+    cfg = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+    params = T.init_params(jax.random.PRNGKey(0), cfg)
+    sampled = dict(temperature=0.8, top_k=20, top_p=0.9)
+    servers = {}
+    for pass_name in ("blind", "aware"):
+        for mode, kw in (("greedy", {}), ("sampled", sampled)):
+            batcher = ContinuousBatcher(params, cfg, batch=2, max_len=64,
+                                        chunk=3, seed=7, **kw)
+            servers[f"{pass_name}_{mode}"] = ServingServer(batcher)
+    ports = {name: {"port": s.start(), "prefix_port": s.prefix_port}
+             for name, s in servers.items()}
+    tmp = args.port_file + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(ports, f)
+    os.replace(tmp, args.port_file)
+    print(f"prefix replica serving on {ports}", flush=True)
+    deadline = time.time() + args.timeout_s
+    while not os.path.exists(args.done_file) and time.time() < deadline:
+        time.sleep(0.1)
+    for s in servers.values():
+        s.stop(drain=True)
+    print("prefix replica done", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -540,9 +540,9 @@ class _GatedPrefill(PrefillServer):
         super().__init__(*a, **kw)
         self.gate = threading.Event()
 
-    def _prefill_group(self, grp, bucket):
+    def _prefill_group(self, grp, bucket, entry=None):
         self.gate.wait(timeout=60)
-        super()._prefill_group(grp, bucket)
+        super()._prefill_group(grp, bucket, entry)
 
 
 class _BoomWavePrefill(PrefillServer):
@@ -563,13 +563,13 @@ class _BoomWavePrefill(PrefillServer):
         self.take_gate.wait(timeout=60)
         return super()._take_wave()
 
-    def _prefill_group(self, grp, bucket):
+    def _prefill_group(self, grp, bucket, entry=None):
         if not self._boomed:
             self._boomed = True
             self.in_wave.set()
             self.resume.wait(timeout=60)
             raise RuntimeError("injected wave failure")
-        super()._prefill_group(grp, bucket)
+        super()._prefill_group(grp, bucket, entry)
 
 
 def _package_blob(params, cfg, rid, budget, prompt=(3, 1, 4, 1, 5),

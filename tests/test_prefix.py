@@ -1,0 +1,846 @@
+"""Prefix-aware routing + the shared KV prefix tier: prefix identity
+and matching, the template wire codec (every cache layout round-trips
+through a real socket pair bit-identically; adversarial blobs are
+request-scoped), the engine admission fast path (token-identical to
+prefix-blind full prefill in every mode; a shipped template warms a
+replica with ZERO prefix forwards), router placement (residency
+preference, idle-slot tiebreak, ring degradation), the PREFIX wire
+ops, and the deterministic bench-arm pins.
+
+The two-REAL-process warm-ship acceptance pin lives at the bottom
+(fixture: tests/fixtures/prefix_replica_fixture.py x2 — router + two
+replicas, one warmed by a template ship).
+
+Compile frugality: one tiny f32 config for everything except the
+per-layout codec cases (single prefills, not serve loops).
+"""
+
+import json
+import logging
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.models import transformer as T
+from tony_tpu.models.decode import generate
+from tony_tpu.models.serve import (ContinuousBatcher,
+                                   SpeculativeContinuousBatcher)
+from tony_tpu.runtime import metrics as M
+from tony_tpu.serving import kvship
+from tony_tpu.serving import protocol as P
+from tony_tpu.serving.client import StreamingClient
+from tony_tpu.serving.prefix import fingerprint, match_prefix
+from tony_tpu.serving.router import ServingRouter
+from tony_tpu.serving.server import ServingServer
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)          # for `import bench` (repo-root script)
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "fixtures")
+
+CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _reference(params, prompt, max_new):
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None], CFG,
+                   max_new_tokens=max_new, rng=jax.random.PRNGKey(0),
+                   temperature=0.0)
+    return [int(t) for t in np.asarray(out.tokens[0, len(prompt):])]
+
+
+def _prefix_and_suffixes(seed, prefix_len, suffix_lens, vocab=None):
+    rs = np.random.RandomState(seed)
+    v = vocab or CFG.vocab_size
+    prefix = [int(t) for t in rs.randint(0, v, size=prefix_len)]
+    return prefix, [[int(t) for t in rs.randint(0, v, size=n)]
+                    for n in suffix_lens]
+
+
+def _wait_resident(host, pid, timeout=20.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if pid in host.resident_prefixes():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Identity + matching (jax-free)
+# ---------------------------------------------------------------------------
+class TestPrefixIdentity:
+    def test_fingerprint_is_content_derived(self):
+        assert fingerprint([1, 2, 3]) == fingerprint([1, 2, 3])
+        assert fingerprint([1, 2, 3]) != fingerprint([1, 2, 4])
+        assert fingerprint([1, 2]) != fingerprint([1, 2, 0])
+        assert len(fingerprint(list(range(100)))) == 16
+
+    def test_match_prefix_longest_proper_boundary(self):
+        catalog = {"a": [1, 2], "b": [1, 2, 3], "c": [9]}
+        # longest wins
+        assert match_prefix([1, 2, 3, 4], catalog) == "b"
+        # a prompt that IS a catalog entry leaves no suffix: only the
+        # shorter entry is a PROPER prefix
+        assert match_prefix([1, 2, 3], catalog) == "a"
+        assert match_prefix([1, 2], catalog) is None  # only improper
+        assert match_prefix([2, 1, 3], catalog) is None
+        assert match_prefix([], {}) is None
+
+
+# ---------------------------------------------------------------------------
+# Template codec: every layout round-trips through a real socket pair
+# ---------------------------------------------------------------------------
+class TestTemplateCodec:
+    LAYOUTS = {
+        "f32": dict(),
+        "bf16": dict(dtype=jnp.bfloat16),
+        "int8": dict(kv_cache_dtype="int8"),
+        "window": dict(attn_window=8),
+    }
+
+    def _ship_blob(self, blob):
+        """One real socket hop: sendall on one end, drain the other."""
+        a, b = socket.socketpair()
+        got = bytearray()
+
+        def _drain():
+            while len(got) < len(blob):
+                chunk = b.recv(65536)
+                if not chunk:
+                    return
+                got.extend(chunk)
+
+        t = threading.Thread(target=_drain)
+        t.start()
+        try:
+            a.sendall(blob)
+            t.join(timeout=30)
+        finally:
+            a.close()
+            b.close()
+        return bytes(got)
+
+    @pytest.mark.parametrize("layout", sorted(LAYOUTS))
+    def test_socket_round_trip_installs_bit_identical(self, layout):
+        """install on A -> pack -> REAL socket -> unpack -> install on
+        B: B's resident template buffers are bit-identical to A's, for
+        every template-capable cache layout (f32, bf16, int8+scales,
+        sliding-window), and B ran ZERO prefill forwards to get there.
+        int8 templates stay in STORAGE dtype on the wire (int8 values +
+        f32 scales, like KV row shipments)."""
+        cfg = CFG.scaled(**self.LAYOUTS[layout])
+        p = T.init_params(jax.random.PRNGKey(0), cfg)
+        prefix = [3, 1, 4, 1, 5, 9, 2, 6]
+        src = ContinuousBatcher(p, cfg, batch=1, max_len=32)
+        assert src.install_prefix("sys", prefix)
+        blob = src.export_prefix_blob("sys")
+
+        meta, bufs = kvship.unpack_template(self._ship_blob(blob))
+        dst = ContinuousBatcher(p, cfg, batch=1, max_len=32)
+        assert dst.install_prefix_template(meta, bufs) == "sys"
+        assert dst.prefill_forward_tokens == 0
+
+        a = src._prefix_store["sys"].template
+        b = dst._prefix_store["sys"].template
+        assert set(a) == set(b)
+        for name in a:
+            na, nb = np.asarray(a[name]), np.asarray(b[name])
+            assert na.dtype == nb.dtype, name
+            assert na.tobytes() == nb.tobytes(), name
+        if layout == "int8":
+            assert any(np.asarray(v).dtype == np.int8
+                       for v in bufs.values())
+
+    def test_truncated_and_mistagged_blobs_are_protocol_errors(self,
+                                                               params):
+        src = ContinuousBatcher(params, CFG, batch=1, max_len=32)
+        src.install_prefix("sys", [1, 2, 3, 4])
+        blob = src.export_prefix_blob("sys")
+        for cut in (1, 10, len(blob) // 2, len(blob) - 1):
+            with pytest.raises(P.ProtocolError):
+                kvship.unpack_template(blob[:cut])
+        # a KV ROW shipment routed onto the template lane is refused by
+        # its kind tag, not silently misread
+        key = np.zeros(2, np.uint32)
+        row_blob = kvship.pack_shipment(
+            kvship.pack_kv_meta(1, 4, 3, key, rng_off=0),
+            {"k": np.zeros((2, 1, 3, 1, 4), np.float32)})
+        with pytest.raises(P.ProtocolError, match="not a prefix"):
+            kvship.unpack_template(row_blob)
+
+    def test_wrong_vocab_and_wrong_layers_rejected_at_install(self,
+                                                              params):
+        """A template from a differently-shaped model is a
+        request-scoped ValueError at install — never garbage K/V
+        discovered mid-serve, never engine death."""
+        batcher = ContinuousBatcher(params, CFG, batch=1, max_len=32)
+        src = ContinuousBatcher(params, CFG, batch=1, max_len=32)
+        src.install_prefix("sys", [1, 2, 3])
+        meta, bufs = kvship.unpack_template(src.export_prefix_blob("sys"))
+
+        wrong_vocab = dict(meta, vocab=CFG.vocab_size + 1)
+        with pytest.raises(ValueError, match="vocab"):
+            batcher.install_prefix_template(wrong_vocab, bufs)
+
+        lcfg = CFG.scaled(n_layers=1)
+        lsrc = ContinuousBatcher(
+            T.init_params(jax.random.PRNGKey(0), lcfg), lcfg,
+            batch=1, max_len=32)
+        lsrc.install_prefix("sys", [1, 2, 3])
+        lmeta, lbufs = kvship.unpack_template(
+            lsrc.export_prefix_blob("sys"))
+        with pytest.raises(ValueError, match="layer"):
+            batcher.install_prefix_template(lmeta, lbufs)
+
+        # the batcher is unharmed either way: nothing resident, serving
+        # works
+        assert batcher.resident_prefixes() == []
+        assert batcher.serve([[5, 6, 7]], 3) == [
+            _reference(params, [5, 6, 7], 3)]
+
+    def test_garbage_on_the_live_lane_costs_only_itself(self, params):
+        """The REAL install path: garbage and a wrong-vocab template
+        shipped onto a running server's prefix lane are dropped by the
+        install thread — the replica keeps serving and stays unwarmed,
+        and a subsequent GOOD ship still lands."""
+        from tony_tpu.channels.channel import ChannelSender
+
+        server = ServingServer(
+            ContinuousBatcher(params, CFG, batch=1, max_len=32),
+            registry=M.MetricsRegistry())
+        try:
+            server.start()
+            target = f"127.0.0.1:{server.prefix_port}"
+            s = ChannelSender(target, "prefix", window=2,
+                              registry=M.MetricsRegistry())
+            try:
+                s.send_bytes(b"not a template at all", sync=True,
+                             timeout=20)
+                wrong = kvship.pack_template(
+                    "sys", [1, 2, 3],
+                    {"k": np.zeros((2, 1, 3, 1, 4), np.float32)},
+                    vocab=CFG.vocab_size + 7)
+                s.send_bytes(wrong, sync=True, timeout=20)
+            finally:
+                s.close(drain=False)
+            time.sleep(0.3)
+            assert server.resident_prefixes() == []
+
+            src = ContinuousBatcher(params, CFG, batch=1, max_len=32)
+            src.install_prefix("sys", [1, 2, 3, 4])
+            s2 = ChannelSender(target, "prefix", window=2,
+                               registry=M.MetricsRegistry())
+            try:
+                s2.send_bytes(src.export_prefix_blob("sys"), sync=True,
+                              timeout=20)
+            finally:
+                s2.close(drain=False)
+            assert _wait_resident(server, "sys"), \
+                "good ship did not land after garbage"
+            with StreamingClient("127.0.0.1", server.port) as c:
+                out, reason = c.result(c.submit([5, 6, 7], 3))
+            assert reason in ("eos", "budget")
+            assert out == _reference(params, [5, 6, 7], 3)
+        finally:
+            server.kill()
+
+
+# ---------------------------------------------------------------------------
+# Engine admission fast path: token-identical, fewer forward tokens
+# ---------------------------------------------------------------------------
+class TestEngineFastPath:
+    def _serve(self, params, prompts, budgets, install=None, **kw):
+        b = ContinuousBatcher(params, CFG, batch=2, max_len=64, chunk=3,
+                              **kw)
+        if install is not None:
+            assert b.install_prefix(fingerprint(install), install)
+        outs = b.serve(prompts, budgets)
+        return outs, b
+
+    @pytest.mark.parametrize("mode", ["greedy", "sampled"])
+    def test_token_identity_vs_prefix_blind(self, params, mode):
+        """Prefix-hit admissions (auto-matched — no id anywhere) are
+        token-identical to prefix-blind full prefill, greedy AND
+        sampled, across a mixed workload (hits + a non-matching
+        prompt)."""
+        kw = (dict(temperature=0.9, top_k=12, top_p=0.95, seed=11)
+              if mode == "sampled" else {})
+        prefix, suffixes = _prefix_and_suffixes(3, 17, (4, 2, 6, 3))
+        prompts = [prefix + s for s in suffixes]
+        prompts.insert(2, [7] * 9)          # prefix-blind bystander
+        budgets = [5, 7, 4, 6, 5]
+        blind, _ = self._serve(params, prompts, budgets, **kw)
+        aware, b = self._serve(params, prompts, budgets, install=prefix,
+                               **kw)
+        assert aware == blind
+        assert b.prefix_admits == 4
+        assert b.prefix_copied_tokens == 4 * len(prefix)
+        # install cost (one prefill) + suffixes + the bystander — never
+        # the hits' prefix positions
+        assert b.prefill_forward_tokens == (
+            len(prefix) + sum(len(s) for s in suffixes) + 9)
+
+    def test_speculative_token_identity(self, params):
+        prefix, suffixes = _prefix_and_suffixes(5, 11, (3, 5, 2))
+        prompts = [prefix + s for s in suffixes]
+        budgets = [6, 4, 7]
+
+        def run(install):
+            b = SpeculativeContinuousBatcher(
+                params, CFG, params, CFG, batch=2, max_len=64,
+                num_speculative=3, chunk=2)
+            if install:
+                assert b.install_prefix("sys", prefix)
+            return b.serve(prompts, budgets), b
+
+        blind, _ = run(False)
+        aware, b = run(True)
+        assert aware == blind
+        assert b.prefix_admits == 3
+        # the draft template was computed at install (entry hook), so
+        # draft-side admission never re-prefilled the prefix either
+        assert b._prefix_store["sys"].draft_template is not None
+
+    def test_shipped_template_serves_with_zero_prefix_forwards(self,
+                                                               params):
+        """The warm replica's whole point: install from a SHIPPED
+        template, serve a prefix-heavy workload, and the lifetime
+        forward-token count is suffixes only."""
+        prefix, suffixes = _prefix_and_suffixes(9, 21, (3, 4, 2, 5))
+        src = ContinuousBatcher(params, CFG, batch=2, max_len=64)
+        src.install_prefix("sys", prefix)
+        meta, bufs = kvship.unpack_template(src.export_prefix_blob("sys"))
+
+        warm = ContinuousBatcher(params, CFG, batch=2, max_len=64,
+                                 chunk=3)
+        warm.install_prefix_template(meta, bufs)
+        prompts = [prefix + s for s in suffixes]
+        blind = ContinuousBatcher(params, CFG, batch=2, max_len=64,
+                                  chunk=3).serve(prompts, 5)
+        assert warm.serve(prompts, 5) == blind
+        assert warm.prefill_forward_tokens == sum(
+            len(s) for s in suffixes)
+        assert warm.prefix_admits == len(suffixes)
+
+    def test_explicit_id_and_wrong_id_are_both_safe(self, params):
+        """submit(prefix_id=) takes the named entry when the prompt
+        really continues it; a wrong/unknown id falls back (tokenized
+        match, then full prefill) — outputs identical in every case."""
+        from tony_tpu.models.serve import ServeEngine
+
+        prefix, (sfx,) = _prefix_and_suffixes(13, 9, (4,))
+        prompt = prefix + sfx
+        ref = _reference(params, prompt, 5)
+
+        for pid in ("sys", "no-such-prefix", None):
+            b = ContinuousBatcher(params, CFG, batch=1, max_len=64,
+                                  chunk=3)
+            assert b.install_prefix("sys", prefix)
+            outs = {}
+            eng = ServeEngine(
+                b, on_delta=lambda r, t: outs.setdefault(r, []).extend(t),
+                on_retired=lambda r, reason, n, final:
+                    outs.setdefault(r, []).extend(final),
+                registry=M.MetricsRegistry())
+            eng.submit("r1", prompt, 5, prefix_id=pid)
+            th = threading.Thread(target=eng.run)
+            th.start()
+            eng.drain()
+            th.join(timeout=60)
+            assert outs["r1"] == ref, pid
+            assert b.prefix_admits == 1, pid    # matched under any id
+
+    def test_prompt_equal_to_prefix_is_not_a_hit(self, params):
+        """A prompt that IS the prefix leaves no suffix to run — it
+        must full-prefill (proper-prefix contract), same tokens."""
+        prefix, _ = _prefix_and_suffixes(15, 12, ())
+        b = ContinuousBatcher(params, CFG, batch=1, max_len=64, chunk=3)
+        assert b.install_prefix("sys", prefix)
+        assert b.serve([prefix], 4) == [_reference(params, prefix, 4)]
+        assert b.prefix_admits == 0
+
+    def test_install_validation(self, params):
+        b = ContinuousBatcher(params, CFG, batch=1, max_len=16)
+        with pytest.raises(ValueError, match="non-empty"):
+            b.install_prefix("x", [])
+        with pytest.raises(ValueError, match="no room"):
+            b.install_prefix("x", list(range(15)))
+        legacy = ContinuousBatcher(params, CFG, batch=1, max_len=32,
+                                   shared_prefix=[1, 2, 3])
+        with pytest.raises(ValueError, match="shared_prefix"):
+            legacy.install_prefix("x", [4, 5])
+        hit_overflow = ContinuousBatcher(params, CFG, batch=1,
+                                         max_len=16)
+        assert hit_overflow.install_prefix("s", list(range(10)))
+        from tony_tpu.models.serve import ServeEngine
+        eng = ServeEngine(hit_overflow, on_delta=lambda r, t: None,
+                          on_retired=lambda r, reason, n, final: None,
+                          registry=M.MetricsRegistry())
+        with pytest.raises(ValueError, match="exceeds"):
+            eng.submit("r", list(range(10)) + [1, 2], 8)
+
+
+# ---------------------------------------------------------------------------
+# Ring caches degrade prefix-blind (warning, never an error)
+# ---------------------------------------------------------------------------
+class TestRingDegrade:
+    RING = dict(attn_window=8, kv_cache_capacity=8)
+
+    def test_batcher_degrades_with_one_warning(self, caplog):
+        cfg = CFG.scaled(**self.RING)
+        p = T.init_params(jax.random.PRNGKey(0), cfg)
+        b = ContinuousBatcher(p, cfg, batch=1, max_len=32)
+        with caplog.at_level(logging.WARNING, "tony_tpu.models.serve"):
+            assert b.install_prefix("sys", [1, 2, 3]) is False
+            assert b.install_prefix("sys2", [4, 5]) is False
+        warns = [r for r in caplog.records
+                 if "ring" in r.getMessage()]
+        assert len(warns) == 1                  # once, not per install
+        assert b.resident_prefixes() == []
+        # prefix-id admissions still serve, prefix-blind
+        ref = generate(p, jnp.asarray([5, 6, 7], jnp.int32)[None], cfg,
+                       max_new_tokens=3, rng=jax.random.PRNGKey(0),
+                       temperature=0.0)
+        assert b.serve([[5, 6, 7]], 3) == [
+            [int(t) for t in np.asarray(ref.tokens[0, 3:])]]
+
+    def test_router_places_on_ring_replicas_prefix_blind(self, caplog):
+        """A ring replica advertises `ring`; the router warns ONCE,
+        keeps placing on it (miss-counted), and the session serves."""
+        cfg = CFG.scaled(**self.RING)
+        p = T.init_params(jax.random.PRNGKey(0), cfg)
+        server = ServingServer(
+            ContinuousBatcher(p, cfg, batch=2, max_len=32),
+            registry=M.MetricsRegistry())
+        reg = M.MetricsRegistry()
+        router = None
+        try:
+            addr = f"127.0.0.1:{server.start()}"
+            router = ServingRouter([addr], registry=reg,
+                                   health_interval_s=0.2)
+            prefix = [1, 2, 3, 4]
+            router.register_prefix(prefix, prefix_id="sys")
+            with caplog.at_level(logging.WARNING,
+                                 "tony_tpu.serving.router"):
+                router.start()
+            assert sum("ring" in r.getMessage()
+                       for r in caplog.records) == 1
+            with StreamingClient("127.0.0.1", router.port) as c:
+                out, reason = c.result(c.submit(prefix + [9, 9], 3))
+            assert reason in ("eos", "budget") and len(out) == 3
+            assert reg.counter(
+                "tony_router_prefix_misses_total").value == 1
+            assert reg.counter(
+                "tony_router_prefix_hits_total").value == 0
+        finally:
+            if router is not None:
+                router.stop()
+            server.kill()
+
+
+# ---------------------------------------------------------------------------
+# Router placement: residency preference + idle-slot tiebreak
+# ---------------------------------------------------------------------------
+def _fake_link(load, idle, assigned=0, prefixes=(), alive=True,
+               role="engine", addr="x"):
+    return types.SimpleNamespace(
+        alive=alive, role=role, reported_load=load, idle_slots=idle,
+        assigned=assigned, prefixes=set(prefixes), addr=addr)
+
+
+class TestRouterPlacement:
+    def _router(self):
+        # never started: placement is exercised directly on fake links
+        return ServingRouter(["127.0.0.1:1"],
+                             registry=M.MetricsRegistry())
+
+    def test_idle_slot_tiebreak_ordering_pinned(self):
+        """At EQUAL queue depths the link with more idle decode slots
+        wins; load still dominates idle; assigned breaks the final
+        tie. First-seen no longer wins."""
+        r = self._router()
+        busy = _fake_link(load=1, idle=4, addr="busy")
+        few_idle = _fake_link(load=0, idle=1, addr="few")
+        many_idle = _fake_link(load=0, idle=3, addr="many")
+        r._links = [busy, few_idle, many_idle]
+        assert r._pick_link() is many_idle
+        # load dominates: a lower-load link beats a higher-idle one
+        busy.reported_load = 0
+        busy.idle_slots = 9
+        assert r._pick_link() is busy
+        # full tie -> fewest router-side assignments
+        r._links = [_fake_link(0, 2, assigned=3, addr="a"),
+                    _fake_link(0, 2, assigned=1, addr="b")]
+        assert r._pick_link().addr == "b"
+
+    def test_residency_restricts_then_falls_back(self):
+        """prefer_prefix narrows the pool to resident replicas even
+        when a non-resident one is less loaded; with NO resident
+        replica the full pool serves (cold fleet never errors)."""
+        r = self._router()
+        cold = _fake_link(load=0, idle=4, addr="cold")
+        warm = _fake_link(load=2, idle=1, prefixes={"sys"}, addr="warm")
+        r._links = [cold, warm]
+        assert r._pick_link(prefer_prefix="sys") is warm
+        assert r._pick_link(prefer_prefix="nope") is cold
+        assert r._pick_link() is cold
+
+    def test_sessions_land_on_the_resident_replica(self, params):
+        """In-process fleet: A resident, B cold — every prefix session
+        places on A (hits counted, residency gauge = 1) while a
+        non-prefix session still balances by load."""
+        servers = [ServingServer(
+            ContinuousBatcher(params, CFG, batch=2, max_len=64,
+                              chunk=3),
+            registry=M.MetricsRegistry()) for _ in range(2)]
+        reg = M.MetricsRegistry()
+        router = None
+        prefix, suffixes = _prefix_and_suffixes(21, 13, (3, 4, 2))
+        try:
+            addrs = [f"127.0.0.1:{s.start()}" for s in servers]
+            assert servers[0].install_prefix(prefix,
+                                             prefix_id="sys") == "sys"
+            router = ServingRouter(addrs, registry=reg,
+                                   health_interval_s=0.2)
+            router.register_prefix(prefix, prefix_id="sys")
+            router.start()
+            with StreamingClient("127.0.0.1", router.port) as c:
+                rids = [c.submit(prefix + s, 4) for s in suffixes]
+                for r in rids:
+                    out, reason = c.result(r, timeout=120)
+                    assert reason in ("eos", "budget") and len(out) == 4
+            assert reg.counter(
+                "tony_router_prefix_hits_total").value == len(suffixes)
+            assert reg.counter(
+                "tony_router_prefix_misses_total").value == 0
+            assert reg.gauge("tony_router_prefix_resident_replicas",
+                             prefix="sys").value == 1
+            st = router.stats()
+            assert st["replicas"][addrs[0]]["prefixes"] == ["sys"]
+            assert st["replicas"][addrs[1]]["prefixes"] == []
+            # every prefix session went to the resident replica
+            with StreamingClient("127.0.0.1", servers[0].port) as ca:
+                assert ca.stats()["prefix_admits"] == len(suffixes)
+        finally:
+            if router is not None:
+                router.stop()
+            for s in servers:
+                s.kill()
+
+
+# ---------------------------------------------------------------------------
+# PREFIX wire ops + the in-process warm-ship composition
+# ---------------------------------------------------------------------------
+class TestPrefixOps:
+    def test_install_publish_list_and_bad_ops(self, params):
+        """The full wire surface against real servers: install on A
+        over PREFIX frames, publish A->B over B's template lane, list
+        shows residency on both; bad ops are request-scoped (the
+        connection keeps working)."""
+        servers = [ServingServer(
+            ContinuousBatcher(params, CFG, batch=1, max_len=32),
+            registry=M.MetricsRegistry()) for _ in range(2)]
+        try:
+            for s in servers:
+                s.start()
+            with StreamingClient("127.0.0.1", servers[0].port) as ca, \
+                    StreamingClient("127.0.0.1", servers[1].port) as cb:
+                lane_b = cb.hello.get("prefix_port")
+                assert lane_b == servers[1].prefix_port
+                assert ca.hello.get("prefixes") == []
+
+                res = ca.prefix_op("install", tokens=[1, 2, 3, 4],
+                                   id="sys")
+                assert res["ok"] and res["id"] == "sys"
+                assert res["resident"] == ["sys"]
+
+                # request-scoped failures, same connection
+                assert not ca.prefix_op("install", tokens=[])["ok"]
+                assert not ca.prefix_op("install",
+                                        tokens=["nan"])["ok"]
+                assert not ca.prefix_op("publish", id="ghost",
+                                        target="127.0.0.1:1")["ok"]
+                assert not ca.prefix_op("frobnicate")["ok"]
+
+                res = ca.prefix_op("publish", id="sys",
+                                   target=f"127.0.0.1:{lane_b}")
+                assert res["ok"] and res["bytes"] > 0
+                assert _wait_resident(servers[1], "sys")
+                assert cb.prefix_op("list")["resident"] == ["sys"]
+                # B's STATS now advertises it (router residency source)
+                assert cb.stats()["prefixes"] == ["sys"]
+        finally:
+            for s in servers:
+                s.kill()
+
+    def test_router_register_and_list_ops(self, params):
+        server = ServingServer(
+            ContinuousBatcher(params, CFG, batch=1, max_len=32),
+            registry=M.MetricsRegistry())
+        router = None
+        try:
+            addr = f"127.0.0.1:{server.start()}"
+            router = ServingRouter([addr],
+                                   registry=M.MetricsRegistry(),
+                                   health_interval_s=0.2)
+            router.start()
+            with StreamingClient("127.0.0.1", router.port) as c:
+                res = c.prefix_op("register", tokens=[1, 2, 3])
+                assert res["ok"]
+                pid = res["id"]
+                assert pid == fingerprint([1, 2, 3])
+                listed = c.prefix_op("list")
+                assert listed["catalog"] == [pid]
+                assert addr in listed["resident"]
+                assert not c.prefix_op("register", tokens=[])["ok"]
+                assert not c.prefix_op("install", tokens=[1])["ok"]
+                # the connection survived every failure
+                assert c.prefix_op("list")["ok"]
+        finally:
+            if router is not None:
+                router.stop()
+            server.kill()
+
+    def test_metrics_plane_sees_installs_and_ships(self, params):
+        rega, regb = M.MetricsRegistry(), M.MetricsRegistry()
+        a = ServingServer(ContinuousBatcher(params, CFG, batch=1,
+                                            max_len=32), registry=rega)
+        b = ServingServer(ContinuousBatcher(params, CFG, batch=1,
+                                            max_len=32), registry=regb)
+        try:
+            a.start()
+            b.start()
+            pid = a.install_prefix([1, 2, 3, 4], prefix_id="sys")
+            n = a.publish_prefix(pid, f"127.0.0.1:{b.prefix_port}")
+            assert _wait_resident(b, "sys")
+            assert rega.counter("tony_prefix_ships_total").value == 1
+            assert rega.counter(
+                "tony_prefix_ship_bytes_total").value == n
+            assert regb.counter(
+                "tony_prefix_installs_total").value == 1
+        finally:
+            a.kill()
+            b.kill()
+
+
+# ---------------------------------------------------------------------------
+# Disaggregation composes: the prefill tier takes the fast path
+# ---------------------------------------------------------------------------
+class TestDisaggComposition:
+    def test_prefill_tier_prefix_hits_are_token_identical(self, params):
+        """A prefill tier with a resident prefix runs suffix-only waves
+        (forward-token counters prove it) and the disaggregated outputs
+        stay token-identical to the colocated reference."""
+        from tony_tpu.serving.disagg import DecodeServer, PrefillServer
+
+        prefix, suffixes = _prefix_and_suffixes(31, 15, (3, 5, 2, 4))
+        prompts = [prefix + s for s in suffixes]
+        ref = ContinuousBatcher(params, CFG, batch=2, max_len=64,
+                                chunk=3, seed=0).serve(prompts, 5)
+
+        regp = M.MetricsRegistry()
+        pre = PrefillServer(params, CFG, max_len=64, max_batch=2,
+                            seed=0, registry=regp)
+        dec = DecodeServer(ContinuousBatcher(params, CFG, batch=2,
+                                             max_len=64, chunk=3,
+                                             seed=0),
+                           registry=M.MetricsRegistry())
+        router = None
+        try:
+            pre.start()
+            dec.start()
+            assert pre.install_prefix(prefix, prefix_id="sys") == "sys"
+            router = ServingRouter(
+                [f"127.0.0.1:{pre.port}"],
+                decode_replicas=[f"127.0.0.1:{dec.port}"],
+                registry=M.MetricsRegistry(), health_interval_s=0.2)
+            router.register_prefix(prefix, prefix_id="sys")
+            router.start()
+            with StreamingClient("127.0.0.1", router.port) as c:
+                rids = [c.submit(p, 5) for p in prompts]
+                outs = [c.result(r, timeout=120)[0] for r in rids]
+            assert outs == ref
+            assert regp.counter(
+                "tony_prefill_forward_tokens_total").value == sum(
+                    len(s) for s in suffixes)
+            assert regp.counter(
+                "tony_prefill_prefix_tokens_total").value == len(
+                    prefix) * len(suffixes)
+        finally:
+            if router is not None:
+                router.stop()
+            pre.stop()
+            dec.stop()
+
+
+# ---------------------------------------------------------------------------
+# Bench-arm pins (deterministic tier-1 + latency-realistic @slow)
+# ---------------------------------------------------------------------------
+class TestPrefixBenchArm:
+    def test_ttft_and_flops_pins(self):
+        """The tentpole acceptance, deterministically: at 8x reuse of
+        one shared prefix across a 2-replica fleet (one computed the
+        prefix, one warmed in ONE template ship — zero prefix forwards
+        on it, asserted inside the arm), prefix-aware placement wins
+        TTFT >= 2x, cuts prefill forward tokens >= 2x, places every
+        prefix session on a resident replica, and stays
+        token-identical to the prefix-blind fleet (asserted inside
+        the arm)."""
+        import bench
+
+        res = bench._prefix_arm()
+        assert res["serving_prefix_ttft_vs_blind"] >= 2.0, res
+        assert res["serving_prefix_forward_vs_blind"] >= 2.0, res
+        assert res["serving_prefix_hit_rate"] == 1.0, res
+        assert res["serving_prefix_ship_bytes"] > 0, res
+        assert res["serving_prefix_forward_tokens_aware"] > 0, res
+
+
+@pytest.mark.slow
+class TestPrefixBenchRealistic:
+    def test_ttft_contrast_survives_wan_latency(self):
+        """Latency-realistic variant: the client path rides a
+        LatencyProxy WAN hop. The TTFT win comes from admission
+        compute, not the link — the contrast must hold."""
+        import bench
+
+        res = bench._prefix_arm(one_way_s=0.02)
+        assert res["serving_prefix_ttft_vs_blind"] >= 1.5, res
+
+
+# ---------------------------------------------------------------------------
+# Two REAL processes: warm-ship + token-identity acceptance pin
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+def test_warm_ship_token_identity_across_real_processes(tmp_path,
+                                                        params):
+    """Router + two real replica processes, replica B warmed by ONE
+    template ship from replica A: prefix-aware serving is
+    token-identical to the same fleet serving prefix-blind, greedy AND
+    sampled, every placement is a hit, and B's engines ran ZERO prefix
+    forwards (stats-pinned: forward tokens == suffix tokens of its
+    admissions). Everything that could diverge — params init, template
+    pack/unpack, the channel lane, residency advertisement, placement
+    — crosses real process boundaries here."""
+    port_files = [tmp_path / "replica-a.json", tmp_path / "replica-b.json"]
+    done = tmp_path / "done"
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable,
+         os.path.join(FIXTURES, "prefix_replica_fixture.py"),
+         "--port_file", str(pf), "--done_file", str(done)],
+        env=env, cwd=str(tmp_path)) for pf in port_files]
+    routers = []
+    prefix, suffixes = _prefix_and_suffixes(41, 19, (4, 2, 5, 3, 4, 2))
+    prompts = [prefix + s for s in suffixes]
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline and not all(
+                pf.exists() for pf in port_files):
+            assert all(p.poll() is None for p in procs), \
+                "a replica process died before binding"
+            time.sleep(0.2)
+        assert all(pf.exists() for pf in port_files), \
+            "replica port files never appeared"
+        pa, pb = [json.loads(pf.read_text()) for pf in port_files]
+
+        # warm B's "aware" servers from A's over the template lane —
+        # the only prefix compute in the whole fleet is A's two
+        # installs (one per mode)
+        for mode in ("greedy", "sampled"):
+            with StreamingClient(
+                    "127.0.0.1", pa[f"aware_{mode}"]["port"]) as ca:
+                res = ca.prefix_op("install", tokens=prefix, id="sys",
+                                   timeout=180)
+                assert res["ok"], res
+                res = ca.prefix_op(
+                    "publish", id="sys",
+                    target="127.0.0.1:"
+                           f"{pb[f'aware_{mode}']['prefix_port']}",
+                    timeout=180)
+                assert res["ok"], res
+            with StreamingClient(
+                    "127.0.0.1", pb[f"aware_{mode}"]["port"]) as cb:
+                deadline = time.time() + 60
+                while time.time() < deadline:
+                    if cb.prefix_op("list")["resident"] == ["sys"]:
+                        break
+                    time.sleep(0.1)
+                assert cb.prefix_op("list")["resident"] == ["sys"], \
+                    f"{mode}: template ship never landed on B"
+
+        def run_fleet(pass_name, mode, aware):
+            reg = M.MetricsRegistry()
+            router = ServingRouter(
+                [f"127.0.0.1:{pa[f'{pass_name}_{mode}']['port']}",
+                 f"127.0.0.1:{pb[f'{pass_name}_{mode}']['port']}"],
+                registry=reg, health_interval_s=5.0)
+            routers.append(router)
+            if aware:
+                router.register_prefix(prefix, prefix_id="sys")
+            router.start()
+            with StreamingClient("127.0.0.1", router.port) as c:
+                rids = [c.submit(p, 5) for p in prompts]
+                outs = [c.result(r, timeout=180)[0] for r in rids]
+            # gauges reflect LIVE links — read before stop tears them
+            resident = reg.gauge("tony_router_prefix_resident_replicas",
+                                 prefix="sys").value if aware else 0
+            router.stop()
+            return outs, reg, resident
+
+        for mode in ("greedy", "sampled"):
+            blind, _, _ = run_fleet("blind", mode, aware=False)
+            aware, reg, resident = run_fleet("aware", mode, aware=True)
+            assert aware == blind, mode
+            if mode == "greedy":
+                assert blind == [_reference(params, p, 5)
+                                 for p in prompts]
+            assert reg.counter(
+                "tony_router_prefix_hits_total").value == len(prompts), \
+                mode
+            assert reg.counter(
+                "tony_router_prefix_misses_total").value == 0, mode
+            assert resident == 2, mode
+            # the warmed replica ran ZERO prefix forwards, ever: its
+            # lifetime forward tokens are exactly its admissions'
+            # suffixes
+            with StreamingClient(
+                    "127.0.0.1", pb[f"aware_{mode}"]["port"]) as cb:
+                st = cb.stats()
+            assert st["prefix_admits"] > 0, \
+                f"{mode}: warmed replica B never got a session"
+            assert st["prefix_tokens"] == len(prefix) * \
+                st["prefix_admits"], mode
+            # suffixes are <= 5 tokens, the prefix is 19: even ONE
+            # prefix forward on B would blow this bound
+            assert st["prefill_tokens"] <= max(
+                len(s) for s in suffixes) * st["prefix_admits"], mode
+    finally:
+        done.write_text("done")
+        for router in routers:
+            try:
+                router.stop()
+            except Exception:
+                pass
+        for p in procs:
+            try:
+                p.wait(timeout=90)
+            except subprocess.TimeoutExpired:
+                p.kill()
+    assert all(p.returncode == 0 for p in procs), \
+        [p.returncode for p in procs]

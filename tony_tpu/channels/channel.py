@@ -226,6 +226,16 @@ class ChannelSender:
         sock, self._sock = self._sock, None
         self._broken = True
         if sock is not None:
+            # shutdown BEFORE close: the ack-reader thread blocked in
+            # recv on this fd holds the kernel socket alive, so a bare
+            # close() would never send the FIN — the receiver's
+            # delivery loop would keep its per-channel conn lock and
+            # every later sender's handshake would hang (seen with the
+            # short-lived one-ship senders of the prefix template lane)
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:
@@ -280,6 +290,11 @@ class ChannelSender:
                 backoff = min(backoff * 2, self.max_backoff_s)
                 continue
             with self._cv:
+                if not self._connected_once and not self._unacked:
+                    # a fresh sender adopts the lane's resume point:
+                    # nothing of ours can be below it, so seqs start
+                    # where the receiver expects them
+                    self._next_seq = max(self._next_seq, resume)
                 # everything below the resume point was delivered before
                 # the cut — retire it; the rest goes out again below
                 self._acked_through = max(self._acked_through, resume - 1)
@@ -379,6 +394,16 @@ class ChannelSender:
                 f"tensor of {len(raw)} bytes exceeds the "
                 f"{MAX_TENSOR_BYTES}-byte frame cap — split the "
                 f"microbatch")
+        # the FIRST connect also happens before a sequence number
+        # exists: the handshake's resume point fast-forwards _next_seq
+        # (see _reconnect), so a fresh sender joining a lane whose
+        # receive state already advanced — short-lived one-ship senders
+        # sharing a template lane — numbers its payloads as NEW frames
+        # instead of the resume dedup retiring them unsent
+        with self._cv:
+            never_connected = not self._connected_once
+        if never_connected:
+            self._reconnect(deadline)
         # window backpressure BEFORE a sequence number exists: a wait
         # that times out here leaves no hole in the seq space (a burned
         # seq would wedge the channel in a permanent gap/reconnect loop)
@@ -608,6 +633,14 @@ class ChannelHub:
     def stop(self) -> None:
         self._stopping.set()
         if self._server is not None:
+            # shutdown wakes an accept() blocked in another thread —
+            # a bare close() does not (the blocked syscall pins the
+            # fd), which left stop() burning the accept-thread join
+            # timeout (the FrameServerBase listener does the same)
+            try:
+                self._server.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._server.close()
             except OSError:
@@ -703,12 +736,19 @@ class ChannelHub:
             "tony_channel_bytes_total",
             help="tensor payload bytes moved", channel=name,
             direction="recv")
-        # preempt the predecessor: closing its socket makes a half-open
-        # connection's blocked read fail NOW, so conn_lock frees instead
-        # of this handshake queueing behind a dead peer forever
+        # preempt the predecessor: shutting down its socket makes a
+        # half-open connection's blocked read fail NOW, so conn_lock
+        # frees instead of this handshake queueing behind a dead peer
+        # forever (shutdown, not just close — the delivery thread
+        # blocked in recv holds the fd alive, and a bare close() from
+        # this thread would not wake it)
         with state.active_lock:
             old, state.active_sock = state.active_sock, sock
         if old is not None and old is not sock:
+            try:
+                old.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 old.close()
             except OSError:

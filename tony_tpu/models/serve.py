@@ -292,6 +292,74 @@ def prefix_template(params, prefix, cfg):
     return _kv_bufs(mini)
 
 
+class PrefixEntry:
+    """One RESIDENT shared prefix in a batcher's prefix store: the
+    token sequence (for matching and suffix splitting) plus its
+    precomputed K/V ``template`` (:func:`prefix_template` shape —
+    ``[L, 1, P, KV, hd]`` per buffer). ``draft_template`` is the
+    speculative batcher's draft-model template (computed locally at
+    install — template ships carry only the target's K/V)."""
+
+    __slots__ = ("id", "tokens", "template", "draft_template")
+
+    def __init__(self, prefix_id: str, tokens: list, template: dict,
+                 draft_template: dict | None = None) -> None:
+        self.id = prefix_id
+        self.tokens = tokens
+        self.template = template
+        self.draft_template = draft_template
+
+
+class _PrefixHit:
+    """Engine-side admission payload for a request that matched a
+    resident prefix: only ``suffix`` runs a forward; the prefix K/V
+    come from ``entry.template``. Routed by ``_admit_batch`` exactly
+    like :class:`KVPackage` payloads are — one admission seam, three
+    admission kinds."""
+
+    __slots__ = ("entry", "suffix")
+
+    def __init__(self, entry: PrefixEntry, suffix: list) -> None:
+        self.entry = entry
+        self.suffix = suffix
+
+
+def validate_template_bufs(proto: dict, tokens, bufs: dict) -> dict:
+    """Validate a (possibly shipped) prefix template against a
+    reference cache's buffer layout ``proto`` (``_kv_bufs`` of any
+    cache built from the serving config): buffer-name set, dtypes,
+    layer count, and trailing head dims must match, and the sequence
+    extent must equal the prefix length. Raises ``ValueError`` naming
+    the mismatch — request-scoped at the install path, exactly like a
+    mismatched KV row shipment. Returns the buffers as device arrays."""
+    p_len = len(tokens)
+    if set(bufs) != set(proto):
+        raise ValueError(
+            f"template buffers {sorted(bufs)} do not match this cache's "
+            f"layout {sorted(proto)} (quantization mismatch?)")
+    out = {}
+    for n, c in proto.items():
+        a = np.asarray(bufs[n])
+        if a.dtype != c.dtype:
+            raise ValueError(f"template buffer {n!r} dtype {a.dtype} "
+                             f"!= cache dtype {c.dtype}")
+        if a.ndim != c.ndim or a.shape[0] != c.shape[0]:
+            layers = a.shape[0] if a.ndim else 0
+            raise ValueError(
+                f"template buffer {n!r} carries {layers} layers; this "
+                f"model has {c.shape[0]} (layer mismatch between "
+                f"producer and installer?)")
+        if a.shape[1] != 1 or a.shape[3:] != c.shape[3:]:
+            raise ValueError(f"template buffer {n!r} shape "
+                             f"{list(a.shape)} does not fit cache "
+                             f"{list(c.shape)}")
+        if a.shape[2] != p_len:
+            raise ValueError(f"template buffer {n!r} holds {a.shape[2]} "
+                             f"positions for a {p_len}-token prefix")
+        out[n] = jnp.asarray(a)
+    return out
+
+
 def _extend_from_template(model_params, template, suffix, model_cfg):
     """Build a [L, 1, P+S]-row mini cache from a prefix ``template`` and
     run the ``suffix`` through the model against it (a chunked
@@ -438,6 +506,25 @@ def prefill_ship_row(params, prompt, cfg):
     colocated ring admission does. prompt: [1, S_p]."""
     _count_trace("prefill_ship_row", prompt.shape)
     return prefill(params, prompt, cfg, max_len=prompt.shape[1])
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def prefix_ship_rows(params, template, suffixes, lengths, cfg):
+    """Prefill one admission bucket of SUFFIXES for shipment against a
+    resident prefix ``template`` — the disaggregated counterpart of
+    :func:`prefix_admit_rows`: only the suffix tokens run a forward
+    (:func:`_extend_rows_from_template`), and the resulting
+    prefix+suffix mini cache ships to a decode gang exactly like a
+    full-prefill row. The prefill tier's prefix fast path: at a hot
+    shared prefix, shipped-row prefill compute drops from O(P+S) to
+    O(S) tokens per request while the decode gang needs no prefix
+    knowledge at all. suffixes: [K, S_bucket] right-padded; lengths:
+    [K] TRACED true suffix lengths. Returns (per-row last-real-suffix
+    logits [K, V], mini cache [L, K, P+S_bucket, ...])."""
+    _count_trace("prefix_ship_rows", suffixes.shape)
+    lg, mini, _ = _extend_rows_from_template(params, template, suffixes,
+                                             lengths, cfg)
+    return lg, mini
 
 
 @functools.partial(jax.jit, donate_argnames=("cache", "logits"))
@@ -753,6 +840,19 @@ class ContinuousBatcher:
         self._prefix_template = (
             prefix_template(params, self.shared_prefix, cfg)
             if self.shared_prefix else None)
+        #: RESIDENT prefix templates (prefix-aware serving): id ->
+        #: PrefixEntry. Entries are immutable once published and the
+        #: dict is only ever grown, so install threads and the engine's
+        #: reader-thread resolution need no lock (GIL-atomic dict ops).
+        self._prefix_store: dict = {}
+        self._ring_prefix_warned = False
+        #: host-side prefill-compute accounting (the prefix fast path's
+        #: FLOPs story, folded into the metrics plane by ServeEngine):
+        #: true tokens run through a prefill/extend forward at
+        #: admission vs prefix positions satisfied by a template COPY
+        self.prefill_forward_tokens = 0
+        self.prefix_copied_tokens = 0
+        self.prefix_admits = 0
         #: sampling controls (greedy by default). Streams are
         #: PER-REQUEST: request q's t-th draw comes from
         #: fold_in(fold_in(PRNGKey(seed), q), t) — a re-served workload
@@ -807,6 +907,128 @@ class ContinuousBatcher:
     def _req_key(self, req: int):
         return jax.random.fold_in(self._base_key, req)
 
+    # --- resident prefix templates (prefix-aware serving) ---
+
+    def install_prefix(self, prefix_id: str, tokens,
+                       template: dict | None = None) -> bool:
+        """Make a shared prefix RESIDENT: admissions whose prompt
+        continues ``tokens`` run only their suffix through the model
+        (:func:`prefix_admit_rows` against the stored template) —
+        token-identical to full prefill, test-pinned. ``template``
+        None computes the prefill here (ONE forward for the whole
+        serve); a template shipped from a peer replica installs with
+        ZERO prefix forwards (:func:`validate_template_bufs` guards
+        the layout). Rolling (ring) caches cannot host positional
+        templates: the batcher DEGRADES to prefix-blind serving with
+        one warning and returns False — never an error (ring replicas
+        still serve every request, just without the fast path).
+        Raises ``ValueError`` for an unusable request (empty tokens,
+        no room for a suffix, legacy ``shared_prefix`` mode, or a
+        mismatched shipped template)."""
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("prefix tokens must be non-empty")
+        if self.shared_prefix is not None:
+            raise ValueError(
+                "legacy shared_prefix mode already interprets every "
+                "prompt as a continuation; per-request prefix "
+                "templates compose with plain batchers only")
+        if self._ring:
+            if not self._ring_prefix_warned:
+                self._ring_prefix_warned = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "rolling (ring) caches cannot host prefix "
+                    "templates (positional K/V do not survive "
+                    "wraparound); serving prefix-blind")
+            return False
+        if len(tokens) + 2 > self.max_len:
+            raise ValueError(
+                f"prefix of {len(tokens)} tokens leaves no room for a "
+                f"suffix + generation under max_len {self.max_len}")
+        if template is None:
+            template = prefix_template(self.params, tokens, self.cfg)
+            self.prefill_forward_tokens += len(tokens)
+        else:
+            template = validate_template_bufs(_kv_bufs(self.cache),
+                                              tokens, template)
+        self._prefix_store[str(prefix_id)] = self._build_entry(
+            str(prefix_id), tokens, template)
+        return True
+
+    def _build_entry(self, prefix_id: str, tokens: list,
+                     template: dict) -> PrefixEntry:
+        """Entry construction hook — the speculative subclass adds the
+        draft-model template BEFORE the entry is published to the
+        store (a half-built entry must never be resolvable)."""
+        return PrefixEntry(prefix_id, tokens, template)
+
+    def install_prefix_template(self, meta: dict, bufs: dict) -> str:
+        """Land an unpacked SHIPPED template (``kvship.unpack_template``
+        output): vocab is checked against this model up front — a
+        template from a differently-shaped model is a request-scoped
+        ``ValueError`` at the install path, never garbage K/V
+        discovered mid-serve. Returns the installed prefix id."""
+        if int(meta["vocab"]) != self.cfg.vocab_size:
+            raise ValueError(
+                f"template vocab {meta['vocab']} != this model's "
+                f"{self.cfg.vocab_size} (shipped from a different "
+                f"model?)")
+        if not self.install_prefix(meta["id"], meta["tokens"],
+                                   template=bufs):
+            raise ValueError("rolling-cache layout cannot host prefix "
+                             "templates (degraded prefix-blind)")
+        return str(meta["id"])
+
+    def resident_prefixes(self) -> list:
+        """Ids of the installed prefix templates (what the serving
+        server advertises via HELLO/STATS for residency-aware
+        routing)."""
+        return sorted(self._prefix_store)
+
+    def export_prefix_blob(self, prefix_id: str) -> bytes:
+        """Pack the resident ``prefix_id`` for publication to a peer
+        replica (the warm-ship path); raises ``ValueError`` when not
+        resident."""
+        from tony_tpu.serving import kvship
+        entry = self._prefix_store.get(str(prefix_id))
+        if entry is None:
+            raise ValueError(f"prefix {prefix_id!r} is not resident")
+        return kvship.pack_template(
+            entry.id, entry.tokens,
+            {n: np.asarray(a) for n, a in entry.template.items()},
+            self.cfg.vocab_size)
+
+    def _resolve_prefix(self, prefix_id, prompt) -> PrefixEntry | None:
+        """Resolve a submission against the resident store: the named
+        entry when ``prefix_id`` is given and the prompt properly
+        continues its tokens, else the LONGEST resident match
+        (token-boundary, proper prefix). None = serve prefix-blind —
+        a miss is never an error (the fast path is an optimization
+        with token-identical outputs)."""
+        if self._ring:
+            if prefix_id is not None and not self._ring_prefix_warned:
+                self._ring_prefix_warned = True
+                import logging
+                logging.getLogger(__name__).warning(
+                    "prefix-id admission on a rolling (ring) cache; "
+                    "serving prefix-blind")
+            return None
+        if not self._prefix_store or self.shared_prefix is not None:
+            return None
+        if prefix_id is not None:
+            entry = self._prefix_store.get(prefix_id)
+            if (entry is not None and len(entry.tokens) < len(prompt)
+                    and prompt[:len(entry.tokens)] == entry.tokens):
+                return entry
+        # tokenized fallback: longest resident proper prefix (ONE copy
+        # of the matching invariant, snapshot-safe vs install threads)
+        from tony_tpu.serving.prefix import match_prefix
+        entries = list(self._prefix_store.values())
+        pid = match_prefix(prompt, ((e.id, e.tokens) for e in entries))
+        return next((e for e in entries if e.id == pid), None) \
+            if pid is not None else None
+
     # --- admission (bucketed/batched with a per-length fallback) ---
 
     def _bucket_for(self, n: int) -> int:
@@ -834,15 +1056,23 @@ class ContinuousBatcher:
         return (jnp.asarray(rows),
                 jax.vmap(self._req_key)(jnp.asarray(req_ids)))
 
+    @staticmethod
+    def _seq_of(payload):
+        """The token sequence an admission payload runs through the
+        model: the whole prompt, or only the suffix of a prefix hit."""
+        return payload.suffix if isinstance(payload, _PrefixHit) \
+            else payload
+
     def _pad_prompts_to(self, grp, prompts, bucket):
         """[batch, bucket] right-padded prompt matrix plus [batch] true
         lengths for one bucket group (entries past the group are inert —
         their scatter targets are :meth:`_marshal_wave`'s out-of-range
-        sentinels)."""
+        sentinels). Prefix hits pad their SUFFIX (the only tokens that
+        run a forward)."""
         toks = np.zeros((self.batch, bucket), np.int64)
         lens = np.ones((self.batch,), np.int32)
         for i, (_, req) in enumerate(grp):
-            p = prompts[req]
+            p = self._seq_of(prompts[req])
             toks[i, :len(p)] = p
             lens[i] = len(p)
         return jnp.asarray(toks, jnp.int32), jnp.asarray(lens)
@@ -964,32 +1194,63 @@ class ContinuousBatcher:
                              f"width {pkg.width}")
 
     def _admit_prompts(self, pairs, prompts) -> None:
-        """Admit prompt (row, request-index) pairs: group by length
-        bucket and land each group in ONE device dispatch (legacy
-        per-row programs when bucketing is off/ring). Also rebinds each
-        row's rng stream to its new occupant — one scatter of the
-        wave's marshalled keys, not a dispatch per row."""
+        """Admit prompt (row, request-index) pairs: group by (resident
+        prefix, length bucket) and land each group in ONE device
+        dispatch (legacy per-row programs when bucketing is off/ring).
+        A prefix-hit group runs only its SUFFIXES through the model
+        against the stored template (:func:`prefix_admit_rows`) — the
+        admission fast path. Also rebinds each row's rng stream to its
+        new occupant — one scatter of the wave's marshalled keys, not
+        a dispatch per row."""
         if not pairs:
             return
         with self.phase_times.phase("admit"):
             if self.bucketed_admission:
-                groups: dict[int, list] = {}
+                groups: dict[tuple, list] = {}
                 for row, req in pairs:
-                    groups.setdefault(
-                        self._bucket_for(len(prompts[req])),
-                        []).append((row, req))
-                for bucket in sorted(groups):
-                    grp = groups[bucket]
+                    p = prompts[req]
+                    if isinstance(p, _PrefixHit):
+                        cap = self.max_len - len(p.entry.tokens)
+                        key = (p.entry.id,
+                               bucket_for(len(p.suffix), cap,
+                                          self.admission_buckets))
+                    else:
+                        key = (None, self._bucket_for(len(p)))
+                    groups.setdefault(key, []).append((row, req))
+                for pid, bucket in sorted(groups,
+                                          key=lambda k: (k[0] or "",
+                                                         k[1])):
+                    grp = groups[(pid, bucket)]
+                    entry = (prompts[grp[0][1]].entry if pid is not None
+                             else None)
                     rows, keys = self._marshal_wave(grp)
                     toks, lens = self._pad_prompts_to(grp, prompts,
                                                       bucket)
-                    self._admit_rows(rows, toks, lens, keys)
+                    self._admit_rows(rows, toks, lens, keys, entry=entry)
                     self._rebind_streams(grp, rows, keys)
+                    self._count_admission(grp, prompts)
             else:
                 for row, req in pairs:
                     self._admit_legacy(row, req, prompts)
                 rows, keys = self._marshal_wave(pairs)
                 self._rebind_streams(pairs, rows, keys)
+                self._count_admission(pairs, prompts)
+
+    def _count_admission(self, pairs, prompts) -> None:
+        """Fold one admitted group into the host-side prefill-compute
+        accounting (forward tokens vs template-copied prefix
+        positions — the FLOPs contrast the prefix fast path exists
+        for). Legacy ``shared_prefix`` mode counts its template copies
+        too: prompts there are already suffixes."""
+        shared_p = len(self.shared_prefix) if self.shared_prefix else 0
+        for _, req in pairs:
+            p = prompts[req]
+            self.prefill_forward_tokens += len(self._seq_of(p))
+            if isinstance(p, _PrefixHit):
+                self.prefix_copied_tokens += len(p.entry.tokens)
+                self.prefix_admits += 1
+            elif shared_p:
+                self.prefix_copied_tokens += shared_p
 
     def _rebind_streams(self, pairs, rows, keys) -> None:
         """Rebind the admitted rows' rng streams to their new occupants:
@@ -1001,8 +1262,12 @@ class ContinuousBatcher:
         for row, _ in pairs:
             self._row_off[row] = self._off0
 
-    def _admit_rows(self, rows, toks, lens, keys) -> None:
-        if self._prefix_template is not None:
+    def _admit_rows(self, rows, toks, lens, keys, entry=None) -> None:
+        if entry is not None:
+            self.cache, self.logits = prefix_admit_rows(
+                self.params, self.cache, self.logits, rows,
+                entry.template, toks, lens, self.cfg)
+        elif self._prefix_template is not None:
             self.cache, self.logits = prefix_admit_rows(
                 self.params, self.cache, self.logits, rows,
                 self._prefix_template, toks, lens, self.cfg)
@@ -1012,7 +1277,13 @@ class ContinuousBatcher:
                 self.cfg)
 
     def _admit_legacy(self, row, req, prompts) -> None:
-        if self._prefix_template is not None:
+        p = prompts[req]
+        if isinstance(p, _PrefixHit):
+            self.cache, self.logits = prefix_admit_row(
+                self.params, self.cache, self.logits, row,
+                p.entry.template,
+                jnp.asarray(p.suffix, jnp.int32)[None], self.cfg)
+        elif self._prefix_template is not None:
             self.cache, self.logits = prefix_admit_row(
                 self.params, self.cache, self.logits, row,
                 self._prefix_template,
@@ -1081,6 +1352,22 @@ class ContinuousBatcher:
                 (f"shared prefix {p_len} + " if p_len else "")
                 + f"prompt {len(prompt)} + {max_new} new tokens exceeds "
                   f"max_len {self.max_len}")
+
+    def _validate_prefix_hit(self, hit: "_PrefixHit",
+                             max_new: int) -> None:
+        """Validate a prefix-hit admission (resident template + suffix)
+        against the cache geometry — the fast-path counterpart of
+        :meth:`_validate_request` (suffix non-emptiness is guaranteed
+        by the proper-prefix match)."""
+        total = len(hit.entry.tokens) + len(hit.suffix)
+        if max_new <= 0:
+            raise ValueError(f"max_new_tokens must be positive, "
+                             f"got {max_new}")
+        if total + max_new > self.max_len:
+            raise ValueError(
+                f"prefix {len(hit.entry.tokens)} + suffix "
+                f"{len(hit.suffix)} + {max_new} new tokens exceeds "
+                f"max_len {self.max_len}")
 
     def serve(self, prompts: Sequence, max_new_tokens):
         """Run all ``prompts`` (each a [S_p] int sequence) to completion;
@@ -1222,13 +1509,32 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         # one sync = chunk rounds x up to k+1 commits per row
         return self.chunk * (self.k + 1)
 
-    def _admit_rows(self, rows, toks, lens, keys) -> None:
+    def _build_entry(self, prefix_id: str, tokens: list,
+                     template: dict) -> PrefixEntry:
+        # the draft keeps its own per-slot K/V history, so a resident
+        # prefix needs a DRAFT template too; template ships carry only
+        # the target's buffers, so it is computed locally (the draft is
+        # the cheap model — one small prefill per install)
+        return PrefixEntry(
+            prefix_id, tokens, template,
+            draft_template=prefix_template(self.draft_params, tokens,
+                                           self.draft_cfg))
+
+    def _admit_rows(self, rows, toks, lens, keys, entry=None) -> None:
         # the seed draw takes stream position 0 of each admitted
         # request's base key — one vmapped fold over the wave's
         # ALREADY-marshalled keys (shared with the rebind scatter), not
         # a second per-request derivation
         seed_keys = jax.vmap(lambda kk: jax.random.fold_in(kk, 0))(keys)
-        if self._prefix_template is not None:
+        if entry is not None:
+            self.cache, self.d_cache, self.pending = (
+                spec_prefix_admit_rows(
+                    self.params, self.draft_params, self.cache,
+                    self.d_cache, self.pending, rows, entry.template,
+                    entry.draft_template, toks, lens, seed_keys,
+                    self.cfg, self.draft_cfg, self.temperature,
+                    self.top_k, self.top_p))
+        elif self._prefix_template is not None:
             self.cache, self.d_cache, self.pending = (
                 spec_prefix_admit_rows(
                     self.params, self.draft_params, self.cache,
@@ -1252,8 +1558,17 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
             "mode (the shipment carries no draft-model cache)")
 
     def _admit_legacy(self, row, req, prompts) -> None:
-        tokens = jnp.asarray(prompts[req], jnp.int32)[None]
+        p = prompts[req]
         sub = jax.random.fold_in(self._req_key(req), 0)
+        if isinstance(p, _PrefixHit):
+            self.cache, self.d_cache, self.pending = spec_prefix_admit_row(
+                self.params, self.draft_params, self.cache, self.d_cache,
+                self.pending, row, p.entry.template,
+                p.entry.draft_template,
+                jnp.asarray(p.suffix, jnp.int32)[None], sub, self.cfg,
+                self.draft_cfg, self.temperature, self.top_k, self.top_p)
+            return
+        tokens = jnp.asarray(p, jnp.int32)[None]
         if self._prefix_template is not None:
             self.cache, self.d_cache, self.pending = spec_prefix_admit_row(
                 self.params, self.draft_params, self.cache, self.d_cache,
@@ -1427,17 +1742,38 @@ class ServeEngine:
             "tony_serve_intertoken_seconds",
             help="mean per-token gap of each consumed delta after a "
                  "request's first (inter-token latency, engine-side)")
+        self._prefill_tok_c = reg.counter(
+            "tony_serve_prefill_tokens_total",
+            help="true prompt/suffix tokens run through a prefill or "
+                 "extend forward at admission (the prefill-FLOPs "
+                 "proxy the prefix fast path shrinks)")
+        self._prefix_tok_c = reg.counter(
+            "tony_serve_prefix_tokens_total",
+            help="prefix positions satisfied by a resident-template "
+                 "COPY instead of a forward (prefix-aware serving)")
+        self._prefix_admits_c = reg.counter(
+            "tony_serve_prefix_admits_total",
+            help="admissions that went through a resident prefix "
+                 "template (only suffix tokens ran the model)")
         self._qdepth_g.set(0)
 
     # --- thread-safe control surface ---
 
     def submit(self, rid, prompt, max_new_tokens: int,
-               trace_ctx: dict | None = None) -> None:
+               trace_ctx: dict | None = None,
+               prefix_id: str | None = None) -> None:
         """Enqueue a request under caller-chosen id ``rid`` (any
         hashable; must not collide with a LIVE request's). Raises
         ``ValueError`` for un-servable requests (validated up front, so
         a bad request never strands engine state) and ``RuntimeError``
         once draining/stopped.
+
+        ``prefix_id`` optionally names a resident shared-prefix
+        template the prompt continues (the ADMIT frame's ``prefix``
+        field); the engine also auto-matches the prompt against its
+        resident store. A hit admits only the SUFFIX through the model
+        — token-identical to full prefill, test-pinned; a miss (or a
+        replica degraded prefix-blind) serves normally, never errors.
 
         ``trace_ctx`` is the submitter's span context (``{"tid", "sid"}``
         off the ADMIT frame): the request's engine-side spans — the TTFT
@@ -1445,9 +1781,16 @@ class ServeEngine:
         head-samples a fresh trace per ``tony.trace.sample-rate``."""
         prompt = [int(t) for t in prompt]
         max_new_tokens = int(max_new_tokens)
-        self.b._validate_request(prompt, max_new_tokens)
-        self._enqueue(rid, prompt, max_new_tokens, trace_ctx,
-                      prompt_tokens=len(prompt))
+        entry = self.b._resolve_prefix(prefix_id, prompt)
+        if entry is None:
+            self.b._validate_request(prompt, max_new_tokens)
+            self._enqueue(rid, prompt, max_new_tokens, trace_ctx,
+                          prompt_tokens=len(prompt))
+        else:
+            hit = _PrefixHit(entry, prompt[len(entry.tokens):])
+            self.b._validate_prefix_hit(hit, max_new_tokens)
+            self._enqueue(rid, hit, max_new_tokens, trace_ctx,
+                          prompt_tokens=len(prompt), prefix=entry.id)
 
     def submit_prefilled(self, rid, package: KVPackage,
                          max_new_tokens: int,
@@ -1549,6 +1892,11 @@ class ServeEngine:
                               if r is not None and not r.done),
                 "slots": self.b.batch,
                 "draining": self._draining,
+                # the prefix fast path's compute story, readable
+                # cross-process (the e2e zero-prefix-forward pin)
+                "prefill_tokens": self.b.prefill_forward_tokens,
+                "prefix_tokens": self.b.prefix_copied_tokens,
+                "prefix_admits": self.b.prefix_admits,
             }
 
     # --- the loop (one driving thread) ---
@@ -1645,8 +1993,21 @@ class ServeEngine:
                     # share of TTFT, next to engine.queued's queue share
                     req.first_span = tr.start_span("engine.first_token",
                                                    parent=req.span)
-            self.b._admit_batch(pairs, prompts)
+            b = self.b
+            before = (b.prefill_forward_tokens, b.prefix_copied_tokens,
+                      b.prefix_admits)
+            b._admit_batch(pairs, prompts)
             self._admitted_c.inc(len(admitted))
+            # fold the batcher's host-side prefill accounting into the
+            # registry (the batcher itself is registry-unaware)
+            if b.prefill_forward_tokens > before[0]:
+                self._prefill_tok_c.inc(b.prefill_forward_tokens
+                                        - before[0])
+            if b.prefix_copied_tokens > before[1]:
+                self._prefix_tok_c.inc(b.prefix_copied_tokens
+                                       - before[1])
+            if b.prefix_admits > before[2]:
+                self._prefix_admits_c.inc(b.prefix_admits - before[2])
 
     def _consume(self, host_toks, snap) -> None:
         """Apply one fetched chunk under the occupancy it was ISSUED

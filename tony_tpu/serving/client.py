@@ -110,6 +110,9 @@ class StreamingClient:
                     self._dispatch(rid, ("error", msg))
                 elif ftype == P.STATS:
                     self._stats_q.put(P.unpack_json(payload))
+                elif ftype == P.PREFIX:
+                    self._dispatch(rid, ("prefix",
+                                         P.unpack_json(payload)))
                 # unknown server frames are ignored (forward compat)
         except (P.ProtocolError, OSError) as e:
             error = str(e)
@@ -146,8 +149,13 @@ class StreamingClient:
 
     # -- request surface ----------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, stream: bool = True,
-               rid: int | None = None) -> int:
-        """Admit a request; returns its (client-chosen or auto) rid."""
+               rid: int | None = None,
+               prefix_id: str | None = None) -> int:
+        """Admit a request; returns its (client-chosen or auto) rid.
+        ``prefix_id`` optionally names the shared prefix the prompt
+        continues (prefix-aware routing/admission); routers also
+        token-match unnamed prompts against their catalog, so it is
+        never required."""
         if rid is None:
             rid = next(self._next_rid)
         tr = tracing.get_tracer()
@@ -155,6 +163,8 @@ class StreamingClient:
                            prompt_tokens=len(prompt))
         body = {"prompt": [int(t) for t in prompt],
                 "max_new_tokens": int(max_new_tokens), "stream": stream}
+        if prefix_id is not None:
+            body["prefix"] = str(prefix_id)
         if sp.recording:
             # propagate the client's span context so the router's and
             # engine's spans join this trace (the end-to-end TTFT
@@ -267,6 +277,33 @@ class StreamingClient:
             return [], ev[1]
         self._forget(rid)
         raise ServingConnectionError(ev[1])
+
+    def prefix_op(self, op: str, timeout: float | None = 60.0,
+                  **fields) -> dict:
+        """One PREFIX-frame round trip (prefix-aware serving): replica
+        ops ``install`` (``tokens=``, optional ``id=``), ``publish``
+        (``id=``, ``target=`` — the peer's ``host:prefix_port``
+        template lane) and ``list``; router ops ``register``
+        (``tokens=``) and ``list``. Returns the reply object
+        (``{"ok": bool, ...}`` — op failures are returned, not
+        raised); raises ``ServingConnectionError`` only on transport
+        loss."""
+        rid = next(self._next_rid)
+        with self._lock:
+            if self._closed:
+                raise ServingConnectionError(
+                    self._conn_error or "client is closed")
+            self._queues[rid] = queue.Queue()
+        try:
+            self._send(P.PREFIX, rid,
+                       P.pack_json(dict(fields, op=op)))
+            ev = self._event_or_raise(rid, timeout)
+        finally:
+            self._forget(rid)
+        if ev[0] == "prefix":
+            return ev[1]
+        raise ServingConnectionError(
+            ev[1] if ev[0] == "error" else f"unexpected reply {ev[0]}")
 
     def stats(self, timeout: float | None = 30.0) -> dict:
         """Server stats snapshot (the ``tony_serve_queue_depth`` gauge

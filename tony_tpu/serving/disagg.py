@@ -67,6 +67,7 @@ from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime import tracing
 from tony_tpu.serving import kvship
 from tony_tpu.serving import protocol as P
+from tony_tpu.serving.prefix import PrefixHost, fingerprint, match_prefix
 from tony_tpu.serving.server import FrameConn, FrameServerBase
 
 log = logging.getLogger(__name__)
@@ -80,17 +81,22 @@ class _PrefillItem:
     """One admitted prompt waiting for (or undergoing) prefill."""
 
     __slots__ = ("conn", "rid", "prompt", "budget", "decode", "stream",
-                 "cancelled", "done", "span", "queued_span")
+                 "cancelled", "done", "span", "queued_span", "prefix")
 
     def __init__(self, conn: FrameConn, rid: int, prompt: list[int],
                  budget: int, decode: str, stream: int,
-                 trace_ctx: dict | None) -> None:
+                 trace_ctx: dict | None,
+                 prefix: str | None = None) -> None:
         self.conn = conn
         self.rid = rid
         self.prompt = prompt
         self.budget = budget
         self.decode = decode
         self.stream = stream
+        #: the resident-prefix id this prompt continues (ADMIT's
+        #: ``prefix`` field) — resolved against the tier's store at
+        #: wave time; a miss just full-prefills
+        self.prefix = prefix
         self.cancelled = False
         self.done = False       # a terminal frame (or conn loss) settled it
         tr = tracing.get_tracer()
@@ -105,7 +111,7 @@ class _PrefillItem:
                                          parent=self.span)
 
 
-class PrefillServer(FrameServerBase):
+class PrefillServer(PrefixHost, FrameServerBase):
     """The prefill tier of disaggregated serving (see module
     docstring). Stateless per request — no persistent KV cache, no
     decode loop: ADMIT → bucketed prefill wave → KV shipment →
@@ -118,7 +124,17 @@ class PrefillServer(FrameServerBase):
     cannot land is rejected HERE, before any compute). Rolling (ring)
     cache configs take the exact-length
     :func:`~tony_tpu.models.serve.prefill_ship_row` path and ship the
-    full capacity ring."""
+    full capacity ring.
+
+    The tier is a :class:`~tony_tpu.serving.prefix.PrefixHost` too
+    (prefix reuse composes with disaggregation): a wave item whose
+    prompt continues a resident prefix runs only its SUFFIX through
+    the model (:func:`~tony_tpu.models.serve.prefix_ship_rows` against
+    the stored template) and ships the full prefix+suffix row — the
+    decode gang needs no prefix knowledge. Templates arrive over the
+    same install path as the colocated server's (PREFIX ops or a
+    peer's template ship); ring configs degrade prefix-blind with one
+    warning."""
 
     def __init__(self, params, cfg, *, max_len: int, seed: int = 0,
                  max_batch: int = 4, admission_buckets=None,
@@ -165,7 +181,104 @@ class PrefillServer(FrameServerBase):
         self._ship_bytes_c = reg.counter(
             "tony_kv_ship_bytes_total",
             help="KV shipment payload bytes sent to decode gangs")
+        self._fwd_tok_c = reg.counter(
+            "tony_prefill_forward_tokens_total",
+            help="true prompt/suffix tokens run through a prefill or "
+                 "extend forward at the prefill tier (the FLOPs proxy "
+                 "the prefix fast path shrinks)")
+        self._pref_tok_c = reg.counter(
+            "tony_prefill_prefix_tokens_total",
+            help="prefix positions served from a resident template "
+                 "instead of a forward at the prefill tier")
         self._qdepth_g.set(0)
+        #: resident prefix templates: id -> (tokens, template). Grown
+        #: only; entries immutable — lock-free reads at wave time.
+        self._prefix_store: dict[str, tuple] = {}
+        self._ring_prefix_warned = False
+        self._proto_bufs = None          # lazy layout prototype
+        self._init_prefix_host(reg)
+
+    # -- resident prefix templates (PrefixHost hooks) -----------------------
+    def install_prefix(self, tokens, prefix_id: str | None = None):
+        """Compute ``tokens``' K/V template on this tier and make it
+        resident; None when degraded (ring layout)."""
+        from tony_tpu.models.serve import prefix_template
+
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("prefix tokens must be non-empty")
+        if self._ring:
+            if not self._ring_prefix_warned:
+                self._ring_prefix_warned = True
+                log.warning("prefill tier: rolling (ring) caches cannot "
+                            "host prefix templates; serving prefix-blind")
+            return None
+        if len(tokens) + 2 > self.max_len:
+            raise ValueError(
+                f"prefix of {len(tokens)} tokens leaves no room for a "
+                f"suffix + generation under max_len {self.max_len}")
+        pid = prefix_id or fingerprint(tokens)
+        template = prefix_template(self.params, tokens, self.cfg)
+        self._prefix_store[str(pid)] = (tokens, template)
+        return str(pid)
+
+    def install_prefix_template(self, meta, bufs) -> str:
+        from tony_tpu.models.serve import validate_template_bufs
+
+        if int(meta["vocab"]) != self.cfg.vocab_size:
+            raise ValueError(
+                f"template vocab {meta['vocab']} != this model's "
+                f"{self.cfg.vocab_size} (shipped from a different "
+                f"model?)")
+        if self._ring:
+            raise ValueError("rolling-cache layout cannot host prefix "
+                             "templates (degraded prefix-blind)")
+        tokens = [int(t) for t in meta["tokens"]]
+        if len(tokens) + 2 > self.max_len:
+            # same room check as the local install paths: a too-long
+            # shipped template would otherwise install, get ADVERTISED
+            # (steering the router's prefix placement here), yet never
+            # serve a single admissible prompt
+            raise ValueError(
+                f"prefix of {len(tokens)} tokens leaves no room for a "
+                f"suffix + generation under max_len {self.max_len}")
+        if self._proto_bufs is None:
+            from tony_tpu.models.decode import _kv_bufs, init_kv_cache
+            self._proto_bufs = _kv_bufs(init_kv_cache(self.cfg, 1, 1))
+        template = validate_template_bufs(self._proto_bufs, tokens, bufs)
+        pid = str(meta["id"])
+        self._prefix_store[pid] = (tokens, template)
+        return pid
+
+    def resident_prefixes(self) -> list:
+        return sorted(self._prefix_store)
+
+    def _prefix_blob(self, prefix_id: str) -> bytes:
+        entry = self._prefix_store.get(str(prefix_id))
+        if entry is None:
+            raise ValueError(f"prefix {prefix_id!r} is not resident")
+        tokens, template = entry
+        return kvship.pack_template(
+            str(prefix_id), tokens,
+            {n: np.asarray(a) for n, a in template.items()},
+            self.cfg.vocab_size)
+
+    def _resolve_item(self, item: _PrefillItem):
+        """(tokens, template) the item's prompt continues, or None:
+        the explicit ADMIT prefix id first, else the longest resident
+        token-boundary match."""
+        if self._ring or not self._prefix_store:
+            return None
+        if item.prefix is not None:
+            ent = self._prefix_store.get(item.prefix)
+            if (ent is not None and len(ent[0]) < len(item.prompt)
+                    and item.prompt[:len(ent[0])] == ent[0]):
+                return item.prefix, ent
+        entries = list(self._prefix_store.items())
+        pid = match_prefix(item.prompt,
+                           ((p, e[0]) for p, e in entries))
+        return next(((p, e) for p, e in entries if p == pid), None) \
+            if pid is not None else None
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
@@ -173,9 +286,11 @@ class PrefillServer(FrameServerBase):
                                         name="tony-prefill-worker",
                                         daemon=True)
         self._worker.start()
+        self._start_prefix_host()
         port = super().start()
-        log.info("prefill tier on %s:%s (%d-row waves)", self.bind_host,
-                 port, self.max_batch)
+        log.info("prefill tier on %s:%s (%d-row waves; prefix lane on "
+                 ":%s)", self.bind_host, port, self.max_batch,
+                 self.prefix_port)
         return port
 
     def stop(self) -> None:
@@ -185,6 +300,7 @@ class PrefillServer(FrameServerBase):
             self._cv.notify_all()
         if self._worker is not None:
             self._worker.join(timeout=60)
+        self._stop_prefix_host()
         with self._senders_lock:
             senders, self._senders = list(self._senders.values()), {}
         for s in senders:
@@ -195,7 +311,9 @@ class PrefillServer(FrameServerBase):
 
     # -- frame handling (reader threads) ------------------------------------
     def _hello_payload(self) -> dict:
-        return {"v": 1, "role": "prefill", "slots": self.max_batch}
+        return {"v": 1, "role": "prefill", "slots": self.max_batch,
+                "prefixes": self.resident_prefixes(),
+                "ring": self._ring, "prefix_port": self.prefix_port}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -205,6 +323,8 @@ class PrefillServer(FrameServerBase):
             self._cancel(conn, rid)
         elif ftype == P.STATS:
             conn.send(P.STATS, 0, P.pack_json(self.stats()))
+        elif ftype == P.PREFIX:
+            self._handle_prefix_frame(conn, rid, payload)
         else:
             raise P.ProtocolError(
                 f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}"
@@ -214,7 +334,9 @@ class PrefillServer(FrameServerBase):
         with self._cv:
             depth, active = len(self._queue), self._inflight
         return {"queue_depth": depth, "active": active,
-                "slots": self.max_batch, "role": "prefill"}
+                "slots": self.max_batch, "role": "prefill",
+                "prefixes": self.resident_prefixes(),
+                "ring": self._ring}
 
     def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
         prompt, max_new, _stream = P.parse_admit(payload)
@@ -244,7 +366,8 @@ class PrefillServer(FrameServerBase):
                 return
             item = _PrefillItem(conn, rid, prompt, max_new, decode,
                                 self._next_stream,
-                                P.parse_trace_ctx(obj))
+                                P.parse_trace_ctx(obj),
+                                prefix=P.parse_prefix_id(obj))
             self._next_stream += 1
             self._items[key] = item
             self._queue.append(item)
@@ -316,14 +439,30 @@ class PrefillServer(FrameServerBase):
                     for item in wave:
                         self._prefill_group([item], 0)
                 else:
-                    groups: dict[int, list] = {}
+                    # group by (resident prefix, bucket): a prefix-hit
+                    # group pays only its suffixes' prefill compute
+                    groups: dict[tuple, list] = {}
+                    entries: dict = {None: None}
                     for item in wave:
-                        groups.setdefault(
-                            bucket_for(len(item.prompt), self.max_len,
-                                       self.admission_buckets),
-                            []).append(item)
-                    for bucket in sorted(groups):
-                        self._prefill_group(groups[bucket], bucket)
+                        hit = self._resolve_item(item)
+                        if hit is None:
+                            key = (None,
+                                   bucket_for(len(item.prompt),
+                                              self.max_len,
+                                              self.admission_buckets))
+                        else:
+                            pid, ent = hit
+                            entries[pid] = ent
+                            cap = self.max_len - len(ent[0])
+                            key = (pid,
+                                   bucket_for(len(item.prompt)
+                                              - len(ent[0]), cap,
+                                              self.admission_buckets))
+                        groups.setdefault(key, []).append(item)
+                    for pid, bucket in sorted(
+                            groups, key=lambda k: (k[0] or "", k[1])):
+                        self._prefill_group(groups[(pid, bucket)],
+                                            bucket, entries[pid])
             except Exception as e:  # noqa: BLE001 — thread survival
                 # the tier's ONLY worker: an unexpected wave failure
                 # must cost this wave, never the thread (a dead worker
@@ -350,17 +489,22 @@ class PrefillServer(FrameServerBase):
                 with self._cv:
                     self._inflight = 0
 
-    def _prefill_group(self, grp: list[_PrefillItem],
-                       bucket: int) -> None:
+    def _prefill_group(self, grp: list[_PrefillItem], bucket: int,
+                       entry: tuple | None = None) -> None:
         """Prefill one bucket group (padded to ``max_batch`` rows — one
-        compiled program per bucket) and ship each real row. Overridden
-        hooks: the bench's deterministic arm injects its prefill
-        compute floor around this."""
+        compiled program per bucket) and ship each real row. ``entry``
+        is a resident-prefix ``(tokens, template)`` pair: the group
+        then runs only its SUFFIXES through the model
+        (:func:`~tony_tpu.models.serve.prefix_ship_rows`) and ships
+        prefix+suffix rows. Overridden hooks: the bench's
+        deterministic arm injects its prefill compute floor around
+        this."""
         import jax
 
         from tony_tpu.models.decode import extract_kv_rows
         from tony_tpu.models.serve import (prefill_ship_row,
-                                           prefill_ship_rows)
+                                           prefill_ship_rows,
+                                           prefix_ship_rows)
         import jax.numpy as jnp
 
         for item in grp:
@@ -373,6 +517,26 @@ class PrefillServer(FrameServerBase):
                     jnp.asarray(item.prompt, jnp.int32)[None], self.cfg)
                 widths = [mini["k"].shape[2]]
                 lengths = [len(item.prompt)]
+                fwd = len(item.prompt)
+            elif entry is not None:
+                p_toks, template = entry
+                p_len = len(p_toks)
+                toks = np.zeros((self.max_batch, bucket), np.int64)
+                lens = np.ones((self.max_batch,), np.int32)
+                for i, item in enumerate(grp):
+                    suffix = item.prompt[p_len:]
+                    toks[i, :len(suffix)] = suffix
+                    lens[i] = len(suffix)
+                lg, mini = prefix_ship_rows(
+                    self.params, template,
+                    jnp.asarray(toks, jnp.int32), jnp.asarray(lens),
+                    self.cfg)
+                # the shipped row is the FULL prefix+suffix frontier —
+                # the decode gang lands it like any other package
+                widths = [len(item.prompt) for item in grp]
+                lengths = widths
+                fwd = sum(len(item.prompt) - p_len for item in grp)
+                self._pref_tok_c.inc(p_len * len(grp))
             else:
                 toks = np.zeros((self.max_batch, bucket), np.int64)
                 lens = np.ones((self.max_batch,), np.int32)
@@ -384,8 +548,10 @@ class PrefillServer(FrameServerBase):
                     jnp.asarray(lens), self.cfg)
                 widths = [len(item.prompt) for item in grp]
                 lengths = widths
+                fwd = sum(widths)
             rows = extract_kv_rows(mini, widths)
             lg_host = jax.device_get(lg)
+            self._fwd_tok_c.inc(fwd)
         except Exception as e:            # device failure: request-scoped
             log.exception("prefill wave failed")
             for item in grp:

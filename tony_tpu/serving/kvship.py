@@ -148,6 +148,62 @@ def pack_kv_meta(rid: int, budget: int, length: int, rng_key,
     return meta
 
 
+#: the ``kind`` tag distinguishing a prefix-template blob from a KV row
+#: shipment sharing the same header+raw-buffers wire shape (a template
+#: arriving on the kvship lane fails ``parse_kv_meta``; a row shipment
+#: arriving on the prefix lane fails ``unpack_template`` — neither can
+#: be silently misread as the other)
+TEMPLATE_KIND = "prefix_template"
+
+#: sanity cap on a template's token list (a prefix is a system prompt /
+#: few-shot header, not a corpus; a million-token "prefix" is a corrupt
+#: or adversarial header)
+MAX_TEMPLATE_TOKENS = 1 << 20
+
+
+def pack_template(prefix_id: str, tokens, bufs: dict, vocab: int) -> bytes:
+    """Pack a shared-prefix K/V template for publication to a peer
+    replica: the same header+raw-buffers wire shape as a row shipment
+    (:func:`pack_shipment`), with the meta carrying the template's
+    identity — ``id``, the prefix ``tokens`` (the installer registers
+    them for prompt matching and suffix splitting), and the producing
+    model's ``vocab`` (a template from a differently-shaped model must
+    be rejected at install, not discovered as garbage logits mid-
+    serve). ``bufs`` ship in their STORAGE dtype exactly like row
+    shipments — an int8-quantized cache's template is int8 values +
+    f32 scales, bf16 stays bf16 (bit-identical round trip,
+    test-pinned)."""
+    meta = {"kind": TEMPLATE_KIND, "id": str(prefix_id),
+            "tokens": [int(t) for t in tokens], "vocab": int(vocab)}
+    return pack_shipment(meta, bufs)
+
+
+def unpack_template(blob: bytes) -> tuple[dict, dict]:
+    """Parse + validate a template blob -> (meta, {name: ndarray}).
+    Anything structurally off — including a KV row shipment routed onto
+    the template lane — raises ProtocolError; the install thread drops
+    the blob and keeps serving."""
+    meta, bufs = unpack_shipment(blob)
+    if meta.get("kind") != TEMPLATE_KIND:
+        raise ProtocolError(
+            f"not a prefix template (kind={meta.get('kind')!r})")
+    pid = meta.get("id")
+    tokens = meta.get("tokens")
+    vocab = meta.get("vocab")
+    if not isinstance(pid, str) or not 0 < len(pid) <= 128:
+        raise ProtocolError(f"malformed template id: {pid!r}")
+    if (not isinstance(tokens, list) or not tokens
+            or len(tokens) > MAX_TEMPLATE_TOKENS
+            or not all(isinstance(t, int) and not isinstance(t, bool)
+                       and t >= 0 for t in tokens)):
+        raise ProtocolError("malformed template token list")
+    if isinstance(vocab, bool) or not isinstance(vocab, int) or vocab < 1:
+        raise ProtocolError(f"malformed template vocab: {vocab!r}")
+    if not bufs:
+        raise ProtocolError("template carries no buffers")
+    return meta, bufs
+
+
 def parse_kv_meta(meta: dict) -> dict:
     """Validate an adoption record (the decode server's landing thread
     calls this before touching the engine); returns the meta with

@@ -92,11 +92,20 @@ HANDOFF = 9
 #: RETIRED frames here (rids are the shipper's, globally unique per
 #: router). Last BIND wins; empty payload.
 BIND = 10
+#: c -> s then s -> c (prefix-aware serving): a JSON prefix-catalog op
+#: and its reply on the same rid. Replica-side ops: ``install`` (make a
+#: prefix resident by computing its K/V template locally), ``publish``
+#: (ship a resident template to a peer replica's template lane — the
+#: warm path), ``list``. Router-side ops: ``register`` (add a prefix to
+#: the matching catalog), ``list``. Replies are
+#: ``{"ok": bool, ...}`` — op failures are request-scoped, never
+#: connection-scoped.
+PREFIX = 11
 
 FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
                TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
                STATS: "STATS", HELLO: "HELLO", HANDOFF: "HANDOFF",
-               BIND: "BIND"}
+               BIND: "BIND", PREFIX: "PREFIX"}
 
 #: sanity bound on one frame's body (type + rid + payload). A prompt of
 #: a million tokens is ~4 MB; anything past this is a corrupt length
@@ -306,6 +315,26 @@ def parse_decode_target(obj: dict) -> str | None:
         # the channel sender on the prefill tier's worker thread
         if host and port.isdigit() and 0 < int(port) < 65536:
             return addr
+    return None
+
+
+def parse_prefix_id(payload_or_obj) -> str | None:
+    """Extract the OPTIONAL ``prefix`` id from an ADMIT payload:
+    ``{"prefix": "<id>"}`` names the shared-prefix template the prompt
+    continues, so the router can place the session where that prefix's
+    KV is already resident and the engine can admit only the suffix
+    through the model. Never load-bearing: absent/malformed is simply
+    ``None`` (the request still serves, prefix-blind), and a replica
+    that does not hold the named template falls back to a full
+    prefill — outputs are token-identical either way."""
+    try:
+        obj = payload_or_obj if isinstance(payload_or_obj, dict) \
+            else unpack_json(payload_or_obj)
+        pid = obj.get("prefix")
+        if isinstance(pid, str) and 0 < len(pid) <= 128:
+            return pid
+    except ProtocolError:
+        pass
     return None
 
 

@@ -53,6 +53,7 @@ import time
 
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.serving import protocol as P
+from tony_tpu.serving.prefix import fingerprint, match_prefix
 from tony_tpu.serving.server import FrameConn, FrameServerBase
 
 log = logging.getLogger(__name__)
@@ -97,6 +98,18 @@ class _ReplicaLink:
         #: the decode tier's channel-hub endpoint port (what prefill
         #: replicas are told to ship this gang's KV packages to)
         self.channel_port = self.hello.get("channel_port")
+        #: resident shared-prefix templates this replica advertised
+        #: (HELLO at connect, refreshed by every STATS reply) — what
+        #: prefix-aware placement reads
+        self.prefixes = self._parse_prefixes(self.hello)
+        #: rolling-cache layout: positional prefix templates cannot be
+        #: resident here — the router places prefix traffic on it
+        #: PREFIX-BLIND (one warning, never an error)
+        self.ring = bool(self.hello.get("ring"))
+        self.slots = int(self.hello.get("slots", 0) or 0)
+        #: decode slots with no live occupant per the last STATS — the
+        #: equal-queue-depth placement tiebreak
+        self.idle_slots = self.slots
         got_role = self.hello.get("role")
         if role != "engine" and got_role != role:
             self._sock.close()
@@ -148,12 +161,30 @@ class _ReplicaLink:
                     obj = P.unpack_json(payload)
                     self.reported_load = (int(obj.get("queue_depth", 0))
                                           + int(obj.get("active", 0)))
+                    if "slots" in obj:
+                        self.slots = int(obj.get("slots", 0) or 0)
+                    self.idle_slots = max(
+                        0, self.slots - int(obj.get("active", 0)))
+                    if "prefixes" in obj:
+                        got = self._parse_prefixes(obj)
+                        if got != self.prefixes:
+                            # residency gauges refresh only on an
+                            # actual change, not every health ping
+                            self.prefixes = got
+                            router._refresh_prefix_residency()
                     self.last_stats = time.monotonic()
                     self.pings_unanswered = 0
                     router._note_stats(self)
         except (P.ProtocolError, OSError):
             pass
         router._replica_down(self)
+
+    @staticmethod
+    def _parse_prefixes(obj: dict) -> set:
+        got = obj.get("prefixes")
+        if not isinstance(got, list):
+            return set()
+        return {p for p in got if isinstance(p, str) and len(p) <= 128}
 
     def close(self) -> None:
         self.alive = False
@@ -170,14 +201,21 @@ class _ReplicaLink:
 class _RouterSession:
     __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
                  "prefill_link", "handed_off", "rrid", "cancelled",
-                 "trace_ctx")
+                 "trace_ctx", "prefix_id")
 
     def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
-                 budget: int, trace_ctx: dict | None = None) -> None:
+                 budget: int, trace_ctx: dict | None = None,
+                 prefix_id: str | None = None) -> None:
         self.conn = conn
         self.crid = crid
         self.prompt = prompt
         self.budget = budget
+        #: the shared prefix this session continues (ADMIT's prefix
+        #: field, or the router's tokenized match): prefix-aware
+        #: placement prefers replicas where it is resident, and the id
+        #: is forwarded on every replica ADMIT — including failover
+        #: re-placements (a cold survivor just full-prefills)
+        self.prefix_id = prefix_id
         self.streamed: list[int] = []       # every token forwarded
         #: the link TOKENS flow from: the replica itself (colocated) or
         #: the DECODE link of a disaggregated placement pair
@@ -220,7 +258,8 @@ class ServingRouter(FrameServerBase):
 
     def __init__(self, replicas, bind_host: str = "127.0.0.1",
                  port: int = 0, health_interval_s: float = 0.5,
-                 decode_replicas=None, registry=None) -> None:
+                 decode_replicas=None, registry=None,
+                 prefixes=None) -> None:
         super().__init__(bind_host, port)
         self._replica_addrs = list(replicas)
         self._decode_addrs = list(decode_replicas or [])
@@ -235,6 +274,13 @@ class ServingRouter(FrameServerBase):
         self._downed: set[int] = set()      # id()s of links already torn
         self.health_interval_s = health_interval_s
         self._health_thread: threading.Thread | None = None
+        #: the prefix-matching catalog: id -> token list. ADMITs naming
+        #: no prefix are matched here (longest proper token-boundary
+        #: prefix); residency still comes from the replicas' own
+        #: advertisements, so a stale catalog can only cost fast-path
+        #: hits, never correctness.
+        self._prefix_catalog: dict[str, list[int]] = {}
+        self._ring_warned: set[str] = set()
         reg = registry or metrics_mod.get_default()
         self._reg = reg
         self._failovers_c = reg.counter(
@@ -244,9 +290,23 @@ class ServingRouter(FrameServerBase):
             "tony_router_handoffs_total",
             help="prefill->decode KV handoffs observed (disaggregated "
                  "placement mode)")
+        self._prefix_hits_c = reg.counter(
+            "tony_router_prefix_hits_total",
+            help="prefix-naming sessions placed on a replica where the "
+                 "prefix KV is already resident")
+        self._prefix_misses_c = reg.counter(
+            "tony_router_prefix_misses_total",
+            help="prefix-naming sessions placed prefix-blind (no live "
+                 "replica had the prefix resident)")
         self._up_g = {}
         self._depth_g = {}
         self._placed_c = {}
+        self._resident_g: dict[str, object] = {}
+        if prefixes:
+            # after the registry fields: register_prefix refreshes the
+            # residency gauges
+            for pid, toks in dict(prefixes).items():
+                self.register_prefix(toks, prefix_id=pid)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
@@ -269,6 +329,7 @@ class ServingRouter(FrameServerBase):
                 help="sessions placed on the replica", replica=addr)
             self._up_g[addr].set(1)
             link = _ReplicaLink(addr, self, role=role)
+            self._warn_if_ring(link)
             if role == "decode":
                 if link.channel_port is None:
                     link.close()
@@ -279,6 +340,7 @@ class ServingRouter(FrameServerBase):
                 # TOKENS/RETIRED frames push down this link
                 link.send(P.BIND, 0)
             self._links.append(link)
+        self._refresh_prefix_residency()
         port = super().start()
         self._health_thread = threading.Thread(
             target=self._health_loop, name="tony-router-health",
@@ -287,6 +349,66 @@ class ServingRouter(FrameServerBase):
         log.info("router on %s:%s over %d replicas", self.bind_host,
                  port, len(self._links))
         return port
+
+    # -- prefix catalog -----------------------------------------------------
+    def register_prefix(self, tokens, prefix_id: str | None = None) -> str:
+        """Add a shared prefix to the matching catalog (callable before
+        or after :meth:`start`, and remotely via the ``PREFIX``
+        ``register`` op); returns its id — the content fingerprint
+        unless given, so it names the same prefix the replicas
+        installed. Bounded like the template wire codec: ids cap at
+        128 chars and token lists at ``kvship.MAX_TEMPLATE_TOKENS``
+        (the register op is remote-reachable; an unbounded catalog
+        would grow router memory AND every unnamed ADMIT's match
+        cost)."""
+        from tony_tpu.serving.kvship import MAX_TEMPLATE_TOKENS
+
+        tokens = [int(t) for t in tokens]
+        if not tokens:
+            raise ValueError("prefix tokens must be non-empty")
+        if len(tokens) > MAX_TEMPLATE_TOKENS:
+            raise ValueError(
+                f"prefix of {len(tokens)} tokens exceeds the "
+                f"{MAX_TEMPLATE_TOKENS}-token cap — a prefix is a "
+                f"system prompt, not a corpus")
+        if prefix_id is not None and (
+                not isinstance(prefix_id, str)
+                or not 0 < len(prefix_id) <= 128):
+            raise ValueError(f"prefix id must be a 1..128-char string, "
+                             f"got {prefix_id!r}")
+        pid = prefix_id if prefix_id else fingerprint(tokens)
+        self._prefix_catalog[pid] = tokens
+        self._refresh_prefix_residency()
+        return pid
+
+    def _warn_if_ring(self, link: _ReplicaLink) -> None:
+        """A rolling-cache replica can never host a resident prefix —
+        say so ONCE and keep placing on it prefix-blind (graceful
+        degradation, never an error)."""
+        if link.ring and link.addr not in self._ring_warned:
+            self._ring_warned.add(link.addr)
+            log.warning(
+                "router: replica %s serves a rolling (ring) cache; "
+                "prefix-aware placement is disabled for it "
+                "(prefix-blind)", link.addr)
+
+    def _refresh_prefix_residency(self) -> None:
+        """Recompute the per-prefix residency gauges
+        (``tony_router_prefix_resident_replicas{prefix=...}``) over
+        the LIVE links' advertisements."""
+        links = list(self._links)       # snapshot vs concurrent callers
+        pids = set(self._prefix_catalog)
+        for link in links:
+            pids |= link.prefixes
+        for pid in pids:
+            g = self._resident_g.get(pid)
+            if g is None:
+                g = self._resident_g[pid] = self._reg.gauge(
+                    "tony_router_prefix_resident_replicas",
+                    help="live replicas advertising this prefix's KV "
+                         "template as resident", prefix=pid)
+            g.set(sum(1 for l in links
+                      if l.alive and pid in l.prefixes))
 
     def stop(self) -> None:
         self._stopping.set()
@@ -300,17 +422,35 @@ class ServingRouter(FrameServerBase):
             self._accept_thread.join(timeout=5)
 
     # -- placement ----------------------------------------------------------
+    @staticmethod
+    def _load_key(link: _ReplicaLink):
+        """Placement order: the load gauge first (the metrics-plane
+        signal), then — at EQUAL queue depths — the link with more
+        idle decode slots (headroom that admits without queueing),
+        then the router's own not-yet-reported assignment count
+        (spreads a burst between stats refreshes)."""
+        return (link.reported_load, -link.idle_slots, link.assigned)
+
     def _pick_link(self, exclude: _ReplicaLink | None = None,
-                   role: str | None = None):
+                   role: str | None = None,
+                   prefer_prefix: str | None = None):
+        """Least-loaded live link of ``role``. ``prefer_prefix``
+        restricts the pool to replicas advertising that prefix as
+        RESIDENT when any exist (sessions go where the prefix KV
+        already lives — the whole point of prefix-aware routing), and
+        falls back to the full pool on a cold fleet."""
         with self._lock:
             live = [l for l in self._links
                     if l.alive and l is not exclude
                     and (role is None or l.role == role)]
             if not live:
                 return None
-            # gauge first (the metrics-plane signal), local assignment
-            # count second (spreads a burst between stats refreshes)
-            return min(live, key=lambda l: (l.reported_load, l.assigned))
+            if prefer_prefix is not None:
+                resident = [l for l in live
+                            if prefer_prefix in l.prefixes]
+                if resident:
+                    live = resident
+            return min(live, key=self._load_key)
 
     def _unassign_locked(self, sess: _RouterSession) -> None:
         """Release a session's assignment counts (BOTH halves of a
@@ -369,12 +509,48 @@ class ServingRouter(FrameServerBase):
                     link.send(P.CANCEL, rrid)
         elif ftype == P.STATS:
             conn.send(P.STATS, 0, P.pack_json(self.stats()))
+        elif ftype == P.PREFIX:
+            self._handle_prefix_op(conn, rid, payload)
         elif ftype == P.POLL:
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": "router supports streaming requests only"}))
         else:
             raise P.ProtocolError(
                 f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}")
+
+    def _handle_prefix_op(self, conn: FrameConn, rid: int,
+                          payload: bytes) -> None:
+        """Router-side ``PREFIX`` ops: ``register`` (grow the matching
+        catalog) and ``list`` (catalog + fleet residency). Failures are
+        request-scoped replies, never connection deaths."""
+        obj = P.unpack_json(payload)
+        op = obj.get("op")
+        try:
+            if op == "register":
+                tokens = obj.get("tokens")
+                if (not isinstance(tokens, list) or not tokens
+                        or not all(isinstance(t, int)
+                                   and not isinstance(t, bool)
+                                   for t in tokens)):
+                    raise ValueError("register needs a non-empty token "
+                                     "list")
+                pid = self.register_prefix(tokens,
+                                           prefix_id=obj.get("id"))
+                body = {"ok": True, "id": pid,
+                        "catalog": sorted(self._prefix_catalog)}
+            elif op == "list":
+                body = {"ok": True,
+                        "catalog": sorted(self._prefix_catalog),
+                        "resident": {
+                            l.addr: sorted(l.prefixes)
+                            for l in self._links if l.alive}}
+            else:
+                body = {"ok": False,
+                        "error": f"unknown router prefix op {op!r} "
+                                 f"(install/publish go to replicas)"}
+        except ValueError as e:
+            body = {"ok": False, "error": str(e)}
+        conn.send(P.PREFIX, rid, P.pack_json(body))
 
     def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
         prompt, max_new, stream = P.parse_admit(payload)
@@ -389,6 +565,12 @@ class ServingRouter(FrameServerBase):
                 {"message": f"max_new_tokens must be positive, "
                             f"got {max_new}"}))
             return
+        # the session's prefix identity: the ADMIT's explicit id, or
+        # the router's tokenized longest-match against the catalog (the
+        # fallback for clients that know nothing about prefixes)
+        prefix_id = P.parse_prefix_id(payload)
+        if prefix_id is None and self._prefix_catalog:
+            prefix_id = match_prefix(prompt, self._prefix_catalog)
         key = (conn.id, rid)
         with self._lock:
             if key in self._sessions:
@@ -396,7 +578,8 @@ class ServingRouter(FrameServerBase):
                     {"message": f"request id {rid} is already active"}))
                 return
             sess = _RouterSession(conn, rid, prompt, max_new,
-                                  trace_ctx=P.parse_trace_ctx(payload))
+                                  trace_ctx=P.parse_trace_ctx(payload),
+                                  prefix_id=prefix_id)
             self._sessions[key] = sess
         if not self._place(sess, exclude=None):
             with self._lock:
@@ -417,16 +600,25 @@ class ServingRouter(FrameServerBase):
         one-shot ``_replica_down`` sweep before this session was
         registered, so relying on it would strand the session."""
         if self._disagg:
-            plink = self._pick_link(exclude=exclude, role="prefill")
+            plink = self._pick_link(exclude=exclude, role="prefill",
+                                    prefer_prefix=sess.prefix_id)
             dlink = self._pick_link(exclude=exclude, role="decode")
             if plink is None or dlink is None:
                 return False
             admit_link, token_link = plink, dlink
         else:
             plink = None
-            admit_link = token_link = self._pick_link(exclude=exclude)
+            admit_link = token_link = self._pick_link(
+                exclude=exclude, prefer_prefix=sess.prefix_id)
             if admit_link is None:
                 return False
+        if sess.prefix_id is not None:
+            # the placement's prefix outcome: resident (the prefill
+            # pays only the suffix) or blind (a cold/ring fleet)
+            if sess.prefix_id in admit_link.prefixes:
+                self._prefix_hits_c.inc()
+            else:
+                self._prefix_misses_c.inc()
         rrid = next(self._next_rrid)
         with self._lock:
             # the session may have died while it was between homes: a
@@ -469,6 +661,12 @@ class ServingRouter(FrameServerBase):
         body = {"prompt": sess.prompt + sess.streamed,
                 "max_new_tokens": sess.budget - len(sess.streamed),
                 "stream": True}
+        if sess.prefix_id is not None:
+            # forwarded on failover re-placements too: the streamed
+            # prefix folds in AFTER the shared prefix, so the re-placed
+            # prompt still continues it (replicas verify the tokens
+            # before taking the fast path regardless)
+            body["prefix"] = sess.prefix_id
         if plink is not None:
             # the KV shipment target: the decode gang's channel hub
             host = token_link.addr.rpartition(":")[0]
@@ -605,6 +803,7 @@ class ServingRouter(FrameServerBase):
         link.alive = False
         link.close()
         self._up_g[link.addr].set(0)
+        self._refresh_prefix_residency()
         with self._lock:
             orphans = [s for s in self._by_rrid.values()
                        if s.link is link
@@ -666,10 +865,13 @@ class ServingRouter(FrameServerBase):
                              if not self._disagg or l.role == "decode"),
                 "sessions": len(self._sessions),
                 "disaggregated": self._disagg,
+                "prefixes": sorted(self._prefix_catalog),
                 "replicas": {
                     l.addr: {"up": int(l.alive),
                              "reported_load": l.reported_load,
                              "assigned": l.assigned,
-                             "role": l.role}
+                             "role": l.role,
+                             "prefixes": sorted(l.prefixes),
+                             "ring": l.ring}
                     for l in self._links},
             }

@@ -35,7 +35,9 @@ import logging
 import socket
 import threading
 
+from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.serving import protocol as P
+from tony_tpu.serving.prefix import PrefixHost, fingerprint
 
 log = logging.getLogger(__name__)
 
@@ -211,7 +213,7 @@ class _Session:
         self.poll_pending = False
 
 
-class ServingServer(FrameServerBase):
+class ServingServer(PrefixHost, FrameServerBase):
     """Drive a batcher's :class:`~tony_tpu.models.serve.ServeEngine`
     behind the TONYS1 streaming protocol.
 
@@ -221,6 +223,15 @@ class ServingServer(FrameServerBase):
         port = server.start()          # engine + accept threads
         ...
         server.stop(drain=True)        # finish in-flight, then exit
+
+    PREFIX-AWARE serving (docs/serving.md §Prefix-aware routing): the
+    server is a :class:`~tony_tpu.serving.prefix.PrefixHost` — its
+    HELLO and STATS advertise the batcher's resident prefix templates
+    (and the template lane's ``prefix_port``), ``PREFIX`` frames carry
+    install/publish/list ops, and a peer's published template lands
+    through the lane into the batcher's store with zero prefill
+    forwards. ADMITs naming (or auto-matching) a resident prefix run
+    only their suffix through the model.
     """
 
     def __init__(self, batcher, bind_host: str = "127.0.0.1",
@@ -234,15 +245,36 @@ class ServingServer(FrameServerBase):
                                   on_retired=self._on_retired,
                                   registry=registry)
         self._engine_thread: threading.Thread | None = None
+        self._init_prefix_host(registry or metrics_mod.get_default())
+
+    # -- resident prefix templates (PrefixHost hooks) -----------------------
+    def install_prefix(self, tokens, prefix_id: str | None = None):
+        """Compute ``tokens``' K/V template locally and make it
+        resident; returns the prefix id (content fingerprint unless
+        given), or None when the batcher degraded prefix-blind (ring
+        layout)."""
+        pid = prefix_id or fingerprint(tokens)
+        return pid if self.batcher.install_prefix(pid, tokens) else None
+
+    def install_prefix_template(self, meta, bufs) -> str:
+        return self.batcher.install_prefix_template(meta, bufs)
+
+    def resident_prefixes(self) -> list:
+        return self.batcher.resident_prefixes()
+
+    def _prefix_blob(self, prefix_id: str) -> bytes:
+        return self.batcher.export_prefix_blob(prefix_id)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
         self._engine_thread = threading.Thread(
             target=self.engine.run, name="tony-serve-engine", daemon=True)
         self._engine_thread.start()
+        self._start_prefix_host()
         port = super().start()
-        log.info("serving on %s:%s (%d slots)", self.bind_host, port,
-                 self.batcher.batch)
+        log.info("serving on %s:%s (%d slots; prefix lane on :%s)",
+                 self.bind_host, port, self.batcher.batch,
+                 self.prefix_port)
         return port
 
     def stop(self, drain: bool = False,
@@ -274,6 +306,7 @@ class ServingServer(FrameServerBase):
                 self.engine.stop()
                 self._engine_thread.join(timeout=60)
         self._stopping.set()
+        self._stop_prefix_host()
         self._close_conns()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
@@ -285,6 +318,7 @@ class ServingServer(FrameServerBase):
         self._stopping.set()
         self._close_listener()
         self._close_conns()
+        self._stop_prefix_host()
         self.engine.stop()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout=60)
@@ -292,8 +326,12 @@ class ServingServer(FrameServerBase):
     # -- frame handling (reader threads) ------------------------------------
     def _hello_payload(self) -> dict:
         # "role" lets a disaggregation-aware router sanity-check what
-        # it connected to (a colocated engine serves prompts end-to-end)
-        return {"v": 1, "slots": self.batcher.batch, "role": "engine"}
+        # it connected to (a colocated engine serves prompts end-to-end);
+        # "prefixes"/"ring"/"prefix_port" seed residency-aware routing
+        return {"v": 1, "slots": self.batcher.batch, "role": "engine",
+                "prefixes": self.batcher.resident_prefixes(),
+                "ring": self.batcher._ring,
+                "prefix_port": self.prefix_port}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -304,7 +342,12 @@ class ServingServer(FrameServerBase):
         elif ftype == P.POLL:
             self._poll(conn, rid)
         elif ftype == P.STATS:
-            conn.send(P.STATS, 0, P.pack_json(self.engine.stats()))
+            conn.send(P.STATS, 0, P.pack_json(dict(
+                self.engine.stats(),
+                prefixes=self.batcher.resident_prefixes(),
+                ring=self.batcher._ring)))
+        elif ftype == P.PREFIX:
+            self._handle_prefix_frame(conn, rid, payload)
         else:
             raise P.ProtocolError(
                 f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}")
@@ -314,6 +357,7 @@ class ServingServer(FrameServerBase):
         # un-servable request is request-scoped (ERROR with its rid)
         prompt, max_new, stream = P.parse_admit(payload)
         trace_ctx = P.parse_trace_ctx(payload)
+        prefix_id = P.parse_prefix_id(payload)
         if rid == 0:
             raise P.ProtocolError("ADMIT rid must be nonzero")
         key = (conn.id, rid)
@@ -324,7 +368,8 @@ class ServingServer(FrameServerBase):
                 return
             self._sessions[key] = _Session(conn, rid, stream)
         try:
-            self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx)
+            self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx,
+                               prefix_id=prefix_id)
         except (ValueError, RuntimeError) as e:
             with self._lock:
                 self._sessions.pop(key, None)
