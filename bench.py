@@ -295,6 +295,14 @@ def main() -> None:
     # wall visible on the metrics plane (tests/test_disagg.py).
     out.update(_disagg_arm())
 
+    # live fleet operations on the simulated fleet: drain the most-
+    # loaded replica under concurrent streams; every migrated session's
+    # tokens are checked against the sim oracle, so the migration
+    # dup/drop gap is an exact count (== 0 tier-1-pinned,
+    # tests/test_fleet.py) and the drain wall is bounded by placement
+    # latency, not stream length.
+    out.update(_fleet_arm())
+
     # prefix-aware routing + shared KV prefix tier: sessions placed
     # where the prefix KV already lives (one replica computes the
     # prefix once, the other warms in one template ship), suffix-only
@@ -1026,6 +1034,79 @@ def _disagg_arm(slots: int = 4, n_streams: int = 2, n_admits: int = 6,
             itl_colo / max(itl_dis, 1e-9), 2),
         "serving_disagg_handoff_wall_s": round(handoff_wall, 4),
         "serving_disagg_handoffs": handoffs,
+    }
+
+
+def _fleet_arm(n_replicas: int = 4, n_streams: int = 8,
+               max_new: int = 80, itl_s: float = 0.003) -> dict:
+    """Planned drain under live load, on the simulated fleet: SimFleet
+    stands up ``n_replicas`` oracle-token replicas behind a real
+    router, ``n_streams`` sessions stream concurrently, and the most-
+    loaded replica is drained mid-stream. Every session's final token
+    list is compared against the ``sim_token`` oracle — dup/drop
+    during migration shows up as a positional mismatch, so
+    ``serving_migration_token_gap`` is an exact count, pinned == 0 by
+    tier-1 (tests/test_fleet.py). ``serving_drain_wall_s`` is the
+    wall from fence to last migrated ACK: with migration implemented
+    as re-prefill-on-survivor it is bounded by placement latency, not
+    by any session's remaining stream length."""
+    import threading
+
+    from tony_tpu.runtime.metrics import MetricsRegistry
+    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.simfleet import SimFleet, sim_token
+
+    reg = MetricsRegistry()
+    fleet = SimFleet(n_replicas, itl_s=itl_s, slots=16, registry=reg)
+    outs: dict = {}
+
+    def pump(client, rid):
+        toks = []
+        for delta in client.deltas(rid):
+            toks.extend(delta)
+        outs[rid] = toks
+
+    try:
+        port = fleet.start()
+        with StreamingClient("127.0.0.1", port) as client:
+            seeds = {}
+            threads = []
+            for i in range(n_streams):
+                seed = 1000 + 17 * i
+                rid = client.submit([seed, 1, 2, 3], max_new)
+                seeds[rid] = seed
+                t = threading.Thread(target=pump, args=(client, rid),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+            # let every stream get past first tokens so the drain
+            # migrates genuinely mid-flight sessions
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                reps = client.stats()["replicas"]
+                if all(s["assigned"] > 0 for s in reps.values()):
+                    break
+                time.sleep(0.01)
+            victim = max(reps, key=lambda a: reps[a]["assigned"])
+            res = client.drain_replica(victim)
+            assert res.get("drained"), f"drain failed: {res}"
+            for t in threads:
+                t.join(timeout=60)
+            gap = 0
+            for rid, toks in outs.items():
+                oracle = [sim_token(seeds[rid], p) for p in range(max_new)]
+                gap += abs(len(toks) - max_new)
+                gap += sum(1 for a, b in zip(toks, oracle) if a != b)
+    finally:
+        fleet.stop()
+    return {
+        "serving_fleet_replicas": n_replicas,
+        "serving_fleet_streams": n_streams,
+        "serving_drain_wall_s": round(res["wall_s"], 4),
+        "serving_drain_migrated": res["migrated"],
+        # dup/drop token count across every migrated session vs the
+        # oracle (== 0 tier-1-pinned)
+        "serving_migration_token_gap": gap,
     }
 
 

@@ -141,7 +141,8 @@ def _run_server(args, batcher) -> int:
     from tony_tpu.serving.server import ServingServer
 
     host, port = _parse_addr(args.listen)
-    server = ServingServer(batcher, bind_host=host, port=port)
+    server = ServingServer(batcher, bind_host=host, port=port,
+                           weights_version=args.weights_version or None)
     bound = server.start()
     if args.shared_prefix_file:
         _install_and_publish(args, server)
@@ -200,7 +201,8 @@ def _run_prefill(args, params, cfg) -> int:
                            max_len=(len(shared) + args.prompt_len
                                     + args.max_new_tokens),
                            seed=args.seed, max_batch=args.slots,
-                           bind_host=host, port=port)
+                           bind_host=host, port=port,
+                           weights_version=args.weights_version or None)
     bound = server.start()
     if args.shared_prefix_file:
         _install_and_publish(args, server)
@@ -220,7 +222,8 @@ def _run_decode(args, batcher) -> int:
     from tony_tpu.serving.disagg import DecodeServer
 
     host, port = _parse_addr(args.listen)
-    server = DecodeServer(batcher, bind_host=host, port=port)
+    server = DecodeServer(batcher, bind_host=host, port=port,
+                          weights_version=args.weights_version or None)
     bound = server.start()
     mode = "sampled" if args.temperature > 0 else "greedy"
     print(f"decode tier ({args.preset}, {mode}) on {host}:{bound} with "
@@ -324,6 +327,14 @@ def _run_client(args) -> int:
     from tony_tpu.serving.client import StreamingClient
 
     host, port = _parse_addr(args.connect)
+    if args.drain:
+        # operator mode: ask the ROUTER to live-migrate every session
+        # off a replica, print the summary, exit (docs/serving.md
+        # §Operating the fleet)
+        with StreamingClient(host, port) as client:
+            res = client.drain_replica(args.drain)
+        print(f"drain {args.drain}: {res}")
+        return 0 if res.get("ok") else 1
     vocab = T.PRESETS[args.preset].vocab_size
     rs = np.random.RandomState(args.seed)
     # with a shared prefix the workload is PREFIX-HEAVY: every prompt
@@ -462,6 +473,19 @@ def main() -> int:
                              "tokenized matching; client: prepend it "
                              "to every synthetic prompt (prefix-heavy "
                              "traffic)")
+    parser.add_argument("--weights_version", default="",
+                        help="with --listen: the weights generation "
+                             "this replica advertises (HELLO/STATS). "
+                             "Routers pin each session to its first "
+                             "placement's version, which is what makes "
+                             "drain-by-drain rolling upgrades "
+                             "session-transparent (docs/serving.md "
+                             "§Operating the fleet)")
+    parser.add_argument("--drain", default="", metavar="HOST:PORT",
+                        help="with --connect to a ROUTER: fence this "
+                             "replica and live-migrate every session "
+                             "off it (planned maintenance), print the "
+                             "summary, exit")
     parser.add_argument("--publish_prefix", default="",
                         metavar="HOST:PORT",
                         help="with --listen + --shared_prefix_file: "
@@ -474,6 +498,8 @@ def main() -> int:
                                     and args.listen):
         parser.error("--publish_prefix requires --listen and "
                      "--shared_prefix_file")
+    if args.drain and not args.connect:
+        parser.error("--drain requires --connect (a router address)")
 
     if args.connect:
         return _run_client(args)

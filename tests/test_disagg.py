@@ -593,6 +593,89 @@ def _package_blob(params, cfg, rid, budget, prompt=(3, 1, 4, 1, 5),
     return kvship.pack_shipment(meta, dict(bufs, logits=logits))
 
 
+class TestDisaggDrain:
+    """Planned decode-replica drain: the live-operability twin of the
+    failover pin. The router fences the replica, re-prefills each of
+    its sessions through the prefill tier onto the survivor (streamed
+    prefix folded in, rng stream + offset pinned), and the old
+    placement streams until the new one ACKs — zero duplicated or
+    dropped tokens, greedy and sampled."""
+
+    def _run(self, params, *, seed=0, temperature=0.0, top_k=0,
+             top_p=0.0, ref=None):
+        class SlowFetch(ContinuousBatcher):
+            def _fetch(self, handle):
+                time.sleep(0.05)          # keep streams mid-flight
+                return super()._fetch(handle)
+
+        # batch=4: the surviving decode replica has idle slots, so
+        # migrations ACK while the old placement still streams
+        kw = dict(batch=4, max_len=64, chunk=2, seed=seed,
+                  temperature=temperature, top_k=top_k, top_p=top_p)
+        batchers = [SlowFetch(params, CFG, **kw) for _ in range(2)]
+        prompts = _prompts(44, (5, 5, 4, 6))
+        budget = 20
+        if ref is None:
+            ref = [_reference(params, p, budget) for p in prompts]
+        else:
+            ref = ref(kw, prompts, budget)
+        with _Stack(params, CFG, max_len=64, seed=seed,
+                    decode_batchers=batchers) as st:
+            with StreamingClient("127.0.0.1", st.port) as c:
+                rids = [c.submit(p, budget) for p in prompts]
+                got = {r: [] for r in rids}
+                started = set()
+                deadline = time.time() + 90
+                while len(started) < len(rids) and time.time() < deadline:
+                    for r in rids:
+                        if r in started:
+                            continue
+                        try:
+                            ev = c.next_event(r, timeout=0.05)
+                        except Exception:
+                            continue
+                        assert ev[0] == "tokens", ev
+                        got[r].extend(ev[1])
+                        started.add(r)
+                assert len(started) == len(rids), "streams never started"
+                reps = st.router.stats()["replicas"]
+                decode = {a: v for a, v in reps.items()
+                          if v["role"] == "decode"}
+                assert all(v["assigned"] > 0 for v in decode.values())
+                victim = max(decode, key=lambda a: decode[a]["assigned"])
+                res = c.drain_replica(victim)
+                assert res.get("drained"), res
+                assert res["migrated"] >= 1, res
+                for r in rids:
+                    while True:
+                        ev = c.next_event(r, timeout=90)
+                        if ev[0] == "tokens":
+                            got[r].extend(ev[1])
+                        elif ev[0] == "retired":
+                            break
+                        else:
+                            raise AssertionError(ev)
+                for i, r in enumerate(rids):
+                    assert got[r] == ref[i], \
+                        f"stream {i}: dup/drop across decode drain"
+                post = st.router.stats()["replicas"]
+                assert post[victim]["draining"]
+                assert post[victim]["assigned"] == 0
+            # planned migration, not crash failover
+            assert st.regr.counter(
+                "tony_router_failovers_total").value == 0
+            assert st.regr.counter(
+                "tony_router_drains_total").value == 1
+
+    def test_decode_drain_zero_dup_drop_greedy(self, params):
+        self._run(params)
+
+    def test_decode_drain_zero_dup_drop_sampled(self, params):
+        self._run(params, seed=7, temperature=0.8, top_k=20, top_p=0.9,
+                  ref=lambda kw, prompts, budget: ContinuousBatcher(
+                      params, CFG, **kw).serve(prompts, budget))
+
+
 class TestDisaggCancel:
     def test_cancel_queued_and_mid_wave_both_retire(self, params):
         """Cancel a prompt still QUEUED at the prefill tier and one

@@ -1,0 +1,529 @@
+"""Live fleet operations on the simulated fleet (SimFleet: a real
+ServingRouter and real sockets in front of oracle-token replicas — no
+model stack, so a hundred replicas fit in one process).
+
+Pins, tier-1 scope:
+
+- planned drain under live load: zero duplicated/dropped tokens
+  (strict equality against the ``sim_token`` oracle) and EXACTLY one
+  terminal frame per session, including a 3-at-once drain storm;
+- drain edge cases: zero-session drain returns immediately; drain
+  racing the target's crash falls back to crash-failover with the same
+  zero dup/drop guarantee; client CANCEL mid-migration yields exactly
+  one terminal frame;
+- ``stop()`` racing a drain sweeps every session to a client-visible
+  terminal and double-stop is idempotent;
+- rolling weight upgrade: version-pinned migration tier to tier, token
+  continuity per session, old tier retired;
+- FleetController: no flapping on an oscillating load signal
+  (hysteresis + cooldown), real scale-up/down against SimProvider;
+- the bench arm's dup/drop gap == 0 and drain wall bounded.
+
+The 100-replica storm (drain 30 at once + seeded chaos crashes, p99
+placement latency bound off ``tony_router_place_seconds``) is @slow.
+"""
+
+import os
+import queue
+import random
+import sys
+import threading
+import time
+
+import pytest
+
+from tony_tpu.runtime.metrics import MetricsRegistry
+from tony_tpu.serving.client import StreamingClient
+from tony_tpu.serving.fleet import CapacityProvider, FleetController
+from tony_tpu.serving.simfleet import SimFleet, SimProvider, sim_token
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)          # for `import bench` (repo-root script)
+
+pytestmark = pytest.mark.fleet_sim
+
+
+def _oracle(seed, n):
+    return [sim_token(seed, p) for p in range(n)]
+
+
+def _pump(client, rid, out, timeout=60.0):
+    """Collect every event for ``rid`` until its FIRST terminal frame,
+    then linger briefly to catch any duplicate terminal (there must be
+    none). Stores ``(tokens, terminals)``."""
+    toks, terminals = [], []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            ev = client.next_event(rid, timeout=5.0)
+        except queue.Empty:
+            continue
+        if ev[0] == "tokens":
+            toks.extend(ev[1])
+        else:
+            terminals.append(ev)
+            break
+    # duplicate-terminal watch: nothing else may arrive for this rid
+    try:
+        terminals.append(client.next_event(rid, timeout=0.2))
+    except queue.Empty:
+        pass
+    out[rid] = (toks, terminals)
+
+
+def _launch_streams(client, n, max_new, out, prompt_len=4):
+    seeds, threads = {}, []
+    for i in range(n):
+        seed = 1000 + 17 * i
+        rid = client.submit([seed] + list(range(1, prompt_len)), max_new)
+        seeds[rid] = seed
+        t = threading.Thread(target=_pump, args=(client, rid, out),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+    return seeds, threads
+
+
+def _wait_spread(client, deadline_s=30.0):
+    """Block until every replica holds at least one session (so drains
+    migrate genuinely mid-flight streams)."""
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        reps = client.stats()["replicas"]
+        if reps and all(r["assigned"] > 0 for r in reps.values()):
+            return reps
+        time.sleep(0.01)
+    raise AssertionError("streams never spread across the fleet")
+
+
+def _assert_exact(out, seeds, max_new, reason="budget"):
+    for rid, (toks, terminals) in out.items():
+        assert len(terminals) == 1, \
+            f"rid {rid}: expected exactly one terminal, got {terminals}"
+        assert terminals[0][0] == "retired" and terminals[0][1] == reason, \
+            f"rid {rid}: unexpected terminal {terminals[0]}"
+        assert toks == _oracle(seeds[rid], max_new), \
+            f"rid {rid}: token dup/drop across migration"
+
+
+class TestDrainUnderLoad:
+    def test_drain_storm_zero_dup_drop(self):
+        """Drain 3 of 8 replicas AT ONCE while 16 sessions stream:
+        every session retires with the exact oracle token list and one
+        terminal frame; the drained replicas end fenced and empty."""
+        reg = MetricsRegistry()
+        fleet = SimFleet(8, itl_s=0.002, slots=16, registry=reg)
+        out = {}
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 16, 80, out)
+                reps = _wait_spread(client)
+                victims = sorted(reps, key=lambda a: -reps[a]["assigned"])[:3]
+                results = {}
+
+                def do_drain(addr):
+                    results[addr] = client.drain_replica(addr)
+
+                drains = [threading.Thread(target=do_drain, args=(a,),
+                                           daemon=True) for a in victims]
+                for d in drains:
+                    d.start()
+                for d in drains:
+                    d.join(timeout=60)
+                for addr, res in results.items():
+                    assert res.get("drained"), f"{addr}: {res}"
+                for t in threads:
+                    t.join(timeout=60)
+                _assert_exact(out, seeds, 80)
+                reps = client.stats()["replicas"]
+                for addr in victims:
+                    assert reps[addr]["draining"], addr
+                    assert reps[addr]["assigned"] == 0, addr
+            assert sum(r["migrated"] for r in results.values()) > 0
+            assert reg.counter("tony_router_migrations_total").value > 0
+            assert reg.counter("tony_router_drains_total").value == 3
+        finally:
+            fleet.stop()
+
+    def test_zero_session_drain_immediate(self):
+        fleet = SimFleet(2, registry=MetricsRegistry())
+        try:
+            fleet.start()
+            addr = fleet.addrs()[0]
+            t0 = time.monotonic()
+            res = fleet.router.drain(addr)
+            assert res["drained"] and res["migrated"] == 0
+            assert time.monotonic() - t0 < 2.0
+            # fence holds after the drain: new admissions avoid it
+            assert fleet.router.stats()["replicas"][addr]["draining"]
+            fleet.router.undrain(addr)
+            assert not fleet.router.stats()["replicas"][addr]["draining"]
+        finally:
+            fleet.stop()
+
+    def test_drain_racing_target_crash(self):
+        """The drain target crashes mid-drain: its sessions fall back
+        to crash-failover (rng-offset re-placement) and still retire
+        with the exact oracle tokens and one terminal each."""
+        reg = MetricsRegistry()
+        fleet = SimFleet(4, itl_s=0.004, slots=16, registry=reg)
+        out = {}
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 8, 60, out)
+                reps = _wait_spread(client)
+                victim = max(reps, key=lambda a: reps[a]["assigned"])
+                res_box = {}
+
+                def do_drain():
+                    res_box["res"] = client.drain_replica(victim)
+
+                d = threading.Thread(target=do_drain, daemon=True)
+                d.start()
+                fleet.kill(victim)
+                d.join(timeout=60)
+                for t in threads:
+                    t.join(timeout=60)
+                _assert_exact(out, seeds, 60)
+        finally:
+            fleet.stop()
+
+    def test_cancel_mid_migration_single_terminal(self):
+        """Client CANCEL while a migration is in flight: exactly one
+        terminal frame, no stray tokens after it."""
+        fleet = SimFleet(3, itl_s=0.01, slots=8,
+                         registry=MetricsRegistry())
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as client:
+                rid = client.submit([4242, 1, 2, 3], 400)
+                # wait for first tokens so the migration snapshots a
+                # non-empty stream
+                ev = client.next_event(rid, timeout=30)
+                assert ev[0] == "tokens"
+                client.migrate(rid)
+                client.cancel(rid)
+                terminals = []
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        ev = client.next_event(rid, timeout=1.0)
+                    except queue.Empty:
+                        if terminals:
+                            break
+                        continue
+                    if ev[0] != "tokens":
+                        terminals.append(ev)
+                assert len(terminals) == 1, terminals
+                assert terminals[0][:2] == ("retired", "cancelled")
+        finally:
+            fleet.stop()
+
+    def test_stop_racing_drain_sweeps_sessions(self):
+        """router.stop() while a drain migrates live sessions: every
+        session gets exactly one client-visible terminal, the drain
+        call reports not-drained, and a second stop() is a no-op."""
+        fleet = SimFleet(4, itl_s=0.01, slots=8,
+                         registry=MetricsRegistry())
+        out = {}
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 8, 400, out)
+                reps = _wait_spread(client)
+                victim = max(reps, key=lambda a: reps[a]["assigned"])
+                res_box = {}
+
+                def do_drain():
+                    try:
+                        res_box["res"] = fleet.router.drain(victim)
+                    except Exception as e:   # noqa: BLE001
+                        res_box["err"] = e
+
+                d = threading.Thread(target=do_drain, daemon=True)
+                d.start()
+                time.sleep(0.05)
+                fleet.router.stop()
+                fleet.router.stop()          # idempotent double-stop
+                d.join(timeout=30)
+                assert "err" not in res_box, res_box
+                for t in threads:
+                    t.join(timeout=30)
+                for rid, (_, terminals) in out.items():
+                    # exactly one protocol terminal; the client may
+                    # additionally synthesize a transport-loss error
+                    # once the router's listener goes away
+                    assert terminals and terminals[0][0] == "error", \
+                        (rid, terminals)
+                    for extra in terminals[1:]:
+                        assert extra == ("error",
+                                         "connection closed by server"), \
+                            (rid, terminals)
+        finally:
+            fleet.stop()
+
+
+class TestRollingUpgrade:
+    def test_upgrade_token_continuity(self):
+        """Stand up a v2 tier, drain the v1 tier replica by replica:
+        every in-flight session keeps its exact token stream, the old
+        tier is retired, and new sessions land on v2."""
+        reg = MetricsRegistry()
+        fleet = SimFleet(2, itl_s=0.004, slots=16,
+                         weights_version="v1", registry=reg)
+        out = {}
+        try:
+            port = fleet.start()
+            ctrl = FleetController(fleet.router, SimProvider(fleet),
+                                   registry=reg)
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 6, 80, out)
+                _wait_spread(client)
+                old = fleet.router.stats()["replicas"]
+                new_addrs = [fleet.spawn(weights_version="v2")
+                             for _ in range(2)]
+                results = ctrl.rolling_upgrade(new_addrs)
+                for addr, res in results.items():
+                    assert res.get("drained"), (addr, res)
+                for t in threads:
+                    t.join(timeout=60)
+                _assert_exact(out, seeds, 80)
+                reps = client.stats()["replicas"]
+                assert set(reps) == set(new_addrs)
+                assert all(r["weights_version"] == "v2"
+                           for r in reps.values())
+                assert set(old).isdisjoint(reps)
+                # a fresh session lands on the new tier and streams
+                rid = client.submit([7, 1, 2, 3], 5)
+                toks, reason = client.result(rid)
+                assert reason == "budget" and toks == _oracle(7, 5)
+            assert reg.counter("tony_fleet_upgrades_total").value == 1
+        finally:
+            fleet.stop()
+
+
+class _ScriptedRouter:
+    """stats()-only stand-in driving FleetController.tick()
+    deterministically: each tick() observes the next scripted
+    (load, active) pair over a fixed 4-replica, 64-slot fleet."""
+
+    def __init__(self, script):
+        self._script = list(script)
+        self._i = 0
+        self.added, self.removed, self.drained = [], [], []
+
+    def stats(self):
+        load, active = self._script[min(self._i,
+                                        len(self._script) - 1)]
+        self._i += 1
+        n = 4 + len(self.added) - len(self.removed)
+        return {
+            "active": active, "slots": 16 * n,
+            "replicas": {f"r{i}": {"up": 1, "reported_load": load,
+                                   "assigned": active // max(n, 1),
+                                   "draining": False}
+                         for i in range(n)},
+        }
+
+    def add_replicas(self, addrs, role=None):
+        self.added.extend(addrs)
+
+    def remove_replica(self, addr):
+        self.removed.append(addr)
+
+    def drain(self, addr, timeout_s=None):
+        self.drained.append(addr)
+        return {"drained": True, "migrated": 0}
+
+
+class _CountingProvider(CapacityProvider):
+    def __init__(self):
+        self.grown, self.released = 0, []
+
+    def grow(self, n):
+        addrs = [f"new{self.grown + i}" for i in range(n)]
+        self.grown += n
+        return addrs
+
+    def release(self, addrs):
+        self.released.extend(addrs)
+
+
+class TestFleetController:
+    def test_no_flap_on_oscillating_load(self):
+        """Load that alternates above/below the scale-up threshold
+        every tick must never trigger an action: the hysteresis
+        counter resets on each dip."""
+        script = [(8.0, 60) if i % 2 == 0 else (1.0, 30)
+                  for i in range(40)]
+        router = _ScriptedRouter(script)
+        provider = _CountingProvider()
+        ctrl = FleetController(router, provider, hysteresis_ticks=3,
+                               cooldown_ticks=5,
+                               registry=MetricsRegistry())
+        actions = [ctrl.tick() for _ in range(40)]
+        assert set(actions) == {"hold"}, actions
+        assert provider.grown == 0 and not router.drained
+
+    def test_sustained_pressure_scales_once_then_cools(self):
+        """Sustained over-threshold load scales up exactly once, then
+        the cooldown window absorbs the (still high) signal."""
+        router = _ScriptedRouter([(8.0, 60)] * 20)
+        provider = _CountingProvider()
+        reg = MetricsRegistry()
+        ctrl = FleetController(router, provider, hysteresis_ticks=3,
+                               cooldown_ticks=10, registry=reg)
+        actions = [ctrl.tick() for _ in range(12)]
+        assert actions.count("up") == 1, actions
+        assert actions.index("up") == 2      # 3rd consecutive tick
+        assert provider.grown == 1
+        assert reg.counter("tony_fleet_scale_ups_total").value == 1
+
+    def test_sustained_idle_scales_down_via_drain(self):
+        router = _ScriptedRouter([(0.5, 2)] * 10)
+        provider = _CountingProvider()
+        reg = MetricsRegistry()
+        ctrl = FleetController(router, provider, min_replicas=1,
+                               hysteresis_ticks=3, cooldown_ticks=10,
+                               down_utilization=0.3, registry=reg)
+        actions = [ctrl.tick() for _ in range(4)]
+        assert actions.count("down") == 1, actions
+        # scale-down path = drain THEN retire THEN release
+        assert len(router.drained) == 1
+        assert router.removed == router.drained
+        assert provider.released == router.drained
+        assert reg.counter("tony_fleet_scale_downs_total").value == 1
+
+    def test_autoscale_against_simfleet(self):
+        """Real loop: SimProvider spawns a sim replica on scale-up and
+        reaps it on scale-down; the router picks both up live."""
+        reg = MetricsRegistry()
+        fleet = SimFleet(2, itl_s=0.005, slots=4, registry=reg)
+        try:
+            port = fleet.start()
+            ctrl = FleetController(
+                fleet.router, SimProvider(fleet), min_replicas=2,
+                max_replicas=3, up_queue_per_replica=2.0,
+                down_utilization=0.3, hysteresis_ticks=2,
+                cooldown_ticks=2, drain_timeout_s=30, registry=reg)
+            with StreamingClient("127.0.0.1", port) as client:
+                rids = [client.submit([50 + i, 1], 300) for i in range(8)]
+                # let STATS report the load
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    if ctrl._observe()[1] > 2.0:
+                        break
+                    time.sleep(0.05)
+                actions = [ctrl.tick() for _ in range(3)]
+                assert "up" in actions, actions
+                assert len(fleet.router.stats()["replicas"]) == 3
+                assert len(fleet.addrs()) == 3
+                for rid in rids:
+                    client.cancel(rid)
+                # idle now: wait for STATS to catch up, then tick down
+                deadline = time.monotonic() + 10
+                while time.monotonic() < deadline:
+                    n, load, util = ctrl._observe()
+                    if util < 0.3 and load < 2.0:
+                        break
+                    time.sleep(0.05)
+                actions = []
+                for _ in range(8):
+                    actions.append(ctrl.tick())
+                    if "down" in actions:
+                        break
+                assert "down" in actions, actions
+                assert len(fleet.router.stats()["replicas"]) == 2
+                assert len(fleet.addrs()) == 2
+        finally:
+            fleet.stop()
+
+
+class TestBenchArm:
+    def test_fleet_arm_pins(self):
+        import bench
+        out = bench._fleet_arm()
+        assert out["serving_migration_token_gap"] == 0
+        assert out["serving_drain_migrated"] >= 1
+        # migration is re-prefill-on-survivor: the drain wall is
+        # placement-bounded, never stream-length-bounded
+        assert out["serving_drain_wall_s"] < 10.0
+
+
+@pytest.mark.slow
+class TestStorm:
+    def test_100_replica_drain_storm_with_chaos(self):
+        """100 replicas, 150 live sessions; drain 30 replicas at once
+        while a seeded schedule crashes 5 more. Every session ends in
+        exactly one terminal frame; sessions that retire on budget
+        match the oracle exactly; p99 placement latency (from the
+        ``tony_router_place_seconds`` buckets) stays bounded."""
+        rng = random.Random(0xF1EE7)
+        reg = MetricsRegistry()
+        fleet = SimFleet(100, itl_s=0.005, slots=8,
+                         health_interval_s=0.2, registry=reg)
+        out = {}
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 150, 60, out)
+                # all sessions placed (spread need not be perfectly
+                # even at this scale — placement keys lag STATS)
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    reps = client.stats()["replicas"]
+                    if sum(r["assigned"] for r in reps.values()) >= 150:
+                        break
+                    time.sleep(0.02)
+                reps = client.stats()["replicas"]
+                by_load = sorted(reps, key=lambda a: -reps[a]["assigned"])
+                victims = by_load[:30]
+                crash = rng.sample(by_load[30:], 5)
+                results = {}
+
+                def do_drain(addr):
+                    results[addr] = client.drain_replica(addr,
+                                                         timeout_s=120)
+
+                drains = [threading.Thread(target=do_drain, args=(a,),
+                                           daemon=True)
+                          for a in victims]
+                for d in drains:
+                    d.start()
+                for addr in crash:
+                    time.sleep(rng.uniform(0.0, 0.05))
+                    fleet.kill(addr)
+                for d in drains:
+                    d.join(timeout=180)
+                assert all(r.get("drained") for r in results.values()), \
+                    {a: r for a, r in results.items()
+                     if not r.get("drained")}
+                for t in threads:
+                    t.join(timeout=180)
+                assert len(out) == 150
+                budget_done = 0
+                for rid, (toks, terminals) in out.items():
+                    assert len(terminals) == 1, (rid, terminals)
+                    kind = terminals[0][0]
+                    assert kind in ("retired", "error"), terminals[0]
+                    if kind == "retired" and terminals[0][1] == "budget":
+                        budget_done += 1
+                        assert toks == _oracle(seeds[rid], 60), \
+                            f"rid {rid}: dup/drop under storm"
+                # chaos may error a handful of sessions (both halves
+                # dead mid-migration); the vast majority must complete
+                assert budget_done >= 140, budget_done
+            h = reg.histogram("tony_router_place_seconds")
+            assert h.count >= 150
+            cum = h.cumulative()
+            p99_bound = None
+            for bound, c in zip(h.buckets, cum):
+                if c >= 0.99 * h.count:
+                    p99_bound = bound
+                    break
+            assert p99_bound is not None and p99_bound <= 2.5, \
+                (p99_bound, cum)
+        finally:
+            fleet.stop()

@@ -459,7 +459,8 @@ def _fake_link(load, idle, assigned=0, prefixes=(), alive=True,
                role="engine", addr="x"):
     return types.SimpleNamespace(
         alive=alive, role=role, reported_load=load, idle_slots=idle,
-        assigned=assigned, prefixes=set(prefixes), addr=addr)
+        assigned=assigned, prefixes=set(prefixes), addr=addr,
+        draining=False, weights_version=None)
 
 
 class TestRouterPlacement:
@@ -498,6 +499,40 @@ class TestRouterPlacement:
         assert r._pick_link(prefer_prefix="sys") is warm
         assert r._pick_link(prefer_prefix="nope") is cold
         assert r._pick_link() is cold
+
+    def test_exclude_accepts_a_set_and_draining_fences(self):
+        """``exclude`` is a SET (a migration storm / multi-replica
+        failure excludes several links at once); an exhausted pool
+        returns None; a draining link never takes a placement until
+        undrained."""
+        r = self._router()
+        a = _fake_link(0, 4, addr="a")
+        b = _fake_link(0, 3, addr="b")
+        c = _fake_link(0, 2, addr="c")
+        r._links = [a, b, c]
+        assert r._pick_link(exclude=(a, b)) is c
+        assert r._pick_link(exclude=[a]) in (b, c)
+        assert r._pick_link(exclude=(a, b, c)) is None
+        b.draining = True
+        assert r._pick_link(exclude=(a,)) is c
+        b.draining = False
+        assert r._pick_link(exclude=(a, c)) is b
+
+    def test_prefer_version_restricts_then_falls_back(self):
+        """A version-pinned session stays on its weights generation
+        while ANY same-version replica survives — even a busier one;
+        with the generation gone, continuity beats pinning and the
+        full pool serves."""
+        r = self._router()
+        v1 = _fake_link(load=2, idle=1, addr="v1")
+        v1.weights_version = "v1"
+        v2 = _fake_link(load=0, idle=4, addr="v2")
+        v2.weights_version = "v2"
+        r._links = [v1, v2]
+        assert r._pick_link(prefer_version="v1") is v1
+        assert r._pick_link(prefer_version="v2") is v2
+        assert r._pick_link(prefer_version="v3") is v2
+        assert r._pick_link() is v2
 
     def test_sessions_land_on_the_resident_replica(self, params):
         """In-process fleet: A resident, B cold — every prefix session

@@ -697,6 +697,194 @@ class TestRouter:
                 s.stop()
 
 
+class TestFleetOperations:
+    """Planned drain + rolling upgrade on REAL serving replicas: the
+    live-operability acceptance pins. Migration re-prefills on a
+    survivor with the streamed prefix folded in and the session's rng
+    stream/offset pinned, so the full token sequence — greedy AND
+    sampled — must equal the solo reference exactly."""
+
+    def _slow_servers(self, params, n=2, weights_version=None,
+                      fetch_s=0.05, **kw):
+        class SlowFetch(ContinuousBatcher):
+            def _fetch(self, handle):
+                time.sleep(fetch_s)       # keep streams mid-flight
+                return super()._fetch(handle)
+
+        kw.setdefault("batch", 2)
+        kw.setdefault("max_len", 64)
+        kw.setdefault("chunk", 2)
+        servers = [ServingServer(SlowFetch(params, CFG, **kw),
+                                 registry=M.MetricsRegistry(),
+                                 weights_version=weights_version)
+                   for _ in range(n)]
+        return servers, [f"127.0.0.1:{s.start()}" for s in servers]
+
+    def _start_streams(self, c, prompts, budget):
+        """Submit and block until every stream has produced at least
+        one token (so a drain migrates genuinely mid-flight)."""
+        rids = [c.submit(p, budget) for p in prompts]
+        got = {r: [] for r in rids}
+        started = set()
+        deadline = time.time() + 60
+        while len(started) < len(rids) and time.time() < deadline:
+            for r in rids:
+                if r in started:
+                    continue
+                try:
+                    ev = c.next_event(r, timeout=0.05)
+                except queue_mod.Empty:
+                    continue
+                assert ev[0] == "tokens", ev
+                got[r].extend(ev[1])
+                started.add(r)
+        assert len(started) == len(rids), "streams never started"
+        return rids, got
+
+    def _collect(self, c, rids, got):
+        for r in rids:
+            while True:
+                ev = c.next_event(r, timeout=60)
+                if ev[0] == "tokens":
+                    got[r].extend(ev[1])
+                elif ev[0] == "retired":
+                    break
+                else:
+                    raise AssertionError(ev)
+
+    def test_planned_drain_zero_dup_drop_greedy(self, params):
+        """Drain a replica carrying live greedy streams: every session
+        completes with exactly the solo-reference tokens, the drained
+        replica ends fenced and empty, and the migration counters
+        move."""
+        # batch=4: the survivor has idle slots, so migrations ACK
+        # while the old placement still streams (the interesting path)
+        servers, addrs = self._slow_servers(params, batch=4)
+        reg = M.MetricsRegistry()
+        router = ServingRouter(addrs, health_interval_s=0.2,
+                               registry=reg)
+        rport = router.start()
+        prompts = _prompts(31, (5, 5, 5, 5))
+        budget = 24
+        try:
+            with StreamingClient("127.0.0.1", rport) as c:
+                rids, got = self._start_streams(c, prompts, budget)
+                pre = router.stats()["replicas"]
+                assert all(v["assigned"] > 0 for v in pre.values()), pre
+                victim = max(pre, key=lambda a: pre[a]["assigned"])
+                res = c.drain_replica(victim)
+                assert res.get("drained"), res
+                assert res["migrated"] >= 1, res
+                self._collect(c, rids, got)
+                for i, r in enumerate(rids):
+                    assert got[r] == _reference(params, prompts[i],
+                                                budget), i
+                post = router.stats()["replicas"]
+                assert post[victim]["draining"]
+                assert post[victim]["assigned"] == 0
+            # every drain-initiated migration either ACKs (counted) or
+            # the old placement legitimately finishes first — at least
+            # one must take the ACK path with idle survivor slots
+            migs = reg.counter("tony_router_migrations_total").value
+            assert 1 <= migs <= res["migrated"], (migs, res)
+            assert reg.counter("tony_router_drains_total").value == 1
+            # drain is planned, not failover
+            assert reg.counter("tony_router_failovers_total").value == 0
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    def test_planned_drain_zero_dup_drop_sampled(self, params):
+        """The sampled twin: per-session rng stream + offset pinning
+        makes the migrated continuation bit-identical to the
+        uninterrupted sampled run."""
+        kw = dict(batch=2, max_len=64, chunk=2, seed=7,
+                  temperature=0.8, top_k=20, top_p=0.9)
+        prompts = _prompts(32, (5, 4, 6, 5))
+        budget = 20
+        ref = ContinuousBatcher(params, CFG, **kw).serve(prompts, budget)
+        servers, addrs = self._slow_servers(params, **kw)
+        router = ServingRouter(addrs, health_interval_s=0.2,
+                               registry=M.MetricsRegistry())
+        rport = router.start()
+        try:
+            with StreamingClient("127.0.0.1", rport) as c:
+                rids, got = self._start_streams(c, prompts, budget)
+                pre = router.stats()["replicas"]
+                victim = max(pre, key=lambda a: pre[a]["assigned"])
+                res = c.drain_replica(victim)
+                assert res.get("drained"), res
+                self._collect(c, rids, got)
+                for i, r in enumerate(rids):
+                    assert got[r] == ref[i], \
+                        f"stream {i}: sampled dup/drop across migration"
+        finally:
+            router.stop()
+            for s in servers:
+                s.stop()
+
+    def test_rolling_upgrade_mid_stream_continuity(self, params):
+        """Upgrade a 2-replica fleet mid-stream: stand up the v2 tier,
+        drain and retire v1 replica by replica. Every in-flight
+        session keeps exact token continuity, the fleet ends all-v2,
+        and a fresh session lands on the new tier."""
+        from tony_tpu.serving.fleet import CapacityProvider, \
+            FleetController
+
+        old_servers, old_addrs = self._slow_servers(
+            params, weights_version="v1")
+        new_servers, new_addrs = self._slow_servers(
+            params, weights_version="v2")
+        by_addr = dict(zip(old_addrs + new_addrs,
+                           old_servers + new_servers))
+
+        class StopProvider(CapacityProvider):
+            released = []
+
+            def grow(self, n):
+                raise AssertionError("upgrade must not grow")
+
+            def release(self, addrs):
+                for a in addrs:
+                    self.released.append(a)
+                    by_addr[a].stop()
+
+        reg = M.MetricsRegistry()
+        router = ServingRouter(old_addrs, health_interval_s=0.2,
+                               registry=reg)
+        rport = router.start()
+        prompts = _prompts(33, (5, 5, 4, 6))
+        budget = 24
+        try:
+            ctrl = FleetController(router, StopProvider(), registry=reg)
+            with StreamingClient("127.0.0.1", rport) as c:
+                rids, got = self._start_streams(c, prompts, budget)
+                results = ctrl.rolling_upgrade(new_addrs)
+                assert set(results) == set(old_addrs)
+                assert all(r.get("drained") for r in results.values()), \
+                    results
+                self._collect(c, rids, got)
+                for i, r in enumerate(rids):
+                    assert got[r] == _reference(params, prompts[i],
+                                                budget), i
+                post = router.stats()["replicas"]
+                assert set(post) == set(new_addrs), post
+                assert all(v["weights_version"] == "v2"
+                           for v in post.values()), post
+                assert sorted(StopProvider.released) == sorted(old_addrs)
+                # a fresh session serves on the upgraded tier
+                p = _prompts(34, (5,))[0]
+                rid = c.submit(p, 6)
+                toks, reason = c.result(rid)
+                assert toks == _reference(params, p, 6)
+            assert reg.counter("tony_fleet_upgrades_total").value == 1
+        finally:
+            router.stop()
+            for s in old_servers + new_servers:
+                s.stop()
+
+
 class TestStreamingBenchArm:
     def test_stream_vs_request_response_pins(self):
         """The tentpole acceptance, deterministically: at a 50 ms

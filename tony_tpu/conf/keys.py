@@ -232,6 +232,19 @@ DOCKER_ENABLED_KEY = "tony.docker.enabled"
 DOCKER_IMAGE_KEY = "tony.docker.image"
 
 # ---------------------------------------------------------------------------
+# Serving router ("tony.router.*"): the front door's health-check knobs,
+# lifted from hardcoded constants so fleet simulations can run at
+# accelerated time (milliseconds of ping cadence against hundreds of
+# simulated replicas) without patching the router.
+# ---------------------------------------------------------------------------
+# Cadence of the router's STATS health ping per replica link.
+ROUTER_HEALTH_INTERVAL_MS_KEY = "tony.router.health-interval-ms"
+# Consecutive UNANSWERED pings before a connected-but-hung replica is
+# marked down (unanswered pings, not wall-clock staleness — the router's
+# own scheduling stalls must not down healthy replicas).
+ROUTER_MAX_MISSED_PINGS_KEY = "tony.router.max-missed-pings"
+
+# ---------------------------------------------------------------------------
 # Defaults registry — the tony-default.xml analog. One entry per static key.
 # Values are strings, exactly like Hadoop Configuration; typed getters on
 # TonyConfig parse them.
@@ -306,6 +319,8 @@ DEFAULTS: dict[str, str] = {
     CONTAINER_LOG_DIR_KEY: "",
     DOCKER_ENABLED_KEY: "false",
     DOCKER_IMAGE_KEY: "",
+    ROUTER_HEALTH_INTERVAL_MS_KEY: "500",
+    ROUTER_MAX_MISSED_PINGS_KEY: "3",
 }
 
 # ---------------------------------------------------------------------------
@@ -320,7 +335,7 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
                                 "launch", "elastic", "metrics", "pipeline",
-                                "trace"})
+                                "trace", "router", "fleet"})
 
 
 def instances_key(job_type: str) -> str:

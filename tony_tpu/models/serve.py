@@ -903,6 +903,12 @@ class ContinuousBatcher:
         self._row_keys = jnp.tile(idle[None], (self.batch, 1))
         #: per-row stream positions consumed so far (host-side ints)
         self._row_off = [self._off0] * self.batch
+        #: stream index -> positions ALREADY consumed elsewhere (a
+        #: migrated session re-admitting with its streamed prefix folded
+        #: into the prompt): the row's first sample is drawn at this
+        #: offset instead of 0, so the continuation is sample-identical
+        #: to the placement it left. Consumed at admission.
+        self._stream_skip: dict[int, int] = {}
 
     def _req_key(self, req: int):
         return jax.random.fold_in(self._base_key, req)
@@ -1259,8 +1265,9 @@ class ContinuousBatcher:
         resets."""
         self._row_keys = self._row_keys.at[rows].set(
             keys, mode="drop", unique_indices=True)
-        for row, _ in pairs:
-            self._row_off[row] = self._off0
+        for row, req in pairs:
+            self._row_off[row] = (self._off0
+                                  + self._stream_skip.pop(req, 0))
 
     def _admit_rows(self, rows, toks, lens, keys, entry=None) -> None:
         if entry is not None:
@@ -1618,16 +1625,20 @@ class _EngineRequest:
     closed-batch wrapper reproduces the fixed-queue loop's per-request
     streams exactly); ``budget`` counts REMAINING tokens."""
 
-    __slots__ = ("rid", "prompt", "budget", "stream", "emitted", "done",
-                 "reason", "t_submit", "t_last", "span", "queued_span",
-                 "first_span")
+    __slots__ = ("rid", "prompt", "budget", "stream", "rng_skip",
+                 "emitted", "done", "reason", "t_submit", "t_last",
+                 "span", "queued_span", "first_span")
 
     def __init__(self, rid, prompt, budget: int, stream: int,
-                 t_submit: float) -> None:
+                 t_submit: float, rng_skip: int = 0) -> None:
         self.rid = rid
         self.prompt = prompt
         self.budget = budget
         self.stream = stream
+        #: stream positions already consumed by a previous placement of
+        #: this request (router-coordinated migration) — the batcher
+        #: draws this row's first sample at this offset
+        self.rng_skip = rng_skip
         self.emitted = 0
         self.done = False
         self.reason: str | None = None
@@ -1761,7 +1772,8 @@ class ServeEngine:
 
     def submit(self, rid, prompt, max_new_tokens: int,
                trace_ctx: dict | None = None,
-               prefix_id: str | None = None) -> None:
+               prefix_id: str | None = None,
+               rng: tuple | None = None) -> None:
         """Enqueue a request under caller-chosen id ``rid`` (any
         hashable; must not collide with a LIVE request's). Raises
         ``ValueError`` for un-servable requests (validated up front, so
@@ -1778,19 +1790,28 @@ class ServeEngine:
         ``trace_ctx`` is the submitter's span context (``{"tid", "sid"}``
         off the ADMIT frame): the request's engine-side spans — the TTFT
         decomposition — join that trace; without one the engine
-        head-samples a fresh trace per ``tony.trace.sample-rate``."""
+        head-samples a fresh trace per ``tony.trace.sample-rate``.
+
+        ``rng`` optionally pins the request's rng stream:
+        ``(stream, off)`` uses stream index ``stream`` (instead of the
+        engine's submission counter) with the first ``off`` positions
+        treated as already consumed — how a router-coordinated
+        migration continues a SAMPLED stream token-identically on a new
+        replica (the ADMIT frame's ``rng`` field; see
+        ``protocol.parse_rng``)."""
         prompt = [int(t) for t in prompt]
         max_new_tokens = int(max_new_tokens)
         entry = self.b._resolve_prefix(prefix_id, prompt)
         if entry is None:
             self.b._validate_request(prompt, max_new_tokens)
             self._enqueue(rid, prompt, max_new_tokens, trace_ctx,
-                          prompt_tokens=len(prompt))
+                          rng=rng, prompt_tokens=len(prompt))
         else:
             hit = _PrefixHit(entry, prompt[len(entry.tokens):])
             self.b._validate_prefix_hit(hit, max_new_tokens)
             self._enqueue(rid, hit, max_new_tokens, trace_ctx,
-                          prompt_tokens=len(prompt), prefix=entry.id)
+                          rng=rng, prompt_tokens=len(prompt),
+                          prefix=entry.id)
 
     def submit_prefilled(self, rid, package: KVPackage,
                          max_new_tokens: int,
@@ -1812,7 +1833,7 @@ class ServeEngine:
 
     def _enqueue(self, rid, payload, max_new_tokens: int,
                  trace_ctx: dict | None, *, prompt_tokens: int,
-                 **span_attrs) -> None:
+                 rng: tuple | None = None, **span_attrs) -> None:
         """The shared admission-queue push behind :meth:`submit` and
         :meth:`submit_prefilled`: drain/duplicate checks, request
         registration, the engine-side span pair, and the wakeup — ONE
@@ -1823,15 +1844,20 @@ class ServeEngine:
                     "engine is draining; not accepting new requests")
             if rid in self._reqs:
                 raise ValueError(f"request id {rid!r} is already active")
-            req = _EngineRequest(rid, payload, max_new_tokens,
-                                 self._next_stream, time.perf_counter())
+            stream = self._next_stream if rng is None else int(rng[0])
+            skip = 0 if rng is None else int(rng[1])
+            req = _EngineRequest(rid, payload, max_new_tokens, stream,
+                                 time.perf_counter(), rng_skip=skip)
             tr = tracing.get_tracer()
             req.span = tr.start_span("engine.request", ctx=trace_ctx,
                                      prompt_tokens=prompt_tokens,
                                      budget=max_new_tokens, **span_attrs)
             req.queued_span = tr.start_span("engine.queued",
                                             parent=req.span)
-            self._next_stream += 1
+            if rng is None:
+                # pinned streams live in the router's reserved range;
+                # the local counter keeps its own sequence untouched
+                self._next_stream += 1
             self._reqs[rid] = req
             self._wait.append(rid)
             self._qdepth_g.set(len(self._wait))
@@ -1996,6 +2022,10 @@ class ServeEngine:
             b = self.b
             before = (b.prefill_forward_tokens, b.prefix_copied_tokens,
                       b.prefix_admits)
+            for req in admitted:
+                if req.rng_skip:
+                    # consumed by _rebind_streams at this admission
+                    b._stream_skip[req.stream] = req.rng_skip
             b._admit_batch(pairs, prompts)
             self._admitted_c.inc(len(admitted))
             # fold the batcher's host-side prefill accounting into the

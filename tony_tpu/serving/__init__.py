@@ -29,6 +29,12 @@ clients on the network:
              by prefill compute
   netem    — LatencyProxy: deterministic per-direction latency
              injection for the streamed-vs-request/response bench arm
+  fleet    — FleetController + CapacityProvider: metrics-driven
+             autoscale (grow/drain/release) and rolling weight
+             upgrades over a running router
+  simfleet — SimFleet / SimReplica: deterministic simulated replicas
+             (token oracle, no model stack) for fleet-scale chaos and
+             migration-storm tests
 
 ``server`` pulls in the model stack (jax); ``protocol``/``client``/
 ``router``/``netem`` are stdlib-only, so the lazy re-exports below
@@ -46,6 +52,10 @@ _LAZY = {
     "LatencyProxy": ("tony_tpu.serving.netem", "LatencyProxy"),
     "PrefillServer": ("tony_tpu.serving.disagg", "PrefillServer"),
     "DecodeServer": ("tony_tpu.serving.disagg", "DecodeServer"),
+    "FleetController": ("tony_tpu.serving.fleet", "FleetController"),
+    "CapacityProvider": ("tony_tpu.serving.fleet", "CapacityProvider"),
+    "SimFleet": ("tony_tpu.serving.simfleet", "SimFleet"),
+    "SimReplica": ("tony_tpu.serving.simfleet", "SimReplica"),
 }
 
 __all__ = ["ProtocolError", *_LAZY]

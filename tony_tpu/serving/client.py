@@ -113,7 +113,13 @@ class StreamingClient:
                 elif ftype == P.PREFIX:
                     self._dispatch(rid, ("prefix",
                                          P.unpack_json(payload)))
-                # unknown server frames are ignored (forward compat)
+                elif ftype == P.DRAIN:
+                    self._dispatch(rid, ("drain",
+                                         P.unpack_json(payload)))
+                # unknown server frames are ignored (forward compat) —
+                # including MIGRATE acks: migrate() is fire-and-forget
+                # (the migrated stream itself just keeps delivering on
+                # its own rid)
         except (P.ProtocolError, OSError) as e:
             error = str(e)
         with self._lock:
@@ -304,6 +310,47 @@ class StreamingClient:
             return ev[1]
         raise ServingConnectionError(
             ev[1] if ev[0] == "error" else f"unexpected reply {ev[0]}")
+
+    def drain_replica(self, replica: str, timeout_s: float = 120.0,
+                      timeout: float | None = None) -> dict:
+        """Operator op against a ROUTER: fence ``replica`` and
+        live-migrate every session off it (planned maintenance /
+        rolling upgrades — see docs/serving.md §Operating the fleet).
+        Blocks until the router reports the drain finished; returns its
+        summary ``{"ok", "replica", "drained", "migrated", "wall_s",
+        ...}``. ``timeout_s`` is the ROUTER's drain deadline;
+        ``timeout`` (default ``timeout_s + 30``) is this call's local
+        reply wait. Raises ``ServingConnectionError`` on transport loss
+        or a rejected request (unknown frame on a plain replica, bad
+        replica name)."""
+        rid = next(self._next_rid)
+        with self._lock:
+            if self._closed:
+                raise ServingConnectionError(
+                    self._conn_error or "client is closed")
+            self._queues[rid] = queue.Queue()
+        if timeout is None:
+            timeout = timeout_s + 30.0
+        try:
+            self._send(P.DRAIN, rid, P.pack_json(
+                {"replica": replica, "timeout_s": timeout_s}))
+            ev = self._event_or_raise(rid, timeout)
+        finally:
+            self._forget(rid)
+        if ev[0] == "drain":
+            return ev[1]
+        raise ServingConnectionError(
+            ev[1] if ev[0] == "error" else f"unexpected reply {ev[0]}")
+
+    def migrate(self, rid: int) -> None:
+        """Ask the router to live-migrate one of this client's OWN
+        streams (``rid`` from :meth:`submit`) onto another replica —
+        the single-session form of :meth:`drain_replica`.
+        Fire-and-forget: on success the stream just continues on its
+        rid with no duplicated or dropped tokens; if the session cannot
+        move (already finishing, no eligible replica) it continues
+        where it is."""
+        self._send(P.MIGRATE, rid)
 
     def stats(self, timeout: float | None = 30.0) -> dict:
         """Server stats snapshot (the ``tony_serve_queue_depth`` gauge

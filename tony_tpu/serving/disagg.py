@@ -81,18 +81,23 @@ class _PrefillItem:
     """One admitted prompt waiting for (or undergoing) prefill."""
 
     __slots__ = ("conn", "rid", "prompt", "budget", "decode", "stream",
-                 "cancelled", "done", "span", "queued_span", "prefix")
+                 "rng_off", "cancelled", "done", "span", "queued_span",
+                 "prefix")
 
     def __init__(self, conn: FrameConn, rid: int, prompt: list[int],
                  budget: int, decode: str, stream: int,
                  trace_ctx: dict | None,
-                 prefix: str | None = None) -> None:
+                 prefix: str | None = None, rng_off: int = 0) -> None:
         self.conn = conn
         self.rid = rid
         self.prompt = prompt
         self.budget = budget
         self.decode = decode
         self.stream = stream
+        #: stream positions already consumed by a previous placement
+        #: (router-coordinated migration): shipped in the KV meta so the
+        #: adopting decode row draws its first sample at this offset
+        self.rng_off = rng_off
         #: the resident-prefix id this prompt continues (ADMIT's
         #: ``prefix`` field) — resolved against the tier's store at
         #: wave time; a miss just full-prefills
@@ -140,12 +145,16 @@ class PrefillServer(PrefixHost, FrameServerBase):
                  max_batch: int = 4, admission_buckets=None,
                  bind_host: str = "127.0.0.1", port: int = 0,
                  channel_window: int = 8,
-                 ship_timeout_s: float = 30.0, registry=None) -> None:
+                 ship_timeout_s: float = 30.0, registry=None,
+                 weights_version: str | None = None) -> None:
         super().__init__(bind_host, port)
         import jax
 
         self.params = params
         self.cfg = cfg
+        #: the weights generation this tier serves (HELLO/STATS) — the
+        #: router's version-pinned placement signal (rolling upgrades)
+        self.weights_version = weights_version
         self.max_len = int(max_len)
         self.max_batch = int(max_batch)
         if self.max_batch < 1:
@@ -313,7 +322,8 @@ class PrefillServer(PrefixHost, FrameServerBase):
     def _hello_payload(self) -> dict:
         return {"v": 1, "role": "prefill", "slots": self.max_batch,
                 "prefixes": self.resident_prefixes(),
-                "ring": self._ring, "prefix_port": self.prefix_port}
+                "ring": self._ring, "prefix_port": self.prefix_port,
+                "weights_version": self.weights_version}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -336,7 +346,8 @@ class PrefillServer(PrefixHost, FrameServerBase):
         return {"queue_depth": depth, "active": active,
                 "slots": self.max_batch, "role": "prefill",
                 "prefixes": self.resident_prefixes(),
-                "ring": self._ring}
+                "ring": self._ring,
+                "weights_version": self.weights_version}
 
     def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
         prompt, max_new, _stream = P.parse_admit(payload)
@@ -359,16 +370,20 @@ class PrefillServer(PrefixHost, FrameServerBase):
             conn.send(P.ERROR, rid, P.pack_json({"message": err}))
             return
         key = (conn.id, rid)
+        rng = P.parse_rng(obj)
         with self._cv:
             if key in self._items:
                 conn.send(P.ERROR, rid, P.pack_json(
                     {"message": f"request id {rid} is already active"}))
                 return
             item = _PrefillItem(conn, rid, prompt, max_new, decode,
-                                self._next_stream,
+                                (self._next_stream if rng is None
+                                 else int(rng[0])),
                                 P.parse_trace_ctx(obj),
-                                prefix=P.parse_prefix_id(obj))
-            self._next_stream += 1
+                                prefix=P.parse_prefix_id(obj),
+                                rng_off=0 if rng is None else int(rng[1]))
+            if rng is None:
+                self._next_stream += 1
             self._items[key] = item
             self._queue.append(item)
             self._qdepth_g.set(len(self._queue))
@@ -584,7 +599,7 @@ class PrefillServer(PrefixHost, FrameServerBase):
                                             item.stream), np.uint32)
         ctx = item.span.context if item.span.recording else None
         meta = kvship.pack_kv_meta(item.rid, item.budget, length, key,
-                                   rng_off=0, trace=ctx)
+                                   rng_off=item.rng_off, trace=ctx)
         blob = kvship.pack_shipment(meta, dict(bufs, logits=logits))
         try:
             # sync: HANDOFF transfers the session's fate to the decode
@@ -663,9 +678,12 @@ class DecodeServer(FrameServerBase):
                  port: int = 0, channel_port: int = 0,
                  channel_capacity: int = 8,
                  channel_advertise: int | None = None,
-                 registry=None) -> None:
+                 registry=None,
+                 weights_version: str | None = None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
+
+        self.weights_version = weights_version
 
         if getattr(batcher, "d_cache", None) is not None:
             raise ValueError(
@@ -761,7 +779,8 @@ class DecodeServer(FrameServerBase):
         return {"v": 1, "role": "decode", "slots": self.batcher.batch,
                 "channel_port": (self.channel_advertise
                                  if self.channel_advertise is not None
-                                 else self.hub.port)}
+                                 else self.hub.port),
+                "weights_version": self.weights_version}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -776,7 +795,8 @@ class DecodeServer(FrameServerBase):
             self.engine.cancel(rid)
         elif ftype == P.STATS:
             st = dict(self.engine.stats(), role="decode",
-                      channel_port=self.hub.port)
+                      channel_port=self.hub.port,
+                      weights_version=self.weights_version)
             conn.send(P.STATS, 0, P.pack_json(st))
         elif ftype in (P.ADMIT, P.POLL):
             conn.send(P.ERROR, rid, P.pack_json(

@@ -83,6 +83,9 @@ class LatencyProxy:
         self._server: socket.socket | None = None
         self._accept_thread: threading.Thread | None = None
         self._stopping = threading.Event()
+        self._conn_lock = threading.Lock()
+        #: live (client, upstream) socket pairs — what sever() cuts
+        self._conns: set = set()
 
     def start(self) -> int:
         server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -118,17 +121,39 @@ class LatencyProxy:
         for s in (client, upstream):
             set_nodelay(s)
         upstream.settimeout(None)
+        pair = (client, upstream)
+        with self._conn_lock:
+            self._conns.add(pair)
         t = threading.Thread(target=_delayed_pump,
                              args=(client, upstream, self.delay_s),
                              daemon=True)
         t.start()
         _delayed_pump(upstream, client, self.delay_s)
         t.join()
-        for s in (client, upstream):
+        with self._conn_lock:
+            self._conns.discard(pair)
+        for s in pair:
             try:
                 s.close()
             except OSError:
                 pass
+
+    def sever(self) -> int:
+        """Hard-cut every connection currently flowing through the
+        proxy (both sides see EOF/reset) while the listener keeps
+        accepting — a crash/partition of the REPLICA as seen by its
+        peers, without killing the process behind it. The chaos
+        harness's replica-loss injection. Returns the number of
+        connections cut."""
+        with self._conn_lock:
+            pairs = list(self._conns)
+        for pair in pairs:
+            for s in pair:
+                try:
+                    s.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+        return len(pairs)
 
     def stop(self) -> None:
         self._stopping.set()

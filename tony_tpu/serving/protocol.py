@@ -101,11 +101,26 @@ BIND = 10
 #: ``{"ok": bool, ...}`` — op failures are request-scoped, never
 #: connection-scoped.
 PREFIX = 11
+#: c -> router then router -> c (fleet operations): ask the router to
+#: DRAIN one replica — ``{"replica": "host:port", "timeout_s": n?}``
+#: fences new placements there and live-migrates every session off it
+#: (see :meth:`ServingRouter.drain`). The reply rides the same rid once
+#: the drain settles: ``{"ok": bool, "replica": ..., "migrated": n,
+#: "wall_s": s}``. Runs on a background thread — a drain never blocks
+#: the operator connection's other frames.
+DRAIN = 12
+#: c -> router then router -> c (fleet operations): migrate ONE of the
+#: caller's own sessions (``rid``) off its current replica. Reply is
+#: ``{"ok": bool}`` on the same rid; the session's token stream is
+#: unaffected either way (zero dup/drop — the coordinated-migration
+#: contract).
+MIGRATE = 13
 
 FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
                TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
                STATS: "STATS", HELLO: "HELLO", HANDOFF: "HANDOFF",
-               BIND: "BIND", PREFIX: "PREFIX"}
+               BIND: "BIND", PREFIX: "PREFIX", DRAIN: "DRAIN",
+               MIGRATE: "MIGRATE"}
 
 #: sanity bound on one frame's body (type + rid + payload). A prompt of
 #: a million tokens is ~4 MB; anything past this is a corrupt length
@@ -333,6 +348,33 @@ def parse_prefix_id(payload_or_obj) -> str | None:
         pid = obj.get("prefix")
         if isinstance(pid, str) and 0 < len(pid) <= 128:
             return pid
+    except ProtocolError:
+        pass
+    return None
+
+
+def parse_rng(payload_or_obj) -> tuple[int, int] | None:
+    """Extract the OPTIONAL ``rng`` pin from an ADMIT payload:
+    ``{"rng": {"stream": s, "off": k}}`` fixes the request's rng STREAM
+    index (instead of the engine's local submission counter) and marks
+    ``k`` stream positions as already consumed. This is what makes a
+    planned migration token-identical under SAMPLING: the router pins
+    every session to a fleet-unique stream, and a re-placement that
+    folds ``k`` already-streamed tokens into the prompt tells the new
+    replica to draw its first sample from position ``k`` — the same
+    key, the same offset, the same token the old replica would have
+    drawn. Never load-bearing for plain clients: absent/malformed is
+    ``None`` (the engine assigns its own stream, off 0)."""
+    try:
+        obj = payload_or_obj if isinstance(payload_or_obj, dict) \
+            else unpack_json(payload_or_obj)
+        rng = obj.get("rng")
+        if isinstance(rng, dict):
+            stream, off = rng.get("stream"), rng.get("off", 0)
+            if (isinstance(stream, int) and not isinstance(stream, bool)
+                    and isinstance(off, int)
+                    and not isinstance(off, bool) and off >= 0):
+                return stream, off
     except ProtocolError:
         pass
     return None

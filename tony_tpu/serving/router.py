@@ -34,10 +34,28 @@ through a surviving prefill replica. See
 ``tony_tpu/serving/disagg.py`` and docs/serving.md §Disaggregated
 prefill/decode.
 
+**Fleet operations** (planned, not reactive — the drain/upgrade path):
+:meth:`ServingRouter.drain` fences a replica against new placements
+and LIVE-MIGRATES every session off it: each stream re-admits on a
+survivor with the streamed prefix folded into the prompt and its rng
+stream pinned (the ADMIT ``rng`` field), the OLD replica keeps
+streaming until the new placement's first delta ACKs the takeover,
+then a CANCEL tombstones the old half — zero duplicated and zero
+dropped tokens, greedy AND sampled, colocated AND disaggregated
+(test-pinned). Replicas advertise a ``weights_version`` in
+HELLO/STATS; placement prefers a session's pinned version when any
+same-version replica survives, which is what makes drain-by-drain
+rolling weight upgrades session-transparent. :meth:`add_replicas` /
+:meth:`remove_replica` change fleet membership live. The ``DRAIN`` and
+``MIGRATE`` frames expose drain / single-session migration to remote
+operator clients.
+
 Router-side series (default registry): ``tony_router_replica_up`` /
 ``tony_router_replica_queue_depth`` (gauges, ``replica=host:port``),
 ``tony_router_sessions_total{replica=...}``,
-``tony_router_failovers_total``, ``tony_router_handoffs_total``.
+``tony_router_failovers_total``, ``tony_router_handoffs_total``,
+``tony_router_migrations_total``, ``tony_router_drains_total``,
+``tony_router_place_seconds``.
 
 The router never touches the model stack — it is deployable on a
 jax-free gateway host.
@@ -51,12 +69,24 @@ import socket
 import threading
 import time
 
+from tony_tpu.conf.keys import (DEFAULTS, ROUTER_HEALTH_INTERVAL_MS_KEY,
+                                ROUTER_MAX_MISSED_PINGS_KEY)
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.serving import protocol as P
 from tony_tpu.serving.prefix import fingerprint, match_prefix
 from tony_tpu.serving.server import FrameConn, FrameServerBase
 
 log = logging.getLogger(__name__)
+
+#: first rng stream index the router hands out — 0, matching what an
+#: engine's own submission counter would assign the same admissions in
+#: the same order. That keeps the serving identity contract (routed
+#: sampled output == the colocated engine's, bit-for-bit, test-pinned)
+#: while making streams unique FLEET-wide instead of per-replica. A
+#: direct client bypassing a routed replica can share a stream index
+#: with a routed session — a sampling correlation, never a correctness
+#: issue, and no worse than the per-replica counters it replaces.
+ROUTER_STREAM_BASE = 0
 
 
 class _ReplicaLink:
@@ -89,6 +119,9 @@ class _ReplicaLink:
         self.pings_unanswered = 0
         #: sessions assigned here and not yet retired (router-side)
         self.assigned = 0
+        #: fenced against NEW placements (a drain in progress) — live
+        #: sessions keep streaming until their migration ACKs
+        self.draining = False
         self._sock.sendall(P.MAGIC)
         hello = P.recv_frame(self._sock)
         if hello is None or hello[0] != P.HELLO:
@@ -106,6 +139,10 @@ class _ReplicaLink:
         #: resident here — the router places prefix traffic on it
         #: PREFIX-BLIND (one warning, never an error)
         self.ring = bool(self.hello.get("ring"))
+        #: the weights generation this replica advertised (HELLO,
+        #: refreshed by STATS) — version-pinned placement (rolling
+        #: upgrades) keys on it; None = unversioned
+        self.weights_version = self.hello.get("weights_version")
         self.slots = int(self.hello.get("slots", 0) or 0)
         #: decode slots with no live occupant per the last STATS — the
         #: equal-queue-depth placement tiebreak
@@ -165,6 +202,8 @@ class _ReplicaLink:
                         self.slots = int(obj.get("slots", 0) or 0)
                     self.idle_slots = max(
                         0, self.slots - int(obj.get("active", 0)))
+                    if "weights_version" in obj:
+                        self.weights_version = obj.get("weights_version")
                     if "prefixes" in obj:
                         got = self._parse_prefixes(obj)
                         if got != self.prefixes:
@@ -198,18 +237,62 @@ class _ReplicaLink:
             pass
 
 
+class _Migration:
+    """One in-flight coordinated migration: a SECOND placement of a
+    live session, started while the old one keeps streaming. The first
+    delta from the new placement is the ACK — ownership swaps there,
+    the regenerated overlap (tokens the old side streamed after the
+    snapshot) is discarded count-exactly (token-identical by the rng
+    pin), and a CANCEL tombstones the old half."""
+
+    __slots__ = ("snap_len", "new_link", "new_prefill", "new_rrid",
+                 "acked", "discard", "handed_off")
+
+    def __init__(self, snap_len: int, new_link, new_prefill,
+                 new_rrid: int) -> None:
+        #: len(streamed) at the snapshot the new ADMIT carried
+        self.snap_len = snap_len
+        self.new_link = new_link        # token link of the new placement
+        self.new_prefill = new_prefill  # its prefill half (disagg)
+        self.new_rrid = new_rrid
+        self.acked = False
+        #: regenerated overlap tokens still to drop from the new stream
+        self.discard = 0
+        #: the NEW placement's HANDOFF was observed pre-ACK (disagg)
+        self.handed_off = False
+
+
 class _RouterSession:
     __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
                  "prefill_link", "handed_off", "rrid", "cancelled",
-                 "trace_ctx", "prefix_id")
+                 "trace_ctx", "prefix_id", "stream", "pinned_version",
+                 "migrating", "wlock")
 
     def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
                  budget: int, trace_ctx: dict | None = None,
-                 prefix_id: str | None = None) -> None:
+                 prefix_id: str | None = None, stream: int = 0) -> None:
         self.conn = conn
         self.crid = crid
         self.prompt = prompt
         self.budget = budget
+        #: the fleet-unique rng stream this session is pinned to — every
+        #: placement (initial, failover, migration) forwards it with the
+        #: already-streamed count as the offset, so SAMPLED
+        #: continuations are token-identical across replicas
+        self.stream = stream
+        #: weights_version of the first placement: later placements
+        #: prefer same-version replicas while any survive (rolling
+        #: upgrades migrate tier-by-tier without mixing generations
+        #: mid-stream); continuity beats pinning when none do
+        self.pinned_version = None
+        #: the in-flight coordinated migration, if any
+        self.migrating: _Migration | None = None
+        #: per-session delta ORDER lock: the old and new placements'
+        #: deltas forward from different link reader threads around the
+        #: ACK swap — append+send must be atomic per delta or the client
+        #: could see positions out of order. Lock order: wlock, then
+        #: the router lock; never the reverse.
+        self.wlock = threading.Lock()
         #: the shared prefix this session continues (ADMIT's prefix
         #: field, or the router's tokenized match): prefix-aware
         #: placement prefers replicas where it is resident, and the id
@@ -257,9 +340,9 @@ class ServingRouter(FrameServerBase):
     (test-pinned)."""
 
     def __init__(self, replicas, bind_host: str = "127.0.0.1",
-                 port: int = 0, health_interval_s: float = 0.5,
+                 port: int = 0, health_interval_s: float | None = None,
                  decode_replicas=None, registry=None,
-                 prefixes=None) -> None:
+                 prefixes=None, max_missed_pings: int | None = None) -> None:
         super().__init__(bind_host, port)
         self._replica_addrs = list(replicas)
         self._decode_addrs = list(decode_replicas or [])
@@ -271,9 +354,21 @@ class ServingRouter(FrameServerBase):
         self._sessions: dict[tuple[int, int], _RouterSession] = {}
         self._by_rrid: dict[int, _RouterSession] = {}
         self._next_rrid = itertools.count(1)
+        self._next_stream = itertools.count(ROUTER_STREAM_BASE)
         self._downed: set[int] = set()      # id()s of links already torn
+        # health knobs (tony.router.health-interval-ms /
+        # tony.router.max-missed-pings): kwargs override the config
+        # defaults — the sim harness runs hundreds of replicas at
+        # millisecond cadence through exactly these
+        if health_interval_s is None:
+            health_interval_s = float(
+                DEFAULTS[ROUTER_HEALTH_INTERVAL_MS_KEY]) / 1000.0
         self.health_interval_s = health_interval_s
+        self.max_missed_pings = (
+            int(DEFAULTS[ROUTER_MAX_MISSED_PINGS_KEY])
+            if max_missed_pings is None else int(max_missed_pings))
         self._health_thread: threading.Thread | None = None
+        self._stopped = False               # stop() ran (idempotence)
         #: the prefix-matching catalog: id -> token list. ADMITs naming
         #: no prefix are matched here (longest proper token-boundary
         #: prefix); residency still comes from the replicas' own
@@ -298,6 +393,19 @@ class ServingRouter(FrameServerBase):
             "tony_router_prefix_misses_total",
             help="prefix-naming sessions placed prefix-blind (no live "
                  "replica had the prefix resident)")
+        self._migrations_c = reg.counter(
+            "tony_router_migrations_total",
+            help="planned session migrations completed (ownership "
+                 "swapped to the new placement with zero dup/drop)")
+        self._drains_c = reg.counter(
+            "tony_router_drains_total",
+            help="replica drains completed (fence + migrate-all; "
+                 "zero-session drains count too)")
+        self._place_h = reg.histogram(
+            "tony_router_place_seconds",
+            help="wall time of one placement decision + forwarded "
+                 "ADMIT (initial admissions; the router's tail-latency "
+                 "signal under migration storms)")
         self._up_g = {}
         self._depth_g = {}
         self._placed_c = {}
@@ -309,37 +417,41 @@ class ServingRouter(FrameServerBase):
                 self.register_prefix(toks, prefix_id=pid)
 
     # -- lifecycle ----------------------------------------------------------
+    def _connect(self, role: str, addr: str) -> _ReplicaLink:
+        """Create one replica link (and its per-replica metric series).
+        Gauges BEFORE the link: the link's reader thread may run
+        _replica_down (instant replica death) the moment the link
+        exists, and that path writes these gauges."""
+        self._up_g[addr] = self._reg.gauge(
+            "tony_router_replica_up",
+            help="1 while the replica link is healthy", replica=addr)
+        self._depth_g[addr] = self._reg.gauge(
+            "tony_router_replica_queue_depth",
+            help="replica's last-reported tony_serve_queue_depth "
+                 "+ busy slots", replica=addr)
+        self._placed_c[addr] = self._reg.counter(
+            "tony_router_sessions_total",
+            help="sessions placed on the replica", replica=addr)
+        self._up_g[addr].set(1)
+        link = _ReplicaLink(addr, self, role=role)
+        self._warn_if_ring(link)
+        if role == "decode":
+            if link.channel_port is None:
+                link.close()
+                raise ConnectionError(
+                    f"decode replica {addr} advertised no "
+                    f"channel_port — not a DecodeServer?")
+            # we are this gang's delta sink: every KV-adopted row's
+            # TOKENS/RETIRED frames push down this link
+            link.send(P.BIND, 0)
+        return link
+
     def start(self) -> int:
         roles = ([("prefill" if self._disagg else "engine", a)
                   for a in self._replica_addrs]
                  + [("decode", a) for a in self._decode_addrs])
         for role, addr in roles:
-            # gauges BEFORE the link: the link's reader thread may run
-            # _replica_down (instant replica death) the moment the link
-            # exists, and that path writes these gauges
-            self._up_g[addr] = self._reg.gauge(
-                "tony_router_replica_up",
-                help="1 while the replica link is healthy", replica=addr)
-            self._depth_g[addr] = self._reg.gauge(
-                "tony_router_replica_queue_depth",
-                help="replica's last-reported tony_serve_queue_depth "
-                     "+ busy slots", replica=addr)
-            self._placed_c[addr] = self._reg.counter(
-                "tony_router_sessions_total",
-                help="sessions placed on the replica", replica=addr)
-            self._up_g[addr].set(1)
-            link = _ReplicaLink(addr, self, role=role)
-            self._warn_if_ring(link)
-            if role == "decode":
-                if link.channel_port is None:
-                    link.close()
-                    raise ConnectionError(
-                        f"decode replica {addr} advertised no "
-                        f"channel_port — not a DecodeServer?")
-                # we are this gang's delta sink: every KV-adopted row's
-                # TOKENS/RETIRED frames push down this link
-                link.send(P.BIND, 0)
-            self._links.append(link)
+            self._links.append(self._connect(role, addr))
         self._refresh_prefix_residency()
         port = super().start()
         self._health_thread = threading.Thread(
@@ -410,11 +522,65 @@ class ServingRouter(FrameServerBase):
             g.set(sum(1 for l in links
                       if l.alive and pid in l.prefixes))
 
+    # -- fleet membership (rolling upgrades) --------------------------------
+    def add_replicas(self, addrs, role: str | None = None) -> None:
+        """Connect new replicas into a RUNNING fleet (the rolling
+        upgrade's first step: stand the new-version tier up next to the
+        old one). ``role`` defaults to the fleet's token tier
+        (``engine`` colocated, ``prefill`` disaggregated); pass
+        ``"decode"`` to grow that tier. A replica that refuses the
+        handshake raises — nothing is half-added."""
+        role = role or ("prefill" if self._disagg else "engine")
+        for addr in addrs:
+            link = self._connect(role, addr)
+            with self._lock:
+                self._links.append(link)
+            target = (self._decode_addrs if role == "decode"
+                      else self._replica_addrs)
+            if addr not in target:
+                target.append(addr)
+        self._refresh_prefix_residency()
+
+    def remove_replica(self, addr: str) -> int:
+        """Disconnect ``addr`` from the fleet (the rolling upgrade's
+        last step, after :meth:`drain` emptied it). Sessions still on
+        it — a drain skipped or timed out — go through the
+        crash-failover re-placement, so removal is never worse than the
+        replica dying. Returns the number of links removed."""
+        with self._lock:
+            victims = [l for l in self._links if l.addr == addr]
+            for l in victims:
+                self._links.remove(l)
+        for l in victims:
+            l.close()
+            self._replica_down(l)
+        for addrs in (self._replica_addrs, self._decode_addrs):
+            while addr in addrs:
+                addrs.remove(addr)
+        self._refresh_prefix_residency()
+        return len(victims)
+
     def stop(self) -> None:
+        """Stop the router. Idempotent — a second stop is a no-op. Any
+        session still live (including mid-migration) is swept into a
+        terminal client ERROR before its connection closes: a stop
+        racing an in-flight migration must never strand a stream
+        without exactly one terminal frame."""
+        with self._lock:
+            if self._stopped:
+                return
+            self._stopped = True
         self._stopping.set()
         self._close_listener()
-        for link in self._links:
+        for link in list(self._links):
             link.close()
+        with self._lock:
+            doomed = list(self._sessions.values())
+            self._sessions.clear()
+            self._by_rrid.clear()
+        for s in doomed:
+            s.conn.send(P.ERROR, s.crid,
+                        P.pack_json({"message": "router stopping"}))
         self._close_conns()
         if self._health_thread is not None:
             self._health_thread.join(timeout=5)
@@ -431,20 +597,37 @@ class ServingRouter(FrameServerBase):
         (spreads a burst between stats refreshes)."""
         return (link.reported_load, -link.idle_slots, link.assigned)
 
-    def _pick_link(self, exclude: _ReplicaLink | None = None,
-                   role: str | None = None,
-                   prefer_prefix: str | None = None):
-        """Least-loaded live link of ``role``. ``prefer_prefix``
-        restricts the pool to replicas advertising that prefix as
-        RESIDENT when any exist (sessions go where the prefix KV
-        already lives — the whole point of prefix-aware routing), and
-        falls back to the full pool on a cold fleet."""
+    def _pick_link(self, exclude=None, role: str | None = None,
+                   prefer_prefix: str | None = None,
+                   prefer_version=None):
+        """Least-loaded live, non-draining link of ``role``.
+        ``exclude`` is one link or an iterable of links (a migration
+        storm / multi-replica failure excludes a SET). Preference
+        order: ``prefer_version`` first (a version-pinned session stays
+        on its weights generation while any same-version replica
+        survives — continuity beats pinning when none do), then
+        ``prefer_prefix`` restricts to replicas advertising that prefix
+        as RESIDENT when any exist (sessions go where the prefix KV
+        already lives), falling back to the full pool on a cold
+        fleet."""
+        if exclude is None:
+            ex = ()
+        elif isinstance(exclude, _ReplicaLink):
+            ex = (exclude,)
+        else:
+            ex = tuple(exclude)
         with self._lock:
             live = [l for l in self._links
-                    if l.alive and l is not exclude
+                    if l.alive and not l.draining
+                    and all(l is not e for e in ex)
                     and (role is None or l.role == role)]
             if not live:
                 return None
+            if prefer_version is not None:
+                same = [l for l in live
+                        if l.weights_version == prefer_version]
+                if same:
+                    live = same
             if prefer_prefix is not None:
                 resident = [l for l in live
                             if prefer_prefix in l.prefixes]
@@ -466,7 +649,7 @@ class ServingRouter(FrameServerBase):
             for link in list(self._links):
                 if not link.alive:
                     continue
-                if link.pings_unanswered >= 3:
+                if link.pings_unanswered >= self.max_missed_pings:
                     log.warning("router: replica %s unresponsive (%d "
                                 "unanswered stats pings); marking down",
                                 link.addr, link.pings_unanswered)
@@ -497,20 +680,52 @@ class ServingRouter(FrameServerBase):
             # prefill tier drops a still-queued prompt, the decode tier
             # tombstones the rid so a late-arriving shipment is never
             # adopted into a slot generating into the void.
+            targets = []
             with self._lock:
                 sess = self._sessions.get((conn.id, rid))
                 if sess is not None:
                     sess.cancelled = True
-                    links = [l for l in (sess.link, sess.prefill_link)
-                             if l is not None]
-                    rrid = sess.rrid
-            if sess is not None:
-                for link in links:
-                    link.send(P.CANCEL, rrid)
+                    targets = [(l, sess.rrid)
+                               for l in (sess.link, sess.prefill_link)
+                               if l is not None]
+                    mig = sess.migrating
+                    if mig is not None:
+                        # mid-migration: the NEW placement dies too —
+                        # its pre-ACK retirement is swallowed (the old
+                        # side owns the terminal frame), so the client
+                        # still sees exactly one
+                        targets += [(l, mig.new_rrid)
+                                    for l in (mig.new_link,
+                                              mig.new_prefill)
+                                    if l is not None]
+            for link, rrid_t in targets:
+                link.send(P.CANCEL, rrid_t)
         elif ftype == P.STATS:
             conn.send(P.STATS, 0, P.pack_json(self.stats()))
         elif ftype == P.PREFIX:
             self._handle_prefix_op(conn, rid, payload)
+        elif ftype == P.DRAIN:
+            obj = P.unpack_json(payload)
+            replica = obj.get("replica")
+            if not isinstance(replica, str) or not replica:
+                conn.send(P.ERROR, rid, P.pack_json(
+                    {"message": "DRAIN needs {'replica': 'host:port'}"}))
+                return
+            timeout = obj.get("timeout_s")
+            timeout = float(timeout) if isinstance(
+                timeout, (int, float)) and not isinstance(
+                timeout, bool) else 120.0
+            # a drain blocks until every session left the replica —
+            # never on the operator connection's reader thread
+            threading.Thread(
+                target=self._drain_and_reply,
+                args=(conn, rid, replica, timeout),
+                name=f"tony-router-drain-{replica}", daemon=True).start()
+        elif ftype == P.MIGRATE:
+            with self._lock:
+                sess = self._sessions.get((conn.id, rid))
+            ok = sess is not None and self._migrate_session(sess)
+            conn.send(P.MIGRATE, rid, P.pack_json({"ok": bool(ok)}))
         elif ftype == P.POLL:
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": "router supports streaming requests only"}))
@@ -579,37 +794,45 @@ class ServingRouter(FrameServerBase):
                 return
             sess = _RouterSession(conn, rid, prompt, max_new,
                                   trace_ctx=P.parse_trace_ctx(payload),
-                                  prefix_id=prefix_id)
+                                  prefix_id=prefix_id,
+                                  stream=next(self._next_stream))
             self._sessions[key] = sess
-        if not self._place(sess, exclude=None):
+        t0 = time.perf_counter()
+        placed = self._place(sess, exclude=None)
+        self._place_h.observe(time.perf_counter() - t0)
+        if not placed:
             with self._lock:
                 self._sessions.pop(key, None)
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": "no live replicas"}))
 
-    def _place(self, sess: _RouterSession,
-               exclude: _ReplicaLink | None) -> bool:
+    def _place(self, sess: _RouterSession, exclude) -> bool:
         """Assign (or re-assign) a session to the least-loaded replica;
         the replica prompt carries the already-streamed prefix so a
-        failover continues exactly where the stream left off. In
-        disaggregated mode the placement is a PAIR: the ADMIT goes to a
-        prefill link naming a decode link's channel endpoint, and
-        TOKENS will flow back over the decode link. A failed ADMIT send
-        is handled HERE (tear the link down, retry on the next
+        failover continues exactly where the stream left off, and the
+        session's pinned rng stream rides the ADMIT with the streamed
+        count as its offset — SAMPLED continuations are token-identical
+        too. In disaggregated mode the placement is a PAIR: the ADMIT
+        goes to a prefill link naming a decode link's channel endpoint,
+        and TOKENS will flow back over the decode link. A failed ADMIT
+        send is handled HERE (tear the link down, retry on the next
         replica): the link's reader thread may already have run its
         one-shot ``_replica_down`` sweep before this session was
         registered, so relying on it would strand the session."""
         if self._disagg:
             plink = self._pick_link(exclude=exclude, role="prefill",
-                                    prefer_prefix=sess.prefix_id)
-            dlink = self._pick_link(exclude=exclude, role="decode")
+                                    prefer_prefix=sess.prefix_id,
+                                    prefer_version=sess.pinned_version)
+            dlink = self._pick_link(exclude=exclude, role="decode",
+                                    prefer_version=sess.pinned_version)
             if plink is None or dlink is None:
                 return False
             admit_link, token_link = plink, dlink
         else:
             plink = None
             admit_link = token_link = self._pick_link(
-                exclude=exclude, prefer_prefix=sess.prefix_id)
+                exclude=exclude, prefer_prefix=sess.prefix_id,
+                prefer_version=sess.pinned_version)
             if admit_link is None:
                 return False
         if sess.prefix_id is not None:
@@ -636,6 +859,8 @@ class ServingRouter(FrameServerBase):
                 sess.prefill_link = plink
                 sess.handed_off = False
                 sess.rrid = rrid
+                if sess.pinned_version is None:
+                    sess.pinned_version = token_link.weights_version
                 self._by_rrid[rrid] = sess
                 token_link.assigned += 1
                 if plink is not None:
@@ -660,7 +885,13 @@ class ServingRouter(FrameServerBase):
                 "router.place", 0.0, ctx=sess.trace_ctx, **attrs)
         body = {"prompt": sess.prompt + sess.streamed,
                 "max_new_tokens": sess.budget - len(sess.streamed),
-                "stream": True}
+                "stream": True,
+                # the session's rng pin: same stream index on every
+                # placement, offset = tokens already delivered — a
+                # SAMPLED continuation regenerates the identical
+                # sequence on any replica sharing the fleet seed
+                "rng": {"stream": sess.stream,
+                        "off": len(sess.streamed)}}
         if sess.prefix_id is not None:
             # forwarded on failover re-placements too: the streamed
             # prefix folds in AFTER the shared prefix, so the re-placed
@@ -693,7 +924,207 @@ class ServingRouter(FrameServerBase):
             return self._place(sess, exclude=admit_link)
         return True
 
+    # -- planned migration (drain / upgrade) ---------------------------------
+    def _migrate_session(self, sess: _RouterSession, exclude=()) -> bool:
+        """Start a coordinated live migration of one session: place it
+        a SECOND time on a surviving replica (prompt + streamed prefix,
+        rng pinned at the snapshot offset) while the old placement
+        keeps streaming. The new placement's first delta is the
+        takeover ACK (see :meth:`_replica_delta`); until then the
+        session cannot stall — the old side never stopped. Returns True
+        when a migration is in flight (started here or already),
+        False when the session has nothing to migrate (retired,
+        cancelled, budget-complete, or a disaggregated session still
+        pre-handoff — the handoff lands in milliseconds and the drain
+        loop's next tick catches it) or no eligible replica exists."""
+        with self._lock:
+            if self._sessions.get((sess.conn.id, sess.crid)) is not sess:
+                return False                # already terminal
+            if sess.cancelled:
+                return False
+            if sess.migrating is not None:
+                return True                 # already on its way
+            if sess.link is None:
+                return False                # between homes; sweep owns it
+            if self._disagg and not sess.handed_off:
+                return False                # prompt still on the prefill tier
+            if len(sess.streamed) >= sess.budget:
+                return False                # retirement already due
+            old_token = sess.link
+            # the snapshot the new ADMIT carries: tokens the old side
+            # streams AFTER this become the regenerated overlap the ACK
+            # discards count-exactly
+            snap_len = len(sess.streamed)
+            prompt = sess.prompt + sess.streamed
+            prefix_id = sess.prefix_id
+            trace_ctx = sess.trace_ctx
+            stream = sess.stream
+            pinned = sess.pinned_version
+            budget = sess.budget
+        ex = set(exclude)
+        ex.add(old_token)
+        if self._disagg:
+            plink = self._pick_link(exclude=ex, role="prefill",
+                                    prefer_prefix=prefix_id,
+                                    prefer_version=pinned)
+            dlink = self._pick_link(exclude=ex, role="decode",
+                                    prefer_version=pinned)
+            if plink is None or dlink is None:
+                return False
+            admit_link, token_link = plink, dlink
+        else:
+            plink = None
+            admit_link = token_link = self._pick_link(
+                exclude=ex, prefer_prefix=prefix_id,
+                prefer_version=pinned)
+            if admit_link is None:
+                return False
+        new_rrid = next(self._next_rrid)
+        mig = _Migration(snap_len, token_link, plink, new_rrid)
+        with self._lock:
+            # re-validate: the session may have retired, cancelled, or
+            # crash-failed-over to a DIFFERENT link while we were
+            # picking — a stale snapshot must not admit
+            if (self._sessions.get((sess.conn.id, sess.crid)) is not sess
+                    or sess.cancelled or sess.migrating is not None
+                    or sess.link is not old_token):
+                return False
+            # the picked links may have died between the pick and here
+            # (their down-sweep could have run before we registered, so
+            # it would never see this migration)
+            if not token_link.alive or (
+                    plink is not None and not plink.alive):
+                return False
+            sess.migrating = mig
+            self._by_rrid[new_rrid] = sess
+            token_link.assigned += 1
+            if plink is not None:
+                plink.assigned += 1
+        self._placed_c[admit_link.addr].inc()
+        if plink is not None:
+            self._placed_c[token_link.addr].inc()
+        if trace_ctx is not None:
+            from tony_tpu.runtime import tracing
+            attrs = {"replica": admit_link.addr, "snap_len": snap_len}
+            if plink is not None:
+                attrs["decode"] = token_link.addr
+            tracing.get_tracer().record_span(
+                "router.migrate", 0.0, ctx=trace_ctx, **attrs)
+        body = {"prompt": prompt,
+                "max_new_tokens": budget - snap_len,
+                "stream": True,
+                "rng": {"stream": stream, "off": snap_len}}
+        if prefix_id is not None:
+            body["prefix"] = prefix_id
+        if plink is not None:
+            host = token_link.addr.rpartition(":")[0]
+            body["decode"] = f"{host}:{token_link.channel_port}"
+        if trace_ctx is not None:
+            body["trace"] = trace_ctx
+        if not admit_link.send(P.ADMIT, new_rrid, P.pack_json(body)):
+            # roll the second placement back (guarded: the link's
+            # down-sweep may have abandoned it for us already) and let
+            # the drain loop retry on whatever survives
+            with self._lock:
+                if self._by_rrid.get(new_rrid) is sess:
+                    self._by_rrid.pop(new_rrid, None)
+                    for l in {token_link, plink}:
+                        if l is not None:
+                            l.assigned -= 1
+                    if sess.migrating is mig:
+                        sess.migrating = None
+            admit_link.alive = False
+            admit_link.close()
+            self._replica_down(admit_link)
+            return False
+        return True
+
+    def drain(self, replica: str, timeout_s: float = 120.0,
+              poll_interval_s: float = 0.05) -> dict:
+        """Fence ``replica`` against new placements and live-migrate
+        every session off it (planned maintenance / rolling upgrade —
+        the zero-dup/zero-drop counterpart of crash failover). Blocks
+        until the replica holds no sessions or ``timeout_s`` passes;
+        a replica with no sessions drains immediately. The fence stays
+        after the drain — lift it with :meth:`remove_replica` (retire)
+        or :meth:`undrain` (maintenance cancelled). Returns a summary:
+        ``{"replica", "drained", "migrated", "wall_s"}`` plus
+        ``"sessions_left"`` on timeout. A session whose migration is
+        abandoned (its target died mid-flight) is retried on the next
+        poll tick; one that cannot be placed anywhere keeps streaming
+        on the draining replica — a drain never degrades a live
+        stream."""
+        t0 = time.perf_counter()
+        with self._lock:
+            targets = [l for l in self._links if l.addr == replica]
+            for l in targets:
+                l.draining = True
+        if not targets:
+            return {"replica": replica, "drained": False, "migrated": 0,
+                    "wall_s": 0.0, "error": "unknown replica"}
+        tset = {id(l) for l in targets}
+        migrated = 0
+        deadline = t0 + timeout_s
+        while True:
+            with self._lock:
+                pending = [
+                    s for s in self._sessions.values()
+                    if (s.link is not None and id(s.link) in tset)
+                    or (s.prefill_link is not None
+                        and id(s.prefill_link) in tset
+                        and not s.handed_off)]
+                busy = {id(s) for s in pending
+                        if s.migrating is not None}
+            if not pending:
+                break
+            for s in pending:
+                if id(s) in busy:
+                    continue
+                if self._migrate_session(s):
+                    migrated += 1
+            if time.perf_counter() >= deadline:
+                self._drains_c.inc()
+                return {"replica": replica, "drained": False,
+                        "migrated": migrated,
+                        "sessions_left": len(pending),
+                        "wall_s": round(time.perf_counter() - t0, 4)}
+            if self._stopping.wait(poll_interval_s):
+                # router stopping under the drain: stop() sweeps every
+                # session to a terminal ERROR; report honestly
+                return {"replica": replica, "drained": False,
+                        "migrated": migrated,
+                        "sessions_left": len(pending),
+                        "wall_s": round(time.perf_counter() - t0, 4),
+                        "error": "router stopping"}
+        self._drains_c.inc()
+        return {"replica": replica, "drained": True,
+                "migrated": migrated,
+                "wall_s": round(time.perf_counter() - t0, 4)}
+
+    def undrain(self, replica: str) -> None:
+        """Lift a drain fence (maintenance cancelled): the replica
+        takes new placements again."""
+        with self._lock:
+            for l in self._links:
+                if l.addr == replica:
+                    l.draining = False
+
+    def _drain_and_reply(self, conn: FrameConn, rid: int, replica: str,
+                         timeout_s: float) -> None:
+        """Run a remote-requested drain and reply on its rid (its own
+        thread: a drain blocks for its wall time, and the operator
+        connection's reader must keep serving other frames)."""
+        try:
+            result = self.drain(replica, timeout_s=timeout_s)
+        except Exception as e:           # noqa: BLE001 - reply, don't die
+            conn.send(P.ERROR, rid,
+                      P.pack_json({"message": f"drain failed: {e}"}))
+            return
+        result["ok"] = bool(result.get("drained"))
+        conn.send(P.DRAIN, rid, P.pack_json(result))
+
     def _on_conn_closed(self, conn: FrameConn) -> None:
+        cancels = []
         with self._lock:
             doomed = [s for k, s in list(self._sessions.items())
                       if s.conn is conn]
@@ -701,26 +1132,119 @@ class ServingRouter(FrameServerBase):
                 self._sessions.pop((conn.id, s.crid), None)
                 self._by_rrid.pop(s.rrid, None)
                 self._unassign_locked(s)
-        for s in doomed:
-            for link in {s.link, s.prefill_link}:
-                if link is not None:
-                    link.send(P.CANCEL, s.rrid)
+                cancels += [(l, s.rrid)
+                            for l in {s.link, s.prefill_link}
+                            if l is not None]
+                mig = s.migrating
+                if mig is not None and not mig.acked:
+                    # the second placement of an in-flight migration
+                    # dies with the client too
+                    self._by_rrid.pop(mig.new_rrid, None)
+                    for l in {mig.new_link, mig.new_prefill}:
+                        if l is not None:
+                            l.assigned -= 1
+                    cancels += [(l, mig.new_rrid)
+                                for l in {mig.new_link, mig.new_prefill}
+                                if l is not None]
+        for link, rrid in cancels:
+            link.send(P.CANCEL, rrid)
 
     # -- replica side (link reader threads) ---------------------------------
     def _replica_delta(self, link: _ReplicaLink, rrid: int,
                        toks: list[int]) -> None:
+        """Forward a replica delta. During a migration the session's
+        tokens arrive on TWO links from two reader threads — the
+        session's ``wlock`` serializes append+send per delta so the
+        client never sees positions out of order, and the FIRST delta
+        from the new placement is the takeover ACK: ownership swaps to
+        the new links, the old half gets a tombstoning CANCEL, and the
+        regenerated overlap (tokens the old side streamed after the
+        migration snapshot — token-identical by the rng pin) is dropped
+        count-exactly."""
         with self._lock:
             sess = self._by_rrid.get(rrid)
-            if sess is None or sess.link is not link:
-                return                      # stale delta after failover
-            sess.streamed.extend(toks)
-        sess.conn.send(P.TOKENS, sess.crid, P.pack_tokens(toks))
+        if sess is None:
+            return
+        send = None
+        cancels = []
+        completed = False
+        with sess.wlock:
+            with self._lock:
+                if self._by_rrid.get(rrid) is not sess:
+                    return                  # swept under us
+                mig = sess.migrating
+                if mig is not None and rrid == mig.new_rrid:
+                    if link is not mig.new_link:
+                        return              # not the new token link
+                    if not mig.acked:
+                        # the ACK: the new placement is live — swap
+                        # ownership, release the old placement's
+                        # assignment counts (BOTH halves,
+                        # unconditionally: a stateless prefill link can
+                        # serve both placements and was counted twice)
+                        mig.acked = True
+                        mig.discard = len(sess.streamed) - mig.snap_len
+                        old_rrid = sess.rrid
+                        cancels = [(l, old_rrid)
+                                   for l in {sess.link, sess.prefill_link}
+                                   if l is not None]
+                        self._by_rrid.pop(old_rrid, None)
+                        for l in {sess.link, sess.prefill_link}:
+                            if l is not None:
+                                l.assigned -= 1
+                        sess.link = mig.new_link
+                        sess.prefill_link = mig.new_prefill
+                        sess.handed_off = mig.handed_off
+                        sess.rrid = mig.new_rrid
+                        completed = True
+                    # drop the regenerated overlap — the client already
+                    # has those exact tokens from the old side
+                    if mig.discard:
+                        drop = min(mig.discard, len(toks))
+                        mig.discard -= drop
+                        toks = toks[drop:]
+                    if mig.discard == 0:
+                        sess.migrating = None
+                    if toks:
+                        sess.streamed.extend(toks)
+                        send = toks
+                else:
+                    if sess.link is not link or rrid != sess.rrid:
+                        return              # stale delta after failover
+                    sess.streamed.extend(toks)
+                    send = toks
+            # still under wlock (delta order), outside the router lock
+            for l, r in cancels:
+                l.send(P.CANCEL, r)
+            if send:
+                sess.conn.send(P.TOKENS, sess.crid, P.pack_tokens(send))
+        if completed:
+            self._migrations_c.inc()
 
     def _replica_retired(self, link: _ReplicaLink, rrid: int,
                          reason: str) -> None:
+        tombstones = []
         with self._lock:
             sess = self._by_rrid.pop(rrid, None)
             if sess is None:
+                return
+            mig = sess.migrating
+            if mig is not None and not mig.acked and rrid == mig.new_rrid:
+                # the NEW placement of an in-flight migration retired
+                # before its first delta (a client CANCEL fanned to it,
+                # or an instant eos): abandon the migration SILENTLY —
+                # the old placement never stopped streaming and still
+                # owns the one terminal frame the client will see
+                owns = (mig.new_link is link
+                        or (mig.new_prefill is link
+                            and not mig.handed_off))
+                if not owns or reason == "stopped":
+                    self._by_rrid[rrid] = sess
+                    return
+                for l in {mig.new_link, mig.new_prefill}:
+                    if l is not None:
+                        l.assigned -= 1
+                sess.migrating = None
                 return
             # the prefill link speaks for a session it still owns (a
             # CANCEL caught the prompt queued or mid-wave, pre-HANDOFF);
@@ -737,6 +1261,20 @@ class ServingRouter(FrameServerBase):
                 return
             self._sessions.pop((sess.conn.id, sess.crid), None)
             self._unassign_locked(sess)
+            if mig is not None and not mig.acked:
+                # the OLD side finished the stream (eos/budget/cancel)
+                # before the migration ACKed: the takeover is moot —
+                # tombstone the pending second placement
+                self._by_rrid.pop(mig.new_rrid, None)
+                for l in {mig.new_link, mig.new_prefill}:
+                    if l is not None:
+                        l.assigned -= 1
+                tombstones = [(l, mig.new_rrid)
+                              for l in {mig.new_link, mig.new_prefill}
+                              if l is not None and l.alive]
+                sess.migrating = None
+        for l, r in tombstones:
+            l.send(P.CANCEL, r)
         sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
             {"reason": reason, "tokens": len(sess.streamed)}))
 
@@ -747,9 +1285,20 @@ class ServingRouter(FrameServerBase):
         this frame costs the session nothing."""
         with self._lock:
             sess = self._by_rrid.get(rrid)
-            if sess is None or sess.prefill_link is not link:
-                return                      # stale (failover re-placed)
-            sess.handed_off = True
+            if sess is None:
+                return
+            mig = sess.migrating
+            if mig is not None and not mig.acked and rrid == mig.new_rrid:
+                # the migration's second placement handed off — ITS
+                # prefill half is out of the fate path (recorded on the
+                # migration; the ACK swap copies it onto the session)
+                if mig.new_prefill is not link:
+                    return
+                mig.handed_off = True
+            else:
+                if sess.prefill_link is not link or rrid != sess.rrid:
+                    return                  # stale (failover re-placed)
+                sess.handed_off = True
         self._handoffs_c.inc()
 
     def _replica_error(self, link: _ReplicaLink, rrid: int, msg: str,
@@ -760,15 +1309,44 @@ class ServingRouter(FrameServerBase):
         link the shipment could not reach — the same contract as losing
         that decode link outright, just noticed by the prefill tier
         first."""
+        tombstones = []
         with self._lock:
             sess = self._by_rrid.pop(rrid, None)
             if sess is None:
                 return
+            mig = sess.migrating
+            if mig is not None and not mig.acked and rrid == mig.new_rrid:
+                # the migration's second placement failed before taking
+                # over: abandon it silently — the old half never
+                # stopped streaming; the drain loop just retries
+                for l in {mig.new_link, mig.new_prefill}:
+                    if l is not None:
+                        l.assigned -= 1
+                sess.migrating = None
+                return
             self._unassign_locked(sess)
+            if mig is not None and not mig.acked:
+                # the OWNING placement failed mid-migration: the
+                # pending takeover is torn down with it — the failover
+                # re-placement below restarts from the full streamed
+                # prefix (never from the stale migration snapshot)
+                self._by_rrid.pop(mig.new_rrid, None)
+                for l in {mig.new_link, mig.new_prefill}:
+                    if l is not None:
+                        l.assigned -= 1
+                tombstones = [(l, mig.new_rrid)
+                              for l in {mig.new_link, mig.new_prefill}
+                              if l is not None and l.alive]
+            # any residual migration state (including a post-ACK
+            # discard countdown) dies with the placement: the failover
+            # re-placement below restarts from the full streamed prefix
+            sess.migrating = None
             old_link = sess.link
             retry = retryable and not sess.cancelled
             if not retry:
                 self._sessions.pop((sess.conn.id, sess.crid), None)
+        for l, r in tombstones:
+            l.send(P.CANCEL, r)
         if retry:
             # tombstone the old rrid on the decode link the shipment
             # could not (verifiably) reach: "unreachable" may be a
@@ -804,13 +1382,93 @@ class ServingRouter(FrameServerBase):
         link.close()
         self._up_g[link.addr].set(0)
         self._refresh_prefix_residency()
+        abandoned = []  # (surviving links, new_rrid): dead migrations
+        promoted = []   # (sess, old_rrid, surviving old links): forced ACKs
+        orphans = []
         with self._lock:
-            orphans = [s for s in self._by_rrid.values()
-                       if s.link is link
-                       or (s.prefill_link is link and not s.handed_off)]
-            for s in orphans:
+            seen = set()
+            for s in list(self._by_rrid.values()):
+                if id(s) in seen:
+                    continue                # mapped twice mid-migration
+                seen.add(id(s))
+                mig = s.migrating
+                if (mig is not None and not mig.acked
+                        and (mig.new_link is link
+                             or (mig.new_prefill is link
+                                 and not mig.handed_off))):
+                    # a pending migration TARGETED the dead replica:
+                    # abandon it — the old placement never stopped
+                    # streaming; the drain loop just retries
+                    self._by_rrid.pop(mig.new_rrid, None)
+                    for l in {mig.new_link, mig.new_prefill}:
+                        if l is not None:
+                            l.assigned -= 1
+                    abandoned.append((
+                        [l for l in {mig.new_link, mig.new_prefill}
+                         if l is not None and l is not link and l.alive],
+                        mig.new_rrid))
+                    s.migrating = None
+                    mig = None
+                hit = (s.link is link
+                       or (s.prefill_link is link and not s.handed_off))
+                if not hit:
+                    continue
+                if (mig is not None and not mig.acked
+                        and mig.new_link.alive
+                        and (mig.new_prefill is None or mig.handed_off
+                             or mig.new_prefill.alive)):
+                    # the OLD half died while a migration toward a
+                    # healthy target was pending: PROMOTE it — a forced
+                    # ACK. No re-placement, no re-prefill: the new side
+                    # is already computing, its deltas just haven't
+                    # landed yet; the discard countdown drops the
+                    # overlap exactly as a delta-ACK would.
+                    old_rrid = s.rrid
+                    survivors = [l for l in {s.link, s.prefill_link}
+                                 if l is not None and l is not link
+                                 and l.alive]
+                    mig.acked = True
+                    mig.discard = len(s.streamed) - mig.snap_len
+                    self._by_rrid.pop(old_rrid, None)
+                    for l in {s.link, s.prefill_link}:
+                        if l is not None:
+                            l.assigned -= 1
+                    s.link = mig.new_link
+                    s.prefill_link = mig.new_prefill
+                    s.handed_off = mig.handed_off
+                    s.rrid = mig.new_rrid
+                    if mig.discard == 0:
+                        s.migrating = None
+                    promoted.append((s, old_rrid, survivors))
+                    continue
+                if mig is not None and not mig.acked:
+                    # pending migration whose target ALSO already died:
+                    # tear both placements down, re-place fresh below
+                    self._by_rrid.pop(mig.new_rrid, None)
+                    for l in {mig.new_link, mig.new_prefill}:
+                        if l is not None:
+                            l.assigned -= 1
+                    abandoned.append((
+                        [l for l in {mig.new_link, mig.new_prefill}
+                         if l is not None and l is not link and l.alive],
+                        mig.new_rrid))
+                s.migrating = None
                 self._by_rrid.pop(s.rrid, None)
                 self._unassign_locked(s)
+                orphans.append(s)
+        for links_, new_rrid in abandoned:
+            # tombstone the surviving half of a torn-down second
+            # placement (a queued prompt / a pre-adoption rid)
+            for l in links_:
+                l.send(P.CANCEL, new_rrid)
+        for s, old_rrid, survivors in promoted:
+            for l in survivors:
+                l.send(P.CANCEL, old_rrid)
+            self._migrations_c.inc()
+        if promoted:
+            log.warning("router: replica %s (%s) down; promoted %d "
+                        "in-flight migrations", link.addr, link.role,
+                        len(promoted))
         if orphans:
             log.warning("router: replica %s (%s) down; re-admitting %d "
                         "sessions", link.addr, link.role, len(orphans))
@@ -871,6 +1529,8 @@ class ServingRouter(FrameServerBase):
                              "reported_load": l.reported_load,
                              "assigned": l.assigned,
                              "role": l.role,
+                             "draining": bool(l.draining),
+                             "weights_version": l.weights_version,
                              "prefixes": sorted(l.prefixes),
                              "ring": l.ring}
                     for l in self._links},

@@ -235,10 +235,15 @@ class ServingServer(PrefixHost, FrameServerBase):
     """
 
     def __init__(self, batcher, bind_host: str = "127.0.0.1",
-                 port: int = 0, registry=None) -> None:
+                 port: int = 0, registry=None,
+                 weights_version: str | None = None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
         self.batcher = batcher
+        #: the model-weights generation this replica serves, advertised
+        #: in HELLO and STATS — what the router's version-pinned
+        #: placement (rolling upgrades) keys on. None = unversioned.
+        self.weights_version = weights_version
         self._lock = threading.Lock()
         self._sessions: dict[tuple[int, int], _Session] = {}
         self.engine = ServeEngine(batcher, on_delta=self._on_delta,
@@ -331,7 +336,8 @@ class ServingServer(PrefixHost, FrameServerBase):
         return {"v": 1, "slots": self.batcher.batch, "role": "engine",
                 "prefixes": self.batcher.resident_prefixes(),
                 "ring": self.batcher._ring,
-                "prefix_port": self.prefix_port}
+                "prefix_port": self.prefix_port,
+                "weights_version": self.weights_version}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -345,7 +351,8 @@ class ServingServer(PrefixHost, FrameServerBase):
             conn.send(P.STATS, 0, P.pack_json(dict(
                 self.engine.stats(),
                 prefixes=self.batcher.resident_prefixes(),
-                ring=self.batcher._ring)))
+                ring=self.batcher._ring,
+                weights_version=self.weights_version)))
         elif ftype == P.PREFIX:
             self._handle_prefix_frame(conn, rid, payload)
         else:
@@ -358,6 +365,7 @@ class ServingServer(PrefixHost, FrameServerBase):
         prompt, max_new, stream = P.parse_admit(payload)
         trace_ctx = P.parse_trace_ctx(payload)
         prefix_id = P.parse_prefix_id(payload)
+        rng = P.parse_rng(payload)
         if rid == 0:
             raise P.ProtocolError("ADMIT rid must be nonzero")
         key = (conn.id, rid)
@@ -369,7 +377,7 @@ class ServingServer(PrefixHost, FrameServerBase):
             self._sessions[key] = _Session(conn, rid, stream)
         try:
             self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx,
-                               prefix_id=prefix_id)
+                               prefix_id=prefix_id, rng=rng)
         except (ValueError, RuntimeError) as e:
             with self._lock:
                 self._sessions.pop(key, None)

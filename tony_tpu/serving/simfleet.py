@@ -1,0 +1,267 @@
+"""Deterministic simulated serving fleet: the chaos harness's model.
+
+A :class:`SimReplica` speaks the full TONYS1 replica surface (HELLO
+with slots/weights_version, ADMIT honoring the router's ``rng`` pin,
+streamed TOKENS at an injected inter-token compute floor, atomic
+TOKENS+RETIRED finals, CANCEL, STATS pings) with NO model stack — the
+"generation" is a pure position-indexed token oracle,
+:func:`sim_token`. That makes fleet-scale behavior testable exactly:
+
+- any observer who knows a session's prompt can compute the ONE
+  correct token sequence, so zero-dup/zero-drop across any number of
+  migrations, failovers, and crashes is a strict equality check —
+  the oracle keys on the prompt's first token and the ABSOLUTE
+  position (the rng offset plus tokens emitted), which is precisely
+  the contract the router's rng pin promises a real sampled engine
+  reproduces;
+- a 100-replica fleet runs in one process in milliseconds of wall
+  time per token, so migration storms (drain 30 replicas at once) and
+  seeded crash/drain chaos mixes are tier-1-affordable at small scale
+  and @slow at full scale (tests/test_fleet.py);
+- :class:`SimFleet` wires N replicas behind a real
+  :class:`~tony_tpu.serving.router.ServingRouter` (real sockets, real
+  frames — only the model is simulated) and exposes kill/spawn/reap
+  for chaos and autoscale (:class:`SimProvider` plugs into
+  :class:`~tony_tpu.serving.fleet.FleetController`).
+
+This is the serving twin of the bench's ``_disagg_arm`` pattern
+(LatencyProxy + injected compute floors instead of real math), promoted
+from a bench trick to a first-class harness.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from tony_tpu.serving import protocol as P
+from tony_tpu.serving.server import FrameConn, FrameServerBase
+
+
+def sim_token(seed: int, pos: int) -> int:
+    """The simulated model: token at absolute position ``pos`` of the
+    stream seeded by ``seed`` (a session's first prompt token). Pure,
+    stateless, collision-scrambled — any two (seed, pos) pairs disagree
+    enough that a dup/drop/cross-session mixup cannot pass the equality
+    check by accident. Values stay under 2**30 (engine token range)."""
+    x = (seed & 0x3FFFFF) * 1315423911 + pos * 2654435761 + 97531
+    x ^= x >> 13
+    return x & 0x3FFFFFFF
+
+
+class _SimSession:
+    __slots__ = ("conn", "rid", "seed", "off", "emitted", "max_new",
+                 "ready_at")
+
+    def __init__(self, conn: FrameConn, rid: int, seed: int, off: int,
+                 max_new: int, ready_at: float) -> None:
+        self.conn = conn
+        self.rid = rid
+        self.seed = seed
+        self.off = off                      # rng offset: tokens already
+        self.emitted = 0                    # delivered by PRIOR placements
+        self.max_new = max_new
+        self.ready_at = ready_at
+
+
+class SimReplica(FrameServerBase):
+    """One simulated serving replica. ``itl_s`` is the injected
+    inter-token compute floor (one pump tick emits one token per live
+    session); ``ttft_s`` the injected prefill floor before a session's
+    first token. ``kill()`` is a crash: listener and every connection
+    sever mid-stream, no goodbye frames."""
+
+    def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
+                 itl_s: float = 0.002, ttft_s: float = 0.0,
+                 slots: int = 16, weights_version: str | None = None)\
+            -> None:
+        super().__init__(bind_host, port)
+        self.itl_s = itl_s
+        self.ttft_s = ttft_s
+        self.slots = slots
+        self.weights_version = weights_version
+        self._slock = threading.Lock()
+        self._sessions: dict = {}           # (conn.id, rid) -> _SimSession
+        self._pump_thread: threading.Thread | None = None
+        self.addr = ""
+
+    def start(self) -> int:
+        port = super().start()
+        self.addr = f"{self.bind_host}:{port}"
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, name=f"tony-sim-pump-{port}",
+            daemon=True)
+        self._pump_thread.start()
+        return port
+
+    # -- replica protocol surface --------------------------------------------
+    def _hello_payload(self) -> dict:
+        return {"v": 1, "role": "engine", "slots": self.slots,
+                "weights_version": self.weights_version, "sim": True}
+
+    def _stats_payload(self) -> dict:
+        with self._slock:
+            active = len(self._sessions)
+        return {"queue_depth": 0, "active": active, "slots": self.slots,
+                "weights_version": self.weights_version}
+
+    def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
+                      payload: bytes) -> None:
+        if ftype == P.ADMIT:
+            prompt, max_new, stream = P.parse_admit(payload)
+            if rid == 0 or not stream or max_new <= 0 or not prompt:
+                conn.send(P.ERROR, rid, P.pack_json(
+                    {"message": "sim replica: bad ADMIT"}))
+                return
+            rng = P.parse_rng(payload)
+            off = rng[1] if rng is not None else 0
+            # the oracle seed is the ORIGINAL prompt's first token:
+            # folded-in streamed prefixes append, so it survives every
+            # re-placement of the session
+            sess = _SimSession(conn, rid, seed=prompt[0], off=off,
+                               max_new=max_new,
+                               ready_at=time.monotonic() + self.ttft_s)
+            with self._slock:
+                self._sessions[(conn.id, rid)] = sess
+        elif ftype == P.CANCEL:
+            with self._slock:
+                sess = self._sessions.pop((conn.id, rid), None)
+            if sess is not None:
+                conn.send(P.RETIRED, rid, P.pack_json(
+                    {"reason": "cancelled", "tokens": sess.emitted}))
+        elif ftype == P.STATS:
+            conn.send(P.STATS, 0, P.pack_json(self._stats_payload()))
+        else:
+            raise P.ProtocolError(
+                f"sim replica: unexpected frame "
+                f"{P.FRAME_NAMES.get(ftype, ftype)}")
+
+    def _on_conn_closed(self, conn: FrameConn) -> None:
+        with self._slock:
+            for key in [k for k in self._sessions if k[0] == conn.id]:
+                self._sessions.pop(key, None)
+
+    # -- the simulated engine ------------------------------------------------
+    def _pump_loop(self) -> None:
+        while not self._stopping.wait(self.itl_s):
+            now = time.monotonic()
+            with self._slock:
+                items = list(self._sessions.items())
+            for key, s in items:
+                if now < s.ready_at:
+                    continue
+                tok = sim_token(s.seed, s.off + s.emitted)
+                s.emitted += 1
+                if s.emitted >= s.max_new:
+                    with self._slock:
+                        self._sessions.pop(key, None)
+                    # final delta + retirement share one kernel write:
+                    # a crash cannot deliver one without the other
+                    s.conn.send_many([
+                        (P.TOKENS, s.rid, P.pack_tokens([tok])),
+                        (P.RETIRED, s.rid, P.pack_json(
+                            {"reason": "budget", "tokens": s.emitted}))])
+                else:
+                    if not s.conn.send(P.TOKENS, s.rid,
+                                       P.pack_tokens([tok])):
+                        with self._slock:
+                            self._sessions.pop(key, None)
+
+    # -- lifecycle -----------------------------------------------------------
+    def stop(self) -> None:
+        self._stopping.set()
+        self._close_listener()
+        self._close_conns()
+        if self._pump_thread is not None:
+            self._pump_thread.join(timeout=5)
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=5)
+
+    def kill(self) -> None:
+        """Crash, not shutdown: sever everything mid-stream."""
+        self.stop()
+
+
+class SimFleet:
+    """N simulated replicas behind a real router. ``start`` returns the
+    router's client port. Chaos surface: :meth:`kill` (crash replica
+    i), :meth:`spawn` (stand up a new replica and return its address —
+    NOT yet routed; pair with ``router.add_replicas`` or use
+    :class:`SimProvider`), :meth:`reap` (stop a spawned replica)."""
+
+    def __init__(self, n: int, itl_s: float = 0.002,
+                 ttft_s: float = 0.0, slots: int = 16,
+                 weights_version: str | None = None,
+                 health_interval_s: float = 0.1,
+                 max_missed_pings: int = 3, registry=None) -> None:
+        self.n = n
+        self.itl_s = itl_s
+        self.ttft_s = ttft_s
+        self.slots = slots
+        self.weights_version = weights_version
+        self.health_interval_s = health_interval_s
+        self.max_missed_pings = max_missed_pings
+        self.registry = registry
+        self.replicas: dict = {}            # addr -> SimReplica
+        self.router = None
+
+    def start(self) -> int:
+        from tony_tpu.serving.router import ServingRouter
+
+        for _ in range(self.n):
+            self.spawn()
+        self.router = ServingRouter(
+            list(self.replicas), health_interval_s=self.health_interval_s,
+            max_missed_pings=self.max_missed_pings,
+            registry=self.registry)
+        return self.router.start()
+
+    def spawn(self, weights_version: str | None = None,
+              itl_s: float | None = None) -> str:
+        rep = SimReplica(
+            itl_s=self.itl_s if itl_s is None else itl_s,
+            ttft_s=self.ttft_s, slots=self.slots,
+            weights_version=(self.weights_version
+                             if weights_version is None
+                             else weights_version))
+        rep.start()
+        self.replicas[rep.addr] = rep
+        return rep.addr
+
+    def kill(self, addr: str) -> None:
+        rep = self.replicas.get(addr)
+        if rep is not None:
+            rep.kill()
+
+    def reap(self, addr: str) -> None:
+        rep = self.replicas.pop(addr, None)
+        if rep is not None:
+            rep.stop()
+
+    def addrs(self) -> list:
+        return list(self.replicas)
+
+    def stop(self) -> None:
+        if self.router is not None:
+            self.router.stop()
+        for rep in self.replicas.values():
+            rep.stop()
+        self.replicas.clear()
+
+
+class SimProvider:
+    """:class:`~tony_tpu.serving.fleet.CapacityProvider` over a
+    :class:`SimFleet` — what the autoscale tests grow and shrink."""
+
+    def __init__(self, fleet: SimFleet,
+                 weights_version: str | None = None) -> None:
+        self.fleet = fleet
+        self.weights_version = weights_version
+
+    def grow(self, n: int) -> list:
+        return [self.fleet.spawn(weights_version=self.weights_version)
+                for _ in range(n)]
+
+    def release(self, addrs) -> None:
+        for addr in addrs:
+            self.fleet.reap(addr)
