@@ -320,6 +320,11 @@ def main() -> None:
     # tier-1 pin (tests/test_channels.py) asserts overlap >= 1.5x.
     out.update(_pipeline_arm())
 
+    # DCN bytes as a resource: int8 wire codec bytes ratio + interleaved
+    # (v=2) vs flat placement walls under injected latency. Tier-1 pins:
+    # bytes >= 1.9x, interleaved beats flat (tests/test_channels.py).
+    out.update(_pipeline_dcn_arm())
+
     # device-prefetched vs synchronous train feed: with nonzero decode
     # cost the pipelined loop's step wall should approach the
     # pure-compute wall (decode + H2D overlap the device step) while the
@@ -2149,6 +2154,238 @@ def _pipeline_arm(num_microbatches: int = 8, one_way_s: float = 0.05,
         # the tentpole ratio: DCN round trips overlapped under compute
         "pipeline_overlap_vs_serialized_wall": round(wall_sr / wall_ov, 2),
         "pipeline_bubble_fraction": round(float(bubble), 3),
+    }
+
+
+def _pipeline_dcn_arm(num_microbatches: int = 24, one_way_s: float = 0.05,
+                      fwd_floor_s: float = 0.02,
+                      bwd_floor_s: float = 0.04,
+                      bytes_dim: int = 256, bytes_rows: int = 8,
+                      dim: int = 8, mb_rows: int = 4,
+                      window: int = 16) -> dict:
+    """DCN bytes as a resource: wire compression + interleaved 1F1B.
+
+    Two deterministic sub-arms, both over REAL loopback channels:
+
+    - **bytes-on-wire**: one 2-stage int8-codec training step with
+      dim-256 activations; ratio = logical (decoded) send bytes /
+      encoded wire bytes, both straight off the channel counters
+      (``tony_channel_bytes_total`` vs the codec-only
+      ``tony_channel_compressed_bytes_total``). The header is a fixed
+      ~100B JSON cost per frame, so the ratio approaches the dtype
+      ratio (4x for f32→int8) as tensors grow — at dim 256 it sits
+      ~3.9x, tier-1-pinned >= 1.9x.
+    - **interleaved vs flat wall**: the SAME 4-block model placed two
+      ways across 2 gangs under ``one_way_s`` injected latency
+      (LatencyProxy) and fixed per-block compute floors. Flat: gang s
+      runs blocks 2s,2s+1 as one stage (one virtual stage per gang,
+      in-flight = S). Interleaved (v=2): gang s runs blocks s, s+2 as
+      two chunks (looping placement, in-flight = S*v). Little's law:
+      steady per-mb rate ≈ max(per-gang compute, cycle/in-flight)
+      while latency-bound, where cycle = total compute C + hop
+      latencies — flat (0.24+2h)/2 = 0.17 s/mb vs interleaved
+      (0.24+6h)/4 = 0.135 s/mb at h = 0.05. C sits a notch below the
+      6h crossover so the interleaved rate keeps slack over its 0.12
+      s/mb compute floor (thread-scheduling overhead lands in that
+      slack, not on the wall); the interleaved fill is ~0.3s longer
+      (3 act hops vs 1). Each placement is timed at TWO microbatch
+      counts (M and M/3): the marginal rate (wall_big - wall_small)
+      / (M - M/3) cancels the fill term exactly, giving the
+      steady-state per-mb wall — measured ~1.13x flat/interleaved,
+      and stable under load because host jitter inflates both
+      placements' rates together. The absolute M-microbatch walls are
+      also reported (measured ~1.03-1.07x, fill drag included).
+      Losses agree across modes (allclose, not bit-equal: jit
+      granularity differs; the BIT pin lives in tests against the
+      in-slice V-stage schedule).
+
+    Emits ``pipeline_bytes_on_wire_vs_raw``,
+    ``pipeline_interleaved_vs_flat_steady_rate`` and
+    ``pipeline_interleaved_vs_flat_wall`` (all tier-1-pinned)."""
+    import threading
+
+    import numpy as np
+
+    from tony_tpu.channels import open_local_pipeline
+    from tony_tpu.parallel.pipeline import CrossSlicePipeline
+    from tony_tpu.runtime import metrics as M
+    from tony_tpu.serving.netem import LatencyProxy
+
+    rs = np.random.RandomState(11)
+    m = num_microbatches
+
+    def block_fn(p, x):
+        return x + jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_head(hp, out, tgt):
+        return jnp.mean((out @ hp["wo"] - tgt) ** 2)
+
+    def mk_block(d):
+        return {"w": jnp.asarray(rs.randn(d, d).astype(np.float32) * 0.3),
+                "b": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+
+    # -- sub-arm 1: bytes on the wire under int8 ------------------------
+    def run_bytes():
+        reg = M.MetricsRegistry()
+        links = open_local_pipeline(2, window=window, registry=reg,
+                                    compression="int8")
+        blocks = [mk_block(bytes_dim) for _ in range(2)]
+        head = {"wo": jnp.asarray(
+            rs.randn(bytes_dim, bytes_dim).astype(np.float32) * 0.2)}
+        xs = jnp.asarray(
+            rs.randn(4, bytes_rows, bytes_dim).astype(np.float32))
+        tgts = jnp.asarray(
+            rs.randn(4, bytes_rows, bytes_dim).astype(np.float32))
+        res: dict = {}
+        try:
+            pls = [CrossSlicePipeline(block_fn, links[0], registry=reg),
+                   CrossSlicePipeline(block_fn, links[1],
+                                      loss_head=loss_head, registry=reg)]
+            ts = [threading.Thread(target=lambda: res.update(
+                      a=pls[0].value_and_grad(blocks[0],
+                                              num_microbatches=4,
+                                              microbatches=xs))),
+                  threading.Thread(target=lambda: res.update(
+                      b=pls[1].value_and_grad(blocks[1],
+                                              num_microbatches=4,
+                                              head_params=head,
+                                              head_batches=tgts)))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert "a" in res and "b" in res
+        finally:
+            for link in links:
+                link.close()
+        wire = reg.to_wire()
+        logical = sum(v for n, lb, v in wire["c"]
+                      if n == "tony_channel_bytes_total"
+                      and lb.get("direction") == "send")
+        encoded = sum(v for n, lb, v in wire["c"]
+                      if n == "tony_channel_compressed_bytes_total"
+                      and lb.get("direction") == "send")
+        # the codec-only series must be VISIBLE on the metrics plane
+        assert encoded > 0, "tony_channel_compressed_bytes_total missing"
+        return logical / encoded
+
+    bytes_ratio = run_bytes()
+
+    # -- sub-arm 2: interleaved (v=2) vs flat placement under latency ---
+    blocks = [mk_block(dim) for _ in range(4)]
+    head = {"wo": jnp.asarray(rs.randn(dim, dim).astype(np.float32) * 0.2)}
+    xs = jnp.asarray(rs.randn(m, mb_rows, dim).astype(np.float32))
+    tgts = jnp.asarray(rs.randn(m, mb_rows, dim).astype(np.float32))
+
+    def make_floor(fwd_s, bwd_s):
+        class FloorPipeline(CrossSlicePipeline):
+            def _forward_compute(self, params, x):
+                out = super()._forward_compute(params, x)
+                jax.block_until_ready(out)
+                time.sleep(fwd_s)
+                return out
+
+            def _backward_compute(self, params, saved, cot):
+                out = super()._backward_compute(params, saved, cot)
+                jax.block_until_ready(out)
+                time.sleep(bwd_s)
+                return out
+
+            def _last_compute(self, params, head_params, saved, head_mb):
+                out = super()._last_compute(params, head_params, saved,
+                                            head_mb)
+                jax.block_until_ready(out)
+                time.sleep(fwd_s + bwd_s)
+                return out
+        return FloorPipeline
+
+    def run_placement(interleave: int):
+        reg = M.MetricsRegistry()
+        proxies: list[LatencyProxy] = []
+
+        def endpoint_map(stage_idx: int, port: int) -> str:
+            proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+            proxies.append(proxy)
+            return f"127.0.0.1:{proxy.start()}"
+
+        links = open_local_pipeline(2, window=window, capacity=window,
+                                    interleave=interleave, registry=reg,
+                                    endpoint_map=endpoint_map)
+        if interleave == 1:
+            # flat: gang s runs blocks 2s,2s+1 fused as ONE stage — its
+            # per-mb floor is both blocks' compute
+            def stage_fn(p, x):
+                return block_fn(p["hi"], block_fn(p["lo"], x))
+            Floor = make_floor(2 * fwd_floor_s, 2 * bwd_floor_s)
+            gang_params = [{"lo": blocks[0], "hi": blocks[1]},
+                           {"lo": blocks[2], "hi": blocks[3]}]
+        else:
+            # looping placement: gang s chunk j = block j*2+s
+            stage_fn = block_fn
+            Floor = make_floor(fwd_floor_s, bwd_floor_s)
+            gang_params = [[blocks[0], blocks[2]],
+                           [blocks[1], blocks[3]]]
+        res: dict = {}
+        try:
+            pls = [Floor(stage_fn, links[0], registry=reg),
+                   Floor(stage_fn, links[1], loss_head=loss_head,
+                         registry=reg)]
+
+            def one_round(m_run: int) -> float:
+                def run0():
+                    res[0] = pls[0].value_and_grad(
+                        gang_params[0], num_microbatches=m_run,
+                        microbatches=xs[:m_run])
+
+                def run1():
+                    res[1] = pls[1].value_and_grad(
+                        gang_params[1], num_microbatches=m_run,
+                        head_params=head, head_batches=jax.tree.map(
+                            lambda a: a[:m_run], tgts))
+                ts = [threading.Thread(target=run0),
+                      threading.Thread(target=run1)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join(timeout=120)
+                return time.perf_counter() - t0
+
+            one_round(4)                    # compile + connect warmup
+            wall_small = one_round(m_small)
+            wall_big = one_round(m)
+            return wall_small, wall_big, res
+        finally:
+            for link in links:
+                link.close()
+            for proxy in proxies:
+                proxy.stop()
+
+    m_small = max(4, m // 3)
+    fl_small, fl_big, res_flat = run_placement(1)
+    il_small, il_big, res_il = run_placement(2)
+    # same model, two placements: the schedule moves walls, not math
+    # (allclose, not bit-equal — flat jits two blocks per stage program)
+    np.testing.assert_allclose(np.asarray(res_flat[1][0]),
+                               np.asarray(res_il[1][0]),
+                               rtol=1e-5, atol=1e-6)
+    # steady-state per-microbatch wall: the two-point marginal rate
+    # (wall_big - wall_small)/(m - m_small) cancels the pipeline fill —
+    # the interleaved fill is ~3x longer (3 act hops vs 1), so the
+    # absolute-wall ratio understates the throughput gap and converges
+    # to the rate ratio only as M grows
+    rate_flat = (fl_big - fl_small) / (m - m_small)
+    rate_il = (il_big - il_small) / (m - m_small)
+    return {
+        "pipeline_bytes_on_wire_vs_raw": round(bytes_ratio, 2),
+        "pipeline_flat_wall_s": round(fl_big, 3),
+        "pipeline_interleaved_wall_s": round(il_big, 3),
+        # the second tentpole ratio: latency hidden by v=2's doubled
+        # in-flight, fill excluded (steady-state rates)...
+        "pipeline_interleaved_vs_flat_steady_rate":
+            round(rate_flat / rate_il, 2),
+        # ...and the end-to-end wall at M microbatches, fill included
+        "pipeline_interleaved_vs_flat_wall": round(fl_big / il_big, 2),
     }
 
 

@@ -37,7 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tony_tpu.channels import open_stage_links_from_env
+from tony_tpu.channels import open_stage_links, stage_env
 from tony_tpu.models.loop import run_training
 from tony_tpu.parallel.pipeline import CrossSlicePipeline
 
@@ -92,18 +92,36 @@ def main(argv=None) -> int:
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--window", type=int, default=8)
+    ap.add_argument("--interleave", type=int, default=0,
+                    help="virtual stages per gang (0 = take "
+                    "TONY_PIPELINE_INTERLEAVE from the coordinator)")
+    ap.add_argument("--channel_compression", default="",
+                    choices=("", "none", "bf16", "int8"),
+                    help="wire codec for the tensor channels ('' = take "
+                    "TONY_CHANNEL_COMPRESSION from the coordinator; all "
+                    "stage gangs must pass the same value)")
     ap.add_argument("--out", default="", help="npz with losses + final "
                     "params (filename gains a -stage<k> suffix)")
     args = ap.parse_args(argv)
 
-    links = open_stage_links_from_env(window=args.window)
-    if links is None:
+    env = stage_env()
+    if env is None:
         print("train_pipeline.py must run as a pipeline stage "
               "(tony.pipeline.stages): no TONY_PIPELINE_STAGE in env",
               file=sys.stderr)
         return 2
+    if args.interleave > 0:
+        env["interleave"] = args.interleave
+    if args.channel_compression:
+        env["compression"] = args.channel_compression
+    links = open_stage_links(window=args.window, **env)
     m, mb, dim = args.microbatches, args.mb_rows, args.dim
-    params = init_stage_params(links.stage, dim, args.seed)
+    v = links.interleave
+    # chunk j's block is VIRTUAL stage j*S + s of the model — the same
+    # seeding the in-slice reference uses for its stacked stage axis
+    params = init_stage_params(links.stage, dim, args.seed) if v == 1 \
+        else [init_stage_params(links.global_stage(j), dim, args.seed)
+              for j in range(v)]
     head = init_head_params(dim, args.seed) if links.is_last else None
     pipe = CrossSlicePipeline(stage_fn, links,
                               loss_head=loss_head if links.is_last
@@ -146,9 +164,16 @@ def main(argv=None) -> int:
     finally:
         links.close()
     if args.out:
-        out = {f"p_{k}": np.asarray(v) for k, v in params.items()}
+        if v == 1:
+            out = {f"p_{k}": np.asarray(a) for k, a in params.items()}
+        else:
+            # per-chunk params keyed by chunk index (chunk j = virtual
+            # stage j*S + s)
+            out = {f"p{j}_{k}": np.asarray(a)
+                   for j, chunk in enumerate(params)
+                   for k, a in chunk.items()}
         if links.is_last:
-            out.update({f"h_{k}": np.asarray(v) for k, v in head.items()})
+            out.update({f"h_{k}": np.asarray(a) for k, a in head.items()})
             out["losses"] = np.asarray(losses, np.float32)
         np.savez(f"{args.out}-stage{links.stage}.npz", **out)
     return 0

@@ -113,6 +113,24 @@ def retrace_guard():
     yield Guard()
 
 
+@pytest.fixture(autouse=True)
+def _forbid_codecs_in_exact_tests(request):
+    """Bit-exactness tripwire: tests marked ``exact`` pin bit-identical
+    numerics, where a stray quantized tensor channel would surface as an
+    unexplainable flake. Arm the channel layer's guard for their
+    duration — constructing any non-"none" codec sender/receiver then
+    raises RuntimeError at the construction site instead."""
+    if request.node.get_closest_marker("exact") is None:
+        yield
+        return
+    from tony_tpu.channels import channel
+    channel.forbid_codecs(True)
+    try:
+        yield
+    finally:
+        channel.forbid_codecs(False)
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Reset XLA's in-process compilation caches after each test module.

@@ -10,21 +10,23 @@ endpoint discovery. See ``docs/pipeline.md`` and docs/serving.md
 §Disaggregated prefill/decode.
 """
 
-from tony_tpu.channels.channel import (ChannelClosed, ChannelError,
+from tony_tpu.channels.channel import (CODECS, ChannelClosed, ChannelError,
                                        ChannelHub, ChannelReceiver,
                                        ChannelSender, decode_tensor,
-                                       encode_tensor)
+                                       encode_tensor, forbid_codecs)
 from tony_tpu.channels.registry import (ACT_CHANNEL, GRAD_CHANNEL,
-                                        StageLinks, build_channel_specs,
+                                        StageLinks, act_channel,
+                                        build_channel_specs, grad_channel,
                                         open_local_pipeline,
                                         open_stage_links,
                                         open_stage_links_from_env,
                                         parse_channel_spec, stage_env)
 
 __all__ = [
-    "ChannelClosed", "ChannelError", "ChannelHub", "ChannelReceiver",
-    "ChannelSender",
-    "decode_tensor", "encode_tensor", "ACT_CHANNEL", "GRAD_CHANNEL",
+    "CODECS", "ChannelClosed", "ChannelError", "ChannelHub",
+    "ChannelReceiver", "ChannelSender",
+    "decode_tensor", "encode_tensor", "forbid_codecs",
+    "ACT_CHANNEL", "GRAD_CHANNEL", "act_channel", "grad_channel",
     "StageLinks", "build_channel_specs", "open_local_pipeline",
     "open_stage_links", "open_stage_links_from_env", "parse_channel_spec",
     "stage_env",

@@ -40,6 +40,20 @@ CH_ERROR  r -> s       JSON ``{"message": str}`` — the receiver is
                        reconnects and resumes
 ======== ============ =========================================
 
+Wire compression (``codec`` = ``"bf16"`` | ``"int8"``): a channel may
+negotiate an on-the-wire codec at the handshake — the sender's HELLO
+carries ``"codec"`` and the hub refuses (CH_ERROR, permanent: the
+sender raises ChannelError instead of retrying into garbled math) when
+it disagrees with what the consumer declared. Tensor headers on a codec
+channel are KIND-TAGGED with the same ``"codec"`` field, so a
+compressed frame on a raw channel — or a raw frame on a codec channel —
+is a ProtocolError at decode, never a silently misread buffer (the
+``TEMPLATE_KIND`` discipline of serving/kvship.py). f32/bf16 payloads
+ship as bf16 halves or int8+per-tensor-scale (~quarter of f32); every
+other dtype passes through raw under the tag. The codec runs BEFORE the
+send window, so the resend buffer holds only the encoded bytes — window
+host memory shrinks with the wire.
+
 Reliability/backpressure contract:
 
 - **Bounded send window**: at most ``window`` unacked TENSOR frames in
@@ -113,46 +127,172 @@ class ChannelClosed(ChannelError):
     loop can exit instead of hot-spinning on instant failures."""
 
 
-def encode_tensor(arr: np.ndarray) -> tuple[bytes, bytes]:
-    """-> (tensor header bytes, raw payload bytes). The raw buffer is
-    ``tobytes()`` of the C-contiguous array — one copy, retained for
-    resend-after-reconnect (window × tensor size of host memory)."""
+#: valid per-channel wire codecs (tony.channel.compression values).
+CODECS = ("none", "bf16", "int8")
+
+#: dtypes a codec actually compresses; everything else passes through
+#: raw under the codec kind-tag (ints/bools must stay exact).
+_COMPRESSIBLE = ("float32", "bfloat16")
+
+_SCALE = struct.Struct("<f")    # int8 per-tensor scale, payload prefix
+
+#: exactness-guard flag (see :func:`forbid_codecs`).
+_CODECS_FORBIDDEN = False
+
+
+def forbid_codecs(on: bool) -> None:
+    """Arm (or disarm) the bit-exactness guard: while armed, building a
+    sender or receiver with a non-"none" codec raises RuntimeError. The
+    test harness arms this inside bit-identity-pinned tests (pytest
+    marker ``exact``), so a stray quantized channel in an exactness pin
+    fails loudly instead of flaking the comparison."""
+    global _CODECS_FORBIDDEN
+    _CODECS_FORBIDDEN = on
+
+
+def _check_codec(codec: str, what: str) -> str:
+    if codec not in CODECS:
+        raise ValueError(f"unknown channel codec {codec!r} for {what}; "
+                         f"expected one of {CODECS}")
+    if codec != "none" and _CODECS_FORBIDDEN:
+        raise RuntimeError(
+            f"quantized channel codec {codec!r} constructed for {what} "
+            f"inside a bit-exactness-pinned context (channels."
+            f"forbid_codecs) — exactness tests must run uncompressed")
+    return codec
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype-by-name with the ml_dtypes fallback for bfloat16 (numpy
+    alone cannot name it; ml_dtypes rides in with jax) — the same
+    resolution kvship uses for shipped KV buffers."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        try:
+            import ml_dtypes
+            return np.dtype(getattr(ml_dtypes, name))
+        except (ImportError, AttributeError) as e:
+            raise ProtocolError(f"unknown TENSOR dtype {name!r}") from e
+
+
+def _bf16_dtype() -> np.dtype:
+    import ml_dtypes
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def encode_tensor(arr: np.ndarray, codec: str = "none") \
+        -> tuple[bytes, bytes]:
+    """-> (tensor header bytes, encoded payload bytes). The payload is
+    what the wire carries AND what the sender's resend window retains —
+    the codec runs here, before windowing, so a compressed channel's
+    window holds the small encoded buffer, never the f32 original.
+
+    codec "none" keeps the original wire format (header
+    ``{"dtype", "shape"}``, raw C-contiguous bytes). A real codec
+    kind-tags the header with ``"codec"`` plus the on-wire layout
+    (``"wire"``: "bf16" / "int8" / "raw" passthrough) while ``"dtype"``
+    stays the ORIGINAL dtype the receiver must restore."""
     arr = np.asarray(arr)
     # shape captured FIRST: ascontiguousarray promotes 0-d to 1-d
     shape = list(arr.shape)
     if not arr.flags["C_CONTIGUOUS"]:
         arr = np.ascontiguousarray(arr)
-    head = pack_json({"dtype": str(arr.dtype), "shape": shape})
-    return _HLEN.pack(len(head)) + head, arr.tobytes()
+    if codec == "none":
+        head = pack_json({"dtype": str(arr.dtype), "shape": shape})
+        return _HLEN.pack(len(head)) + head, arr.tobytes()
+    if codec == "bf16":
+        if str(arr.dtype) in _COMPRESSIBLE:
+            wire, raw = "bf16", \
+                np.ascontiguousarray(arr.astype(_bf16_dtype())).tobytes()
+        else:
+            wire, raw = "raw", arr.tobytes()
+    elif codec == "int8":
+        if str(arr.dtype) in _COMPRESSIBLE:
+            a = arr.astype(np.float32, copy=False)
+            amax = float(np.max(np.abs(a))) if a.size else 0.0
+            scale = amax / 127.0 if amax > 0.0 else 1.0
+            q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+            wire, raw = "int8", _SCALE.pack(scale) + q.tobytes()
+        else:
+            wire, raw = "raw", arr.tobytes()
+    else:
+        raise ValueError(f"unknown channel codec {codec!r}")
+    head = pack_json({"codec": codec, "wire": wire,
+                      "dtype": str(arr.dtype), "shape": shape})
+    return _HLEN.pack(len(head)) + head, raw
 
 
-def decode_tensor(payload: bytes) -> np.ndarray:
-    """Parse a CH_TENSOR payload back into an ndarray. Anything
-    structurally off is a ProtocolError (channel-scoped)."""
+def decode_tensor(payload: bytes, codec: str = "none") -> np.ndarray:
+    """Parse a CH_TENSOR payload back into an ndarray under the
+    channel's negotiated ``codec``. Anything structurally off is a
+    ProtocolError (channel-scoped) — including a KIND-TAG mismatch: a
+    compressed frame on a raw channel, a raw frame on a codec channel,
+    or a frame tagged with a different codec than negotiated can never
+    silently misread each other's bytes."""
     if len(payload) < _HLEN.size:
         raise ProtocolError("TENSOR frame shorter than its header prefix")
     (hlen,) = _HLEN.unpack_from(payload, 0)
     if _HLEN.size + hlen > len(payload):
         raise ProtocolError(f"TENSOR header length {hlen} exceeds frame")
     head = unpack_json(payload[_HLEN.size:_HLEN.size + hlen])
+    tag = head.get("codec")
+    if codec == "none":
+        if tag is not None:
+            raise ProtocolError(
+                f"compressed frame (codec {tag!r}) on a raw channel")
+    elif tag != codec:
+        raise ProtocolError(
+            f"frame kind-tag {tag!r} on a channel negotiated for "
+            f"codec {codec!r}"
+            + (" (raw frame on a codec channel)" if tag is None else ""))
     shape = head.get("shape")
     dtype = head.get("dtype")
     if not isinstance(shape, list) or not all(
             isinstance(d, int) and not isinstance(d, bool) and d >= 0
             for d in shape) or not isinstance(dtype, str):
         raise ProtocolError(f"malformed TENSOR header: {head!r}")
-    try:
-        dt = np.dtype(dtype)
-    except TypeError as e:
-        raise ProtocolError(f"unknown TENSOR dtype {dtype!r}") from e
+    dt = _np_dtype(dtype)
     raw = payload[_HLEN.size + hlen:]
     # python-int math: np.prod wraps on adversarial shapes, letting a
     # bogus length claim past the check into a reshape crash
-    want = math.prod(shape) * dt.itemsize
+    count = math.prod(shape)
+    if codec == "none":
+        wire = "raw"
+    else:
+        wire = head.get("wire")
+        if wire not in ("raw", "bf16", "int8"):
+            raise ProtocolError(f"malformed TENSOR wire layout {wire!r}")
+        if wire != "raw" and dtype not in _COMPRESSIBLE:
+            raise ProtocolError(
+                f"codec wire {wire!r} cannot restore dtype {dtype!r}")
+    if wire == "raw":
+        want = count * dt.itemsize
+        if len(raw) != want:
+            raise ProtocolError(
+                f"TENSOR payload {len(raw)} bytes, header promises {want}")
+        return np.frombuffer(raw, dtype=dt).reshape(shape)
+    if wire == "bf16":
+        want = count * 2
+        if len(raw) != want:
+            raise ProtocolError(
+                f"bf16 payload {len(raw)} bytes, header promises {want}")
+        return np.frombuffer(raw, dtype=_bf16_dtype()) \
+            .astype(dt).reshape(shape)
+    # wire == "int8": per-tensor f32 scale prefix + int8 values — a
+    # truncated scale (or a length off by even one value byte) must
+    # fail structurally, never decode shifted garbage
+    want = _SCALE.size + count
     if len(raw) != want:
         raise ProtocolError(
-            f"TENSOR payload {len(raw)} bytes, header promises {want}")
-    return np.frombuffer(raw, dtype=dt).reshape(shape)
+            f"int8 payload {len(raw)} bytes, header promises {want} "
+            f"(scale prefix + values)")
+    (scale,) = _SCALE.unpack_from(raw, 0)
+    if not math.isfinite(scale):
+        raise ProtocolError(f"non-finite int8 scale {scale!r}")
+    q = np.frombuffer(raw, dtype=np.int8, offset=_SCALE.size)
+    return (q.astype(np.float32) * np.float32(scale)) \
+        .astype(dt).reshape(shape)
 
 
 def _send_tensor_frame(sock: socket.socket, seq: int, head: bytes,
@@ -180,6 +320,7 @@ class ChannelSender:
     bench contrasts against)."""
 
     def __init__(self, address: str, channel: str, *, window: int = 8,
+                 codec: str = "none",
                  connect_timeout_s: float = 10.0, max_retries: int = 30,
                  backoff_s: float = 0.05, max_backoff_s: float = 2.0,
                  registry: metrics_mod.MetricsRegistry | None = None) -> None:
@@ -188,6 +329,7 @@ class ChannelSender:
         host, _, port = address.rpartition(":")
         self.address = (host, int(port))
         self.channel = channel
+        self.codec = _check_codec(codec, f"sender channel {channel!r}")
         self.window = window
         self.connect_timeout_s = connect_timeout_s
         self.max_retries = max_retries
@@ -218,8 +360,16 @@ class ChannelSender:
             channel=channel)
         self._bytes = reg.counter(
             "tony_channel_bytes_total",
-            help="tensor payload bytes moved", channel=channel,
+            help="logical (decoded) tensor bytes moved", channel=channel,
             direction="send")
+        #: wire bytes actually shipped on a codec channel (header +
+        #: encoded payload): bytes_total / compressed_bytes_total is the
+        #: live bytes-on-wire compression ratio. Only registered when a
+        #: codec is negotiated — raw channels keep their series set.
+        self._wire_bytes = None if self.codec == "none" else reg.counter(
+            "tony_channel_compressed_bytes_total",
+            help="encoded bytes on the wire (codec channels only)",
+            channel=channel, direction="send")
 
     # -- connection management ---------------------------------------------
     def _teardown_locked(self) -> None:
@@ -271,15 +421,35 @@ class ChannelSender:
             try:
                 set_nodelay(sock)
                 sock.sendall(CH_MAGIC)
-                send_frame(sock, CH_HELLO, 0,
-                           pack_json({"v": 1, "channel": self.channel}))
+                hello = {"v": 1, "channel": self.channel}
+                if self.codec != "none":    # wire-compat: raw peers
+                    hello["codec"] = self.codec     # omit the field
+                send_frame(sock, CH_HELLO, 0, pack_json(hello))
                 fr = recv_frame(sock)
+                if fr is not None and fr[0] == CH_ERROR:
+                    # an explicit handshake refusal (codec mismatch) is
+                    # PERMANENT: retrying would never converge, and
+                    # falling through to raw frames would garble math —
+                    # fail channel-scoped right here
+                    try:
+                        msg = unpack_json(fr[2]).get("message", "")
+                    except ProtocolError:
+                        msg = ""
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise ChannelError(
+                        f"channel {self.channel!r} handshake refused by "
+                        f"{self.address}: {msg}")
                 if fr is None or fr[0] != CH_HELLO:
                     raise ProtocolError("channel handshake refused")
                 resume = unpack_json(fr[2]).get("resume")
                 if not isinstance(resume, int) or resume < 0:
                     raise ProtocolError(f"bad resume seq {resume!r}")
                 sock.settimeout(None)
+            except ChannelError:
+                raise               # permanent refusal: not a retry case
             except (OSError, ProtocolError) as e:
                 last_err = e
                 try:
@@ -385,7 +555,12 @@ class ChannelSender:
         ``sync=True`` — until the peer acked this frame."""
         t0 = time.perf_counter()
         deadline = None if timeout is None else time.monotonic() + timeout
-        head, raw = encode_tensor(arr)
+        arr = np.asarray(arr)
+        logical_bytes = arr.nbytes
+        # encode BEFORE the window: _unacked retains only the encoded
+        # (post-codec) buffer, so resend-window host memory shrinks with
+        # the wire instead of pinning window × f32-tensor bytes
+        head, raw = encode_tensor(arr, self.codec)
         # mirrors frame_header's limit check exactly (incl. the frame's
         # own header bytes): an oversize frame must fail HERE, before a
         # seq exists — once in _unacked it would poison every reconnect
@@ -434,7 +609,9 @@ class ChannelSender:
             self._reconnect(deadline)
         if sync:
             self._wait(lambda: self._acked_through >= seq, timeout)
-        self._bytes.inc(len(raw))
+        self._bytes.inc(logical_bytes)
+        if self._wire_bytes is not None:
+            self._wire_bytes.inc(len(head) + len(raw))
         self._send_hist.observe(time.perf_counter() - t0)
         return seq
 
@@ -460,6 +637,13 @@ class ChannelSender:
         with self._cv:
             return len(self._unacked)
 
+    def window_bytes(self) -> int:
+        """Host bytes the resend window currently retains (encoded
+        header + payload per in-flight frame) — what a codec ≈ halves;
+        pinned by the window-memory test."""
+        with self._cv:
+            return sum(len(h) + len(r) for h, r in self._unacked.values())
+
     def close(self, drain: bool = True,
               timeout: float | None = 30.0) -> None:
         if drain and not self._closed:
@@ -482,6 +666,10 @@ class _RecvState:
 
     def __init__(self, capacity: int) -> None:
         self.capacity = capacity
+        #: the channel's negotiated wire codec: None until the consumer
+        #: (hub.receiver) or the first sender HELLO declares one; every
+        #: later declarer must MATCH or is refused channel-scoped.
+        self.codec: str | None = None
         self.next_seq = 0
         self.queue: deque[np.ndarray] = deque()
         self.cv = threading.Condition()
@@ -669,8 +857,17 @@ class ChannelHub:
             except OSError:
                 pass
 
-    def receiver(self, name: str) -> ChannelReceiver:
-        return ChannelReceiver(self, name, self._state_for(name))
+    def receiver(self, name: str, codec: str = "none") -> ChannelReceiver:
+        _check_codec(codec, f"receiver channel {name!r}")
+        state = self._state_for(name)
+        with self._states_lock:
+            if state.codec is None:
+                state.codec = codec
+            elif state.codec != codec:
+                raise ValueError(
+                    f"channel {name!r} already negotiated codec "
+                    f"{state.codec!r}, receiver asked for {codec!r}")
+        return ChannelReceiver(self, name, state)
 
     def _state_for(self, name: str) -> _RecvState:
         with self._states_lock:
@@ -728,14 +925,34 @@ class ChannelHub:
             name = hello.get("channel")
             if not isinstance(name, str) or not name:
                 raise ProtocolError(f"bad channel name {name!r}")
+            peer_codec = hello.get("codec", "none")
+            if peer_codec not in CODECS:
+                raise ProtocolError(f"unknown codec {peer_codec!r}")
         except ProtocolError:
             self._best_effort_error(sock, "malformed channel handshake")
             return
         state = self._state_for(name)
+        # codec negotiation, BEFORE this connection may preempt the
+        # active one: a mismatched dialer is refused channel-scoped
+        # (permanent CH_ERROR the sender surfaces as ChannelError) and
+        # must not cost the healthy connection its socket
+        with self._states_lock:
+            if state.codec is None:
+                state.codec = peer_codec
+            elif state.codec != peer_codec:
+                self._best_effort_error(
+                    sock, f"codec mismatch: channel {name!r} negotiated "
+                          f"{state.codec!r}, sender speaks {peer_codec!r}")
+                return
         recv_bytes = self._registry.counter(
             "tony_channel_bytes_total",
-            help="tensor payload bytes moved", channel=name,
+            help="logical (decoded) tensor bytes moved", channel=name,
             direction="recv")
+        wire_counter = None if state.codec == "none" \
+            else self._registry.counter(
+                "tony_channel_compressed_bytes_total",
+                help="encoded bytes on the wire (codec channels only)",
+                channel=name, direction="recv")
         # preempt the predecessor: shutting down its socket makes a
         # half-open connection's blocked read fail NOW, so conn_lock
         # frees instead of this handshake queueing behind a dead peer
@@ -757,10 +974,10 @@ class ChannelHub:
             with state.active_lock:
                 if state.active_sock is not sock:
                     return          # superseded while waiting our turn
-            self._deliver(sock, state, recv_bytes)
+            self._deliver(sock, state, recv_bytes, wire_counter)
 
     def _deliver(self, sock: socket.socket, state: _RecvState,
-                 recv_bytes) -> None:
+                 recv_bytes, wire_counter=None) -> None:
         """One connection's delivery loop, serialized per channel by
         ``state.conn_lock`` — the resume value below is only correct
         once no predecessor connection can still advance next_seq."""
@@ -797,7 +1014,7 @@ class ChannelHub:
                     sock, f"seq gap: got {seq}, expected {state.next_seq}")
                 return
             try:
-                arr = decode_tensor(payload)
+                arr = decode_tensor(payload, codec=state.codec or "none")
             except ProtocolError as e:
                 self._flight_incident(sock, str(e))
                 self._best_effort_error(sock, "undecodable tensor payload")
@@ -805,6 +1022,8 @@ class ChannelHub:
             if not state.put(arr):
                 return                      # hub stopping
             recv_bytes.inc(arr.nbytes)
+            if wire_counter is not None:
+                wire_counter.inc(len(payload))
             try:
                 send_frame(sock, CH_ACK, seq)
             except OSError:

@@ -29,17 +29,29 @@ import os
 from dataclasses import dataclass
 
 from tony_tpu import constants
-from tony_tpu.channels.channel import ChannelHub, ChannelReceiver, \
+from tony_tpu.channels.channel import CODECS, ChannelHub, ChannelReceiver, \
     ChannelSender
 
 #: channel names on a task's hub: activations flowing INTO this stage,
-#: cotangents flowing back INTO this stage.
+#: cotangents flowing back INTO this stage. With interleaving (v
+#: virtual stage chunks per gang) each chunk gets its own lane —
+#: ``act.1``, ``grad.2``, … — named by the CONSUMING chunk; chunk 0
+#: keeps the bare names, so a non-interleaved job's wire is unchanged.
 ACT_CHANNEL = "act"
 GRAD_CHANNEL = "grad"
 
 
-def build_channel_specs(stages: list[str],
-                        tasks_of) -> dict[str, dict]:
+def act_channel(chunk: int = 0) -> str:
+    return ACT_CHANNEL if chunk == 0 else f"{ACT_CHANNEL}.{chunk}"
+
+
+def grad_channel(chunk: int = 0) -> str:
+    return GRAD_CHANNEL if chunk == 0 else f"{GRAD_CHANNEL}.{chunk}"
+
+
+def build_channel_specs(stages: list[str], tasks_of, *,
+                        interleave: int = 1,
+                        compression: str = "none") -> dict[str, dict]:
     """task_id → channel-spec dict for every task of a pipeline job.
 
     ``stages``: job types in stage order. ``tasks_of(job_type)`` yields
@@ -47,6 +59,15 @@ def build_channel_specs(stages: list[str],
     in index order. A task that registered no channel port (0) gets no
     entry — its stage neighbors' specs then carry "" for that side, and
     the trainer fails fast rather than dialing port 0.
+
+    ``interleave`` > 1 (tony.pipeline.interleave) gives every gang that
+    many VIRTUAL stages and closes the stage chain into a ring: the last
+    gang's ``next`` becomes gang 0's hub (activations wrapping into the
+    next chunk) and gang 0's ``prev`` the last gang's (cotangents
+    wrapping back). ``compression`` (tony.channel.compression) rides
+    every spec so each gang opens its channels with the same codec.
+    Both fields are ADDITIVE on the wire: defaults are omitted, so old
+    executors parse new specs and vice versa.
     """
     per_stage: list[list[tuple[str, str, int]]] = [
         list(tasks_of(jt)) for jt in stages]
@@ -59,15 +80,23 @@ def build_channel_specs(stages: list[str],
                     return ""
                 _, h, p = stage_members[r]
                 return f"{h}:{p}" if p else ""
+            ring = interleave > 1
+            prev = _peer(per_stage[k - 1], rank) \
+                if (k > 0 or ring) else ""      # k-1 = -1 wraps the ring
+            nxt = _peer(per_stage[(k + 1) % s_count], rank) \
+                if (k < s_count - 1 or ring) else ""
             specs[task_id] = {
                 "stage": k,
                 "num_stages": s_count,
                 "rank": rank,
                 "ranks": len(members),
-                "prev": _peer(per_stage[k - 1], rank) if k > 0 else "",
-                "next": _peer(per_stage[k + 1], rank)
-                        if k < s_count - 1 else "",
+                "prev": prev,
+                "next": nxt,
             }
+            if interleave > 1:
+                specs[task_id]["interleave"] = interleave
+            if compression != "none":
+                specs[task_id]["compression"] = compression
     return specs
 
 
@@ -85,15 +114,31 @@ class StageLinks:
 
     Boundary stages hold ``None`` on the missing side. ``close`` drains
     senders (so the last microbatch's grads land) then stops the hub.
+
+    With ``interleave`` = v > 1 the gang holds v virtual stage CHUNKS
+    (global virtual stage of chunk j = ``j * num_stages + stage``, the
+    Megatron looping placement) and the per-chunk lanes live in the
+    ``act_ins`` / ``act_outs`` / ``grad_ins`` / ``grad_outs`` lists
+    (index = chunk, ``None`` at the model boundary); the scalar fields
+    stay chunk 0's lanes for the non-interleaved consumers. Stage
+    neighbors form a RING: every chunk's activations go out on ``next``
+    and cotangents on ``prev``, the lane NAME carrying the consuming
+    chunk (``act``, ``act.1``, …).
     """
     stage: int
     num_stages: int
     rank: int = 0
+    interleave: int = 1
+    compression: str = "none"
     hub: ChannelHub | None = None
     act_in: ChannelReceiver | None = None
     act_out: ChannelSender | None = None
     grad_in: ChannelReceiver | None = None
     grad_out: ChannelSender | None = None
+    act_ins: list = None
+    act_outs: list = None
+    grad_ins: list = None
+    grad_outs: list = None
 
     @property
     def is_first(self) -> bool:
@@ -103,45 +148,103 @@ class StageLinks:
     def is_last(self) -> bool:
         return self.stage == self.num_stages - 1
 
+    @property
+    def num_virtual(self) -> int:
+        return self.num_stages * self.interleave
+
+    def global_stage(self, chunk: int = 0) -> int:
+        """This gang's chunk ``chunk`` as a VIRTUAL stage index in
+        0..num_virtual-1 (looping placement)."""
+        return chunk * self.num_stages + self.stage
+
+    def _senders(self):
+        seen = []
+        for group in (self.act_outs or [self.act_out],
+                      self.grad_outs or [self.grad_out]):
+            for sender in group:
+                if sender is not None and sender not in seen:
+                    seen.append(sender)
+        return seen
+
     def close(self) -> None:
-        for sender in (self.act_out, self.grad_out):
-            if sender is not None:
-                sender.close(drain=True)
+        for sender in self._senders():
+            sender.close(drain=True)
         if self.hub is not None:
             self.hub.stop()
 
 
+def _wire_links(links: StageLinks, *, prev: str, next: str,
+                window: int, registry) -> StageLinks:
+    """Attach the per-chunk lanes (and the chunk-0 scalar mirrors) to a
+    StageLinks whose hub is already listening. The only topology rule:
+    chunk j of gang s is virtual stage g = j*S + s; activations for g+1
+    ride ``next`` (the ring successor gang) on the CONSUMING chunk's act
+    lane, cotangents for g-1 ride ``prev`` on the consuming chunk's grad
+    lane. For interleave=1 this reduces exactly to the historical
+    act/grad pair."""
+    s, S, v = links.stage, links.num_stages, links.interleave
+    V = links.num_virtual
+    codec = links.compression
+    hub = links.hub
+    links.act_ins, links.act_outs = [], []
+    links.grad_ins, links.grad_outs = [], []
+    for j in range(v):
+        g = j * S + s
+        links.act_ins.append(
+            hub.receiver(act_channel(j), codec=codec) if g > 0 else None)
+        links.grad_ins.append(
+            hub.receiver(grad_channel(j), codec=codec)
+            if g < V - 1 else None)
+        # consuming chunk on the ring successor/predecessor gang:
+        # same chunk when the hop stays inside the chain, next/previous
+        # chunk when it wraps past gang S-1 / gang 0
+        links.act_outs.append(
+            ChannelSender(next, act_channel(j if s < S - 1 else j + 1),
+                          window=window, codec=codec, registry=registry)
+            if g < V - 1 else None)
+        links.grad_outs.append(
+            ChannelSender(prev, grad_channel(j if s > 0 else j - 1),
+                          window=window, codec=codec, registry=registry)
+            if g > 0 else None)
+    links.act_in = links.act_ins[0]
+    links.act_out = links.act_outs[0]
+    links.grad_in = links.grad_ins[0]
+    links.grad_out = links.grad_outs[0]
+    return links
+
+
 def open_stage_links(*, stage: int, num_stages: int, rank: int = 0,
                      prev: str = "", next: str = "",
+                     interleave: int = 1, compression: str = "none",
                      hub_port: int = 0, window: int = 8,
                      capacity: int = 8, registry=None) -> StageLinks:
     """Stand up this task's hub and dial its neighbors. ``prev``/``next``
     are the neighbor hubs' ``host:port`` endpoints ("" at the pipeline
-    boundary). Senders dial lazily — a neighbor whose hub is still
-    coming up is absorbed by the sender's connect retry."""
+    boundary; with ``interleave`` > 1 the boundary gangs need them too —
+    the stages close into a ring). Senders dial lazily — a neighbor
+    whose hub is still coming up is absorbed by the sender's connect
+    retry."""
     if not 0 <= stage < num_stages:
         raise ValueError(f"stage {stage} outside 0..{num_stages - 1}")
-    if stage > 0 and not prev:
+    if interleave < 1:
+        raise ValueError(f"interleave must be >= 1, got {interleave}")
+    ring = interleave > 1
+    if (stage > 0 or ring) and not prev:
         raise ValueError(f"stage {stage} has no upstream channel endpoint")
-    if stage < num_stages - 1 and not next:
+    if (stage < num_stages - 1 or ring) and not next:
         raise ValueError(f"stage {stage} has no downstream channel endpoint")
     hub = ChannelHub(port=hub_port, capacity=capacity, registry=registry)
     hub.start()
     links = StageLinks(stage=stage, num_stages=num_stages, rank=rank,
+                       interleave=interleave, compression=compression,
                        hub=hub)
-    if stage > 0:
-        links.act_in = hub.receiver(ACT_CHANNEL)
-        links.grad_out = ChannelSender(prev, GRAD_CHANNEL, window=window,
-                                       registry=registry)
-    if stage < num_stages - 1:
-        links.grad_in = hub.receiver(GRAD_CHANNEL)
-        links.act_out = ChannelSender(next, ACT_CHANNEL, window=window,
-                                      registry=registry)
-    return links
+    return _wire_links(links, prev=prev, next=next, window=window,
+                       registry=registry)
 
 
 def open_local_pipeline(num_stages: int, *, window: int = 8,
-                        capacity: int = 8, registry=None,
+                        capacity: int = 8, interleave: int = 1,
+                        compression: str = "none", registry=None,
                         endpoint_map=None) -> list[StageLinks]:
     """Wire ``num_stages`` in-process stages over loopback — the bench
     and test harness for the cross-slice schedule (each "gang" is a
@@ -151,6 +254,7 @@ def open_local_pipeline(num_stages: int, *, window: int = 8,
     hubs = [ChannelHub(capacity=capacity, registry=registry)
             for _ in range(num_stages)]
     ports = [hub.start() for hub in hubs]
+    ring = interleave > 1
 
     def addr(k: int) -> str:
         if endpoint_map is not None:
@@ -159,16 +263,14 @@ def open_local_pipeline(num_stages: int, *, window: int = 8,
 
     links = []
     for k in range(num_stages):
-        link = StageLinks(stage=k, num_stages=num_stages, hub=hubs[k])
-        if k > 0:
-            link.act_in = hubs[k].receiver(ACT_CHANNEL)
-            link.grad_out = ChannelSender(addr(k - 1), GRAD_CHANNEL,
-                                          window=window, registry=registry)
-        if k < num_stages - 1:
-            link.grad_in = hubs[k].receiver(GRAD_CHANNEL)
-            link.act_out = ChannelSender(addr(k + 1), ACT_CHANNEL,
-                                         window=window, registry=registry)
-        links.append(link)
+        link = StageLinks(stage=k, num_stages=num_stages,
+                          interleave=interleave, compression=compression,
+                          hub=hubs[k])
+        prev = addr((k - 1) % num_stages) if (k > 0 or ring) else ""
+        nxt = addr((k + 1) % num_stages) \
+            if (k < num_stages - 1 or ring) else ""
+        links.append(_wire_links(link, prev=prev, next=nxt,
+                                 window=window, registry=registry))
     return links
 
 
@@ -185,6 +287,9 @@ def stage_env(environ=None) -> dict | None:
         "rank": int(env.get(constants.PIPELINE_RANK, "0")),
         "prev": env.get(constants.CHANNEL_PREV, ""),
         "next": env.get(constants.CHANNEL_NEXT, ""),
+        "interleave": int(env.get(constants.PIPELINE_INTERLEAVE, "1")
+                          or "1"),
+        "compression": env.get(constants.CHANNEL_COMPRESSION, "") or "none",
         "hub_port": int(env.get(constants.CHANNEL_PORT, "0")),
     }
 

@@ -428,6 +428,9 @@ class TaskExecutor:
             env[constants.CHANNEL_PORT] = str(self.channel_port)
             env[constants.CHANNEL_PREV] = ch.get("prev", "")
             env[constants.CHANNEL_NEXT] = ch.get("next", "")
+            env[constants.PIPELINE_INTERLEAVE] = str(ch.get("interleave", 1))
+            env[constants.CHANNEL_COMPRESSION] = ch.get("compression",
+                                                        "none")
         cluster = json.loads(self.bootstrap["cluster_spec"])
         # Multi-slice identity: which gang of the job type this host is in
         # (tony.{job}.slices > 1). Index order is slice-major (session.py).

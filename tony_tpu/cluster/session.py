@@ -124,6 +124,12 @@ class Session:
         #: gangs' tensor channels at every barrier release
         self.pipeline_stages: list[str] = conf.pipeline_stages() \
             if hasattr(conf, "pipeline_stages") else []
+        #: virtual stages per gang + wire codec, stamped into every
+        #: channel spec so stage trainers agree without coordination
+        self.pipeline_interleave: int = conf.pipeline_interleave() \
+            if hasattr(conf, "pipeline_interleave") else 1
+        self.channel_compression: str = conf.channel_compression() \
+            if hasattr(conf, "channel_compression") else "none"
         #: task_id → channel-spec dict, rebuilt at each barrier release
         #: (endpoints are only knowable once every stage task registered
         #: its hub port)
@@ -242,7 +248,9 @@ class Session:
                     continue
                 host = t.spec.rsplit(":", 1)[0] if t.spec else ""
                 yield t.task_id, host, t.channel_port
-        return build_channel_specs(self.pipeline_stages, tasks_of)
+        return build_channel_specs(self.pipeline_stages, tasks_of,
+                                   interleave=self.pipeline_interleave,
+                                   compression=self.channel_compression)
 
     def channel_spec_for(self, task_id: str) -> str:
         """This worker's channel-registry entry as wire JSON ("" when the

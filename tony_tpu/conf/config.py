@@ -277,11 +277,33 @@ class TonyConfig:
         when the job declares no cross-slice pipeline."""
         return self.get_list(K.PIPELINE_STAGES_KEY)
 
+    def pipeline_interleave(self) -> int:
+        """Virtual stages per gang (tony.pipeline.interleave); 1 = the
+        classic non-interleaved 1F1B schedule."""
+        v = self.get_int(K.PIPELINE_INTERLEAVE_KEY, 1)
+        if v < 1:
+            raise ValueError(
+                f"{K.PIPELINE_INTERLEAVE_KEY}={v} — interleave must be >= 1")
+        return v
+
+    def channel_compression(self) -> str:
+        """On-the-wire codec for inter-gang tensor channels
+        (tony.channel.compression): none, bf16, or int8."""
+        codec = (self.get(K.CHANNEL_COMPRESSION_KEY, "none") or "none").strip()
+        from ..channels.channel import CODECS
+        if codec not in CODECS:
+            raise ValueError(
+                f"{K.CHANNEL_COMPRESSION_KEY}={codec!r} — must be one of "
+                f"{CODECS}")
+        return codec
+
     def _validate_pipeline(self, requests: dict[str, TaskRequest]) -> None:
         """Fail at parse time when the stage declaration cannot wire up:
         every stage must be a declared job type, stages must be distinct,
         and adjacent stages need matching host counts (the channel
         registry pairs tasks rank-to-rank across stages)."""
+        self.pipeline_interleave()
+        self.channel_compression()
         stages = self.pipeline_stages()
         if not stages:
             return

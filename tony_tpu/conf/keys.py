@@ -115,6 +115,14 @@ ELASTIC_QUIESCE_KEY = "tony.elastic.quiesce-ms"
 # channel registry assigns at gang-barrier release. Empty = no pipeline.
 # ---------------------------------------------------------------------------
 PIPELINE_STAGES_KEY = "tony.pipeline.stages"
+# Virtual stages per gang (interleaved/looped 1F1B): chunk j on gang s is
+# virtual stage j*S+s, shrinking the pipeline bubble ~1/v at the cost of
+# ring channel traffic. 1 = classic non-interleaved schedule.
+PIPELINE_INTERLEAVE_KEY = "tony.pipeline.interleave"
+# On-the-wire codec for inter-gang tensor channels: "none" (raw bytes,
+# bit-exact), "bf16", or "int8" (per-tensor-scale quantization). Both
+# ends of every channel must agree — negotiated at the channel handshake.
+CHANNEL_COMPRESSION_KEY = "tony.channel.compression"
 
 # ---------------------------------------------------------------------------
 # Metrics plane ("tony.metrics.*" — the TaskMonitor/MetricsRpc analog):
@@ -281,6 +289,8 @@ DEFAULTS: dict[str, str] = {
     ELASTIC_REGROW_BACKOFF_KEY: "1000",
     ELASTIC_QUIESCE_KEY: "300",
     PIPELINE_STAGES_KEY: "",
+    PIPELINE_INTERLEAVE_KEY: "1",
+    CHANNEL_COMPRESSION_KEY: "none",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
     TRACE_SAMPLE_RATE_KEY: "1.0",
     TRACE_RING_KEY: "2048",
@@ -335,7 +345,7 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
                                 "launch", "elastic", "metrics", "pipeline",
-                                "trace", "router", "fleet"})
+                                "channel", "trace", "router", "fleet"})
 
 
 def instances_key(job_type: str) -> str:

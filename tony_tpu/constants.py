@@ -133,6 +133,8 @@ PIPELINE_RANK = "TONY_PIPELINE_RANK"              # rank within the stage
 CHANNEL_PORT = "TONY_CHANNEL_PORT"                # own hub's listen port
 CHANNEL_PREV = "TONY_CHANNEL_PREV"                # upstream peer hub host:port
 CHANNEL_NEXT = "TONY_CHANNEL_NEXT"                # downstream peer hub host:port
+PIPELINE_INTERLEAVE = "TONY_PIPELINE_INTERLEAVE"  # virtual stages per gang
+CHANNEL_COMPRESSION = "TONY_CHANNEL_COMPRESSION"  # wire codec (none/bf16/int8)
 
 # Data-feed handshake (replaces the reference's PY4J_GATEWAY_PORT,
 # Constants.java / TaskExecutor.java:87 — pure-Python executor needs no py4j).

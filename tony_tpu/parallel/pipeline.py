@@ -45,6 +45,7 @@ each device reads only its own stage's slice.
 from __future__ import annotations
 
 import functools
+import threading
 import time
 from typing import Any, Callable
 
@@ -586,6 +587,18 @@ class CrossSlicePipeline:
     stage, the 1F1B bound. ``with_aux`` stage functions are not
     supported cross-slice yet (MoE balance losses stay in-slice).
 
+    **Interleaved/looped 1F1B** (``links.interleave`` = v > 1): the gang
+    holds v model CHUNKS, chunk j acting as virtual stage ``j*S + s`` of
+    a V = S*v deep pipeline (the Megatron looping placement — every
+    chunk boundary crosses gangs, over the links' per-chunk ring lanes).
+    Each chunk runs its own projection of the V-stage 1F1B schedule in
+    its own host thread; a per-gang device lock keeps the compute
+    serialization honest, so the win is pure bubble shrink (~1/v) plus
+    more DCN transfers in flight per tick. ``value_and_grad`` then takes
+    ``params`` as a LIST of v per-chunk pytrees and returns grads the
+    same shape; chunk j's math is bit-identical to virtual stage
+    ``j*S+s`` of the non-interleaved V-stage schedule (test-pinned).
+
     Observability: per-call wall and bubble fraction land in the default
     registry (``tony_pipeline_step_seconds``,
     ``tony_pipeline_bubble_fraction{stage=}``), alongside the channels'
@@ -637,6 +650,12 @@ class CrossSlicePipeline:
         self.sync_transport = sync_transport
         self.send_timeout_s = send_timeout_s
         self.recv_timeout_s = recv_timeout_s
+        #: virtual stages per gang (looping placement); 1 = classic
+        self.interleave = getattr(links, "interleave", 1) or 1
+        self.num_virtual = self.num_stages * self.interleave
+        # one device per gang: chunk threads must not interleave their
+        # compute dispatches (the lock also keeps busy accounting honest)
+        self._device_lock = threading.Lock()
         if links.is_last and loss_head is None:
             raise ValueError("the last stage needs the loss head")
         self._fwd = jax.jit(stage_fn)
@@ -696,9 +715,19 @@ class CrossSlicePipeline:
         scalar) and ``head_grads`` are non-None only on the last stage,
         ``dxs`` ([M, mb, ...] input cotangents) only on stage 0;
         ``grads`` matches ``params`` everywhere.
+
+        With ``interleave`` = v > 1, ``params`` is a LIST of v per-chunk
+        pytrees and ``grads`` comes back the same shape; the loss head
+        fires on the LAST gang (its last chunk is virtual stage V-1) and
+        ``dxs`` on the first (its chunk 0 is virtual stage 0).
         """
         import numpy as np
 
+        if self.interleave > 1:
+            return self._value_and_grad_interleaved(
+                params, num_microbatches=num_microbatches,
+                microbatches=microbatches, head_params=head_params,
+                head_batches=head_batches)
         links = self.links
         m = num_microbatches
         if links.is_first:
@@ -837,3 +866,163 @@ class CrossSlicePipeline:
                     span_id=root_sid, step=self._calls,
                     num_stages=self.num_stages, microbatches=m)
         return loss, grads, hgrads, dxs
+
+    def _value_and_grad_interleaved(self, params_list, *,
+                                    num_microbatches: int,
+                                    microbatches=None,
+                                    head_params=None, head_batches=None):
+        """The interleaved schedule: chunk j is virtual stage
+        ``g = j*S + s`` of the V-stage pipeline, driven by its own host
+        thread running exactly the per-stage projection of the V-stage
+        non-interleaved 1F1B schedule (warmup ``min(V-1-g+lookahead, m)``
+        forwards, then F/B pairs). Recvs block on the per-chunk lanes, so
+        global ordering emerges from dataflow — no cross-gang clock.
+        Per-chunk grads accumulate in microbatch order, which is what
+        makes chunk j bit-identical to stacked stage g of the in-slice
+        V-stage schedule."""
+        import numpy as np
+
+        links = self.links
+        v, S, V = self.interleave, self.num_stages, self.num_virtual
+        m = num_microbatches
+        if not isinstance(params_list, (list, tuple)) or \
+                len(params_list) != v:
+            raise ValueError(
+                f"interleave={v}: params must be a list/tuple of {v} "
+                f"per-chunk pytrees")
+        if links.is_first:
+            if microbatches is None:
+                raise ValueError("stage 0 must supply microbatches")
+            if microbatches.shape[0] != m:
+                raise ValueError(
+                    f"microbatches leading dim {microbatches.shape[0]} "
+                    f"!= num_microbatches {m}")
+        if links.is_last and (head_batches is None or head_params is None):
+            raise ValueError("the last stage must supply head_params and "
+                             "head_batches")
+        self._calls += 1
+        step_tid = self._tracing.deterministic_trace_id(
+            f"{self._trace_seed}:step:{self._calls}")
+        root_sid = self._tracing.deterministic_span_id(f"{step_tid}:root")
+        stage_sid = self._tracing.deterministic_span_id(
+            f"{step_tid}:s{self.stage}")
+        traced = (self._tracer.enabled
+                  and self._tracing.deterministic_sample(
+                      step_tid, self._tracer.sample_rate))
+        t_start = time.perf_counter()
+        busy = [0.0] * v
+        results: list = [None] * v
+        failures: list = []
+
+        def _send(sender, arr):
+            return sender.send(np.asarray(arr), sync=self.sync_transport,
+                               timeout=self.send_timeout_s)
+
+        def run_chunk(j: int) -> None:
+            g = j * S + self.stage
+            params = params_list[j]
+            act_in = links.act_ins[j]
+            act_out = links.act_outs[j]
+            grad_in = links.grad_ins[j]
+            grad_out = links.grad_outs[j]
+            saved: dict[int, jax.Array] = {}
+            grads = jax.tree.map(jnp.zeros_like, params)
+            hgrads = (jax.tree.map(jnp.zeros_like, head_params)
+                      if g == V - 1 else None)
+            loss_acc = jnp.zeros((), jnp.float32) if g == V - 1 else None
+            dx_list: list[jax.Array] = []
+
+            def do_forward(i: int) -> None:
+                if g == 0:
+                    x = microbatches[i]
+                else:
+                    x = jnp.asarray(act_in.recv(self.recv_timeout_s))
+                saved[i] = x
+                if g == V - 1:
+                    return      # last virtual stage folds fwd into _last
+                with self._device_lock:
+                    t0 = time.perf_counter()
+                    out = self._forward_compute(params, x)
+                    out_host = np.asarray(out)
+                    busy[j] += time.perf_counter() - t0
+                _send(act_out, out_host)
+
+            def do_backward(i: int) -> None:
+                nonlocal grads, hgrads, loss_acc
+                if g == V - 1:
+                    head_mb = jax.tree.map(lambda a: a[i], head_batches)
+                    with self._device_lock:
+                        t0 = time.perf_counter()
+                        lval, dp, dhp, dx = self._last_compute(
+                            params, head_params, saved.pop(i), head_mb)
+                        loss_acc = loss_acc + lval
+                        grads = jax.tree.map(jnp.add, grads, dp)
+                        hgrads = jax.tree.map(jnp.add, hgrads, dhp)
+                        dx_host = np.asarray(dx)
+                        busy[j] += time.perf_counter() - t0
+                else:
+                    cot = jnp.asarray(grad_in.recv(self.recv_timeout_s))
+                    with self._device_lock:
+                        t0 = time.perf_counter()
+                        dp, dx = self._backward_compute(
+                            params, saved.pop(i), cot)
+                        grads = jax.tree.map(jnp.add, grads, dp)
+                        dx_host = np.asarray(dx)
+                        busy[j] += time.perf_counter() - t0
+                if g == 0:
+                    dx_list.append(jnp.asarray(dx_host))
+                else:
+                    _send(grad_out, dx_host)
+                self._mb_counter.inc()
+
+            warmup = min(V - 1 - g + self.lookahead, m)
+            for i in range(warmup):
+                do_forward(i)
+            for i in range(m):
+                k = i + warmup
+                if k < m:
+                    do_forward(k)
+                do_backward(i)
+            results[j] = (grads, loss_acc, hgrads, dx_list)
+
+        def chunk_main(j: int) -> None:
+            try:
+                run_chunk(j)
+            except BaseException as exc:   # propagated after join
+                failures.append((j, exc))
+
+        threads = [threading.Thread(target=chunk_main, args=(j,),
+                                    name=f"pp-chunk{j}", daemon=True)
+                   for j in range(v)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if failures:
+            j, exc = failures[0]
+            raise RuntimeError(
+                f"interleaved chunk {j} (virtual stage "
+                f"{j * S + self.stage}) failed") from exc
+
+        grads_out = [jax.tree.map(lambda a: a / m, r[0]) for r in results]
+        loss = hgrads = dxs = None
+        if links.is_last:
+            loss = results[v - 1][1] / m
+            hgrads = jax.tree.map(lambda a: a / m, results[v - 1][2])
+        if links.is_first:
+            dxs = jnp.stack(results[0][3]) / m
+        wall = time.perf_counter() - t_start
+        self._step_hist.observe(wall)
+        bubble = max(0.0, 1.0 - sum(busy) / wall) if wall > 0 else 0.0
+        self._bubble_gauge.set(bubble)
+        if traced:
+            self._tracer.record_span(
+                "pipeline.stage", wall, trace_id=step_tid,
+                span_id=stage_sid, parent_id=root_sid, stage=self.stage,
+                microbatches=m, interleave=v, bubble=round(bubble, 4))
+            if links.is_first:
+                self._tracer.record_span(
+                    "pipeline.step", wall, trace_id=step_tid,
+                    span_id=root_sid, step=self._calls,
+                    num_stages=self.num_stages, microbatches=m)
+        return loss, grads_out, hgrads, dxs
