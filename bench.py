@@ -276,6 +276,15 @@ def main() -> None:
     # detection, resync, relaunch — not model compile walls.
     out.update(_elastic_arm())
 
+    # coordinator crash recovery: SIGKILL the coordinator mid-train and
+    # let journal replay re-adopt the live executors (user processes
+    # never stop, zero re-provisions) vs the cold full-job restart the
+    # journal-less stack pays — resubmit, re-provision, re-run every
+    # step since the last checkpoint. Hardware-free and jax-free; the
+    # recovery number is the coordinator's own recovery-wall gauge and
+    # the ratio is pinned >= 3x (tests/test_recovery.py runs the arm).
+    out.update(_recovery_arm())
+
     # streaming serving data plane: the persistent token-push wire vs a
     # request/response round trip per chunk, through an injected-latency
     # transport (LatencyProxy). Deterministic: a tiny CPU model with a
@@ -669,6 +678,143 @@ def _elastic_arm(steps: int = 16, step_wait: float = 0.15,
         # goodput retained for the identical injected kill
         "elastic_goodput_vs_restart": round(
             (el["unique"] / el["wall"]) / (rs["unique"] / rs["wall"]), 2),
+    }
+
+
+def _recovery_arm(steps: int = 36, step_wait: float = 0.25,
+                  kill_at: int = 4, ckpt_every: int = 2) -> dict:
+    """Coordinator crash recovery (journal re-adoption) vs the cold
+    full-job restart the journal-less stack pays for the SAME loss.
+
+    Two local-backend jobs (2 workers) run the jax-free fake trainer
+    (tests/fixtures/fake_elastic_trainer.py):
+
+    - **recover**: the chaos path — worker 0 touches a marker at
+      ``kill_at``, the backend SIGKILLs the coordinator mid-train, the
+      client relaunches it (tony.am.retry-count) on the same job dir,
+      and journal replay re-adopts the still-running executors: the
+      user processes never stop, zero steps replay, and the headline
+      number is the coordinator's own
+      ``tony_coordinator_recovery_seconds`` gauge (restart → last
+      adopted executor re-attached), read back from the final
+      ``METRICS_SNAPSHOT``;
+    - **cold**: the pre-journal behavior for the identical loss — the
+      whole job is resubmitted and re-runs from the last committed
+      checkpoint (a fresh job dir primed with the kill-step progress
+      files): full bring-up + every remaining step re-executed +
+      teardown.
+
+    Emitted keys: ``coordinator_recovery_wall_s`` (the gauge),
+    ``cold_restart_wall_s``, both sides' replayed/re-run step counts,
+    and ``recovery_vs_cold_restart`` (cold/recovery, pinned >= 3 —
+    slice re-adoption doing the work; the gap widens enormously on real
+    TPUs, where the cold path also re-pays minutes of slice
+    provisioning while re-adoption pays one probe). The deterministic
+    tier-1 chaos variant lives in tests/test_recovery.py."""
+    import os
+    import re
+    import shutil
+    import sys
+    import tempfile
+
+    from tony_tpu.client.client import TonyClient
+    from tony_tpu.cluster import journal as journal_mod
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.events.events import find_job_files, parse_events
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    trainer = os.path.join(repo, "tests", "fixtures",
+                           "fake_elastic_trainer.py")
+    tmp = tempfile.mkdtemp(prefix="tony-recovery-bench-")
+    workers = 2
+
+    def run_one(name, kill_flags="", extra_conf=None, shell_env=None):
+        root = os.path.join(tmp, name)
+        os.makedirs(root, exist_ok=True)
+        cmd = (f"{sys.executable} {trainer} --steps {steps} "
+               f"--ckpt {os.path.join(root, 'progress')} "
+               f"--ckpt_every {ckpt_every} --step_wait {step_wait}"
+               + (f" {kill_flags}" if kill_flags else ""))
+        conf = TonyConfig(dict({
+            "tony.staging.dir": os.path.join(root, "staging"),
+            "tony.history.location": os.path.join(root, "hist"),
+            "tony.application.timeout": "180000",
+            "tony.worker.instances": str(workers),
+            "tony.task.heartbeat-interval-ms": "250",
+            "tony.metrics.snapshot-interval-ms": "1000",
+        }, **(extra_conf or {})))
+        client = TonyClient(conf, cmd, shell_env=shell_env or {})
+        t0 = time.perf_counter()
+        rc = client.run()
+        wall = time.perf_counter() - t0
+        assert rc == 0, f"{name} bench job failed (job dir {client.job_dir})"
+        total = unique = 0
+        log_dir = os.path.join(client.job_dir, "logs")
+        for fn in os.listdir(log_dir):
+            if fn.startswith("worker-") and fn.endswith(".stdout"):
+                found = re.findall(r"^step (\d+)$",
+                                   open(os.path.join(log_dir, fn)).read(),
+                                   re.M)
+                total += len(found)
+                unique += len(set(found))
+        return client, wall, total - unique
+
+    # recover: SIGKILL the coordinator once worker 0 starts `kill_at`
+    marker = os.path.join(tmp, "recover", "kill.marker")
+    os.makedirs(os.path.dirname(marker))
+    try:
+        client, recover_wall, recover_replayed = run_one(
+            "recover", kill_flags=f"--kill {marker}:{kill_at}:0",
+            extra_conf={"tony.am.retry-count": "1"},
+            shell_env={"TEST_KILL_COORDINATOR": marker})
+        assert os.path.exists(marker + ".fired"), "kill hook never fired"
+        records = journal_mod.replay(
+            journal_mod.journal_path(client.job_dir))
+        state = journal_mod.fold(records)
+        assert state.incarnation == 2, "coordinator never restarted"
+        launches = [r for r in records if r["k"] == "launch"]
+        assert len(launches) == workers, "recovery re-provisioned a task"
+        # the recovery wall rides am:0 into the restarted generation's
+        # final METRICS_SNAPSHOT
+        recovery_wall = None
+        for f in find_job_files(os.path.join(tmp, "recover", "hist")):
+            events = list(parse_events(f))
+            if not any(e.event_type == "COORDINATOR_RESTART"
+                       for e in events):
+                continue
+            snaps = [e for e in events
+                     if e.event_type == "METRICS_SNAPSHOT"]
+            for name, _, value in snaps[-1].payload["tasks"]["am:0"]["g"]:
+                if name == "tony_coordinator_recovery_seconds":
+                    recovery_wall = value
+        assert recovery_wall, "recovery wall gauge never recorded"
+
+        # cold: a fresh submission primed with the kill-step checkpoints
+        # — everything re-provisions and every later step re-runs
+        primed = (kill_at // ckpt_every) * ckpt_every
+        cold_root = os.path.join(tmp, "cold")
+        os.makedirs(cold_root)
+        for i in range(workers):
+            with open(os.path.join(cold_root,
+                                   f"progress-worker-{i}"), "w") as f:
+                f.write(str(primed))
+        _, cold_wall, _ = run_one("cold")
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    ratio = cold_wall / max(recovery_wall, 1e-9)
+    assert ratio >= 3, (
+        f"coordinator recovery ({recovery_wall:.2f}s) not >= 3x better "
+        f"than the cold full-job restart ({cold_wall:.2f}s)")
+    return {
+        "recovery_kill_at_step": kill_at,
+        "coordinator_recovery_wall_s": round(recovery_wall, 3),
+        # 0: re-adopted trainers never stopped, so nothing re-ran
+        "recovery_steps_replayed": recover_replayed,
+        "recovery_job_wall_s": round(recover_wall, 2),
+        "cold_restart_wall_s": round(cold_wall, 2),
+        "cold_restart_steps_rerun": steps - primed,
+        "recovery_vs_cold_restart": round(ratio, 2),
     }
 
 

@@ -39,6 +39,15 @@ and scp staging take minutes; the fake sleeps instead):
   FAKE_DELAY_CREATE_S / FAKE_DELAY_SCP_S / FAKE_DELAY_SSH_S /
   FAKE_DELAY_DESCRIBE_S = seconds slept before executing that verb.
 
+Coordinator kill (the crash-recovery suite's TPU-side fault):
+  FAKE_KILL_COORDINATOR=1|<marker>  SIGKILLs the invoking coordinator —
+      the fake's parent process, since the TPU backend shells out from
+      inside the coordinator — on the next describe (the state poller's
+      code path). A value other than "1" is a marker path the flip waits
+      for. One-shot per job via a .kill-coordinator-fired sentinel under
+      $FAKE_GCLOUD_ROOT, written+fsync'd BEFORE the kill (an in-memory
+      latch would die with the process).
+
 Like real gcloud, ``create`` of an existing slice fails ALREADY_EXISTS
 (the backend adopts the surviving slice on that error — the warm-restart
 path).
@@ -137,6 +146,26 @@ def maybe_env_preempt(name: str) -> None:
         open(fired, "w").close()
 
 
+def maybe_kill_coordinator() -> None:
+    """FAKE_KILL_COORDINATOR: one-shot marker-gated SIGKILL of the
+    invoking coordinator process, checked on describe. Slice state and
+    host processes are left untouched — exactly what a coordinator host
+    crash looks like from the gang's point of view."""
+    import signal
+    val = os.environ.get("FAKE_KILL_COORDINATOR")
+    if not val:
+        return
+    fired = os.path.join(root(), ".kill-coordinator-fired")
+    if os.path.exists(fired):
+        return
+    if val != "1" and not os.path.exists(val):
+        return      # marker-gated: wait for the trainer to reach the step
+    fd = os.open(fired, os.O_CREAT | os.O_WRONLY, 0o644)
+    os.fsync(fd)
+    os.close(fd)
+    os.kill(os.getppid(), signal.SIGKILL)
+
+
 def main(argv):
     if argv[:2] == ["auth", "print-access-token"]:
         # per-job scoped identity mint (tony.gcs.service-account)
@@ -202,6 +231,7 @@ def main(argv):
             print("ERROR: backend error: please retry", file=sys.stderr)
             return 1
         maybe_env_preempt(name)
+        maybe_kill_coordinator()
         state_path = os.path.join(slice_dir(name), "state")
         if not os.path.exists(state_path):
             print("NOT_FOUND", file=sys.stderr)

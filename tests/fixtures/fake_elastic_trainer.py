@@ -26,6 +26,10 @@ def main() -> int:
                    help="marker_path:step:task_index — task_index touches "
                         "marker_path when it STARTS that step (repeatable; "
                         "the TEST_PREEMPT_TASKS handshake)")
+    p.add_argument("--tail_wait", default="",
+                   help="task_index:seconds — that task sleeps extra before "
+                        "'done' (make the chief finish LAST so its "
+                        "completion verdict never truncates a sibling)")
     args = p.parse_args()
 
     idx = int(os.environ.get("TASK_INDEX", "0"))
@@ -52,6 +56,10 @@ def main() -> int:
             with open(tmp, "w") as f:
                 f.write(str(step + 1))
             os.replace(tmp, path)       # atomic: a kill never corrupts it
+    if args.tail_wait:
+        who, _, wait_s = args.tail_wait.partition(":")
+        if int(who) == idx:
+            time.sleep(float(wait_s))
     print("done", flush=True)
     return 0
 

@@ -121,3 +121,78 @@ def test_seeded_gang_kill_schedule_survives(tmp_path):
     am_dumps = glob.glob(os.path.join(client.job_dir, "flight-am-0-*.json"))
     assert len(am_dumps) >= len(schedule["kills"]), (
         detail + f" (dumps={am_dumps})")
+
+
+@pytest.mark.chaos
+@pytest.mark.recovery
+@pytest.mark.e2e
+@pytest.mark.slow
+def test_coordinator_kill_then_gang_preemption(tmp_path):
+    """Interleaved faults: SIGKILL the coordinator early, then preempt a
+    gang the RESTARTED coordinator only knows through journal adoption.
+    The recovered session must absorb the loss through the normal
+    elastic shrink → resync → regrow path — coordinator recovery and
+    elastic recovery compose, neither resets the session."""
+    from tony_tpu.cluster import journal as journal_mod
+    steps = 30
+    kill_marker = tmp_path / "kill-coordinator.marker"
+    preempt_marker = tmp_path / "preempt-worker-2.marker"
+    # Worker 0 touches the coordinator-kill marker at step 1; worker 2
+    # touches its own preemption marker at step 10 — well after
+    # re-adoption (~step 6 at this cadence). The job is long enough for
+    # the adopted-reap hold + shrink + regrow to play out before the
+    # chief's completion becomes the job verdict.
+    cmd = (f"{PY} {TRAINER} --steps {steps} "
+           f"--ckpt {tmp_path / 'progress'} --ckpt_every 2 "
+           f"--step_wait 0.3 "
+           f"--kill {kill_marker}:1:0 "
+           f"--kill {preempt_marker}:10:2")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": str(tmp_path / "hist"),
+        "tony.application.timeout": "120000",
+        "tony.worker.instances": "3",
+        "tony.worker.slices": "3",
+        "tony.task.heartbeat-interval-ms": "250",
+        "tony.am.retry-count": "1",
+        "tony.elastic.enabled": "true",
+        "tony.elastic.regrow": "true",
+        "tony.elastic.regrow-backoff-ms": "500",
+    })
+    client = TonyClient(conf, cmd, shell_env={
+        "TEST_KILL_COORDINATOR": str(kill_marker),
+        "TEST_PREEMPT_TASKS": f"worker:2@{preempt_marker}",
+        "TONY_RESYNC_KILL_GRACE_S": "3",
+    })
+    rc = client.run()
+    # events span both coordinator generations (the killed one's file
+    # stays .inprogress forever; find_job_files matches both)
+    files = find_job_files(conf.get("tony.history.location"))
+    types = [e.event_type for f in files for e in parse_events(f)]
+    detail = f"rc={rc}, job_dir={client.job_dir}, events={types}"
+    assert rc == 0, detail
+    assert os.path.exists(str(kill_marker) + ".fired"), detail
+    assert "COORDINATOR_RESTART" in types, detail
+    assert "ELASTIC_SHRINK" in types, detail
+    assert "SESSION_RESET" not in types, detail
+    # The chief ran the whole schedule out under BOTH faults. The
+    # coordinator restart itself never touched it — exactly one
+    # from-scratch generation; the later elastic resyncs legitimately
+    # restart it FROM CHECKPOINT ("starting at step <n>0").
+    chief = open(os.path.join(client.job_dir, "logs",
+                              "worker-0.stdout")).read()
+    assert f"step {steps - 1}" in chief, detail
+    assert chief.count("starting at step 0 ") == 1, detail
+    # the preempted gang came back through regrow: a second generation
+    victim = open(os.path.join(client.job_dir, "logs",
+                               "worker-2.stdout")).read()
+    assert victim.count("starting at step") >= 2, detail
+    # the journal folds both stories: two coordinator generations, and
+    # the shrink/regrow records for the preempted gang
+    records = journal_mod.replay(
+        journal_mod.journal_path(client.job_dir))
+    state = journal_mod.fold(records)
+    kinds = [r["k"] for r in records]
+    assert state.incarnation == 2, detail
+    assert "elastic_shrink" in kinds, detail
+    assert "regrow_activated" in kinds, detail

@@ -64,7 +64,10 @@ def test_lost_coordinator_exits_distinct_code(tmp_path):
     conf = tmp_path / "tony-final.xml"
     conf.write_text("")      # kv format: empty + overrides via file
     (tmp_path / "conf.kv").write_text(
-        "tony.task.heartbeat-interval-ms=100\n")
+        "tony.task.heartbeat-interval-ms=100\n"
+        # a short re-attach window: the test is about the EXIT CODE once
+        # the window expires, not about riding out a 30s (default) outage
+        "tony.coordinator.reattach-timeout-ms=1500\n")
     env = dict(os.environ)
     env.update({
         "JOB_NAME": "worker", "TASK_INDEX": "0", "TASK_NUM": "1",

@@ -1,0 +1,207 @@
+"""Unit suite for the coordinator's write-ahead session journal.
+
+Pins the recovery contract: torn FINAL records recover by truncation,
+corrupt INTERIOR records fail loudly with the byte offset, and folding
+is deterministic — the same journal always rebuilds the same state.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from tony_tpu.cluster import journal as jr
+
+
+def _write_basic(job_dir) -> str:
+    j = jr.Journal(str(job_dir))
+    j.append("coordinator_start", app_id="app-1", attempt=0)
+    j.append("rpc_bound", port=12345)
+    j.append("launch", task_id="worker:0", allocation_id=0, pid=111)
+    j.append("launch", task_id="worker:1", allocation_id=1, pid=222)
+    j.append("task_registered", task_id="worker:0", spec="h0:9000",
+             channel_port=0)
+    j.append("task_registered", task_id="worker:1", spec="h1:9001",
+             channel_port=7070)
+    j.close()
+    return j.path
+
+
+def test_round_trip_fold(tmp_path):
+    path = _write_basic(tmp_path)
+    state = jr.fold(jr.replay(path))
+    assert state.incarnation == 1
+    assert state.app_id == "app-1"
+    assert state.rpc_port == 12345
+    assert state.session_id == 0
+    t0 = state.tasks["worker:0"]
+    assert (t0.spec, t0.pid, t0.allocation_id) == ("h0:9000", 111, 0)
+    assert state.tasks["worker:1"].channel_port == 7070
+    assert {t.task_id for t in state.live_tasks()} == {"worker:0",
+                                                       "worker:1"}
+
+
+def test_completion_and_restart_fold(tmp_path):
+    j = jr.Journal(str(tmp_path))
+    j.append("coordinator_start", app_id="a")
+    j.append("launch", task_id="worker:0", allocation_id=0, pid=10)
+    j.append("task_registered", task_id="worker:0", spec="h0:1")
+    j.append("completion", task_id="worker:0", exit_code=9)
+    j.append("task_restart", task_id="worker:0")
+    j.append("launch", task_id="worker:0", allocation_id=1, pid=20)
+    j.close()
+    t = jr.fold(jr.replay(j.path)).tasks["worker:0"]
+    # the restarted generation is launched but not yet registered
+    assert not t.completed and not t.registered
+    assert t.restarts == 1
+    assert (t.pid, t.allocation_id) == (20, 1)
+
+
+def test_elastic_and_session_reset_fold(tmp_path):
+    j = jr.Journal(str(tmp_path))
+    j.append("coordinator_start", app_id="a")
+    j.append("task_registered", task_id="worker:0", spec="h0:1")
+    j.append("task_registered", task_id="worker:1", spec="h1:1")
+    j.append("elastic_shrink", lost=["worker:1"], epoch=1)
+    j.append("regrow_armed", task_ids=["worker:1"])
+    state = jr.fold(jr.replay(j.path))
+    assert state.cluster_epoch == 1
+    assert state.tasks["worker:1"].detached
+    assert state.regrow_pending == {"worker:1"}
+    assert [t.task_id for t in state.live_tasks()] == ["worker:0"]
+    j.append("task_registered", task_id="worker:1", spec="h2:1")
+    j.append("regrow_activated", epoch=2, task_ids=["worker:1"])
+    state = jr.fold(jr.replay(j.path))
+    assert state.cluster_epoch == 2
+    assert not state.tasks["worker:1"].detached
+    assert state.regrow_pending == set()
+    # a whole-job retry wipes per-task state but keeps the incarnation
+    j.append("session_reset", session_id=1)
+    j.close()
+    state = jr.fold(jr.replay(j.path))
+    assert state.session_id == 1
+    assert state.tasks == {}
+    assert state.incarnation == 1
+
+
+def test_watermark_and_unknown_kinds(tmp_path):
+    j = jr.Journal(str(tmp_path))
+    j.append("coordinator_start", app_id="a")
+    j.append("watermark", name="checkpoint_step", value=40)
+    j.append("watermark", name="checkpoint_step", value=60)
+    j.append("from_the_future", some_field=1)     # must be skipped
+    j.close()
+    state = jr.fold(jr.replay(j.path))
+    assert state.watermarks == {"checkpoint_step": 60}
+
+
+def test_incarnation_counts_coordinator_starts(tmp_path):
+    j = jr.Journal(str(tmp_path))
+    j.append("coordinator_start", app_id="a")
+    j.append("coordinator_start", app_id="a")
+    j.append("coordinator_start", app_id="a")
+    j.close()
+    assert jr.fold(jr.replay(j.path)).incarnation == 3
+
+
+def test_torn_final_record_recovers_by_truncation(tmp_path):
+    path = _write_basic(tmp_path)
+    full = jr.replay(path)
+    with open(path, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 7)      # tear the final record mid-line
+    assert jr.replay(path) == full[:-1]
+    # truncate_torn physically drops the tear; the file is clean after
+    jr.replay(path, truncate_torn=True)
+    records, torn_offset, _ = jr.scan(path)
+    assert torn_offset is None
+    assert records == full[:-1]
+
+
+def test_torn_final_append_in_progress(tmp_path):
+    """A crash can also land mid-append of a NEW record: valid file +
+    partial trailing line with no newline."""
+    path = _write_basic(tmp_path)
+    full = jr.replay(path)
+    with open(path, "ab") as f:
+        f.write(b"deadbeef {\"k\":\"launch\",\"task")
+    assert jr.replay(path) == full
+
+
+def test_corrupt_interior_record_fails_with_offset(tmp_path):
+    path = _write_basic(tmp_path)
+    with open(path, "rb") as f:
+        data = f.read()
+    # flip one payload byte of the THIRD record
+    offsets = [i + 1 for i, b in enumerate(data) if b == ord("\n")]
+    victim = offsets[1]      # start of record 3
+    corrupted = bytearray(data)
+    corrupted[victim + 12] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(corrupted))
+    with pytest.raises(jr.JournalCorruptError) as e:
+        jr.replay(path)
+    assert e.value.offset == victim
+    assert "checksum mismatch" in str(e.value)
+
+
+def test_replay_is_deterministic(tmp_path):
+    path = _write_basic(tmp_path)
+    a = jr.fold(jr.replay(path))
+    b = jr.fold(jr.replay(path))
+    assert a == b
+    # byte-stability: identical records encode identically
+    rec = {"k": "launch", "task_id": "worker:0", "pid": 1}
+    assert jr.encode_record(rec) == jr.encode_record(dict(reversed(
+        list(rec.items()))))
+
+
+def test_append_survives_unwritable_dir(tmp_path):
+    j = jr.Journal(str(tmp_path / "does-not-exist"))
+    j.append("coordinator_start", app_id="a")     # must not raise
+    j.append("rpc_bound", port=1)
+    j.close()
+
+
+def _fsck(job_dir):
+    return subprocess.run(
+        [sys.executable, "-m", "tony_tpu.cluster.journal",
+         "--verify", str(job_dir)],
+        capture_output=True, text=True)
+
+
+def test_fsck_clean(tmp_path):
+    _write_basic(tmp_path)
+    res = _fsck(tmp_path)
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "OK: 6 record(s), incarnation 1" in res.stdout
+    assert "task worker:0: running pid=111" in res.stdout
+
+
+def test_fsck_torn_tail_is_clean_but_reported(tmp_path):
+    path = _write_basic(tmp_path)
+    with open(path, "r+b") as f:
+        f.seek(0, 2)
+        f.truncate(f.tell() - 5)
+    res = _fsck(tmp_path)
+    assert res.returncode == 0
+    assert "torn final record at byte offset" in res.stdout
+
+
+def test_fsck_corrupt_interior_points_at_offset(tmp_path):
+    path = _write_basic(tmp_path)
+    with open(path, "rb") as f:
+        data = f.read()
+    offsets = [i + 1 for i, b in enumerate(data) if b == ord("\n")]
+    victim = offsets[0]
+    corrupted = bytearray(data)
+    corrupted[victim + 12] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(corrupted))
+    res = _fsck(tmp_path)
+    assert res.returncode == 2
+    assert f"byte offset {victim}" in res.stdout
+
+
+def test_fsck_missing_file(tmp_path):
+    assert _fsck(tmp_path).returncode == 1

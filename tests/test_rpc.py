@@ -334,6 +334,164 @@ class TestHeartbeatTracePiggyback:
 
 
 # ---------------------------------------------------------------------------
+# Retry policy: per-call deadlines, and non-idempotent calls never
+# retried on DEADLINE_EXCEEDED (the coordinator may have processed them)
+# ---------------------------------------------------------------------------
+
+class TestRetryPolicy:
+    def test_nonidempotent_not_retried_on_deadline(self):
+        """register_execution_result past its deadline raises instead of
+        retrying — a second send could double-record the exit."""
+        import grpc
+        from tony_tpu.rpc import tony_pb2 as pb
+
+        class SlowResult(FakeImpl):
+            def __init__(self):
+                super().__init__(expected=1)
+                self.result_calls = 0
+
+            def register_execution_result(self, *a):
+                self.result_calls += 1
+                time.sleep(0.6)
+                return "RECEIVED"
+
+        impl = SlowResult()
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        try:
+            client = ApplicationRpcClient(f"localhost:{srv.port}",
+                                          max_retries=5,
+                                          base_backoff_s=0.01)
+            with pytest.raises(grpc.RpcError) as ei:
+                client._call(client._register_result,
+                             pb.RegisterExecutionResultRequest(
+                                 exit_code=0, job_name="worker",
+                                 job_index="0", session_id="0"),
+                             idempotent=False, deadline_s=0.3)
+            assert ei.value.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+            time.sleep(0.8)               # let any straggler attempts land
+            assert impl.result_calls == 1, "non-idempotent call was retried"
+            client.close()
+        finally:
+            srv.stop(0)
+
+    def test_idempotent_deadline_is_retried(self):
+        """An idempotent read that times out once succeeds on the retry
+        (the wedged-then-recovered coordinator shape)."""
+        from tony_tpu.rpc import tony_pb2 as pb
+
+        class SlowOnce(FakeImpl):
+            def __init__(self):
+                super().__init__(expected=1)
+                self.spec_calls = 0
+
+            def get_cluster_spec(self, task_id):
+                self.spec_calls += 1
+                if self.spec_calls == 1:
+                    time.sleep(0.5)
+                return '{"worker": ["h0:1"]}'
+
+        impl = SlowOnce()
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        try:
+            client = ApplicationRpcClient(f"localhost:{srv.port}",
+                                          max_retries=5,
+                                          base_backoff_s=0.01)
+            resp = client._call(client._get_cluster_spec,
+                                pb.GetClusterSpecRequest(task_id="worker:0"),
+                                idempotent=True, deadline_s=0.3)
+            assert "worker" in resp.cluster_spec
+            assert impl.spec_calls >= 2
+            client.close()
+        finally:
+            srv.stop(0)
+
+    def test_hot_path_reads_pass_tight_deadline(self, server, monkeypatch):
+        """The barrier poll and the client monitor's status read run with
+        a 3s per-attempt deadline — a wedged coordinator surfaces as a
+        quick retryable timeout, not a 10s stall per attempt."""
+        impl, srv = server
+        client = ApplicationRpcClient(f"localhost:{srv.port}")
+        seen = {}
+        orig = client._call
+
+        def spy(stub, request, **kw):
+            seen[stub] = kw
+            return orig(stub, request, **kw)
+
+        monkeypatch.setattr(client, "_call", spy)
+        client.get_cluster_spec("worker:0")
+        client.get_application_status()
+        assert seen[client._get_cluster_spec]["deadline_s"] == 3.0
+        assert seen[client._get_status]["deadline_s"] == 3.0
+        client.close()
+
+    def test_reconnect_evicts_cached_instance(self, server):
+        """reconnect() hands back a FRESH client (new channel) and
+        installs it as the cached instance — the stale-channel escape
+        hatch the executor's re-attach probe uses after a coordinator
+        restart on the same address."""
+        _, srv = server
+        addr = f"localhost:{srv.port}"
+        a = ApplicationRpcClient.get_instance(addr)
+        b = ApplicationRpcClient.reconnect(addr)
+        assert b is not a
+        assert ApplicationRpcClient.get_instance(addr) is b
+        assert b.get_task_urls()          # the fresh channel really dials
+        b.close()
+
+
+# ---------------------------------------------------------------------------
+# Coordinator incarnation (crash-recovery re-attach signal) on the wire
+# ---------------------------------------------------------------------------
+
+class IncarnationImpl(FakeImpl):
+    """Restarted-coordinator shape: serves incarnation 2 on both the
+    heartbeat ack and the registration response."""
+
+    def task_executor_heartbeat(self, task_id, metrics="", spans="",
+                                client_time=0.0, client_rtt=0.0):
+        from tony_tpu.rpc.service import HeartbeatAck
+        self.heartbeats.append(task_id)
+        return HeartbeatAck(gcs_token="tok", cluster_epoch=3, incarnation=2)
+
+    def register_worker_spec(self, worker, spec):
+        r = super().register_worker_spec(worker, spec)
+        from dataclasses import replace
+        return replace(r, incarnation=2)
+
+
+class TestIncarnationWire:
+    def test_round_trips_on_heartbeat_and_registration(self):
+        impl = IncarnationImpl(expected=1)
+        srv = ApplicationRpcServer(impl)
+        srv.start()
+        try:
+            client = ApplicationRpcClient(f"localhost:{srv.port}")
+            ack = client.task_executor_heartbeat("worker:0")
+            assert ack.incarnation == 2
+            assert ack.cluster_epoch == 3
+            r = client.register_worker_spec("worker:0", "h0:1")
+            assert r.incarnation == 2
+            client.close()
+        finally:
+            srv.stop(0)
+
+    def test_old_server_defaults_to_untracked(self, server):
+        """A pre-recovery impl (FakeImpl returns a bare ack) maps to
+        incarnation 0 = "not tracked" — new executors must not mistake
+        it for a restart."""
+        impl, srv = server
+        client = ApplicationRpcClient(f"localhost:{srv.port}")
+        ack = client.task_executor_heartbeat("worker:0")
+        assert ack.incarnation == 0
+        r = client.register_worker_spec("worker:0", "h0:1")
+        assert r.incarnation == 0
+        client.close()
+
+
+# ---------------------------------------------------------------------------
 # Control-plane auth (ClientToAMToken analog)
 # ---------------------------------------------------------------------------
 

@@ -28,6 +28,13 @@ log = logging.getLogger(__name__)
 
 class LocalBackend(SchedulerBackend):
     KILL_GRACE_S = 2.0
+    #: how long an adopted pid must stay observably dead before its
+    #: completion event is emitted — gives the executor's own
+    #: register_execution_result RPC (which always lands before the
+    #: process is reaped) time to report the REAL exit code, so this
+    #: backend-side observation is the deduped fallback, not the source
+    #: of truth
+    ADOPTED_REAP_HOLD_S = 1.2
 
     def __init__(self) -> None:
         self._procs: dict[str, subprocess.Popen] = {}
@@ -38,6 +45,12 @@ class LocalBackend(SchedulerBackend):
         self._preemption_simulated = False
         #: TEST_PREEMPT_TASKS clauses already fired (one-shot each)
         self._preempt_clauses_fired: set[str] = set()
+        #: tasks re-adopted by a restarted coordinator: task_id -> pid of a
+        #: process LAUNCHED BY THE PREDECESSOR (journal-recovered; not our
+        #: child, so no Popen handle — liveness is os.kill(pid, 0))
+        self._adopted: dict[str, int] = {}
+        #: adopted pids first observed dead: task_id -> monotonic time
+        self._adopted_dead_at: dict[str, float] = {}
         self._lock = threading.Lock()
         #: drained by the coordinator via take_launch_timings(); local
         #: launches have no provision/stage phase, only process dispatch
@@ -84,6 +97,36 @@ class LocalBackend(SchedulerBackend):
                 "seconds": round(time.monotonic() - t_start, 6),
                 "task": spec.task_id})
         log.info("launched %s as pid %d", spec.task_id, proc.pid)
+
+    # -- crash-recovery adoption --------------------------------------------
+    def adopt(self, task_id: str, pid: int) -> None:
+        """Re-adopt a live task process launched by a PREDECESSOR coordinator
+        (pid recovered from the session journal). The process is not our
+        child, so there is no Popen handle: liveness is probed with
+        ``os.kill(pid, 0)`` and kills go through ``os.killpg`` (launch_task
+        uses start_new_session, so pid == pgid)."""
+        with self._lock:
+            try:
+                os.kill(pid, 0)
+            except (ProcessLookupError, PermissionError):
+                # Died during the coordinator outage — surface immediately
+                # as an ordinary failure (no reap hold: there is no live
+                # executor left to race a result RPC against).
+                log.warning("adopt: %s pid %d already dead", task_id, pid)
+                self._adopted[task_id] = pid
+                self._adopted_dead_at[task_id] = -1.0
+                return
+            self._adopted[task_id] = pid
+            self._reported.discard(task_id)
+        log.info("adopted %s as pre-existing pid %d", task_id, pid)
+
+    def pid_of(self, task_id: str) -> int | None:
+        """Pid of the task's process, for journaling (None = unknown)."""
+        with self._lock:
+            proc = self._procs.get(task_id)
+            if proc is not None:
+                return proc.pid
+            return self._adopted.get(task_id)
 
     def _maybe_simulate_preemption(self) -> None:
         """TEST_PREEMPT_SLICE=<job_type> chaos: SIGKILL every running task of
@@ -132,27 +175,81 @@ class LocalBackend(SchedulerBackend):
             # kill would turn an intended whole-gang preemption into a
             # different scenario. A clause naming a never-launched task
             # simply stays armed (and inert) for the backend's life.
-            if not all(tid in self._procs for tid in task_ids):
+            # Adopted tasks count as launched: a restarted coordinator's
+            # re-adopted gang must stay preemptable, or chaos schedules
+            # spanning a coordinator kill could never fire their later
+            # clauses.
+            if not all(tid in self._procs or tid in self._adopted
+                       for tid in task_ids):
                 continue
             self._preempt_clauses_fired.add(clause)
             for task_id in task_ids:
+                if task_id in self._reported:
+                    continue
                 proc = self._procs.get(task_id)
-                if proc is None or task_id in self._reported \
-                        or proc.poll() is not None:
+                pid = proc.pid if proc is not None \
+                    else self._adopted.get(task_id)
+                if pid is None or (proc is not None
+                                   and proc.poll() is not None):
                     continue
                 log.info("chaos: TEST_PREEMPT_TASKS killing %s (marker %s)",
                          task_id, marker or "<immediate>")
                 self._preempted.add(task_id)
                 try:
-                    os.killpg(proc.pid, signal.SIGKILL)
+                    os.killpg(pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
+
+    def _maybe_kill_coordinator(self) -> None:
+        """TEST_KILL_COORDINATOR chaos: the value is a marker-file path;
+        once the marker exists, SIGKILL the COORDINATOR process — this
+        backend runs inside it — exactly once. The one-shot latch is a
+        sentinel FILE ("<marker>.fired", written before the kill): any
+        in-memory fired flag would die with the process and re-fire on
+        every restart. Trainers touch the marker from a step hook, so
+        "kill the coordinator at step K" is exactly reproducible; tasks
+        survive the kill (they run in their own sessions) for the
+        restarted coordinator to re-adopt."""
+        marker = os.environ.get(constants.TEST_KILL_COORDINATOR)
+        if not marker or not os.path.exists(marker):
+            return
+        sentinel = marker + ".fired"
+        if os.path.exists(sentinel):
+            return
+        log.warning("chaos: TEST_KILL_COORDINATOR marker %s present — "
+                    "SIGKILLing coordinator pid %d", marker, os.getpid())
+        with open(sentinel, "w") as f:
+            f.write(str(os.getpid()))
+            f.flush()
+            os.fsync(f.fileno())
+        os.kill(os.getpid(), signal.SIGKILL)
 
     def poll_completed(self) -> list[CompletionEvent]:
         events = []
         with self._lock:
+            self._maybe_kill_coordinator()
             self._maybe_simulate_preemption()
             self._maybe_kill_gang_at_marker()
+            now = time.monotonic()
+            for task_id, pid in self._adopted.items():
+                if task_id in self._reported or task_id in self._procs:
+                    continue
+                try:
+                    os.kill(pid, 0)
+                    self._adopted_dead_at.pop(task_id, None)
+                    continue
+                except (ProcessLookupError, PermissionError):
+                    pass
+                first_dead = self._adopted_dead_at.setdefault(task_id, now)
+                # Hold the dead observation briefly (unless it was dead at
+                # adoption, first_dead < 0): the executor's
+                # register_execution_result RPC carries the real exit code
+                # and beats this fallback, which record_completion dedupes.
+                if first_dead >= 0 and now - first_dead < self.ADOPTED_REAP_HOLD_S:
+                    continue
+                self._reported.add(task_id)
+                events.append(CompletionEvent(
+                    task_id, 1, preempted=task_id in self._preempted))
             for task_id, proc in self._procs.items():
                 if task_id in self._reported:
                     continue
@@ -196,16 +293,45 @@ class LocalBackend(SchedulerBackend):
         t.daemon = True
         t.start()
 
+    def _kill_adopted(self, task_id: str) -> None:
+        """Kill an adopted (non-child) task: TERM its process group, then
+        escalate after the usual grace (launch_task's start_new_session
+        guarantees pid == pgid for adopted pids too)."""
+        pid = self._adopted.get(task_id)
+        if pid is None or task_id in self._reported:
+            return
+        self._killed.add(task_id)
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (ProcessLookupError, PermissionError):
+            return
+
+        def _escalate():
+            try:
+                os.kill(pid, 0)
+                os.killpg(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+        t = threading.Timer(self.KILL_GRACE_S, _escalate)
+        t.daemon = True
+        t.start()
+
     def kill_task(self, task_id: str) -> None:
         with self._lock:
             proc = self._procs.get(task_id)
             if proc:
                 self._kill_proc(task_id, proc)
+            elif task_id in self._adopted:
+                self._kill_adopted(task_id)
 
     def kill_all(self) -> None:
         with self._lock:
             for task_id, proc in self._procs.items():
                 self._kill_proc(task_id, proc)
+            for task_id in self._adopted:
+                if task_id not in self._procs:
+                    self._kill_adopted(task_id)
 
     def stop(self) -> None:
         self.kill_all()

@@ -38,6 +38,7 @@ import time
 from tony_tpu import constants
 from tony_tpu.backend.base import CompletionEvent, LaunchSpec, SchedulerBackend
 from tony_tpu.backend.local import LocalBackend
+from tony_tpu.cluster import journal as journal_mod
 from tony_tpu.cluster.liveness import HeartbeatMonitor
 from tony_tpu.cluster.session import (Session, SessionStatus, TaskStatus,
                                       next_session)
@@ -118,6 +119,9 @@ class CoordinatorRpc(ApplicationRpc):
                                 spans: str = "", client_time: float = 0.0,
                                 client_rtt: float = 0.0) -> HeartbeatAck:
         self.co.hb_monitor.ping(task_id)
+        # A beat from a task the RESTARTED coordinator re-adopted closes
+        # that task's recovery wait (no-op outside recovery).
+        self.co.note_reattach(task_id)
         if metrics:
             # Telemetry rides the liveness channel but must never break
             # it: ingest validates and drops malformed snapshots (keeping
@@ -134,7 +138,8 @@ class CoordinatorRpc(ApplicationRpc):
         # re-runs the registration handshake (the elastic resync path).
         return HeartbeatAck(
             gcs_token=os.environ.get(constants.TONY_GCS_TOKEN, ""),
-            cluster_epoch=self.co.session.cluster_epoch)
+            cluster_epoch=self.co.session.cluster_epoch,
+            incarnation=self.co.incarnation)
 
     def renew_gcs_token(self, token: str) -> None:
         # Client-pushed replacement for the expiring impersonation token:
@@ -166,12 +171,53 @@ class Coordinator:
         os.makedirs(self.log_dir, exist_ok=True)
         self.session = Session(conf, session_id=0)
         self.backend = make_backend(conf, app_id)
+        # Crash recovery (the session journal): every expensive or
+        # undiscoverable transition is journaled write-ahead; a journal
+        # left behind by a predecessor WITHOUT a final-status file means
+        # that predecessor died mid-job — replay it and re-adopt the
+        # still-running gang instead of reprovisioning it.
+        self.journal_enabled = conf.get_bool(
+            K.COORDINATOR_JOURNAL_ENABLED_KEY, True)
+        self.reattach_grace_s = conf.get_int(
+            K.COORDINATOR_REATTACH_TIMEOUT_KEY, 30000) / 1000.0
+        self._recovered: journal_mod.RecoveredState | None = None
+        self._recovery_t0 = 0.0
+        #: re-adopted live tasks still silent since the restart; drains as
+        #: their executors re-attach (heartbeat or re-registration)
+        self._recovery_awaiting: set[str] = set()
+        jpath = journal_mod.journal_path(self.job_dir)
+        if (self.journal_enabled and os.path.exists(jpath)
+                and not os.path.exists(
+                    os.path.join(self.job_dir, constants.FINAL_STATUS_FILE))):
+            # A torn FINAL record is the only damage a crash mid-append
+            # can do — truncated and recovery proceeds. Interior
+            # corruption raises out of __init__: restarting on garbage
+            # state is worse than failing loudly with the byte offset
+            # (the journal fsck points at it).
+            state = journal_mod.fold(
+                journal_mod.replay(jpath, truncate_torn=True))
+            if state.incarnation >= 1:
+                self._recovered = state
+                self._recovery_t0 = time.monotonic()
+        #: coordinator process generation served to executors on every
+        #: registration response and heartbeat ack (1 = first process; a
+        #: mid-job CHANGE tells executors to re-run the handshake)
+        self.incarnation = (self._recovered.incarnation + 1
+                            if self._recovered else 1)
+        self.journal = (journal_mod.Journal(self.job_dir)
+                        if self.journal_enabled else None)
+        if self._recovered is not None:
+            self._restore_session(self._recovered)
         self.tensorboard_url: str | None = None
         self.final_status: str | None = None
         self.failure_message: str | None = None
         self.client_signalled_finish = threading.Event()
         self.task_missed_hb = threading.Event()
         self._completion_lock = threading.Lock()
+        # stop() re-entrancy latch: an Event, NOT a lock — the SIGTERM
+        # handler runs on the main thread, possibly while that same
+        # thread is already inside stop(), and a lock would self-deadlock
+        self._stopping = threading.Event()
         self.retries_left = conf.get_int(K.AM_RETRY_COUNT_KEY, 0)
         # Slice preemption is infrastructure failure: retried from its own
         # budget so user-failure retries (tony.am.retry-count) keep their
@@ -249,8 +295,27 @@ class Coordinator:
         self.tls_key = os.environ.get(constants.TONY_TLS_KEY) or None
         tls = (self.tls_key, self.tls_cert) \
             if self.tls_cert and self.tls_key else None
-        self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self),
-                                               secret=self.secret, tls=tls)
+        # Port continuity across restarts: executors cache the coordinator
+        # address, so a recovered coordinator first tries the journaled
+        # port — re-attaching executors then never even notice the address
+        # changed. If something else grabbed the port during the outage,
+        # fall back to a fresh one; executors recover via the re-published
+        # coordinator.addr file (_refresh_rpc on their side).
+        self.rpc_server = None
+        if self._recovered is not None and self._recovered.rpc_port:
+            try:
+                self.rpc_server = ApplicationRpcServer(
+                    CoordinatorRpc(self), port=self._recovered.rpc_port,
+                    secret=self.secret, tls=tls)
+            except OSError:
+                log.warning(
+                    "journaled RPC port %d is taken — binding a fresh one "
+                    "(executors will re-resolve via %s)",
+                    self._recovered.rpc_port, COORDINATOR_ADDR_FILE)
+        if self.rpc_server is None:
+            self.rpc_server = ApplicationRpcServer(CoordinatorRpc(self),
+                                                   secret=self.secret,
+                                                   tls=tls)
         history_dir = ev.HistoryDirs.from_conf(conf).intermediate
         self.events = ev.EventHandler(history_dir, app_id,
                                       os.environ.get("USER", "unknown"))
@@ -311,6 +376,132 @@ class Coordinator:
         self._launch_errors: list[str] = []
 
     # ------------------------------------------------------------------
+    # Crash recovery (session journal)
+    # ------------------------------------------------------------------
+    def _journal_append(self, kind: str, **payload) -> None:
+        if self.journal is not None:
+            self.journal.append(kind, **payload)
+
+    def _restore_session(self, state: journal_mod.RecoveredState) -> None:
+        """Rebuild the session from the journal fold (__init__ time —
+        nothing else is running yet). Each task comes back in the phase
+        it was journaled in: completed, registered-live (RUNNING, spec
+        intact, so the gang barrier stays released), launched-but-silent
+        (SCHEDULED), or detached. Restored tasks KEEP their allocations
+        — next_allocation only binds NEW tasks, so the recovered session
+        launches nothing: zero slice re-provisions."""
+        now = time.monotonic()
+        self.session = Session(self.conf, session_id=state.session_id)
+        self.session.cluster_epoch = state.cluster_epoch
+        max_alloc = -1
+        for tid in sorted(state.tasks):
+            rec = state.tasks[tid]
+            try:
+                task = self.session.get_task_by_id(tid)
+            except (KeyError, IndexError, ValueError):
+                log.warning("journaled task %s is not in the current "
+                            "config — skipped", tid)
+                continue
+            max_alloc = max(max_alloc, rec.allocation_id)
+            task.allocation_id = rec.allocation_id
+            task.restarts = rec.restarts
+            if rec.detached:
+                task.detached = True
+                task.exit_code = rec.exit_code
+                task.status = TaskStatus.FAILED
+                task.completed_at = now
+            elif rec.completed:
+                task.exit_code = rec.exit_code
+                task.status = (TaskStatus.SUCCEEDED if rec.exit_code == 0
+                               else TaskStatus.FAILED)
+                task.completed_at = now
+            elif rec.registered:
+                task.spec = rec.spec
+                task.channel_port = rec.channel_port
+                task.status = TaskStatus.RUNNING
+                # nonzero registered_at: the executor's re-registration
+                # takes the NON-first path (no duplicate TASK_REGISTERED
+                # event, the barrier stays released)
+                task.registered_at = now
+            elif rec.allocation_id >= 0:
+                task.status = TaskStatus.SCHEDULED
+        self.session._next_allocation_id = max_alloc + 1
+        self.session._regrow_pending = set(state.regrow_pending)
+        if self.session.barrier_released():
+            self.session._assign_process_ids()
+            self.session._channel_specs = self.session._build_channel_specs()
+            self.session._mesh_spec = self.session._build_mesh_spec()
+        log.info("journal replay: restored session %d at cluster epoch %d "
+                 "(%d journaled task(s), %d live)", state.session_id,
+                 state.cluster_epoch, len(state.tasks),
+                 len(state.live_tasks()))
+
+    def _adopt_recovered(self) -> None:
+        """Re-adopt the predecessor's live tasks (run() time — events,
+        RPC server and liveness monitor are all up). Backend adoption
+        where the backend supports it (LocalBackend probes the journaled
+        pid), liveness registration with one full re-attach window of
+        grace — the outage was OURS, a silent executor is still backing
+        off toward us — and the COORDINATOR_RESTART history event, after
+        which zero TASK_SCHEDULED events is the history-visible proof
+        that recovery launched nothing."""
+        state = self._recovered
+        assert state is not None
+        live = sorted(t.task_id for t in state.live_tasks())
+        completed = sum(1 for t in state.tasks.values()
+                        if t.completed and not t.detached)
+        metrics_mod.get_default().counter(
+            "tony_coordinator_restarts_total",
+            help="coordinator processes that recovered a prior session "
+                 "from the journal").inc()
+        tracing.get_flight().record(
+            "coordinator_restart", incarnation=self.incarnation,
+            adopted=",".join(live), completed=completed)
+        self.events.emit(ev.COORDINATOR_RESTART,
+                         incarnation=self.incarnation, adopted=live,
+                         completed=completed,
+                         session_id=self.session.session_id)
+        adopt = getattr(self.backend, "adopt", None)
+        for tid in sorted(state.tasks):
+            rec = state.tasks[tid]
+            if rec.completed or rec.detached:
+                continue
+            if adopt is not None and rec.pid:
+                adopt(tid, rec.pid)
+            if rec.registered:
+                self.hb_monitor.register(tid, grace_s=self.reattach_grace_s)
+                self._recovery_awaiting.add(tid)
+        log.warning(
+            "coordinator restart (incarnation %d): recovered session %d "
+            "at epoch %d — re-adopted %d live task(s) %s, %d already "
+            "completed; awaiting executor re-attach", self.incarnation,
+            self.session.session_id, self.session.cluster_epoch,
+            len(live), live, completed)
+
+    def note_reattach(self, task_id: str) -> None:
+        """An executor from before the restart made contact (heartbeat
+        or re-registration). When the last awaited one arrives, the
+        recovery wall — coordinator start to full re-attachment — is
+        recorded. Set ops are GIL-atomic; no lock needed."""
+        if task_id not in self._recovery_awaiting:
+            return
+        self._recovery_awaiting.discard(task_id)
+        remaining = len(self._recovery_awaiting)
+        log.info("executor %s re-attached (%d still awaited)", task_id,
+                 remaining)
+        if remaining:
+            return
+        wall = time.monotonic() - self._recovery_t0
+        log.info("all executors re-attached %.2fs after coordinator start",
+                 wall)
+        metrics_mod.get_default().gauge(
+            "tony_coordinator_recovery_seconds",
+            help="wall seconds from coordinator restart to every live "
+                 "executor re-attaching (last recovery)").set(wall)
+        tracing.get_flight().record("coordinator_recovered",
+                                    wall_s=round(wall, 3))
+
+    # ------------------------------------------------------------------
     # RPC-driven hooks
     # ------------------------------------------------------------------
     def on_register_worker_spec(self, worker: str, spec: str,
@@ -344,8 +535,13 @@ class Coordinator:
             # gang barrier has no Heartbeater yet, and slow allocations
             # elsewhere must not expire it.
             self.hb_monitor.ping(worker)
+            # A re-registration from a task restored as already-registered
+            # is the re-attach handshake after a coordinator restart.
+            self.note_reattach(worker)
         else:
             self.hb_monitor.register(worker)
+            self._journal_append("task_registered", task_id=worker,
+                                 spec=spec, channel_port=channel_port)
             self.events.emit(ev.TASK_REGISTERED, task=worker, spec=spec,
                              session_id=self.session.session_id)
             self.session.set_task_url(
@@ -371,7 +567,8 @@ class Coordinator:
             num_processes=payload["num_processes"],
             mesh_spec=payload["mesh_spec"],
             cluster_epoch=payload.get("cluster_epoch", 0),
-            channel_spec=self.session.channel_spec_for(worker))
+            channel_spec=self.session.channel_spec_for(worker),
+            incarnation=self.incarnation)
 
     def _terminate_workers(self) -> None:
         time.sleep(0.5)
@@ -559,6 +756,8 @@ class Coordinator:
         if self.session.regrow_ready():
             regrown = sorted(self.session.regrow_pending_ids())
             epoch = self.session.activate_regrow()
+            self._journal_append("regrow_activated", epoch=epoch,
+                                 task_ids=regrown)
             for tid in regrown:
                 # a successful regrow wipes the task's attempt history —
                 # the give-up counter is per INCIDENT, not per job
@@ -687,6 +886,8 @@ class Coordinator:
         with self._completion_lock:
             self._elastic_retire_pending(lost)
         epoch = self.session.begin_elastic_resync()
+        self._journal_append("elastic_shrink", epoch=epoch,
+                             lost=sorted(lost))
         active = len([t for t in self.session.participants()
                       if not t.completed])
         log.warning("elastic: gang(s) %s lost — shrinking to %d task(s), "
@@ -718,6 +919,8 @@ class Coordinator:
         armed = self.session.arm_regrow(task_ids)
         if not armed:
             return
+        self._journal_append("regrow_armed",
+                             task_ids=sorted(t.task_id for t in armed))
         log.info("elastic: relaunching %s for regrow",
                  [t.task_id for t in armed])
         for t in armed:
@@ -945,6 +1148,15 @@ class Coordinator:
             gpus=request.gpus,
             tpus=request.tpus,
             tpu_topology=request.tpu_topology))
+        # Journaled AFTER the backend accepted the launch: the record's
+        # count is the recovery e2e's zero-reprovision pin, and the pid
+        # (where the backend tracks one) is what a restarted coordinator
+        # adopts instead of relaunching.
+        pid_of = getattr(self.backend, "pid_of", None)
+        self._journal_append(
+            "launch", task_id=task.task_id,
+            allocation_id=task.allocation_id,
+            pid=(pid_of(task.task_id) or 0) if pid_of else 0)
 
     # ------------------------------------------------------------------
     # Monitor loop
@@ -1034,6 +1246,8 @@ class Coordinator:
                 self.events.emit(ev.TASK_RESTARTED, task=task.task_id,
                                  exit_code=exit_code, restarts=t.restarts,
                                  session_id=self.session.session_id)
+                self._journal_append("task_restart", task_id=task.task_id,
+                                     exit_code=exit_code)
                 relaunch = t
             else:
                 already_done = task.completed
@@ -1041,6 +1255,8 @@ class Coordinator:
                                                session_id=session_id,
                                                via_rpc=via_rpc)
                 if not already_done and task.completed:
+                    self._journal_append("completion", task_id=task.task_id,
+                                         exit_code=task.exit_code)
                     if task.exit_code != 0 \
                             and self.session.is_tracked(job_type):
                         if preempted:
@@ -1411,6 +1627,10 @@ class Coordinator:
     # ------------------------------------------------------------------
     def run(self, user_command: str) -> int:
         self.events.start()
+        # One start record per coordinator process — the count IS the
+        # incarnation id (a recovered journal folds to incarnation-1
+        # starts, so appending ours keeps fold() == self.incarnation).
+        self._journal_append("coordinator_start", app_id=self.app_id)
         # The job root span: every process's coarse spans (bring-up,
         # executor lifecycle, incidents) parent onto it via the
         # TONY_TRACE_CTX env exported into each launch.
@@ -1439,10 +1659,13 @@ class Coordinator:
         with open(tmp, "w", encoding="utf-8") as f:
             f.write(addr)
         os.replace(tmp, addr_path)  # atomic: client never reads a partial file
+        self._journal_append("rpc_bound", port=self.rpc_server.port)
         log.info("coordinator %s serving on %s", self.app_id, addr)
         self.events.emit(ev.APPLICATION_INITED, app_id=self.app_id,
                          num_tasks=self.session.total_tasks(),
                          host=socket.gethostname())
+        if self._recovered is not None:
+            self._adopt_recovered()
 
         # Chaos: coordinator suicide before any task is scheduled (reference:
         # TEST_AM_CRASH, TonyApplicationMaster.java:352-357 returns false
@@ -1559,6 +1782,9 @@ class Coordinator:
             # that finally succeeded.
             self._session_metrics.append(self.session.uptime_metrics())
             self.session = next_session(self.session)
+            # per-task journal state starts over with the new session
+            self._journal_append("session_reset",
+                                 session_id=self.session.session_id)
 
         return self.stop(status)
 
@@ -1595,6 +1821,16 @@ class Coordinator:
         return final
 
     def stop(self, status: SessionStatus) -> int:
+        # Idempotent: the signal handler's stop(KILLED) can land while the
+        # main thread is ALREADY inside stop() (double SIGTERM, or a
+        # client kill racing normal teardown) — re-running the teardown
+        # would double-emit terminal events and re-enter non-reentrant
+        # backend kills. First caller wins; later callers only read the
+        # already-decided verdict.
+        if self._stopping.is_set():
+            return 0 if self.final_status == SessionStatus.SUCCEEDED.value \
+                else 1
+        self._stopping.set()
         self.final_status = status.value
         self.failure_message = self.failure_message or self.session.failure_message
         with self._launch_lock:
@@ -1667,6 +1903,11 @@ class Coordinator:
         self.client_signalled_finish.wait(
             timeout=5 if os.environ.get("TONY_TEST_MODE") else 30)
         self.rpc_server.stop()
+        # The final-status file above is what marks the journal obsolete
+        # (a future submit on this job dir starts fresh); close the handle
+        # last so every record through teardown made it out.
+        if self.journal is not None:
+            self.journal.close()
         return 0 if status is SessionStatus.SUCCEEDED else 1
 
 

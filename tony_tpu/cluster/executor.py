@@ -25,6 +25,7 @@ import argparse
 import json
 import logging
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -65,15 +66,31 @@ def reserve_port() -> int:
 
 class Heartbeater(threading.Thread):
     """1s-period heartbeat sender (reference: TaskExecutor.Heartbeater:234-273).
-    Dies — taking the whole executor with it — after 5 consecutive failed
-    sends. Supports the TEST_TASK_EXECUTOR_NUM_HB_MISS chaos hook (skip the
+    After 5 consecutive failed sends the coordinator is presumed gone; with
+    ``reattach_timeout_s`` > 0 the thread enters a bounded re-attach window
+    (capped jittered backoff, optional RPC-target refresh per attempt) —
+    a restarted coordinator that starts answering, possibly under a NEW
+    incarnation, resumes the beat with the user process untouched. Only
+    when the window expires (or with the timeout at 0, the legacy
+    fail-fast shape) does the executor die. Transient single-send failures
+    NEVER kill the thread — they are counted
+    (``tony_heartbeat_send_failures_total``) and retried on schedule, so
+    the final-beat flush machinery in run() is never forfeited to one
+    blip. Supports the TEST_TASK_EXECUTOR_NUM_HB_MISS chaos hook (skip the
     first N pings to trigger coordinator-side expiry)."""
 
     MAX_CONSECUTIVE_FAILURES = 5
+    #: re-attach backoff bounds: start fast (the coordinator restart the
+    #: window exists for takes ~a second locally), cap at 2s so the window
+    #: budget is spent probing, not sleeping
+    REATTACH_BACKOFF_MIN_S = 0.2
+    REATTACH_BACKOFF_MAX_S = 2.0
 
     def __init__(self, rpc: ApplicationRpcClient, task_id: str,
                  interval_s: float, gcs_token_file: str | None = None,
-                 snapshot_fn=None, on_epoch=None, spans_fn=None) -> None:
+                 snapshot_fn=None, on_epoch=None, spans_fn=None,
+                 reattach_timeout_s: float = 0.0, refresh_rpc=None,
+                 on_reattach=None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
@@ -103,6 +120,23 @@ class Heartbeater(threading.Thread):
         #: epoch its user process was launched under and resyncs on a
         #: bump. Errors in the observer must never cost a ping.
         self.on_epoch = on_epoch
+        #: how long to keep retrying an unreachable coordinator before
+        #: giving up (tony.coordinator.reattach-timeout-ms); 0 restores
+        #: the legacy die-after-5-failures behavior
+        self.reattach_timeout_s = reattach_timeout_s
+        #: () -> None, called before each re-attach probe — the executor
+        #: re-reads coordinator.addr and swaps in a client for the NEW
+        #: address (a restarted coordinator may bind a different port).
+        #: Errors must never abort the window.
+        self.refresh_rpc = refresh_rpc
+        #: (new_incarnation) -> None, called when an ack's incarnation
+        #: CHANGES from the first-seen value — the executor re-runs the
+        #: registration handshake so the restarted coordinator re-learns
+        #: this task's endpoint. Errors must never kill the beat.
+        self.on_reattach = on_reattach
+        #: coordinator incarnation from the registration response (seeded
+        #: by the executor); 0 = not tracked
+        self.incarnation = 0
         self.stop_event = threading.Event()
         self.skip_remaining = int(
             os.environ.get(constants.TEST_TASK_EXECUTOR_NUM_HB_MISS, "0"))
@@ -148,6 +182,109 @@ class Heartbeater(threading.Thread):
                         "heartbeat", exc_info=True)
             return ""
 
+    def _send_beat(self) -> None:
+        """One heartbeat send + ack handling; raises on send failure (the
+        caller counts). Ack handling — token republish, epoch observer,
+        incarnation tracking — is each individually shielded: a broken
+        observer must not turn a DELIVERED beat into a counted failure."""
+        # collect the piggybacks BEFORE the clock starts: the
+        # RTT shipped on the next beat must measure the RPC, not
+        # snapshot assembly
+        snapshot = self._snapshot()
+        spans = self._spans() if self._rpc_takes_trace else ""
+        t0 = time.perf_counter()
+        if self._rpc_takes_trace:
+            ack = self.rpc.task_executor_heartbeat(
+                self.task_id, snapshot, spans=spans,
+                client_rtt=self.last_rtt)
+        else:
+            ack = self.rpc.task_executor_heartbeat(self.task_id,
+                                                   snapshot)
+        measured = time.perf_counter() - t0
+        # an implausibly large "RTT" spanned the client's
+        # internal retries (deadline + backoff), not one round
+        # trip — shipping it would skew the midpoint estimate;
+        # 0 means "no estimate this beat"
+        self.last_rtt = measured if measured < 5.0 else 0.0
+        self._failures = 0
+        try:
+            self._republish_token(ack.gcs_token)
+        except Exception:
+            log.warning("GCS token republish failed", exc_info=True)
+        if self.on_epoch is not None:
+            try:
+                self.on_epoch(ack.cluster_epoch)
+            except Exception:
+                log.warning("cluster-epoch observer failed",
+                            exc_info=True)
+        self._handle_incarnation(getattr(ack, "incarnation", 0))
+
+    def _handle_incarnation(self, inc: int) -> None:
+        """First nonzero incarnation is remembered; a CHANGE afterwards
+        means a restarted coordinator answered this beat — fire
+        ``on_reattach`` so the executor re-registers its endpoint."""
+        if inc <= 0:
+            return
+        if self.incarnation == 0:
+            self.incarnation = inc
+            return
+        if inc == self.incarnation:
+            return
+        old, self.incarnation = self.incarnation, inc
+        log.warning("coordinator incarnation changed %d -> %d — a restarted "
+                    "coordinator recovered the session", old, inc)
+        if self.on_reattach is not None:
+            try:
+                self.on_reattach(inc)
+            except Exception:
+                log.warning("re-attach handshake failed (next beat retries)",
+                            exc_info=True)
+
+    def _count_failure(self) -> None:
+        self._failures += 1
+        metrics_mod.get_default().counter(
+            "tony_heartbeat_send_failures_total",
+            help="heartbeat sends that failed (transient or fatal)").inc()
+        log.warning("heartbeat send failure %d/%d", self._failures,
+                    self.MAX_CONSECUTIVE_FAILURES)
+
+    def _reattach(self) -> bool:
+        """The coordinator stopped answering: probe it for up to
+        ``reattach_timeout_s`` with capped jittered backoff, refreshing
+        the RPC target each attempt (a restarted coordinator may listen
+        on a new port — refresh_rpc re-reads coordinator.addr). Jitter
+        matters: every executor in the job enters this window at the
+        same instant, and synchronized probes would hammer the
+        recovering coordinator in waves. Returns True once a beat lands;
+        exits the process when the window expires."""
+        deadline = time.monotonic() + self.reattach_timeout_s
+        backoff = self.REATTACH_BACKOFF_MIN_S
+        log.warning("coordinator unreachable — entering re-attach window "
+                    "(%.0fs)", self.reattach_timeout_s)
+        while not self.stop_event.is_set():
+            if time.monotonic() > deadline:
+                break
+            if self.refresh_rpc is not None:
+                try:
+                    self.refresh_rpc()
+                except Exception:
+                    log.warning("RPC target refresh failed", exc_info=True)
+            try:
+                self._send_beat()
+                log.info("coordinator answering again — re-attach window "
+                         "closed, resuming normal beats")
+                return True
+            except Exception:
+                self._count_failure()
+            if self.stop_event.wait(backoff * (0.5 + random.random() / 2)):
+                break
+            backoff = min(backoff * 2, self.REATTACH_BACKOFF_MAX_S)
+        if self.stop_event.is_set():
+            return False
+        log.error("coordinator did not come back within %.0fs — lost the "
+                  "coordinator, exiting", self.reattach_timeout_s)
+        os._exit(constants.EXIT_LOST_COORDINATOR)
+
     def run(self) -> None:
         while not self.stop_event.wait(self.interval_s):
             if self.skip_remaining > 0:
@@ -156,41 +293,16 @@ class Heartbeater(threading.Thread):
                          self.skip_remaining)
                 continue
             try:
-                # collect the piggybacks BEFORE the clock starts: the
-                # RTT shipped on the next beat must measure the RPC, not
-                # snapshot assembly
-                snapshot = self._snapshot()
-                spans = self._spans() if self._rpc_takes_trace else ""
-                t0 = time.perf_counter()
-                if self._rpc_takes_trace:
-                    ack = self.rpc.task_executor_heartbeat(
-                        self.task_id, snapshot, spans=spans,
-                        client_rtt=self.last_rtt)
-                else:
-                    ack = self.rpc.task_executor_heartbeat(self.task_id,
-                                                           snapshot)
-                measured = time.perf_counter() - t0
-                # an implausibly large "RTT" spanned the client's
-                # internal retries (deadline + backoff), not one round
-                # trip — shipping it would skew the midpoint estimate;
-                # 0 means "no estimate this beat"
-                self.last_rtt = measured if measured < 5.0 else 0.0
-                self._failures = 0
-                self._republish_token(ack.gcs_token)
-                if self.on_epoch is not None:
-                    try:
-                        self.on_epoch(ack.cluster_epoch)
-                    except Exception:
-                        log.warning("cluster-epoch observer failed",
-                                    exc_info=True)
+                self._send_beat()
             except Exception:  # any send failure counts
-                self._failures += 1
-                log.warning("heartbeat send failure %d/%d", self._failures,
-                            self.MAX_CONSECUTIVE_FAILURES)
+                self._count_failure()
                 if self._failures >= self.MAX_CONSECUTIVE_FAILURES:
-                    log.error("too many heartbeat failures — lost the "
-                              "coordinator, exiting")
-                    os._exit(constants.EXIT_LOST_COORDINATOR)
+                    if self.reattach_timeout_s > 0:
+                        self._reattach()
+                    else:
+                        log.error("too many heartbeat failures — lost the "
+                                  "coordinator, exiting")
+                        os._exit(constants.EXIT_LOST_COORDINATOR)
 
 
 class TaskExecutor:
@@ -252,6 +364,12 @@ class TaskExecutor:
         self.hb_interval_s = conf.get_int(K.TASK_HEARTBEAT_INTERVAL_KEY, 1000) / 1000.0
         self.registration_timeout_s = conf.get_int(
             K.TASK_REGISTRATION_TIMEOUT_KEY, 300000) / 1000.0
+        #: coordinator-crash survival: how long the heartbeater keeps
+        #: probing an unreachable coordinator before the executor gives
+        #: up (0 = legacy fail-fast after 5 missed sends)
+        self.reattach_timeout_s = conf.get_int(
+            K.COORDINATOR_REATTACH_TIMEOUT_KEY, 30000) / 1000.0
+        self._heartbeater: Heartbeater | None = None
         self.bootstrap: dict | None = None
         self._started_at = time.monotonic()
         #: elastic resync: set by the heartbeat epoch observer when the
@@ -289,6 +407,48 @@ class TaskExecutor:
         self._resync_target = max(self._resync_target, epoch)
         self._resync.set()
         self._interrupt_user_process()
+
+    def _refresh_rpc(self) -> None:
+        """Re-attach probe hook (runs on the Heartbeater thread): re-read
+        coordinator.addr from the job dir — a restarted coordinator
+        usually rebinds its journaled port, but a port collision makes it
+        pick a fresh one and rewrite the file — and swap in a
+        freshly-dialed RPC client. The heartbeater AND the executor's
+        own handle both move, so the final-beat flush and the
+        register_execution_result report reach the moved coordinator."""
+        path = os.path.join(os.getcwd(), constants.COORDINATOR_ADDR_FILE)
+        try:
+            with open(path) as f:
+                addr = f.read().strip()
+        except OSError:
+            return
+        if not addr:
+            return
+        if addr != self.am_address:
+            log.info("coordinator address moved %s -> %s",
+                     self.am_address, addr)
+            self.am_address = addr
+        # Same-address restart is the COMMON case (the recovered
+        # coordinator rebinds its journaled port) and the old channel is
+        # stuck in gRPC's connection backoff — force a fresh dial either
+        # way; probes run at most every couple hundred ms, so the churn
+        # is bounded.
+        self.rpc = ApplicationRpcClient.reconnect(addr)
+        if self._heartbeater is not None:
+            self._heartbeater.rpc = self.rpc
+
+    def _on_coordinator_restart(self, incarnation: int) -> None:
+        """Incarnation-change observer (runs on the Heartbeater thread): a
+        restarted coordinator recovered the session from its journal and
+        re-adopted us from the journaled spec — re-run the registration
+        handshake to confirm our live endpoint (idempotent; the recovered
+        barrier is already released, so this returns immediately and the
+        epoch is unchanged — the user process is never touched)."""
+        log.warning("re-attached to restarted coordinator (incarnation %d) "
+                    "— re-running the registration handshake", incarnation)
+        self.register_and_get_cluster_spec()
+        log.info("re-attach handshake complete (epoch %d)",
+                 self.bootstrap.get("cluster_epoch", 0))
 
     def _interrupt_user_process(self) -> None:
         with self._user_proc_lock:
@@ -367,7 +527,15 @@ class TaskExecutor:
                     "mesh_spec": resp.mesh_spec,
                     "cluster_epoch": resp.cluster_epoch,
                     "channel_spec": getattr(resp, "channel_spec", ""),
+                    "incarnation": getattr(resp, "incarnation", 0),
                 }
+                if self._heartbeater is not None:
+                    # keep the heartbeater's first-seen incarnation in
+                    # step with the coordinator that just answered the
+                    # handshake, so only FUTURE restarts trigger another
+                    # re-attach
+                    self._heartbeater.incarnation = \
+                        self.bootstrap["incarnation"]
                 return self.bootstrap
             if time.monotonic() > deadline:
                 raise TimeoutError(
@@ -650,7 +818,12 @@ class TaskExecutor:
                                   gcs_token_file=token_file,
                                   snapshot_fn=self.metrics_snapshot,
                                   on_epoch=self._on_cluster_epoch,
-                                  spans_fn=self.trace_batch)
+                                  spans_fn=self.trace_batch,
+                                  reattach_timeout_s=self.reattach_timeout_s,
+                                  refresh_rpc=self._refresh_rpc,
+                                  on_reattach=self._on_coordinator_restart)
+        heartbeater.incarnation = self.bootstrap.get("incarnation", 0)
+        self._heartbeater = heartbeater
         heartbeater.start()
         if (self.job_name == constants.WORKER_JOB_NAME and self.task_index == 0):
             try:

@@ -1,0 +1,344 @@
+"""Durable session journal: the coordinator's write-ahead log.
+
+A coordinator crash used to kill the whole job — every live slice
+(minutes of provisioning + staging) was forgotten with the process.
+This module makes the expensive-to-rediscover state durable: the
+coordinator appends one fsync'd, checksummed record per state
+transition (launches, registrations, completions, elastic epochs,
+checkpoint watermarks), and a restarted coordinator replays the file
+to rebuild its :class:`~tony_tpu.cluster.session.Session` and re-adopt
+the still-running executors instead of relaunching them.
+
+Format — one record per line::
+
+    crc32hex SP json LF
+
+where ``crc32hex`` is the zero-padded lowercase CRC-32 of the JSON
+bytes, and the JSON is compact with sorted keys (so identical records
+are byte-identical). Every append is written in one ``write`` call,
+flushed, and ``fsync``'d before the caller proceeds.
+
+Torn-tail policy: because appends are single writes, a crash can only
+corrupt the FINAL record (a partial line). Replay therefore tolerates
+an invalid final record — it is dropped (and physically truncated when
+``truncate_torn=True``) — but an invalid record with valid records
+AFTER it cannot be explained by a crash mid-append: that is real
+corruption, and replay fails loudly with the byte offset so the fsck
+(``python -m tony_tpu.cluster.journal --verify <job_dir>``) can point
+at it.
+
+Record kinds (unknown kinds are ignored on fold, so old coordinators
+can replay journals written by newer ones):
+
+- ``coordinator_start`` — one per coordinator process; the count IS the
+  incarnation id served to executors
+- ``rpc_bound`` — the control-plane port, re-bound on restart so
+  executors' cached addresses stay valid
+- ``launch`` — a task submitted to the backend (allocation id + local
+  pid when the backend knows one; the pid is what LocalBackend adopts)
+- ``task_registered`` — worker spec + channel port (first registration
+  of each task generation)
+- ``completion`` / ``task_restart`` — the completion reduction's
+  durable shadow
+- ``elastic_shrink`` / ``regrow_armed`` / ``regrow_activated`` — the
+  elastic plane's epoch transitions
+- ``session_reset`` — whole-job retry: per-task state starts over
+- ``watermark`` — committed-checkpoint watermarks (named monotonic
+  values; the persistent-daemon roadmap item resumes from these)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import threading
+import zlib
+from dataclasses import dataclass, field
+
+log = logging.getLogger("tony_tpu.journal")
+
+JOURNAL_FILE = "session.journal"
+
+
+class JournalCorruptError(RuntimeError):
+    """An invalid NON-final record: not explicable by a torn append."""
+
+    def __init__(self, path: str, offset: int, reason: str) -> None:
+        super().__init__(
+            f"{path}: corrupt journal record at byte offset {offset}: "
+            f"{reason}")
+        self.path = path
+        self.offset = offset
+        self.reason = reason
+
+
+def journal_path(job_dir: str) -> str:
+    return os.path.join(job_dir, JOURNAL_FILE)
+
+
+def encode_record(record: dict) -> bytes:
+    payload = json.dumps(record, sort_keys=True,
+                         separators=(",", ":")).encode("utf-8")
+    return b"%08x " % (zlib.crc32(payload) & 0xFFFFFFFF) + payload + b"\n"
+
+
+def _decode_line(line: bytes) -> tuple[dict | None, str]:
+    """(record, "") for a valid line, (None, reason) otherwise."""
+    if len(line) < 10 or line[8:9] != b" ":
+        return None, "malformed header"
+    try:
+        want = int(line[:8], 16)
+    except ValueError:
+        return None, "malformed checksum"
+    payload = line[9:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != want:
+        return None, "checksum mismatch"
+    try:
+        record = json.loads(payload)
+    except ValueError:
+        return None, "invalid JSON payload"
+    if not isinstance(record, dict) or "k" not in record:
+        return None, "record is not a keyed object"
+    return record, ""
+
+
+def scan(path: str) -> tuple[list[dict], int | None, str]:
+    """Decode every record; returns (records, torn_offset, torn_reason).
+
+    ``torn_offset`` is None for a clean file, else the byte offset of an
+    invalid FINAL record (recoverable by truncation). An invalid record
+    with valid data after it raises :class:`JournalCorruptError`.
+    """
+    with open(path, "rb") as f:
+        data = f.read()
+    records: list[dict] = []
+    offset = 0
+    while offset < len(data):
+        nl = data.find(b"\n", offset)
+        end = nl if nl >= 0 else len(data)
+        record, reason = _decode_line(data[offset:end])
+        if record is None:
+            if nl >= 0 and nl != len(data) - 1:
+                raise JournalCorruptError(path, offset, reason)
+            return records, offset, reason
+        records.append(record)
+        if nl < 0:
+            break       # valid checksum, just no trailing newline
+        offset = nl + 1
+    return records, None, ""
+
+
+def replay(path: str, truncate_torn: bool = False) -> list[dict]:
+    """Decode the journal, tolerating (and optionally truncating) a torn
+    final record. Raises :class:`JournalCorruptError` on interior
+    corruption and propagates ``FileNotFoundError`` for a missing file."""
+    records, torn_offset, reason = scan(path)
+    if torn_offset is not None:
+        log.warning("%s: dropping torn final record at byte offset %d "
+                    "(%s)%s", path, torn_offset, reason,
+                    " — truncating" if truncate_torn else "")
+        if truncate_torn:
+            with open(path, "r+b") as f:
+                f.truncate(torn_offset)
+    return records
+
+
+@dataclass
+class TaskRecord:
+    """Folded per-task state (one journaled task generation)."""
+    task_id: str
+    spec: str = ""
+    channel_port: int = 0
+    allocation_id: int = -1
+    pid: int = 0
+    registered: bool = False
+    completed: bool = False
+    exit_code: int = 0
+    restarts: int = 0
+    detached: bool = False
+
+
+@dataclass
+class RecoveredState:
+    """The deterministic fold of a journal: same records, same state."""
+    incarnation: int = 0
+    app_id: str = ""
+    session_id: int = 0
+    cluster_epoch: int = 0
+    rpc_port: int = 0
+    tasks: dict[str, TaskRecord] = field(default_factory=dict)
+    regrow_pending: set[str] = field(default_factory=set)
+    watermarks: dict[str, float] = field(default_factory=dict)
+
+    def live_tasks(self) -> list[TaskRecord]:
+        """Tasks whose executor may still be running: registered, not
+        completed, not detached — the re-adoption set."""
+        return [t for t in self.tasks.values()
+                if t.registered and not t.completed and not t.detached]
+
+
+def fold(records: list[dict]) -> RecoveredState:
+    """Reduce a record list to the recovered session state. Pure and
+    deterministic: the replay-determinism test pins that the same journal
+    always folds to the same state. Unknown record kinds are skipped."""
+    state = RecoveredState()
+
+    def task(tid: str) -> TaskRecord:
+        return state.tasks.setdefault(tid, TaskRecord(task_id=tid))
+
+    for r in records:
+        kind = r.get("k")
+        if kind == "coordinator_start":
+            state.incarnation += 1
+            state.app_id = r.get("app_id", state.app_id)
+        elif kind == "rpc_bound":
+            state.rpc_port = int(r.get("port", 0))
+        elif kind == "session_reset":
+            state.session_id = int(r.get("session_id", 0))
+            state.cluster_epoch = 0
+            state.tasks.clear()
+            state.regrow_pending.clear()
+        elif kind == "launch":
+            t = task(r["task_id"])
+            t.allocation_id = int(r.get("allocation_id", -1))
+            t.pid = int(r.get("pid", 0))
+        elif kind == "task_registered":
+            t = task(r["task_id"])
+            t.spec = r.get("spec", "")
+            t.channel_port = int(r.get("channel_port", 0))
+            t.registered = True
+        elif kind == "completion":
+            t = task(r["task_id"])
+            t.completed = True
+            t.exit_code = int(r.get("exit_code", 0))
+        elif kind == "task_restart":
+            t = task(r["task_id"])
+            t.restarts += 1
+            t.registered = False
+            t.completed = False
+            t.spec = ""
+            t.pid = 0
+        elif kind == "elastic_shrink":
+            state.cluster_epoch = int(r.get("epoch", state.cluster_epoch))
+            for tid in r.get("lost", []):
+                t = task(tid)
+                t.detached = True
+                t.completed = True
+                t.exit_code = int(r.get("exit_code", -1))
+        elif kind == "regrow_armed":
+            for tid in r.get("task_ids", []):
+                t = task(tid)
+                t.registered = False
+                t.completed = False
+                t.spec = ""
+                t.pid = 0
+                state.regrow_pending.add(tid)
+        elif kind == "regrow_activated":
+            state.cluster_epoch = int(r.get("epoch", state.cluster_epoch))
+            for tid in r.get("task_ids", []):
+                task(tid).detached = False
+                state.regrow_pending.discard(tid)
+        elif kind == "watermark":
+            state.watermarks[r.get("name", "checkpoint")] = r.get("value")
+    return state
+
+
+class Journal:
+    """Append-side handle. Durability is best-effort-but-loud: an append
+    that hits an OSError logs once and disables further journaling (the
+    job keeps running — it just loses restartability), instead of
+    turning a full disk into a job failure."""
+
+    def __init__(self, job_dir: str) -> None:
+        self.path = journal_path(job_dir)
+        self._lock = threading.Lock()
+        self._f = None
+        self._dead = False
+
+    def append(self, kind: str, **payload) -> None:
+        record = dict(payload)
+        record["k"] = kind
+        with self._lock:
+            if self._dead:
+                return
+            try:
+                if self._f is None:
+                    self._f = open(self.path, "ab")
+                self._f.write(encode_record(record))
+                self._f.flush()
+                os.fsync(self._f.fileno())
+            except OSError:
+                log.error("session journal append failed — journaling "
+                          "disabled (job keeps running, restart recovery "
+                          "lost)", exc_info=True)
+                self._dead = True
+                try:
+                    if self._f is not None:
+                        self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                self._f = None
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Journal fsck: ``python -m tony_tpu.cluster.journal --verify DIR``.
+
+    Exit 0: clean (a recoverable torn tail still counts as clean, and is
+    reported). Exit 1: usage / missing file. Exit 2: interior corruption
+    — the offset in the message is where recovery would have to stop.
+    """
+    parser = argparse.ArgumentParser(
+        prog="python -m tony_tpu.cluster.journal",
+        description="Verify a job dir's session journal.")
+    parser.add_argument("--verify", metavar="JOB_DIR", required=True,
+                        help="job dir (or journal file) to check")
+    args = parser.parse_args(argv)
+    path = args.verify
+    if os.path.isdir(path):
+        path = journal_path(path)
+    try:
+        records, torn_offset, torn_reason = scan(path)
+    except FileNotFoundError:
+        print(f"ERROR: no journal at {path}")
+        return 1
+    except JournalCorruptError as e:
+        print(f"CORRUPT: {e}")
+        return 2
+    state = fold(records)
+    print(f"OK: {len(records)} record(s), incarnation {state.incarnation}, "
+          f"session {state.session_id}, cluster epoch {state.cluster_epoch},"
+          f" rpc port {state.rpc_port}")
+    if torn_offset is not None:
+        print(f"torn final record at byte offset {torn_offset} "
+              f"({torn_reason}) — recoverable by truncation")
+    kinds: dict[str, int] = {}
+    for r in records:
+        kinds[r.get("k", "?")] = kinds.get(r.get("k", "?"), 0) + 1
+    for kind in sorted(kinds):
+        print(f"  {kind}: {kinds[kind]}")
+    for tid in sorted(state.tasks):
+        t = state.tasks[tid]
+        phase = ("completed" if t.completed and not t.detached
+                 else "detached" if t.detached
+                 else "running" if t.registered
+                 else "launched")
+        extra = f" exit={t.exit_code}" if t.completed else ""
+        print(f"  task {tid}: {phase} pid={t.pid} "
+              f"alloc={t.allocation_id}{extra}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

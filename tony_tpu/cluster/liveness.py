@@ -36,11 +36,17 @@ class HeartbeatMonitor:
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
-    def register(self, task_id: str) -> None:
+    def register(self, task_id: str, grace_s: float = 0.0) -> None:
         """Start tracking a task (first ping = registration time, reference
-        :833 registers the task with the monitor when its spec arrives)."""
+        :833 registers the task with the monitor when its spec arrives).
+
+        ``grace_s`` credits the task extra silence on top of the normal
+        expiry window — a restarted coordinator re-adopting live tasks
+        grants each one its full executor re-attach window, so a task
+        whose executor is still backing off toward the NEW coordinator is
+        not declared dead for an outage the coordinator itself caused."""
         with self._lock:
-            self._last_ping[task_id] = time.monotonic()
+            self._last_ping[task_id] = time.monotonic() + grace_s
 
     def unregister(self, task_id: str) -> None:
         """Stop tracking (task completed normally)."""

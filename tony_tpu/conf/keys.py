@@ -49,6 +49,22 @@ AM_MEMORY_KEY = "tony.am.memory"
 AM_VCORES_KEY = "tony.am.vcores"
 AM_GPUS_KEY = "tony.am.gpus"
 
+# Coordinator crash recovery ("tony.coordinator.*"): the write-ahead
+# session journal + executor re-attach plane. A restarted coordinator
+# (tony.am.retry-count relaunches on the SAME job dir) replays the
+# journal, re-adopts live slices, and serves a bumped incarnation id;
+# executors ride out the outage instead of suiciding.
+# ---------------------------------------------------------------------------
+# How long an executor keeps retrying an unreachable coordinator before
+# giving up (exit 75, the lost-coordinator suicide). Also the liveness
+# grace a restarted coordinator grants re-adopted tasks on top of the
+# normal expiry window. 0 restores the old fail-fast behavior (five
+# consecutive heartbeat failures are fatal).
+COORDINATOR_REATTACH_TIMEOUT_KEY = "tony.coordinator.reattach-timeout-ms"
+# Write the fsync'd session journal (<job_dir>/session.journal). Off
+# means a coordinator crash loses the session exactly as before.
+COORDINATOR_JOURNAL_ENABLED_KEY = "tony.coordinator.journal-enabled"
+
 # ---------------------------------------------------------------------------
 # Task keys ("tony.task.*")
 # ---------------------------------------------------------------------------
@@ -273,6 +289,8 @@ DEFAULTS: dict[str, str] = {
     AM_MEMORY_KEY: "2g",
     AM_VCORES_KEY: "1",
     AM_GPUS_KEY: "0",
+    COORDINATOR_REATTACH_TIMEOUT_KEY: "30000",
+    COORDINATOR_JOURNAL_ENABLED_KEY: "true",
     TASK_EXECUTOR_PYTHON_OPTS_KEY: "",
     TASK_HEARTBEAT_INTERVAL_KEY: "1000",
     TASK_MAX_MISSED_HEARTBEATS_KEY: "25",
@@ -345,7 +363,8 @@ INSTANCES_REGEX = re.compile(r"^tony\.([a-z][a-z0-9]*)\.instances$")
 NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
                                 "launch", "elastic", "metrics", "pipeline",
-                                "channel", "trace", "router", "fleet"})
+                                "channel", "trace", "router", "fleet",
+                                "coordinator"})
 
 
 def instances_key(job_type: str) -> str:

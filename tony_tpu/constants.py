@@ -188,6 +188,14 @@ TEST_PREEMPT_SLICE = "TEST_PREEMPT_SLICE"                    # TPU-only: simulat
 # the kill fires when the marker file exists — trainers touch the marker
 # from a step hook, making "kill gang G at step K" exactly reproducible.
 TEST_PREEMPT_TASKS = "TEST_PREEMPT_TASKS"
+# Coordinator-kill chaos for the local backend (the crash-recovery
+# suite's kill-coordinator-at-step hook): the value is a marker-file
+# path; when the marker exists the backend SIGKILLs the COORDINATOR
+# process (the local backend runs inside it) exactly once — a sentinel
+# file ("<marker>.fired") survives the kill so the restarted
+# coordinator does not re-fire. Trainers touch the marker from a step
+# hook, making "kill the coordinator at step K" exactly reproducible.
+TEST_KILL_COORDINATOR = "TEST_KILL_COORDINATOR"
 
 # ---------------------------------------------------------------------------
 # Exit codes / misc

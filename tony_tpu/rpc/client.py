@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import threading
 import time
 
@@ -109,6 +110,23 @@ class ApplicationRpcClient(ApplicationRpc):
                 _instances[address] = cls(address)
             return _instances[address]
 
+    @classmethod
+    def reconnect(cls, address: str) -> "ApplicationRpcClient":
+        """Evict any cached client for ``address`` and dial a fresh
+        channel. A coordinator that died and came back on the SAME
+        address (the journal-recovery restart rebinds its old port)
+        leaves the cached channel deep in gRPC's connection backoff —
+        calls keep failing fast long after the server is serving again.
+        A new channel dials immediately."""
+        with _instances_lock:
+            old = _instances.pop(address, None)
+        if old is not None:
+            try:
+                old._channel.close()
+            except Exception:
+                pass
+        return cls.get_instance(address)
+
     def close(self) -> None:
         self._channel.close()
         with _instances_lock:
@@ -119,12 +137,24 @@ class ApplicationRpcClient(ApplicationRpc):
 
     # -- retry wrapper ------------------------------------------------------
     def _call(self, stub, request, retries: int | None = None,
-              idempotent: bool = True):
+              idempotent: bool = True, deadline_s: float = 10.0):
         """Retry policy: UNAVAILABLE always retries (the request never reached
         a serving coordinator). DEADLINE_EXCEEDED may mean the server *did*
         process the call, so it only retries for idempotent methods — the
         coordinator's register_worker_spec/heartbeat are idempotent by
         contract (keyed on task id); register_execution_result is not.
+
+        ``deadline_s`` is the per-ATTEMPT gRPC deadline; idempotent reads
+        on hot paths (get_cluster_spec during the barrier poll,
+        get_application_status from the client's monitor loop) pass a
+        tighter one so a wedged coordinator surfaces as a quick retryable
+        DEADLINE_EXCEEDED instead of a 10s stall per attempt.
+
+        The backoff sleep is jittered (uniform in [0.5, 1.0] of the
+        nominal delay): a coordinator restart makes every executor's
+        calls fail at the same instant, and unjittered exponential
+        backoff would re-synchronize them into thundering-herd retry
+        waves against the recovering process.
 
         ``request`` may be a zero-arg callable, rebuilt PER ATTEMPT —
         for requests carrying a send timestamp (the heartbeat's
@@ -136,7 +166,7 @@ class ApplicationRpcClient(ApplicationRpc):
         for _ in range(retries):
             try:
                 req = request() if callable(request) else request
-                return stub(req, timeout=10.0, metadata=self._metadata)
+                return stub(req, timeout=deadline_s, metadata=self._metadata)
             except grpc.RpcError as e:
                 code = e.code() if hasattr(e, "code") else None
                 retryable = code == grpc.StatusCode.UNAVAILABLE or (
@@ -144,7 +174,7 @@ class ApplicationRpcClient(ApplicationRpc):
                 if not retryable:
                     raise
                 last_err = e
-                time.sleep(backoff)
+                time.sleep(backoff * (0.5 + random.random() / 2))
                 backoff = min(backoff * 2, self.max_backoff_s)
         raise RpcRetryError(
             f"RPC to {self.address} failed after {retries} retries: {last_err}")
@@ -155,8 +185,11 @@ class ApplicationRpcClient(ApplicationRpc):
         return [TaskUrl(u.name, u.index, u.url) for u in resp.task_urls]
 
     def get_cluster_spec(self, task_id: str) -> str:
+        # Idempotent barrier-poll read: tight per-attempt deadline so a
+        # wedged (or restarting) coordinator costs 3s per attempt, not 10.
         resp = self._call(self._get_cluster_spec,
-                          pb.GetClusterSpecRequest(task_id=task_id))
+                          pb.GetClusterSpecRequest(task_id=task_id),
+                          deadline_s=3.0)
         return resp.cluster_spec
 
     def register_worker_spec(self, worker: str, spec: str,
@@ -169,7 +202,8 @@ class ApplicationRpcClient(ApplicationRpc):
             spec=resp.spec, coordinator_address=resp.coordinator_address,
             process_id=resp.process_id, num_processes=resp.num_processes,
             mesh_spec=resp.mesh_spec, cluster_epoch=resp.cluster_epoch,
-            channel_spec=resp.channel_spec)
+            channel_spec=resp.channel_spec,
+            incarnation=getattr(resp, "incarnation", 0))
 
     def register_tensorboard_url(self, spec: str) -> str:
         resp = self._call(self._register_tb_url,
@@ -220,13 +254,17 @@ class ApplicationRpcClient(ApplicationRpc):
 
         resp = self._call(self._heartbeat, build, retries=2)
         return HeartbeatAck(gcs_token=resp.gcs_token,
-                            cluster_epoch=resp.cluster_epoch)
+                            cluster_epoch=resp.cluster_epoch,
+                            incarnation=getattr(resp, "incarnation", 0))
 
     def renew_gcs_token(self, token: str) -> None:
         self._call(self._renew_gcs_token,
                    pb.RenewGcsTokenRequest(token=token))
 
     def get_application_status(self) -> ApplicationStatus:
-        resp = self._call(self._get_status, pb.GetApplicationStatusRequest())
+        # Idempotent status poll (client monitor loop, ~every few seconds):
+        # same tight-deadline treatment as get_cluster_spec.
+        resp = self._call(self._get_status, pb.GetApplicationStatusRequest(),
+                          deadline_s=3.0)
         return ApplicationStatus(status=resp.status, message=resp.message,
                                  session_id=resp.session_id)

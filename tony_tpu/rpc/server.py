@@ -118,7 +118,8 @@ class ApplicationRpcServer:
                 process_id=r.process_id, num_processes=r.num_processes,
                 mesh_spec=r.mesh_spec,
                 cluster_epoch=getattr(r, "cluster_epoch", 0),
-                channel_spec=getattr(r, "channel_spec", ""))
+                channel_spec=getattr(r, "channel_spec", ""),
+                incarnation=getattr(r, "incarnation", 0))
 
         def _register_tb_url(req, ctx):
             return pb.RegisterTensorBoardUrlResponse(
@@ -163,7 +164,8 @@ class ApplicationRpcServer:
             if isinstance(ack, str) or ack is None:
                 return pb.HeartbeatResponse(gcs_token=ack or "")
             return pb.HeartbeatResponse(gcs_token=ack.gcs_token or "",
-                                        cluster_epoch=ack.cluster_epoch)
+                                        cluster_epoch=ack.cluster_epoch,
+                                        incarnation=getattr(ack, "incarnation", 0))
 
         def _renew_gcs_token(req, ctx):
             impl.renew_gcs_token(req.token)

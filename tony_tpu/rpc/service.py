@@ -44,7 +44,11 @@ class WorkerSpecResponse:
     and re-holds the barrier, so a released payload always carries the
     epoch its spec belongs to. ``channel_spec`` is the coordinator's
     channel-registry entry for THIS worker (JSON: pipeline stage
-    id/count + peer hub endpoints; "" for non-pipeline jobs)."""
+    id/count + peer hub endpoints; "" for non-pipeline jobs).
+    ``incarnation`` is the coordinator process GENERATION (count of
+    coordinator starts on this job dir, journal-derived): a restarted
+    coordinator serves a higher value, telling re-registering executors
+    they are re-attaching, not bootstrapping (0 = not tracked)."""
     spec: str = ""
     coordinator_address: str = ""
     process_id: int = -1
@@ -52,6 +56,7 @@ class WorkerSpecResponse:
     mesh_spec: str = ""
     cluster_epoch: int = 0
     channel_spec: str = ""
+    incarnation: int = 0
 
     @property
     def released(self) -> bool:
@@ -65,9 +70,15 @@ class HeartbeatAck:
     executor's own is the elastic resync directive — stop the user
     process at the next safe point and re-run the registration handshake
     (implementations may also return a bare token ``str``; the server
-    maps it to epoch 0, the pre-elastic wire shape)."""
+    maps it to epoch 0, the pre-elastic wire shape). ``incarnation`` is
+    the coordinator process GENERATION: an incarnation that CHANGES
+    mid-job (from a nonzero first-seen value) tells the executor a
+    restarted coordinator recovered the session from its journal — it
+    re-runs the registration handshake without touching the user
+    process (0 = not tracked)."""
     gcs_token: str = ""
     cluster_epoch: int = 0
+    incarnation: int = 0
 
 
 class ApplicationRpc(abc.ABC):
