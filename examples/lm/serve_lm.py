@@ -434,6 +434,12 @@ def main() -> int:
                              "distinct prompt length; default pads to "
                              "power-of-two buckets and batches freed "
                              "slots into one dispatch)")
+    parser.add_argument("--warm_from", default="", metavar="HOST:PORT",
+                        help="warm boot: pull content-addressed "
+                             "weights peer-to-peer from a serving "
+                             "replica's weights lane instead of a "
+                             "storage load (falls back to "
+                             "--ckpt_dir / random params on failure)")
     parser.add_argument("--listen", default="", metavar="HOST:PORT",
                         help="serve a LIVE admission queue over the "
                              "TONYS1 streaming protocol instead of the "
@@ -522,14 +528,27 @@ def main() -> int:
         kv_cache_dtype=args.kv_cache_dtype,
         attn_window=args.attn_window,
         kv_cache_capacity=args.kv_cache_capacity)
-    params = T.init_params(jax.random.PRNGKey(0), cfg)
-    if args.ckpt_dir:
-        with CheckpointManager(args.ckpt_dir) as mgr:
-            from tony_tpu.models.train import default_optimizer, init_state
-            state = mgr.restore(
-                template=init_state(params, default_optimizer()))
-        params = state["params"]
-        print(f"restored step {int(state['step'])} from {args.ckpt_dir}")
+    params = None
+    if args.warm_from:
+        from tony_tpu.serving.weightstore import pull_weights
+        try:
+            meta, params = pull_weights(args.warm_from)
+            print(f"warm boot: pulled weights "
+                  f"{meta['digest'][:12]}… from {args.warm_from}")
+        except Exception as e:              # noqa: BLE001 — degrade
+            print(f"warm boot from {args.warm_from} failed ({e}); "
+                  f"falling back to a storage load")
+    if params is None:
+        params = T.init_params(jax.random.PRNGKey(0), cfg)
+        if args.ckpt_dir:
+            with CheckpointManager(args.ckpt_dir) as mgr:
+                from tony_tpu.models.train import (default_optimizer,
+                                                   init_state)
+                state = mgr.restore(
+                    template=init_state(params, default_optimizer()))
+            params = state["params"]
+            print(f"restored step {int(state['step'])} from "
+                  f"{args.ckpt_dir}")
     if args.quantize_weights:
         from tony_tpu.models.quantize import quantize_weights_int8
         params = quantize_weights_int8(params)

@@ -304,6 +304,62 @@ class TestRollingUpgrade:
             fleet.stop()
 
 
+class TestWarmScaleUp:
+    def test_seeder_crash_mid_ship_falls_back_to_storage(self):
+        """Chaos pin for the warm path: the upgrade's ONLY seeder is
+        killed mid-ship. The fan-out condemns it, mints a fresh seeder
+        off a storage load, warms the whole new tier anyway, and the
+        upgrade completes with exact token streams — a crashed seeder
+        degrades to the old cold path, never a wedged fleet."""
+        from tony_tpu.serving.simfleet import SimWarmer
+
+        reg = MetricsRegistry()
+        fleet = SimFleet(2, itl_s=0.004, slots=16,
+                         weights_version="v1", registry=reg)
+        out = {}
+        try:
+            port = fleet.start()
+            # the doomed seeder: warm, not routed, killed mid-ship
+            doomed = fleet.spawn(weights_version="v2")
+            warmer = SimWarmer(fleet, "v2", seeders=[doomed],
+                               ship_s=0.3, load_s=0.05)
+            ctrl = FleetController(fleet.router, SimProvider(fleet),
+                                   registry=reg, warmer=warmer)
+            with StreamingClient("127.0.0.1", port) as client:
+                seeds, threads = _launch_streams(client, 4, 60, out)
+                _wait_spread(client)
+                new_addrs = [fleet.spawn(weights_version=None)
+                             for _ in range(4)]
+                # the first ship holds its ship_s floor for 0.3s; the
+                # seeder dies 0.1s in — a genuine crash mid-transfer
+                killer = threading.Timer(0.1, fleet.kill, args=(doomed,))
+                killer.start()
+                results = ctrl.rolling_upgrade(new_addrs)
+                killer.join()
+                for addr, res in results.items():
+                    assert res.get("drained"), (addr, res)
+                warm = ctrl.last_warm
+                assert warm is not None and not warm["failed"], warm
+                # the crash cost exactly one storage load, then the
+                # minted seeder fanned out to the rest
+                assert warmer.loads == 1
+                assert len(warm["fallback"]) == 1
+                assert len(warm["warmed"]) == 3
+                for t in threads:
+                    t.join(timeout=60)
+                _assert_exact(out, seeds, 60)
+                reps = client.stats()["replicas"]
+                assert set(reps) == set(new_addrs)      # doomed never routed
+                assert all(r["weights_version"] == "v2"
+                           for r in reps.values())
+                # the fleet is live: a fresh session streams to budget
+                rid = client.submit([7, 1, 2, 3], 5)
+                toks, reason = client.result(rid)
+                assert reason == "budget" and toks == _oracle(7, 5)
+        finally:
+            fleet.stop()
+
+
 class _ScriptedRouter:
     """stats()-only stand-in driving FleetController.tick()
     deterministically: each tick() observes the next scripted

@@ -106,6 +106,28 @@ _HLEN = struct.Struct("<I")     # tensor-header length prefix
 #: than shipping something the peer will reject.
 MAX_TENSOR_BYTES = 1 << 31
 
+#: magic prefix of a chunked byte-blob ENVELOPE frame (see
+#: :meth:`ChannelSender.send_bytes`): a blob larger than the chunk
+#: budget ships as one envelope frame — this magic + a JSON manifest
+#: ``{"v":1,"chunks":N,"total":T}`` — followed by N bounded chunk
+#: frames. Each chunk is an ordinary seq-numbered tensor frame, so a
+#: disconnect mid-blob resumes at the first unacked CHUNK, not the
+#: whole blob (zero duplicated / dropped bytes — test-pinned). A raw
+#: blob that happens to START with this magic is escaped into a
+#: single-chunk envelope so the receiver can never misparse it.
+BLOB_CHUNK_MAGIC = b"TONYB1\0"
+
+#: default chunk budget for :meth:`ChannelSender.send_bytes` (the
+#: ``tony.weights.chunk-bytes`` config key feeds callers that override
+#: it). 8 MiB keeps resend-on-reconnect work bounded while staying far
+#: above per-frame overhead.
+DEFAULT_BLOB_CHUNK_BYTES = 8 << 20
+
+#: sanity cap on a chunked blob's manifest (an envelope promising
+#: billions of chunks is a corrupt or adversarial frame, refused
+#: before the receiver commits to gathering them).
+MAX_BLOB_CHUNKS = 1 << 20
+
 #: send/recv wait buckets: DCN one-way latencies are milliseconds, a
 #: window stall can reach seconds — finer than the generic time ladder
 #: at the low end.
@@ -616,15 +638,54 @@ class ChannelSender:
         return seq
 
     def send_bytes(self, data, *, sync: bool = False,
-                   timeout: float | None = None) -> int:
-        """Ship an opaque byte blob as a 1-D uint8 tensor frame — the
-        lane structured multi-buffer payloads (the serving KV shipment,
-        ``tony_tpu/serving/kvship.py``) ride without teaching the
-        tensor plane their schema. Same window/reconnect/ordering
+                   timeout: float | None = None,
+                   chunk_bytes: int | None = None) -> int:
+        """Ship an opaque byte blob — the lane structured multi-buffer
+        payloads (the serving KV shipment, ``tony_tpu/serving/
+        kvship.py``; weight artifacts, ``tony_tpu/serving/
+        weightstore.py``) ride without teaching the tensor plane their
+        schema. A blob within ``chunk_bytes`` ships as ONE 1-D uint8
+        tensor frame; a larger one ships as an envelope frame
+        (:data:`BLOB_CHUNK_MAGIC` + manifest) followed by bounded chunk
+        frames, each an ordinary seq-numbered frame — so a multi-GB
+        blob inherits the window's backpressure and, on disconnect,
+        resumes at the first unacked CHUNK instead of resending (or
+        worse, dropping) the whole blob. Same window/reconnect/ordering
         contract as :meth:`send`; pair with
-        :meth:`ChannelReceiver.recv_bytes`."""
-        return self.send(np.frombuffer(data, dtype=np.uint8), sync=sync,
-                         timeout=timeout)
+        :meth:`ChannelReceiver.recv_bytes`. Returns the seq of the
+        blob's LAST frame (what ``sync=True`` waits on)."""
+        data = bytes(data) if not isinstance(data, (bytes, bytearray,
+                                                    memoryview)) else data
+        view = memoryview(data)
+        limit = chunk_bytes if chunk_bytes is not None \
+            else DEFAULT_BLOB_CHUNK_BYTES
+        if limit < 1:
+            raise ValueError(f"chunk_bytes must be >= 1, got {limit}")
+        magic_collision = view[:len(BLOB_CHUNK_MAGIC)] == BLOB_CHUNK_MAGIC
+        if len(view) <= limit and not magic_collision:
+            return self.send(np.frombuffer(view, dtype=np.uint8),
+                             sync=sync, timeout=timeout)
+        # chunked path: envelope first, then the chunks. Only the LAST
+        # frame honours sync — in-order exactly-once delivery means the
+        # last ack implies every earlier chunk landed.
+        chunks = max(1, -(-len(view) // limit))
+        manifest = json.dumps({"v": 1, "chunks": chunks,
+                               "total": len(view)},
+                              separators=(",", ":")).encode("utf-8")
+        envelope = BLOB_CHUNK_MAGIC + manifest
+        deadline = None if timeout is None else time.monotonic() + timeout
+        def left() -> float | None:
+            return None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+        self.send(np.frombuffer(envelope, dtype=np.uint8), sync=False,
+                  timeout=left())
+        seq = -1
+        for i in range(chunks):
+            part = view[i * limit:(i + 1) * limit]
+            last = i == chunks - 1
+            seq = self.send(np.frombuffer(part, dtype=np.uint8),
+                            sync=sync and last, timeout=left())
+        return seq
 
     def drain(self, timeout: float | None = None) -> None:
         """Block until every sent frame is acked."""
@@ -762,16 +823,57 @@ class ChannelReceiver:
 
     def recv_bytes(self, timeout: float | None = None) -> bytes:
         """Consume one opaque byte blob (the :meth:`ChannelSender.
-        send_bytes` counterpart). A frame that is not a 1-D uint8
-        tensor is a peer speaking the wrong sub-protocol — surfaced as
+        send_bytes` counterpart) — reassembling a chunked blob
+        (:data:`BLOB_CHUNK_MAGIC` envelope + chunk frames) back into
+        the exact sent bytes. A frame that is not a 1-D uint8 tensor is
+        a peer speaking the wrong sub-protocol — surfaced as
         ProtocolError so the consumer can scope it, never silently
         reinterpreted bytes."""
-        arr = self.recv(timeout)
+        deadline = None if timeout is None else time.monotonic() + timeout
+        def left() -> float | None:
+            return None if deadline is None \
+                else max(0.0, deadline - time.monotonic())
+        arr = self.recv(left())
         if arr.dtype != np.uint8 or arr.ndim != 1:
             raise ProtocolError(
                 f"expected a byte-blob frame (1-D uint8), got "
                 f"{arr.dtype}{list(arr.shape)}")
-        return arr.tobytes()
+        first = arr.tobytes()
+        if not first.startswith(BLOB_CHUNK_MAGIC):
+            return first
+        try:
+            man = json.loads(first[len(BLOB_CHUNK_MAGIC):]
+                             .decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as e:
+            raise ProtocolError(
+                f"malformed chunked-blob envelope: {e}") from e
+        chunks = man.get("chunks") if isinstance(man, dict) else None
+        total = man.get("total") if isinstance(man, dict) else None
+        if (isinstance(chunks, bool) or not isinstance(chunks, int)
+                or isinstance(total, bool) or not isinstance(total, int)
+                or not 1 <= chunks <= MAX_BLOB_CHUNKS or total < 0):
+            raise ProtocolError(
+                f"implausible chunked-blob manifest: {man!r}")
+        parts: list[bytes] = []
+        got = 0
+        for i in range(chunks):
+            part = self.recv(left())
+            if part.dtype != np.uint8 or part.ndim != 1:
+                raise ProtocolError(
+                    f"chunk {i}/{chunks} is not a byte frame: "
+                    f"{part.dtype}{list(part.shape)}")
+            b = part.tobytes()
+            got += len(b)
+            if got > total:
+                raise ProtocolError(
+                    f"chunked blob overflows its manifest: chunk {i} "
+                    f"brings {got} bytes past the promised {total}")
+            parts.append(b)
+        if got != total:
+            raise ProtocolError(
+                f"chunked blob reassembled to {got} bytes, manifest "
+                f"promised {total}")
+        return b"".join(parts)
 
     @property
     def last_seq(self) -> int:

@@ -269,6 +269,26 @@ ROUTER_HEALTH_INTERVAL_MS_KEY = "tony.router.health-interval-ms"
 ROUTER_MAX_MISSED_PINGS_KEY = "tony.router.max-missed-pings"
 
 # ---------------------------------------------------------------------------
+# Weight distribution plane ("tony.weights.*"): the warm scale-up path —
+# content-addressed weight + compiled-program artifacts shipped peer-to-peer
+# over the channel plane (tony_tpu/serving/weightstore.py) instead of N
+# replicas each cold-loading from storage.
+# ---------------------------------------------------------------------------
+# Chunk size for the resumable byte-blob lane a weight ship rides (each
+# chunk is one seq-numbered channel frame, so a disconnect mid-ship
+# resumes at the first unacked chunk instead of restarting the blob).
+WEIGHTS_CHUNK_BYTES_KEY = "tony.weights.chunk-bytes"
+# Ship int8-quantized weights on the wire (digest is computed over the
+# as-served dequantized tree on BOTH ends, so a lossy wire cannot land
+# silently — mismatches are refused). Only safe when the serving stack
+# dequantizes back to the exact shipped version; leave false otherwise.
+WEIGHTS_QUANTIZE_WIRE_KEY = "tony.weights.quantize-wire"
+# Directory for the shippable JAX persistent compilation cache ("" =
+# don't attach one). Shipping it alongside weights lands replicas
+# pre-traced: first token needs no XLA compile.
+WEIGHTS_COMPILE_CACHE_DIR_KEY = "tony.weights.compile-cache-dir"
+
+# ---------------------------------------------------------------------------
 # Defaults registry — the tony-default.xml analog. One entry per static key.
 # Values are strings, exactly like Hadoop Configuration; typed getters on
 # TonyConfig parse them.
@@ -349,6 +369,9 @@ DEFAULTS: dict[str, str] = {
     DOCKER_IMAGE_KEY: "",
     ROUTER_HEALTH_INTERVAL_MS_KEY: "500",
     ROUTER_MAX_MISSED_PINGS_KEY: "3",
+    WEIGHTS_CHUNK_BYTES_KEY: "8388608",
+    WEIGHTS_QUANTIZE_WIRE_KEY: "false",
+    WEIGHTS_COMPILE_CACHE_DIR_KEY: "",
 }
 
 # ---------------------------------------------------------------------------
@@ -364,7 +387,7 @@ NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
                                 "launch", "elastic", "metrics", "pipeline",
                                 "channel", "trace", "router", "fleet",
-                                "coordinator"})
+                                "coordinator", "weights"})
 
 
 def instances_key(job_type: str) -> str:

@@ -1,0 +1,176 @@
+"""The ONE JSON-header+raw-buffers blob codec every byte-blob lane
+shares, kind-tagged so no lane can silently misread another's blobs.
+
+Three kinds ride it today: KV row shipments (``kv_row``,
+prefill → decode handoff), shared-prefix templates
+(``prefix_template``, replica warming), and weight artifacts
+(``weights``, the scale-up warm path). Each lane holds a
+:class:`BlobCodec` bound to its kind: :meth:`BlobCodec.pack` stamps
+the kind into the header, :meth:`BlobCodec.unpack` parses the blob
+STRUCTURALLY first (so truncation / corrupt lengths / unknown dtypes
+surface as their own precise errors) and refuses a parse-clean blob
+whose kind belongs to another lane — a weight artifact routed onto the
+template lane fails loudly at the kind gate, never lands as a
+"template".
+
+Wire layout (little-endian)::
+
+    head_len   4 bytes  u32    JSON header length
+    header     head_len bytes  {"v": 1, "meta": {..., "kind": ...},
+                                "bufs": [{"name", "dtype", "shape"}...]}
+    payload    concatenated C-contiguous buffer bytes, in header order
+
+Buffers serialize in sorted-name order — deterministic wire bytes for
+identical inputs, which is what lets a content digest over the packed
+form name the artifact (see ``tony_tpu/serving/weightstore.py``).
+dtype resolution falls back to ``ml_dtypes`` for bfloat16 et al., so
+this module stays importable without jax.
+
+Anything structurally off raises the serving wire's
+:class:`~tony_tpu.serving.protocol.ProtocolError`.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import struct
+
+import numpy as np
+
+from tony_tpu.serving.protocol import ProtocolError
+
+_HLEN = struct.Struct("<I")
+
+#: sanity cap on the JSON header alone (buffer entries are dozens of
+#: bytes each; megabytes of "header" is a corrupt length prefix)
+MAX_HEADER_BYTES = 1 << 20
+
+#: the registered lane kinds (adding a kind here is what entitles a
+#: lane to the wire shape — an UNREGISTERED kind is refused everywhere,
+#: so a typo'd producer cannot mint a kind no consumer owns)
+KV_ROW_KIND = "kv_row"
+TEMPLATE_KIND = "prefix_template"
+WEIGHTS_KIND = "weights"
+KINDS = (KV_ROW_KIND, TEMPLATE_KIND, WEIGHTS_KIND)
+
+
+def np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype string, including the ml_dtypes extensions
+    (bfloat16 et al.) plain numpy cannot name."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        pass
+    try:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+    except (ImportError, AttributeError, TypeError) as e:
+        raise ProtocolError(f"unknown shipment dtype {name!r}") from e
+
+
+def pack_blob(meta: dict, bufs: dict) -> bytes:
+    """-> one blob (header + raw buffers). ``bufs``: {name: ndarray};
+    arrays are serialized C-contiguous in sorted-name order
+    (deterministic wire bytes for identical inputs)."""
+    entries, blobs = [], []
+    for name in sorted(bufs):
+        a = np.asarray(bufs[name])
+        shape = list(a.shape)          # before ascontiguousarray: it
+        if not a.flags["C_CONTIGUOUS"]:   # promotes 0-d to 1-d
+            a = np.ascontiguousarray(a)
+        entries.append({"name": name, "dtype": str(a.dtype),
+                        "shape": shape})
+        blobs.append(a.tobytes())
+    head = json.dumps({"v": 1, "meta": meta, "bufs": entries},
+                      separators=(",", ":")).encode("utf-8")
+    return _HLEN.pack(len(head)) + head + b"".join(blobs)
+
+
+def unpack_blob(blob: bytes) -> tuple[dict, dict]:
+    """Parse a blob -> (meta, {name: ndarray}), structural validation
+    only (kind gating is the codec's job). Arrays view the blob's
+    memory (frombuffer — no copy); callers that outlive the blob hold
+    a reference through the arrays automatically."""
+    if len(blob) < _HLEN.size:
+        raise ProtocolError("shipment shorter than its header prefix")
+    (hlen,) = _HLEN.unpack_from(blob, 0)
+    if hlen > MAX_HEADER_BYTES or _HLEN.size + hlen > len(blob):
+        raise ProtocolError(f"implausible shipment header length {hlen}")
+    try:
+        head = json.loads(blob[_HLEN.size:_HLEN.size + hlen]
+                          .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed shipment header: {e}") from e
+    if not isinstance(head, dict) or not isinstance(head.get("meta"),
+                                                    dict):
+        raise ProtocolError(f"shipment header is not an object: {head!r}")
+    entries = head.get("bufs")
+    if not isinstance(entries, list):
+        raise ProtocolError("shipment header missing buffer table")
+    bufs: dict = {}
+    off = _HLEN.size + hlen
+    for e in entries:
+        if (not isinstance(e, dict) or not isinstance(e.get("name"), str)
+                or not isinstance(e.get("dtype"), str)
+                or not isinstance(e.get("shape"), list)
+                or not all(isinstance(d, int) and not isinstance(d, bool)
+                           and d >= 0 for d in e["shape"])):
+            raise ProtocolError(f"malformed buffer entry: {e!r}")
+        dt = np_dtype(e["dtype"])
+        # python-int math: np.prod would WRAP on adversarial shapes
+        # ([2**32, 2**32] -> 0), sneaking a bogus buffer past the
+        # bounds check into a reshape crash
+        count = math.prod(e["shape"])
+        n = count * dt.itemsize
+        if off + n > len(blob):
+            raise ProtocolError(
+                f"shipment truncated: buffer {e['name']!r} promises "
+                f"{n} bytes past the blob end")
+        bufs[e["name"]] = np.frombuffer(
+            blob, dtype=dt, count=count,
+            offset=off).reshape(e["shape"])
+        off += n
+    if off != len(blob):
+        raise ProtocolError(
+            f"shipment carries {len(blob) - off} trailing bytes beyond "
+            f"its buffer table")
+    return head["meta"], bufs
+
+
+class BlobCodec:
+    """One lane's binding to the shared wire shape: packs with the
+    lane's kind stamped into the meta, unpacks with the kind gated.
+
+    ``allow_untagged`` grandfathers blobs whose meta carries NO kind
+    (the pre-kind kv-row wire shape) — a blob tagged with a DIFFERENT
+    kind is always refused, tagged or not."""
+
+    def __init__(self, kind: str, *, allow_untagged: bool = False) -> None:
+        if kind not in KINDS:
+            raise ValueError(f"unregistered blob kind {kind!r}; "
+                             f"expected one of {KINDS}")
+        self.kind = kind
+        self.allow_untagged = allow_untagged
+
+    def pack(self, meta: dict, bufs: dict) -> bytes:
+        out = dict(meta)
+        out["kind"] = self.kind
+        return pack_blob(out, bufs)
+
+    def unpack(self, blob: bytes) -> tuple[dict, dict]:
+        meta, bufs = unpack_blob(blob)
+        kind = meta.get("kind")
+        if kind != self.kind and not (kind is None and
+                                      self.allow_untagged):
+            raise ProtocolError(
+                f"blob kind {kind!r} does not belong on the "
+                f"{self.kind!r} lane")
+        return meta, bufs
+
+
+#: the three lane bindings (kv rows tolerate untagged legacy metas;
+#: the newer lanes never shipped untagged and do not)
+KV_ROW = BlobCodec(KV_ROW_KIND, allow_untagged=True)
+PREFIX_TEMPLATE = BlobCodec(TEMPLATE_KIND)
+WEIGHTS = BlobCodec(WEIGHTS_KIND)

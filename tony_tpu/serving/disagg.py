@@ -69,6 +69,8 @@ from tony_tpu.serving import kvship
 from tony_tpu.serving import protocol as P
 from tony_tpu.serving.prefix import PrefixHost, fingerprint, match_prefix
 from tony_tpu.serving.server import FrameConn, FrameServerBase
+from tony_tpu.serving.weightstore import WeightHost, pack_weights, \
+    tree_digest
 
 log = logging.getLogger(__name__)
 
@@ -116,7 +118,7 @@ class _PrefillItem:
                                          parent=self.span)
 
 
-class PrefillServer(PrefixHost, FrameServerBase):
+class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
     """The prefill tier of disaggregated serving (see module
     docstring). Stateless per request — no persistent KV cache, no
     decode loop: ADMIT → bucketed prefill wave → KV shipment →
@@ -146,7 +148,8 @@ class PrefillServer(PrefixHost, FrameServerBase):
                  bind_host: str = "127.0.0.1", port: int = 0,
                  channel_window: int = 8,
                  ship_timeout_s: float = 30.0, registry=None,
-                 weights_version: str | None = None) -> None:
+                 weights_version: str | None = None,
+                 weights_digest: str | None = None) -> None:
         super().__init__(bind_host, port)
         import jax
 
@@ -155,6 +158,10 @@ class PrefillServer(PrefixHost, FrameServerBase):
         #: the weights generation this tier serves (HELLO/STATS) — the
         #: router's version-pinned placement signal (rolling upgrades)
         self.weights_version = weights_version
+        #: content digest of the served weight tree (computed at
+        #: start() when not given) — the unversioned pinning fallback
+        #: and the peer-pull artifact name (warm scale-up)
+        self.weights_digest = weights_digest
         self.max_len = int(max_len)
         self.max_batch = int(max_batch)
         if self.max_batch < 1:
@@ -206,6 +213,11 @@ class PrefillServer(PrefixHost, FrameServerBase):
         self._ring_prefix_warned = False
         self._proto_bufs = None          # lazy layout prototype
         self._init_prefix_host(reg)
+        # weights lane shares the prefix hub port (kind-tagged blobs)
+        self._init_weight_host(
+            reg, exporter=lambda: pack_weights(
+                self.params, version=self.weights_version),
+            hub=self._prefix_hub)
 
     # -- resident prefix templates (PrefixHost hooks) -----------------------
     def install_prefix(self, tokens, prefix_id: str | None = None):
@@ -291,11 +303,17 @@ class PrefillServer(PrefixHost, FrameServerBase):
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
+        if self.weights_digest is None:
+            try:
+                self.weights_digest = tree_digest(self.params)
+            except Exception as e:          # noqa: BLE001 — advisory
+                log.warning("weights digest not computed: %s", e)
         self._worker = threading.Thread(target=self._work_loop,
                                         name="tony-prefill-worker",
                                         daemon=True)
         self._worker.start()
         self._start_prefix_host()
+        self._start_weight_host()
         port = super().start()
         log.info("prefill tier on %s:%s (%d-row waves; prefix lane on "
                  ":%s)", self.bind_host, port, self.max_batch,
@@ -310,6 +328,7 @@ class PrefillServer(PrefixHost, FrameServerBase):
         if self._worker is not None:
             self._worker.join(timeout=60)
         self._stop_prefix_host()
+        self._stop_weight_host()
         with self._senders_lock:
             senders, self._senders = list(self._senders.values()), {}
         for s in senders:
@@ -323,7 +342,10 @@ class PrefillServer(PrefixHost, FrameServerBase):
         return {"v": 1, "role": "prefill", "slots": self.max_batch,
                 "prefixes": self.resident_prefixes(),
                 "ring": self._ring, "prefix_port": self.prefix_port,
-                "weights_version": self.weights_version}
+                "weights_version": self.weights_version,
+                "weights_digest": self.weights_digest,
+                "weight_port": self.weight_port,
+                "weights_resident": self.weight_store.digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -335,6 +357,8 @@ class PrefillServer(PrefixHost, FrameServerBase):
             conn.send(P.STATS, 0, P.pack_json(self.stats()))
         elif ftype == P.PREFIX:
             self._handle_prefix_frame(conn, rid, payload)
+        elif ftype == P.WEIGHTS:
+            self._handle_weights_frame(conn, rid, payload)
         else:
             raise P.ProtocolError(
                 f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}"
@@ -347,7 +371,9 @@ class PrefillServer(PrefixHost, FrameServerBase):
                 "slots": self.max_batch, "role": "prefill",
                 "prefixes": self.resident_prefixes(),
                 "ring": self._ring,
-                "weights_version": self.weights_version}
+                "weights_version": self.weights_version,
+                "weights_digest": self.weights_digest,
+                "weights_resident": self.weight_store.digests()}
 
     def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
         prompt, max_new, _stream = P.parse_admit(payload)
@@ -660,7 +686,7 @@ class PrefillServer(PrefixHost, FrameServerBase):
             return sender
 
 
-class DecodeServer(FrameServerBase):
+class DecodeServer(WeightHost, FrameServerBase):
     """The decode tier of disaggregated serving: a
     :class:`~tony_tpu.models.serve.ServeEngine` whose admissions arrive
     as KV shipments through a :class:`ChannelHub` instead of as ADMIT
@@ -679,11 +705,15 @@ class DecodeServer(FrameServerBase):
                  channel_capacity: int = 8,
                  channel_advertise: int | None = None,
                  registry=None,
-                 weights_version: str | None = None) -> None:
+                 weights_version: str | None = None,
+                 weights_digest: str | None = None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
 
         self.weights_version = weights_version
+        #: content digest of the served weight tree (computed at
+        #: start() when not given) — see the colocated server
+        self.weights_digest = weights_digest
 
         if getattr(batcher, "d_cache", None) is not None:
             raise ValueError(
@@ -720,14 +750,26 @@ class DecodeServer(FrameServerBase):
             help="decode slots with no live occupant (awaiting KV "
                  "arrivals — the decode tier's headroom signal)")
         self._idle_g.set(batcher.batch)
+        # weights lane multiplexes on the KV hub (kind-tagged blobs:
+        # a shipment cannot be misread as an artifact or vice versa)
+        self._init_weight_host(
+            self._reg, exporter=lambda: pack_weights(
+                self.batcher.params, version=self.weights_version),
+            hub=self.hub)
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
+        if self.weights_digest is None:
+            try:
+                self.weights_digest = tree_digest(self.batcher.params)
+            except Exception as e:          # noqa: BLE001 — advisory
+                log.warning("weights digest not computed: %s", e)
         self._engine_thread = threading.Thread(
             target=self.engine.run, name="tony-decode-engine",
             daemon=True)
         self._engine_thread.start()
         self.hub.start()
+        self._start_weight_host()
         self._land_thread = threading.Thread(
             target=self._land_loop, name="tony-decode-land", daemon=True)
         self._land_thread.start()
@@ -754,6 +796,7 @@ class DecodeServer(FrameServerBase):
                 self._engine_thread.join(timeout=60)
         self._stopping.set()
         self.hub.stop()
+        self._stop_weight_host()
         if self._land_thread is not None:
             self._land_thread.join(timeout=10)
         self._close_conns()
@@ -768,6 +811,7 @@ class DecodeServer(FrameServerBase):
         self._close_listener()
         self._close_conns()
         self.hub.stop()
+        self._stop_weight_host()
         self.engine.stop()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout=60)
@@ -780,7 +824,10 @@ class DecodeServer(FrameServerBase):
                 "channel_port": (self.channel_advertise
                                  if self.channel_advertise is not None
                                  else self.hub.port),
-                "weights_version": self.weights_version}
+                "weights_version": self.weights_version,
+                "weights_digest": self.weights_digest,
+                "weight_port": self.weight_port,
+                "weights_resident": self.weight_store.digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -796,8 +843,12 @@ class DecodeServer(FrameServerBase):
         elif ftype == P.STATS:
             st = dict(self.engine.stats(), role="decode",
                       channel_port=self.hub.port,
-                      weights_version=self.weights_version)
+                      weights_version=self.weights_version,
+                      weights_digest=self.weights_digest,
+                      weights_resident=self.weight_store.digests())
             conn.send(P.STATS, 0, P.pack_json(st))
+        elif ftype == P.WEIGHTS:
+            self._handle_weights_frame(conn, rid, payload)
         elif ftype in (P.ADMIT, P.POLL):
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": "decode tier takes KV shipments, not "

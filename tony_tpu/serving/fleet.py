@@ -138,9 +138,11 @@ class FleetController:
                  hysteresis_ticks: int = 3, cooldown_ticks: int = 10,
                  step: int = 1, interval_s: float = 1.0,
                  drain_timeout_s: float = 120.0,
-                 registry=None) -> None:
+                 registry=None, warmer=None) -> None:
         self.router = router
         self.provider = provider
+        self.warmer = warmer                # FleetWarmer or None (cold admit)
+        self.last_warm: dict | None = None  # summary of the last warm pass
         self.min_replicas = int(min_replicas)
         self.max_replicas = int(max_replicas)
         self.up_queue_per_replica = float(up_queue_per_replica)
@@ -217,10 +219,30 @@ class FleetController:
 
     def _scale_up(self, n: int) -> None:
         addrs = self.provider.grow(n)
-        self.router.add_replicas(addrs)
+        addrs = self._warm(addrs)
+        if addrs:
+            self.router.add_replicas(addrs)
         self._ups_c.inc()
         self._reset()
         log.info("fleet: scaled up by %d (%s)", n, addrs)
+
+    def _warm(self, addrs) -> list:
+        """Warm fresh capacity before it takes traffic. With no warmer
+        every address is admitted cold (storage load already happened
+        in the provider). With one, targets the warm pass could not
+        bring up — peer ship failed AND the storage fallback failed —
+        are released instead of admitted: a replica that never landed
+        the weights would 503 every stream routed at it."""
+        if self.warmer is None or not addrs:
+            return list(addrs)
+        self.last_warm = res = self.warmer.warm(list(addrs))
+        failed = list(res.get("failed", ()))
+        if failed:
+            log.warning("fleet: releasing %d unwarmable replicas (%s)",
+                        len(failed), failed)
+            self.provider.release(failed)
+        dead = set(failed)
+        return [a for a in addrs if a not in dead]
 
     def _scale_down(self) -> None:
         st = self.router.stats()
@@ -241,8 +263,11 @@ class FleetController:
     def rolling_upgrade(self, new_addrs, old_addrs=None,
                         role: str | None = None) -> dict:
         """Replace the fleet's weights generation without dropping a
-        stream: connect ``new_addrs`` (already serving the new
-        weights), then drain and retire each OLD replica in turn.
+        stream: connect ``new_addrs`` (warmed first via the fleet's
+        ``warmer`` when one is configured — one storage load seeds the
+        tier, peers fan the weights out — otherwise already serving
+        the new weights), then drain and retire each OLD replica in
+        turn.
         Version-pinned placement keeps existing sessions on their
         generation while any same-version replica survives, and the
         per-replica drains migrate them (zero dup/drop) as their tier
@@ -252,6 +277,7 @@ class FleetController:
         if old_addrs is None:
             old_addrs = [a for a, r in st["replicas"].items() if r["up"]]
         old_addrs = [a for a in old_addrs if a not in set(new_addrs)]
+        new_addrs = self._warm(list(new_addrs))
         self.router.add_replicas(new_addrs, role=role)
         results = {}
         for addr in old_addrs:

@@ -3,17 +3,16 @@ decode gang (disaggregated serving).
 
 A shipment is ONE opaque blob — JSON metadata plus the row's named
 cache buffers concatenated raw — that rides the TONYC1 tensor plane as
-a single 1-D uint8 tensor frame (:meth:`ChannelSender.send_bytes`), so
-the channel plane needs no knowledge of cache layouts and the shipment
-inherits the channel's bounded-window backpressure, reconnect-with-
-resume, and exactly-once delivery for free.
+byte-blob frames (:meth:`ChannelSender.send_bytes`), so the channel
+plane needs no knowledge of cache layouts and the shipment inherits
+the channel's bounded-window backpressure, reconnect-with-resume, and
+exactly-once delivery for free.
 
-Wire layout (little-endian)::
-
-    head_len   4 bytes  u32    JSON header length
-    header     head_len bytes  {"v": 1, "meta": {...},
-                                "bufs": [{"name", "dtype", "shape"}...]}
-    payload    concatenated C-contiguous buffer bytes, in header order
+The wire shape itself (header + raw buffers, kind-tagged) lives in
+:mod:`tony_tpu.serving.blobcodec` — ONE codec shared by the three blob
+lanes (KV rows here, prefix templates below, weight artifacts in
+:mod:`tony_tpu.serving.weightstore`); this module binds the serving
+semantics: the KV adoption record, the template identity checks.
 
 ``meta`` carries the adoption record: ``rid`` (the router's request
 id), ``budget`` (remaining new tokens), ``length`` (the row's
@@ -37,101 +36,41 @@ the hub, request-scoped at the decode server's landing thread).
 
 from __future__ import annotations
 
-import json
-import math
-import struct
-
 import numpy as np
 
+from tony_tpu.serving import blobcodec
+from tony_tpu.serving.blobcodec import (MAX_HEADER_BYTES,  # noqa: F401
+                                        _HLEN, np_dtype as _np_dtype)
 from tony_tpu.serving.protocol import ProtocolError
 
-_HLEN = struct.Struct("<I")
+#: the ``kind`` tags distinguishing the three blob lanes sharing one
+#: wire shape (a template arriving on the kvship lane fails
+#: ``unpack_shipment``'s kind gate; a row shipment arriving on the
+#: prefix lane fails ``unpack_template`` — neither can be silently
+#: misread as the other). Re-exported for back-compat; the codec
+#: itself lives in :mod:`tony_tpu.serving.blobcodec`.
+KV_ROW_KIND = blobcodec.KV_ROW_KIND
+TEMPLATE_KIND = blobcodec.TEMPLATE_KIND
 
-#: sanity cap on the JSON header alone (buffer entries are dozens of
-#: bytes each; megabytes of "header" is a corrupt length prefix)
-MAX_HEADER_BYTES = 1 << 20
-
-
-def _np_dtype(name: str) -> np.dtype:
-    """Resolve a dtype string, including the ml_dtypes extensions
-    (bfloat16 et al.) plain numpy cannot name."""
-    try:
-        return np.dtype(name)
-    except TypeError:
-        pass
-    try:
-        import ml_dtypes
-        return np.dtype(getattr(ml_dtypes, name))
-    except (ImportError, AttributeError, TypeError) as e:
-        raise ProtocolError(f"unknown shipment dtype {name!r}") from e
+#: sanity cap on a template's token list (a prefix is a system prompt /
+#: few-shot header, not a corpus; a million-token "prefix" is a corrupt
+#: or adversarial header)
+MAX_TEMPLATE_TOKENS = 1 << 20
 
 
 def pack_shipment(meta: dict, bufs: dict) -> bytes:
-    """-> one shipment blob. ``bufs``: {name: ndarray}; arrays are
-    serialized C-contiguous in sorted-name order (deterministic wire
-    bytes for identical inputs)."""
-    entries, blobs = [], []
-    for name in sorted(bufs):
-        a = np.asarray(bufs[name])
-        shape = list(a.shape)          # before ascontiguousarray: it
-        if not a.flags["C_CONTIGUOUS"]:   # promotes 0-d to 1-d
-            a = np.ascontiguousarray(a)
-        entries.append({"name": name, "dtype": str(a.dtype),
-                        "shape": shape})
-        blobs.append(a.tobytes())
-    head = json.dumps({"v": 1, "meta": meta, "bufs": entries},
-                      separators=(",", ":")).encode("utf-8")
-    return _HLEN.pack(len(head)) + head + b"".join(blobs)
+    """-> one KV row shipment blob (kind-tagged ``kv_row``). ``bufs``:
+    {name: ndarray}; arrays are serialized C-contiguous in sorted-name
+    order (deterministic wire bytes for identical inputs)."""
+    return blobcodec.KV_ROW.pack(meta, bufs)
 
 
 def unpack_shipment(blob: bytes) -> tuple[dict, dict]:
-    """Parse a shipment blob -> (meta, {name: ndarray}). Arrays view
-    the blob's memory (frombuffer — no copy); callers that outlive the
-    blob hold a reference through the arrays automatically."""
-    if len(blob) < _HLEN.size:
-        raise ProtocolError("shipment shorter than its header prefix")
-    (hlen,) = _HLEN.unpack_from(blob, 0)
-    if hlen > MAX_HEADER_BYTES or _HLEN.size + hlen > len(blob):
-        raise ProtocolError(f"implausible shipment header length {hlen}")
-    try:
-        head = json.loads(blob[_HLEN.size:_HLEN.size + hlen]
-                          .decode("utf-8"))
-    except (UnicodeDecodeError, json.JSONDecodeError) as e:
-        raise ProtocolError(f"malformed shipment header: {e}") from e
-    if not isinstance(head, dict) or not isinstance(head.get("meta"),
-                                                    dict):
-        raise ProtocolError(f"shipment header is not an object: {head!r}")
-    entries = head.get("bufs")
-    if not isinstance(entries, list):
-        raise ProtocolError("shipment header missing buffer table")
-    bufs: dict = {}
-    off = _HLEN.size + hlen
-    for e in entries:
-        if (not isinstance(e, dict) or not isinstance(e.get("name"), str)
-                or not isinstance(e.get("dtype"), str)
-                or not isinstance(e.get("shape"), list)
-                or not all(isinstance(d, int) and not isinstance(d, bool)
-                           and d >= 0 for d in e["shape"])):
-            raise ProtocolError(f"malformed buffer entry: {e!r}")
-        dt = _np_dtype(e["dtype"])
-        # python-int math: np.prod would WRAP on adversarial shapes
-        # ([2**32, 2**32] -> 0), sneaking a bogus buffer past the
-        # bounds check into a reshape crash
-        count = math.prod(e["shape"])
-        n = count * dt.itemsize
-        if off + n > len(blob):
-            raise ProtocolError(
-                f"shipment truncated: buffer {e['name']!r} promises "
-                f"{n} bytes past the blob end")
-        bufs[e["name"]] = np.frombuffer(
-            blob, dtype=dt, count=count,
-            offset=off).reshape(e["shape"])
-        off += n
-    if off != len(blob):
-        raise ProtocolError(
-            f"shipment carries {len(blob) - off} trailing bytes beyond "
-            f"its buffer table")
-    return head["meta"], bufs
+    """Parse a KV row shipment blob -> (meta, {name: ndarray}). Arrays
+    view the blob's memory (frombuffer — no copy). A parse-clean blob
+    belonging to ANOTHER lane (a prefix template, a weight artifact)
+    is refused at the kind gate."""
+    return blobcodec.KV_ROW.unpack(blob)
 
 
 def pack_kv_meta(rid: int, budget: int, length: int, rng_key,
@@ -148,45 +87,36 @@ def pack_kv_meta(rid: int, budget: int, length: int, rng_key,
     return meta
 
 
-#: the ``kind`` tag distinguishing a prefix-template blob from a KV row
-#: shipment sharing the same header+raw-buffers wire shape (a template
-#: arriving on the kvship lane fails ``parse_kv_meta``; a row shipment
-#: arriving on the prefix lane fails ``unpack_template`` — neither can
-#: be silently misread as the other)
-TEMPLATE_KIND = "prefix_template"
-
-#: sanity cap on a template's token list (a prefix is a system prompt /
-#: few-shot header, not a corpus; a million-token "prefix" is a corrupt
-#: or adversarial header)
-MAX_TEMPLATE_TOKENS = 1 << 20
-
-
 def pack_template(prefix_id: str, tokens, bufs: dict, vocab: int) -> bytes:
     """Pack a shared-prefix K/V template for publication to a peer
-    replica: the same header+raw-buffers wire shape as a row shipment
-    (:func:`pack_shipment`), with the meta carrying the template's
-    identity — ``id``, the prefix ``tokens`` (the installer registers
-    them for prompt matching and suffix splitting), and the producing
-    model's ``vocab`` (a template from a differently-shaped model must
-    be rejected at install, not discovered as garbage logits mid-
-    serve). ``bufs`` ship in their STORAGE dtype exactly like row
-    shipments — an int8-quantized cache's template is int8 values +
-    f32 scales, bf16 stays bf16 (bit-identical round trip,
-    test-pinned)."""
-    meta = {"kind": TEMPLATE_KIND, "id": str(prefix_id),
+    replica: the shared blob wire shape (:mod:`~tony_tpu.serving.
+    blobcodec`), with the meta carrying the template's identity —
+    ``id``, the prefix ``tokens`` (the installer registers them for
+    prompt matching and suffix splitting), and the producing model's
+    ``vocab`` (a template from a differently-shaped model must be
+    rejected at install, not discovered as garbage logits mid-serve).
+    ``bufs`` ship in their STORAGE dtype exactly like row shipments —
+    an int8-quantized cache's template is int8 values + f32 scales,
+    bf16 stays bf16 (bit-identical round trip, test-pinned)."""
+    meta = {"id": str(prefix_id),
             "tokens": [int(t) for t in tokens], "vocab": int(vocab)}
-    return pack_shipment(meta, bufs)
+    return blobcodec.PREFIX_TEMPLATE.pack(meta, bufs)
 
 
 def unpack_template(blob: bytes) -> tuple[dict, dict]:
     """Parse + validate a template blob -> (meta, {name: ndarray}).
-    Anything structurally off — including a KV row shipment routed onto
-    the template lane — raises ProtocolError; the install thread drops
-    the blob and keeps serving."""
-    meta, bufs = unpack_shipment(blob)
-    if meta.get("kind") != TEMPLATE_KIND:
-        raise ProtocolError(
-            f"not a prefix template (kind={meta.get('kind')!r})")
+    Anything structurally off — including a KV row shipment or weight
+    artifact routed onto the template lane — raises ProtocolError; the
+    install thread drops the blob and keeps serving."""
+    try:
+        meta, bufs = blobcodec.PREFIX_TEMPLATE.unpack(blob)
+    except ProtocolError as e:
+        if "lane" in str(e):
+            raise ProtocolError(
+                f"not a prefix template "
+                f"(kind={blobcodec.unpack_blob(blob)[0].get('kind')!r})"
+            ) from e
+        raise
     pid = meta.get("id")
     tokens = meta.get("tokens")
     vocab = meta.get("vocab")

@@ -115,12 +115,20 @@ DRAIN = 12
 #: unaffected either way (zero dup/drop — the coordinated-migration
 #: contract).
 MIGRATE = 13
+#: c -> replica then replica -> c (warm scale-up, tony_tpu/serving/
+#: weightstore.py): content-addressed weight / compiled-program
+#: artifact ops — ``{"op": "publish", "digest", "target"}`` commands
+#: this replica to ship a resident artifact to a peer's weights lane;
+#: ``{"op": "list"}`` returns the resident digests. Replies are
+#: ``{"ok": bool, ...}`` — op failures are request-scoped, never
+#: connection-scoped.
+WEIGHTS = 14
 
 FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
                TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
                STATS: "STATS", HELLO: "HELLO", HANDOFF: "HANDOFF",
                BIND: "BIND", PREFIX: "PREFIX", DRAIN: "DRAIN",
-               MIGRATE: "MIGRATE"}
+               MIGRATE: "MIGRATE", WEIGHTS: "WEIGHTS"}
 
 #: sanity bound on one frame's body (type + rid + payload). A prompt of
 #: a million tokens is ~4 MB; anything past this is a corrupt length

@@ -143,6 +143,15 @@ class _ReplicaLink:
         #: refreshed by STATS) — version-pinned placement (rolling
         #: upgrades) keys on it; None = unversioned
         self.weights_version = self.hello.get("weights_version")
+        #: the content digest of the served weight tree (HELLO,
+        #: refreshed by STATS) — when the operator never named a
+        #: version, the digest IS the generation: sessions pin on it,
+        #: so an unversioned rolling upgrade still never mixes weight
+        #: generations mid-stream
+        self.weights_digest = self.hello.get("weights_digest")
+        if self.weights_version is None and isinstance(
+                self.weights_digest, str):
+            self.weights_version = self.weights_digest
         self.slots = int(self.hello.get("slots", 0) or 0)
         #: decode slots with no live occupant per the last STATS — the
         #: equal-queue-depth placement tiebreak
@@ -202,8 +211,14 @@ class _ReplicaLink:
                         self.slots = int(obj.get("slots", 0) or 0)
                     self.idle_slots = max(
                         0, self.slots - int(obj.get("active", 0)))
+                    if "weights_digest" in obj:
+                        self.weights_digest = obj.get("weights_digest")
                     if "weights_version" in obj:
-                        self.weights_version = obj.get("weights_version")
+                        got_v = obj.get("weights_version")
+                        if got_v is None and isinstance(
+                                self.weights_digest, str):
+                            got_v = self.weights_digest
+                        self.weights_version = got_v
                     if "prefixes" in obj:
                         got = self._parse_prefixes(obj)
                         if got != self.prefixes:
@@ -1531,6 +1546,7 @@ class ServingRouter(FrameServerBase):
                              "role": l.role,
                              "draining": bool(l.draining),
                              "weights_version": l.weights_version,
+                             "weights_digest": l.weights_digest,
                              "prefixes": sorted(l.prefixes),
                              "ring": l.ring}
                     for l in self._links},

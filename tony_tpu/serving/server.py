@@ -38,6 +38,8 @@ import threading
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.serving import protocol as P
 from tony_tpu.serving.prefix import PrefixHost, fingerprint
+from tony_tpu.serving.weightstore import WeightHost, pack_weights, \
+    tree_digest
 
 log = logging.getLogger(__name__)
 
@@ -213,7 +215,7 @@ class _Session:
         self.poll_pending = False
 
 
-class ServingServer(PrefixHost, FrameServerBase):
+class ServingServer(WeightHost, PrefixHost, FrameServerBase):
     """Drive a batcher's :class:`~tony_tpu.models.serve.ServeEngine`
     behind the TONYS1 streaming protocol.
 
@@ -236,7 +238,8 @@ class ServingServer(PrefixHost, FrameServerBase):
 
     def __init__(self, batcher, bind_host: str = "127.0.0.1",
                  port: int = 0, registry=None,
-                 weights_version: str | None = None) -> None:
+                 weights_version: str | None = None,
+                 weights_digest: str | None = None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
         self.batcher = batcher
@@ -244,13 +247,25 @@ class ServingServer(PrefixHost, FrameServerBase):
         #: in HELLO and STATS — what the router's version-pinned
         #: placement (rolling upgrades) keys on. None = unversioned.
         self.weights_version = weights_version
+        #: the content digest of the served weight tree (computed at
+        #: start() when not given) — the version-pinning fallback for
+        #: unversioned fleets, and the name peers pull this replica's
+        #: artifact by (warm scale-up).
+        self.weights_digest = weights_digest
         self._lock = threading.Lock()
         self._sessions: dict[tuple[int, int], _Session] = {}
         self.engine = ServeEngine(batcher, on_delta=self._on_delta,
                                   on_retired=self._on_retired,
                                   registry=registry)
         self._engine_thread: threading.Thread | None = None
-        self._init_prefix_host(registry or metrics_mod.get_default())
+        reg = registry or metrics_mod.get_default()
+        self._init_prefix_host(reg)
+        # the weights lane shares the prefix hub's port (blobs are
+        # kind-tagged: neither lane can misread the other's); the
+        # exporter lazily packs the live params the first time a peer
+        # (or the fleet) asks to seed from this replica
+        self._init_weight_host(reg, exporter=self._export_weights_blob,
+                               hub=self._prefix_hub)
 
     # -- resident prefix templates (PrefixHost hooks) -----------------------
     def install_prefix(self, tokens, prefix_id: str | None = None):
@@ -270,12 +285,23 @@ class ServingServer(PrefixHost, FrameServerBase):
     def _prefix_blob(self, prefix_id: str) -> bytes:
         return self.batcher.export_prefix_blob(prefix_id)
 
+    # -- the seedable weight artifact (WeightHost exporter) -----------------
+    def _export_weights_blob(self) -> bytes:
+        return pack_weights(self.batcher.params,
+                            version=self.weights_version)
+
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> int:
+        if self.weights_digest is None:
+            try:
+                self.weights_digest = tree_digest(self.batcher.params)
+            except Exception as e:          # noqa: BLE001 — advisory
+                log.warning("weights digest not computed: %s", e)
         self._engine_thread = threading.Thread(
             target=self.engine.run, name="tony-serve-engine", daemon=True)
         self._engine_thread.start()
         self._start_prefix_host()
+        self._start_weight_host()
         port = super().start()
         log.info("serving on %s:%s (%d slots; prefix lane on :%s)",
                  self.bind_host, port, self.batcher.batch,
@@ -312,6 +338,7 @@ class ServingServer(PrefixHost, FrameServerBase):
                 self._engine_thread.join(timeout=60)
         self._stopping.set()
         self._stop_prefix_host()
+        self._stop_weight_host()
         self._close_conns()
         if self._accept_thread is not None:
             self._accept_thread.join(timeout=5)
@@ -324,6 +351,7 @@ class ServingServer(PrefixHost, FrameServerBase):
         self._close_listener()
         self._close_conns()
         self._stop_prefix_host()
+        self._stop_weight_host()
         self.engine.stop()
         if self._engine_thread is not None:
             self._engine_thread.join(timeout=60)
@@ -337,7 +365,10 @@ class ServingServer(PrefixHost, FrameServerBase):
                 "prefixes": self.batcher.resident_prefixes(),
                 "ring": self.batcher._ring,
                 "prefix_port": self.prefix_port,
-                "weights_version": self.weights_version}
+                "weights_version": self.weights_version,
+                "weights_digest": self.weights_digest,
+                "weight_port": self.weight_port,
+                "weights_resident": self.weight_store.digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -352,9 +383,13 @@ class ServingServer(PrefixHost, FrameServerBase):
                 self.engine.stats(),
                 prefixes=self.batcher.resident_prefixes(),
                 ring=self.batcher._ring,
-                weights_version=self.weights_version)))
+                weights_version=self.weights_version,
+                weights_digest=self.weights_digest,
+                weights_resident=self.weight_store.digests())))
         elif ftype == P.PREFIX:
             self._handle_prefix_frame(conn, rid, payload)
+        elif ftype == P.WEIGHTS:
+            self._handle_weights_frame(conn, rid, payload)
         else:
             raise P.ProtocolError(
                 f"unexpected frame type {P.FRAME_NAMES.get(ftype, ftype)}")

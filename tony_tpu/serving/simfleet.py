@@ -249,6 +249,70 @@ class SimFleet:
         self.replicas.clear()
 
 
+class SimWarmer:
+    """:class:`~tony_tpu.serving.weightstore.FleetWarmer` over a
+    :class:`SimFleet` — deterministic twin of ``ChannelWarmer`` for
+    chaos and bench runs. A peer ship takes ``ship_s`` of injected
+    wall time, a storage load ``load_s`` (typically >> ship_s: that
+    gap IS the cold-start the warm path kills). ``ship`` raises when
+    the seeder replica was killed (crash mid-ship chaos), which
+    :func:`~tony_tpu.serving.weightstore.warm_fanout` absorbs by
+    condemning the seeder and (with ``fallback``) minting a fresh one
+    off storage — the fleet never wedges. Warmed replicas get
+    ``version`` stamped as their weights_version, so the router pins
+    sessions to the new generation exactly as with real replicas."""
+
+    def __init__(self, fleet: SimFleet, version: str,
+                 seeders=(), ship_s: float = 0.0, load_s: float = 0.0,
+                 fallback: bool = True) -> None:
+        self.fleet = fleet
+        self.version = version
+        self.seeders = list(seeders)
+        self.ship_s = ship_s
+        self.load_s = load_s
+        self.fallback = fallback
+        self.loads = 0                      # storage loads consumed
+        self.last: dict | None = None       # last warm_fanout summary
+
+    def warm(self, targets) -> dict:
+        from tony_tpu.serving.weightstore import warm_fanout
+
+        self.last = warm_fanout(
+            list(targets), self._ship, seeders=list(self.seeders),
+            fallback=self._load if self.fallback else None)
+        # freshly-warmed replicas stay seeders for the NEXT pass too
+        for addr in self.last["warmed"] + self.last["fallback"]:
+            if addr not in self.seeders:
+                self.seeders.append(addr)
+        return self.last
+
+    def _alive(self, addr: str):
+        rep = self.fleet.replicas.get(addr)
+        if rep is None or rep._stopping.is_set():
+            return None
+        return rep
+
+    def _ship(self, src: str, dst: str) -> None:
+        if self._alive(src) is None:
+            raise RuntimeError(f"seeder {src} crashed mid-ship")
+        if self.ship_s:
+            time.sleep(self.ship_s)
+        if self._alive(src) is None:        # crashed DURING the ship
+            raise RuntimeError(f"seeder {src} crashed mid-ship")
+        self._mark(dst)
+
+    def _load(self, dst: str) -> None:
+        if self.load_s:
+            time.sleep(self.load_s)
+        self.loads += 1
+        self._mark(dst)
+
+    def _mark(self, dst: str) -> None:
+        rep = self.fleet.replicas.get(dst)
+        if rep is not None:
+            rep.weights_version = self.version
+
+
 class SimProvider:
     """:class:`~tony_tpu.serving.fleet.CapacityProvider` over a
     :class:`SimFleet` — what the autoscale tests grow and shrink."""
