@@ -31,8 +31,9 @@ import pytest
 jax = pytest.importorskip("jax")
 import jax.numpy as jnp                                     # noqa: E402
 
-from tony_tpu.channels.channel import (BLOB_CHUNK_MAGIC, ChannelHub,
-                                       ChannelSender)        # noqa: E402
+from tony_tpu.channels.channel import (BLOB_CHUNK_MAGIC, ChannelError,
+                                       ChannelHub, ChannelSender,
+                                       _blob_frame)          # noqa: E402
 from tony_tpu.models import transformer as T                 # noqa: E402
 from tony_tpu.models.serve import ContinuousBatcher          # noqa: E402
 from tony_tpu.runtime.metrics import MetricsRegistry         # noqa: E402
@@ -283,6 +284,118 @@ class TestChunkedBlobLane:
         finally:
             hub.stop()
 
+    def test_short_poll_timeout_never_aborts_mid_blob(self):
+        """The install-loop regression: a consumer polling with a
+        250 ms timeout must land a blob whose chunks arrive SLOWER
+        than that — the caller's timeout bounds only the wait for the
+        blob to start; each chunk gets its own generous deadline."""
+        hub, port, reg = self._hub()
+        recv = hub.receiver("w")
+        payloads = [b"a" * 100, b"b" * 100, b"c" * 77]
+        blob_id = "feedfeedfeedfeed"
+        landed = {}
+        done = threading.Event()
+
+        def consume():
+            # the install-loop shape: short idle polls, forever
+            while not done.is_set():
+                try:
+                    landed["blob"] = recv.recv_bytes(timeout=0.25)
+                    return
+                except ChannelError:
+                    continue
+
+        def trickle():
+            s = ChannelSender(f"127.0.0.1:{port}", "w", window=8,
+                              registry=reg)
+            try:
+                s.send(np.frombuffer(_blob_frame(
+                    {"v": 2, "kind": "manifest", "chunks": 3,
+                     "total": 277, "blob": blob_id}), np.uint8),
+                    sync=True, timeout=30)
+                for i, p in enumerate(payloads):
+                    time.sleep(0.4)         # slower than the 0.25 poll
+                    s.send(np.frombuffer(_blob_frame(
+                        {"v": 2, "kind": "chunk", "blob": blob_id,
+                         "i": i}, p), np.uint8), sync=True, timeout=30)
+            finally:
+                s.close(drain=False)
+
+        ct = threading.Thread(target=consume, daemon=True)
+        st = threading.Thread(target=trickle, daemon=True)
+        try:
+            ct.start()
+            st.start()
+            st.join(timeout=30)
+            ct.join(timeout=30)
+            done.set()
+            assert landed.get("blob") == b"".join(payloads)
+        finally:
+            done.set()
+            hub.stop()
+
+    def test_aborted_reassembly_resyncs_discarding_stale_chunks(self):
+        """A reassembly aborted mid-blob (dead seeder) leaves the
+        already-queued stragglers on the lane; the NEXT recv_bytes
+        identifies them by blob id and discards them instead of
+        misparsing them as standalone blobs — the lane re-synchronizes
+        and a fresh ship lands intact."""
+        hub, port, reg = self._hub()
+        recv = hub.receiver("w")
+        stale_id = "deaddeaddeaddead"
+        fresh = np.random.RandomState(7).bytes(300 * 1024)
+        try:
+            s = ChannelSender(f"127.0.0.1:{port}", "w", window=8,
+                              registry=reg)
+            # manifest promising 3 chunks, only one delivered: the
+            # committed reassembly times out on chunk 1
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "manifest", "chunks": 3,
+                 "total": 300, "blob": stale_id}), np.uint8),
+                sync=True, timeout=30)
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "chunk", "blob": stale_id, "i": 0},
+                b"x" * 100), np.uint8), sync=True, timeout=30)
+            with pytest.raises(ChannelError):
+                recv.recv_bytes(timeout=5, chunk_timeout=0.2)
+            # the dead blob's stragglers arrive late, then a fresh blob
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "chunk", "blob": stale_id, "i": 1},
+                b"y" * 100), np.uint8), sync=True, timeout=30)
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "chunk", "blob": stale_id, "i": 2},
+                b"z" * 100), np.uint8), sync=True, timeout=30)
+            s.send_bytes(fresh, sync=True, timeout=30,
+                         chunk_bytes=64 * 1024)
+            assert recv.recv_bytes(timeout=30) == fresh
+            s.close(drain=False)
+        finally:
+            hub.stop()
+
+    def test_new_manifest_mid_blob_restarts_reassembly(self):
+        """A sender that gave up and re-shipped: a fresh manifest
+        arriving mid-reassembly restarts on the new blob instead of
+        erroring (or worse, splicing two blobs together)."""
+        hub, port, reg = self._hub()
+        recv = hub.receiver("w")
+        fresh = np.random.RandomState(9).bytes(200 * 1024)
+        try:
+            s = ChannelSender(f"127.0.0.1:{port}", "w", window=8,
+                              registry=reg)
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "manifest", "chunks": 2,
+                 "total": 200, "blob": "0011223344556677"}), np.uint8),
+                sync=True, timeout=30)
+            s.send(np.frombuffer(_blob_frame(
+                {"v": 2, "kind": "chunk", "blob": "0011223344556677",
+                 "i": 0}, b"q" * 100), np.uint8), sync=True, timeout=30)
+            s.send_bytes(fresh, sync=True, timeout=30,
+                         chunk_bytes=64 * 1024)
+            assert recv.recv_bytes(timeout=30) == fresh
+            s.close(drain=False)
+        finally:
+            hub.stop()
+
     def test_disconnect_mid_blob_resumes_zero_dup_zero_drop(self):
         """Sever the socket repeatedly DURING a chunked transfer: the
         sender reconnects and resumes at the receiver's seq, and the
@@ -375,6 +488,34 @@ class TestWarmFanout:
                           seeders=["dead"])
         assert res["failed"] == ["t0", "t1"] and not res["warmed"]
 
+    def test_failing_fallback_reports_failed_never_raises(self):
+        """The chaos case the fleet controller ships: a storage load
+        that ITSELF fails moves its target to ``failed`` (for the
+        controller's release path) and the wave loop keeps warming —
+        it never propagates out of _scale_up / rolling_upgrade."""
+        attempts = []
+
+        def fallback(dst):
+            attempts.append(dst)
+            if len(attempts) == 1:
+                raise OSError("storage load failed")
+
+        res = warm_fanout(["t0", "t1", "t2"], lambda src, dst: None,
+                          fallback=fallback)
+        assert res["failed"] == ["t0"]          # the failed load's target
+        assert res["fallback"] == ["t1"]        # retry minted a seeder
+        assert res["warmed"] == ["t2"]          # and fan-out resumed
+        assert attempts == ["t0", "t1"]
+
+    def test_fallback_always_failing_terminates(self):
+        def fallback(dst):
+            raise OSError("storage down")
+
+        res = warm_fanout(["t0", "t1"], lambda src, dst: None,
+                          fallback=fallback)
+        assert res["failed"] == ["t0", "t1"]
+        assert not res["warmed"] and not res["fallback"]
+
 
 # ---------------------------------------------------------------------------
 # Live server: advertise, pull, bit-identical serving
@@ -446,6 +587,119 @@ class TestLiveServerWarmBoot:
 
 
 # ---------------------------------------------------------------------------
+# Lazy export: HELLO/STATS never pay (or pin) the params pack
+# ---------------------------------------------------------------------------
+class TestLazyExport:
+    def test_resident_view_never_triggers_export(self):
+        """The first client HELLO must not synchronously pack a
+        multi-GB host copy of the params: resident_digests() (what
+        HELLO/STATS advertise) never runs the exporter; digests()
+        (the seed-intent list/publish path) runs it exactly once."""
+        calls = []
+
+        def exporter():
+            calls.append(1)
+            return pack_weights(_tree())
+
+        store = WeightStore(MetricsRegistry(), exporter=exporter)
+        assert store.resident_digests() == []
+        assert store.resident_digests() == []
+        assert not calls                        # advertising is free
+        d = tree_digest(_tree())
+        assert store.digests() == [d]           # seed intent: exports
+        assert len(calls) == 1
+        assert store.digests() == [d]           # ... exactly once
+        assert len(calls) == 1
+        assert store.resident_digests() == [d]
+
+    def test_live_hello_and_resident_op_do_not_export(self, params):
+        """End-to-end: a fresh server's HELLO advertises an EMPTY
+        resident list (plus its precomputed weights_digest — the
+        seedability signal); the 'resident' op stays non-exporting;
+        the 'list' op is the moment the export runs."""
+        srv = ServingServer(
+            ContinuousBatcher(params, CFG, batch=2, max_len=32, chunk=3),
+            registry=MetricsRegistry())
+        port = srv.start()
+        addr = f"127.0.0.1:{port}"
+        try:
+            digest = srv.weights_digest
+            res = weights_rpc(addr, {"op": "resident"})
+            assert res["ok"] and res["resident"] == []
+            assert res["_hello"]["weights_resident"] == []
+            assert res["_hello"]["weights_digest"] == digest
+            listed = weights_rpc(addr, {"op": "list"})
+            assert digest in listed["resident"]
+            res2 = weights_rpc(addr, {"op": "resident"})
+            assert digest in res2["resident"]
+            assert digest in res2["_hello"]["weights_resident"]
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# The advertised pull-back address (cross-host warm boot)
+# ---------------------------------------------------------------------------
+class TestPullAdvertiseHost:
+    def test_reachable_host_toward_loopback_is_loopback(self):
+        from tony_tpu.serving.weightstore import _reachable_host
+        assert _reachable_host("127.0.0.1:9") == "127.0.0.1"
+
+    def test_reachable_host_falls_back_on_unresolvable_peer(self):
+        from tony_tpu.serving.weightstore import _reachable_host
+        assert _reachable_host("host.invalid:1",
+                               default="203.0.113.1") == "203.0.113.1"
+
+    @pytest.mark.parametrize("advertise,expect", [
+        (None, "192.0.2.55"),           # derived from the seeder route
+        ("203.0.113.7", "203.0.113.7"),  # explicit override wins
+    ], ids=["derived", "explicit"])
+    def test_pull_advertises_reachable_target(self, monkeypatch,
+                                              advertise, expect):
+        """The cross-host regression: pull_weights must advertise an
+        address the SEEDER can reach — never a hard-coded loopback
+        that would have a remote seeder ship the artifact to itself."""
+        from tony_tpu.serving import weightstore as ws
+        blob = pack_weights(_tree())
+        digest = peek_weights_meta(blob)["digest"]
+        captured = {}
+        probed = []
+
+        def fake_reachable(peer, default="127.0.0.1"):
+            probed.append(peer)
+            return "192.0.2.55"
+
+        monkeypatch.setattr(ws, "_reachable_host", fake_reachable)
+
+        def fake_rpc(addr, body, timeout_s=30.0):
+            if body["op"] == "list":
+                return {"ok": True, "resident": [digest], "_hello": {}}
+            assert body["op"] == "publish"
+            captured["target"] = body["target"]
+            host, port = body["target"].rsplit(":", 1)
+
+            def ship():
+                s = ChannelSender(f"127.0.0.1:{port}", WEIGHT_CHANNEL,
+                                  registry=MetricsRegistry())
+                try:
+                    s.send_bytes(blob, sync=True, timeout=30)
+                finally:
+                    s.close(drain=False)
+
+            threading.Thread(target=ship, daemon=True).start()
+            return {"ok": True, "digest": digest, "_hello": {}}
+
+        monkeypatch.setattr(ws, "weights_rpc", fake_rpc)
+        meta, tree = pull_weights("198.51.100.2:4242", timeout_s=30,
+                                  advertise_host=advertise)
+        assert meta["digest"] == digest
+        assert tree_digest(tree) == digest
+        assert captured["target"].rsplit(":", 1)[0] == expect
+        # the route probe names the seeder; an explicit host skips it
+        assert probed == ([] if advertise else ["198.51.100.2:4242"])
+
+
+# ---------------------------------------------------------------------------
 # Compiled-program artifacts
 # ---------------------------------------------------------------------------
 class TestCompileCache:
@@ -473,6 +727,24 @@ class TestCompileCache:
         blob[-7] ^= 0x20
         with pytest.raises(ProtocolError, match="landed dirty"):
             install_compile_cache(bytes(blob), str(tmp_path / "landed"))
+
+    def test_corrupt_blob_refused_at_put(self, tmp_path):
+        """put() digest-verifies compile-cache artifacts too: a
+        corrupt blob can never land resident (counted as an install)
+        and be re-seeded peer-to-peer — corruption is caught at the
+        store, not later at every target's install."""
+        reg = MetricsRegistry()
+        store = WeightStore(reg)
+        good = pack_compile_cache(self._seed_dir(tmp_path))
+        bad = bytearray(good)
+        bad[-7] ^= 0x20
+        with pytest.raises(ProtocolError, match="REFUSED"):
+            store.put(bytes(bad))
+        assert store.resident_digests() == []
+        assert reg.counter("tony_weight_installs_total").value == 0
+        digest = store.put(good)
+        assert store.get(digest) == good        # a compile-cache hit
+        assert reg.counter("tony_compile_cache_hits_total").value == 1
 
 
 # ---------------------------------------------------------------------------

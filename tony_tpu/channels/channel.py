@@ -76,6 +76,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import socket
 import struct
 import threading
@@ -106,16 +107,33 @@ _HLEN = struct.Struct("<I")     # tensor-header length prefix
 #: than shipping something the peer will reject.
 MAX_TENSOR_BYTES = 1 << 31
 
-#: magic prefix of a chunked byte-blob ENVELOPE frame (see
+#: magic prefix of every chunked byte-blob frame (see
 #: :meth:`ChannelSender.send_bytes`): a blob larger than the chunk
-#: budget ships as one envelope frame — this magic + a JSON manifest
-#: ``{"v":1,"chunks":N,"total":T}`` — followed by N bounded chunk
-#: frames. Each chunk is an ordinary seq-numbered tensor frame, so a
-#: disconnect mid-blob resumes at the first unacked CHUNK, not the
-#: whole blob (zero duplicated / dropped bytes — test-pinned). A raw
-#: blob that happens to START with this magic is escaped into a
-#: single-chunk envelope so the receiver can never misparse it.
+#: budget ships as one MANIFEST frame — this magic + a u32-length-
+#: prefixed JSON header ``{"v":2,"kind":"manifest","chunks":N,
+#: "total":T,"blob":id}`` — followed by N bounded CHUNK frames, each
+#: the same magic + header ``{"v":2,"kind":"chunk","blob":id,"i":i}``
+#: + payload bytes. Each frame is an ordinary seq-numbered tensor
+#: frame, so a disconnect mid-blob resumes at the first unacked CHUNK,
+#: not the whole blob (zero duplicated / dropped bytes — test-pinned).
+#: The per-frame kind tag + per-blob id are what let a receiver that
+#: ABORTED a reassembly (chunk timeout, seeder death) re-synchronize:
+#: a stale chunk of the dead blob is identified and discarded, never
+#: misparsed as a standalone blob. A raw blob that happens to START
+#: with this magic is escaped into a single-chunk envelope so the
+#: receiver can never misparse it.
 BLOB_CHUNK_MAGIC = b"TONYB1\0"
+
+#: per-chunk recv deadline while reassembling a chunked blob (see
+#: :meth:`ChannelReceiver.recv_bytes`): once a manifest has arrived
+#: the receiver is committed to the blob, so each chunk gets its own
+#: generous deadline instead of whatever sliver remains of the
+#: caller's first-frame timeout — a multi-GB artifact backpressured
+#: through a small hub must never be aborted mid-reassembly by an
+#: idle-poll timeout. Transient disconnects are invisible here (the
+#: sender reconnects and seq-resumes); only a truly dead sender makes
+#: a chunk wait this long.
+BLOB_CHUNK_TIMEOUT_S = 60.0
 
 #: default chunk budget for :meth:`ChannelSender.send_bytes` (the
 #: ``tony.weights.chunk-bytes`` config key feeds callers that override
@@ -315,6 +333,53 @@ def decode_tensor(payload: bytes, codec: str = "none") -> np.ndarray:
     q = np.frombuffer(raw, dtype=np.int8, offset=_SCALE.size)
     return (q.astype(np.float32) * np.float32(scale)) \
         .astype(dt).reshape(shape)
+
+
+def _blob_frame(head: dict, payload: bytes = b"") -> bytes:
+    """Serialize one chunked-blob frame: magic + u32 header length +
+    compact-JSON header + payload bytes."""
+    h = json.dumps(head, separators=(",", ":")).encode("utf-8")
+    return BLOB_CHUNK_MAGIC + _HLEN.pack(len(h)) + h + payload
+
+
+def _parse_blob_frame(buf: bytes) -> tuple[dict, bytes]:
+    """Split a magic-prefixed chunked-blob frame into (header dict,
+    payload bytes); structurally-off frames are ProtocolError."""
+    off = len(BLOB_CHUNK_MAGIC)
+    if len(buf) < off + _HLEN.size:
+        raise ProtocolError(
+            "chunked-blob frame shorter than its header prefix")
+    (hlen,) = _HLEN.unpack_from(buf, off)
+    if off + _HLEN.size + hlen > len(buf):
+        raise ProtocolError(
+            f"chunked-blob header length {hlen} exceeds frame")
+    try:
+        head = json.loads(
+            buf[off + _HLEN.size:off + _HLEN.size + hlen]
+            .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ProtocolError(f"malformed chunked-blob header: {e}") from e
+    if not isinstance(head, dict):
+        raise ProtocolError(f"malformed chunked-blob header: {head!r}")
+    return head, buf[off + _HLEN.size + hlen:]
+
+
+def _check_manifest(head: dict, payload: bytes) -> tuple[int, int, str]:
+    """Validate a manifest header -> (chunks, total, blob id)."""
+    chunks = head.get("chunks")
+    total = head.get("total")
+    blob_id = head.get("blob")
+    if (isinstance(chunks, bool) or not isinstance(chunks, int)
+            or isinstance(total, bool) or not isinstance(total, int)
+            or not 1 <= chunks <= MAX_BLOB_CHUNKS or total < 0
+            or not isinstance(blob_id, str)):
+        raise ProtocolError(
+            f"implausible chunked-blob manifest: {head!r}")
+    if payload:
+        raise ProtocolError(
+            f"chunked-blob manifest carries {len(payload)} payload "
+            f"bytes (chunks carry the data, the manifest never does)")
+    return chunks, total, blob_id
 
 
 def _send_tensor_frame(sock: socket.socket, seq: int, head: bytes,
@@ -650,8 +715,10 @@ class ChannelSender:
         frames, each an ordinary seq-numbered frame — so a multi-GB
         blob inherits the window's backpressure and, on disconnect,
         resumes at the first unacked CHUNK instead of resending (or
-        worse, dropping) the whole blob. Same window/reconnect/ordering
-        contract as :meth:`send`; pair with
+        worse, dropping) the whole blob. Chunk frames are kind-tagged
+        with a per-blob id, so a receiver that aborted a reassembly
+        can identify and discard the dead blob's stragglers. Same
+        window/reconnect/ordering contract as :meth:`send`; pair with
         :meth:`ChannelReceiver.recv_bytes`. Returns the seq of the
         blob's LAST frame (what ``sync=True`` waits on)."""
         data = bytes(data) if not isinstance(data, (bytes, bytearray,
@@ -665,14 +732,16 @@ class ChannelSender:
         if len(view) <= limit and not magic_collision:
             return self.send(np.frombuffer(view, dtype=np.uint8),
                              sync=sync, timeout=timeout)
-        # chunked path: envelope first, then the chunks. Only the LAST
+        # chunked path: manifest first, then the chunks. Only the LAST
         # frame honours sync — in-order exactly-once delivery means the
         # last ack implies every earlier chunk landed.
         chunks = max(1, -(-len(view) // limit))
-        manifest = json.dumps({"v": 1, "chunks": chunks,
-                               "total": len(view)},
-                              separators=(",", ":")).encode("utf-8")
-        envelope = BLOB_CHUNK_MAGIC + manifest
+        blob_id = os.urandom(8).hex()   # names THIS transfer: a stale
+        # chunk surviving an aborted reassembly can never be mistaken
+        # for part of a later blob (even a byte-identical re-ship)
+        envelope = _blob_frame({"v": 2, "kind": "manifest",
+                                "chunks": chunks, "total": len(view),
+                                "blob": blob_id})
         deadline = None if timeout is None else time.monotonic() + timeout
         def left() -> float | None:
             return None if deadline is None \
@@ -681,9 +750,11 @@ class ChannelSender:
                   timeout=left())
         seq = -1
         for i in range(chunks):
-            part = view[i * limit:(i + 1) * limit]
+            frame = _blob_frame({"v": 2, "kind": "chunk",
+                                 "blob": blob_id, "i": i},
+                                bytes(view[i * limit:(i + 1) * limit]))
             last = i == chunks - 1
-            seq = self.send(np.frombuffer(part, dtype=np.uint8),
+            seq = self.send(np.frombuffer(frame, dtype=np.uint8),
                             sync=sync and last, timeout=left())
         return seq
 
@@ -821,59 +892,104 @@ class ChannelReceiver:
                 - len(self._state.queue) - 1
         return arr
 
-    def recv_bytes(self, timeout: float | None = None) -> bytes:
+    def recv_bytes(self, timeout: float | None = None,
+                   chunk_timeout: float | None = None) -> bytes:
         """Consume one opaque byte blob (the :meth:`ChannelSender.
         send_bytes` counterpart) — reassembling a chunked blob
-        (:data:`BLOB_CHUNK_MAGIC` envelope + chunk frames) back into
-        the exact sent bytes. A frame that is not a 1-D uint8 tensor is
-        a peer speaking the wrong sub-protocol — surfaced as
-        ProtocolError so the consumer can scope it, never silently
-        reinterpreted bytes."""
+        (:data:`BLOB_CHUNK_MAGIC` manifest + tagged chunk frames) back
+        into the exact sent bytes.
+
+        ``timeout`` bounds the wait for the blob's FIRST frame only —
+        the idle-poll budget. Once a manifest arrives the reassembly
+        is committed, and each chunk frame gets its own
+        ``chunk_timeout`` (default :data:`BLOB_CHUNK_TIMEOUT_S`)
+        instead of whatever remains of the caller's budget: an install
+        loop polling at 250 ms must never abort a multi-GB transfer
+        that takes seconds to backpressure through the hub. A chunk
+        that truly never arrives (seeder death — transient disconnects
+        seq-resume invisibly) raises ChannelError mid-reassembly;
+        stale chunks the dead blob already queued are identified by
+        their blob id and DISCARDED on the next call, so the lane
+        re-synchronizes instead of misparsing them as standalone
+        blobs. A fresh manifest arriving mid-reassembly restarts the
+        reassembly on the new blob (the sender gave up and re-shipped).
+
+        A frame that is not a 1-D uint8 tensor is a peer speaking the
+        wrong sub-protocol — surfaced as ProtocolError so the consumer
+        can scope it, never silently reinterpreted bytes."""
         deadline = None if timeout is None else time.monotonic() + timeout
         def left() -> float | None:
             return None if deadline is None \
                 else max(0.0, deadline - time.monotonic())
-        arr = self.recv(left())
-        if arr.dtype != np.uint8 or arr.ndim != 1:
-            raise ProtocolError(
-                f"expected a byte-blob frame (1-D uint8), got "
-                f"{arr.dtype}{list(arr.shape)}")
-        first = arr.tobytes()
-        if not first.startswith(BLOB_CHUNK_MAGIC):
-            return first
-        try:
-            man = json.loads(first[len(BLOB_CHUNK_MAGIC):]
-                             .decode("utf-8"))
-        except (UnicodeDecodeError, json.JSONDecodeError) as e:
-            raise ProtocolError(
-                f"malformed chunked-blob envelope: {e}") from e
-        chunks = man.get("chunks") if isinstance(man, dict) else None
-        total = man.get("total") if isinstance(man, dict) else None
-        if (isinstance(chunks, bool) or not isinstance(chunks, int)
-                or isinstance(total, bool) or not isinstance(total, int)
-                or not 1 <= chunks <= MAX_BLOB_CHUNKS or total < 0):
-            raise ProtocolError(
-                f"implausible chunked-blob manifest: {man!r}")
-        parts: list[bytes] = []
-        got = 0
-        for i in range(chunks):
-            part = self.recv(left())
-            if part.dtype != np.uint8 or part.ndim != 1:
+        per_chunk = BLOB_CHUNK_TIMEOUT_S if chunk_timeout is None \
+            else chunk_timeout
+
+        def byte_frame(waiting: float | None, what: str) -> bytes:
+            arr = self.recv(waiting)
+            if arr.dtype != np.uint8 or arr.ndim != 1:
                 raise ProtocolError(
-                    f"chunk {i}/{chunks} is not a byte frame: "
-                    f"{part.dtype}{list(part.shape)}")
-            b = part.tobytes()
-            got += len(b)
-            if got > total:
+                    f"expected {what} (1-D uint8), got "
+                    f"{arr.dtype}{list(arr.shape)}")
+            return arr.tobytes()
+
+        # wait for the blob to START (the only wait the caller's
+        # timeout bounds), discarding stragglers of any aborted blob
+        while True:
+            first = byte_frame(left(), "a byte-blob frame")
+            if not first.startswith(BLOB_CHUNK_MAGIC):
+                return first
+            head, payload = _parse_blob_frame(first)
+            kind = head.get("kind")
+            if kind == "chunk":
+                continue    # orphan of an aborted reassembly: discard
+            if kind != "manifest":
                 raise ProtocolError(
-                    f"chunked blob overflows its manifest: chunk {i} "
-                    f"brings {got} bytes past the promised {total}")
-            parts.append(b)
-        if got != total:
-            raise ProtocolError(
-                f"chunked blob reassembled to {got} bytes, manifest "
-                f"promised {total}")
-        return b"".join(parts)
+                    f"unknown chunked-blob frame kind {kind!r}")
+            break
+        while True:     # one iteration per manifest (restart on a new one)
+            chunks, total, blob_id = _check_manifest(head, payload)
+            parts: list[bytes] = []
+            got = 0
+            restarted = False
+            while len(parts) < chunks:
+                b = byte_frame(per_chunk,
+                               f"chunk {len(parts)}/{chunks} of blob "
+                               f"{blob_id}")
+                if not b.startswith(BLOB_CHUNK_MAGIC):
+                    raise ProtocolError(
+                        f"untagged frame interleaved mid-reassembly of "
+                        f"blob {blob_id} ({len(parts)}/{chunks} chunks "
+                        f"landed)")
+                chead, cpayload = _parse_blob_frame(b)
+                ckind = chead.get("kind")
+                if ckind == "manifest":
+                    # the sender abandoned this blob and started over
+                    head, payload = chead, cpayload
+                    restarted = True
+                    break
+                if ckind != "chunk":
+                    raise ProtocolError(
+                        f"unknown chunked-blob frame kind {ckind!r}")
+                if chead.get("blob") != blob_id:
+                    continue    # stale chunk of an aborted blob
+                if chead.get("i") != len(parts):
+                    raise ProtocolError(
+                        f"blob {blob_id} chunk out of order: got "
+                        f"{chead.get('i')!r}, expected {len(parts)}")
+                got += len(cpayload)
+                if got > total:
+                    raise ProtocolError(
+                        f"chunked blob overflows its manifest: chunk "
+                        f"{len(parts)} brings {got} bytes past the "
+                        f"promised {total}")
+                parts.append(cpayload)
+            if restarted:
+                continue
+            if got != total:
+                raise ProtocolError(
+                    f"chunked blob reassembled to {got} bytes, manifest "
+                    f"promised {total}")
+            return b"".join(parts)
 
     @property
     def last_seq(self) -> int:

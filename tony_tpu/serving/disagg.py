@@ -345,7 +345,7 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                 "weights_version": self.weights_version,
                 "weights_digest": self.weights_digest,
                 "weight_port": self.weight_port,
-                "weights_resident": self.weight_store.digests()}
+                "weights_resident": self.weight_store.resident_digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -373,7 +373,7 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                 "ring": self._ring,
                 "weights_version": self.weights_version,
                 "weights_digest": self.weights_digest,
-                "weights_resident": self.weight_store.digests()}
+                "weights_resident": self.weight_store.resident_digests()}
 
     def _admit(self, conn: FrameConn, rid: int, payload: bytes) -> None:
         prompt, max_new, _stream = P.parse_admit(payload)
@@ -827,7 +827,7 @@ class DecodeServer(WeightHost, FrameServerBase):
                 "weights_version": self.weights_version,
                 "weights_digest": self.weights_digest,
                 "weight_port": self.weight_port,
-                "weights_resident": self.weight_store.digests()}
+                "weights_resident": self.weight_store.resident_digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -845,7 +845,7 @@ class DecodeServer(WeightHost, FrameServerBase):
                       channel_port=self.hub.port,
                       weights_version=self.weights_version,
                       weights_digest=self.weights_digest,
-                      weights_resident=self.weight_store.digests())
+                      weights_resident=self.weight_store.resident_digests())
             conn.send(P.STATS, 0, P.pack_json(st))
         elif ftype == P.WEIGHTS:
             self._handle_weights_frame(conn, rid, payload)

@@ -368,7 +368,7 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
                 "weights_version": self.weights_version,
                 "weights_digest": self.weights_digest,
                 "weight_port": self.weight_port,
-                "weights_resident": self.weight_store.digests()}
+                "weights_resident": self.weight_store.resident_digests()}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
                       payload: bytes) -> None:
@@ -385,7 +385,7 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
                 ring=self.batcher._ring,
                 weights_version=self.weights_version,
                 weights_digest=self.weights_digest,
-                weights_resident=self.weight_store.digests())))
+                weights_resident=self.weight_store.resident_digests())))
         elif ftype == P.PREFIX:
             self._handle_prefix_frame(conn, rid, payload)
         elif ftype == P.WEIGHTS:

@@ -321,6 +321,34 @@ def pack_compile_cache(cache_dir: str,
     return blobcodec.WEIGHTS.pack(meta, bufs)
 
 
+def _verify_compile_cache_entries(meta: dict, bufs: dict) -> None:
+    """Digest-gate a compile-cache artifact's IN-MEMORY entry table —
+    the same ``file\\0rel\\0`` + content + ``\\0`` sorted walk as
+    :func:`dir_digest`, applied to the unpacked buffers (entry keys
+    are ``/``-joined, which on POSIX is exactly the on-disk walk), so
+    a corrupt artifact is refused at :meth:`WeightStore.put` instead
+    of landing resident and re-seeding peer-to-peer."""
+    claimed = meta.get("digest")
+    if not isinstance(claimed, str) or len(claimed) != 64:
+        raise ProtocolError(f"malformed compile-cache digest: "
+                            f"{claimed!r}")
+    h = hashlib.sha256()
+    for rel in sorted(bufs):
+        arr = bufs[rel]
+        if arr.dtype != np.uint8 or arr.ndim != 1:
+            raise ProtocolError(
+                f"compile-cache entry {rel!r} is not a raw byte buffer")
+        h.update(f"file\0{rel}\0".encode("utf-8"))
+        h.update(arr.tobytes())
+        h.update(b"\0")
+    got = h.hexdigest()
+    if got != claimed:
+        raise ProtocolError(
+            f"compile-cache artifact REFUSED: content digest "
+            f"{got[:12]}… != claimed {claimed[:12]}… (corrupt or "
+            f"tampered transfer)")
+
+
 def install_compile_cache(blob: bytes, cache_dir: str) -> dict:
     """Land a compile-cache artifact into ``cache_dir`` (created if
     missing), digest-verified after the write — a mismatch removes
@@ -406,10 +434,15 @@ class WeightStore:
 
     def put(self, blob: bytes) -> str:
         """Make a packed artifact resident (digest read from its meta,
-        VERIFIED for weight artifacts); returns the digest."""
-        meta, _ = blobcodec.WEIGHTS.unpack(blob)
+        VERIFIED — weight artifacts through the full as-served gate,
+        compile-cache artifacts against their entry table); returns
+        the digest. A corrupt blob can never land resident and be
+        re-seeded peer-to-peer."""
+        meta, bufs = blobcodec.WEIGHTS.unpack(blob)
         if meta.get("part") == "weights":
             meta, _tree = unpack_weights(blob)      # full digest gate
+        elif meta.get("part") == "compile_cache":
+            _verify_compile_cache_entries(meta, bufs)
         digest = meta.get("digest")
         if not isinstance(digest, str) or len(digest) != 64:
             raise ProtocolError(f"artifact has no digest: {meta!r}")
@@ -440,8 +473,22 @@ class WeightStore:
         return dict(entry[0])
 
     def digests(self) -> list:
+        """Every artifact this host can SEED — triggers the lazy
+        self-export (packing the live params) the first time. This is
+        the seed-intent view: the WEIGHTS ``list``/``publish`` ops pay
+        the pack here, exactly once, when a peer actually asks."""
         with self._lock:
             self._ensure_exported_locked()
+            return sorted(self._artifacts)
+
+    def resident_digests(self) -> list:
+        """Digests already resident, WITHOUT triggering the lazy
+        self-export. HELLO/STATS advertise through this: a client
+        handshake must never synchronously pack (and then pin) a
+        multi-GB host copy of the params under the store lock — the
+        precomputed ``weights_digest`` field advertises seedability;
+        the export runs when a peer sends an actual seed op."""
+        with self._lock:
             return sorted(self._artifacts)
 
     def _ensure_exported_locked(self) -> None:
@@ -516,11 +563,17 @@ class WeightHost:
         receiver = self._weight_hub.receiver(WEIGHT_CHANNEL)
         while True:
             try:
+                # the 0.25 bounds only the idle poll for a blob to
+                # START; once a manifest lands, reassembly runs under
+                # recv_bytes' own generous per-chunk deadline — a
+                # multi-GB artifact backpressuring through the hub is
+                # never aborted mid-transfer by this poll cadence
                 blob = receiver.recv_bytes(timeout=0.25)
             except ChannelClosed:
                 return                  # hub stopped: lane is dead
             except ChannelError:
-                continue                # timeout; re-check liveness
+                continue                # idle poll (or a dead seeder
+                #                         mid-blob); re-check liveness
             except ProtocolError as e:
                 log.warning("weights lane: non-artifact frame dropped: "
                             "%s", e)
@@ -586,8 +639,16 @@ class WeightHost:
                     timeout_s=float(obj.get("timeout_s", 120.0)))
                 body = {"ok": True, "digest": digest, "bytes": n}
             elif op == "list":
+                # seed intent: triggers the lazy self-export
                 body = {"ok": True,
                         "resident": self.weight_store.digests()}
+            elif op == "resident":
+                # residency poll (warmers confirming a landing): never
+                # triggers the export — polling a TARGET must not make
+                # it pack its own params
+                body = {"ok": True,
+                        "resident":
+                            self.weight_store.resident_digests()}
             else:
                 body = {"ok": False,
                         "error": f"unknown weights op {op!r}"}
@@ -624,16 +685,38 @@ def weights_rpc(addr: str, body: dict, timeout_s: float = 30.0) -> dict:
                 return out
 
 
+def _reachable_host(peer: str, default: str = "127.0.0.1") -> str:
+    """The local address the kernel routes TOWARD ``peer`` from — what
+    a puller must advertise as its weights-lane host. A hard-coded
+    loopback would have a remote seeder ship the artifact to its own
+    127.0.0.1 instead of the puller. The UDP connect assigns the
+    outbound interface without sending a packet; on failure (peer
+    unresolvable) fall back to ``default`` — the pull will fail
+    loudly anyway."""
+    host, _, port = peer.rpartition(":")
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((host or peer, int(port) if port.isdigit() else 1))
+        return s.getsockname()[0]
+    except OSError:
+        return default
+    finally:
+        s.close()
+
+
 def pull_weights(seeder: str, digest: str | None = None,
-                 timeout_s: float = 120.0, registry=None) -> tuple:
+                 timeout_s: float = 120.0, registry=None,
+                 advertise_host: str | None = None) -> tuple:
     """The cold replica's warm boot path: stand up a one-shot weights
     lane, ask ``seeder`` (a warm replica's serving address) to publish
     its artifact here, land it digest-verified, and return
     ``(meta, params tree)``. ``digest=None`` takes the seeder's first
-    advertised resident artifact. Raises ChannelError (seeder
-    unreachable / refused / timed out) or ProtocolError (artifact
-    refused at the digest gate) — callers fall back to a storage
-    load."""
+    advertised resident artifact. ``advertise_host=None`` derives the
+    address the seeder should ship to from the route toward it
+    (:func:`_reachable_host`); pass it explicitly when the puller sits
+    behind NAT/a proxy. Raises ChannelError (seeder unreachable /
+    refused / timed out) or ProtocolError (artifact refused at the
+    digest gate) — callers fall back to a storage load."""
     from tony_tpu.runtime import metrics as metrics_mod
     reg = registry or metrics_mod.MetricsRegistry()
     hub = ChannelHub(port=0, capacity=4, registry=reg)
@@ -648,7 +731,9 @@ def pull_weights(seeder: str, digest: str | None = None,
                 raise ChannelError(
                     f"seeder {seeder} has no resident artifact")
             digest = resident[0]
-        target = f"127.0.0.1:{hub.port}"
+        if advertise_host is None:
+            advertise_host = _reachable_host(seeder)
+        target = f"{advertise_host}:{hub.port}"
         res = weights_rpc(seeder, {"op": "publish", "digest": digest,
                                    "target": target,
                                    "timeout_s": timeout_s},
@@ -679,9 +764,11 @@ def warm_fanout(targets, ship, *, seeders=(), fallback=None,
     seeder): the seeder is dropped from the pool and the target stays
     pending. When the pool runs dry — including at the start, when no
     warm peer exists — ``fallback(dst)`` (a storage load) mints a new
-    seeder; with no fallback either, the remaining targets are
-    reported ``failed``. Warming never wedges: every wave either makes
-    progress or consumes a failure.
+    seeder; a fallback that itself raises moves THAT target to
+    ``failed`` (never out of this function — the fleet controller's
+    release path owns failed targets); with no fallback at all, the
+    remaining targets are reported ``failed``. Warming never wedges:
+    every wave either makes progress or consumes a failure.
 
     Returns ``{"waves", "warmed", "fallback", "failed", "ships"}``
     (warmed = targets shipped peer-to-peer; fallback = targets
@@ -701,7 +788,16 @@ def warm_fanout(targets, ship, *, seeders=(), fallback=None,
                 failed.extend(pending)
                 break
             waves += 1
-            fallback(dst)
+            try:
+                fallback(dst)
+            except Exception as e:          # noqa: BLE001 — per-target
+                # a failed storage load costs only its target: report
+                # it failed and keep warming the rest ("warming never
+                # wedges" covers the fallback path too)
+                log.warning("warm fan-out: storage fallback for %s "
+                            "failed: %s", dst, e)
+                failed.append(dst)
+                continue
             fell_back.append(dst)
             pool.append(dst)
             continue
@@ -772,7 +868,9 @@ class ChannelWarmer(FleetWarmer):
         self.timeout_s = timeout_s
 
     def _ship(self, src: str, dst: str) -> None:
-        hello = weights_rpc(dst, {"op": "list"},
+        # "resident" (not "list"): probing the TARGET must not make it
+        # lazily pack its own params just to answer a residency check
+        hello = weights_rpc(dst, {"op": "resident"},
                             timeout_s=self.timeout_s)
         if self.digest in (hello.get("resident") or []):
             return                          # already warm
@@ -790,7 +888,8 @@ class ChannelWarmer(FleetWarmer):
                 f"seeder {src} refused publish: {res.get('error')}")
         deadline = time.monotonic() + self.timeout_s
         while time.monotonic() < deadline:
-            listed = weights_rpc(dst, {"op": "list"}, timeout_s=10.0)
+            listed = weights_rpc(dst, {"op": "resident"},
+                                 timeout_s=10.0)
             if self.digest in (listed.get("resident") or []):
                 return
             time.sleep(0.05)
