@@ -285,6 +285,13 @@ def main() -> None:
     # the ratio is pinned >= 3x (tests/test_recovery.py runs the arm).
     out.update(_recovery_arm())
 
+    # goodput ledger: interval-accounting overhead vs a NullRegistry
+    # ledger (< 1% of the measured step wall asserted inside the arm)
+    # plus goodput_fraction_train read off a real local-backend run's
+    # final GOODPUT jhist event — the job page's headline number.
+    # Hardware-free and jax-free.
+    out.update(_goodput_arm())
+
     # streaming serving data plane: the persistent token-push wire vs a
     # request/response round trip per chunk, through an injected-latency
     # transport (LatencyProxy). Deterministic: a tiny CPU model with a
@@ -822,6 +829,106 @@ def _recovery_arm(steps: int = 36, step_wait: float = 0.25,
         "cold_restart_wall_s": round(cold_wall, 2),
         "cold_restart_steps_rerun": steps - primed,
         "recovery_vs_cold_restart": round(ratio, 2),
+    }
+
+
+def _goodput_arm(steps: int = 12, step_wait: float = 0.1) -> dict:
+    """Goodput-ledger overhead + a real attributed training run.
+
+    (a) Microbench: one enter/exit interval through the ledger, mirrored
+    into a live MetricsRegistry vs a NullRegistry (the metrics arm's A/B
+    discipline — snapshot-per-"step" included so the mirror path is in
+    the measurement). The train loop opens <= 4 intervals per step
+    (data_wait / step / checkpoint / eval), so 4x the per-interval cost
+    is asserted < 1% of the REAL mean step wall measured in (b) — the
+    issue's hard bound: attribution must be free.
+
+    (b) A 2-worker local-backend run of the jax-free fake trainer whose
+    final (cumulative) GOODPUT jhist event yields
+    ``goodput_fraction_train`` — the same headline the history job page
+    renders — plus the mean step wall used by (a)'s bound.
+
+    Emitted keys: ``goodput_interval_ns``, ``goodput_ledger_frac_of_step``
+    (< 0.01 asserted), ``goodput_ledger_live_vs_null`` (~1.0),
+    ``goodput_fraction_train``, ``goodput_step_wall_mean_s``."""
+    import os
+    import shutil
+    import sys
+    import tempfile
+
+    from tony_tpu.client.client import TonyClient
+    from tony_tpu.conf.config import TonyConfig
+    from tony_tpu.events.events import find_job_files, parse_events
+    from tony_tpu.runtime import goodput as goodput_mod
+    from tony_tpu.runtime import metrics as M
+
+    # (a) per-interval cost through the real enter/exit path; a snapshot
+    # every `per_snap` intervals models the trainer's publish cadence
+    n, per_snap = 100_000, 100
+
+    def timed(reg) -> float:
+        led = goodput_mod.GoodputLedger(registry=reg)
+        t0 = time.perf_counter()
+        for i in range(n):
+            with led.enter("step"):
+                pass
+            if i % per_snap == 0:
+                led.snapshot()
+        return (time.perf_counter() - t0) / n
+
+    live = timed(M.MetricsRegistry())
+    null = timed(M.NullRegistry())
+
+    # (b) the real run: step walls + the headline fraction from the
+    # final GOODPUT event
+    tmp = tempfile.mkdtemp(prefix="tony-goodput-bench-")
+    repo = os.path.dirname(os.path.abspath(__file__))
+    trainer = os.path.join(repo, "tests", "fixtures",
+                           "fake_elastic_trainer.py")
+    try:
+        cmd = (f"{sys.executable} {trainer} --steps {steps} "
+               f"--ckpt {os.path.join(tmp, 'progress')} "
+               f"--ckpt_every 2 --step_wait {step_wait} --tail_wait 0:1.5")
+        conf = TonyConfig({
+            "tony.staging.dir": os.path.join(tmp, "staging"),
+            "tony.history.location": os.path.join(tmp, "hist"),
+            "tony.application.timeout": "120000",
+            "tony.worker.instances": "2",
+            "tony.task.heartbeat-interval-ms": "100",
+            "tony.metrics.snapshot-interval-ms": "300",
+        })
+        rc = TonyClient(conf, cmd).run()
+        assert rc == 0, "goodput bench job failed"
+        final = None
+        for f in find_job_files(os.path.join(tmp, "hist")):
+            for e in parse_events(f):
+                if e.event_type == "GOODPUT":
+                    final = e
+        assert final is not None, "no GOODPUT event reached the jhist"
+        fraction = final.payload["fraction"]
+        assert 0 < fraction <= 1, fraction
+        sw_c = sw_s = 0.0
+        for tid, entry in final.payload["tasks"].items():
+            if tid.startswith("worker:"):
+                sw_c += entry["sw"]["c"]
+                sw_s += entry["sw"]["s"]
+        assert sw_c >= 2 * steps, "trainer ledgers never reached the jhist"
+        step_wall = sw_s / sw_c
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # the hard bound: <= 4 ledger intervals per train step must cost
+    # < 1% of the step wall they attribute
+    frac = 4 * live / step_wall
+    assert frac < 0.01, (
+        f"goodput ledger costs {frac:.2%} of the step wall — interval "
+        f"accounting is no longer free on the train loop")
+    return {
+        "goodput_interval_ns": round(live * 1e9, 1),
+        "goodput_ledger_frac_of_step": round(frac, 6),
+        "goodput_ledger_live_vs_null": round(live / max(null, 1e-12), 3),
+        "goodput_fraction_train": round(fraction, 4),
+        "goodput_step_wall_mean_s": round(step_wall, 4),
     }
 
 

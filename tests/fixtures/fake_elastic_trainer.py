@@ -7,12 +7,22 @@ relaunch), not model compile time.
 
 Prints ``step <i>`` per step; the same line set is what the bench arm
 diffs to count replayed steps.
+
+The step loop runs inside the process goodput ledger (``enter("step")``
+around the step wait, ``enter("checkpoint")`` around the progress-file
+write) and publishes through ``TONY_GOODPUT_SPOOL`` each step, so
+executor heartbeats carry a real per-step breakdown — which is also what
+the straggler chaos test drives: ``--slow index:seconds[:from:to]``
+stretches one task's step wall so the coordinator's detector has an
+honest skew signal to flag (and to watch recover once the window ends).
 """
 
 import argparse
 import os
 import sys
 import time
+
+from tony_tpu.runtime import goodput as goodput_mod
 
 
 def main() -> int:
@@ -30,6 +40,11 @@ def main() -> int:
                    help="task_index:seconds — that task sleeps extra before "
                         "'done' (make the chief finish LAST so its "
                         "completion verdict never truncates a sibling)")
+    p.add_argument("--slow", default="",
+                   help="task_index:seconds[:from_step:to_step] — that task "
+                        "sleeps EXTRA per step (inside its step-wall "
+                        "interval) over [from_step, to_step); omit the "
+                        "range for every step. The straggler-chaos knob.")
     args = p.parse_args()
 
     idx = int(os.environ.get("TASK_INDEX", "0"))
@@ -38,6 +53,13 @@ def main() -> int:
         marker, step, who = clause.rsplit(":", 2)
         if int(who) == idx:
             kills.append((int(step), marker))
+    slow_s, slow_from, slow_to = 0.0, 0, 1 << 30
+    if args.slow:
+        parts = args.slow.split(":")
+        if int(parts[0]) == idx:
+            slow_s = float(parts[1])
+            if len(parts) >= 4:
+                slow_from, slow_to = int(parts[2]), int(parts[3])
     path = f"{args.ckpt}-{os.environ.get('JOB_NAME', 'worker')}-{idx}"
     start = 0
     if os.path.exists(path):
@@ -45,21 +67,30 @@ def main() -> int:
     print(f"starting at step {start} "
           f"(epoch {os.environ.get('TONY_CLUSTER_EPOCH', '0')}, "
           f"session {os.environ.get('SESSION_ID', '0')})", flush=True)
+    ledger = goodput_mod.get_ledger()
     for step in range(start, args.steps):
         for kill_step, marker in kills:
             if step == kill_step:
                 open(marker, "w").close()
-        time.sleep(args.step_wait)
+        with ledger.enter("step"):
+            time.sleep(args.step_wait)
+            if slow_s > 0 and slow_from <= step < slow_to:
+                time.sleep(slow_s)
         print(f"step {step}", flush=True)
         if (step + 1) % args.ckpt_every == 0:
-            tmp = f"{path}.tmp"
-            with open(tmp, "w") as f:
-                f.write(str(step + 1))
-            os.replace(tmp, path)       # atomic: a kill never corrupts it
+            with ledger.enter("checkpoint"):
+                tmp = f"{path}.tmp"
+                with open(tmp, "w") as f:
+                    f.write(str(step + 1))
+                os.replace(tmp, path)   # atomic: a kill never corrupts it
+        # publish every step (not the ~1s throttle): chaos tests run
+        # sub-second step waits and the detector needs fresh windows
+        ledger.publish()
     if args.tail_wait:
         who, _, wait_s = args.tail_wait.partition(":")
         if int(who) == idx:
             time.sleep(float(wait_s))
+    ledger.publish()
     print("done", flush=True)
     return 0
 

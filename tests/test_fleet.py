@@ -32,6 +32,7 @@ import time
 
 import pytest
 
+from tony_tpu.runtime import metrics as M
 from tony_tpu.runtime.metrics import MetricsRegistry
 from tony_tpu.serving.client import StreamingClient
 from tony_tpu.serving.fleet import CapacityProvider, FleetController
@@ -573,13 +574,7 @@ class TestStorm:
                 assert budget_done >= 140, budget_done
             h = reg.histogram("tony_router_place_seconds")
             assert h.count >= 150
-            cum = h.cumulative()
-            p99_bound = None
-            for bound, c in zip(h.buckets, cum):
-                if c >= 0.99 * h.count:
-                    p99_bound = bound
-                    break
-            assert p99_bound is not None and p99_bound <= 2.5, \
-                (p99_bound, cum)
+            p99 = M.histogram_quantile(h, 0.99)
+            assert p99 <= 2.5, (p99, h.cumulative())
         finally:
             fleet.stop()

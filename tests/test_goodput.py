@@ -1,0 +1,427 @@
+"""Goodput ledger + straggler detector: the interval accountant's
+invariants (no gaps, no overlap — sum(categories) == wall), the
+executor/user-process spool bridge, the heartbeat piggyback's
+back-compat discipline, journal replay of coordinator-attributed
+extras, and the two e2e acceptance pins: bit-exact ``/goodput`` replay
+against the live coordinator's final GOODPUT event, and the chaos run
+where exactly the artificially-slowed worker is flagged (then cleared
+once the skew stops)."""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tony_tpu.client.client import TonyClient
+from tony_tpu.cluster import journal as journal_mod
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events import events as ev
+from tony_tpu.history.server import HistoryServer
+from tony_tpu.runtime import goodput as G
+from tony_tpu.runtime import metrics as M
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TRAINER = os.path.join(REPO, "tests", "fixtures",
+                       "fake_elastic_trainer.py")
+PY = sys.executable
+
+
+# ---------------------------------------------------------------------------
+# Ledger core: the no-gaps / no-overlap invariant
+# ---------------------------------------------------------------------------
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+def _ledger(clock):
+    return G.GoodputLedger(clock=clock, wall_clock=clock)
+
+
+def test_ledger_sum_equals_wall_with_nesting():
+    clk = FakeClock()
+    led = _ledger(clk)
+    clk.tick(1.0)                        # base overhead
+    with led.enter("provision"):
+        clk.tick(2.0)
+    with led.enter("step"):
+        clk.tick(3.0)
+        with led.enter("checkpoint"):    # nested: suspends step
+            clk.tick(0.5)
+        clk.tick(1.5)
+    clk.tick(0.25)
+    w = led.snapshot()
+    assert w["cat"] == {"overhead": 1.25, "provision": 2.0,
+                        "step": 4.5, "checkpoint": 0.5}
+    assert sum(w["cat"].values()) == pytest.approx(w["now"] - w["t0"])
+    assert w["cur"] == "overhead"
+    # only the OUTER closed step counts toward the straggler accumulators
+    assert w["sw"] == {"c": 1, "s": pytest.approx(4.5)}
+    assert w["n"]["step"] == 1 and w["n"]["checkpoint"] == 1
+
+
+def test_ledger_tolerates_out_of_order_exit():
+    """A generator-held inner context finalized AFTER its parent exits
+    must not corrupt the stack: the pop unwinds to the matching frame."""
+    clk = FakeClock()
+    led = _ledger(clk)
+    led._push("step")
+    clk.tick(1.0)
+    led._push("checkpoint")
+    clk.tick(1.0)
+    led._pop("step")                     # outer popped first
+    clk.tick(1.0)
+    w = led.snapshot()
+    assert sum(w["cat"].values()) == pytest.approx(w["now"] - w["t0"])
+    assert w["cur"] == "overhead"
+
+
+def test_ledger_rejects_unknown_category():
+    led = _ledger(FakeClock())
+    with pytest.raises(ValueError):
+        led.enter("coffee")
+    with pytest.raises(ValueError):
+        led.add("coffee", 1.0)
+    with pytest.raises(ValueError):
+        G.GoodputLedger(base="coffee")
+
+
+def test_ledger_mirrors_deltas_into_registry():
+    clk = FakeClock()
+    reg = M.MetricsRegistry()
+    led = G.GoodputLedger(clock=clk, wall_clock=clk, registry=reg,
+                          extra_categories=(G.USER_CATEGORY,))
+    with led.enter("step"):
+        clk.tick(2.0)
+    with led.enter(G.USER_CATEGORY):     # internal: never exported
+        clk.tick(1.0)
+    led.snapshot()
+    with led.enter("step"):
+        clk.tick(3.0)
+    led.snapshot()
+    wire = reg.to_wire()
+    totals = {(name, tuple(sorted(labels.items()))): value
+              for name, labels, value in wire["c"]}
+    key = ("tony_goodput_seconds_total", (("category", "step"),))
+    assert totals[key] == pytest.approx(5.0)     # 2.0 then +3.0, not 2+5
+    assert not any(lbls == (("category", G.USER_CATEGORY),)
+                   for (_, lbls) in totals)
+
+
+def test_ledger_spool_publish_roundtrip(tmp_path):
+    spool = str(tmp_path / "spool.json")
+    clk = FakeClock()
+    led = G.GoodputLedger(clock=clk, wall_clock=clk, spool_path=spool)
+    with led.enter("step"):
+        clk.tick(1.0)
+    led.publish()
+    wire = G.from_wire_json(open(spool).read())
+    assert wire is not None
+    assert wire["cat"]["step"] == pytest.approx(1.0)
+    assert not os.path.exists(spool + ".tmp")    # atomic publish
+
+
+@pytest.mark.parametrize("payload", [
+    "not json", "[]", '{"v": 99, "t0": 0, "now": 1}',
+    '{"v": 1, "t0": 5, "now": 1}',               # now < t0
+    '{"v": 1, "t0": 0, "now": 1, "cat": [1, 2]}',
+    '{"v": 1, "t0": 0, "now": 1, "cat": {"step": -2}}',
+    '{"v": 1, "t0": 0, "now": 1, "cat": {}, "sw": {"c": "x"}}',
+])
+def test_malformed_wires_are_dropped(payload):
+    assert G.from_wire_json(payload) is None
+
+
+def test_merge_wires_substitutes_child_and_credits_residual():
+    host = {"v": 1, "t0": 0.0, "now": 10.0,
+            "cat": {"provision": 1.0, "user": 8.0, "overhead": 1.0},
+            "cur": "user", "n": {"provision": 1}, "sw": {"c": 0, "s": 0.0}}
+    child = {"v": 1, "t0": 2.0, "now": 9.0,
+             "cat": {"step": 5.0, "data_wait": 1.0, "overhead": 0.5},
+             "cur": "step", "n": {"step": 10}, "sw": {"c": 10, "s": 5.0}}
+    merged = G.merge_wires(host, child)
+    assert "user" not in merged["cat"]
+    # residual user wall the child hasn't accounted (8 - 6.5) -> overhead
+    assert merged["cat"]["overhead"] == pytest.approx(1.0 + 0.5 + 1.5)
+    assert sum(merged["cat"].values()) == pytest.approx(10.0)
+    assert merged["sw"] == {"c": 10, "s": 5.0}
+    assert merged["cur"] == "step"       # host was inside user -> child's
+    # no child snapshot yet: the whole user wall is overhead
+    alone = G.merge_wires(host, None)
+    assert alone["cat"]["overhead"] == pytest.approx(9.0)
+    assert alone["cur"] == "overhead"
+
+
+def test_goodput_fraction_includes_extras_in_denominator():
+    entry = {"t0": 0.0, "now": 8.0, "cat": {"step": 6.0, "overhead": 2.0},
+             "extra": {"provision": 2.0}}
+    assert G.goodput_fraction(entry) == pytest.approx(0.6)
+    assert G.goodput_fraction({"t0": 0.0, "now": 0.0, "cat": {},
+                               "extra": {}}) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Straggler detector: pure-logic windows
+# ---------------------------------------------------------------------------
+def _wire(c, s):
+    return {"v": 1, "t0": 0.0, "now": 0.0, "cat": {}, "cur": "",
+            "n": {}, "sw": {"c": c, "s": s}}
+
+
+def test_straggler_flags_exactly_the_slow_task_then_clears():
+    det = G.StragglerDetector(factor=2.0, windows=2, alpha=1.0)
+    # window 0 seeds the per-task state; no verdicts possible yet
+    det.observe({f"worker:{i}": _wire(0, 0.0) for i in range(3)})
+    step = {0: 0.1, 1: 0.1, 2: 0.5}
+    cum = {i: [0, 0.0] for i in range(3)}
+    suspected_at = None
+    for rnd in range(1, 5):
+        wires = {}
+        for i in range(3):
+            cum[i][0] += 2
+            cum[i][1] += 2 * step[i]
+            wires[f"worker:{i}"] = _wire(*cum[i])
+        sus, cleared = det.observe(wires)
+        assert cleared == []
+        if sus:
+            assert suspected_at is None, "flagged twice without clearing"
+            suspected_at = rnd
+            assert [e["task"] for e in sus] == ["worker:2"]
+            assert sus[0]["gang"] == "worker"
+            assert sus[0]["ewma_s"] > 2.0 * sus[0]["median_s"]
+    assert suspected_at == 2             # windows=2 consecutive strikes
+    assert list(det.suspected) == ["worker:2"]
+    # skew stops: with alpha=1 one healthy window clears the suspicion
+    step[2] = 0.1
+    for i in range(3):
+        cum[i][0] += 2
+        cum[i][1] += 2 * step[i]
+    sus, cleared = det.observe(
+        {f"worker:{i}": _wire(*cum[i]) for i in range(3)})
+    assert sus == [] and cleared == ["worker:2"]
+    assert det.suspected == {}
+
+
+def test_straggler_gang_of_one_and_idle_windows_are_not_evidence():
+    det = G.StragglerDetector(factor=2.0, windows=1, alpha=1.0)
+    det.observe({"chief:0": _wire(0, 0.0)})
+    sus, _ = det.observe({"chief:0": _wire(4, 40.0)})
+    assert sus == []                     # no peers, no median, no verdict
+    det2 = G.StragglerDetector(factor=2.0, windows=1, alpha=1.0)
+    det2.observe({"worker:0": _wire(2, 0.2), "worker:1": _wire(2, 1.0)})
+    # second window closes NO steps anywhere: strikes must not advance
+    sus, cleared = det2.observe(
+        {"worker:0": _wire(2, 0.2), "worker:1": _wire(2, 1.0)})
+    assert sus == [] and cleared == []
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat piggyback: back-compat at the Heartbeater layer
+# ---------------------------------------------------------------------------
+class _Ack:
+    gcs_token = ""
+    cluster_epoch = 0
+    incarnation = 0
+
+
+def test_heartbeater_goodput_piggyback_and_backcompat():
+    from tony_tpu.cluster.executor import Heartbeater
+
+    class NewRpc:
+        def __init__(self):
+            self.calls = []
+
+        def task_executor_heartbeat(self, task_id, metrics="", spans="",
+                                    client_unix_time=0.0, client_rtt=0.0,
+                                    goodput=""):
+            self.calls.append(goodput)
+            return _Ack()
+
+    rpc = NewRpc()
+    hb = Heartbeater(rpc, "worker:0", interval_s=0.01,
+                     goodput_fn=lambda: '{"v":1}')
+    assert hb._rpc_takes_goodput
+    hb._send_beat()
+    assert rpc.calls == ['{"v":1}']
+    # a RAISING provider costs nothing: the beat goes out ledger-less
+    hb.goodput_fn = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+    hb._send_beat()
+    assert rpc.calls[-1] == ""
+
+    class OldRpc:                        # pre-goodput RPC surface
+        def __init__(self):
+            self.calls = []
+
+        def task_executor_heartbeat(self, task_id, metrics=""):
+            self.calls.append((task_id, metrics))
+            return ""
+
+    old = OldRpc()
+    hb2 = Heartbeater(old, "worker:0", interval_s=0.01,
+                      goodput_fn=lambda: '{"v":1}')
+    assert not hb2._rpc_takes_goodput
+    hb2._send_beat()                     # must not pass goodput= at all
+    assert old.calls == [("worker:0", "")]
+
+
+# ---------------------------------------------------------------------------
+# Journal: coordinator-attributed extras replay exactly once
+# ---------------------------------------------------------------------------
+def test_fold_accumulates_goodput_extras_and_reset_clears():
+    records = [
+        {"k": "goodput_extra", "task": "worker:0",
+         "category": "provision", "seconds": 1.5},
+        {"k": "goodput_extra", "task": "worker:0",
+         "category": "provision", "seconds": 0.5},
+        {"k": "goodput_extra", "task": "worker:1",
+         "category": "recovery", "seconds": 2.0},
+        {"k": "goodput_extra", "task": "worker:1"},           # malformed
+        {"k": "goodput_extra", "task": "worker:1",
+         "category": "recovery", "seconds": "not-a-number"},
+    ]
+    state = journal_mod.fold(records)
+    assert state.goodput_extra == {
+        "worker:0": {"provision": pytest.approx(2.0)},
+        "worker:1": {"recovery": pytest.approx(2.0)}}
+    state2 = journal_mod.fold(records + [
+        {"k": "session_reset", "session_id": 1},
+        {"k": "goodput_extra", "task": "worker:0",
+         "category": "stage", "seconds": 0.25}])
+    assert state2.goodput_extra == {
+        "worker:0": {"stage": pytest.approx(0.25)}}
+
+
+# ---------------------------------------------------------------------------
+# E2E: live plane -> jhist -> bit-exact /goodput replay
+# ---------------------------------------------------------------------------
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://localhost:{port}{path}", timeout=10) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def _events_from_hist(hist_dir):
+    out = []
+    for path in sorted(ev.find_job_files(hist_dir)):
+        out.extend(ev.parse_events(path))
+    return out
+
+
+@pytest.mark.e2e
+def test_goodput_plane_end_to_end_and_replay_bit_exact(tmp_path):
+    """A real local-backend training run: every task's replayed breakdown
+    sums to its wall clock (no gaps, no overlap), the goodput fraction
+    shows on /metrics and the job page, and /api/jobs/<id>/goodput
+    replays the live coordinator's final GOODPUT event bit-exact."""
+    hist = str(tmp_path / "hist")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": hist,
+        "tony.application.timeout": "90000",
+        "tony.worker.instances": "2",
+        "tony.task.heartbeat-interval-ms": "100",
+        "tony.metrics.snapshot-interval-ms": "300",
+    })
+    cmd = (f"{PY} {TRAINER} --steps 10 --ckpt {tmp_path / 'progress'} "
+           f"--ckpt_every 2 --step_wait 0.1 --tail_wait 0:1.5")
+    client = TonyClient(conf, cmd)
+    assert client.run() == 0
+
+    events = _events_from_hist(hist)
+    goodputs = [e for e in events if e.event_type == ev.GOODPUT]
+    assert goodputs, "no GOODPUT events reached the jhist"
+    final = goodputs[-1]
+    tasks = final.payload["tasks"]
+    assert set(tasks) >= {"worker:0", "worker:1"}
+    for tid in ("worker:0", "worker:1"):
+        entry = tasks[tid]
+        wall = entry["now"] - entry["t0"]
+        assert wall > 0
+        # the acceptance pin: the carve-up is exhaustive and disjoint
+        assert sum(entry["cat"].values()) == pytest.approx(wall, abs=0.02)
+        assert entry["cat"]["step"] > 0.5        # 10 steps x 0.1s
+        assert entry["sw"]["c"] == 10
+        assert "extra" in entry
+    frac = final.payload["fraction"]
+    assert 0 < frac <= 1
+    # the fraction gauge rode the coordinator's own registry (am:0) into
+    # the same snapshot pass; worker wires carry the per-category counter
+    snaps = [e for e in events if e.event_type == ev.METRICS_SNAPSHOT]
+    assert snaps
+    am_wire = json.dumps(snaps[-1].payload.get("tasks", {}).get("am:0", {}))
+    assert "tony_goodput_fraction" in am_wire
+    worker_wire = json.dumps(snaps[-1].payload["tasks"]["worker:0"])
+    assert "tony_goodput_seconds_total" in worker_wire
+
+    server = HistoryServer(TonyConfig({"tony.history.location": hist}),
+                           port=0)
+    server.start()
+    try:
+        status, body = _get(server.port,
+                            f"/api/jobs/{client.app_id}/goodput")
+        assert status == 200
+        g = json.loads(body)
+        # bit-exact: the replayed breakdown IS the final GOODPUT event
+        assert g["tasks"] == final.payload["tasks"]
+        assert g["fraction"] == final.payload["fraction"]
+        assert g["window_count"] == len(goodputs)
+        # the job page renders the goodput bar with its headline fraction
+        status, page = _get(server.port, f"/jobs/{client.app_id}")
+        assert status == 200
+        assert f"Goodput {frac * 100.0:.1f}%" in page
+        assert "Wall breakdown" in page
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# E2E chaos: one worker skewed -> exactly that task flagged, then cleared
+# ---------------------------------------------------------------------------
+@pytest.mark.e2e
+def test_straggler_chaos_flags_exactly_the_slow_worker(tmp_path):
+    """3-worker gang; worker 2 sleeps an extra 0.6s/step over a step
+    window. The detector must flag worker:2 — and ONLY worker:2 — and
+    clear it once the skew stops (both verdicts as jhist events)."""
+    hist = str(tmp_path / "hist")
+    conf = TonyConfig({
+        "tony.staging.dir": str(tmp_path / "staging"),
+        "tony.history.location": hist,
+        "tony.application.timeout": "120000",
+        "tony.worker.instances": "3",
+        "tony.task.heartbeat-interval-ms": "250",
+        "tony.metrics.snapshot-interval-ms": "1000",
+        "tony.goodput.window-ms": "400",
+        "tony.straggler.factor": "2.0",
+        "tony.straggler.windows": "2",
+    })
+    cmd = (f"{PY} {TRAINER} --steps 44 --ckpt {tmp_path / 'progress'} "
+           f"--ckpt_every 4 --step_wait 0.15 --slow 2:0.6:2:12 "
+           f"--tail_wait 0:8")
+    client = TonyClient(conf, cmd)
+    assert client.run() == 0
+
+    events = _events_from_hist(hist)
+    sus = [e for e in events if e.event_type == ev.STRAGGLER_SUSPECTED]
+    clr = [e for e in events if e.event_type == ev.STRAGGLER_CLEARED]
+    assert sus, "the slowed worker was never flagged"
+    assert {e.payload["task"] for e in sus} == {"worker:2"}, \
+        [e.payload for e in sus]
+    assert {e.payload["task"] for e in clr} == {"worker:2"}, \
+        "suspicion never cleared after the skew stopped"
+    evidence = sus[0].payload
+    assert evidence["gang"] == "worker"
+    assert evidence["ewma_s"] > evidence["factor"] * evidence["median_s"]
+    # the counter rode the coordinator's registry into the jhist
+    snaps = [e for e in events if e.event_type == ev.METRICS_SNAPSHOT]
+    am_wire = json.dumps(snaps[-1].payload.get("tasks", {}).get("am:0", {}))
+    assert "tony_straggler_suspected_total" in am_wire

@@ -243,6 +243,52 @@ def test_sample_host_stats_populates_gauges():
     assert "tony_process_cpu_seconds" in names
     rss = dict((n, v) for n, _, v in wire["g"])["tony_process_rss_bytes"]
     assert rss > 1 << 20                      # a python process is > 1 MiB
+    # Linux-gated: the CI image has /proc/self/fd, so the open-fd gauge
+    # must land — a python process always holds stdio at minimum
+    import os
+    if os.path.isdir("/proc/self/fd"):
+        fds = dict((n, v) for n, _, v in wire["g"])["tony_task_open_fds"]
+        assert fds >= 3
+
+
+# ---------------------------------------------------------------------------
+# histogram_quantile
+# ---------------------------------------------------------------------------
+
+def test_histogram_quantile_interpolates_and_handles_edges():
+    import math
+    h = M.Histogram("lat", {}, buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 1.5, 3.0):
+        h.observe(v)
+    # rank 2 of 4: one observation below the (1, 2] bucket, so the
+    # rank sits halfway through it -> 1.5 (prometheus semantics)
+    assert M.histogram_quantile(h, 0.5) == pytest.approx(1.5)
+    assert M.histogram_quantile(h, 1.0) == pytest.approx(4.0)
+    # first bucket interpolates from lower bound 0
+    assert M.histogram_quantile(h, 0.25) == pytest.approx(1.0)
+    # wire-dict input is equivalent to the live instrument
+    wire = {"b": [1.0, 2.0, 4.0], "n": list(h._counts)}
+    assert M.histogram_quantile(wire, 0.5) == \
+        M.histogram_quantile(h, 0.5)
+    # empty histogram -> NaN, never a crash
+    empty = M.Histogram("e", {}, buckets=(1.0,))
+    assert math.isnan(M.histogram_quantile(empty, 0.99))
+    assert math.isnan(M.histogram_quantile({"b": [], "n": []}, 0.5))
+    # a rank landing in the +Inf bucket clamps to the highest finite
+    # bound (no interior to interpolate)
+    inf = M.Histogram("i", {}, buckets=(1.0, 2.0))
+    for v in (0.5, 10.0, 20.0):
+        inf.observe(v)
+    assert M.histogram_quantile(inf, 0.99) == 2.0
+    # single-bucket histogram: everything interpolates inside [0, bound]
+    one = M.Histogram("o", {}, buckets=(8.0,))
+    for _ in range(4):
+        one.observe(1.0)
+    assert M.histogram_quantile(one, 0.5) == pytest.approx(4.0)
+    with pytest.raises(ValueError):
+        M.histogram_quantile(h, 1.5)
+    with pytest.raises(ValueError):
+        M.histogram_quantile(h, -0.1)
 
 
 def test_default_registry_swap_restores():

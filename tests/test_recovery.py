@@ -166,6 +166,25 @@ def test_coordinator_kill_mid_train_recovers(tmp_path):
     assert "tony_coordinator_restarts_total" in wire, detail
     assert "tony_coordinator_recovery_seconds" in wire, detail
 
+    # Goodput ledger resume: the restarted coordinator journaled the
+    # recovery wall ONCE per adopted task, and its final GOODPUT event
+    # carries exactly the journal-folded extras — pre-crash attributions
+    # are replayed, never re-journaled, so nothing double-counts.
+    recov = [r for r in records if r["k"] == "goodput_extra"
+             and r.get("category") == "recovery"]
+    assert sorted(r["task"] for r in recov) == [
+        f"worker:{i}" for i in range(workers)], (recov, detail)
+    goodputs = [e for e in restart_events if e.event_type == "GOODPUT"]
+    assert goodputs, detail
+    final_tasks = goodputs[-1].payload["tasks"]
+    for tid, cats in state.goodput_extra.items():
+        got = final_tasks[tid]["extra"]
+        for cat, secs in cats.items():
+            assert got.get(cat, 0.0) == pytest.approx(secs, abs=1e-3), (
+                tid, cat, got, state.goodput_extra, detail)
+    assert all(final_tasks[f"worker:{i}"]["extra"]["recovery"] > 0
+               for i in range(workers)), (final_tasks, detail)
+
 
 @pytest.mark.recovery
 def test_journal_disabled_runs_without_journal(tmp_path):

@@ -647,3 +647,36 @@ def test_metric_series_docs_bijection():
     missing = sorted(n for n in names if n not in doc)
     assert not missing, f"series missing from docs/observability.md: " \
                         f"{missing}"
+
+
+# ---------------------------------------------------------------------------
+# Event-type ↔ docs bijection (same enforcement, jhist vocabulary)
+# ---------------------------------------------------------------------------
+def _declared_event_types():
+    """Every jhist event type declared under tony_tpu/: the SCREAMING_CASE
+    ``NAME = "NAME"`` constants in events/events.py (the single
+    registration point — emit sites all reference these) — scanned from
+    source so a constant added without touching this test still counts."""
+    path = os.path.join(os.path.dirname(__file__), os.pardir, "tony_tpu",
+                        "events", "events.py")
+    src = open(path, encoding="utf-8").read()
+    pairs = re.findall(r'^([A-Z][A-Z_]*) = "([A-Z][A-Z_]*)"', src,
+                       flags=re.MULTILINE)
+    return {value for name, value in pairs if name == value}
+
+
+def test_event_types_docs_bijection():
+    """Every declared jhist event type must have a row in
+    docs/observability.md — an event type without an operator-facing
+    description is a doc regression by construction, exactly like an
+    undocumented metric series."""
+    doc = open(os.path.join(os.path.dirname(__file__), os.pardir, "docs",
+                            "observability.md"), encoding="utf-8").read()
+    types = _declared_event_types()
+    # sanity: the scanner still sees known types from several subsystems
+    assert {"APPLICATION_INITED", "METRICS_SNAPSHOT", "TRACE_SPAN",
+            "GOODPUT", "STRAGGLER_SUSPECTED",
+            "COORDINATOR_RESTART"} <= types, types
+    missing = sorted(t for t in types if t not in doc)
+    assert not missing, f"event types missing from " \
+                        f"docs/observability.md: {missing}"

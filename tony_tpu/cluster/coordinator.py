@@ -46,6 +46,7 @@ from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.events import events as ev
 from tony_tpu.rpc.server import ApplicationRpcServer
+from tony_tpu.runtime import goodput as goodput_mod
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime import tracing
 from tony_tpu.utils.docker import docker_wrap
@@ -117,7 +118,8 @@ class CoordinatorRpc(ApplicationRpc):
 
     def task_executor_heartbeat(self, task_id: str, metrics: str = "",
                                 spans: str = "", client_time: float = 0.0,
-                                client_rtt: float = 0.0) -> HeartbeatAck:
+                                client_rtt: float = 0.0,
+                                goodput: str = "") -> HeartbeatAck:
         self.co.hb_monitor.ping(task_id)
         # A beat from a task the RESTARTED coordinator re-adopted closes
         # that task's recovery wait (no-op outside recovery).
@@ -132,6 +134,9 @@ class CoordinatorRpc(ApplicationRpc):
         # discipline — anything malformed is dropped inside, never
         # raised into the handler; the ping above already counted.
         self.co.on_trace_beat(task_id, spans, client_time, client_rtt)
+        # Goodput-ledger piggyback: last-snapshot-wins like the metrics
+        # table (the wire is cumulative, so retries re-ingest cleanly).
+        self.co.on_goodput_beat(task_id, goodput)
         # The ack fans out BOTH slow-moving control values: the current
         # GCS token (renewal) and the cluster-spec epoch — an executor
         # seeing an epoch ahead of its own stops its user process and
@@ -185,6 +190,9 @@ class Coordinator:
         #: re-adopted live tasks still silent since the restart; drains as
         #: their executors re-attach (heartbeat or re-registration)
         self._recovery_awaiting: set[str] = set()
+        #: everything re-adopted this incarnation (kept after the awaiting
+        #: set drains — the goodput recovery-wall attribution set)
+        self._recovery_adopted: list[str] = []
         jpath = journal_mod.journal_path(self.job_dir)
         if (self.journal_enabled and os.path.exists(jpath)
                 and not os.path.exists(
@@ -355,6 +363,31 @@ class Coordinator:
         self._trace_last_batch: dict[str, str] = {}
         self.clock_offsets: dict[str, float] = {}
         self.trace_rejects = 0
+        # Goodput plane: last heartbeat-shipped ledger wire per task
+        # (cumulative → last-snapshot-wins, like the metrics table) plus
+        # the seconds only the COORDINATOR can attribute (launch
+        # provision/stage walls, elastic resync, crash recovery), which
+        # are journaled so a restarted coordinator keeps them without
+        # re-measuring. Folded into GOODPUT jhist events on the metrics
+        # cadence; the straggler detector ticks on its own window.
+        self._goodput_lock = threading.Lock()
+        self._goodput_wires: dict[str, dict] = {}
+        # _restore_session (above) may already have repopulated the
+        # journaled attributions — keep them.
+        self._goodput_extra: dict[str, dict[str, float]] = getattr(
+            self, "_goodput_extra", {})
+        self.goodput_rejects = 0
+        self._goodput_window_s = conf.get_int(
+            K.GOODPUT_WINDOW_MS_KEY, 2000) / 1000.0
+        self._goodput_last_tick = time.monotonic()
+        try:
+            straggler_factor = float(
+                conf.get(K.STRAGGLER_FACTOR_KEY) or "2.0")
+        except ValueError:
+            straggler_factor = 2.0
+        self.straggler = goodput_mod.StragglerDetector(
+            factor=straggler_factor,
+            windows=conf.get_int(K.STRAGGLER_WINDOWS_KEY, 3))
         #: task_id -> last flight-recorder tail shipped on a beat; popped
         #: into the task's incident TASK_FINISHED event
         self._flight_tails: dict[str, dict] = {}
@@ -427,6 +460,11 @@ class Coordinator:
                 task.status = TaskStatus.SCHEDULED
         self.session._next_allocation_id = max_alloc + 1
         self.session._regrow_pending = set(state.regrow_pending)
+        # Journaled goodput attributions come back as-is (set directly,
+        # NOT via _note_goodput_extra — re-journaling them would double
+        # the seconds on the next replay).
+        self._goodput_extra = {tid: dict(cats) for tid, cats
+                               in state.goodput_extra.items()}
         if self.session.barrier_released():
             self.session._assign_process_ids()
             self.session._channel_specs = self.session._build_channel_specs()
@@ -471,6 +509,7 @@ class Coordinator:
             if rec.registered:
                 self.hb_monitor.register(tid, grace_s=self.reattach_grace_s)
                 self._recovery_awaiting.add(tid)
+                self._recovery_adopted.append(tid)
         log.warning(
             "coordinator restart (incarnation %d): recovered session %d "
             "at epoch %d — re-adopted %d live task(s) %s, %d already "
@@ -500,6 +539,12 @@ class Coordinator:
                  "executor re-attaching (last recovery)").set(wall)
         tracing.get_flight().record("coordinator_recovered",
                                     wall_s=round(wall, 3))
+        # Goodput: each adopted task paid the recovery wall (coordinator
+        # start → full re-attachment). Attributed (and journaled) ONCE,
+        # here — a later coordinator restart replays the journal record
+        # instead of re-measuring, so the window never double-counts.
+        for tid in self._recovery_adopted:
+            self._note_goodput_extra(tid, "recovery", wall)
 
     # ------------------------------------------------------------------
     # RPC-driven hooks
@@ -748,6 +793,14 @@ class Coordinator:
                              active=active,
                              recovery_wall_s=round(wall, 3),
                              session_id=self.session.session_id)
+            # every survivor paid the shrink→barrier wall as resync
+            # time. The executor's own ledger sees part of this wall
+            # (its re-registration wait) too — the overlap makes the
+            # goodput fraction CONSERVATIVE during elastic incidents,
+            # never optimistic.
+            for t in self.session.participants():
+                if not t.completed:
+                    self._note_goodput_extra(t.task_id, "resync", wall)
         if (self._elastic_regrow_queue
                 and now >= self._elastic_regrow_deadline):
             queue, self._elastic_regrow_queue = \
@@ -1373,6 +1426,24 @@ class Coordinator:
                         cached=bool(rec.get("cached")))
                 except (TypeError, ValueError):
                     pass          # a malformed record already renders raw
+                # Goodput attribution: backend bring-up walls happen
+                # BEFORE the executor's own ledger exists, so only the
+                # coordinator can account them. A task-tagged record
+                # charges that task; a gang-level record charges every
+                # task of the gang (each of them paid that wall).
+                if phase in ("provision", "stage"):
+                    try:
+                        seconds = float(rec.get("seconds", 0.0))
+                    except (TypeError, ValueError):
+                        seconds = 0.0
+                    tid = str(rec.get("task", "") or "")
+                    if tid:
+                        self._note_goodput_extra(tid, phase, seconds)
+                    else:
+                        gang = str(rec.get("gang", ""))
+                        for task in self.session.tasks.get(gang, ()):
+                            self._note_goodput_extra(task.task_id, phase,
+                                                     seconds)
             self.events.emit(ev.LAUNCH,
                              session_id=self.session.session_id, **rec)
 
@@ -1437,6 +1508,142 @@ class Coordinator:
         whatever locks they like; the dict op is atomic enough)."""
         return self._flight_tails.pop(task_id, None)
 
+    # ------------------------------------------------------------------
+    # Goodput plane
+    # ------------------------------------------------------------------
+    def on_goodput_beat(self, task_id: str, payload: str) -> None:
+        """Heartbeat goodput piggyback (RPC handler threads): validate
+        and keep the task's latest cumulative ledger wire. Malformed
+        payloads are dropped without costing the ping."""
+        if not payload:
+            return
+        wire = goodput_mod.from_wire_json(payload)
+        if wire is None:
+            self.goodput_rejects += 1
+            metrics_mod.get_default().counter(
+                "tony_goodput_beats_rejected_total",
+                help="malformed heartbeat goodput snapshots dropped").inc()
+            log.warning("dropping malformed goodput snapshot from %s",
+                        task_id)
+            return
+        with self._goodput_lock:
+            self._goodput_wires[task_id] = wire
+
+    def _note_goodput_extra(self, task_id: str, category: str,
+                            seconds: float) -> None:
+        """Attribute *seconds* of *category* to a task on the
+        coordinator's own authority (walls no executor ledger can see:
+        backend provisioning, elastic resync, crash recovery). Journaled
+        so a restarted coordinator replays the attribution instead of
+        re-measuring it — the no-double-count guarantee."""
+        if seconds <= 0:
+            return
+        with self._goodput_lock:
+            cats = self._goodput_extra.setdefault(task_id, {})
+            cats[category] = cats.get(category, 0.0) + seconds
+        self._journal_append("goodput_extra", task=task_id,
+                             category=category,
+                             seconds=round(seconds, 6))
+
+    def _goodput_payload(self) -> tuple[dict, float]:
+        """The GOODPUT event payload: per-task entries (ledger wire with
+        t0/now shifted onto the coordinator's clock via the task's
+        offset estimate, plus the coordinator-attributed "extra"
+        seconds) and the job-level goodput fraction — total step seconds
+        over total attributed wall."""
+        with self._goodput_lock:
+            wires = {t: dict(w) for t, w in self._goodput_wires.items()}
+            extras = {t: dict(e) for t, e in self._goodput_extra.items()}
+        tasks: dict[str, dict] = {}
+        total_step = total_wall = 0.0
+        for tid in sorted(set(wires) | set(extras)):
+            wire = wires.get(tid)
+            offset = self.clock_offsets.get(tid, 0.0)
+            if wire is not None:
+                entry = {
+                    "t0": round(float(wire.get("t0", 0.0)) + offset, 6),
+                    "now": round(float(wire.get("now", 0.0)) + offset, 6),
+                    "cat": {k: round(float(v), 6)
+                            for k, v in wire.get("cat", {}).items()},
+                    "cur": wire.get("cur", ""),
+                    "n": wire.get("n", {}),
+                    "sw": wire.get("sw", {"c": 0, "s": 0.0}),
+                }
+            else:           # extras-only task (e.g. died before a beat)
+                entry = {"t0": 0.0, "now": 0.0, "cat": {}, "cur": "",
+                         "n": {}, "sw": {"c": 0, "s": 0.0}}
+            entry["extra"] = {k: round(v, 6)
+                              for k, v in extras.get(tid, {}).items()}
+            tasks[tid] = entry
+            total_step += entry["cat"].get("step", 0.0)
+            total_wall += max(0.0, entry["now"] - entry["t0"]) \
+                + sum(entry["extra"].values())
+        fraction = (total_step / total_wall) if total_wall > 0 else 0.0
+        return tasks, fraction
+
+    def _emit_goodput(self) -> None:
+        """Fold the goodput tables into one GOODPUT jhist event (the
+        metrics-snapshot cadence). Entries are cumulative, so the LAST
+        event of a job is its complete breakdown — what the history
+        server's /goodput endpoint replays bit-exact."""
+        tasks, fraction = self._goodput_payload()
+        if not tasks:
+            return
+        # The fraction gauge rides the coordinator's own registry into
+        # the SAME _maybe_emit_metrics pass (am:0), hence /metrics.
+        metrics_mod.get_default().gauge(
+            "tony_goodput_fraction",
+            help="job-level goodput fraction: step seconds over total "
+                 "attributed wall seconds").set(round(fraction, 6))
+        self.events.emit(ev.GOODPUT, tasks=tasks,
+                         fraction=round(fraction, 6),
+                         session_id=self.session.session_id)
+
+    def _straggler_tick(self) -> None:
+        """Detector window (monitor loop, tony.goodput.window-ms
+        cadence): feed the latest per-task wires to the EWMA-vs-gang-
+        median comparison; turn transitions into jhist events, the
+        suspected counter, an active gauge, and flight-recorder evidence."""
+        now = time.monotonic()
+        if (self._goodput_window_s <= 0
+                or now - self._goodput_last_tick < self._goodput_window_s):
+            return
+        self._goodput_last_tick = now
+        with self._goodput_lock:
+            wires = {t: dict(w) for t, w in self._goodput_wires.items()}
+        if not wires:
+            return
+        suspected, cleared = self.straggler.observe(wires)
+        reg = metrics_mod.get_default()
+        for evidence in suspected:
+            tid = evidence["task"]
+            log.warning(
+                "straggler suspected: %s step-wall EWMA %.4fs > %.1fx gang "
+                "median %.4fs for %d windows", tid, evidence["ewma_s"],
+                evidence["factor"], evidence["median_s"],
+                evidence["windows"])
+            reg.counter(
+                "tony_straggler_suspected_total",
+                help="straggler-detector suspicions raised",
+                task=tid).inc()
+            reg.gauge(
+                "tony_straggler_active",
+                help="1 while the task is suspected of straggling",
+                task=tid).set(1)
+            tracing.get_flight().record("straggler", **evidence)
+            self.events.emit(ev.STRAGGLER_SUSPECTED,
+                             session_id=self.session.session_id,
+                             **evidence)
+        for tid in cleared:
+            log.info("straggler cleared: %s back under the gang threshold",
+                     tid)
+            reg.gauge("tony_straggler_active",
+                      help="1 while the task is suspected of straggling",
+                      task=tid).set(0)
+            tracing.get_flight().record("straggler_cleared", task=tid)
+            self.events.emit(ev.STRAGGLER_CLEARED, task=tid,
+                             session_id=self.session.session_id)
+
     def _emit_trace_events(self) -> None:
         """Fold pending span batches into TRACE_SPAN jhist events, one
         per (task, batch), with the task's clock-offset estimate applied
@@ -1473,6 +1680,9 @@ class Coordinator:
         self._metrics_last_emit = now
         # trace spans share the snapshot cadence (batched, not per-beat)
         self._emit_trace_events()
+        # goodput too — BEFORE the own-registry collection below, so the
+        # fraction gauge it sets lands in this same snapshot
+        self._emit_goodput()
         payload = self.metrics_table.as_payload()
         metrics_mod.sample_host_stats()
         own = metrics_mod.get_default().to_wire()
@@ -1489,6 +1699,7 @@ class Coordinator:
             self._apply_completions(self.backend.poll_completed())
             self._elastic_tick()
             self._drain_launch_timings()
+            self._straggler_tick()
             self._maybe_emit_metrics()
             if self.timeout_s > 0 and time.monotonic() - started_at > self.timeout_s:
                 self.failure_message = (
@@ -1775,6 +1986,16 @@ class Coordinator:
             # the table holds the dead generation's snapshots; the new
             # session's executors repopulate it within one heartbeat
             self.metrics_table.clear()
+            # goodput follows the same scoping: the dead session's ledger
+            # wires, coordinator attributions and straggler EWMAs all
+            # belong to it (the journal fold clears goodput_extra on
+            # session_reset too, keeping replay and live state aligned)
+            with self._goodput_lock:
+                self._goodput_wires.clear()
+                self._goodput_extra.clear()
+            self.straggler = goodput_mod.StragglerDetector(
+                factor=self.straggler.factor,
+                windows=self.straggler.windows)
             self.events.emit(ev.SESSION_RESET,
                              old_session_id=self.session.session_id)
             # Keep the failed attempt's uptime: the north-star fraction must

@@ -37,6 +37,7 @@ from tony_tpu import constants
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig
 from tony_tpu.rpc.client import ApplicationRpcClient, RpcRetryError
+from tony_tpu.runtime import goodput as goodput_mod
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime import tracing
 
@@ -90,7 +91,7 @@ class Heartbeater(threading.Thread):
                  interval_s: float, gcs_token_file: str | None = None,
                  snapshot_fn=None, on_epoch=None, spans_fn=None,
                  reattach_timeout_s: float = 0.0, refresh_rpc=None,
-                 on_reattach=None) -> None:
+                 on_reattach=None, goodput_fn=None) -> None:
         super().__init__(name="heartbeater", daemon=True)
         self.rpc = rpc
         self.task_id = task_id
@@ -103,6 +104,10 @@ class Heartbeater(threading.Thread):
         #: the executor's own spans plus the user process's spool tail.
         #: Same contract as snapshot_fn: errors never cost a ping.
         self.spans_fn = spans_fn
+        #: () -> cumulative goodput-ledger wire JSON (runtime/goodput.py)
+        #: — host ledger merged with the user process's spool snapshot.
+        #: Same contract as snapshot_fn: errors never cost a ping.
+        self.goodput_fn = goodput_fn
         #: last measured beat RTT — shipped on the NEXT beat as the
         #: coordinator's clock-offset half-trip estimate
         self.last_rtt = 0.0
@@ -111,10 +116,13 @@ class Heartbeater(threading.Thread):
         # inspect precedent as the server-side handler.
         try:
             import inspect
-            self._rpc_takes_trace = "spans" in inspect.signature(
+            _params = inspect.signature(
                 rpc.task_executor_heartbeat).parameters
+            self._rpc_takes_trace = "spans" in _params
+            self._rpc_takes_goodput = "goodput" in _params
         except (TypeError, ValueError):
             self._rpc_takes_trace = True
+            self._rpc_takes_goodput = True
         #: epoch observer (elastic resync): called with the coordinator's
         #: cluster epoch from every ack; the executor compares it to the
         #: epoch its user process was launched under and resyncs on a
@@ -182,6 +190,16 @@ class Heartbeater(threading.Thread):
                         "heartbeat", exc_info=True)
             return ""
 
+    def _goodput(self) -> str:
+        if self.goodput_fn is None:
+            return ""
+        try:
+            return self.goodput_fn() or ""
+        except Exception:
+            log.warning("goodput snapshot collection failed; sending "
+                        "ledger-less heartbeat", exc_info=True)
+            return ""
+
     def _send_beat(self) -> None:
         """One heartbeat send + ack handling; raises on send failure (the
         caller counts). Ack handling — token republish, epoch observer,
@@ -192,8 +210,13 @@ class Heartbeater(threading.Thread):
         # snapshot assembly
         snapshot = self._snapshot()
         spans = self._spans() if self._rpc_takes_trace else ""
+        goodput = self._goodput() if self._rpc_takes_goodput else ""
         t0 = time.perf_counter()
-        if self._rpc_takes_trace:
+        if self._rpc_takes_goodput:
+            ack = self.rpc.task_executor_heartbeat(
+                self.task_id, snapshot, spans=spans,
+                client_rtt=self.last_rtt, goodput=goodput)
+        elif self._rpc_takes_trace:
             ack = self.rpc.task_executor_heartbeat(
                 self.task_id, snapshot, spans=spans,
                 client_rtt=self.last_rtt)
@@ -357,6 +380,22 @@ class TaskExecutor:
                           flight_dir=os.getcwd(),
                           flight_ring=self._flight_ring)
         self._spool_reader = tracing.SpoolReader(self.trace_spool)
+        # Goodput ledger: the HOST-side accountant of this task's wall
+        # clock. The user process keeps its own ledger and publishes it
+        # to the goodput spool (same child→executor bridge as the trace
+        # spool); goodput_snapshot() substitutes that breakdown for the
+        # host ledger's internal "user" span at each beat.
+        self.goodput_spool = os.path.join(
+            os.getcwd(), f".goodput-{self.job_name}-{self.task_index}.json")
+        try:
+            # a previous generation's spool must not be merged into this
+            # generation's fresh host ledger
+            os.unlink(self.goodput_spool)
+        except OSError:
+            pass
+        self._ledger = goodput_mod.GoodputLedger(
+            registry=metrics_mod.get_default(),
+            extra_categories=(goodput_mod.USER_CATEGORY,))
         #: one-shot incident tail attached to the FINAL beat after an
         #: abnormal child exit, so the coordinator can hang it on the
         #: incident's jhist event even when nobody can read this host
@@ -507,6 +546,22 @@ class TaskExecutor:
             return ""
         return tracing.encode_batch(spans, flight=tail)
 
+    def goodput_snapshot(self) -> str:
+        """Merged goodput wire for the heartbeat piggyback: the host
+        ledger (provision/stage/resync + the internal ``user`` span)
+        with the user process's own spool-published breakdown
+        substituted in (see runtime/goodput.py merge_wires). Cumulative
+        totals — a re-delivered beat re-ingests to the same table."""
+        host = self._ledger.snapshot()
+        child = None
+        try:
+            with open(self.goodput_spool, encoding="utf-8") as f:
+                child = goodput_mod.from_wire_json(f.read())
+        except OSError:
+            pass
+        return json.dumps(goodput_mod.merge_wires(host, child),
+                          sort_keys=True)
+
     # ------------------------------------------------------------------
     def register_and_get_cluster_spec(self) -> dict:
         """Register our endpoint, then poll until the gang barrier releases
@@ -629,6 +684,10 @@ class TaskExecutor:
         env[constants.TONY_TRACE_RING] = str(self._trace_ring)
         env[constants.TONY_FLIGHT_DIR] = os.getcwd()
         env[constants.TONY_FLIGHT_RING] = str(self._flight_ring)
+        # Goodput bridge: the user process's ledger publishes its
+        # cumulative snapshot here; goodput_snapshot() merges it into
+        # the host ledger on each beat.
+        env[constants.TONY_GOODPUT_SPOOL] = self.goodput_spool
         if self.conf.get_bool(K.TASK_PROFILE_ENABLED_KEY, False):
             env[constants.TONY_PROFILE_ENABLED] = "true"
             profile_dir = self.conf.get(K.TASK_PROFILE_DIR_KEY) or ""
@@ -811,7 +870,8 @@ class TaskExecutor:
     def run(self) -> int:
         log.info("task %s registering with coordinator %s",
                  self.task_id, self.am_address)
-        self.register_and_get_cluster_spec()
+        with self._ledger.enter("provision"):
+            self.register_and_get_cluster_spec()
         token_file = (self._publish_gcs_token()
                       if os.environ.get(constants.TONY_GCS_TOKEN) else None)
         heartbeater = Heartbeater(self.rpc, self.task_id, self.hb_interval_s,
@@ -821,7 +881,8 @@ class TaskExecutor:
                                   spans_fn=self.trace_batch,
                                   reattach_timeout_s=self.reattach_timeout_s,
                                   refresh_rpc=self._refresh_rpc,
-                                  on_reattach=self._on_coordinator_restart)
+                                  on_reattach=self._on_coordinator_restart,
+                                  goodput_fn=self.goodput_snapshot)
         heartbeater.incarnation = self.bootstrap.get("incarnation", 0)
         self._heartbeater = heartbeater
         heartbeater.start()
@@ -841,7 +902,8 @@ class TaskExecutor:
                     f"http://{host}:{self.notebook_port}")
             except Exception:
                 log.warning("notebook URL registration failed", exc_info=True)
-        venv_bin = self._prepare_venv()
+        with self._ledger.enter("stage"):
+            venv_bin = self._prepare_venv()
 
         def user_env() -> dict[str, str]:
             extra_env = self.framework_env()
@@ -872,7 +934,8 @@ class TaskExecutor:
                 "executor.user_process", ctx=job_ctx, coarse=True,
                 task=self.task_id,
                 epoch=self.bootstrap.get("cluster_epoch", 0))
-            exit_code = self.run_user_process(user_env())
+            with self._ledger.enter(goodput_mod.USER_CATEGORY):
+                exit_code = self.run_user_process(user_env())
             gen_span.end(exit_code=exit_code)
             flight.record("child_exit", task=self.task_id, code=exit_code,
                           epoch=self.bootstrap.get("cluster_epoch", 0))
@@ -899,7 +962,8 @@ class TaskExecutor:
                           target_epoch=self._resync_target)
             log.info("elastic resync: user process stopped (exit %d) — "
                      "re-running the registration handshake", exit_code)
-            self.register_and_get_cluster_spec()
+            with self._ledger.enter("resync"):
+                self.register_and_get_cluster_spec()
             log.info("elastic resync: re-registered at epoch %d "
                      "(%d processes)",
                      self.bootstrap.get("cluster_epoch", 0),
@@ -945,7 +1009,16 @@ class TaskExecutor:
             else ""
         for attempt in range(2):
             try:
-                if heartbeater._rpc_takes_trace:
+                if heartbeater._rpc_takes_goodput:
+                    # the final ledger snapshot is cumulative, so
+                    # rebuilding it per attempt is safe (unlike the
+                    # drained span batch)
+                    self.rpc.task_executor_heartbeat(
+                        self.task_id, self.metrics_snapshot(),
+                        spans=final_spans,
+                        client_rtt=heartbeater.last_rtt,
+                        goodput=self.goodput_snapshot())
+                elif heartbeater._rpc_takes_trace:
                     self.rpc.task_executor_heartbeat(
                         self.task_id, self.metrics_snapshot(),
                         spans=final_spans,

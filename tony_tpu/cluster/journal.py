@@ -172,6 +172,12 @@ class RecoveredState:
     tasks: dict[str, TaskRecord] = field(default_factory=dict)
     regrow_pending: set[str] = field(default_factory=set)
     watermarks: dict[str, float] = field(default_factory=dict)
+    #: coordinator-attributed goodput seconds (task -> category ->
+    #: cumulative seconds): launch provision/stage walls, elastic resync
+    #: and crash-recovery walls. Restored so a recovered coordinator's
+    #: GOODPUT events keep the pre-crash attribution without
+    #: re-measuring (= without double-counting) it.
+    goodput_extra: dict[str, dict[str, float]] = field(default_factory=dict)
 
     def live_tasks(self) -> list[TaskRecord]:
         """Tasks whose executor may still be running: registered, not
@@ -201,6 +207,7 @@ def fold(records: list[dict]) -> RecoveredState:
             state.cluster_epoch = 0
             state.tasks.clear()
             state.regrow_pending.clear()
+            state.goodput_extra.clear()
         elif kind == "launch":
             t = task(r["task_id"])
             t.allocation_id = int(r.get("allocation_id", -1))
@@ -243,6 +250,13 @@ def fold(records: list[dict]) -> RecoveredState:
                 state.regrow_pending.discard(tid)
         elif kind == "watermark":
             state.watermarks[r.get("name", "checkpoint")] = r.get("value")
+        elif kind == "goodput_extra":
+            try:
+                cats = state.goodput_extra.setdefault(r["task"], {})
+                cat = r["category"]
+                cats[cat] = cats.get(cat, 0.0) + float(r["seconds"])
+            except (KeyError, TypeError, ValueError):
+                pass            # malformed attribution: skip, don't fail replay
     return state
 
 

@@ -168,6 +168,22 @@ TRACE_RING_KEY = "tony.trace.ring-size"
 FLIGHT_RING_KEY = "tony.flight-recorder.ring-size"
 
 # ---------------------------------------------------------------------------
+# Goodput ledger + straggler detector ("tony.goodput.*" /
+# "tony.straggler.*"): per-task wall-clock attribution rides heartbeats
+# (runtime/goodput.py), the coordinator folds it into GOODPUT jhist
+# events and compares per-task step walls across each gang.
+# ---------------------------------------------------------------------------
+# Detector tick + GOODPUT aggregation window. Each window the coordinator
+# updates per-task step-wall EWMAs from the ledger deltas.
+GOODPUT_WINDOW_MS_KEY = "tony.goodput.window-ms"
+# A task is suspected when its step-wall EWMA exceeds the gang median by
+# this factor ...
+STRAGGLER_FACTOR_KEY = "tony.straggler.factor"
+# ... for this many consecutive windows (hysteresis against one-off
+# checkpoint or GC pauses).
+STRAGGLER_WINDOWS_KEY = "tony.straggler.windows"
+
+# ---------------------------------------------------------------------------
 # Chief designation (TonyConfigurationKeys: chief name/index)
 # ---------------------------------------------------------------------------
 CHIEF_REGEX_KEY = "tony.application.chief.name"
@@ -330,6 +346,9 @@ DEFAULTS: dict[str, str] = {
     PIPELINE_INTERLEAVE_KEY: "1",
     CHANNEL_COMPRESSION_KEY: "none",
     METRICS_SNAPSHOT_INTERVAL_KEY: "5000",
+    GOODPUT_WINDOW_MS_KEY: "2000",
+    STRAGGLER_FACTOR_KEY: "2.0",
+    STRAGGLER_WINDOWS_KEY: "3",
     TRACE_SAMPLE_RATE_KEY: "1.0",
     TRACE_RING_KEY: "2048",
     FLIGHT_RING_KEY: "256",
@@ -387,7 +406,8 @@ NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "scheduler", "staging", "docker", "container",
                                 "launch", "elastic", "metrics", "pipeline",
                                 "channel", "trace", "router", "fleet",
-                                "coordinator", "weights"})
+                                "coordinator", "weights", "goodput",
+                                "straggler"})
 
 
 def instances_key(job_type: str) -> str:

@@ -80,6 +80,12 @@ TONY_TRACE_RING = "TONY_TRACE_RING"
 TONY_FLIGHT_DIR = "TONY_FLIGHT_DIR"
 TONY_FLIGHT_RING = "TONY_FLIGHT_RING"
 
+# Goodput ledger (runtime/goodput.py). Same bridge shape as the trace
+# spool: the fork-exec'd user process publishes its cumulative ledger
+# snapshot to this file (atomic rename, last-write-wins) and the executor
+# merges it into the host ledger it ships on heartbeats.
+TONY_GOODPUT_SPOOL = "TONY_GOODPUT_SPOOL"
+
 # Pseudo job-name under which the coordinator surfaces the tracking
 # (TensorBoard / notebook) URL in get_task_urls — the analog of the YARN
 # application tracking URL the reference sets reflectively

@@ -74,6 +74,23 @@ METRICS_SNAPSHOT = "METRICS_SNAPSHOT"
 # metrics-snapshot cadence; the history server renders every batch of a
 # job as one Chrome-trace JSON (GET /api/jobs/<id>/trace).
 TRACE_SPAN = "TRACE_SPAN"
+# Periodic coordinator-aggregated goodput ledger (runtime/goodput.py):
+# payload {"tasks": {task_id: {"t0", "now" (both clock-offset-corrected
+# to coordinator time), "cat": {category: cumulative seconds}, "cur",
+# "n", "sw", "extra": {category: coordinator-attributed seconds}}},
+# "fraction": job-level goodput fraction, "session_id"}. Cumulative like
+# METRICS_SNAPSHOT — the LAST event of a job is its complete breakdown,
+# so GET /api/jobs/<id>/goodput replays it bit-exact.
+GOODPUT = "GOODPUT"
+# The straggler detector flagged a task: its step-wall EWMA exceeded the
+# gang median by tony.straggler.factor for tony.straggler.windows
+# consecutive windows. Payload {"task", "gang", "ewma_s", "median_s",
+# "factor", "windows", "session_id"} — the evidence, not just the verdict.
+STRAGGLER_SUSPECTED = "STRAGGLER_SUSPECTED"
+# A previously-suspected task dropped back under the threshold (one
+# window is enough to clear; flapping shows up as SUSPECTED/CLEARED
+# pairs). Payload {"task", "session_id"}.
+STRAGGLER_CLEARED = "STRAGGLER_CLEARED"
 
 
 @dataclass

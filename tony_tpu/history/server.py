@@ -34,6 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from tony_tpu.conf import keys as K
 from tony_tpu.conf.config import TonyConfig, parse_cli_confs
 from tony_tpu.events import events as ev
+from tony_tpu.runtime import goodput as goodput_mod
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.storage import (StorageError, sdirname, sjoin, storage_for)
 
@@ -409,6 +410,44 @@ class HistoryServer:
             "tasks": (latest.payload.get("tasks", {}) if latest else {}),
         }
 
+    #: GOODPUT windows returned in one /api/jobs/<id>/goodput response —
+    #: same truncation rationale as MAX_METRICS_SNAPSHOTS; entries are
+    #: cumulative, so the final window alone already carries the complete
+    #: breakdown and truncating the timeline loses no attribution.
+    MAX_GOODPUT_WINDOWS = 200
+
+    def job_goodput(self, app_id: str) -> dict | None:
+        """JSON replay of a job's GOODPUT events. ``tasks`` and
+        ``fraction`` come VERBATIM from the last (cumulative) GOODPUT
+        event, so the replayed breakdown is bit-exact against the live
+        coordinator's final emission; ``windows`` is the truncated
+        timeline for fraction-over-time consumers, and ``stragglers``
+        the suspicion/clear verdicts the detector recorded."""
+        events = self.job_events(app_id)
+        if events is None:
+            return None
+        snaps = [e for e in events if e.event_type == ev.GOODPUT]
+        latest = snaps[-1] if snaps else None
+        stragglers = [
+            {"timestamp": e.timestamp, "event_type": e.event_type,
+             **(e.payload if isinstance(e.payload, dict) else {})}
+            for e in events
+            if e.event_type in (ev.STRAGGLER_SUSPECTED,
+                                ev.STRAGGLER_CLEARED)]
+        return {
+            "app_id": app_id,
+            "window_count": len(snaps),
+            "windows": [{"timestamp": e.timestamp,
+                         "session_id": e.payload.get("session_id"),
+                         "fraction": e.payload.get("fraction"),
+                         "tasks": e.payload.get("tasks", {})}
+                        for e in snaps[-self.MAX_GOODPUT_WINDOWS:]],
+            "tasks": (latest.payload.get("tasks", {}) if latest else {}),
+            "fraction": (latest.payload.get("fraction")
+                         if latest else None),
+            "stragglers": stragglers,
+        }
+
     def job_trace(self, app_id: str) -> dict | None:
         """Chrome Trace Event JSON (Perfetto / chrome://tracing
         loadable) reconstructed purely from the job's TRACE_SPAN jhist
@@ -495,13 +534,13 @@ class HistoryServer:
         events = self.job_events(app_id)
         if events is None:
             return None
-        # METRICS_SNAPSHOT / LAUNCH events render as their own sections
-        # below, and TRACE_SPAN batches export through the trace link —
-        # inlining each multi-task wire blob / span batch into the
+        # METRICS_SNAPSHOT / LAUNCH / GOODPUT events render as their own
+        # sections below, and TRACE_SPAN batches export through the trace
+        # link — inlining each multi-task wire blob / span batch into the
         # timeline would bury the lifecycle events it exists to show.
         timeline = [e for e in events
                     if e.event_type not in (ev.METRICS_SNAPSHOT, ev.LAUNCH,
-                                            ev.TRACE_SPAN)]
+                                            ev.TRACE_SPAN, ev.GOODPUT)]
         rows = "".join(
             f"<tr><td>{_fmt_ts(e.timestamp)}</td>"
             f"<td>{html.escape(e.event_type)}</td>"
@@ -518,6 +557,7 @@ class HistoryServer:
                      f"Trace ({n_spans} spans, Chrome/Perfetto JSON)"
                      f"</a></p>")
         body += self._render_startup_section(events)
+        body += self._render_goodput_section(events, app_id)
         body += self._render_metrics_section(events)
         return _PAGE.format(title=f"Events — {html.escape(app_id)}", body=body)
 
@@ -551,6 +591,100 @@ class HistoryServer:
                 "<table><tr><th>Time (UTC)</th><th>Gang</th><th>Phase</th>"
                 "<th>Task</th><th>Wall (s)</th><th></th></tr>"
                 + "".join(rows) + "</table>")
+
+    #: stacked-bar colors per ledger category — goodput (step) in green,
+    #: input/IO waits in warm tones, framework walls in cool/neutral ones;
+    #: unknown categories fall back to blue-grey
+    _GOODPUT_COLORS = {
+        "step": "#2e7d32", "data_wait": "#ef6c00", "checkpoint": "#1565c0",
+        "eval": "#6a1b9a", "provision": "#9e9d24", "stage": "#00838f",
+        "compile": "#c62828", "resync": "#ad1457", "recovery": "#4e342e",
+        "idle": "#bdbdbd", "overhead": "#757575",
+    }
+
+    @classmethod
+    def _render_goodput_section(cls, events: list[ev.Event],
+                                app_id: str) -> str:
+        """Headline goodput fraction + one stacked wall-clock bar per
+        task from the LAST (cumulative) GOODPUT event: each segment's
+        width is the share of that task's attributed wall spent in the
+        category (executor ledger categories merged with the
+        coordinator-attributed extras). Straggler verdicts already show
+        in the event timeline; here only the counts are summarized.
+        Empty string when the job shipped no ledger."""
+        latest = None
+        suspected = cleared = 0
+        for e in events:
+            if e.event_type == ev.GOODPUT:
+                latest = e
+            elif e.event_type == ev.STRAGGLER_SUSPECTED:
+                suspected += 1
+            elif e.event_type == ev.STRAGGLER_CLEARED:
+                cleared += 1
+        if latest is None:
+            return ""
+        p = latest.payload
+        try:
+            headline = f"{float(p.get('fraction')) * 100.0:.1f}%"
+        except (TypeError, ValueError):
+            headline = "n/a"
+        tasks = p.get("tasks", {})
+        rows = []
+        for task_id in sorted(tasks if isinstance(tasks, dict) else ()):
+            entry = tasks[task_id]
+            if not isinstance(entry, dict):
+                continue
+            try:
+                extra = entry.get("extra") or {}
+                wall = max(0.0, float(entry.get("now", 0.0))
+                           - float(entry.get("t0", 0.0))) \
+                    + sum(float(s) for s in extra.values())
+                cats: dict[str, float] = {}
+                for src in (entry.get("cat") or {}, extra):
+                    for c, s in src.items():
+                        cats[str(c)] = cats.get(str(c), 0.0) + float(s)
+            except (TypeError, ValueError, AttributeError):
+                continue        # one malformed entry must not lose the page
+            if wall <= 0:
+                continue
+            # ledger order first (stable bar layout across tasks), then
+            # any categories this build doesn't know about
+            order = [c for c in goodput_mod.CATEGORIES
+                     if cats.get(c, 0.0) > 0]
+            order += [c for c in sorted(cats)
+                      if c not in goodput_mod.CATEGORIES and cats[c] > 0]
+            segs = []
+            for c in order:
+                pct = 100.0 * cats[c] / wall
+                color = cls._GOODPUT_COLORS.get(c, "#90a4ae")
+                segs.append(
+                    f"<div title='{html.escape(c)}: {cats[c]:.2f}s "
+                    f"({pct:.1f}%)' style='display:inline-block;"
+                    f"height:16px;width:{pct:.2f}%;"
+                    f"background:{color}'></div>")
+            step_pct = 100.0 * cats.get("step", 0.0) / wall
+            rows.append(
+                f"<tr><td>{html.escape(task_id)}</td>"
+                f"<td style='width:60%'><div style='width:100%;"
+                f"background:#eee;font-size:0;white-space:nowrap'>"
+                + "".join(segs) + "</div></td>"
+                f"<td>{wall:.1f}</td><td>{step_pct:.1f}%</td></tr>")
+        legend = " ".join(
+            f"<span style='white-space:nowrap'><span style='display:"
+            f"inline-block;width:10px;height:10px;background:"
+            f"{cls._GOODPUT_COLORS[c]}'></span> {c}</span>"
+            for c in goodput_mod.CATEGORIES)
+        body = (f"<h1>Goodput {headline}</h1>"
+                f"<p>{legend}</p>"
+                "<table><tr><th>Task</th><th>Wall breakdown</th>"
+                "<th>Wall (s)</th><th>Goodput</th></tr>"
+                + "".join(rows) + "</table>")
+        if suspected:
+            body += (f"<p>Stragglers: {suspected} suspected, "
+                     f"{cleared} cleared (see timeline above).</p>")
+        body += (f"<p><a href='/api/jobs/{html.escape(app_id)}/goodput'>"
+                 "Goodput breakdown (JSON)</a></p>")
+        return body
 
     def _render_metrics_section(self, events: list[ev.Event]) -> str:
         """Per-job metrics table from the LATEST snapshot: one row per
@@ -668,6 +802,11 @@ class HistoryServer:
                     app_id = path[len("/api/jobs/"):-len("/metrics")]
                     m = server.job_metrics(app_id)
                     self._not_found() if m is None else self._json(m)
+                elif path.startswith("/api/jobs/") and \
+                        path.endswith("/goodput"):
+                    app_id = path[len("/api/jobs/"):-len("/goodput")]
+                    g = server.job_goodput(app_id)
+                    self._not_found() if g is None else self._json(g)
                 elif path.startswith("/api/jobs/") and \
                         path.endswith("/trace"):
                     app_id = path[len("/api/jobs/"):-len("/trace")]

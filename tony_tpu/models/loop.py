@@ -30,6 +30,7 @@ import time
 from typing import Any, Callable, Iterable
 
 from tony_tpu import constants
+from tony_tpu.runtime import goodput as goodput_mod
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime import tracing
 
@@ -124,6 +125,10 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
     last_eval = None
     tracer = tracing.get_tracer()
     flight = tracing.get_flight()
+    # Goodput attribution: each phase below ALSO lands in the process
+    # ledger (data_wait/step/checkpoint/eval), which publishes to the
+    # executor via TONY_GOODPUT_SPOOL and rides heartbeats from there.
+    ledger = goodput_mod.get_ledger()
     try:
         for step in range(start_step, steps):
             if step_hook is not None:
@@ -134,7 +139,8 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
             with tracer.span("train.step", step=step) as step_span:
                 t0 = time.perf_counter()
                 try:
-                    batch = next(it)
+                    with ledger.enter("data_wait"):
+                        batch = next(it)
                 except StopIteration:
                     log.warning("data exhausted at step %d (wanted %d); "
                                 "stopping early", step, steps)
@@ -144,7 +150,8 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
                 tracer.record_span("train.data_wait", wait,
                                    parent=step_span)
                 try:
-                    with tracer.span("train.dispatch"):
+                    with tracer.span("train.dispatch"), \
+                            ledger.enter("step"):
                         state, metrics = step_fn(state, batch)
                 except Exception as e:
                     if _looks_like_gang_loss(e):
@@ -166,11 +173,12 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
                         raise GangLostError(str(e)) from e
                     raise
                 if checkpoint is not None:
-                    with tracer.span("train.checkpoint"):
+                    with tracer.span("train.checkpoint"), \
+                            ledger.enter("checkpoint"):
                         checkpoint.save(step + 1, state)
                 if (eval_fn is not None and eval_every > 0
                         and (step + 1) % eval_every == 0):
-                    with tracer.span("train.eval"):
+                    with tracer.span("train.eval"), ledger.enter("eval"):
                         last_eval = eval_fn(state)
                 if last_eval is not None:
                     metrics = dict(metrics)
@@ -183,5 +191,9 @@ def run_training(step_fn: Callable[[Any, Any], tuple[Any, dict]],
         if close is not None:
             close()
         if checkpoint is not None:
-            checkpoint.wait_until_finished()
+            with ledger.enter("checkpoint"):
+                checkpoint.wait_until_finished()
+        # push the final breakdown to the executor bridge even if the
+        # loop ends between throttled publishes
+        ledger.publish()
     return state, metrics

@@ -144,6 +144,7 @@ from tony_tpu.models.decode import (_check_draft_vocab, _check_no_ring,
                                     decode_step, extend_step,
                                     init_kv_cache, place_rows, prefill,
                                     prefill_rows)
+from tony_tpu.runtime import goodput as goodput_mod
 from tony_tpu.runtime import metrics as metrics_mod
 from tony_tpu.runtime import tracing
 from tony_tpu.runtime.profiler import PhaseTimes
@@ -1935,10 +1936,15 @@ class ServeEngine:
             raise RuntimeError("batcher is already driven by an engine")
         self.b._engine_running = True
         try:
-            if self.b.pipeline:
-                self._run_pipelined()
-            else:
-                self._run_sequential()
+            # Goodput attribution for the serving plane: the driving
+            # thread's wall is "step" (producing tokens) except the
+            # blocks inside _wait_for_work, which re-enter "idle" —
+            # slot busy-vs-idle falls out of the ledger breakdown.
+            with goodput_mod.get_ledger().enter("step"):
+                if self.b.pipeline:
+                    self._run_pipelined()
+                else:
+                    self._run_sequential()
         finally:
             # seal the engine even on an abnormal exit (a device error
             # escaping the loop): late submits must raise rather than
@@ -1987,7 +1993,8 @@ class ServeEngine:
                     return True
                 if self._draining:
                     return False
-                self._work.wait()
+                with goodput_mod.get_ledger().enter("idle"):
+                    self._work.wait()
 
     def _admit_free(self) -> None:
         """Admit waiting requests into every free slot (row order — the

@@ -226,7 +226,8 @@ class ApplicationRpcClient(ApplicationRpc):
 
     def task_executor_heartbeat(self, task_id: str, metrics: str = "",
                                 spans: str = "", client_time: float = 0.0,
-                                client_rtt: float = 0.0) -> HeartbeatAck:
+                                client_rtt: float = 0.0,
+                                goodput: str = "") -> HeartbeatAck:
         # Heartbeats get a tight retry budget: the executor-side heartbeater
         # counts consecutive failures itself (reference: TaskExecutor.java:
         # 264-268 dies after 5 failed sends). Returns the job's current
@@ -241,6 +242,8 @@ class ApplicationRpcClient(ApplicationRpc):
         # negative value to suppress the stamp entirely) — with
         # ``client_rtt`` (the caller's last measured beat RTT) it feeds
         # the coordinator's RTT-midpoint clock-offset estimate.
+        # ``goodput``: optional cumulative goodput-ledger snapshot
+        # (runtime/goodput.py wire JSON); "" means no ledger.
         def build():
             # stamped per ATTEMPT: a retried beat must carry the retry's
             # send time, not bytes stamped before a 10s deadline expiry
@@ -250,7 +253,8 @@ class ApplicationRpcClient(ApplicationRpc):
                                        metrics=metrics or "",
                                        spans=spans or "",
                                        client_unix_time=now,
-                                       client_rtt=max(0.0, client_rtt))
+                                       client_rtt=max(0.0, client_rtt),
+                                       goodput=goodput or "")
 
         resp = self._call(self._heartbeat, build, retries=2)
         return HeartbeatAck(gcs_token=resp.gcs_token,

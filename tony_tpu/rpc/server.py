@@ -145,12 +145,20 @@ class ApplicationRpcServer:
                 impl.task_executor_heartbeat).parameters
             _hb_takes_metrics = len(_hb_params) >= 2
             _hb_takes_trace = "spans" in _hb_params
+            _hb_takes_goodput = "goodput" in _hb_params
         except (TypeError, ValueError):
             _hb_takes_metrics = True
             _hb_takes_trace = True
+            _hb_takes_goodput = True
 
         def _heartbeat(req, ctx):
-            if _hb_takes_trace:
+            if _hb_takes_goodput:
+                ack = impl.task_executor_heartbeat(
+                    req.task_id, req.metrics, spans=req.spans,
+                    client_time=req.client_unix_time,
+                    client_rtt=req.client_rtt,
+                    goodput=getattr(req, "goodput", ""))
+            elif _hb_takes_trace:
                 ack = impl.task_executor_heartbeat(
                     req.task_id, req.metrics, spans=req.spans,
                     client_time=req.client_unix_time,

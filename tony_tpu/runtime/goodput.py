@@ -1,0 +1,524 @@
+"""Goodput/badput ledger: attribute every task-second to a category.
+
+The fleet-accounting question ("of the slice-seconds we paid for, how
+many produced training steps or served tokens, and where did the rest
+go?") needs a time-attribution layer that metrics (point-in-time
+counters) and traces (sampled spans) don't provide: an *exhaustive*
+carve-up of each task's wall clock into non-overlapping categories.
+
+Design:
+
+- A :class:`GoodputLedger` always has exactly ONE open category (a
+  stack; the base category is ``overhead``).  ``enter(cat)`` is a
+  context manager that pushes a category and restores the previous one
+  on exit, so "no gaps, no overlap" is structural, not something a
+  caller has to get right: ``sum(categories) == now - t0`` at every
+  snapshot, within float epsilon.
+- Totals are *cumulative* seconds per category.  The wire snapshot that
+  rides heartbeats is therefore idempotent: re-delivery or re-ingest
+  after a coordinator restart rebuilds the same table (same discipline
+  as the PR 2 metrics piggyback).
+- The user process (trainer/server) is fork-exec'd by the executor, so
+  its ledger is process-local.  It bridges via a spool file (see
+  ``TONY_GOODPUT_SPOOL``): the child atomically publishes its wire
+  snapshot ~1/s; the executor's :func:`merge_wires` substitutes the
+  child's breakdown for the host ledger's ``user`` span.
+- The coordinator additionally attributes seconds it alone can see
+  (launch provision/stage walls, elastic resync, crash-recovery walls)
+  as "extras" — additive per-task seconds outside any ledger.
+
+On top of the ledger's ``step`` intervals, :class:`StragglerDetector`
+implements the classic synchronous-training failure-mode detector: a
+per-task EWMA of mean step wall compared against the gang median; a
+task exceeding ``factor`` x median for ``windows`` consecutive windows
+is flagged (and un-flagged when it recovers).
+
+Dependency-free (stdlib only); safe to import in the user process.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+# The closed set of categories.  ``step`` is goodput; everything else is
+# badput of a named flavor.  ``overhead`` is the base category: time not
+# claimed by any instrumented phase (process startup, logging, ...).
+CATEGORIES: Tuple[str, ...] = (
+    "provision",   # waiting for the gang barrier / resources to materialize
+    "stage",       # staging artifacts (venv, weights) onto the host
+    "compile",     # XLA compilation walls
+    "data_wait",   # input pipeline starvation (host blocked on next batch)
+    "step",        # productive train-step / serve-token time (GOODPUT)
+    "checkpoint",  # checkpoint save/restore walls
+    "eval",        # in-loop evaluation
+    "resync",      # elastic reconfiguration (shrink/regrow re-registration)
+    "recovery",    # crash-recovery walls (coordinator/executor restart)
+    "idle",        # intentionally idle (serve engine waiting for work)
+    "overhead",    # everything unclaimed
+)
+
+_CATEGORY_SET = frozenset(CATEGORIES)
+
+# Category used internally by the executor's host ledger to mark "the
+# user process is running"; replaced by the child's own breakdown in
+# merge_wires().  Not a public category.
+USER_CATEGORY = "user"
+
+WIRE_VERSION = 1
+
+
+class GoodputLedger:
+    """Thread-safe interval accountant with exactly one open category.
+
+    The ledger starts at construction time with the base category open
+    (``overhead`` unless overridden).  ``enter(cat)`` pushes; on exit the
+    previous category resumes.  ``snapshot()`` folds the live interval
+    into the totals so the sum always equals the elapsed wall clock.
+    """
+
+    def __init__(
+        self,
+        base: str = "overhead",
+        registry=None,
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+        spool_path: Optional[str] = None,
+        spool_interval_s: float = 1.0,
+        extra_categories: Tuple[str, ...] = (),
+    ):
+        allowed = _CATEGORY_SET | set(extra_categories)
+        if base not in allowed:
+            raise ValueError("unknown goodput category: %r" % (base,))
+        self._allowed = allowed
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self._lock = threading.Lock()
+        self._t0_wall = wall_clock()
+        self._t0 = clock()
+        self._totals: Dict[str, float] = {}
+        self._counts: Dict[str, int] = {}
+        # Stack of [category, resumed_at, folded_seconds_this_frame];
+        # bottom is the base category. The third field accumulates wall
+        # already folded out of an interrupted frame (a nested push folds
+        # the parent) so closing a "step" credits the WHOLE step wall to
+        # the straggler accumulators, not just its last segment.
+        self._stack: List[List] = [[base, self._t0, 0.0]]
+        # step-wall accumulators for the straggler detector: closed-step
+        # count and cumulative closed-step seconds (live step interval is
+        # NOT included so window deltas measure completed steps only).
+        self._step_closed = 0
+        self._step_seconds = 0.0
+        self._registry = registry
+        self._shipped: Dict[str, float] = {}
+        self._spool_path = spool_path
+        self._spool_interval_s = spool_interval_s
+        self._last_spool = 0.0
+
+    # -- core accounting ------------------------------------------------
+
+    def enter(self, category: str):
+        """Context manager: attribute the enclosed wall time to *category*."""
+        if category not in self._allowed:
+            raise ValueError("unknown goodput category: %r" % (category,))
+        return _Interval(self, category)
+
+    def _push(self, category: str) -> None:
+        with self._lock:
+            now = self._clock()
+            self._fold_top(now)
+            self._stack.append([category, now, 0.0])
+
+    def _pop(self, category: str) -> None:
+        with self._lock:
+            now = self._clock()
+            # Tolerate out-of-order exits (e.g. a generator-held context
+            # finalized late): unwind to the matching frame, folding
+            # everything above it as-is. Each unwound frame's parent
+            # resumes from *now* — its since still points at the child's
+            # push time, and folding from there would attribute the
+            # child's interval twice.
+            while len(self._stack) > 1:
+                top = self._fold_top(now, close=True)
+                self._stack[-1][1] = now
+                if top == category:
+                    break
+        self._maybe_spool()
+
+    def _fold_top(self, now: float, close: bool = False):
+        """Fold the top frame's elapsed time into totals (caller holds lock).
+
+        With close=True the frame is removed and its interval count
+        bumped; otherwise the frame stays open and restarts from *now*.
+        """
+        frame = self._stack[-1]
+        cat, since = frame[0], frame[1]
+        dt = max(0.0, now - since)
+        if dt:
+            self._totals[cat] = self._totals.get(cat, 0.0) + dt
+        if close:
+            self._stack.pop()
+            self._counts[cat] = self._counts.get(cat, 0) + 1
+            if cat == "step":
+                self._step_closed += 1
+                self._step_seconds += frame[2] + dt
+        else:
+            frame[1] = now
+            frame[2] += dt
+        return cat
+
+    def add(self, category: str, seconds: float) -> None:
+        """Attribute *seconds* to *category* without an interval.
+
+        Escape hatch for walls measured elsewhere (coordinator extras use
+        their own mechanism; this is for in-process pre-measured time).
+        Note: added seconds are NOT part of the wall-clock invariant.
+        """
+        if category not in self._allowed:
+            raise ValueError("unknown goodput category: %r" % (category,))
+        if seconds <= 0:
+            return
+        with self._lock:
+            self._totals[category] = self._totals.get(category, 0.0) + seconds
+            self._counts[category] = self._counts.get(category, 0) + 1
+
+    # -- snapshots ------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Cumulative wire snapshot.  Idempotent: safe to re-send/re-ingest."""
+        with self._lock:
+            now = self._clock()
+            self._fold_top(now)
+            cats = {k: v for k, v in self._totals.items() if v > 0.0}
+            wire = {
+                "v": WIRE_VERSION,
+                "t0": self._t0_wall,
+                "now": self._t0_wall + (now - self._t0),
+                "cat": cats,
+                "cur": self._stack[-1][0],
+                "n": dict(self._counts),
+                "sw": {"c": self._step_closed, "s": self._step_seconds},
+            }
+        self._mirror(cats)
+        return wire
+
+    def to_wire_json(self) -> str:
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def _mirror(self, cats: Dict[str, float]) -> None:
+        """Delta-mirror cumulative totals into tony_goodput_seconds_total."""
+        reg = self._registry
+        if reg is None:
+            return
+        try:
+            for cat, total in cats.items():
+                if cat == USER_CATEGORY:
+                    continue
+                delta = total - self._shipped.get(cat, 0.0)
+                if delta > 0:
+                    reg.counter(
+                        "tony_goodput_seconds_total",
+                        help="wall seconds attributed by the goodput "
+                             "ledger, by category",
+                        category=cat,
+                    ).inc(delta)
+                    self._shipped[cat] = total
+        except Exception:  # noqa: BLE001 - accounting must never break the task
+            pass
+
+    def _maybe_spool(self) -> None:
+        path = self._spool_path
+        if not path:
+            return
+        now = self._clock()
+        if now - self._last_spool < self._spool_interval_s:
+            return
+        self._last_spool = now
+        self.publish()
+
+    def publish(self) -> None:
+        """Atomically publish the current snapshot to the spool file."""
+        path = self._spool_path
+        if not path:
+            return
+        try:
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(self.to_wire_json())
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+
+class _Interval:
+    """Re-entrant-per-use context manager returned by ``ledger.enter``."""
+
+    __slots__ = ("_ledger", "_category")
+
+    def __init__(self, ledger: GoodputLedger, category: str):
+        self._ledger = ledger
+        self._category = category
+
+    def __enter__(self):
+        self._ledger._push(self._category)
+        return self
+
+    def __exit__(self, *exc):
+        self._ledger._pop(self._category)
+        return False
+
+
+# -- wire validation / merge ------------------------------------------
+
+
+def validate_wire(wire) -> Optional[dict]:
+    """Return the wire dict if structurally sound, else None (drop).
+
+    Same discipline as the metrics piggyback: a malformed payload is
+    dropped (the caller logs/counts), never an error up the heartbeat.
+    """
+    if not isinstance(wire, dict):
+        return None
+    try:
+        if int(wire.get("v", 0)) != WIRE_VERSION:
+            return None
+        t0 = float(wire["t0"])
+        now = float(wire["now"])
+        if now < t0:
+            return None
+        cat = wire.get("cat", {})
+        if not isinstance(cat, dict):
+            return None
+        for k, v in cat.items():
+            if not isinstance(k, str) or float(v) < 0:
+                return None
+        sw = wire.get("sw", {})
+        if not isinstance(sw, dict):
+            return None
+        int(sw.get("c", 0))
+        float(sw.get("s", 0.0))
+    except (KeyError, TypeError, ValueError):
+        return None
+    return wire
+
+
+def from_wire_json(payload: str) -> Optional[dict]:
+    try:
+        return validate_wire(json.loads(payload))
+    except (ValueError, TypeError):
+        return None
+
+
+def merge_wires(host: dict, child: Optional[dict]) -> dict:
+    """Merge the executor's host ledger wire with the user process's.
+
+    The host ledger marks the user process's entire lifetime under the
+    internal ``user`` category.  The child publishes its own breakdown
+    of (part of) that same wall time.  The merge substitutes: host
+    categories minus ``user``, plus the child's categories, plus any
+    residual (user wall the child has not yet accounted for — startup,
+    spool lag) credited to ``overhead``.  Step-wall accumulators come
+    from the child (the host never closes steps).
+    """
+    merged_cat = {
+        k: v for k, v in host.get("cat", {}).items() if k != USER_CATEGORY
+    }
+    merged_n = {
+        k: v for k, v in host.get("n", {}).items() if k != USER_CATEGORY
+    }
+    host_user = float(host.get("cat", {}).get(USER_CATEGORY, 0.0))
+    if host.get("cur") == USER_CATEGORY:
+        cur = "overhead"
+    else:
+        cur = host.get("cur", "overhead")
+    sw = {"c": 0, "s": 0.0}
+    if child:
+        child_sum = 0.0
+        for k, v in child.get("cat", {}).items():
+            v = float(v)
+            child_sum += v
+            merged_cat[k] = merged_cat.get(k, 0.0) + v
+        for k, v in child.get("n", {}).items():
+            merged_n[k] = merged_n.get(k, 0) + int(v)
+        residual = host_user - child_sum
+        if residual > 0:
+            merged_cat["overhead"] = merged_cat.get("overhead", 0.0) + residual
+        csw = child.get("sw", {})
+        sw = {"c": int(csw.get("c", 0)), "s": float(csw.get("s", 0.0))}
+        if host.get("cur") == USER_CATEGORY:
+            cur = child.get("cur", "overhead")
+    elif host_user > 0:
+        # No child snapshot yet: its wall is unattributed overhead.
+        merged_cat["overhead"] = merged_cat.get("overhead", 0.0) + host_user
+    return {
+        "v": WIRE_VERSION,
+        "t0": host.get("t0", 0.0),
+        "now": host.get("now", 0.0),
+        "cat": merged_cat,
+        "cur": cur,
+        "n": merged_n,
+        "sw": sw,
+    }
+
+
+def goodput_fraction(entry: dict) -> float:
+    """Goodput fraction of a per-task goodput payload entry.
+
+    ``entry`` is one task's dict from a GOODPUT event payload: ledger
+    categories under "cat" plus coordinator-attributed seconds under
+    "extra".  The denominator is the full attributed wall:
+    (now - t0) + sum(extra).
+    """
+    cat = entry.get("cat", {})
+    extra = entry.get("extra", {})
+    wall = max(0.0, float(entry.get("now", 0.0)) - float(entry.get("t0", 0.0)))
+    wall += sum(float(v) for v in extra.values())
+    if wall <= 0:
+        return 0.0
+    return float(cat.get("step", 0.0)) / wall
+
+
+# -- process-global ledger (user-process side) -------------------------
+
+_default_ledger: Optional[GoodputLedger] = None
+_default_lock = threading.Lock()
+
+
+def get_ledger() -> GoodputLedger:
+    """The process-global ledger.
+
+    In a fork-exec'd user process, honors ``TONY_GOODPUT_SPOOL`` so the
+    first caller transparently wires up the executor bridge.
+    """
+    global _default_ledger
+    with _default_lock:
+        if _default_ledger is None:
+            spool = os.environ.get("TONY_GOODPUT_SPOOL") or None
+            registry = None
+            try:
+                from tony_tpu.runtime import metrics as _metrics
+
+                registry = _metrics.get_default()
+            except Exception:  # noqa: BLE001
+                pass
+            _default_ledger = GoodputLedger(
+                registry=registry, spool_path=spool
+            )
+        return _default_ledger
+
+
+def set_ledger(ledger: Optional[GoodputLedger]) -> None:
+    global _default_ledger
+    with _default_lock:
+        _default_ledger = ledger
+
+
+# -- straggler detection ----------------------------------------------
+
+
+class StragglerDetector:
+    """Flag tasks whose step wall persistently exceeds the gang median.
+
+    Fed one merged goodput wire per task per window (the coordinator's
+    monitor loop calls :meth:`observe` on the ``tony.goodput.window-ms``
+    cadence).  Per task, the mean step wall over the window is the delta
+    of the wire's cumulative step accumulators; an EWMA smooths it.  A
+    task is *suspected* when its EWMA exceeds ``factor`` x the gang
+    median EWMA for ``windows`` consecutive windows, and *cleared* the
+    first window it drops back under.  Windows that closed no steps are
+    skipped (checkpoint pauses are not evidence).
+
+    Pure logic, no I/O: returns (suspected, cleared) transition lists;
+    the coordinator turns those into jhist events / counters / flight
+    entries.
+    """
+
+    def __init__(self, factor: float = 2.0, windows: int = 3, alpha: float = 0.3):
+        self.factor = max(1.0, float(factor))
+        self.windows = max(1, int(windows))
+        self.alpha = alpha
+        # task_id -> (last step count, last step seconds, ewma, strikes)
+        self._state: Dict[str, List[float]] = {}
+        self._suspected: Dict[str, dict] = {}
+
+    @staticmethod
+    def gang_of(task_id: str) -> str:
+        return task_id.split(":", 1)[0]
+
+    def forget(self, task_id: str) -> None:
+        self._state.pop(task_id, None)
+        self._suspected.pop(task_id, None)
+
+    @property
+    def suspected(self) -> Dict[str, dict]:
+        """Currently-suspected tasks -> evidence dict."""
+        return dict(self._suspected)
+
+    def observe(self, wires: Dict[str, dict]) -> Tuple[List[dict], List[str]]:
+        """Ingest one window of per-task wires; return transitions.
+
+        Returns (newly_suspected, newly_cleared): the former as evidence
+        dicts ({task, gang, ewma_s, median_s, factor, windows}), the
+        latter as task ids.
+        """
+        # 1. Update EWMAs from step-accumulator deltas.
+        ewmas: Dict[str, float] = {}
+        for task_id, wire in wires.items():
+            sw = wire.get("sw") or {}
+            c = int(sw.get("c", 0))
+            s = float(sw.get("s", 0.0))
+            st = self._state.get(task_id)
+            if st is None:
+                self._state[task_id] = [c, s, 0.0, 0]
+                continue
+            dc, ds = c - st[0], s - st[1]
+            st[0], st[1] = c, s
+            if dc <= 0 or ds < 0:
+                continue  # no steps closed this window: not evidence
+            mean = ds / dc
+            st[2] = mean if st[2] == 0.0 else (
+                self.alpha * mean + (1 - self.alpha) * st[2]
+            )
+        for task_id, st in self._state.items():
+            if st[2] > 0.0:
+                ewmas[task_id] = st[2]
+
+        # 2. Compare against the gang median.
+        gangs: Dict[str, List[float]] = {}
+        for task_id, ewma in ewmas.items():
+            gangs.setdefault(self.gang_of(task_id), []).append(ewma)
+
+        suspected: List[dict] = []
+        cleared: List[str] = []
+        for task_id, ewma in ewmas.items():
+            gang = self.gang_of(task_id)
+            vals = sorted(gangs[gang])
+            if len(vals) < 2:
+                continue  # a gang of one has no peers to lag behind
+            median = vals[len(vals) // 2] if len(vals) % 2 else (
+                (vals[len(vals) // 2 - 1] + vals[len(vals) // 2]) / 2.0
+            )
+            st = self._state[task_id]
+            slow = median > 0 and ewma > self.factor * median
+            if slow:
+                st[3] = int(st[3]) + 1
+                if st[3] >= self.windows and task_id not in self._suspected:
+                    evidence = {
+                        "task": task_id,
+                        "gang": gang,
+                        "ewma_s": round(ewma, 6),
+                        "median_s": round(median, 6),
+                        "factor": self.factor,
+                        "windows": self.windows,
+                    }
+                    self._suspected[task_id] = evidence
+                    suspected.append(evidence)
+            else:
+                st[3] = 0
+                if task_id in self._suspected:
+                    del self._suspected[task_id]
+                    cleared.append(task_id)
+        return suspected, cleared

@@ -154,6 +154,46 @@ class Histogram:
         return out
 
 
+def histogram_quantile(snapshot, q: float) -> float:
+    """Bucket-interpolated quantile, Prometheus ``histogram_quantile``
+    semantics.
+
+    ``snapshot`` is either a live :class:`Histogram` or its wire dict
+    (``{"b": bounds, "n": per-bucket counts (+Inf last), ...}``).  The
+    target rank ``q * count`` is located in the cumulative bucket
+    counts, then linearly interpolated between the bucket's bounds (the
+    first bucket's lower bound is 0).  A rank landing in the +Inf bucket
+    returns the highest finite bound (the classic prometheus caveat: an
+    unbounded bucket has no interior to interpolate).  Empty histogram
+    -> NaN.
+    """
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("quantile must be in [0, 1]")
+    if isinstance(snapshot, dict):
+        bounds = [float(b) for b in snapshot.get("b", [])]
+        counts = [int(c) for c in snapshot.get("n", [])]
+    else:
+        bounds = list(snapshot.buckets)
+        counts = list(snapshot._counts)
+    if not bounds or len(counts) != len(bounds) + 1:
+        return float("nan")
+    total = sum(counts)
+    if total == 0:
+        return float("nan")
+    rank = q * total
+    running = 0.0
+    for i, c in enumerate(counts):
+        running += c
+        if running >= rank and c > 0:
+            if i >= len(bounds):          # +Inf bucket
+                return bounds[-1]
+            lower = bounds[i - 1] if i > 0 else 0.0
+            upper = bounds[i]
+            frac = (rank - (running - c)) / c
+            return lower + (upper - lower) * frac
+    return bounds[-1]
+
+
 class MetricsRegistry:
     """Thread-safe instrument registry with get-or-create semantics.
 
@@ -468,6 +508,15 @@ def sample_host_stats(registry: MetricsRegistry | None = None) -> None:
                   help="resident set size in bytes").set(
                       rss_pages * _PAGE_SIZE)
     except (OSError, IndexError, ValueError):
+        pass                         # non-Linux / constrained container
+    try:
+        # Open-fd count next to RSS/CPU: the cheap early-warning for the
+        # launch-path fd-leak class (a leaked pipe per task launch grows
+        # this linearly with restarts).
+        reg.gauge("tony_task_open_fds",
+                  help="open file descriptors in this process").set(
+                      len(os.listdir("/proc/self/fd")))
+    except OSError:
         pass                         # non-Linux / constrained container
 
 
