@@ -292,6 +292,12 @@ def main() -> None:
     # Hardware-free and jax-free.
     out.update(_goodput_arm())
 
+    # tonylint full-repo analysis wall: the static gate must stay cheap
+    # enough to run in tier-1 on every PR (< 10 s asserted inside the
+    # arm), and the shipped tree must carry zero non-baselined findings.
+    # Hardware-free and jax-free.
+    out.update(_lint_arm())
+
     # streaming serving data plane: the persistent token-push wire vs a
     # request/response round trip per chunk, through an injected-latency
     # transport (LatencyProxy). Deterministic: a tiny CPU model with a
@@ -929,6 +935,43 @@ def _goodput_arm(steps: int = 12, step_wait: float = 0.1) -> dict:
         "goodput_ledger_live_vs_null": round(live / max(null, 1e-12), 3),
         "goodput_fraction_train": round(fraction, 4),
         "goodput_step_wall_mean_s": round(step_wall, 4),
+    }
+
+
+def _lint_arm() -> dict:
+    """tonylint full-repo analysis wall (docs/static-analysis.md).
+
+    Runs every checker — per-file AST passes over all of tony_tpu/ plus
+    the repo-wide proto/frame/observability checks — and asserts the
+    whole sweep lands under 10 s, the budget that keeps the gate cheap
+    enough for tier-1 (tests/test_lint.py runs the same self-check).
+    Also asserts the shipped tree is clean: zero findings outside the
+    committed ratchet baseline.
+
+    Emitted keys: ``lint_full_repo_s`` (< 10 asserted),
+    ``lint_files_scanned``, ``lint_findings_unbaselined`` (== 0
+    asserted), ``lint_baseline_entries``."""
+    import os
+
+    from tony_tpu.devtools import lint
+
+    pkg = os.path.join(lint.REPO_ROOT, "tony_tpu")
+    t0 = time.perf_counter()
+    findings = lint.run([pkg])
+    wall = time.perf_counter() - t0
+    left, _suppressed, _stale = lint.apply_baseline(
+        findings, lint.load_baseline(
+            os.path.join(lint.REPO_ROOT, lint.DEFAULT_BASELINE)))
+    n_files = len(lint.scan_paths([pkg]))
+    assert wall < 10.0, f"tonylint full sweep took {wall:.1f}s (>= 10s)"
+    assert not left, "tonylint found unbaselined findings:\n" + \
+        "\n".join(f.render() for f in left)
+    return {
+        "lint_full_repo_s": round(wall, 3),
+        "lint_files_scanned": n_files,
+        "lint_findings_unbaselined": len(left),
+        "lint_baseline_entries": len(lint.load_baseline(
+            os.path.join(lint.REPO_ROOT, lint.DEFAULT_BASELINE))),
     }
 
 

@@ -16,16 +16,15 @@ from tony_tpu.conf.config import (TonyConfig, parse_cli_confs,
 
 def test_keys_defaults_bijection():
     """Every static *_KEY constant has a default and vice versa (the
-    TestTonyConfigurationFields analog)."""
-    declared = {
-        getattr(K, name)
-        for name in dir(K)
-        if name.endswith("_KEY") and isinstance(getattr(K, name), str)
-    }
-    assert declared == set(K.DEFAULTS), (
-        "keys.py *_KEY constants and DEFAULTS registry out of sync: "
-        f"missing defaults={declared - set(K.DEFAULTS)}, "
-        f"orphan defaults={set(K.DEFAULTS) - declared}")
+    TestTonyConfigurationFields analog). Enforced by tonylint TL008 —
+    this wrapper keeps the check in tier-1 under its historical name."""
+    from tony_tpu.devtools import lint
+
+    declared, defaults = lint.config_key_constants()
+    assert declared and defaults
+    findings = [f for f in lint.check_observability(facets=("config",))
+                if "out of sync" in f.message]
+    assert not findings, "\n".join(f.message for f in findings)
 
 
 def test_parse_memory_string():
@@ -190,18 +189,10 @@ def test_site_via_env(tmp_path, monkeypatch):
 def test_config_reference_doc_covers_every_key():
     """docs/configuration.md must document every static key (and every
     dynamic per-job-type suffix) — the doc-side half of the keys⇄defaults
-    bijection (reference: TestTonyConfigurationFields)."""
-    import os
-    from tony_tpu.conf import keys as K
+    bijection (reference: TestTonyConfigurationFields). Enforced by
+    tonylint TL008."""
+    from tony_tpu.devtools import lint
 
-    doc_path = os.path.join(os.path.dirname(__file__), os.pardir, "docs",
-                            "configuration.md")
-    doc = open(doc_path, encoding="utf-8").read()
-    # Markdown tables escape the | inside the chief regex default.
-    doc = doc.replace("\\|", "|")
-    missing = [key for key in K.DEFAULTS if key not in doc]
-    assert not missing, f"undocumented config keys: {missing}"
-    for suffix in ("instances", "memory", "vcores", "gpus", "tpus",
-                   "tpu.topology", "resources"):
-        assert f"tony.<job>.{suffix}" in doc, \
-            f"dynamic key tony.<job>.{suffix} undocumented"
+    findings = [f for f in lint.check_observability(facets=("config",))
+                if "out of sync" not in f.message]
+    assert not findings, "\n".join(f.message for f in findings)

@@ -652,3 +652,21 @@ def test_https_requires_both_cert_and_key(dirs, tmp_path):
     s = HistoryServer(conf, port=0)
     with pytest.raises(ValueError, match="BOTH"):
         s.start()
+
+
+def test_malformed_jhist_tail_logs_and_does_not_500(dirs, server, caplog):
+    """One corrupt log must not 500 the whole index (the uptime column
+    degrades to "-") — and TL005 behaviorally: the swallow leaves
+    evidence in the server log instead of hiding the corrupt file."""
+    import logging
+
+    path = _write_job(dirs.intermediate, "application_7_0001")
+    # corrupt the tail: a FINISHED-looking line that is not valid JSON
+    with open(path, "a", encoding="utf-8") as f:
+        f.write('{"event_type": "APPLICATION_FINISHED" oops\n')
+    with caplog.at_level(logging.WARNING, logger="tony_tpu.history.server"):
+        status, body = _get(server, "/")
+    assert status == 200
+    assert "application_7_0001" in body
+    assert any("unreadable jhist tail" in r.message
+               for r in caplog.records), caplog.records

@@ -252,3 +252,23 @@ def test_parse_skips_malformed_lines(tmp_path):
                  'garbage\n'
                  '{"event_type": "Y", "payload": {}, "timestamp": 2}\n')
     assert [e.event_type for e in parse_events(str(p))] == ["X", "Y"]
+
+
+def test_background_threads_carry_tony_names(tmp_path):
+    """TL003 behaviorally: the monitor's and event handler's threads are
+    tony-* named daemons, so stacks/py-spy/flight dumps attribute them."""
+    m = HeartbeatMonitor(hb_interval_ms=50, max_missed=3,
+                         on_expired=lambda tid: None)
+    m.start()
+    try:
+        assert m._thread.name == "tony-hb-monitor"
+        assert m._thread.daemon
+    finally:
+        m.stop()
+    h = EventHandler(str(tmp_path), "application_1_1", "alice")
+    h.start()
+    try:
+        assert h._thread.name == "tony-event-handler"
+        assert h._thread.daemon
+    finally:
+        h.stop("SUCCEEDED")

@@ -540,6 +540,29 @@ class TestProtocolRobustness:
             toks, _ = c.result(c.submit(prompts[0], 5))
             assert toks == _reference(params, prompts[0], 5)
 
+    def test_duplicate_rid_is_request_scoped(self, params, server):
+        """A duplicate ADMIT rid earns an ERROR for that rid while the
+        original stream keeps delivering — and the reply is sent after
+        the session lock is dropped (TL001), so a slow duplicate-sender
+        can never stall admission for everyone else."""
+        with StreamingClient("127.0.0.1", server.port) as c:
+            prompt = _prompts(14, (4,))[0]
+            c.submit(prompt, 6, rid=777)
+            c.submit(prompt, 6, rid=777)          # duplicate, same rid
+            saw_error, saw_retired = False, False
+            deadline = time.time() + 60
+            while not (saw_error and saw_retired) and time.time() < deadline:
+                ev = c.next_event(777, timeout=60)
+                if ev[0] == "error":
+                    assert "already active" in ev[1]
+                    saw_error = True
+                elif ev[0] == "retired":
+                    saw_retired = True            # original stream intact
+            assert saw_error and saw_retired
+            # connection-scoped state is clean: fresh rids still serve
+            toks, _ = c.result(c.submit(prompt, 5))
+            assert toks == _reference(params, prompt, 5)
+
     def test_disconnect_mid_stream_frees_slots(self, params, server):
         """A client that vanishes mid-stream must not leak its cache
         slots: with batch=2 fully occupied by the vanished client, a

@@ -6,7 +6,6 @@ ids, and the metric-series ↔ docs bijection."""
 
 import json
 import os
-import re
 import socket
 import threading
 import time
@@ -605,78 +604,40 @@ class TestPipelineTracing:
 
 
 # ---------------------------------------------------------------------------
-# Metric-series ↔ docs bijection (the docs-enforcement satellite)
+# Metric-series / event-type ↔ docs bijections (the docs-enforcement
+# satellite) — thin wrappers over tonylint's TL008 checker, which owns the
+# one scanner implementation (tony_tpu/devtools/lint.py).
 # ---------------------------------------------------------------------------
-#: string literals matching the series shape that are NOT metric series
-_NON_SERIES = {"tony_pb2", "tony_tpu", "tony_src"}
-
-
-def _registered_series_names():
-    """Every tony_* series name registered anywhere under tony_tpu/ —
-    plain string literals plus f-string names truncated at their first
-    placeholder (e.g. tony_startup_{phase}_seconds -> tony_startup_)."""
-    root = os.path.join(os.path.dirname(__file__), os.pardir, "tony_tpu")
-    names = set()
-    lit = re.compile(r"[\"'](tony_[a-z0-9_]+)[\"']")
-    fstr = re.compile(r"f[\"'](tony_[a-z0-9_]*)\{")
-    for dirpath, _, files in os.walk(root):
-        if "__pycache__" in dirpath:
-            continue
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            src = open(os.path.join(dirpath, fn), encoding="utf-8").read()
-            names.update(lit.findall(src))
-            names.update(fstr.findall(src))
-    return names - _NON_SERIES
-
-
 def test_metric_series_docs_bijection():
     """Every tony_* series registered anywhere under tony_tpu/ must have
-    a row in docs/observability.md (the metrics-plane mirror of
-    test_config's DEFAULTS-key enforcement) — a new series without an
-    operator-facing description is a doc regression by construction."""
-    doc = open(os.path.join(os.path.dirname(__file__), os.pardir, "docs",
-                            "observability.md"), encoding="utf-8").read()
-    names = _registered_series_names()
-    assert names, "series scan found nothing — the scanner regressed"
+    a row in docs/observability.md, and every documented series must be
+    registered (the metrics-plane mirror of test_config's DEFAULTS-key
+    enforcement) — a new series without an operator-facing description
+    is a doc regression by construction. Enforced by tonylint TL008."""
+    from tony_tpu.devtools import lint
+
+    exact, _prefixes, _suffixes = lint.registered_series_names()
+    assert exact, "series scan found nothing — the scanner regressed"
     # sanity: known series from several layers must be in the scan
     assert {"tony_serve_ttft_seconds", "tony_clock_offset_seconds",
             "tony_trace_spans_total",
-            "tony_flight_dumps_total"} <= names
-    missing = sorted(n for n in names if n not in doc)
-    assert not missing, f"series missing from docs/observability.md: " \
-                        f"{missing}"
-
-
-# ---------------------------------------------------------------------------
-# Event-type ↔ docs bijection (same enforcement, jhist vocabulary)
-# ---------------------------------------------------------------------------
-def _declared_event_types():
-    """Every jhist event type declared under tony_tpu/: the SCREAMING_CASE
-    ``NAME = "NAME"`` constants in events/events.py (the single
-    registration point — emit sites all reference these) — scanned from
-    source so a constant added without touching this test still counts."""
-    path = os.path.join(os.path.dirname(__file__), os.pardir, "tony_tpu",
-                        "events", "events.py")
-    src = open(path, encoding="utf-8").read()
-    pairs = re.findall(r'^([A-Z][A-Z_]*) = "([A-Z][A-Z_]*)"', src,
-                       flags=re.MULTILINE)
-    return {value for name, value in pairs if name == value}
+            "tony_flight_dumps_total"} <= exact
+    findings = lint.check_observability(facets=("metrics",))
+    assert not findings, "\n".join(f.message for f in findings)
 
 
 def test_event_types_docs_bijection():
     """Every declared jhist event type must have a row in
-    docs/observability.md — an event type without an operator-facing
-    description is a doc regression by construction, exactly like an
-    undocumented metric series."""
-    doc = open(os.path.join(os.path.dirname(__file__), os.pardir, "docs",
-                            "observability.md"), encoding="utf-8").read()
-    types = _declared_event_types()
+    docs/observability.md (and vice versa) — an event type without an
+    operator-facing description is a doc regression by construction,
+    exactly like an undocumented metric series. Enforced by tonylint
+    TL008."""
+    from tony_tpu.devtools import lint
+
+    types = lint.declared_event_types()
     # sanity: the scanner still sees known types from several subsystems
     assert {"APPLICATION_INITED", "METRICS_SNAPSHOT", "TRACE_SPAN",
             "GOODPUT", "STRAGGLER_SUSPECTED",
             "COORDINATOR_RESTART"} <= types, types
-    missing = sorted(t for t in types if t not in doc)
-    assert not missing, f"event types missing from " \
-                        f"docs/observability.md: {missing}"
+    findings = lint.check_observability(facets=("events",))
+    assert not findings, "\n".join(f.message for f in findings)

@@ -602,6 +602,7 @@ class Coordinator:
                     and not self._workers_terminated):
                 self._workers_terminated = True
                 threading.Thread(target=self._terminate_workers,
+                                 name="tony-terminate-workers",
                                  daemon=True).start()
         if payload is None:
             return WorkerSpecResponse()

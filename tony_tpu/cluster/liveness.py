@@ -30,8 +30,8 @@ class HeartbeatMonitor:
         # snappy even with the default 1s heartbeat interval.
         self.check_period_s = min(max(hb_interval_ms / 1000.0, 0.05), 0.25)
         self.on_expired = on_expired
-        self._last_ping: dict[str, float] = {}
-        self._expired: set[str] = set()
+        self._last_ping: dict[str, float] = {}  # guarded-by: _lock
+        self._expired: set[str] = set()         # guarded-by: _lock
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -60,7 +60,8 @@ class HeartbeatMonitor:
                 self._last_ping[task_id] = time.monotonic()
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="hb-monitor",
+        self._thread = threading.Thread(target=self._run,
+                                        name="tony-hb-monitor",
                                         daemon=True)
         self._thread.start()
 

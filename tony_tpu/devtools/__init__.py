@@ -1,0 +1,9 @@
+"""Developer tooling for the tony_tpu tree itself.
+
+Nothing here is imported by the runtime: ``devtools`` is the home of
+``lint.py`` (tonylint — the AST-based invariant checker that gates the
+repo's concurrency, wire, and observability disciplines; see
+``docs/static-analysis.md``) and whatever future repo-hygiene tools ride
+alongside. Dependency-free by design: stdlib only, importable on any
+machine that can run the tests.
+"""

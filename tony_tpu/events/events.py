@@ -248,7 +248,8 @@ class EventHandler:
         self.final_path: str | None = None
 
     def start(self) -> None:
-        self._thread = threading.Thread(target=self._run, name="event-handler",
+        self._thread = threading.Thread(target=self._run,
+                                        name="tony-event-handler",
                                         daemon=True)
         self._thread.start()
 

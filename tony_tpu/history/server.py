@@ -340,7 +340,10 @@ class HistoryServer:
                     result = f"{float(frac) * 100:.1f}%"
                 break
         except Exception:
-            pass       # one malformed log must not 500 the whole index
+            # one malformed log must not 500 the whole index — but it
+            # must leave evidence, or corrupt jhist files stay invisible
+            log.warning("unreadable jhist tail for %s", path,
+                        exc_info=True)
         self._uptime_by_path[path] = result
         return result
 
@@ -870,7 +873,8 @@ class HistoryServer:
                                                  server_side=True)
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        name="history-server", daemon=True)
+                                        name="tony-history-server",
+                                        daemon=True)
         self._thread.start()
         log.info("history server on %s://%s:%d (auth=%s intermediate=%s "
                  "finished=%s)", scheme, self.bind, self.port,

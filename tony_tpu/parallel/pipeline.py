@@ -992,7 +992,7 @@ class CrossSlicePipeline:
                 failures.append((j, exc))
 
         threads = [threading.Thread(target=chunk_main, args=(j,),
-                                    name=f"pp-chunk{j}", daemon=True)
+                                    name=f"tony-pp-chunk{j}", daemon=True)
                    for j in range(v)]
         for t in threads:
             t.start()

@@ -110,7 +110,7 @@ class ProxyServer:
                 pass
         upstream.settimeout(None)
         t = threading.Thread(target=_pump, args=(client, upstream),
-                             daemon=True)
+                             name="tony-proxy-pump", daemon=True)
         t.start()
         _pump(upstream, client)
         t.join()

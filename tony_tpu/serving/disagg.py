@@ -397,23 +397,28 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
             return
         key = (conn.id, rid)
         rng = P.parse_rng(obj)
+        # duplicate-rid reply goes out AFTER the condition is dropped:
+        # the send can block on a slow client and every prefill worker
+        # waits on this condition (TL001)
         with self._cv:
-            if key in self._items:
-                conn.send(P.ERROR, rid, P.pack_json(
-                    {"message": f"request id {rid} is already active"}))
-                return
-            item = _PrefillItem(conn, rid, prompt, max_new, decode,
-                                (self._next_stream if rng is None
-                                 else int(rng[0])),
-                                P.parse_trace_ctx(obj),
-                                prefix=P.parse_prefix_id(obj),
-                                rng_off=0 if rng is None else int(rng[1]))
-            if rng is None:
-                self._next_stream += 1
-            self._items[key] = item
-            self._queue.append(item)
-            self._qdepth_g.set(len(self._queue))
-            self._cv.notify_all()
+            duplicate = key in self._items
+            if not duplicate:
+                item = _PrefillItem(conn, rid, prompt, max_new, decode,
+                                    (self._next_stream if rng is None
+                                     else int(rng[0])),
+                                    P.parse_trace_ctx(obj),
+                                    prefix=P.parse_prefix_id(obj),
+                                    rng_off=0 if rng is None else int(rng[1]))
+                if rng is None:
+                    self._next_stream += 1
+                self._items[key] = item
+                self._queue.append(item)
+                self._qdepth_g.set(len(self._queue))
+                self._cv.notify_all()
+        if duplicate:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": f"request id {rid} is already active"}))
+            return
 
     def _cancel(self, conn: FrameConn, rid: int) -> None:
         """Cancel a QUEUED prompt (idempotent; an already-shipped

@@ -53,7 +53,8 @@ def _delayed_pump(src: socket.socket, dst: socket.socket,
             except OSError:
                 return
 
-    t = threading.Thread(target=writer, daemon=True)
+    t = threading.Thread(target=writer, name="tony-netem-writer",
+                         daemon=True)
     t.start()
     try:
         while True:
@@ -108,6 +109,7 @@ class LatencyProxy:
             except OSError:
                 break
             threading.Thread(target=self._handle, args=(client,),
+                             name="tony-netem-conn",
                              daemon=True).start()
 
     def _handle(self, client: socket.socket) -> None:
@@ -126,7 +128,7 @@ class LatencyProxy:
             self._conns.add(pair)
         t = threading.Thread(target=_delayed_pump,
                              args=(client, upstream, self.delay_s),
-                             daemon=True)
+                             name="tony-netem-pump", daemon=True)
         t.start()
         _delayed_pump(upstream, client, self.delay_s)
         t.join()

@@ -802,16 +802,21 @@ class ServingRouter(FrameServerBase):
         if prefix_id is None and self._prefix_catalog:
             prefix_id = match_prefix(prompt, self._prefix_catalog)
         key = (conn.id, rid)
+        # duplicate-rid reply goes out AFTER the lock is dropped: the
+        # send can block on a slow client and this lock is the router's
+        # whole control plane (TL001)
         with self._lock:
-            if key in self._sessions:
-                conn.send(P.ERROR, rid, P.pack_json(
-                    {"message": f"request id {rid} is already active"}))
-                return
-            sess = _RouterSession(conn, rid, prompt, max_new,
-                                  trace_ctx=P.parse_trace_ctx(payload),
-                                  prefix_id=prefix_id,
-                                  stream=next(self._next_stream))
-            self._sessions[key] = sess
+            duplicate = key in self._sessions
+            if not duplicate:
+                sess = _RouterSession(conn, rid, prompt, max_new,
+                                      trace_ctx=P.parse_trace_ctx(payload),
+                                      prefix_id=prefix_id,
+                                      stream=next(self._next_stream))
+                self._sessions[key] = sess
+        if duplicate:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": f"request id {rid} is already active"}))
+            return
         t0 = time.perf_counter()
         placed = self._place(sess, exclude=None)
         self._place_h.observe(time.perf_counter() - t0)
@@ -1132,6 +1137,8 @@ class ServingRouter(FrameServerBase):
         try:
             result = self.drain(replica, timeout_s=timeout_s)
         except Exception as e:           # noqa: BLE001 - reply, don't die
+            log.warning("remote-requested drain of %s failed: %s",
+                        replica, e)
             conn.send(P.ERROR, rid,
                       P.pack_json({"message": f"drain failed: {e}"}))
             return

@@ -404,12 +404,17 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
         if rid == 0:
             raise P.ProtocolError("ADMIT rid must be nonzero")
         key = (conn.id, rid)
+        # the duplicate-rid reply is sent AFTER the lock is dropped: a
+        # frame send can block on a slow client socket, and this lock
+        # serializes admission/poll for every connection (TL001)
         with self._lock:
-            if key in self._sessions:
-                conn.send(P.ERROR, rid, P.pack_json(
-                    {"message": f"request id {rid} is already active"}))
-                return
-            self._sessions[key] = _Session(conn, rid, stream)
+            duplicate = key in self._sessions
+            if not duplicate:
+                self._sessions[key] = _Session(conn, rid, stream)
+        if duplicate:
+            conn.send(P.ERROR, rid, P.pack_json(
+                {"message": f"request id {rid} is already active"}))
+            return
         try:
             self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx,
                                prefix_id=prefix_id, rng=rng)

@@ -814,7 +814,8 @@ def warm_fanout(targets, ship, *, seeders=(), fallback=None,
             except Exception as e:          # noqa: BLE001 — per-pair
                 outcomes[dst] = e
 
-        threads = [threading.Thread(target=_one, args=pair, daemon=True)
+        threads = [threading.Thread(target=_one, args=pair,
+                                    name="tony-warm-fanout", daemon=True)
                    for pair in pairs]
         for t in threads:
             t.start()
