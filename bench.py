@@ -298,6 +298,13 @@ def main() -> None:
     # Hardware-free and jax-free.
     out.update(_lint_arm())
 
+    # cluster daemon: back-to-back 3-job turnover through the warm
+    # slice pool (digest-affinity ALREADY_EXISTS adoption) vs cold
+    # sequential bring-up. Real daemon + oracle jobs, no hardware; the
+    # tier-1 pin (tests/test_cluster.py) asserts
+    # sched_warm_turnover_vs_cold >= 2.
+    out.update(_sched_arm())
+
     # streaming serving data plane: the persistent token-push wire vs a
     # request/response round trip per chunk, through an injected-latency
     # transport (LatencyProxy). Deterministic: a tiny CPU model with a
@@ -972,6 +979,84 @@ def _lint_arm() -> dict:
         "lint_findings_unbaselined": len(left),
         "lint_baseline_entries": len(lint.load_baseline(
             os.path.join(lint.REPO_ROOT, lint.DEFAULT_BASELINE))),
+    }
+
+
+def _sched_arm(n_jobs: int = 3, duration_steps: int = 40,
+               steps_per_s: float = 1000.0,
+               cold_bringup_s: float = 0.30,
+               warm_adopt_s: float = 0.02) -> dict:
+    """Cluster-daemon warm-pool turnover vs cold sequential bring-up
+    (docs/cluster.md §Warm-pool affinity).
+
+    Two identical 3-job back-to-back workloads through a real
+    :class:`~tony_tpu.cluster.daemon.ClusterDaemon` (OracleRunner, a
+    2-slice pool, every job a 2-slice gang, all submitted at once so
+    the pool turns over between them).  WARM: all jobs share one
+    staging digest, so jobs 2..n adopt the digest-tagged slices the
+    previous job freed (ALREADY_EXISTS warm adoption).  COLD: distinct
+    digests — the no-affinity contrast — so every job pays full
+    bring-up.  Turnover is the completion-to-completion gap (bring-up +
+    run); the bring-up constants are PR 4's measured 9.1s-vs-0.49s
+    contrast scaled down to keep the arm under a second.
+
+    Emitted keys: ``sched_warm_turnover_s``, ``sched_cold_turnover_s``,
+    ``sched_warm_turnover_vs_cold`` (pinned >= 2 in
+    tests/test_cluster.py), ``sched_queue_wait_p99_s`` (bucket-
+    interpolated from tony_sched_queue_wait_seconds via
+    histogram_quantile), ``sched_warm_hits``."""
+    import tempfile
+
+    from tony_tpu.cluster.daemon import ClusterDaemon, OracleRunner
+    from tony_tpu.runtime.metrics import MetricsRegistry, \
+        histogram_quantile
+
+    def run_arm(warm_affinity: bool) -> tuple[float, MetricsRegistry]:
+        registry = MetricsRegistry()
+        runner = OracleRunner(cold_bringup_s=cold_bringup_s,
+                              warm_adopt_s=warm_adopt_s)
+        daemon = ClusterDaemon(
+            tempfile.mkdtemp(prefix="tony-sched-bench-"),
+            slices=2, runner=runner, registry=registry,
+            tick_interval_s=0.005)
+        daemon.start()
+        try:
+            ids = []
+            for i in range(n_jobs):
+                digest = "bench-dd" if warm_affinity else f"bench-{i}"
+                ids.append(daemon.handle_op({
+                    "op": "submit", "user": "bench", "slices": 2,
+                    "digest": digest,
+                    "payload": {"duration_steps": duration_steps,
+                                "steps_per_s": steps_per_s}})["job_id"])
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                states = {j["job_id"]: j["state"]
+                          for j in daemon.handle_op({"op": "list"})["jobs"]}
+                if all(states[i] == "COMPLETED" for i in ids):
+                    break
+                time.sleep(0.005)
+            finished = sorted(daemon.sched.jobs[i].finished_at
+                              for i in ids)
+            assert all(daemon.sched.jobs[i].state == "COMPLETED"
+                       for i in ids), f"bench jobs did not finish: {states}"
+            gaps = [b - a for a, b in zip(finished, finished[1:])]
+            return sum(gaps) / len(gaps), registry
+        finally:
+            daemon.stop()
+
+    warm_turnover, registry = run_arm(warm_affinity=True)
+    cold_turnover, _ = run_arm(warm_affinity=False)
+    hist = registry.histogram("tony_sched_queue_wait_seconds")
+    p99 = histogram_quantile(hist, 0.99)
+    warm_hits = registry.counter("tony_pool_warm_hits_total").value
+    return {
+        "sched_warm_turnover_s": round(warm_turnover, 4),
+        "sched_cold_turnover_s": round(cold_turnover, 4),
+        "sched_warm_turnover_vs_cold": round(
+            cold_turnover / max(warm_turnover, 1e-9), 2),
+        "sched_queue_wait_p99_s": round(p99, 4),
+        "sched_warm_hits": int(warm_hits),
     }
 
 

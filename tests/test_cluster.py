@@ -1,0 +1,630 @@
+"""Multi-tenant cluster daemon tests: scheduler policy units, the
+SimCluster 1000-job chaos suite, the daemon wire plane, journal
+recovery (including the SIGKILL-mid-grant e2e), the history server's
+cluster dashboard, and the bench-arm pin.
+
+The chaos pins live INSIDE the harness (tiling episodes, per-grant
+invariant, fence-resume assertion) — the tests here drive 1000-job
+traces through them and additionally pin the report-level properties:
+every job terminal, queue-wait p99 bounded, determinism by seed.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tony_tpu.cluster import daemon as D
+from tony_tpu.cluster import journal as journal_mod
+from tony_tpu.cluster import scheduler as S
+from tony_tpu.cluster.simcluster import SimCluster, generate_trace
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PY = sys.executable
+
+
+# ---------------------------------------------------------------------------
+# SlicePool
+# ---------------------------------------------------------------------------
+def _pool(n, digest=""):
+    p = S.SlicePool()
+    for i in range(n):
+        p.add(f"s{i}", digest=digest)
+    return p
+
+
+def test_pool_acquire_is_all_or_nothing():
+    p = _pool(3)
+    assert p.acquire("a", 2) is not None
+    # 1 slice free, 2 wanted: nothing must be touched
+    assert p.acquire("b", 2) is None
+    assert p.free_count() == 1
+    ids, warm = p.acquire("b", 1)
+    assert len(ids) == 1 and warm == 0
+
+
+def test_pool_prefers_digest_matching_slices():
+    p = S.SlicePool()
+    p.add("cold-1")
+    p.add("warm-1", digest="d1")
+    p.add("cold-2")
+    p.add("warm-2", digest="d1")
+    ids, warm = p.acquire("job", 2, digest="d1")
+    assert sorted(ids) == ["warm-1", "warm-2"]
+    assert warm == 2
+    assert p.warm_hits == 2 and p.cold_grants == 0
+
+
+def test_pool_release_retags_digest_and_idle():
+    p = _pool(1)
+    p.acquire("a", 1)
+    p.release("s0", digest="dd", now=5.0)
+    slot = p.get("s0")
+    assert slot.digest == "dd" and slot.job_id == "" \
+        and slot.idle_since == 5.0
+    # empty digest on release keeps the old warm tag
+    p.acquire("b", 1)
+    p.release("s0", digest="", now=9.0)
+    assert p.get("s0").digest == "dd"
+
+
+def test_pool_reap_idle_skips_busy_slices():
+    p = _pool(3)
+    p.acquire("a", 1)  # s0 busy (stalest-first order is deterministic)
+    busy = [s.slice_id for s in p.slices() if s.job_id][0]
+    reaped = p.reap_idle(now=100.0, idle_s=50.0)
+    assert busy not in reaped
+    assert p.size() == 1 and p.get(busy) is not None
+
+
+def test_pool_remove_busy_and_duplicate_add_raise():
+    p = _pool(1)
+    with pytest.raises(S.SchedulerError):
+        p.add("s0")
+    p.acquire("a", 1)
+    with pytest.raises(S.SchedulerError):
+        p.remove("s0")
+
+
+# ---------------------------------------------------------------------------
+# ClusterScheduler policy
+# ---------------------------------------------------------------------------
+def _sched(n_slices, **kw):
+    return S.ClusterScheduler(_pool(n_slices), **kw)
+
+
+def _job(jid, slices=1, user="u", priority=0, digest="", elastic=False):
+    return S.Job(job_id=jid, user=user, slices=slices, priority=priority,
+                 digest=digest, elastic=elastic)
+
+
+def test_priority_then_fifo_ordering():
+    sched = _sched(1)
+    sched.submit(_job("low-old"), 0.0)
+    sched.submit(_job("low-new"), 1.0)
+    sched.submit(_job("high", priority=2), 2.0)
+    order = []
+    now = 3.0
+    while len(order) < 3:
+        grants, _ = sched.tick(now)
+        for g in grants:
+            order.append(g.job.job_id)
+            sched.complete(g.job.job_id, now)
+        now += 1.0
+    assert order == ["high", "low-old", "low-new"]
+
+
+def test_gang_grant_is_atomic_and_head_of_line_blocks():
+    sched = _sched(4)
+    sched.submit(_job("big", slices=3), 0.0)
+    sched.submit(_job("small", slices=1), 0.0)
+    grants, _ = sched.tick(1.0)
+    assert {g.job.job_id for g in grants} == {"big", "small"}
+    # big-2 (3 slices, 1 free) now blocks; small-2 behind it must NOT
+    # leak the free slice away from the reserving head
+    sched.submit(_job("big-2", slices=3), 2.0)
+    sched.submit(_job("small-2", slices=1), 2.0)
+    grants, _ = sched.tick(3.0)
+    assert grants == []
+    sched.complete("big", 4.0)
+    grants, _ = sched.tick(5.0)
+    assert [g.job.job_id for g in grants] == ["big-2"]
+    sched.complete("small", 6.0)
+    grants, _ = sched.tick(7.0)
+    assert [g.job.job_id for g in grants] == ["small-2"]
+
+
+def test_quota_blocked_user_is_skipped_not_blocking():
+    sched = _sched(4, user_quota=2)
+    sched.submit(_job("a1", slices=2, user="alice"), 0.0)
+    sched.submit(_job("a2", slices=2, user="alice"), 0.0)
+    sched.submit(_job("b1", slices=2, user="bob"), 0.0)
+    grants, _ = sched.tick(1.0)
+    assert [g.job.job_id for g in grants] == ["a1", "b1"]
+    sched.complete("a1", 2.0)
+    grants, _ = sched.tick(3.0)
+    assert [g.job.job_id for g in grants] == ["a2"]
+
+
+def test_warm_affinity_on_back_to_back_grants():
+    sched = _sched(4)
+    sched.submit(_job("first", slices=2, digest="dd"), 0.0)
+    grants, _ = sched.tick(1.0)
+    freed = grants[0].slice_ids
+    assert grants[0].warm_hits == 0
+    sched.complete("first", 2.0)
+    sched.submit(_job("second", slices=2, digest="dd"), 3.0)
+    grants, _ = sched.tick(4.0)
+    assert grants[0].warm_hits == 2
+    assert sorted(grants[0].slice_ids) == sorted(freed)
+
+
+def test_preemption_victims_lowest_priority_youngest_first():
+    sched = _sched(4)
+    sched.submit(_job("old-low", slices=2, priority=0, elastic=True), 0.0)
+    sched.submit(_job("new-low", slices=2, priority=0, elastic=True), 1.0)
+    sched.tick(2.0)
+    sched.submit(_job("urgent", slices=2, priority=5), 3.0)
+    _, shrinks = sched.tick(4.0)
+    # one victim covers the whole shortfall; youngest-first within the
+    # lowest priority level
+    assert [s.job.job_id for s in shrinks] == ["new-low"]
+    assert shrinks[0].requeue is True
+    assert len(shrinks[0].release_ids) == 2
+    # a fence already in flight is never double-issued
+    _, again = sched.tick(5.0)
+    assert again == []
+    # fence commits -> slices return warm-tagged, victim requeues with
+    # its resume step, and the urgent job takes the freed slices
+    sched.preemption_complete("new-low", 6.0, fence_step=17)
+    victim = sched.jobs["new-low"]
+    assert victim.state == S.QUEUED and victim.resume_step == 17
+    grants, _ = sched.tick(7.0)
+    assert [g.job.job_id for g in grants] == ["urgent"]
+    sched.check_invariant()
+
+
+def test_partial_shrink_keeps_elastic_floor():
+    sched = _sched(4)
+    sched.submit(_job("wide", slices=4, elastic=True), 0.0)
+    sched.tick(1.0)
+    sched.submit(_job("head", slices=2, priority=1), 2.0)
+    _, shrinks = sched.tick(3.0)
+    assert len(shrinks) == 1 and shrinks[0].requeue is False
+    assert len(shrinks[0].release_ids) == 2
+    sched.preemption_complete("wide", 4.0, fence_step=9)
+    wide = sched.jobs["wide"]
+    assert wide.state == S.RUNNING and len(wide.granted) == 2
+    assert wide.resume_step == 9
+    grants, _ = sched.tick(5.0)
+    assert [g.job.job_id for g in grants] == ["head"]
+
+
+def test_non_elastic_and_equal_priority_jobs_are_never_victims():
+    sched = _sched(2)
+    sched.submit(_job("rigid", slices=2, priority=0, elastic=False), 0.0)
+    sched.tick(1.0)
+    sched.submit(_job("urgent", slices=2, priority=5), 2.0)
+    _, shrinks = sched.tick(3.0)
+    assert shrinks == []
+    assert sched.jobs["rigid"].state == S.RUNNING
+
+
+def test_submit_rejections():
+    sched = _sched(2, queue_limit=2)
+    sched.submit(_job("a", slices=2), 0.0)
+    with pytest.raises(S.SchedulerError):
+        sched.submit(_job("a"), 0.0)          # duplicate id
+    with pytest.raises(S.SchedulerError):
+        sched.submit(_job("huge", slices=3), 0.0)  # can never fit
+    sched.submit(_job("b"), 0.0)
+    with pytest.raises(S.QueueFullError):
+        sched.submit(_job("c"), 0.0)
+
+
+def test_check_invariant_catches_double_grant():
+    sched = _sched(2)
+    sched.submit(_job("a"), 0.0)
+    sched.submit(_job("b"), 0.0)
+    sched.tick(1.0)
+    # corrupt the books: both jobs claim the same slice
+    sched.jobs["b"].granted = list(sched.jobs["a"].granted)
+    with pytest.raises(S.DoubleGrantError):
+        sched.check_invariant()
+
+
+# ---------------------------------------------------------------------------
+# fold_daemon (journal replay)
+# ---------------------------------------------------------------------------
+def test_fold_daemon_rejects_grant_of_busy_slice():
+    records = [
+        {"k": "slice_added", "slice_id": "s0", "t": 0.0},
+        {"k": "job_submitted", "job_id": "a", "slices": 1, "seq": 0,
+         "t": 1.0},
+        {"k": "job_submitted", "job_id": "b", "slices": 1, "seq": 1,
+         "t": 1.0},
+        {"k": "job_granted", "job_id": "a", "slice_ids": ["s0"], "t": 2.0},
+        {"k": "job_granted", "job_id": "b", "slice_ids": ["s0"], "t": 3.0},
+    ]
+    with pytest.raises(journal_mod.JournalCorruptError):
+        D.fold_daemon(records)
+
+
+def test_fold_daemon_replays_preemption_to_requeue():
+    records = [
+        {"k": "daemon_start", "t": 0.0, "incarnation": 1},
+        {"k": "slice_added", "slice_id": "s0", "t": 0.0},
+        {"k": "job_submitted", "job_id": "a", "slices": 1, "seq": 0,
+         "digest": "dd", "elastic": True, "t": 1.0},
+        {"k": "job_granted", "job_id": "a", "slice_ids": ["s0"], "t": 2.0},
+        {"k": "shrink_requested", "job_id": "a", "release_ids": ["s0"],
+         "requeue": True, "t": 3.0},
+        {"k": "job_preempted", "job_id": "a", "fence_step": 42, "t": 4.0},
+    ]
+    state = D.fold_daemon(records)
+    job = state["jobs"]["a"]
+    assert job.state == S.QUEUED and job.resume_step == 42
+    assert job.granted == [] and state["pool"].free_count() == 1
+    assert state["pool"].get("s0").digest == "dd"   # released warm
+    assert state["preemptions"] == 1
+
+
+# ---------------------------------------------------------------------------
+# SimCluster: the 1000-job chaos suite
+# ---------------------------------------------------------------------------
+@pytest.mark.chaos
+def test_simcluster_1000_jobs_with_preemption_chaos():
+    """1000-job seeded trace + seeded preemption chaos through the real
+    scheduler.  The harness asserts at every event: no double grant
+    (check_invariant), zero committed steps lost or re-done (episode
+    tiling + fence-resume equality).  Here we pin the report: every
+    job reaches a terminal state, preemption/requeue/warm paths all
+    actually fired, and queue-wait p99 stays bounded."""
+    trace = generate_trace(seed=7, n_jobs=1000, pool_size=8)
+    sc = SimCluster(pool_size=8, chaos_seed=11, cold_bringup_s=2.0,
+                    warm_adopt_s=0.05)
+    report = sc.run(trace)
+    assert report.failed_to_finish == []
+    assert report.completed == len(sc.runs)       # trace + chaos probes
+    assert report.completed >= 1000
+    assert report.preemptions > 20                # chaos really bit
+    assert report.requeues > 0                    # full shrink-to-zero path
+    total = report.warm_hits + report.cold_grants
+    assert report.warm_hits > total // 4          # affinity really works
+    assert report.wait_quantile(0.99) < 60.0      # virtual seconds
+    assert report.wait_quantile(0.5) <= report.wait_quantile(0.99)
+
+
+@pytest.mark.chaos
+def test_simcluster_is_deterministic_by_seed():
+    def run():
+        sc = SimCluster(pool_size=6, chaos_seed=3)
+        return sc.run(generate_trace(seed=5, n_jobs=300, pool_size=6))
+    a, b = run(), run()
+    assert (a.completed, a.preemptions, a.requeues, a.warm_hits,
+            a.virtual_makespan_s) == \
+           (b.completed, b.preemptions, b.requeues, b.warm_hits,
+            b.virtual_makespan_s)
+    assert a.queue_waits == b.queue_waits
+
+
+@pytest.mark.chaos
+def test_simcluster_user_quota_and_fairness():
+    """With a per-user slice cap nobody monopolizes the pool: the run
+    still drains fully and every user's p99 wait stays bounded (no
+    user starves behind another's backlog)."""
+    trace = generate_trace(seed=9, n_jobs=400, pool_size=8, users=4)
+    sc = SimCluster(pool_size=8, user_quota=4, chaos_seed=2)
+    report = sc.run(trace)
+    assert report.failed_to_finish == []
+    assert len(report.per_user_waits) >= 4
+    for user, waits in report.per_user_waits.items():
+        waits = sorted(waits)
+        p99 = waits[min(len(waits) - 1, int(0.99 * len(waits)))]
+        assert p99 < 120.0, f"user {user} starved: p99={p99}"
+
+
+# ---------------------------------------------------------------------------
+# Daemon: in-process wire plane
+# ---------------------------------------------------------------------------
+def _daemon(tmp_path, n_slices=2, **kw):
+    kw.setdefault("runner", D.OracleRunner())
+    kw.setdefault("tick_interval_s", 0.005)
+    d = D.ClusterDaemon(str(tmp_path / "home"), slices=n_slices, **kw)
+    d.start()
+    return d
+
+
+def _wait(predicate, timeout_s=15.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def test_daemon_wire_submit_status_list_stats_cancel(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        with D.DaemonClient("127.0.0.1", d.port) as c:
+            assert c.hello["daemon_id"] == "cluster-daemon"
+            assert c.hello["incarnation"] == 1
+            a = c.submit(user="alice", slices=2, digest="dd",
+                         payload={"duration_steps": 30})["job_id"]
+            b = c.submit(user="bob", slices=1,
+                         payload={"duration_steps": 30})["job_id"]
+            _wait(lambda: c.status(a)["state"] == S.COMPLETED,
+                  msg="job a completion")
+            _wait(lambda: c.status(b)["state"] == S.COMPLETED,
+                  msg="job b completion")
+            jobs = c.list_jobs()
+            assert [j["job_id"] for j in jobs] == [a, b]
+            st = c.stats()
+            assert st["pool_free"] == 2 and st["incarnation"] == 1
+            # cancel a queued job
+            q = c.submit(user="eve", slices=2, job_id="will-cancel",
+                         payload={"duration_steps": 10 ** 6})["job_id"]
+            _wait(lambda: c.status(q)["state"] in (S.RUNNING, S.COMPLETED),
+                  msg="grant")
+            assert c.cancel(q)["state"] in (S.CANCELLED, S.RUNNING)
+            _wait(lambda: c.status(q)["state"] == S.CANCELLED,
+                  msg="cancellation")
+    finally:
+        d.stop()
+
+
+def test_daemon_wire_request_scoped_errors(tmp_path):
+    d = _daemon(tmp_path)
+    try:
+        with D.DaemonClient.from_home(d.home_dir) as c:
+            with pytest.raises(D.DaemonError, match="unknown job"):
+                c.status("nope")
+            c.submit(job_id="dup", payload={"duration_steps": 10 ** 6})
+            with pytest.raises(D.DaemonError, match="duplicate"):
+                c.submit(job_id="dup")
+            with pytest.raises(D.DaemonError, match="wants 99"):
+                c.submit(slices=99)
+            with pytest.raises(D.DaemonError, match="unknown op"):
+                c._op(op="frobnicate")
+            # the connection survives every request-scoped failure
+            assert c.stats()["pool_size"] == 2
+    finally:
+        d.stop()
+
+
+def test_daemon_queue_limit_rejects_submission(tmp_path):
+    conf = TonyConfig({K.DAEMON_QUEUE_LIMIT_KEY: "1"})
+    d = _daemon(tmp_path, conf=conf)
+    try:
+        with D.DaemonClient("127.0.0.1", d.port) as c:
+            a = c.submit(slices=2,
+                         payload={"duration_steps": 10 ** 6})["job_id"]
+            _wait(lambda: c.status(a)["state"] == S.RUNNING, msg="grant")
+            c.submit(slices=2, payload={"duration_steps": 10 ** 6})
+            with pytest.raises(D.DaemonError, match="queue is full"):
+                c.submit(slices=2)
+    finally:
+        d.stop()
+
+
+def test_daemon_preemption_loses_zero_committed_steps(tmp_path):
+    """Wall-clock preemption through the daemon loop: the oracle runner
+    itself asserts the victim resumes from exactly its fence step."""
+    conf = TonyConfig({K.DAEMON_PREEMPTION_GRACE_MS_KEY: "50"})
+    d = _daemon(tmp_path, conf=conf)
+    try:
+        with D.DaemonClient("127.0.0.1", d.port) as c:
+            victim = c.submit(user="low", slices=2, elastic=True,
+                              payload={"duration_steps": 500,
+                                       "steps_per_s": 100})["job_id"]
+            _wait(lambda: c.status(victim)["state"] == S.RUNNING,
+                  msg="victim grant")
+            urgent = c.submit(user="vip", slices=2, priority=5,
+                              payload={"duration_steps": 20,
+                                       "steps_per_s": 1000})["job_id"]
+            _wait(lambda: c.status(urgent)["state"] == S.COMPLETED,
+                  msg="urgent completion")
+            v = c.status(victim)
+            assert v["preemptions"] == 1
+            _wait(lambda: c.status(victim)["state"] == S.COMPLETED,
+                  timeout_s=30.0, msg="victim completion")
+            # the fence step survived the requeue round-trip
+            assert c.status(victim)["resume_step"] > 0
+            assert d.registry.counter(
+                "tony_sched_preemptions_total").value >= 1
+    finally:
+        d.stop()
+
+
+def test_daemon_reaps_idle_slices(tmp_path):
+    conf = TonyConfig({K.DAEMON_POOL_IDLE_REAP_MS_KEY: "50"})
+    reaped = []
+    d = _daemon(tmp_path, conf=conf, on_slice_reaped=reaped.append)
+    try:
+        _wait(lambda: len(reaped) == 2, msg="idle reap")
+        assert d.pool.size() == 0
+        replayed = journal_mod.replay(
+            D.daemon_journal_path(d.home_dir))
+        assert sum(1 for r in replayed if r["k"] == "slice_reaped") == 2
+    finally:
+        d.stop()
+
+
+# ---------------------------------------------------------------------------
+# Daemon: SIGKILL-mid-grant recovery e2e
+# ---------------------------------------------------------------------------
+def _spawn_daemon(home, *extra):
+    proc = subprocess.Popen(
+        [PY, "-m", "tony_tpu.cluster.daemon", "--home", str(home),
+         "--slices", "2", *extra],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        cwd=REPO)
+    line = proc.stdout.readline()
+    return proc, json.loads(line)
+
+
+@pytest.mark.e2e
+@pytest.mark.recovery
+def test_daemon_sigkill_mid_grant_recovers_from_journal(tmp_path):
+    """SIGKILL the daemon while a gang is granted and two jobs queue
+    behind it; the restarted daemon must replay the journal into the
+    exact same pool/grant/queue — same slice ids, same queue order,
+    zero re-provisioned slices — and then drain the queue to
+    completion."""
+    home = tmp_path / "home"
+    proc, hello = _spawn_daemon(home)
+    try:
+        assert hello["incarnation"] == 1 and not hello["recovered"]
+        with D.DaemonClient.from_home(str(home)) as c:
+            a = c.submit(user="alice", slices=2, digest="dd",
+                         payload={"duration_steps": 600,
+                                  "steps_per_s": 100})["job_id"]
+            b = c.submit(user="bob", slices=1,
+                         payload={"duration_steps": 40,
+                                  "steps_per_s": 100})["job_id"]
+            cc = c.submit(user="bob", slices=1,
+                          payload={"duration_steps": 40,
+                                   "steps_per_s": 100})["job_id"]
+            _wait(lambda: c.status(a)["state"] == S.RUNNING,
+                  msg="grant before kill")
+            granted_before = c.status(a)["granted"]
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+        journal_before = journal_mod.replay(
+            D.daemon_journal_path(str(home)))
+        # restart on the same home dir: journal replay, not bootstrap
+        proc2, hello2 = _spawn_daemon(home)
+        try:
+            assert hello2["incarnation"] == 2 and hello2["recovered"]
+            with D.DaemonClient.from_home(str(home)) as c:
+                snap = {j["job_id"]: j for j in c.list_jobs()}
+                assert snap[a]["state"] == S.RUNNING
+                assert snap[a]["granted"] == granted_before
+                assert snap[b]["state"] == S.QUEUED
+                assert snap[cc]["state"] == S.QUEUED
+                for jid in (a, b, cc):
+                    _wait(lambda j=jid: c.status(j)["state"] == S.COMPLETED,
+                          timeout_s=60.0, msg=f"{jid} completion")
+                # b (older seq) was granted before cc
+                assert (c.status(b)["submitted_at"]
+                        < c.status(cc)["submitted_at"])
+        finally:
+            proc2.send_signal(signal.SIGTERM)
+            proc2.wait(timeout=10)
+        journal_after = journal_mod.replay(
+            D.daemon_journal_path(str(home)))
+        added = [r for r in journal_after if r["k"] == "slice_added"]
+        assert len(added) == 2                # ZERO re-provisioned slices
+        assert len(journal_after) > len(journal_before)
+        starts = [r for r in journal_after if r["k"] == "daemon_start"]
+        assert len(starts) == 2
+        # grants after recovery reuse pooled slice ids only
+        pool_ids = {r["slice_id"] for r in added}
+        for r in journal_after:
+            if r["k"] == "job_granted":
+                assert set(r["slice_ids"]) <= pool_ids
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+# ---------------------------------------------------------------------------
+# History server: cluster dashboard from jhist alone
+# ---------------------------------------------------------------------------
+def test_history_server_cluster_dashboard_replays_jhist(tmp_path):
+    from tony_tpu.history import HistoryServer
+
+    hist = tmp_path / "hist" / "intermediate"
+    os.makedirs(hist)
+    d = _daemon(tmp_path, history_dir=str(hist))
+    try:
+        with D.DaemonClient("127.0.0.1", d.port) as c:
+            a = c.submit(user="alice", slices=2, digest="dd",
+                         payload={"duration_steps": 20})["job_id"]
+            _wait(lambda: c.status(a)["state"] == S.COMPLETED,
+                  msg="job a")
+            b = c.submit(user="alice", slices=2, digest="dd",
+                         payload={"duration_steps": 20})["job_id"]
+            _wait(lambda: c.status(b)["state"] == S.COMPLETED,
+                  msg="job b")
+    finally:
+        d.stop()      # daemon is GONE; the dashboard replays jhist only
+    conf = TonyConfig({
+        K.HISTORY_LOCATION_KEY: str(tmp_path / "hist"),
+        K.HISTORY_INTERMEDIATE_KEY: str(hist),
+        K.HISTORY_FINISHED_KEY: str(tmp_path / "hist" / "finished"),
+    })
+    server = HistoryServer(conf, port=0)
+    state = server.cluster_state()
+    assert [x["app_id"] for x in state["daemons"]] == ["cluster-daemon-i1"]
+    assert state["states"].get(S.COMPLETED) == 2
+    by_id = {j["job_id"]: j for j in state["jobs"]}
+    assert by_id[a]["user"] == "alice" and by_id[a]["slices"] == 2
+    assert by_id[a]["warm"] is False        # first grant was cold
+    assert by_id[b]["warm"] is True         # back-to-back digest match
+    assert by_id[b]["warm_hits"] == 2
+    # HTTP routes render the same fold
+    import urllib.request
+    server.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://localhost:{server.port}/cluster", timeout=10) as r:
+            page = r.read().decode("utf-8")
+        assert a in page and "warm" in page
+        with urllib.request.urlopen(
+                f"http://localhost:{server.port}/api/cluster",
+                timeout=10) as r:
+            api = json.loads(r.read().decode("utf-8"))
+        assert api["states"] == state["states"]
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# Backend: release-to-pool (never a teardown)
+# ---------------------------------------------------------------------------
+def test_backend_release_gang_returns_name_and_digest():
+    import threading
+
+    from tony_tpu.backend.tpu import TpuSliceBackend
+
+    conf = TonyConfig({
+        "tony.scheduler.backend": "tpu", "tony.tpu.project": "p",
+        "tony.tpu.zone": "z", "tony.tpu.accelerator-type": "v5litepod",
+        "tony.worker.instances": "1", "tony.worker.tpus": "8",
+        "tony.worker.tpu.topology": "2x2",
+    })
+    b = TpuSliceBackend(conf, app_id="app1", dry_run=True)
+    b._gangs[("worker", 0)] = {"name": b._slice_name("worker", 0),
+                               "ready": threading.Event()}
+    b._stage_digest = "sha256-ff"
+    name, digest = b.release_gang("worker", 0)
+    assert name == b._slice_name("worker", 0)
+    assert digest == "sha256-ff"
+    assert b._gangs == {}          # stop() will NOT tear the slice down
+    assert b.release_all() == []
+
+
+# ---------------------------------------------------------------------------
+# Bench arm pin
+# ---------------------------------------------------------------------------
+def test_sched_bench_arm_pins_warm_turnover_ratio():
+    """bench._sched_arm drives identical 3-job workloads through a real
+    daemon with and without digest affinity.  Pin: warm turnover beats
+    cold by >= 2x (measured ~5x), and the queue-wait p99 read off
+    tony_sched_queue_wait_seconds is sane."""
+    sys.path.insert(0, REPO)
+    import bench
+    res = bench._sched_arm()
+    assert res["sched_warm_turnover_vs_cold"] >= 2
+    assert res["sched_warm_turnover_s"] > 0
+    assert res["sched_cold_turnover_s"] > res["sched_warm_turnover_s"]
+    assert res["sched_warm_hits"] >= 4      # jobs 2..3 x 2 slices, warm arm
+    assert 0 <= res["sched_queue_wait_p99_s"] < 30

@@ -59,6 +59,19 @@ class SchedulerBackend(abc.ABC):
         startup phases may return []."""
         return []
 
+    def release_all(self) -> list[tuple[str, str]]:
+        """Release every allocation to the CALLER without teardown:
+        returns ``(slice_name, staging_digest)`` pairs and forgets them.
+
+        This is the cluster daemon's release-to-pool path (docs/
+        cluster.md): a finished job's slices stay alive — warm, staged,
+        digest-tagged — so the next digest-matching job adopts them via
+        ALREADY_EXISTS in ~0.5s instead of paying full bring-up.
+        ``stop()`` remains the teardown path (the pool reaps idle
+        slices through it).  Backends without durable allocations
+        (LocalBackend) return []."""
+        return []
+
     @abc.abstractmethod
     def kill_task(self, task_id: str) -> None: ...
 

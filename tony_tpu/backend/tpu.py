@@ -831,6 +831,33 @@ class TpuSliceBackend(SchedulerBackend):
             except subprocess.TimeoutExpired:
                 p.kill()
 
+    def release_gang(self, job_type: str,
+                     slice_idx: int = 0) -> tuple[str, str]:
+        """Release one gang's slice to the caller WITHOUT teardown.
+
+        The slice stays alive — provisioned, staged, digest-stamped —
+        and this backend forgets it, so ``stop()`` will not delete it.
+        Returns ``(slice_name, staging_digest)``: the cluster daemon
+        pools the name under the digest, and the next digest-matching
+        job re-adopts it through the create path's ALREADY_EXISTS
+        branch (plus the remote digest probe) at warm-adopt cost.
+        """
+        gang = (job_type, slice_idx)
+        with self._lock:
+            entry = self._gangs.pop(gang, None)
+            self._state_cache.pop(gang, None)
+            self._state_ts.pop(gang, None)
+            name = entry["name"] if entry is not None \
+                else self._slice_name(job_type, slice_idx)
+            digest = self._stage_digest or ""
+        return name, digest
+
+    def release_all(self) -> list[tuple[str, str]]:
+        with self._lock:
+            gangs = list(self._gangs)
+        return [self.release_gang(jt, slice_idx)
+                for jt, slice_idx in gangs]
+
     def stop(self) -> None:
         self.kill_all()
         with self._lock:

@@ -18,6 +18,7 @@ CLI option names follow the reference's common options
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import sys
@@ -48,6 +49,27 @@ def build_parser() -> argparse.ArgumentParser:
                     help="only this task, e.g. worker:0 (default: all)")
     lg.add_argument("--tail", type=int, default=0, metavar="N",
                     help="last N lines of each log (default: everything)")
+    cl = sub.add_parser(
+        "cluster",
+        help="talk to the multi-tenant cluster daemon (docs/cluster.md): "
+             "submit/status/cancel/list/stats")
+    cl.add_argument("action",
+                    choices=("submit", "status", "cancel", "list", "stats"))
+    cl.add_argument("--home",
+                    help="daemon home dir (reads <home>/daemon.port)")
+    cl.add_argument("--host", default="127.0.0.1")
+    cl.add_argument("--port", type=int, default=0,
+                    help="daemon port (overrides --home)")
+    cl.add_argument("--job-id", default="",
+                    help="job id for status/cancel (optional on submit)")
+    cl.add_argument("--user", default=os.environ.get("USER", "anon"))
+    cl.add_argument("--slices", type=int, default=1,
+                    help="gang size (granted all-or-nothing)")
+    cl.add_argument("--priority", type=int, default=0)
+    cl.add_argument("--digest", default="",
+                    help="staging digest for warm-pool affinity")
+    cl.add_argument("--elastic", action="store_true",
+                    help="job tolerates induced shrinks (preemptible)")
     c = sub.add_parser(
         "convert", add_help=False,
         help="convert data files to TONY1 framed records "
@@ -93,6 +115,8 @@ def main(argv: list[str] | None = None) -> int:
         return job_status(args.job_dir)
     if args.command == "logs":
         return job_logs(args.job_dir, task=args.task, tail=args.tail)
+    if args.command == "cluster":
+        return cluster_cmd(args)
     overrides = parse_cli_confs(args.conf)
     conf = TonyConfig.load(args.conf_file, cli_overrides=overrides)
     if args.python_venv:
@@ -131,6 +155,38 @@ def main(argv: list[str] | None = None) -> int:
     except ValueError as e:
         # Config validation failures (bad resource asks, topology vs
         # instances) are user errors: one actionable line, no traceback.
+        raise SystemExit(f"tony: {e}")
+
+
+def cluster_cmd(args) -> int:
+    """Daemon-plane client ops (docs/cluster.md §Submission API)."""
+    from tony_tpu.cluster.daemon import DaemonClient, DaemonError
+    if not args.port and not args.home:
+        raise SystemExit("tony: cluster needs --home or --port")
+    try:
+        client = (DaemonClient(args.host, args.port) if args.port
+                  else DaemonClient.from_home(args.home, host=args.host))
+        with client:
+            if args.action == "submit":
+                out = client.submit(user=args.user, slices=args.slices,
+                                    priority=args.priority,
+                                    digest=args.digest,
+                                    elastic=args.elastic,
+                                    job_id=args.job_id or None)
+            elif args.action == "list":
+                out = {"jobs": client.list_jobs()}
+            elif args.action == "stats":
+                out = client.stats()
+            else:
+                if not args.job_id:
+                    raise SystemExit(
+                        f"tony: cluster {args.action} needs --job-id")
+                out = (client.status(args.job_id)
+                       if args.action == "status"
+                       else client.cancel(args.job_id))
+        print(json.dumps(out, indent=1))
+        return 0
+    except (DaemonError, OSError) as e:
         raise SystemExit(f"tony: {e}")
 
 

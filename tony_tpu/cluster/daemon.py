@@ -1,0 +1,824 @@
+"""Persistent multi-tenant cluster daemon: one scheduler, many jobs.
+
+The coordinator (:mod:`tony_tpu.cluster.coordinator`) lives and dies
+with a single job; this daemon is the long-lived tenant above it.  It
+owns a pool of TPU slices and a job queue, grants gangs all-or-nothing,
+induces elastic shrinks for cross-job preemption, and keeps freed
+slices *warm* (tagged with their staging digest) so back-to-back jobs
+pay ~0.5s ALREADY_EXISTS adoption instead of full bring-up — cluster
+throughput is scheduling-bound, not bring-up-bound (docs/cluster.md).
+
+Three planes, cleanly separated:
+
+- **Policy** lives in :mod:`tony_tpu.cluster.scheduler` (pure,
+  virtual-clock friendly — SimCluster replays 1000-job schedules in
+  milliseconds).
+- **Wire** rides the TONYS1 framing discipline
+  (:mod:`tony_tpu.serving.protocol`): one persistent connection per
+  client, rid-multiplexed ``OP``/``REPLY`` JSON frames.  A malformed
+  frame is connection-scoped; a bad op (queue full, unknown job) is
+  request-scoped.
+- **Durability** rides the PR 15 journal format: every queue/pool/grant
+  transition is an fsync'd record in ``<home>/daemon.journal``, and a
+  SIGKILLed daemon replays it to rebuild its queue (original order),
+  its grants (same slice ids), and its pool — zero re-provisioning,
+  exactly the coordinator's recovery discipline one level up.
+
+Job execution is behind :class:`JobRunner`: production plugs in real
+coordinator launches; tests, bench, and the SIGKILL e2e use
+:class:`OracleRunner` — deterministic simulated jobs whose committed
+step watermark makes "a preemption loses zero committed steps"
+checkable to the step.
+
+Run it::
+
+    python -m tony_tpu.cluster.daemon --home /var/tony --slices 4
+
+The bound port is written to ``<home>/daemon.port`` for clients
+(:class:`DaemonClient`, ``python -m tony_tpu.client.cli cluster-*``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import logging
+import os
+import threading
+import time
+
+from tony_tpu.cluster import journal as J
+from tony_tpu.cluster import scheduler as S
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.events import events as ev
+from tony_tpu.runtime import metrics as metrics_mod
+from tony_tpu.serving import protocol as P
+from tony_tpu.serving.server import FrameConn, FrameServerBase
+
+log = logging.getLogger("tony_tpu.daemon")
+
+#: the daemon's WAL, next to (never mixed with) per-job session journals
+DAEMON_JOURNAL_FILE = "daemon.journal"
+#: where the bound submission port is published for clients
+PORT_FILE = "daemon.port"
+
+# Daemon-plane frame types. Same TONYS1 framing (magic, u32 length, u8
+# type + u64 rid header) and the same HELLO preamble as the serving
+# plane; OP/REPLY live in a distinct type range — the two planes never
+# share a connection.
+DF_OP = 82          # client -> server: {"op": ..., ...}
+DF_REPLY = 83       # server -> client: {"ok": true, ...} | {"ok": false,
+#                     "error": str} (request-scoped failure)
+DF_NAMES = {P.HELLO: "HELLO", DF_OP: "OP", DF_REPLY: "REPLY"}
+
+WIRE_VERSION = 1
+
+
+class DaemonError(RuntimeError):
+    """Request-scoped daemon-op failure reported over the wire."""
+
+
+# ---------------------------------------------------------------------------
+# Job runners
+# ---------------------------------------------------------------------------
+class RunnerEvent:
+    """One thing the runner observed: a job completed, failed, or
+    committed its preemption fence (``step`` = the committed
+    watermark)."""
+
+    __slots__ = ("job_id", "kind", "step")
+    COMPLETED = "completed"
+    FAILED = "failed"
+    FENCED = "fenced"
+
+    def __init__(self, job_id: str, kind: str, step: int = 0) -> None:
+        self.job_id = job_id
+        self.kind = kind
+        self.step = step
+
+
+class JobRunner:
+    """Execution adapter: the daemon decides *what* runs where; the
+    runner makes it so.  Production wires coordinator launches here;
+    :class:`OracleRunner` simulates them deterministically."""
+
+    def start(self, job_id: str, slice_ids: list[str], payload: dict,
+              resume_step: int, warm: bool, adopted: bool = False) -> None:
+        raise NotImplementedError
+
+    def preempt(self, job_id: str, release_ids: list[str],
+                grace_s: float) -> None:
+        """Induce a shrink: fence a checkpoint within ``grace_s``, drain
+        ``release_ids``, then report a ``FENCED`` event via poll()."""
+        raise NotImplementedError
+
+    def stop_job(self, job_id: str) -> None:
+        raise NotImplementedError
+
+    def poll(self, now: float) -> list[RunnerEvent]:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        """Tear down runner-held resources (daemon shutdown)."""
+
+
+class _OracleJob:
+    __slots__ = ("job_id", "total_steps", "rate", "resume", "run_start",
+                 "fence_at", "done")
+
+    def __init__(self, job_id: str, total_steps: int, rate: float,
+                 resume: int, run_start: float) -> None:
+        self.job_id = job_id
+        self.total_steps = total_steps
+        self.rate = rate
+        self.resume = resume
+        self.run_start = run_start       # bring-up already added
+        self.fence_at: float | None = None
+        self.done = False
+
+
+class OracleRunner(JobRunner):
+    """Deterministic simulated jobs (the SimFleet oracle applied to
+    scheduling).
+
+    A job's payload names ``duration_steps`` and ``steps_per_s``; the
+    committed watermark at time t is ``resume + floor((t - run_start) *
+    steps_per_s)`` (clamped) — a pure function, so every pin about lost
+    or re-done work is exact.  Bring-up costs ``warm_adopt_s`` when the
+    whole gang matched the staging digest, ``cold_bringup_s`` otherwise
+    (PR 4's measured contrast, collapsed to two constants).
+
+    The runner also *asserts the fence contract*: a job restarted after
+    a full preemption must resume from exactly the fence step it
+    reported — anything else lost or re-did committed work and raises.
+    """
+
+    def __init__(self, cold_bringup_s: float = 0.0,
+                 warm_adopt_s: float = 0.0,
+                 clock=time.time) -> None:
+        self.cold_bringup_s = cold_bringup_s
+        self.warm_adopt_s = warm_adopt_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._jobs: dict[str, _OracleJob] = {}     # guarded-by: _lock
+        self._fences: dict[str, int] = {}          # guarded-by: _lock
+
+    def committed(self, job: _OracleJob, now: float) -> int:
+        if now <= job.run_start:
+            return job.resume
+        steps = job.resume + int((now - job.run_start) * job.rate)
+        return min(steps, job.total_steps)
+
+    def start(self, job_id: str, slice_ids: list[str], payload: dict,
+              resume_step: int, warm: bool, adopted: bool = False) -> None:
+        total = int(payload.get("duration_steps", 100))
+        rate = float(payload.get("steps_per_s", 1000.0))
+        bringup = 0.0 if adopted else (
+            self.warm_adopt_s if warm else self.cold_bringup_s)
+        now = self._clock()
+        with self._lock:
+            fence = self._fences.get(job_id)
+            if fence is not None and resume_step != fence:
+                raise AssertionError(
+                    f"job {job_id!r} resumed from step {resume_step}, "
+                    f"but its checkpoint fence committed step {fence} — "
+                    "committed work was lost or re-done")
+            self._jobs[job_id] = _OracleJob(
+                job_id, total, rate, resume_step, now + bringup)
+
+    def preempt(self, job_id: str, release_ids: list[str],
+                grace_s: float) -> None:
+        now = self._clock()
+        with self._lock:
+            job = self._jobs.get(job_id)
+            if job is not None and not job.done:
+                job.fence_at = now + grace_s
+
+    def stop_job(self, job_id: str) -> None:
+        with self._lock:
+            self._jobs.pop(job_id, None)
+
+    def poll(self, now: float) -> list[RunnerEvent]:
+        out: list[RunnerEvent] = []
+        with self._lock:
+            for job in list(self._jobs.values()):
+                if job.done:
+                    continue
+                if job.fence_at is not None and now >= job.fence_at:
+                    step = self.committed(job, job.fence_at)
+                    job.fence_at = None
+                    self._fences[job.job_id] = step
+                    out.append(RunnerEvent(job.job_id,
+                                           RunnerEvent.FENCED, step))
+                    continue
+                if (job.fence_at is None
+                        and self.committed(job, now) >= job.total_steps):
+                    job.done = True
+                    out.append(RunnerEvent(job.job_id,
+                                           RunnerEvent.COMPLETED,
+                                           job.total_steps))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Journal recovery
+# ---------------------------------------------------------------------------
+def daemon_journal_path(home_dir: str) -> str:
+    return os.path.join(home_dir, DAEMON_JOURNAL_FILE)
+
+
+def fold_daemon(records: list[dict]) -> dict:
+    """Replay daemon journal records into the current queue/pool/grant
+    state.  Unknown kinds are ignored (older daemons replay newer
+    journals).  Returns ``{"pool", "jobs", "incarnations",
+    "preemptions", "max_seq"}`` — everything :class:`ClusterDaemon`
+    needs to resume without re-provisioning a single slice."""
+    pool = S.SlicePool()
+    jobs: dict[str, S.Job] = {}
+    incarnations = 0
+    preemptions = 0
+    max_seq = -1
+    for r in records:
+        k = r.get("k")
+        t = float(r.get("t", 0.0))
+        if k == "daemon_start":
+            incarnations += 1
+        elif k == "slice_added":
+            pool.add(r["slice_id"], digest=r.get("digest", ""), now=t)
+        elif k == "slice_reaped":
+            pool.remove(r["slice_id"])
+        elif k == "job_submitted":
+            job = S.Job(job_id=r["job_id"], user=r.get("user", ""),
+                        slices=int(r["slices"]),
+                        priority=int(r.get("priority", 0)),
+                        digest=r.get("digest", ""),
+                        elastic=bool(r.get("elastic", False)),
+                        payload=r.get("payload", {}))
+            job.seq = int(r.get("seq", 0))
+            job.submitted_at = job.enqueued_at = t
+            max_seq = max(max_seq, job.seq)
+            jobs[job.job_id] = job
+        elif k == "job_granted":
+            job = jobs[r["job_id"]]
+            job.state = S.RUNNING
+            job.granted = list(r["slice_ids"])
+            job.warm_hits += int(r.get("warm", 0))
+            job.queue_wait_s += float(r.get("wait_s", 0.0))
+            job.granted_at = t
+            for sid in job.granted:
+                slot = pool.get(sid)
+                if slot is None or slot.job_id:
+                    raise J.JournalCorruptError(
+                        "<daemon>", 0,
+                        f"job_granted names slice {sid!r} that is "
+                        f"{'busy' if slot else 'unknown'}")
+                slot.job_id = job.job_id
+        elif k == "shrink_requested":
+            job = jobs[r["job_id"]]
+            job.state = S.PREEMPTING
+            job.pending_release = list(r["release_ids"])
+            job.preemptions += 1
+            preemptions += 1
+        elif k == "job_preempted":
+            job = jobs[r["job_id"]]
+            for sid in job.pending_release:
+                job.granted.remove(sid)
+                pool.release(sid, digest=job.digest, now=t)
+            job.pending_release = []
+            job.resume_step = max(job.resume_step,
+                                  int(r.get("fence_step", 0)))
+            if job.granted:
+                job.state = S.RUNNING
+            else:
+                job.state = S.QUEUED
+                job.enqueued_at = t
+        elif k in ("job_completed", "job_cancelled"):
+            job = jobs[r["job_id"]]
+            for sid in job.granted:
+                pool.release(sid, digest=job.digest, now=t)
+            job.granted = []
+            job.pending_release = []
+            job.state = r.get("status", S.CANCELLED if
+                              k == "job_cancelled" else S.COMPLETED)
+            job.finished_at = t
+    return {"pool": pool, "jobs": jobs, "incarnations": incarnations,
+            "preemptions": preemptions, "max_seq": max_seq}
+
+
+# ---------------------------------------------------------------------------
+# The daemon
+# ---------------------------------------------------------------------------
+class ClusterDaemon:
+    """Owns the pool, the queue, the journal, and the submission wire.
+
+    One loop thread (``tony-daemon-loop``) drives scheduling; RPC
+    threads only submit/cancel/read under the same lock.  Every state
+    transition is journaled *inside* the lock (append order == state
+    order), while runner calls and frame sends happen outside it.
+    """
+
+    def __init__(self, home_dir: str, conf: TonyConfig | None = None,
+                 slices: int | list[str] = 0,
+                 runner: JobRunner | None = None,
+                 registry: metrics_mod.MetricsRegistry | None = None,
+                 bind_host: str = "127.0.0.1", port: int = 0,
+                 history_dir: str | None = None,
+                 tick_interval_s: float = 0.02,
+                 on_slice_reaped=None,
+                 clock=time.time) -> None:
+        self.home_dir = home_dir
+        self.conf = conf or TonyConfig()
+        self.queue_limit = self.conf.get_int(K.DAEMON_QUEUE_LIMIT_KEY, 1000)
+        self.user_quota = self.conf.get_int(K.DAEMON_USER_QUOTA_KEY, 0)
+        self.preemption_grace_s = self.conf.get_int(
+            K.DAEMON_PREEMPTION_GRACE_MS_KEY, 5000) / 1000.0
+        self.idle_reap_s = self.conf.get_int(
+            K.DAEMON_POOL_IDLE_REAP_MS_KEY, 300000) / 1000.0
+        self._initial_slices = slices
+        self.runner = runner or OracleRunner(clock=clock)
+        self.registry = registry or metrics_mod.MetricsRegistry()
+        self._clock = clock
+        self._tick_interval_s = tick_interval_s
+        self._on_slice_reaped = on_slice_reaped
+        #: serializes scheduler/pool mutation between the loop thread
+        #: and RPC threads (start() runs before either exists)
+        self._lock = threading.Lock()
+        self.pool: S.SlicePool | None = None
+        self.sched: S.ClusterScheduler | None = None
+        self.incarnation = 0
+        self.recovered = False
+        self._job_ids = 0
+        self._journal: J.Journal | None = None
+        self._events: ev.EventHandler | None = None
+        self._history_dir = history_dir
+        self._server = _DaemonServer(self, bind_host, port)
+        self._loop_thread: threading.Thread | None = None
+        self._stopping = threading.Event()
+        self.port = 0
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> int:
+        os.makedirs(self.home_dir, exist_ok=True)
+        self._recover_or_bootstrap()
+        self._journal = J.Journal(self.home_dir,
+                                  filename=DAEMON_JOURNAL_FILE)
+        self._journal.append("daemon_start", t=self._clock(),
+                             incarnation=self.incarnation)
+        if self._history_dir:
+            # "i<no>" (not a bare number): a trailing pure-digit
+            # segment would be stolen by the jhist filename regex as a
+            # timestamp
+            self._events = ev.EventHandler(
+                self._history_dir, f"cluster-daemon-i{self.incarnation}",
+                "daemon")
+            self._events.start()
+        if self.recovered:
+            self._readopt_running()
+        self.port = self._server.start()
+        with open(os.path.join(self.home_dir, PORT_FILE), "w") as f:
+            f.write(str(self.port))
+        self._loop_thread = threading.Thread(
+            target=self._loop, name="tony-daemon-loop", daemon=True)
+        self._loop_thread.start()
+        log.info("cluster daemon up: port=%d incarnation=%d pool=%d "
+                 "(recovered=%s)", self.port, self.incarnation,
+                 self.pool.size(), self.recovered)
+        return self.port
+
+    def _recover_or_bootstrap(self) -> None:
+        path = daemon_journal_path(self.home_dir)
+        records: list[dict] = []
+        if os.path.exists(path):
+            records = J.replay(path, truncate_torn=True)
+        if records:
+            state = fold_daemon(records)
+            self.pool = state["pool"]
+            self.sched = S.ClusterScheduler(
+                self.pool, queue_limit=self.queue_limit,
+                user_quota=self.user_quota)
+            self.sched.jobs = state["jobs"]
+            self.sched.preemptions_total = state["preemptions"]
+            self.sched._seq = itertools.count(state["max_seq"] + 1)
+            self._job_ids = len(state["jobs"])
+            self.incarnation = state["incarnations"] + 1
+            self.recovered = True
+            self.sched.check_invariant()
+        else:
+            self.pool = S.SlicePool()
+            self.sched = S.ClusterScheduler(
+                self.pool, queue_limit=self.queue_limit,
+                user_quota=self.user_quota)
+            self.incarnation = 1
+            now = self._clock()
+            slices = self._initial_slices
+            ids = ([f"slice-{i}" for i in range(slices)]
+                   if isinstance(slices, int) else list(slices))
+            # bootstrap slices are journaled BEFORE daemon_start so a
+            # replayed pool is complete by the time grants appear
+            boot = J.Journal(self.home_dir, filename=DAEMON_JOURNAL_FILE)
+            for sid in ids:
+                self.pool.add(sid, now=now)
+                boot.append("slice_added", slice_id=sid, digest="", t=now)
+            boot.close()
+
+    def _readopt_running(self) -> None:
+        """Re-adopt journaled RUNNING/PREEMPTING jobs into the runner —
+        their slices exist and their processes are the backend's to
+        re-find (PR 15 discipline); the daemon re-provisions nothing."""
+        for job in self.sched.running_jobs():
+            self.runner.start(job.job_id, list(job.granted), job.payload,
+                              job.resume_step, warm=True, adopted=True)
+            if job.state == S.PREEMPTING:
+                self.runner.preempt(job.job_id, list(job.pending_release),
+                                    self.preemption_grace_s)
+
+    def stop(self) -> None:
+        self._stopping.set()
+        self._server.shutdown()
+        if self._loop_thread is not None:
+            self._loop_thread.join(timeout=5)
+        self.runner.stop()
+        if self._events is not None:
+            self._events.stop("SUCCEEDED")
+        if self._journal is not None:
+            self._journal.close()
+
+    # -- the scheduling loop --------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                self.tick_once()
+            except Exception:
+                # the loop must survive a bad tick — the failure is
+                # logged with stack for the postmortem, state stays
+                # consistent (transitions are atomic under the lock)
+                log.exception("daemon tick failed")
+            self._stopping.wait(self._tick_interval_s)
+
+    def tick_once(self) -> None:
+        """One scheduling pass — public so tests and the bench arm can
+        drive the daemon synchronously."""
+        now = self._clock()
+        runner_events = self.runner.poll(now)
+        emits: list[tuple[str, dict]] = []
+        starts: list[S.Grant] = []
+        preempts: list[S.Shrink] = []
+        stops: list[str] = []
+        with self._lock:
+            for re_ in runner_events:
+                job = self.sched.jobs.get(re_.job_id)
+                if job is None or job.state in S.TERMINAL_STATES:
+                    continue
+                if re_.kind == RunnerEvent.FENCED:
+                    requeued = len(job.pending_release) == len(job.granted)
+                    released = list(job.pending_release)
+                    self.sched.preemption_complete(job.job_id, now,
+                                                   re_.step)
+                    self._journal.append("job_preempted",
+                                         job_id=job.job_id,
+                                         fence_step=re_.step, t=now)
+                    emits.append((ev.JOB_PREEMPTED, {
+                        "job_id": job.job_id, "fence_step": re_.step,
+                        "released": released, "requeued": requeued}))
+                    if requeued:
+                        stops.append(job.job_id)
+                else:
+                    status = (S.COMPLETED if re_.kind ==
+                              RunnerEvent.COMPLETED else S.FAILED)
+                    self.sched.complete(job.job_id, now, status)
+                    self._journal.append("job_completed",
+                                         job_id=job.job_id,
+                                         status=status, t=now)
+                    emits.append((ev.JOB_COMPLETED, {
+                        "job_id": job.job_id, "status": status,
+                        "queue_wait_s": round(job.queue_wait_s, 6),
+                        "warm_hits": job.warm_hits,
+                        "preemptions": job.preemptions}))
+            if self.idle_reap_s > 0:
+                for sid in self.pool.reap_idle(now, self.idle_reap_s):
+                    self._journal.append("slice_reaped", slice_id=sid,
+                                         t=now)
+                    if self._on_slice_reaped is not None:
+                        self._on_slice_reaped(sid)
+            grants, shrinks = self.sched.tick(now)
+            for g in grants:
+                self._journal.append("job_granted", job_id=g.job.job_id,
+                                     slice_ids=g.slice_ids, warm=g.warm_hits,
+                                     wait_s=round(g.wait_s, 6), t=now)
+                emits.append((ev.JOB_GRANTED, {
+                    "job_id": g.job.job_id, "slice_ids": g.slice_ids,
+                    "warm_hits": g.warm_hits,
+                    "queue_wait_s": round(g.wait_s, 6)}))
+                starts.append(g)
+            for s in shrinks:
+                self._journal.append("shrink_requested",
+                                     job_id=s.job.job_id,
+                                     release_ids=s.release_ids,
+                                     requeue=s.requeue, t=now)
+                preempts.append(s)
+            depth = self.sched.stats()["queue_depth"]
+            free = self.pool.free_count()
+        # blocking/side-effectful calls happen OUTSIDE the lock
+        for g in starts:
+            self._observe_grant(g)
+            self.runner.start(g.job.job_id, g.slice_ids, g.job.payload,
+                              g.job.resume_step,
+                              warm=g.warm_hits == len(g.slice_ids))
+        for s in preempts:
+            self.registry.counter(
+                "tony_sched_preemptions_total",
+                "Cross-job preemption (induced shrink) requests").inc()
+            self.runner.preempt(s.job.job_id, s.release_ids,
+                                self.preemption_grace_s)
+        for job_id in stops:
+            self.runner.stop_job(job_id)
+        for etype, payload in emits:
+            self._emit(etype, payload)
+        self.registry.gauge("tony_sched_queue_depth",
+                            "Jobs waiting in the daemon queue").set(depth)
+        self.registry.gauge("tony_pool_free_slices",
+                            "Free slices in the warm pool").set(free)
+
+    def _observe_grant(self, g: S.Grant) -> None:
+        self.registry.histogram(
+            "tony_sched_queue_wait_seconds",
+            "Queue wait per granted episode").observe(g.wait_s)
+        if g.warm_hits:
+            self.registry.counter(
+                "tony_pool_warm_hits_total",
+                "Granted slices whose staging digest matched"
+            ).inc(g.warm_hits)
+        # queue wait is badput with a name: it joins the goodput
+        # ledger's category space so cluster dashboards see one
+        # accounting (docs/observability.md §Goodput categories)
+        self.registry.counter(
+            "tony_goodput_seconds_total",
+            "Cumulative attributed seconds by category",
+            category="queue_wait").inc(g.wait_s)
+
+    def _emit(self, etype: str, payload: dict) -> None:
+        if self._events is not None:
+            self._events.emit(etype, **payload)
+
+    # -- ops (wire + in-process) ----------------------------------------------
+    def handle_op(self, op: dict) -> dict:
+        """Dispatch one client op; raises :class:`DaemonError` for
+        request-scoped failures (the server turns those into ok=false
+        replies)."""
+        kind = op.get("op")
+        if kind == "submit":
+            return self._op_submit(op)
+        if kind == "status":
+            return {"job": self._snapshot(op.get("job_id", ""))}
+        if kind == "cancel":
+            return self._op_cancel(op)
+        if kind == "list":
+            with self._lock:
+                jobs = sorted(self.sched.jobs.values(),
+                              key=lambda j: j.seq)
+                return {"jobs": [j.snapshot() for j in jobs]}
+        if kind == "stats":
+            with self._lock:
+                st = self.sched.stats()
+            st["incarnation"] = self.incarnation
+            return {"stats": st}
+        raise DaemonError(f"unknown op {kind!r}")
+
+    def _op_submit(self, op: dict) -> dict:
+        now = self._clock()
+        slices = int(op.get("slices", 1))
+        with self._lock:
+            job_id = op.get("job_id")
+            if not job_id:          # generated ids skip recovered jobs
+                while not job_id or job_id in self.sched.jobs:
+                    job_id = f"job-{self._job_ids}"
+                    self._job_ids += 1
+            job = S.Job(job_id=job_id, user=str(op.get("user", "anon")),
+                        slices=slices,
+                        priority=int(op.get("priority", 0)),
+                        digest=str(op.get("digest", "")),
+                        elastic=bool(op.get("elastic", False)),
+                        payload=dict(op.get("payload") or {}))
+            try:
+                position = self.sched.submit(job, now)
+            except S.SchedulerError as e:
+                raise DaemonError(str(e)) from e
+            self._journal.append("job_submitted", job_id=job.job_id,
+                                 user=job.user, slices=job.slices,
+                                 priority=job.priority, digest=job.digest,
+                                 elastic=job.elastic, payload=job.payload,
+                                 seq=job.seq, t=now)
+        self._emit(ev.JOB_QUEUED, {
+            "job_id": job.job_id, "user": job.user,
+            "priority": job.priority, "slices": job.slices,
+            "digest": job.digest})
+        return {"job_id": job.job_id, "position": position}
+
+    def _op_cancel(self, op: dict) -> dict:
+        job_id = op.get("job_id", "")
+        now = self._clock()
+        stop_runner = False
+        with self._lock:
+            job = self.sched.jobs.get(job_id)
+            if job is None:
+                raise DaemonError(f"unknown job {job_id!r}")
+            if job.state == S.QUEUED:
+                self.sched.cancel(job_id)
+                self._journal.append("job_cancelled", job_id=job_id,
+                                     status=S.CANCELLED, t=now)
+            elif job.state in (S.RUNNING, S.PREEMPTING):
+                self.sched.complete(job_id, now, S.CANCELLED)
+                self._journal.append("job_completed", job_id=job_id,
+                                     status=S.CANCELLED, t=now)
+                stop_runner = True
+            else:
+                raise DaemonError(f"job {job_id!r} already {job.state}")
+            snap = job.snapshot()
+        if stop_runner:
+            self.runner.stop_job(job_id)
+        self._emit(ev.JOB_COMPLETED, {"job_id": job_id,
+                                      "status": S.CANCELLED,
+                                      "queue_wait_s": snap["queue_wait_s"],
+                                      "warm_hits": snap["warm_hits"],
+                                      "preemptions": snap["preemptions"]})
+        return {"job": snap}
+
+    def _snapshot(self, job_id: str) -> dict:
+        with self._lock:
+            job = self.sched.jobs.get(job_id)
+            if job is None:
+                raise DaemonError(f"unknown job {job_id!r}")
+            return job.snapshot()
+
+
+# ---------------------------------------------------------------------------
+# Wire: server + client
+# ---------------------------------------------------------------------------
+class _DaemonServer(FrameServerBase):
+    """The submission plane: OP in, REPLY out, rid-multiplexed.  Op
+    failures are request-scoped (ok=false with the rid); malformed
+    frames are connection-scoped (FrameServerBase closes the
+    offender)."""
+
+    def __init__(self, daemon: ClusterDaemon, bind_host: str,
+                 port: int) -> None:
+        super().__init__(bind_host, port)
+        self.daemon = daemon
+
+    def _hello_payload(self) -> dict:
+        return {"v": WIRE_VERSION, "daemon_id": "cluster-daemon",
+                "incarnation": self.daemon.incarnation}
+
+    def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
+                      payload: bytes) -> None:
+        if ftype != DF_OP:
+            raise P.ProtocolError(
+                f"unexpected frame type {ftype} on the daemon plane")
+        op = P.unpack_json(payload)
+        try:
+            reply = self.daemon.handle_op(op)
+        except DaemonError as e:
+            conn.send(DF_REPLY, rid, P.pack_json(
+                {"ok": False, "error": str(e)}))
+            return
+        reply["ok"] = True
+        conn.send(DF_REPLY, rid, P.pack_json(reply))
+
+    def _on_conn_closed(self, conn: FrameConn) -> None:
+        pass      # submissions are durable server-side; nothing to undo
+
+    def shutdown(self) -> None:
+        self._stopping.set()
+        self._close_listener()
+        self._close_conns()
+
+
+class DaemonClient:
+    """Blocking client for the daemon plane (CLI, tests, bench).
+
+    One socket, sequential rids.  Request-scoped failures raise
+    :class:`DaemonError`; transport/protocol failures raise
+    :class:`~tony_tpu.serving.protocol.ProtocolError`/``OSError``.
+    """
+
+    def __init__(self, host: str, port: int, timeout_s: float = 10.0):
+        import socket as socket_mod
+        self._sock = socket_mod.create_connection((host, port),
+                                                  timeout=timeout_s)
+        P.set_nodelay(self._sock)
+        self._sock.sendall(P.MAGIC)
+        self._rid = 0
+        frame = P.recv_frame(self._sock)
+        if frame is None or frame[0] != P.HELLO:
+            raise P.ProtocolError("daemon sent no HELLO")
+        self.hello = P.unpack_json(frame[2])
+
+    @classmethod
+    def from_home(cls, home_dir: str, host: str = "127.0.0.1",
+                  timeout_s: float = 10.0) -> "DaemonClient":
+        with open(os.path.join(home_dir, PORT_FILE)) as f:
+            port = int(f.read().strip())
+        return cls(host, port, timeout_s)
+
+    def _op(self, **op) -> dict:
+        self._rid += 1
+        rid = self._rid
+        self._sock.sendall(P.encode_frame(DF_OP, rid, P.pack_json(op)))
+        while True:
+            frame = P.recv_frame(self._sock)
+            if frame is None:
+                raise P.ProtocolError("daemon closed mid-request")
+            ftype, got_rid, payload = frame
+            if ftype != DF_REPLY or got_rid != rid:
+                continue          # stale reply from a prior timeout
+            reply = P.unpack_json(payload)
+            if not reply.get("ok"):
+                raise DaemonError(reply.get("error", "daemon error"))
+            return reply
+
+    def submit(self, user: str = "anon", slices: int = 1,
+               priority: int = 0, digest: str = "",
+               elastic: bool = False, payload: dict | None = None,
+               job_id: str | None = None) -> dict:
+        op = {"op": "submit", "user": user, "slices": slices,
+              "priority": priority, "digest": digest, "elastic": elastic,
+              "payload": payload or {}}
+        if job_id:
+            op["job_id"] = job_id
+        return self._op(**op)
+
+    def status(self, job_id: str) -> dict:
+        return self._op(op="status", job_id=job_id)["job"]
+
+    def cancel(self, job_id: str) -> dict:
+        return self._op(op="cancel", job_id=job_id)["job"]
+
+    def list_jobs(self) -> list[dict]:
+        return self._op(op="list")["jobs"]
+
+    def stats(self) -> dict:
+        return self._op(op="stats")["stats"]
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "DaemonClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+# ---------------------------------------------------------------------------
+# Entry point: python -m tony_tpu.cluster.daemon
+# ---------------------------------------------------------------------------
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tony_tpu.cluster.daemon",
+        description="Run the persistent multi-tenant cluster daemon.")
+    parser.add_argument("--home", required=True,
+                        help="daemon home dir (journal, port file)")
+    parser.add_argument("--slices", type=int, default=4,
+                        help="bootstrap pool size (fresh start only; a "
+                             "recovered daemon replays its pool)")
+    parser.add_argument("--bind", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0)
+    parser.add_argument("--history-dir", default=None,
+                        help="emit JOB_* jhist events here for the "
+                             "history server's /cluster dashboard")
+    parser.add_argument("--conf", action="append", default=[],
+                        metavar="KEY=VALUE",
+                        help="tony.daemon.* overrides (repeatable)")
+    args = parser.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s")
+    conf = TonyConfig()
+    for kv in args.conf:
+        key, _, value = kv.partition("=")
+        conf.set(key, value)
+    daemon = ClusterDaemon(args.home, conf=conf, slices=args.slices,
+                           bind_host=args.bind, port=args.port,
+                           history_dir=args.history_dir)
+    daemon.start()
+    print(json.dumps({"port": daemon.port,
+                      "incarnation": daemon.incarnation,
+                      "recovered": daemon.recovered}), flush=True)
+    import signal
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    while not stop.is_set():
+        stop.wait(0.2)
+    daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys_exit = main()
+    raise SystemExit(sys_exit)

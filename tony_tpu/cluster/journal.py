@@ -266,8 +266,11 @@ class Journal:
     job keeps running — it just loses restartability), instead of
     turning a full disk into a job failure."""
 
-    def __init__(self, job_dir: str) -> None:
-        self.path = journal_path(job_dir)
+    def __init__(self, job_dir: str, filename: str = JOURNAL_FILE) -> None:
+        # ``filename`` lets other planes ride the same WAL format — the
+        # cluster daemon keeps its queue/pool/grant log as
+        # ``daemon.journal`` next to (never mixed with) job sessions.
+        self.path = os.path.join(job_dir, filename)
         self._lock = threading.Lock()
         self._f = None
         self._dead = False

@@ -1,0 +1,485 @@
+"""Cluster scheduling policy: a warm slice pool + a gang job queue.
+
+This module is the PURE core of the multi-tenant daemon
+(:mod:`tony_tpu.cluster.daemon`): no threads, no sockets, no clocks of
+its own.  Every method takes ``now`` explicitly, so the same policy
+code runs under the real daemon loop, the virtual-time SimCluster
+harness (:mod:`tony_tpu.cluster.simcluster`), and the bench arm —
+1000-job schedules replay deterministically in milliseconds.
+
+Policy (docs/cluster.md §Scheduling policy):
+
+- **Gang scheduling, all-or-nothing.**  A job asks for N slices and is
+  granted all N atomically or nothing — a partially-grantable job never
+  strands slices it cannot use (``SlicePool.acquire`` is transactional).
+- **Priority, then FIFO.**  The queue orders by descending priority,
+  then submission sequence.  The head of the queue blocks lower
+  entries (head-of-line reservation): freed slices accumulate for the
+  blocked head instead of leaking to smaller jobs behind it, so large
+  gangs cannot starve.  Quota-blocked jobs are the exception — they
+  are skipped, not blocking.
+- **Per-user quota.**  A cap on concurrently *granted* slices per user
+  (0 = unlimited).  Quota is checked at grant time, so queued jobs of
+  an over-quota user simply wait.
+- **Warm-pool affinity.**  A freed slice returns to the pool tagged
+  with the staging digest of its last occupant (PR 4's
+  content-addressed stage).  ``acquire`` prefers digest-matching
+  slices, so a back-to-back job with the same artifacts pays ~0.5s
+  ALREADY_EXISTS warm adoption instead of full bring-up.
+- **Preemption is an induced shrink, never a kill.**  When the blocked
+  head outranks running elastic work, the scheduler asks victims to
+  *shrink* (PR 6 elastic machinery): a checkpoint fence commits, the
+  named slices drain, and only then do they return to the pool.  A
+  victim shrunk to zero is requeued with its fence step as the resume
+  point — zero committed steps are ever lost.
+
+Every grant runs :meth:`ClusterScheduler.check_invariant` — the
+no-slice-double-granted property is asserted on every transition, not
+just in tests.
+"""
+
+from __future__ import annotations
+
+import itertools
+from dataclasses import dataclass, field
+
+# -- job states --------------------------------------------------------------
+QUEUED = "QUEUED"
+RUNNING = "RUNNING"
+PREEMPTING = "PREEMPTING"     # checkpoint fence in flight; slices still held
+COMPLETED = "COMPLETED"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+TERMINAL_STATES = frozenset({COMPLETED, FAILED, CANCELLED})
+
+
+class SchedulerError(RuntimeError):
+    """Request-scoped scheduling failure (queue full, unknown job)."""
+
+
+class QueueFullError(SchedulerError):
+    """Submission rejected: queue is at ``tony.daemon.queue-limit``."""
+
+
+class DoubleGrantError(AssertionError):
+    """A slice was about to be (or found) granted to two jobs at once.
+
+    This is an invariant violation, not an operational error — it means
+    the scheduler's bookkeeping is corrupt, and it is raised eagerly at
+    the offending grant so the SimCluster chaos suite (and production)
+    fail at the cause, not at a downstream symptom.
+    """
+
+
+@dataclass
+class PoolSlice:
+    """One TPU slice owned by the daemon's pool.
+
+    ``digest`` is the staging digest of the last occupant — the warm
+    tag.  ``job_id`` is the current occupant ("" = free).
+    """
+
+    slice_id: str
+    digest: str = ""
+    job_id: str = ""
+    idle_since: float = 0.0
+
+
+class SlicePool:
+    """The daemon's slice inventory with digest-affinity acquisition.
+
+    Not thread-safe by itself — the owning scheduler/daemon serializes
+    access.  ``acquire`` is all-or-nothing: it either marks N slices
+    busy and returns them, or touches nothing and returns ``None``.
+    """
+
+    def __init__(self) -> None:
+        self._slices: dict[str, PoolSlice] = {}
+        #: cumulative digest-matching grants (mirrors
+        #: tony_pool_warm_hits_total)
+        self.warm_hits = 0
+        #: cumulative granted slices that did NOT match the digest
+        self.cold_grants = 0
+
+    # -- inventory ----------------------------------------------------------
+    def add(self, slice_id: str, digest: str = "", now: float = 0.0) -> None:
+        if slice_id in self._slices:
+            raise SchedulerError(f"slice {slice_id!r} already pooled")
+        self._slices[slice_id] = PoolSlice(slice_id, digest=digest,
+                                           idle_since=now)
+
+    def remove(self, slice_id: str) -> PoolSlice:
+        s = self._slices.get(slice_id)
+        if s is None:
+            raise SchedulerError(f"slice {slice_id!r} not pooled")
+        if s.job_id:
+            raise SchedulerError(
+                f"slice {slice_id!r} is granted to {s.job_id!r}; "
+                "cannot remove a busy slice")
+        return self._slices.pop(slice_id)
+
+    def get(self, slice_id: str) -> PoolSlice | None:
+        return self._slices.get(slice_id)
+
+    def slices(self) -> list[PoolSlice]:
+        return list(self._slices.values())
+
+    def size(self) -> int:
+        return len(self._slices)
+
+    def free_count(self) -> int:
+        return sum(1 for s in self._slices.values() if not s.job_id)
+
+    # -- grant / release ----------------------------------------------------
+    def acquire(self, job_id: str, n: int, digest: str = "",
+                now: float = 0.0) -> tuple[list[str], int] | None:
+        """All-or-nothing: mark ``n`` free slices busy for ``job_id``.
+
+        Preference order: digest-matching first (warm), then the
+        longest-idle non-matching slices (so recently-warmed slices
+        stay warm for the jobs that can use them).  Returns
+        ``(slice_ids, warm_hits)`` or ``None`` when fewer than ``n``
+        slices are free (nothing is touched).
+        """
+        if n <= 0:
+            raise SchedulerError(f"job {job_id!r} requested {n} slices")
+        free = [s for s in self._slices.values() if not s.job_id]
+        if len(free) < n:
+            return None
+        free.sort(key=lambda s: (
+            0 if digest and s.digest == digest else 1,   # warm first
+            s.idle_since,                                # then stalest
+            s.slice_id))
+        picked = free[:n]
+        warm = sum(1 for s in picked if digest and s.digest == digest)
+        for s in picked:
+            if s.job_id:                 # cannot happen unless corrupt
+                raise DoubleGrantError(
+                    f"slice {s.slice_id!r} already granted to "
+                    f"{s.job_id!r} while granting {job_id!r}")
+            s.job_id = job_id
+        self.warm_hits += warm
+        self.cold_grants += n - warm
+        return [s.slice_id for s in picked], warm
+
+    def release(self, slice_id: str, digest: str = "",
+                now: float = 0.0) -> None:
+        s = self._slices.get(slice_id)
+        if s is None:
+            raise SchedulerError(f"slice {slice_id!r} not pooled")
+        s.job_id = ""
+        if digest:
+            s.digest = digest
+        s.idle_since = now
+
+    def reap_idle(self, now: float, idle_s: float) -> list[str]:
+        """Remove (and return) free slices idle longer than ``idle_s``.
+
+        The daemon turns these into real teardowns
+        (:meth:`~tony_tpu.backend.tpu.TpuSliceBackend.delete_slice_command`);
+        busy slices are never reaped.
+        """
+        reaped = [s.slice_id for s in self._slices.values()
+                  if not s.job_id and now - s.idle_since >= idle_s]
+        for sid in reaped:
+            del self._slices[sid]
+        return reaped
+
+
+@dataclass
+class Job:
+    """One submitted job as the scheduler sees it.
+
+    ``payload`` is opaque to the policy — the runner (real coordinator
+    launch, or the oracle) interprets it.  ``resume_step`` is the
+    checkpoint fence a preempted job resumes from; the SimCluster pin
+    asserts committed work is never re-done or lost across it.
+    """
+
+    job_id: str
+    user: str
+    slices: int
+    priority: int = 0
+    digest: str = ""
+    elastic: bool = False
+    payload: dict = field(default_factory=dict)
+    # -- scheduler-owned state ----------------------------------------------
+    seq: int = -1
+    submitted_at: float = 0.0
+    enqueued_at: float = 0.0
+    state: str = QUEUED
+    granted: list[str] = field(default_factory=list)
+    pending_release: list[str] = field(default_factory=list)
+    warm_hits: int = 0
+    queue_wait_s: float = 0.0
+    granted_at: float = 0.0
+    finished_at: float = 0.0
+    resume_step: int = 0
+    preemptions: int = 0
+
+    def snapshot(self) -> dict:
+        """JSON-safe status dict (the wire/status/dashboard view)."""
+        return {
+            "job_id": self.job_id, "user": self.user,
+            "slices": self.slices, "priority": self.priority,
+            "digest": self.digest, "elastic": self.elastic,
+            "state": self.state, "granted": list(self.granted),
+            "warm_hits": self.warm_hits,
+            "queue_wait_s": round(self.queue_wait_s, 6),
+            "resume_step": self.resume_step,
+            "preemptions": self.preemptions,
+            "submitted_at": self.submitted_at,
+        }
+
+
+@dataclass
+class Grant:
+    """One gang grant decided by :meth:`ClusterScheduler.tick`."""
+
+    job: Job
+    slice_ids: list[str]
+    warm_hits: int
+    wait_s: float                 # this queued episode's wait
+
+
+@dataclass
+class Shrink:
+    """A preemption request: ``job`` must fence a checkpoint, then
+    drain ``release_ids``.  ``requeue`` means the job shrinks to zero
+    (full preemption) and goes back to the queue with its fence step."""
+
+    job: Job
+    release_ids: list[str]
+    requeue: bool
+
+
+class ClusterScheduler:
+    """Priority+FIFO gang scheduler over a :class:`SlicePool`.
+
+    Drive it with :meth:`submit` / :meth:`tick` / :meth:`complete` /
+    :meth:`preemption_complete`; every mutation is synchronous and
+    deterministic.  The owner provides serialization and clocks.
+    """
+
+    def __init__(self, pool: SlicePool, queue_limit: int = 1000,
+                 user_quota: int = 0) -> None:
+        self.pool = pool
+        self.queue_limit = queue_limit
+        self.user_quota = user_quota
+        self.jobs: dict[str, Job] = {}
+        self._seq = itertools.count()
+        #: cumulative shrink requests issued (mirrors
+        #: tony_sched_preemptions_total)
+        self.preemptions_total = 0
+
+    # -- queries -------------------------------------------------------------
+    def queued_jobs(self) -> list[Job]:
+        q = [j for j in self.jobs.values() if j.state == QUEUED]
+        q.sort(key=lambda j: (-j.priority, j.seq))
+        return q
+
+    def running_jobs(self) -> list[Job]:
+        return [j for j in self.jobs.values()
+                if j.state in (RUNNING, PREEMPTING)]
+
+    def _user_granted(self, user: str) -> int:
+        return sum(len(j.granted) for j in self.jobs.values()
+                   if j.user == user and j.state in (RUNNING, PREEMPTING))
+
+    def stats(self) -> dict:
+        states: dict[str, int] = {}
+        for j in self.jobs.values():
+            states[j.state] = states.get(j.state, 0) + 1
+        return {
+            "queue_depth": states.get(QUEUED, 0),
+            "states": states,
+            "pool_size": self.pool.size(),
+            "pool_free": self.pool.free_count(),
+            "warm_hits": self.pool.warm_hits,
+            "cold_grants": self.pool.cold_grants,
+            "preemptions": self.preemptions_total,
+        }
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, job: Job, now: float) -> int:
+        """Enqueue ``job``; returns its queue position (0-based).
+
+        Raises :class:`QueueFullError` past ``queue_limit`` and
+        :class:`SchedulerError` on duplicate ids or gangs larger than
+        the whole pool (which could never be granted).
+        """
+        if job.job_id in self.jobs:
+            raise SchedulerError(f"duplicate job id {job.job_id!r}")
+        depth = sum(1 for j in self.jobs.values() if j.state == QUEUED)
+        if depth >= self.queue_limit:
+            raise QueueFullError(
+                f"queue is full ({depth}/{self.queue_limit})")
+        if job.slices > self.pool.size():
+            raise SchedulerError(
+                f"job {job.job_id!r} wants {job.slices} slices; pool "
+                f"has {self.pool.size()} total — it would queue forever")
+        if job.seq < 0:
+            job.seq = next(self._seq)
+        job.submitted_at = job.enqueued_at = now
+        job.state = QUEUED
+        self.jobs[job.job_id] = job
+        return self.queued_jobs().index(job)
+
+    def cancel(self, job_id: str) -> Job:
+        """Cancel a QUEUED job.  Running jobs are cancelled through the
+        daemon (which must fence/stop the runner first, then call
+        :meth:`complete` with CANCELLED)."""
+        job = self._job(job_id)
+        if job.state != QUEUED:
+            raise SchedulerError(
+                f"job {job_id!r} is {job.state}, not QUEUED")
+        job.state = CANCELLED
+        return job
+
+    # -- the scheduling pass --------------------------------------------------
+    def tick(self, now: float) -> tuple[list[Grant], list[Shrink]]:
+        """One scheduling pass: grant what fits, shrink what must yield.
+
+        Head-of-line semantics: the first non-quota-blocked queued job
+        that cannot be granted blocks everything behind it.  If running
+        lower-priority elastic work could cover the shortfall, shrink
+        requests are issued (once — a fence already in flight is not
+        re-requested); otherwise the head simply waits for completions.
+        """
+        grants: list[Grant] = []
+        shrinks: list[Shrink] = []
+        for job in self.queued_jobs():
+            if (self.user_quota > 0
+                    and self._user_granted(job.user) + job.slices
+                    > self.user_quota):
+                continue                      # quota-blocked: skip, not block
+            res = self.pool.acquire(job.job_id, job.slices,
+                                    digest=job.digest, now=now)
+            if res is not None:
+                ids, warm = res
+                wait = now - job.enqueued_at
+                job.state = RUNNING
+                job.granted = ids
+                job.warm_hits += warm
+                job.queue_wait_s += wait
+                job.granted_at = now
+                grants.append(Grant(job, ids, warm, wait))
+                self.check_invariant()
+                continue
+            shrinks.extend(self._cover_shortfall(job))
+            break                             # head-of-line reservation
+        return grants, shrinks
+
+    def _cover_shortfall(self, head: Job) -> list[Shrink]:
+        """Pick shrink victims so ``head`` can eventually be granted.
+
+        Victims are RUNNING elastic jobs of strictly lower priority,
+        lowest priority first, youngest first within a priority.  Each
+        victim gives up whole slices; the last victim shrinks partially
+        when that covers the shortfall (it keeps running at its elastic
+        floor of one slice), otherwise it shrinks to zero and requeues
+        from its checkpoint fence.  Fences already in flight count
+        toward the shortfall, so a slow fence is never double-issued.
+        """
+        pending = sum(len(j.pending_release) for j in self.jobs.values()
+                      if j.state == PREEMPTING)
+        needed = head.slices - self.pool.free_count() - pending
+        if needed <= 0:
+            return []                         # enough already draining
+        victims = [j for j in self.jobs.values()
+                   if j.state == RUNNING and j.elastic
+                   and j.priority < head.priority]
+        victims.sort(key=lambda j: (j.priority, -j.seq))
+        available = sum(len(j.granted) for j in victims)
+        if available < needed:
+            return []                         # cannot unblock by preempting
+        shrinks: list[Shrink] = []
+        for v in victims:
+            if needed <= 0:
+                break
+            if needed < len(v.granted):
+                take, requeue = needed, False  # partial: keep elastic floor
+            else:
+                take, requeue = len(v.granted), True
+            release = v.granted[-take:]
+            v.state = PREEMPTING
+            v.pending_release = list(release)
+            v.preemptions += 1
+            self.preemptions_total += 1
+            shrinks.append(Shrink(v, list(release), requeue))
+            needed -= take
+        return shrinks
+
+    # -- transitions reported back by the runner ------------------------------
+    def preemption_complete(self, job_id: str, now: float,
+                            fence_step: int) -> Job:
+        """The victim's checkpoint fence committed and its
+        ``pending_release`` slices drained: return them to the pool
+        (warm-tagged) and either resume the shrunken job or requeue it
+        from ``fence_step``."""
+        job = self._job(job_id)
+        if job.state != PREEMPTING:
+            raise SchedulerError(
+                f"job {job_id!r} is {job.state}, not PREEMPTING")
+        released = job.pending_release
+        job.pending_release = []
+        for sid in released:
+            job.granted.remove(sid)
+            self.pool.release(sid, digest=job.digest, now=now)
+        job.resume_step = max(job.resume_step, fence_step)
+        if job.granted:
+            job.state = RUNNING               # partial shrink: keeps running
+        else:
+            job.state = QUEUED                # full preemption: requeue
+            job.enqueued_at = now
+        return job
+
+    def complete(self, job_id: str, now: float,
+                 status: str = COMPLETED) -> Job:
+        """Terminal transition: release every held slice warm-tagged."""
+        if status not in TERMINAL_STATES:
+            raise SchedulerError(f"not a terminal status: {status!r}")
+        job = self._job(job_id)
+        if job.state in TERMINAL_STATES:
+            raise SchedulerError(f"job {job_id!r} already {job.state}")
+        for sid in job.granted:
+            self.pool.release(sid, digest=job.digest, now=now)
+        job.granted = []
+        job.pending_release = []
+        job.state = status
+        job.finished_at = now
+        return job
+
+    # -- invariants -----------------------------------------------------------
+    def check_invariant(self) -> None:
+        """No slice is ever granted to two jobs; pool and job views
+        agree.  Raises :class:`DoubleGrantError` — called at every
+        grant and freely callable from tests/chaos harnesses."""
+        owners: dict[str, str] = {}
+        for job in self.jobs.values():
+            if job.state in TERMINAL_STATES:
+                continue
+            for sid in job.granted:
+                prev = owners.get(sid)
+                if prev is not None:
+                    raise DoubleGrantError(
+                        f"slice {sid!r} granted to both {prev!r} and "
+                        f"{job.job_id!r}")
+                owners[sid] = job.job_id
+        for s in self.pool.slices():
+            want = owners.pop(s.slice_id, "")
+            if s.job_id != want:
+                raise DoubleGrantError(
+                    f"slice {s.slice_id!r}: pool says occupant "
+                    f"{s.job_id!r}, jobs say {want!r}")
+        if owners:
+            sid, jid = next(iter(owners.items()))
+            raise DoubleGrantError(
+                f"job {jid!r} holds slice {sid!r} that is not pooled")
+
+    def _job(self, job_id: str) -> Job:
+        job = self.jobs.get(job_id)
+        if job is None:
+            raise SchedulerError(f"unknown job {job_id!r}")
+        return job

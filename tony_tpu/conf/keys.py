@@ -66,6 +66,24 @@ COORDINATOR_REATTACH_TIMEOUT_KEY = "tony.coordinator.reattach-timeout-ms"
 COORDINATOR_JOURNAL_ENABLED_KEY = "tony.coordinator.journal-enabled"
 
 # ---------------------------------------------------------------------------
+# Cluster-daemon keys ("tony.daemon.*") — the persistent multi-tenant
+# scheduler (docs/cluster.md). The daemon owns a pool of slices and a
+# job queue; these bound the queue, fence preemptions, and reap idle
+# warm slices.
+# ---------------------------------------------------------------------------
+# Max QUEUED jobs; submissions past this are rejected at the wire.
+DAEMON_QUEUE_LIMIT_KEY = "tony.daemon.queue-limit"
+# Max concurrently GRANTED slices per user (gang counted at grant
+# time). 0 = unlimited.
+DAEMON_USER_QUOTA_KEY = "tony.daemon.user-quota"
+# Checkpoint-fence grace for an induced shrink: the victim gets this
+# long to commit its fence before the slices are drained.
+DAEMON_PREEMPTION_GRACE_MS_KEY = "tony.daemon.preemption-grace-ms"
+# A free slice idle longer than this is reaped (real teardown) instead
+# of staying warm. 0 = never reap.
+DAEMON_POOL_IDLE_REAP_MS_KEY = "tony.daemon.pool-idle-reap-ms"
+
+# ---------------------------------------------------------------------------
 # Task keys ("tony.task.*")
 # ---------------------------------------------------------------------------
 TASK_EXECUTOR_PYTHON_OPTS_KEY = "tony.task.executor.python-opts"  # jvm-opts analog
@@ -327,6 +345,10 @@ DEFAULTS: dict[str, str] = {
     AM_GPUS_KEY: "0",
     COORDINATOR_REATTACH_TIMEOUT_KEY: "30000",
     COORDINATOR_JOURNAL_ENABLED_KEY: "true",
+    DAEMON_QUEUE_LIMIT_KEY: "1000",
+    DAEMON_USER_QUOTA_KEY: "0",
+    DAEMON_PREEMPTION_GRACE_MS_KEY: "5000",
+    DAEMON_POOL_IDLE_REAP_MS_KEY: "300000",
     TASK_EXECUTOR_PYTHON_OPTS_KEY: "",
     TASK_HEARTBEAT_INTERVAL_KEY: "1000",
     TASK_MAX_MISSED_HEARTBEATS_KEY: "25",
@@ -407,7 +429,7 @@ NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "launch", "elastic", "metrics", "pipeline",
                                 "channel", "trace", "router", "fleet",
                                 "coordinator", "weights", "goodput",
-                                "straggler"})
+                                "straggler", "daemon"})
 
 
 def instances_key(job_type: str) -> str:

@@ -91,6 +91,23 @@ STRAGGLER_SUSPECTED = "STRAGGLER_SUSPECTED"
 # window is enough to clear; flapping shows up as SUSPECTED/CLEARED
 # pairs). Payload {"task", "session_id"}.
 STRAGGLER_CLEARED = "STRAGGLER_CLEARED"
+# Cluster-daemon lifecycle (one jhist per daemon incarnation; the
+# history server's /cluster dashboard is replayed from these alone).
+# A job entered the daemon's queue. Payload {"job_id", "user",
+# "priority", "slices", "digest"}.
+JOB_QUEUED = "JOB_QUEUED"
+# A gang grant: all slices at once. Payload {"job_id", "slice_ids",
+# "warm_hits", "queue_wait_s"} — warm_hits counts digest-matching
+# slices (warm adoption), queue_wait_s this queued episode's wait.
+JOB_GRANTED = "JOB_GRANTED"
+# A victim's checkpoint fence committed and its slices drained back to
+# the pool. Payload {"job_id", "fence_step", "released", "requeued"} —
+# requeued=True means a shrink to zero (the job re-enters the queue
+# resuming from fence_step).
+JOB_PREEMPTED = "JOB_PREEMPTED"
+# Terminal transition of a daemon-scheduled job. Payload {"job_id",
+# "status", "queue_wait_s", "warm_hits", "preemptions"}.
+JOB_COMPLETED = "JOB_COMPLETED"
 
 
 @dataclass

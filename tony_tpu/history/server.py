@@ -531,7 +531,89 @@ class HistoryServer:
                 "<th>Uptime</th><th></th>"
                 "</tr>" + "".join(rows) + "</table>") if rows else \
             "<p>No jobs found.</p>"
+        body = "<p><a href='/cluster'>cluster dashboard</a></p>" + body
         return _PAGE.format(title="TonY-TPU job history", body=body)
+
+    # -- cluster dashboard ---------------------------------------------------
+    _CLUSTER_EVENTS = (ev.JOB_QUEUED, ev.JOB_GRANTED, ev.JOB_PREEMPTED,
+                       ev.JOB_COMPLETED)
+
+    def cluster_state(self) -> dict:
+        """Fold every cluster-daemon incarnation's jhist into one view of
+        the daemon's lifetime: queued/running/completed jobs with
+        per-job queue wait, warm/cold bring-up, and preemption counts.
+        Replayable from jhist alone — the daemon itself may be gone."""
+        daemons = [j for j in self.list_jobs()
+                   if j["app_id"].startswith("cluster-daemon")]
+        merged: list[ev.Event] = []
+        for d in daemons:
+            for e in (self.job_events(d["app_id"]) or []):
+                if e.event_type in self._CLUSTER_EVENTS:
+                    merged.append(e)
+        merged.sort(key=lambda e: e.timestamp)
+        jobs: dict[str, dict] = {}
+        for e in merged:
+            p = e.payload
+            jid = str(p.get("job_id", ""))
+            job = jobs.setdefault(jid, {
+                "job_id": jid, "user": "", "priority": 0, "slices": 0,
+                "state": "QUEUED", "queue_wait_s": 0.0, "warm": False,
+                "warm_hits": 0, "preemptions": 0,
+                "queued_ms": e.timestamp, "finished_ms": None})
+            if e.event_type == ev.JOB_QUEUED:
+                job.update(user=str(p.get("user", "")),
+                           priority=int(p.get("priority", 0)),
+                           slices=int(p.get("slices", 0)),
+                           queued_ms=e.timestamp)
+            elif e.event_type == ev.JOB_GRANTED:
+                granted = p.get("slice_ids") or []
+                warm_hits = int(p.get("warm_hits", 0))
+                job["state"] = "RUNNING"
+                job["queue_wait_s"] = round(
+                    job["queue_wait_s"] + float(p.get("queue_wait_s", 0.0)),
+                    6)
+                job["warm_hits"] += warm_hits
+                job["warm"] = bool(granted) and warm_hits == len(granted)
+            elif e.event_type == ev.JOB_PREEMPTED:
+                job["preemptions"] += 1
+                if p.get("requeued"):
+                    job["state"] = "QUEUED"
+            elif e.event_type == ev.JOB_COMPLETED:
+                job["state"] = str(p.get("status", "COMPLETED"))
+                job["finished_ms"] = e.timestamp
+        ordered = sorted(jobs.values(), key=lambda j: j["queued_ms"])
+        states: dict[str, int] = {}
+        for j in ordered:
+            states[j["state"]] = states.get(j["state"], 0) + 1
+        return {"daemons": [{"app_id": d["app_id"],
+                             "status": d["status"]} for d in daemons],
+                "states": states, "jobs": ordered}
+
+    def _render_cluster(self) -> str:
+        state = self.cluster_state()
+        counts = " · ".join(f"{k}: {v}"
+                            for k, v in sorted(state["states"].items()))
+        rows = []
+        for j in state["jobs"]:
+            rows.append(
+                f"<tr><td>{html.escape(j['job_id'])}</td>"
+                f"<td>{html.escape(j['user'])}</td>"
+                f"<td>{j['priority']}</td><td>{j['slices']}</td>"
+                f"<td class='{html.escape(j['state'])}'>"
+                f"{html.escape(j['state'])}</td>"
+                f"<td>{j['queue_wait_s']:.3f}s</td>"
+                f"<td>{'warm' if j['warm'] else 'cold'}</td>"
+                f"<td>{j['preemptions']}</td></tr>")
+        body = f"<p>{html.escape(counts) or 'No cluster jobs.'}</p>"
+        if rows:
+            body += ("<table><tr><th>Job</th><th>User</th><th>Priority"
+                     "</th><th>Slices</th><th>State</th><th>Queue wait"
+                     "</th><th>Bring-up</th><th>Preemptions</th></tr>"
+                     + "".join(rows) + "</table>")
+        body += ("<p><a href='/api/cluster'>JSON</a> · "
+                 f"{len(state['daemons'])} daemon incarnation(s)</p>")
+        return _PAGE.format(title="Cluster — jobs across the daemon's "
+                                  "lifetime", body=body)
 
     def _render_events(self, app_id: str) -> str | None:
         events = self.job_events(app_id)
@@ -602,7 +684,7 @@ class HistoryServer:
         "step": "#2e7d32", "data_wait": "#ef6c00", "checkpoint": "#1565c0",
         "eval": "#6a1b9a", "provision": "#9e9d24", "stage": "#00838f",
         "compile": "#c62828", "resync": "#ad1457", "recovery": "#4e342e",
-        "idle": "#bdbdbd", "overhead": "#757575",
+        "idle": "#bdbdbd", "queue_wait": "#f9a825", "overhead": "#757575",
     }
 
     @classmethod
@@ -787,6 +869,10 @@ class HistoryServer:
             def _route(self, path: str) -> None:
                 if path == "/":
                     self._send(200, server._render_index(), "text/html")
+                elif path == "/cluster":
+                    self._send(200, server._render_cluster(), "text/html")
+                elif path == "/api/cluster":
+                    self._json(server.cluster_state())
                 elif path.startswith("/jobs/"):
                     page = server._render_events(path[len("/jobs/"):])
                     self._not_found() if page is None else \

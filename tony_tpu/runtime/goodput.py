@@ -58,6 +58,7 @@ CATEGORIES: Tuple[str, ...] = (
     "resync",      # elastic reconfiguration (shrink/regrow re-registration)
     "recovery",    # crash-recovery walls (coordinator/executor restart)
     "idle",        # intentionally idle (serve engine waiting for work)
+    "queue_wait",  # waiting in the cluster daemon's queue for a grant
     "overhead",    # everything unclaimed
 )
 
