@@ -332,6 +332,14 @@ def main() -> None:
     # latency, not stream length.
     out.update(_fleet_arm())
 
+    # SLO-tiered serving: identical 2x-overload open-loop mixed
+    # workload with and without QoS classes; interactive p99 TTFT
+    # holds under priority admission + batch-row preemption while the
+    # classless FIFO baseline blows through it (ratio >= 2
+    # tier-1-pinned) and every preemption eviction resumes
+    # token-identically (gap == 0 tier-1-pinned, tests/test_qos.py).
+    out.update(_qos_arm())
+
     # warm scale-up: content-addressed weights shipped peer-to-peer
     # over the channel plane vs cold storage load + retrace, plus the
     # 8-replica rolling upgrade as one seed load + O(log N) fan-out vs
@@ -1500,6 +1508,101 @@ def _fleet_arm(n_replicas: int = 4, n_streams: int = 8,
         # dup/drop token count across every migrated session vs the
         # oracle (== 0 tier-1-pinned)
         "serving_migration_token_gap": gap,
+    }
+
+
+def _qos_arm(n_replicas: int = 1, slots: int = 4, itl_s: float = 0.004,
+             ttft_s: float = 0.01, max_new: int = 16, n_req: int = 36,
+             one_way_s: float = 0.0) -> dict:
+    """SLO-tiered serving under 2x overload, on the simulated fleet.
+
+    An open-loop mixed workload (1 interactive : 1 standard : 2 batch)
+    arrives at twice the fleet's service rate — open-loop, so the
+    backlog genuinely builds instead of the clients self-throttling.
+    Run once with classes (interactive admissions jump the queue and
+    preempt decoding batch rows) and once classless (identical
+    arrivals, FIFO service): the interactive p99 TTFT ratio between
+    the two runs is the tentpole number,
+    ``serving_qos_interactive_ttft_p99_vs_classless`` (>= 2
+    tier-1-pinned, tests/test_qos.py). Every preempted batch row is
+    evicted-to-queue and later resumes via rng-offset re-prefill, so
+    comparing every completed stream against the ``sim_token`` oracle
+    makes ``serving_qos_preempt_token_gap`` an exact dup/drop count
+    (== 0 tier-1-pinned). TTFT p99s come from
+    ``histogram_quantile`` over fine-bucket local histograms — the
+    same estimator the dashboards use. ``one_way_s`` (the @slow
+    variant) pushes the whole workload through a LatencyProxy WAN
+    hop: priority is a queue-order property, so the ratio must
+    survive transport latency."""
+    from tony_tpu.runtime.metrics import (MetricsRegistry,
+                                          histogram_quantile)
+    from tony_tpu.serving.netem import LatencyProxy
+    from tony_tpu.serving.simfleet import (SimFleet, open_loop_load,
+                                           sim_token)
+
+    # 2x overload: one arrival every half mean per-request service time
+    interval_s = (itl_s * max_new) / (slots * n_replicas) / 2.0
+    mix = [("interactive", "standard", "batch", "batch")[i % 4]
+           for i in range(n_req)]
+
+    def run(classes):
+        fleet = SimFleet(n_replicas, itl_s=itl_s, ttft_s=ttft_s,
+                         slots=slots, max_queue_depth=10 * n_req,
+                         registry=MetricsRegistry())
+        proxy = None
+        try:
+            port = fleet.start()
+            if one_way_s > 0:
+                proxy = LatencyProxy("127.0.0.1", port, one_way_s)
+                port = proxy.start()
+            recs = open_loop_load(port, classes, interval_s=interval_s,
+                                  max_new=max_new)
+            preempts = sum(r.preemptions
+                           for r in fleet.replicas.values())
+        finally:
+            if proxy is not None:
+                proxy.stop()
+            fleet.stop()
+        return recs, preempts
+
+    classed, preempts = run(mix)
+    classless, _ = run([""] * n_req)
+
+    def p99(recs, idxs):
+        reg = MetricsRegistry()
+        hist = reg.histogram(
+            "tony_bench_qos_ttft_seconds",
+            help="client-side TTFT samples for the qos arm",
+            buckets=tuple(0.002 * i for i in range(1, 400)))
+        for i in idxs:
+            if recs[i]["ttft_s"] is not None:
+                hist.observe(recs[i]["ttft_s"])
+        return histogram_quantile(hist, 0.99)
+
+    inter_idx = [i for i, c in enumerate(mix) if c == "interactive"]
+    classed_p99 = p99(classed, inter_idx)
+    # the SAME arrival positions in the classless run: any difference
+    # is the scheduling discipline, not the arrival pattern
+    classless_p99 = p99(classless, inter_idx)
+    gap = 0
+    for i, r in enumerate(classed):
+        if r["shed"]:
+            continue
+        want = [sim_token(1000 + i, p) for p in range(max_new)]
+        gap += abs(len(r["tokens"]) - max_new)
+        gap += sum(1 for a, b in zip(r["tokens"], want) if a != b)
+    return {
+        "serving_qos_requests": n_req,
+        "serving_qos_preemptions": preempts,
+        "serving_qos_interactive_ttft_p99_s": round(classed_p99, 4),
+        "serving_qos_classless_ttft_p99_s": round(classless_p99, 4),
+        # classed interactive p99 holds under 2x overload while the
+        # classless baseline blows through it (>= 2 tier-1-pinned)
+        "serving_qos_interactive_ttft_p99_vs_classless": round(
+            classless_p99 / max(classed_p99, 1e-9), 2),
+        # dup/drop token count across every preemption eviction vs the
+        # oracle (== 0 tier-1-pinned)
+        "serving_qos_preempt_token_gap": gap,
     }
 
 

@@ -324,7 +324,7 @@ def _run_client(args) -> int:
     import threading
 
     from tony_tpu.models import transformer as T
-    from tony_tpu.serving.client import StreamingClient
+    from tony_tpu.serving.client import ServerBusy, StreamingClient
 
     host, port = _parse_addr(args.connect)
     if args.drain:
@@ -349,29 +349,52 @@ def _run_client(args) -> int:
     budgets = [int(b) for b in
                rs.randint(max(1, args.max_new_tokens // 4),
                           args.max_new_tokens + 1, size=args.requests)]
+    # QoS classes: one class for every request (--request_class), or
+    # the mixed-class mode — a deterministic interactive/standard/batch
+    # rotation that exercises replica-side priority, preemption, and
+    # shedding, reported per class
+    classes = None
+    if args.mixed_classes:
+        cyc = ("interactive", "standard", "batch")
+        classes = [cyc[i % len(cyc)] for i in range(args.requests)]
+    elif args.request_class:
+        classes = [args.request_class] * args.requests
     outs: list = [None] * args.requests
     ttfts: list = [0.0] * args.requests
-    gaps: list[float] = []
+    gaps: list = [[] for _ in range(args.requests)]
+    shed: list = [False] * args.requests
 
     with StreamingClient(host, port) as client:
         print(f"connected to {host}:{port}: {client.hello}")
 
         def drain(i, rid, t_submit):
             toks, last = [], None
-            for delta in client.deltas(rid):
-                now = time.perf_counter()
-                if last is None:
-                    ttfts[i] = now - t_submit
-                else:
-                    gaps.append((now - last) / len(delta))
-                last = now
-                toks.extend(delta)
+            try:
+                for delta in client.deltas(rid):
+                    now = time.perf_counter()
+                    if last is None:
+                        ttfts[i] = now - t_submit
+                    else:
+                        gaps[i].append((now - last) / len(delta))
+                    last = now
+                    toks.extend(delta)
+            except ServerBusy as e:
+                # the fleet shed this request even after the client's
+                # retry budget — overload said no, and that IS the
+                # answer (report it, don't crash the workload)
+                shed[i] = True
+                print(f"request {i} shed (retry after "
+                      f"{e.retry_after_ms}ms)", flush=True)
+                return
             outs[i] = toks
 
         t0 = time.perf_counter()
         threads = []
         for i, p in enumerate(prompts):
-            rid = client.submit(p, budgets[i])
+            rid = client.submit(
+                p, budgets[i],
+                request_class=classes[i] if classes else None,
+                retries=args.busy_retries)
             th = threading.Thread(target=drain,
                                   args=(i, rid, time.perf_counter()))
             th.start()
@@ -381,13 +404,32 @@ def _run_client(args) -> int:
         dt = time.perf_counter() - t0
 
     useful = sum(len(o) for o in outs if o)
-    ttfts_s = sorted(ttfts)
     print(f"streamed {args.requests} requests ({useful} tokens) in "
           f"{dt:.2f}s — {useful / max(dt, 1e-9):.1f} tok/s")
-    print(f"ttft: p50 {ttfts_s[len(ttfts_s) // 2] * 1e3:.0f} ms  "
-          f"max {ttfts_s[-1] * 1e3:.0f} ms;  inter-token mean "
-          f"{(sum(gaps) / len(gaps) * 1e3) if gaps else 0.0:.1f} ms")
-    print("first request tokens:", (outs[0] or [])[:12])
+
+    def _report(label, idx):
+        tt = sorted(ttfts[i] for i in idx if outs[i] is not None)
+        gp = [g for i in idx for g in gaps[i]]
+        n_shed = sum(1 for i in idx if shed[i])
+        if not tt:
+            print(f"{label}: no completed requests"
+                  + (f" ({n_shed} shed)" if n_shed else ""))
+            return
+        line = (f"{label}: ttft p50 {tt[len(tt) // 2] * 1e3:.0f} ms  "
+                f"max {tt[-1] * 1e3:.0f} ms;  inter-token mean "
+                f"{(sum(gp) / len(gp) * 1e3) if gp else 0.0:.1f} ms")
+        if n_shed:
+            line += f"  ({n_shed} shed)"
+        print(line)
+
+    _report("ttft", range(args.requests))
+    if classes:
+        for c in ("interactive", "standard", "batch"):
+            idx = [i for i in range(args.requests) if classes[i] == c]
+            if idx:
+                _report(f"  {c} ({len(idx)} reqs)", idx)
+    first = next((o for o in outs if o), [])
+    print("first request tokens:", first[:12])
     return 0
 
 
@@ -487,6 +529,21 @@ def main() -> int:
                              "drain-by-drain rolling upgrades "
                              "session-transparent (docs/serving.md "
                              "§Operating the fleet)")
+    parser.add_argument("--request_class", default="",
+                        choices=("", "interactive", "standard", "batch"),
+                        help="with --connect: submit every request at "
+                             "this QoS tier (empty = classless wire — "
+                             "servers default it to standard)")
+    parser.add_argument("--mixed_classes", action="store_true",
+                        help="with --connect: rotate requests through "
+                             "interactive/standard/batch and report "
+                             "TTFT/ITL per class (the QoS demo "
+                             "workload)")
+    parser.add_argument("--busy_retries", type=int, default=0,
+                        help="with --connect: transparent re-admissions "
+                             "per request when the fleet sheds it with "
+                             "BUSY (capped jittered backoff on the "
+                             "server's hint)")
     parser.add_argument("--drain", default="", metavar="HOST:PORT",
                         help="with --connect to a ROUTER: fence this "
                              "replica and live-migrate every session "
@@ -506,6 +563,12 @@ def main() -> int:
                      "--shared_prefix_file")
     if args.drain and not args.connect:
         parser.error("--drain requires --connect (a router address)")
+    if (args.mixed_classes or args.request_class) and not args.connect:
+        parser.error("--request_class/--mixed_classes require "
+                     "--connect (they shape CLIENT traffic)")
+    if args.mixed_classes and args.request_class:
+        parser.error("--mixed_classes and --request_class are "
+                     "mutually exclusive")
 
     if args.connect:
         return _run_client(args)

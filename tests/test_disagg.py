@@ -841,8 +841,8 @@ class TestDisaggCancel:
         port = dec.start()
         real_submit = dec.engine.submit_prefilled
 
-        def racing_submit(rid, pkg, budget, trace_ctx=None):
-            real_submit(rid, pkg, budget, trace_ctx=trace_ctx)
+        def racing_submit(rid, pkg, budget, trace_ctx=None, **kw):
+            real_submit(rid, pkg, budget, trace_ctx=trace_ctx, **kw)
             # the CANCEL handler runs here "mid-submit": tombstone set,
             # its engine.cancel no-oped (rid not yet visible to it)
             with dec._lock:

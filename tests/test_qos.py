@@ -1,0 +1,862 @@
+"""SLO-tiered serving: QoS classes end to end. Protocol robustness
+(class parsing, BUSY wire round trip, classless-means-standard), the
+engine's per-class admission + batch-row preemption with token-identical
+resume (greedy AND sampled, colocated AND through the prefill/decode
+split), client BUSY retry, router-level batch re-queue, the
+interactive-pressure autoscale signal, configurable latency buckets,
+and the 2x-overload bench-arm pins.
+
+Compile frugality: the jax tests reuse test_serving's / test_disagg's
+exact (batch, max_len, chunk) shapes, so this module warms the same
+compiled programs those later modules reuse.
+"""
+
+import os
+import queue as queue_mod
+import socket
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tony_tpu.conf import keys as K
+from tony_tpu.conf.config import TonyConfig
+from tony_tpu.models import transformer as T
+from tony_tpu.models.decode import generate
+from tony_tpu.models.serve import ContinuousBatcher, EngineBusy, ServeEngine
+from tony_tpu.runtime import metrics as M
+from tony_tpu.serving import kvship
+from tony_tpu.serving import protocol as P
+from tony_tpu.serving.client import ServerBusy, StreamingClient
+from tony_tpu.serving.disagg import DecodeServer, PrefillServer
+from tony_tpu.serving.fleet import CapacityProvider, FleetController
+from tony_tpu.serving.router import ServingRouter
+from tony_tpu.serving.server import ServingServer
+from tony_tpu.serving.simfleet import SimFleet, sim_token
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)          # for `import bench` (repo-root script)
+
+CFG = T.PRESETS["tiny"].scaled(dtype=jnp.float32, remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return T.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _reference(params, prompt, max_new):
+    out = generate(params, jnp.asarray(prompt, jnp.int32)[None], CFG,
+                   max_new_tokens=max_new, rng=jax.random.PRNGKey(0),
+                   temperature=0.0)
+    return [int(t) for t in np.asarray(out.tokens[0, len(prompt):])]
+
+
+def _prompts(seed, sizes):
+    rng = np.random.RandomState(seed)
+    return [[int(t) for t in rng.randint(0, CFG.vocab_size, size=n)]
+            for n in sizes]
+
+
+class _SlowFetch(ContinuousBatcher):
+    """Keeps streams genuinely mid-flight so admissions land on a full
+    engine (the preemption / shed paths)."""
+
+    def _fetch(self, handle):
+        time.sleep(0.05)
+        return super()._fetch(handle)
+
+
+# ---------------------------------------------------------------------------
+# protocol: class parsing, BUSY frame, kv-meta class field
+# ---------------------------------------------------------------------------
+class TestClassProtocol:
+    def test_parse_class_absent_means_standard(self):
+        assert P.parse_class({}) == "standard"
+        assert P.parse_class({"prompt": [1]}) == "standard"
+
+    def test_parse_class_accepts_every_tier(self):
+        for c in P.QOS_CLASSES:
+            assert P.parse_class({"class": c}) == c
+
+    def test_parse_class_rejects_unknown_and_nonstring(self):
+        with pytest.raises(ValueError, match="request class"):
+            P.parse_class({"class": "gold"})
+        with pytest.raises(ValueError, match="request class"):
+            P.parse_class({"class": 3})
+
+    def test_busy_frame_named_and_round_trips(self):
+        assert P.FRAME_NAMES[P.BUSY] == "BUSY"
+        a, b = socket.socketpair()
+        try:
+            P.send_frame(a, P.BUSY, 7,
+                         P.pack_json({"retry_after_ms": 250}))
+            ftype, rid, payload = P.recv_frame(b)
+            assert (ftype, rid) == (P.BUSY, 7)
+            assert P.unpack_json(payload)["retry_after_ms"] == 250
+        finally:
+            a.close()
+            b.close()
+
+    def test_kv_meta_class_round_trip(self):
+        key = np.zeros((2,), np.uint32)
+        meta = kvship.parse_kv_meta(kvship.pack_kv_meta(
+            5, 8, 3, key, cls="interactive"))
+        assert meta["class"] == "interactive"
+        # default class is omitted from the wire (old peers see the
+        # old meta), and the parse side normalizes it back in
+        packed = kvship.pack_kv_meta(5, 8, 3, key)
+        assert "class" not in packed
+        assert kvship.parse_kv_meta(packed)["class"] == "standard"
+
+    def test_kv_meta_malformed_class_rejected(self):
+        key = np.zeros((2,), np.uint32)
+        packed = kvship.pack_kv_meta(5, 8, 3, key, cls="interactive")
+        packed["class"] = "platinum"
+        with pytest.raises(P.ProtocolError, match="class"):
+            kvship.parse_kv_meta(packed)
+
+
+# ---------------------------------------------------------------------------
+# configurable latency buckets (tony.metrics.latency-buckets)
+# ---------------------------------------------------------------------------
+class TestLatencyBuckets:
+    def test_blank_spec_is_the_builtin_ladder(self):
+        assert M.parse_latency_buckets("") == M.TIME_BUCKETS_S
+        assert M.parse_latency_buckets("  ") == M.TIME_BUCKETS_S
+
+    def test_custom_ladder_parses_and_wires_into_histograms(self):
+        bounds = M.parse_latency_buckets("0.01, 0.05, 0.25, 1.0")
+        assert bounds == (0.01, 0.05, 0.25, 1.0)
+        reg = M.MetricsRegistry()
+        h = reg.histogram("tony_test_qos_ladder", buckets=bounds)
+        assert tuple(h.buckets) == bounds
+
+    @pytest.mark.parametrize("spec", ["abc", "0.1,xyz", "0.5,0.25",
+                                      "0.1,0.1", "-1,2", "0,1", "inf"])
+    def test_malformed_specs_refused(self, spec):
+        with pytest.raises(ValueError):
+            M.parse_latency_buckets(spec)
+
+    def test_bad_ladder_refused_at_config_load(self):
+        with pytest.raises(ValueError, match="increasing"):
+            TonyConfig.load(cli_overrides={
+                K.METRICS_LATENCY_BUCKETS_KEY: "0.5,0.1"})
+        conf = TonyConfig.load(cli_overrides={
+            K.METRICS_LATENCY_BUCKETS_KEY: "0.1,0.5"})
+        assert conf.get_latency_buckets() == (0.1, 0.5)
+
+    def test_default_config_keeps_old_bounds(self):
+        assert TonyConfig.load().get_latency_buckets() == M.TIME_BUCKETS_S
+
+
+# ---------------------------------------------------------------------------
+# engine: floors, shed, preemption with token-identical resume
+# ---------------------------------------------------------------------------
+class _Harness:
+    """ServeEngine on a background thread with recorded deltas and
+    retirement reasons (the final eos/budget delta arrives via
+    on_retired — the atomic-final contract)."""
+
+    def __init__(self, batcher, registry=None, **engine_kw):
+        self.got: dict = {}
+        self.retired: dict = {}
+
+        def on_retired(rid, reason, n, final):
+            self.got.setdefault(rid, []).extend(final)
+            self.retired[rid] = (reason, n)
+
+        self.engine = ServeEngine(
+            batcher,
+            on_delta=lambda rid, t: self.got.setdefault(rid, []).extend(t),
+            on_retired=on_retired, registry=registry, **engine_kw)
+        self.thread = threading.Thread(target=self.engine.run,
+                                       daemon=True)
+        self.thread.start()
+
+    def wait_first_tokens(self, rids, timeout=60):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if all(self.got.get(r) for r in rids):
+                return
+            time.sleep(0.005)
+        raise AssertionError(f"streams never started: "
+                             f"{ {r: self.got.get(r) for r in rids} }")
+
+    def finish(self, timeout=120):
+        self.engine.drain()
+        self.thread.join(timeout=timeout)
+        assert not self.thread.is_alive(), "engine did not drain"
+
+
+class TestEngineQoS:
+    def test_floor_and_class_validation(self, params):
+        b = ContinuousBatcher(params, CFG, batch=2, max_len=32, chunk=3)
+        with pytest.raises(ValueError, match="exceed"):
+            ServeEngine(b, class_floors={"interactive": 2, "batch": 1})
+        with pytest.raises(ValueError, match="unknown QoS class"):
+            ServeEngine(b, class_floors={"gold": 1})
+        eng = ServeEngine(b)
+        with pytest.raises(ValueError, match="unknown request class"):
+            eng.submit(1, [1, 2], 4, request_class="gold")
+        assert eng.stats()["class_floors"] == {
+            c: 0 for c in P.QOS_CLASSES}
+
+    def test_shed_past_queue_depth_interactive_exempt(self, params):
+        """Past the bounded queue, standard/batch submits are refused
+        with EngineBusy carrying the retry hint; interactive always
+        queues (and preempts its way in). Everything that was accepted
+        still finishes token-identically."""
+        reg = M.MetricsRegistry()
+        h = _Harness(_SlowFetch(params, CFG, batch=2, max_len=32,
+                                chunk=3),
+                     registry=reg, max_queue_depth=1, busy_retry_ms=123)
+        prompts = _prompts(40, (4, 5, 4, 6))
+        # long enough that neither slot-holder retires while the shed
+        # probes and the interactive admission land
+        budget = 20
+        try:
+            # stagger the fill: with depth 1, a submit racing the
+            # loop's admission of the previous one would shed
+            h.engine.submit(0, prompts[0], budget, request_class="batch")
+            h.wait_first_tokens([0])
+            h.engine.submit(1, prompts[1], budget, request_class="batch")
+            h.wait_first_tokens([1])      # both slots held, queue empty
+            h.engine.submit(2, prompts[2], budget, request_class="batch")
+            with pytest.raises(EngineBusy) as ei:
+                h.engine.submit(9, prompts[3], budget,
+                                request_class="batch")
+            assert ei.value.retry_after_ms == 123
+            with pytest.raises(EngineBusy):
+                h.engine.submit(9, prompts[3], budget,
+                                request_class="standard")
+            # interactive is exempt: it queues, then preempts a row
+            h.engine.submit(3, prompts[3], 6,
+                            request_class="interactive")
+        finally:
+            h.finish()
+        for rid, prompt in ((0, prompts[0]), (1, prompts[1]),
+                            (2, prompts[2])):
+            assert h.got[rid] == _reference(params, prompt, budget), rid
+        assert h.got[3] == _reference(params, prompts[3], 6)
+        shed = {c: reg.counter("tony_serve_shed_total",
+                               **{"class": c}).value
+                for c in P.QOS_CLASSES}
+        assert shed == {"interactive": 0, "standard": 1, "batch": 1}
+        assert reg.counter("tony_serve_preemptions_total").value >= 1
+
+    def test_preempt_resume_token_identity_greedy(self, params):
+        """An interactive admission evicts a decoding batch row; the
+        evicted stream is reincarnated via rng-offset re-prefill and
+        must finish with EXACTLY the uninterrupted reference tokens —
+        no terminal 'preempted' ever reaches the caller colocated."""
+        reg = M.MetricsRegistry()
+        h = _Harness(_SlowFetch(params, CFG, batch=2, max_len=32,
+                                chunk=3), registry=reg)
+        prompts = _prompts(41, (5, 4, 6))
+        try:
+            h.engine.submit(0, prompts[0], 12, request_class="batch")
+            h.engine.submit(1, prompts[1], 12, request_class="batch")
+            h.wait_first_tokens([0, 1])
+            h.engine.submit(2, prompts[2], 6,
+                            request_class="interactive")
+        finally:
+            h.finish()
+        assert reg.counter("tony_serve_preemptions_total").value == 1
+        assert h.got[0] == _reference(params, prompts[0], 12)
+        assert h.got[1] == _reference(params, prompts[1], 12)
+        assert h.got[2] == _reference(params, prompts[2], 6)
+        assert {r for r, _ in h.retired.values()} == {"budget"}
+
+    def test_preempt_resume_token_identity_sampled(self, params):
+        """The sampled twin: the reincarnation's rng offset skips the
+        emitted count, so the resumed sampled stream is bit-identical
+        to the uninterrupted run."""
+        kw = dict(batch=2, max_len=64, chunk=2, seed=7,
+                  temperature=0.8, top_k=20, top_p=0.9)
+        prompts = _prompts(42, (5, 4, 6))
+        ref = ContinuousBatcher(params, CFG, **kw).serve(
+            prompts, 12)
+        reg = M.MetricsRegistry()
+        h = _Harness(_SlowFetch(params, CFG, **kw), registry=reg)
+        try:
+            h.engine.submit(0, prompts[0], 12, request_class="batch")
+            h.engine.submit(1, prompts[1], 12, request_class="batch")
+            h.wait_first_tokens([0, 1])
+            h.engine.submit(2, prompts[2], 12,
+                            request_class="interactive")
+        finally:
+            h.finish()
+        assert reg.counter("tony_serve_preemptions_total").value == 1
+        for rid in (0, 1, 2):
+            assert h.got[rid] == ref[rid], \
+                f"stream {rid}: sampled dup/drop across preemption"
+
+
+# ---------------------------------------------------------------------------
+# serving server: the wire contract (classless e2e, malformed class,
+# BUSY + client retry)
+# ---------------------------------------------------------------------------
+class TestServerWireQoS:
+    def test_classless_admit_lands_standard_e2e(self, params):
+        """An old client (no class field) must behave exactly as
+        before: admitted, queued as ``standard`` (visible in the STATS
+        per-class depths), served token-identically."""
+        srv = ServingServer(_SlowFetch(params, CFG, batch=2, max_len=32,
+                                       chunk=3),
+                            registry=M.MetricsRegistry())
+        port = srv.start()
+        prompts = _prompts(43, (4, 5, 4))
+        budget = 10
+        try:
+            with StreamingClient("127.0.0.1", port) as c:
+                rids = [c.submit(p, budget) for p in prompts]
+                deadline = time.time() + 30
+                seen_standard = False
+                while time.time() < deadline and not seen_standard:
+                    depths = c.stats()["queue_depths"]
+                    assert depths["interactive"] == 0
+                    assert depths["batch"] == 0
+                    seen_standard = depths["standard"] >= 1
+                    time.sleep(0.01)
+                assert seen_standard, "classless admit never queued as " \
+                                      "standard"
+                for i, r in enumerate(rids):
+                    toks, reason = c.result(r)
+                    assert toks == _reference(params, prompts[i], budget)
+                    assert reason == "budget"
+        finally:
+            srv.stop()
+
+    def test_malformed_class_is_request_scoped(self, params):
+        srv = ServingServer(ContinuousBatcher(params, CFG, batch=2,
+                                              max_len=32, chunk=3),
+                            registry=M.MetricsRegistry())
+        port = srv.start()
+        try:
+            with StreamingClient("127.0.0.1", port) as c:
+                rid = c.submit([1, 2, 3], 4, request_class="gold")
+                ev = c.next_event(rid, timeout=60)
+                assert ev[0] == "error" and "request class" in ev[1]
+                # the connection survives; a valid class still serves
+                p = _prompts(44, (4,))[0]
+                toks, _ = c.result(c.submit(p, 5,
+                                            request_class="interactive"))
+                assert toks == _reference(params, p, 5)
+        finally:
+            srv.stop()
+
+    def test_busy_over_wire_then_client_retry_recovers(self, params):
+        """A shed surfaces as ServerBusy carrying the server's hint
+        when the retry budget is 0; with a budget the client re-admits
+        transparently after backoff and the request completes once
+        capacity frees."""
+        srv = ServingServer(_SlowFetch(params, CFG, batch=2, max_len=32,
+                                       chunk=3),
+                            registry=M.MetricsRegistry(),
+                            max_queue_depth=1, busy_retry_ms=40)
+        port = srv.start()
+        prompts = _prompts(45, (4, 5, 4, 6))
+        budget = 20   # slot-holders must outlive the shed probe
+        try:
+            with StreamingClient("127.0.0.1", port) as c:
+                # stagger the fill: with depth 1, a submit racing the
+                # engine's admission of the previous one would shed
+                rids = []
+                for i, want in enumerate(((1, 0), (2, 0), (2, 1))):
+                    rids.append(c.submit(prompts[i], budget,
+                                         request_class="batch"))
+                    deadline = time.time() + 30
+                    while time.time() < deadline:
+                        st = c.stats()
+                        if (st["active"], st["queue_depth"]) == want:
+                            break
+                        time.sleep(0.01)
+                    else:
+                        pytest.fail(f"fill {i} never settled: {st}")
+                with pytest.raises(ServerBusy) as ei:
+                    c.result(c.submit(prompts[3], 6,
+                                      request_class="batch"))
+                assert ei.value.retry_after_ms == 40
+                # with a retry budget the SAME submission self-heals
+                toks, reason = c.result(
+                    c.submit(prompts[3], 6, request_class="batch",
+                             retries=8), timeout=120)
+                assert toks == _reference(params, prompts[3], 6)
+                assert reason == "budget"
+                for i, r in enumerate(rids):
+                    toks, _ = c.result(r, timeout=120)
+                    assert toks == _reference(params, prompts[i],
+                                              budget)
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# disaggregated: class rides the shipment; decode-tier preemption
+# resumes through a fresh prefill, token-identically
+# ---------------------------------------------------------------------------
+class TestDisaggQoS:
+    def _stack(self, params, decode_batcher, seed=0):
+        regp, regd, regr = (M.MetricsRegistry(), M.MetricsRegistry(),
+                            M.MetricsRegistry())
+        pre = PrefillServer(params, CFG, max_len=64, max_batch=2,
+                            seed=seed, registry=regp)
+        dec = DecodeServer(decode_batcher, registry=regd)
+        router = ServingRouter(
+            [f"127.0.0.1:{pre.start()}"],
+            decode_replicas=[f"127.0.0.1:{dec.start()}"],
+            health_interval_s=0.2, registry=regr)
+        return pre, dec, router, regr
+
+    def _run_preempt(self, params, port, prompts, ref, budgets):
+        got = {}
+        with StreamingClient("127.0.0.1", port) as c:
+            r0 = c.submit(prompts[0], budgets[0], request_class="batch")
+            r1 = c.submit(prompts[1], budgets[1], request_class="batch")
+            # both decode slots must be HELD by batch rows before the
+            # interactive admission, or it would just take a free slot
+            started = set()
+            deadline = time.time() + 60
+            while len(started) < 2 and time.time() < deadline:
+                for r in (r0, r1):
+                    if r in started:
+                        continue
+                    try:
+                        ev = c.next_event(r, timeout=0.02)
+                    except queue_mod.Empty:
+                        continue
+                    assert ev[0] == "tokens", ev
+                    got.setdefault(r, []).extend(ev[1])
+                    started.add(r)
+            assert len(started) == 2, "batch streams never started"
+            r2 = c.submit(prompts[2], budgets[2],
+                          request_class="interactive")
+            for r in (r0, r1, r2):
+                while True:
+                    ev = c.next_event(r, timeout=60)
+                    if ev[0] == "tokens":
+                        got.setdefault(r, []).extend(ev[1])
+                    elif ev[0] == "retired":
+                        assert ev[1] == "budget", (r, ev)
+                        break
+                    else:
+                        raise AssertionError(ev)
+        for i, r in enumerate((r0, r1, r2)):
+            assert got[r] == ref[i], \
+                f"stream {i}: dup/drop across decode-tier preemption"
+
+    def test_decode_preemption_reprefills_identical_greedy(self, params):
+        """Both decode slots hold batch rows; an interactive request
+        arrives through the prefill tier (class rides the kv meta), the
+        decode engine evicts a KV-adopted batch row as 'preempted', and
+        the router re-places it through a FRESH prefill with the
+        streamed prefix folded in — the resumed stream must equal the
+        uninterrupted reference exactly."""
+        dec_b = _SlowFetch(params, CFG, batch=2, max_len=64, chunk=2)
+        prompts = _prompts(46, (5, 4, 6))
+        budgets = (12, 12, 6)
+        ref = [_reference(params, p, n)
+               for p, n in zip(prompts, budgets)]
+        pre, dec, router, regr = self._stack(params, dec_b)
+        try:
+            self._run_preempt(params, router.start(), prompts, ref,
+                              budgets)
+            assert regr.counter(
+                "tony_router_preempt_requeues_total").value == 1
+            assert regr.counter("tony_router_failovers_total").value == 0
+        finally:
+            router.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_decode_preemption_reprefills_identical_sampled(self, params):
+        kw = dict(batch=2, max_len=64, chunk=2, seed=7,
+                  temperature=0.8, top_k=20, top_p=0.9)
+        prompts = _prompts(47, (5, 4, 6))
+        ref = ContinuousBatcher(params, CFG, **kw).serve(prompts, 12)
+        pre, dec, router, regr = self._stack(
+            params, _SlowFetch(params, CFG, **kw), seed=7)
+        try:
+            self._run_preempt(params, router.start(), prompts, ref,
+                              (12, 12, 12))
+            assert regr.counter(
+                "tony_router_preempt_requeues_total").value == 1
+        finally:
+            router.stop()
+            pre.stop()
+            dec.stop()
+
+    def test_prefill_orders_waves_by_class_and_sheds(self, params):
+        """A gated prefill accumulates a mixed queue; on release the
+        wave takes interactive ahead of earlier-arrived batch work, and
+        non-interactive admissions past the queue bound are refused
+        with BUSY before any prefill compute is spent."""
+        class Gated(PrefillServer):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.gate = threading.Event()
+                self.waves = []
+
+            def _take_wave(self):
+                self.gate.wait(timeout=60)
+                wave = super()._take_wave()
+                if wave:
+                    self.waves.append([it.cls for it in wave])
+                return wave
+
+        regp = M.MetricsRegistry()
+        pre = Gated(params, CFG, max_len=64, max_batch=2,
+                    max_queue_depth=3, busy_retry_ms=77, registry=regp)
+        dec = DecodeServer(ContinuousBatcher(params, CFG, batch=2,
+                                             max_len=64, chunk=2),
+                           registry=M.MetricsRegistry())
+        router = ServingRouter(
+            [f"127.0.0.1:{pre.start()}"],
+            decode_replicas=[f"127.0.0.1:{dec.start()}"],
+            health_interval_s=0.2, registry=M.MetricsRegistry())
+        port = router.start()
+        prompts = _prompts(48, (4, 5, 4, 5))
+        budget = 4
+        try:
+            with StreamingClient("127.0.0.1", port) as c:
+                rids = [c.submit(prompts[0], budget,
+                                 request_class="batch"),
+                        c.submit(prompts[1], budget,
+                                 request_class="batch"),
+                        c.submit(prompts[2], budget,
+                                 request_class="interactive")]
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if pre.stats()["queue_depth"] == 3:
+                        break
+                    time.sleep(0.01)
+                assert pre.stats()["queue_depths"] == {
+                    "interactive": 1, "standard": 0, "batch": 2}
+                # the bound is reached: a batch admit sheds BEFORE any
+                # prefill compute is spent; interactive still queues
+                with pytest.raises(ServerBusy) as ei:
+                    c.result(c.submit(prompts[3], budget,
+                                      request_class="batch"))
+                assert ei.value.retry_after_ms == 77
+                assert regp.counter("tony_serve_shed_total",
+                                    **{"class": "batch"}).value == 1
+                rids.append(c.submit(prompts[3], budget,
+                                     request_class="interactive"))
+                # submit() returns once the router has the request;
+                # wait for the ADMIT to land in the prefill queue
+                # before opening the gate, or wave 1 races it
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    if pre.stats()["queue_depth"] == 4:
+                        break
+                    time.sleep(0.01)
+                else:
+                    pytest.fail(f"4th admit never queued: {pre.stats()}")
+                pre.gate.set()
+                for i, r in enumerate(rids):
+                    toks, reason = c.result(r, timeout=120)
+                    assert toks == _reference(params, prompts[i],
+                                              budget), i
+                    assert reason == "budget"
+            # wave 1 (width 2) took BOTH interactive admissions ahead
+            # of the earlier-arrived batch pair
+            assert pre.waves[0] == ["interactive", "interactive"], \
+                pre.waves
+            assert [c for w in pre.waves for c in w].count("batch") == 2
+        finally:
+            router.stop()
+            pre.stop()
+            dec.stop()
+
+
+# ---------------------------------------------------------------------------
+# router over the simulated fleet: interactive placement, batch
+# re-queue on BUSY, client retry, oracle continuity under preemption
+# ---------------------------------------------------------------------------
+@pytest.mark.fleet_sim
+class TestRouterQoS:
+    def _fill_direct(self, addr, n, budget, seed0):
+        """Occupy a replica directly (bypassing the router) with batch
+        streams: returns (client, seeds, results-dict, threads). Waits
+        for each submission to be granted/queued before the next, so a
+        bounded replica never sheds its own fill."""
+        host, port = addr.split(":")
+        c = StreamingClient(host, int(port))
+        out, threads, seeds = {}, [], {}
+
+        def pump(rid):
+            toks = []
+            for delta in c.deltas(rid, timeout=60):
+                toks.extend(delta)
+            out[rid] = toks
+
+        for i in range(n):
+            seed = seed0 + i
+            rid = c.submit([seed, 1, 2], budget, request_class="batch")
+            seeds[rid] = seed
+            t = threading.Thread(target=pump, args=(rid,), daemon=True)
+            t.start()
+            threads.append(t)
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                st = c.stats()
+                if st["active"] + st["queue_depth"] == i + 1 \
+                        and st["queue_depth"] == max(
+                            0, i + 1 - st["slots"]):
+                    break
+                time.sleep(0.005)
+        return c, seeds, out, threads
+
+    def test_interactive_lands_on_idle_slots(self):
+        """With one replica saturated, an interactive admission is
+        placed where idle reserved slots exist instead of by the
+        generic load key."""
+        fleet = SimFleet(2, itl_s=0.02, slots=2, health_interval_s=0.05,
+                         registry=M.MetricsRegistry())
+        try:
+            port = fleet.start()
+            a, b = fleet.addrs()
+            c, seeds, out, threads = self._fill_direct(a, 2, 24, 500)
+            try:
+                deadline = time.time() + 30
+                while time.time() < deadline:
+                    reps = fleet.router.stats()["replicas"]
+                    if reps[a]["reported_load"] >= 2:
+                        break
+                    time.sleep(0.01)
+                with StreamingClient("127.0.0.1", port) as rc:
+                    toks, reason = rc.result(rc.submit(
+                        [900, 1, 2], 4, request_class="interactive"))
+                assert toks == [sim_token(900, p) for p in range(4)]
+                # it landed on the idle replica: the saturated one
+                # (whose rows are batch, hence preemptable) was never
+                # preempted
+                assert fleet.replicas[a].preemptions == 0
+                for t in threads:
+                    t.join(timeout=60)
+                for rid, seed in seeds.items():
+                    assert out[rid] == [sim_token(seed, p)
+                                        for p in range(24)]
+            finally:
+                c.close()
+        finally:
+            fleet.stop()
+
+    def test_batch_requeue_cap_then_busy_interactive_preempts(self):
+        """Every replica sheds batch work: the router re-places a shed
+        batch session up to the cap (bouncing between replicas), then
+        forwards the terminal BUSY with the hint intact. An interactive
+        request submitted into the SAME overload preempts a batch row
+        and completes fast — and every preempted direct stream still
+        finishes with exactly the oracle tokens."""
+        reg = M.MetricsRegistry()
+        fleet = SimFleet(2, itl_s=0.02, slots=1, max_queue_depth=1,
+                         busy_retry_ms=60, health_interval_s=0.05,
+                         registry=reg)
+        try:
+            port = fleet.start()
+            a, b = fleet.addrs()
+            ca, seeds_a, out_a, th_a = self._fill_direct(a, 2, 24, 600)
+            cb, seeds_b, out_b, th_b = self._fill_direct(b, 2, 24, 700)
+            try:
+                with StreamingClient("127.0.0.1", port) as rc:
+                    with pytest.raises(ServerBusy) as ei:
+                        rc.result(rc.submit([910, 1, 2], 4,
+                                            request_class="batch"))
+                    assert ei.value.retry_after_ms == 60
+                    assert reg.counter(
+                        "tony_router_busy_requeues_total").value == 3
+                    toks, _ = rc.result(rc.submit(
+                        [920, 1, 2], 4, request_class="interactive"))
+                    assert toks == [sim_token(920, p) for p in range(4)]
+                for t in th_a + th_b:
+                    t.join(timeout=60)
+                for seeds, out in ((seeds_a, out_a), (seeds_b, out_b)):
+                    for rid, seed in seeds.items():
+                        assert out[rid] == [sim_token(seed, p)
+                                            for p in range(24)], \
+                            "dup/drop across sim preemption"
+                assert sum(r.preemptions
+                           for r in fleet.replicas.values()) >= 1
+            finally:
+                ca.close()
+                cb.close()
+        finally:
+            fleet.stop()
+
+    def test_client_retry_self_heals_on_single_replica(self):
+        """One replica, zero spare capacity: the router cannot re-queue
+        (nowhere to exclude to), so the client's own retry budget is
+        what heals the request once capacity frees."""
+        fleet = SimFleet(1, itl_s=0.01, slots=1, max_queue_depth=1,
+                         busy_retry_ms=30, health_interval_s=0.05,
+                         registry=M.MetricsRegistry())
+        try:
+            port = fleet.start()
+            (a,) = fleet.addrs()
+            c, seeds, out, threads = self._fill_direct(a, 2, 10, 800)
+            try:
+                with StreamingClient("127.0.0.1", port) as rc:
+                    toks, reason = rc.result(
+                        rc.submit([930, 1, 2], 5, request_class="batch",
+                                  retries=10), timeout=60)
+                assert toks == [sim_token(930, p) for p in range(5)]
+                assert reason == "budget"
+                for t in threads:
+                    t.join(timeout=60)
+                for rid, seed in seeds.items():
+                    assert out[rid] == [sim_token(seed, p)
+                                        for p in range(10)]
+            finally:
+                c.close()
+        finally:
+            fleet.stop()
+
+    def test_router_exports_per_class_series(self):
+        reg = M.MetricsRegistry()
+        fleet = SimFleet(1, itl_s=0.005, slots=4, health_interval_s=0.05,
+                         registry=reg)
+        try:
+            port = fleet.start()
+            with StreamingClient("127.0.0.1", port) as rc:
+                toks, _ = rc.result(rc.submit(
+                    [940, 1, 2], 4, request_class="interactive"))
+            assert toks == [sim_token(940, p) for p in range(4)]
+            ttft = reg.histogram("tony_serve_ttft_seconds",
+                                 **{"class": "interactive"})
+            itl = reg.histogram("tony_serve_intertoken_seconds",
+                                **{"class": "interactive"})
+            assert ttft.count == 1
+            assert itl.count >= 1
+            # untouched classes exist but stay empty
+            assert reg.histogram("tony_serve_ttft_seconds",
+                                 **{"class": "batch"}).count == 0
+        finally:
+            fleet.stop()
+
+
+# ---------------------------------------------------------------------------
+# autoscale: interactive pressure pages capacity in; batch backlog
+# alone never does
+# ---------------------------------------------------------------------------
+class _ClassedRouter:
+    """stats()-only stand-in: a fixed 2-replica fleet whose per-replica
+    reported_load/queue_depths are scripted per tick."""
+
+    def __init__(self, script):
+        self._script = list(script)
+        self._i = 0
+        self.added, self.removed, self.drained = [], [], []
+
+    def stats(self):
+        load, depths, active = self._script[min(
+            self._i, len(self._script) - 1)]
+        self._i += 1
+        n = 2 + len(self.added) - len(self.removed)
+        return {
+            "active": active, "slots": 4 * n,
+            "replicas": {f"r{i}": {"up": 1, "reported_load": load,
+                                   "queue_depths": dict(depths),
+                                   "assigned": active // max(n, 1),
+                                   "draining": False}
+                         for i in range(n)},
+        }
+
+    def add_replicas(self, addrs, role=None):
+        self.added.extend(addrs)
+
+    def remove_replica(self, addr):
+        self.removed.append(addr)
+
+    def drain(self, addr, timeout_s=None):
+        self.drained.append(addr)
+        return {"drained": True, "migrated": 0}
+
+
+class _CountingProvider(CapacityProvider):
+    def __init__(self):
+        self.grown, self.released = 0, []
+
+    def grow(self, n):
+        addrs = [f"new{self.grown + i}" for i in range(n)]
+        self.grown += n
+        return addrs
+
+    def release(self, addrs):
+        self.released.extend(addrs)
+
+
+class TestAutoscaleQoS:
+    def test_batch_backlog_alone_never_scales_up(self):
+        """48 batch requests queued per replica, slots busy — deliberate
+        oversubscription, not SLO pressure: 20 ticks, zero actions."""
+        script = [(52.0, {"interactive": 0, "standard": 0, "batch": 48},
+                   8)] * 20
+        router = _ClassedRouter(script)
+        provider = _CountingProvider()
+        ctrl = FleetController(router, provider, hysteresis_ticks=3,
+                               cooldown_ticks=5,
+                               up_queue_per_replica=6.0,
+                               registry=M.MetricsRegistry())
+        actions = [ctrl.tick() for _ in range(20)]
+        assert set(actions) == {"hold"}, actions
+        assert provider.grown == 0 and not router.drained
+
+    def test_interactive_pressure_scales_up(self):
+        """The SAME total backlog, but interactive: scale-up fires on
+        the third consecutive tick, exactly the classless discipline."""
+        script = [(52.0, {"interactive": 48, "standard": 0, "batch": 0},
+                   8)] * 20
+        router = _ClassedRouter(script)
+        provider = _CountingProvider()
+        reg = M.MetricsRegistry()
+        ctrl = FleetController(router, provider, hysteresis_ticks=3,
+                               cooldown_ticks=10,
+                               up_queue_per_replica=6.0, registry=reg)
+        actions = [ctrl.tick() for _ in range(12)]
+        assert actions.count("up") == 1, actions
+        assert actions.index("up") == 2
+        assert reg.counter("tony_fleet_scale_ups_total").value == 1
+
+    def test_classless_replicas_keep_aggregate_signal(self):
+        """Replicas that never report queue_depths (old engines) fall
+        back to reported_load — mixed fleets keep scaling."""
+        script = [(8.0, {}, 8)] * 12
+        router = _ClassedRouter(script)
+        provider = _CountingProvider()
+        ctrl = FleetController(router, provider, hysteresis_ticks=3,
+                               cooldown_ticks=10,
+                               up_queue_per_replica=6.0,
+                               registry=M.MetricsRegistry())
+        actions = [ctrl.tick() for _ in range(6)]
+        assert actions.count("up") == 1, actions
+
+
+# ---------------------------------------------------------------------------
+# the bench-arm pins: 2x overload, classed vs classless
+# ---------------------------------------------------------------------------
+@pytest.mark.fleet_sim
+class TestQosBenchArm:
+    def test_qos_arm_pins(self):
+        import bench
+        out = bench._qos_arm()
+        # interactive p99 TTFT holds under 2x overload while the
+        # classless baseline blows through it
+        assert out["serving_qos_interactive_ttft_p99_vs_classless"] \
+            >= 2, out
+        # every preemption eviction resumed with zero dup/drop tokens
+        assert out["serving_qos_preempt_token_gap"] == 0, out
+        assert out["serving_qos_preemptions"] >= 1, out
+
+    @pytest.mark.slow
+    def test_qos_arm_survives_wan_hop(self):
+        import bench
+        out = bench._qos_arm(one_way_s=0.02)
+        assert out["serving_qos_interactive_ttft_p99_vs_classless"] \
+            >= 2, out
+        assert out["serving_qos_preempt_token_gap"] == 0, out

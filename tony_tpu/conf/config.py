@@ -138,6 +138,15 @@ class TonyConfig:
         v = self._values.get(key)
         return parse_memory_string(v) if v not in (None, "") else default
 
+    def get_latency_buckets(self) -> tuple[float, ...]:
+        """The latency histogram bucket ladder
+        (``tony.metrics.latency-buckets``): parsed + validated bounds,
+        or the built-in default ladder when unset. ValueError on a
+        malformed spec (also enforced at :meth:`load`)."""
+        from tony_tpu.runtime import metrics
+        return metrics.parse_latency_buckets(
+            self._values.get(K.METRICS_LATENCY_BUCKETS_KEY) or "")
+
     def get_list(self, key: str, default: Iterable[str] = ()) -> list[str]:
         v = self._values.get(key)
         if v in (None, ""):
@@ -167,6 +176,10 @@ class TonyConfig:
             site = os.path.join(conf_dir, "tony-site.xml")
             if os.path.exists(site):
                 conf.update(read_conf_file(site))
+        # a malformed latency-bucket ladder is refused HERE — discovered
+        # at the first observe() it would take the serve loop down
+        # instead of the operator's deploy
+        conf.get_latency_buckets()
         return conf
 
     @classmethod

@@ -303,6 +303,36 @@ ROUTER_HEALTH_INTERVAL_MS_KEY = "tony.router.health-interval-ms"
 ROUTER_MAX_MISSED_PINGS_KEY = "tony.router.max-missed-pings"
 
 # ---------------------------------------------------------------------------
+# Serving engine QoS ("tony.serve.*"): SLO-tiered admission. Every
+# request carries a class (interactive | standard | batch, absent =
+# standard); the engine keeps one admission queue per class, reserves
+# decode-slot floors per class, preempts batch rows for interactive
+# admissions, and sheds standard/batch load past a bounded queue depth
+# with a BUSY frame instead of growing the queue.
+# ---------------------------------------------------------------------------
+# Decode-slot floor per class: a free slot is handed to another class
+# only if enough free slots remain to cover this class's unmet floor.
+# Floors are soft capacity reservations (never exceed the batcher's
+# slot count — oversized floors are clamped at engine construction).
+SERVE_SLOTS_INTERACTIVE_KEY = "tony.serve.slots.interactive"
+SERVE_SLOTS_STANDARD_KEY = "tony.serve.slots.standard"
+SERVE_SLOTS_BATCH_KEY = "tony.serve.slots.batch"
+# Total queued admissions (all classes) past which a standard/batch
+# submission is shed with BUSY. Interactive admissions always queue —
+# their overload story is the floor + preemption, not shedding. 0
+# disables shedding (the pre-QoS unbounded queue).
+SERVE_MAX_QUEUE_DEPTH_KEY = "tony.serve.max-queue-depth"
+# The retry_after_ms hint a BUSY frame carries.
+SERVE_BUSY_RETRY_MS_KEY = "tony.serve.busy-retry-ms"
+
+# Latency histogram bucket upper bounds (seconds), comma-separated and
+# strictly increasing — the buckets every tony_*_seconds histogram
+# (TTFT, inter-token, placement...) observes into. The default spans
+# 1ms..60s log-ish; interactive sub-100ms SLO work wants finer low-end
+# buckets. Malformed/non-monotonic bounds are refused at config load.
+METRICS_LATENCY_BUCKETS_KEY = "tony.metrics.latency-buckets"
+
+# ---------------------------------------------------------------------------
 # Weight distribution plane ("tony.weights.*"): the warm scale-up path —
 # content-addressed weight + compiled-program artifacts shipped peer-to-peer
 # over the channel plane (tony_tpu/serving/weightstore.py) instead of N
@@ -410,6 +440,12 @@ DEFAULTS: dict[str, str] = {
     DOCKER_IMAGE_KEY: "",
     ROUTER_HEALTH_INTERVAL_MS_KEY: "500",
     ROUTER_MAX_MISSED_PINGS_KEY: "3",
+    SERVE_SLOTS_INTERACTIVE_KEY: "0",
+    SERVE_SLOTS_STANDARD_KEY: "0",
+    SERVE_SLOTS_BATCH_KEY: "0",
+    SERVE_MAX_QUEUE_DEPTH_KEY: "128",
+    SERVE_BUSY_RETRY_MS_KEY: "250",
+    METRICS_LATENCY_BUCKETS_KEY: "",
     WEIGHTS_CHUNK_BYTES_KEY: "8388608",
     WEIGHTS_QUANTIZE_WIRE_KEY: "false",
     WEIGHTS_COMPILE_CACHE_DIR_KEY: "",
@@ -429,7 +465,7 @@ NON_JOB_TYPE_WORDS = frozenset({"application", "task", "am", "history", "tpu",
                                 "launch", "elastic", "metrics", "pipeline",
                                 "channel", "trace", "router", "fleet",
                                 "coordinator", "weights", "goodput",
-                                "straggler", "daemon"})
+                                "straggler", "daemon", "serve"})
 
 
 def instances_key(job_type: str) -> str:

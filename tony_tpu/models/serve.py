@@ -1620,6 +1620,26 @@ class SpeculativeContinuousBatcher(ContinuousBatcher):
         self.d_cache = retire_rows(self.d_cache, m)
 
 
+#: QoS tiers in admission-priority order (mirrors
+#: ``serving.protocol.QOS_CLASSES`` — the wire-side authority; kept as
+#: a local literal so the models layer stays importable without the
+#: serving plane).
+QOS_CLASSES = ("interactive", "standard", "batch")
+
+
+class EngineBusy(RuntimeError):
+    """Explicit overload shed: the engine refused to QUEUE a
+    standard/batch submission past its bounded queue depth. A statement
+    about load, not about the request — the identical submit is
+    expected to succeed once pressure clears; ``retry_after_ms`` is the
+    server's backoff hint (the BUSY frame's payload)."""
+
+    def __init__(self, retry_after_ms: int) -> None:
+        super().__init__(
+            f"engine overloaded; retry after {retry_after_ms} ms")
+        self.retry_after_ms = int(retry_after_ms)
+
+
 class _EngineRequest:
     """Engine-side record of one live request. ``stream`` is the
     request's rng-stream index (assigned in submission order, so the
@@ -1628,10 +1648,12 @@ class _EngineRequest:
 
     __slots__ = ("rid", "prompt", "budget", "stream", "rng_skip",
                  "emitted", "done", "reason", "t_submit", "t_last",
-                 "span", "queued_span", "first_span")
+                 "span", "queued_span", "first_span", "cls", "history",
+                 "requeued")
 
     def __init__(self, rid, prompt, budget: int, stream: int,
-                 t_submit: float, rng_skip: int = 0) -> None:
+                 t_submit: float, rng_skip: int = 0,
+                 cls: str = "standard") -> None:
         self.rid = rid
         self.prompt = prompt
         self.budget = budget
@@ -1645,6 +1667,18 @@ class _EngineRequest:
         self.reason: str | None = None
         self.t_submit = t_submit
         self.t_last = t_submit
+        #: QoS tier (one of :data:`QOS_CLASSES`)
+        self.cls = cls
+        #: emitted token VALUES, tracked only for evictable rows (batch
+        #: class, foldable payload) — a preemption folds prompt+history
+        #: into the reincarnation's prompt so the PR 12 rng-offset
+        #: re-prefill resumes the stream token-identically
+        self.history: list | None = None
+        #: True on the tombstone left behind by a preemption whose
+        #: stream was re-queued IN-ENGINE under the same rid: its
+        #: retirement must not be emitted (the rid is still live) and
+        #: its counters must not move
+        self.requeued = False
         # TTFT-decomposition spans (tracing.NOOP_SPAN when unsampled):
         # engine.request (submit→retire) with children engine.queued
         # (submit→slot admit) and engine.first_token (admit→first
@@ -1669,7 +1703,11 @@ class ServeEngine:
       land in the registry here).
     - ``on_retired(rid, reason, n_tokens, final_tokens)`` fires exactly
       once per request, reason one of ``"eos"``/``"budget"``/
-      ``"cancelled"``/``"stopped"``. A request retiring on eos/budget
+      ``"cancelled"``/``"stopped"``/``"preempted"`` (the last only for
+      a KV-adopted row evicted for an interactive admission — the
+      router re-places it; a colocated batch row preempts WITHOUT
+      retiring, reincarnated in-engine under the same rid). A request
+      retiring on eos/budget
       delivers its LAST delta here (``final_tokens``) rather than
       through ``on_delta``, so a transport can write the final tokens
       and the retirement atomically — a peer can then never observe
@@ -1677,6 +1715,16 @@ class ServeEngine:
     - :meth:`drain` is the graceful shutdown: no further submits, run()
       returns once every accepted request has retired. :meth:`stop`
       aborts — outstanding requests retire as ``"stopped"``.
+
+    QoS (SLO-tiered serving): every submission carries a class —
+    ``interactive`` / ``standard`` / ``batch`` — with one admission
+    queue per class (interactive jumps, batch waits), per-class
+    decode-slot floors (``class_floors``, the ``tony.serve.slots.*``
+    keys), interactive-over-batch row preemption (evict-to-queue with
+    a token-identical resume), and an explicit overload shed
+    (:class:`EngineBusy` past ``max_queue_depth``, the BUSY frame).
+    Classless callers land as ``standard`` and see the exact pre-QoS
+    admission order.
 
     Callback threading: deltas and eos/budget retirements fire on the
     thread driving :meth:`run`; a ``"cancelled"`` retirement fires on
@@ -1700,7 +1748,11 @@ class ServeEngine:
     """
 
     def __init__(self, batcher: ContinuousBatcher, on_delta=None,
-                 on_retired=None, registry=None) -> None:
+                 on_retired=None, registry=None,
+                 class_floors: dict | None = None,
+                 max_queue_depth: int = 128,
+                 busy_retry_ms: int = 250,
+                 latency_buckets=None) -> None:
         # guard BEFORE the state reset below: constructing a second
         # engine over a live one would silently rebind the running
         # engine's rng streams and counters mid-flight
@@ -1712,9 +1764,31 @@ class ServeEngine:
         self.on_retired = on_retired
         self._lock = threading.Lock()
         self._work = threading.Condition(self._lock)
-        #: rids waiting for a slot, FIFO (deque: O(1) admission pops —
-        #: the old list-queue's pop(0) was O(n) per admission)
-        self._wait: collections.deque = collections.deque()
+        #: rids waiting for a slot, FIFO per QoS class (deque: O(1)
+        #: admission pops). Admission drains interactive first, then
+        #: standard, then batch; a preemption reincarnation goes to the
+        #: FRONT of its class queue (it already waited its turn).
+        self._waitq: dict[str, collections.deque] = {
+            c: collections.deque() for c in QOS_CLASSES}
+        #: per-class decode-slot floors (soft reservations, clamped to
+        #: the batcher's slot count; the ``tony.serve.slots.<class>``
+        #: keys). A class past its floor takes a free slot only if the
+        #: REMAINING free slots still cover every other class's unmet
+        #: floor.
+        self._floors = {c: 0 for c in QOS_CLASSES}
+        for c, n in (class_floors or {}).items():
+            if c not in self._floors:
+                raise ValueError(f"unknown QoS class in floors: {c!r}")
+            self._floors[c] = max(0, min(int(n), batcher.batch))
+        if sum(self._floors.values()) > batcher.batch:
+            raise ValueError(
+                f"class floors {self._floors} exceed {batcher.batch} "
+                f"decode slots")
+        #: total queued admissions past which standard/batch submits
+        #: are shed with :class:`EngineBusy` (0 = unbounded, the
+        #: pre-QoS queue); interactive admissions always queue
+        self._max_queue_depth = max(0, int(max_queue_depth))
+        self._busy_retry_ms = max(0, int(busy_retry_ms))
         self._reqs: dict = {}                    # rid -> _EngineRequest
         self._occupant: list[_EngineRequest | None] = \
             [None] * batcher.batch
@@ -1733,6 +1807,8 @@ class ServeEngine:
         # sync), pinned < 1% of chunk wall by bench.py's overhead arm.
         reg = registry or metrics_mod.get_default()
         self._reg = reg
+        buckets = (metrics_mod.TIME_BUCKETS_S if latency_buckets is None
+                   else tuple(latency_buckets))
         self._admitted_c = reg.counter(
             "tony_serve_requests_admitted_total",
             help="requests admitted into cache slots")
@@ -1749,11 +1825,37 @@ class ServeEngine:
         self._ttft_h = reg.histogram(
             "tony_serve_ttft_seconds",
             help="submit -> first consumed token delta (time to first "
-                 "token, engine-side)")
+                 "token, engine-side)", buckets=buckets)
         self._itl_h = reg.histogram(
             "tony_serve_intertoken_seconds",
             help="mean per-token gap of each consumed delta after a "
-                 "request's first (inter-token latency, engine-side)")
+                 "request's first (inter-token latency, engine-side)",
+            buckets=buckets)
+        # per-class series alongside the aggregates: the same names
+        # with a ``class`` label, so classless dashboards keep working
+        # while SLO alerting reads only its tier
+        self._qdepth_by_cls = {
+            c: reg.gauge("tony_serve_queue_depth",
+                         help="requests waiting for a free slot",
+                         **{"class": c}) for c in QOS_CLASSES}
+        self._ttft_by_cls = {
+            c: reg.histogram("tony_serve_ttft_seconds",
+                             buckets=buckets, **{"class": c})
+            for c in QOS_CLASSES}
+        self._itl_by_cls = {
+            c: reg.histogram("tony_serve_intertoken_seconds",
+                             buckets=buckets, **{"class": c})
+            for c in QOS_CLASSES}
+        self._preempt_c = reg.counter(
+            "tony_serve_preemptions_total",
+            help="batch rows evicted-to-queue for an interactive "
+                 "admission (the stream resumes token-identically)")
+        self._shed_c = {
+            c: reg.counter(
+                "tony_serve_shed_total",
+                help="submissions refused with BUSY past the bounded "
+                     "queue depth", **{"class": c})
+            for c in QOS_CLASSES}
         self._prefill_tok_c = reg.counter(
             "tony_serve_prefill_tokens_total",
             help="true prompt/suffix tokens run through a prefill or "
@@ -1768,13 +1870,16 @@ class ServeEngine:
             help="admissions that went through a resident prefix "
                  "template (only suffix tokens ran the model)")
         self._qdepth_g.set(0)
+        for g in self._qdepth_by_cls.values():
+            g.set(0)
 
     # --- thread-safe control surface ---
 
     def submit(self, rid, prompt, max_new_tokens: int,
                trace_ctx: dict | None = None,
                prefix_id: str | None = None,
-               rng: tuple | None = None) -> None:
+               rng: tuple | None = None,
+               request_class: str = "standard") -> None:
         """Enqueue a request under caller-chosen id ``rid`` (any
         hashable; must not collide with a LIVE request's). Raises
         ``ValueError`` for un-servable requests (validated up front, so
@@ -1799,24 +1904,37 @@ class ServeEngine:
         treated as already consumed — how a router-coordinated
         migration continues a SAMPLED stream token-identically on a new
         replica (the ADMIT frame's ``rng`` field; see
-        ``protocol.parse_rng``)."""
+        ``protocol.parse_rng``).
+
+        ``request_class`` is the QoS tier (:data:`QOS_CLASSES`):
+        ``interactive`` jumps the admission queue and may preempt a
+        batch row, ``batch`` yields and absorbs preemption; a
+        standard/batch submit past the bounded queue depth raises
+        :class:`EngineBusy` (the BUSY shed) instead of queueing."""
         prompt = [int(t) for t in prompt]
         max_new_tokens = int(max_new_tokens)
+        if request_class not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown request class {request_class!r} (expected "
+                f"one of {', '.join(QOS_CLASSES)})")
         entry = self.b._resolve_prefix(prefix_id, prompt)
         if entry is None:
             self.b._validate_request(prompt, max_new_tokens)
             self._enqueue(rid, prompt, max_new_tokens, trace_ctx,
-                          rng=rng, prompt_tokens=len(prompt))
+                          rng=rng, cls=request_class,
+                          prompt_tokens=len(prompt))
         else:
             hit = _PrefixHit(entry, prompt[len(entry.tokens):])
             self.b._validate_prefix_hit(hit, max_new_tokens)
             self._enqueue(rid, hit, max_new_tokens, trace_ctx,
-                          rng=rng, prompt_tokens=len(prompt),
+                          rng=rng, cls=request_class,
+                          prompt_tokens=len(prompt),
                           prefix=entry.id)
 
     def submit_prefilled(self, rid, package: KVPackage,
                          max_new_tokens: int,
-                         trace_ctx: dict | None = None) -> None:
+                         trace_ctx: dict | None = None,
+                         request_class: str = "standard") -> None:
         """Enqueue an ALREADY-PREFILLED request (disaggregated serving):
         ``package`` is the :class:`KVPackage` a prefill gang shipped —
         admission lands it with :func:`land_kv_rows` (a scatter, no
@@ -1826,43 +1944,88 @@ class ServeEngine:
         (:meth:`ContinuousBatcher._validate_package`),
         ``RuntimeError`` once draining. The shipped rng stream state
         rides the package, so sampled output matches the colocated
-        engine serving the same request index."""
+        engine serving the same request index.
+
+        ``request_class`` applies the decode tier's per-class floors
+        and queue order to an adopted package, but a package is NEVER
+        shed with BUSY: the prefill work is already paid — the prefill
+        tier sheds before prefilling (see ``serving/disagg.py``)."""
         max_new_tokens = int(max_new_tokens)
+        if request_class not in QOS_CLASSES:
+            raise ValueError(
+                f"unknown request class {request_class!r} (expected "
+                f"one of {', '.join(QOS_CLASSES)})")
         self.b._validate_package(package, max_new_tokens)
         self._enqueue(rid, package, max_new_tokens, trace_ctx,
-                      prompt_tokens=package.length, prefilled=True)
+                      cls=request_class, prompt_tokens=package.length,
+                      prefilled=True)
+
+    def _wait_total_locked(self) -> int:
+        return sum(len(q) for q in self._waitq.values())
+
+    def _set_qdepth_locked(self) -> None:
+        self._qdepth_g.set(self._wait_total_locked())
+        for c, q in self._waitq.items():
+            self._qdepth_by_cls[c].set(len(q))
 
     def _enqueue(self, rid, payload, max_new_tokens: int,
                  trace_ctx: dict | None, *, prompt_tokens: int,
-                 rng: tuple | None = None, **span_attrs) -> None:
+                 rng: tuple | None = None, cls: str = "standard",
+                 prefilled: bool = False, **span_attrs) -> None:
         """The shared admission-queue push behind :meth:`submit` and
-        :meth:`submit_prefilled`: drain/duplicate checks, request
-        registration, the engine-side span pair, and the wakeup — ONE
-        place, so the two admission paths cannot drift."""
+        :meth:`submit_prefilled`: drain/duplicate checks, the bounded-
+        queue BUSY shed, request registration, the engine-side span
+        pair, and the wakeup — ONE place, so the two admission paths
+        cannot drift."""
+        shed = False
         with self._work:
             if self._draining or self._stopped:
                 raise RuntimeError(
                     "engine is draining; not accepting new requests")
             if rid in self._reqs:
                 raise ValueError(f"request id {rid!r} is already active")
-            stream = self._next_stream if rng is None else int(rng[0])
-            skip = 0 if rng is None else int(rng[1])
-            req = _EngineRequest(rid, payload, max_new_tokens, stream,
-                                 time.perf_counter(), rng_skip=skip)
-            tr = tracing.get_tracer()
-            req.span = tr.start_span("engine.request", ctx=trace_ctx,
-                                     prompt_tokens=prompt_tokens,
-                                     budget=max_new_tokens, **span_attrs)
-            req.queued_span = tr.start_span("engine.queued",
-                                            parent=req.span)
-            if rng is None:
-                # pinned streams live in the router's reserved range;
-                # the local counter keeps its own sequence untouched
-                self._next_stream += 1
-            self._reqs[rid] = req
-            self._wait.append(rid)
-            self._qdepth_g.set(len(self._wait))
-            self._work.notify_all()
+            # the explicit overload shed: a standard/batch submit past
+            # the bounded queue depth is refused NOW with a retry hint
+            # instead of growing the queue into a latency grave.
+            # Interactive always queues (its overload story is the
+            # floor + preemption); an already-prefilled package is
+            # exempt too — its work is paid, the prefill tier shed
+            # before prefilling.
+            if (self._max_queue_depth and cls != "interactive"
+                    and not prefilled
+                    and self._wait_total_locked() >= self._max_queue_depth):
+                shed = True
+            else:
+                stream = self._next_stream if rng is None else int(rng[0])
+                skip = 0 if rng is None else int(rng[1])
+                req = _EngineRequest(rid, payload, max_new_tokens, stream,
+                                     time.perf_counter(), rng_skip=skip,
+                                     cls=cls)
+                if cls == "batch" and isinstance(payload,
+                                                 (list, _PrefixHit)):
+                    # evictable: track emitted values so a preemption
+                    # can fold them into the reincarnation's prompt
+                    req.history = []
+                tr = tracing.get_tracer()
+                req.span = tr.start_span("engine.request", ctx=trace_ctx,
+                                         prompt_tokens=prompt_tokens,
+                                         budget=max_new_tokens,
+                                         request_class=cls,
+                                         prefilled=prefilled,
+                                         **span_attrs)
+                req.queued_span = tr.start_span("engine.queued",
+                                                parent=req.span)
+                if rng is None:
+                    # pinned streams live in the router's reserved range;
+                    # the local counter keeps its own sequence untouched
+                    self._next_stream += 1
+                self._reqs[rid] = req
+                self._waitq[cls].append(rid)
+                self._set_qdepth_locked()
+                self._work.notify_all()
+        if shed:
+            self._shed_c[cls].inc()
+            raise EngineBusy(self._busy_retry_ms)
 
     def cancel(self, rid) -> None:
         """Cancel ``rid``. Idempotent: unknown / already-retired ids are
@@ -1876,10 +2039,10 @@ class ServeEngine:
             req.done = True
             req.reason = "cancelled"
             try:
-                self._wait.remove(rid)
+                self._waitq[req.cls].remove(rid)
             except ValueError:
                 pass          # admitted: the loop's consume frees it
-            self._qdepth_g.set(len(self._wait))
+            self._set_qdepth_locked()
             self._work.notify_all()
         self._cancelled_c.inc()
         req.queued_span.end()
@@ -1914,10 +2077,13 @@ class ServeEngine:
         ``queue_depth`` mirrors the ``tony_serve_queue_depth`` gauge."""
         with self._lock:
             return {
-                "queue_depth": len(self._wait),
+                "queue_depth": self._wait_total_locked(),
+                "queue_depths": {c: len(q)
+                                 for c, q in self._waitq.items()},
                 "active": sum(1 for r in self._occupant
                               if r is not None and not r.done),
                 "slots": self.b.batch,
+                "class_floors": dict(self._floors),
                 "draining": self._draining,
                 # the prefix fast path's compute story, readable
                 # cross-process (the e2e zero-prefix-forward pin)
@@ -1968,9 +2134,10 @@ class ServeEngine:
                 req.done = True
                 req.reason = reason
             self._reqs.clear()
-            self._wait.clear()
+            for q in self._waitq.values():
+                q.clear()
             self._occupant = [None] * self.b.batch
-            self._qdepth_g.set(0)
+            self._set_qdepth_locked()
         for req in doomed:
             req.queued_span.end()
             req.first_span.end()
@@ -1988,35 +2155,145 @@ class ServeEngine:
             while True:
                 if self._stopped:
                     return False
-                if self._wait or any(r is not None and not r.done
-                                     for r in self._occupant):
+                if (self._wait_total_locked()
+                        or any(r is not None and not r.done
+                               for r in self._occupant)):
                     return True
                 if self._draining:
                     return False
                 with goodput_mod.get_ledger().enter("idle"):
                     self._work.wait()
 
+    def _pop_admissible_locked(self, free: int, occ: dict):
+        """Pop the next admissible waiting request (class-priority
+        order: interactive, standard, batch) under the floor
+        discipline. ``free`` counts still-free slots INCLUDING the one
+        about to be granted; ``occ`` is live per-class occupancy
+        including this round's admissions."""
+        for cls in QOS_CLASSES:
+            # a class past its floor takes a free slot only while the
+            # REMAINING free slots still cover every other class's
+            # unmet floor (a floor is a reservation, held even absent
+            # demand); a class under its own floor is claiming its
+            # reservation and always admits
+            if occ[cls] >= self._floors[cls]:
+                owed = sum(max(0, self._floors[o] - occ[o])
+                           for o in QOS_CLASSES if o != cls)
+                if free - 1 < owed:
+                    continue
+            q = self._waitq[cls]
+            while q:
+                req = self._reqs.get(q.popleft())
+                if req is not None and not req.done:
+                    return req
+        return None
+
+    def _preempt_locked(self):
+        """Evict batch rows for interactive admissions still waiting
+        after the fill: the victim (fewest emitted tokens — cheapest
+        re-prefill) is tombstoned exactly like a cancel (its slot
+        frees at the next consumed chunk; stale in-flight tokens
+        discard) and its stream is REINCARNATED under the same rid at
+        the front of the batch queue — prompt + emitted history folded
+        into the new payload, rng offset advanced by the emitted count,
+        so the PR 12 re-prefill machinery resumes it token-identically.
+        A KV-package victim (decode tier) has no prompt to fold: it
+        genuinely retires as ``"preempted"`` and the router re-places
+        it. Returns ``(requeued, evicted)`` for the off-lock span /
+        retirement work."""
+        waiting = len(self._waitq["interactive"])
+        requeued, evicted = [], []
+        if not waiting:
+            return requeued, evicted
+        # slots already on their way free (done occupants vacate at the
+        # next consumed chunk) count against the need — without this,
+        # every settle between eviction and slot-free would evict again
+        vacating = sum(1 for r in self._occupant
+                       if r is not None and r.done)
+        need = waiting - vacating
+        while need > 0:
+            victims = [r for r in self._occupant
+                       if r is not None and not r.done
+                       and r.cls == "batch"]
+            # never evict below the batch floor — the freed slot would
+            # be owed straight back to the batch queue
+            if len(victims) <= self._floors["batch"]:
+                break
+            old = min(victims, key=lambda r: r.emitted)
+            old.done = True
+            old.reason = "preempted"
+            self._reqs.pop(old.rid, None)
+            if old.history is not None:
+                old.requeued = True
+                if isinstance(old.prompt, _PrefixHit):
+                    payload = _PrefixHit(
+                        old.prompt.entry,
+                        list(old.prompt.suffix) + old.history)
+                else:
+                    payload = list(old.prompt) + old.history
+                new = _EngineRequest(old.rid, payload, old.budget,
+                                     old.stream, old.t_submit,
+                                     rng_skip=old.rng_skip + old.emitted,
+                                     cls="batch")
+                new.emitted = old.emitted  # resume deltas are ITL
+                new.t_last = old.t_last
+                new.history = list(old.history)
+                new.span = old.span        # same logical request
+                old.span = tracing.NOOP_SPAN
+                self._reqs[old.rid] = new
+                self._waitq["batch"].appendleft(old.rid)
+                requeued.append(new)
+            else:
+                evicted.append(old)
+            need -= 1
+        if requeued or evicted:
+            self._set_qdepth_locked()
+        return requeued, evicted
+
     def _admit_free(self) -> None:
         """Admit waiting requests into every free slot (row order — the
-        freed order, since consume builds freed lists row-ascending).
-        The device dispatch runs OUTSIDE the lock; a request cancelled
-        between marking and dispatch is discarded at its first consume."""
+        freed order, since consume builds freed lists row-ascending),
+        draining the class queues in priority order under the per-class
+        floors, then preempt batch rows for any interactive admissions
+        left waiting. The device dispatch runs OUTSIDE the lock; a
+        request cancelled between marking and dispatch is discarded at
+        its first consume."""
         with self._lock:
             pairs, prompts, admitted = [], {}, []
+            occ = {c: 0 for c in QOS_CLASSES}
+            free = 0
+            for r in self._occupant:
+                if r is None:
+                    free += 1
+                elif not r.done:
+                    occ[r.cls] += 1
             for row in range(self.b.batch):
                 if self._occupant[row] is not None:
                     continue
-                req = None
-                while self._wait and req is None:
-                    req = self._reqs.get(self._wait.popleft())
+                req = self._pop_admissible_locked(free, occ)
                 if req is None:
                     break
                 self._occupant[row] = req
+                occ[req.cls] += 1
+                free -= 1
                 pairs.append((row, req.stream))
                 prompts[req.stream] = req.prompt
                 admitted.append(req)
             if admitted:
-                self._qdepth_g.set(len(self._wait))
+                self._set_qdepth_locked()
+            requeued, evicted = self._preempt_locked()
+        if requeued or evicted:
+            self._preempt_c.inc(len(requeued) + len(evicted))
+            tr = tracing.get_tracer()
+            for new in requeued:
+                if new.span.recording:
+                    new.queued_span = tr.start_span("engine.queued",
+                                                    parent=new.span,
+                                                    preempted=True)
+            for old in evicted:
+                old.first_span.end()
+                old.span.end(reason="preempted", tokens=old.emitted)
+                self._emit_retired(old)
         if admitted:
             tr = tracing.get_tracer()
             for req in admitted:
@@ -2078,6 +2355,10 @@ class ServeEngine:
                             self._occupant[row] = None
                         break
                 if new:
+                    if req.history is not None:
+                        # evictable row: a preemption folds these into
+                        # the reincarnation's prompt
+                        req.history.extend(new)
                     deltas.append((req, new))
                 if req.done:
                     retired.append(req)
@@ -2089,9 +2370,12 @@ class ServeEngine:
             appended += len(new)
             if req.emitted == len(new):      # this is the first delta
                 self._ttft_h.observe(now - req.t_submit)
+                self._ttft_by_cls[req.cls].observe(now - req.t_submit)
                 req.first_span.end()
             else:
-                self._itl_h.observe((now - req.t_last) / len(new))
+                gap = (now - req.t_last) / len(new)
+                self._itl_h.observe(gap)
+                self._itl_by_cls[req.cls].observe(gap)
             req.t_last = now
             # a retiring request's FINAL delta rides its retirement
             # callback instead of on_delta, so transports can emit the
@@ -2143,7 +2427,7 @@ class ServeEngine:
         garbage dispatch. (A submission landing during that final chunk
         is admitted at its settle and the loop continues.)"""
         with self._lock:
-            if self._wait:
+            if self._wait_total_locked():
                 return False
             return all(req.budget <= self.b.chunk
                        for req in self._occupant
@@ -2161,7 +2445,7 @@ class ServeEngine:
         chunk count, admission timing, and utilization all match the
         sequential loop."""
         with self._lock:
-            return bool(self._wait) and any(
+            return bool(self._wait_total_locked()) and any(
                 req is not None and not req.done
                 and req.budget <= self.b._chunk_tokens_max()
                 for req in snap)

@@ -51,6 +51,42 @@ TIME_BUCKETS_S: tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
     1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
 
+def parse_latency_buckets(spec: str) -> tuple[float, ...]:
+    """Parse a ``tony.metrics.latency-buckets`` value — comma-separated
+    upper bounds in seconds — into a histogram bucket ladder. Empty/
+    blank means the built-in :data:`TIME_BUCKETS_S` (the pre-QoS
+    bounds, so unconfigured deployments render identical series).
+    Raises ``ValueError`` on anything malformed: non-numeric or
+    non-finite bounds, non-positive bounds, or a non-strictly-increasing
+    ladder — refused at CONFIG LOAD, because a bad ladder discovered at
+    the first ``observe`` would take the serve loop down instead of the
+    operator's deploy."""
+    if not isinstance(spec, str):
+        raise ValueError(f"latency buckets must be a string, got "
+                         f"{type(spec).__name__}")
+    if not spec.strip():
+        return TIME_BUCKETS_S
+    bounds = []
+    for part in spec.split(","):
+        try:
+            b = float(part.strip())
+        except ValueError:
+            raise ValueError(
+                f"bad latency bucket bound {part.strip()!r} "
+                f"(want a number of seconds)") from None
+        if not math.isfinite(b) or b <= 0.0:
+            raise ValueError(
+                f"latency bucket bounds must be finite and positive, "
+                f"got {part.strip()!r}")
+        bounds.append(b)
+    for lo, hi in zip(bounds, bounds[1:]):
+        if hi <= lo:
+            raise ValueError(
+                f"latency bucket bounds must be strictly increasing, "
+                f"got {lo} before {hi}")
+    return tuple(bounds)
+
+
 _KIND_COUNTER = "counter"
 _KIND_GAUGE = "gauge"
 _KIND_HISTOGRAM = "histogram"

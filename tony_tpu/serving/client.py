@@ -24,16 +24,33 @@ from __future__ import annotations
 
 import itertools
 import queue
+import random
 import socket
 import threading
 
 from tony_tpu.runtime import tracing
 from tony_tpu.serving import protocol as P
 
+#: ceiling on one busy-retry backoff sleep — the hint grows
+#: exponentially per attempt but never past this (milliseconds)
+BUSY_BACKOFF_CAP_MS = 5000
+
 
 class ServingConnectionError(ConnectionError):
     """The serving connection failed (handshake, mid-stream loss, or a
     connection-scoped server ERROR)."""
+
+
+class ServerBusy(ServingConnectionError):
+    """The server shed this request under overload (the BUSY terminal
+    frame): nothing was computed, nothing streamed — re-admit after
+    ``retry_after_ms``. Raised only once any ``submit(retries=)``
+    budget is exhausted; transparent re-admissions never surface."""
+
+    def __init__(self, retry_after_ms: int) -> None:
+        super().__init__(
+            f"server busy; retry after {int(retry_after_ms)}ms")
+        self.retry_after_ms = int(retry_after_ms)
 
 
 class StreamingClient:
@@ -52,6 +69,10 @@ class StreamingClient:
         #: spans join the same trace
         self._spans: dict[int, tuple] = {}
         self._stats_q: queue.Queue = queue.Queue()
+        #: rid -> [admit body, retries left, attempts made] — consulted
+        #: by the reader thread when a BUSY lands; re-admission rides a
+        #: one-shot timer thread so the reader never sleeps
+        self._retries: dict[int, list] = {}
         self._next_rid = itertools.count(1)
         self._closed = False
         self._conn_error: str | None = None
@@ -108,6 +129,12 @@ class StreamingClient:
                         break               # connection-scoped: fatal
                     self._end_span(rid, reason="error")
                     self._dispatch(rid, ("error", msg))
+                elif ftype == P.BUSY:
+                    obj = P.unpack_json(payload)
+                    hint = int(obj.get("retry_after_ms", 0) or 0)
+                    if not self._retry_busy(rid, hint):
+                        self._end_span(rid, reason="busy")
+                        self._dispatch(rid, ("busy", hint))
                 elif ftype == P.STATS:
                     self._stats_q.put(P.unpack_json(payload))
                 elif ftype == P.PREFIX:
@@ -153,15 +180,56 @@ class StreamingClient:
         if q is not None:
             q.put(event)
 
+    def _retry_busy(self, rid: int, hint_ms: int) -> bool:
+        """A BUSY landed for ``rid``: consume one retry if any remain.
+        The re-admission is TRANSPARENT — same rid, same event queue,
+        same spans (TTFT keeps counting from the original submit, which
+        is what the caller experiences) — and rides a one-shot timer
+        thread so the reader loop never sleeps through other streams'
+        deltas. Returns False when the budget is spent (the BUSY
+        surfaces to the consumer)."""
+        with self._lock:
+            st = self._retries.get(rid)
+            if st is None or st[1] <= 0:
+                return False
+            st[1] -= 1
+            attempt, body = st[2], st[0]
+            st[2] += 1
+        # capped exponential backoff on the server's hint, jittered
+        # +/-25% so a shed burst does not re-arrive as a burst
+        base = max(int(hint_ms), 1) * (2 ** attempt)
+        delay = min(base, BUSY_BACKOFF_CAP_MS) / 1000.0
+        delay *= 0.75 + 0.5 * random.random()
+
+        def _readmit() -> None:
+            try:
+                self._send(P.ADMIT, rid, P.pack_json(body))
+            except ServingConnectionError as e:
+                self._end_span(rid, reason="send_failed")
+                self._dispatch(rid, ("error", str(e)))
+
+        t = threading.Timer(delay, _readmit)
+        t.name = f"tony-client-retry-{rid}"
+        t.daemon = True
+        t.start()
+        return True
+
     # -- request surface ----------------------------------------------------
     def submit(self, prompt, max_new_tokens: int, stream: bool = True,
                rid: int | None = None,
-               prefix_id: str | None = None) -> int:
+               prefix_id: str | None = None,
+               request_class: str | None = None,
+               retries: int = 0) -> int:
         """Admit a request; returns its (client-chosen or auto) rid.
         ``prefix_id`` optionally names the shared prefix the prompt
         continues (prefix-aware routing/admission); routers also
         token-match unnamed prompts against their catalog, so it is
-        never required."""
+        never required. ``request_class`` names the QoS tier
+        (``interactive``/``standard``/``batch``; None omits the field —
+        old servers see the old wire and new servers default it to
+        ``standard``). ``retries`` is the BUSY budget: that many
+        transparent re-admissions with capped jittered backoff on the
+        server's hint before :class:`ServerBusy` surfaces."""
         if rid is None:
             rid = next(self._next_rid)
         tr = tracing.get_tracer()
@@ -171,6 +239,11 @@ class StreamingClient:
                 "max_new_tokens": int(max_new_tokens), "stream": stream}
         if prefix_id is not None:
             body["prefix"] = str(prefix_id)
+        if request_class is not None:
+            # pass-through, not validated here: the server owns the
+            # class vocabulary and answers an unknown one with a
+            # request-scoped ERROR
+            body["class"] = str(request_class)
         if sp.recording:
             # propagate the client's span context so the router's and
             # engine's spans join this trace (the end-to-end TTFT
@@ -182,6 +255,8 @@ class StreamingClient:
                 raise ServingConnectionError(
                     self._conn_error or "client is closed")
             self._queues[rid] = queue.Queue()
+            if retries > 0:
+                self._retries[rid] = [body, int(retries), 0]
             self._spans[rid] = (sp, tr.start_span("client.ttft",
                                                   parent=sp))
         try:
@@ -246,6 +321,10 @@ class StreamingClient:
                     finished = True
                     self._forget(rid)
                     return
+                elif ev[0] == "busy":
+                    finished = True         # terminal: nothing to cancel
+                    self._forget(rid)
+                    raise ServerBusy(ev[1])
                 else:
                     finished = True
                     self._forget(rid)
@@ -265,6 +344,9 @@ class StreamingClient:
             elif ev[0] == "retired":
                 self._forget(rid)
                 return tokens, ev[1]
+            elif ev[0] == "busy":
+                self._forget(rid)
+                raise ServerBusy(ev[1])
             else:
                 self._forget(rid)
                 raise ServingConnectionError(ev[1])
@@ -282,6 +364,8 @@ class StreamingClient:
             self._forget(rid)
             return [], ev[1]
         self._forget(rid)
+        if ev[0] == "busy":
+            raise ServerBusy(ev[1])
         raise ServingConnectionError(ev[1])
 
     def prefix_op(self, op: str, timeout: float | None = 60.0,
@@ -364,6 +448,7 @@ class StreamingClient:
     def _forget(self, rid: int) -> None:
         with self._lock:
             self._queues.pop(rid, None)
+            self._retries.pop(rid, None)
 
     # -- lifecycle ----------------------------------------------------------
     def close(self) -> None:

@@ -84,18 +84,23 @@ class _PrefillItem:
 
     __slots__ = ("conn", "rid", "prompt", "budget", "decode", "stream",
                  "rng_off", "cancelled", "done", "span", "queued_span",
-                 "prefix")
+                 "prefix", "cls")
 
     def __init__(self, conn: FrameConn, rid: int, prompt: list[int],
                  budget: int, decode: str, stream: int,
                  trace_ctx: dict | None,
-                 prefix: str | None = None, rng_off: int = 0) -> None:
+                 prefix: str | None = None, rng_off: int = 0,
+                 cls: str = "standard") -> None:
         self.conn = conn
         self.rid = rid
         self.prompt = prompt
         self.budget = budget
         self.decode = decode
         self.stream = stream
+        #: QoS class: orders the tier's waves (interactive prompts
+        #: never wait a wave behind batch) and ships in the KV meta so
+        #: the decode tier's class floors apply to the adopted row
+        self.cls = cls
         #: stream positions already consumed by a previous placement
         #: (router-coordinated migration): shipped in the KV meta so the
         #: adopting decode row draws its first sample at this offset
@@ -149,7 +154,9 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                  channel_window: int = 8,
                  ship_timeout_s: float = 30.0, registry=None,
                  weights_version: str | None = None,
-                 weights_digest: str | None = None) -> None:
+                 weights_digest: str | None = None,
+                 max_queue_depth: int = 128,
+                 busy_retry_ms: int = 250) -> None:
         super().__init__(bind_host, port)
         import jax
 
@@ -171,6 +178,12 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                                   if admission_buckets else None)
         self.ship_timeout_s = ship_timeout_s
         self.channel_window = channel_window
+        #: overload bound on the tier's wait queue (0 disables): past
+        #: it, non-interactive admissions shed with BUSY — prefill is
+        #: where the work would be WASTED under overload, so the tier
+        #: says no before computing anything
+        self.max_queue_depth = int(max_queue_depth)
+        self.busy_retry_ms = int(busy_retry_ms)
         self._ring = bool(cfg.kv_cache_capacity)
         self._base_key = jax.random.PRNGKey(seed)
         self._cv = threading.Condition()
@@ -206,6 +219,12 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
             "tony_prefill_prefix_tokens_total",
             help="prefix positions served from a resident template "
                  "instead of a forward at the prefill tier")
+        self._shed_c = {
+            c: reg.counter(
+                "tony_serve_shed_total",
+                help="admissions refused with BUSY under overload",
+                **{"class": c})
+            for c in P.QOS_CLASSES}
         self._qdepth_g.set(0)
         #: resident prefix templates: id -> (tokens, template). Grown
         #: only; entries immutable — lock-free reads at wave time.
@@ -367,7 +386,11 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
     def stats(self) -> dict:
         with self._cv:
             depth, active = len(self._queue), self._inflight
+            by_cls = {c: 0 for c in P.QOS_CLASSES}
+            for it in self._queue:
+                by_cls[it.cls] += 1
         return {"queue_depth": depth, "active": active,
+                "queue_depths": by_cls,
                 "slots": self.max_batch, "role": "prefill",
                 "prefixes": self.resident_prefixes(),
                 "ring": self._ring,
@@ -395,20 +418,35 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
         if err is not None:
             conn.send(P.ERROR, rid, P.pack_json({"message": err}))
             return
+        try:
+            cls = P.parse_class(obj)
+        except ValueError as e:
+            conn.send(P.ERROR, rid, P.pack_json({"message": str(e)}))
+            return
         key = (conn.id, rid)
         rng = P.parse_rng(obj)
-        # duplicate-rid reply goes out AFTER the condition is dropped:
-        # the send can block on a slow client and every prefill worker
-        # waits on this condition (TL001)
+        # duplicate-rid/BUSY replies go out AFTER the condition is
+        # dropped: the send can block on a slow client and every
+        # prefill worker waits on this condition (TL001)
+        shed = False
         with self._cv:
             duplicate = key in self._items
-            if not duplicate:
+            if (not duplicate and self.max_queue_depth
+                    and cls != "interactive"
+                    and len(self._queue) >= self.max_queue_depth):
+                # overload shed at the tier where refused work costs
+                # nothing yet; interactive admissions ride through —
+                # the wave order and the decode tier's preemption are
+                # what they paid for
+                shed = True
+            elif not duplicate:
                 item = _PrefillItem(conn, rid, prompt, max_new, decode,
                                     (self._next_stream if rng is None
                                      else int(rng[0])),
                                     P.parse_trace_ctx(obj),
                                     prefix=P.parse_prefix_id(obj),
-                                    rng_off=0 if rng is None else int(rng[1]))
+                                    rng_off=0 if rng is None else int(rng[1]),
+                                    cls=cls)
                 if rng is None:
                     self._next_stream += 1
                 self._items[key] = item
@@ -418,6 +456,11 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
         if duplicate:
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": f"request id {rid} is already active"}))
+            return
+        if shed:
+            self._shed_c[cls].inc()
+            conn.send(P.BUSY, rid, P.pack_json(
+                {"retry_after_ms": self.busy_retry_ms}))
             return
 
     def _cancel(self, conn: FrameConn, rid: int) -> None:
@@ -464,11 +507,17 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                 if self._stopping.is_set():
                     return None
                 self._cv.wait(timeout=0.25)
-            wave = []
-            while self._queue and len(wave) < self.max_batch:
-                item = self._queue.popleft()
-                if not item.cancelled:
-                    wave.append(item)
+            # the wave takes classes in priority order, FIFO within a
+            # class (stable sort): an interactive prompt admitted last
+            # still prefills ahead of every waiting batch prompt
+            order = {c: i for i, c in enumerate(P.QOS_CLASSES)}
+            live = [it for it in self._queue if not it.cancelled]
+            live.sort(key=lambda it: order.get(it.cls, len(order)))
+            wave = live[:self.max_batch]
+            taken = {id(it) for it in wave}
+            self._queue = deque(it for it in self._queue
+                                if not it.cancelled
+                                and id(it) not in taken)
             self._inflight = len(wave)
             self._qdepth_g.set(len(self._queue))
             return wave
@@ -630,7 +679,8 @@ class PrefillServer(WeightHost, PrefixHost, FrameServerBase):
                                             item.stream), np.uint32)
         ctx = item.span.context if item.span.recording else None
         meta = kvship.pack_kv_meta(item.rid, item.budget, length, key,
-                                   rng_off=item.rng_off, trace=ctx)
+                                   rng_off=item.rng_off, cls=item.cls,
+                                   trace=ctx)
         blob = kvship.pack_shipment(meta, dict(bufs, logits=logits))
         try:
             # sync: HANDOFF transfers the session's fate to the decode
@@ -711,7 +761,9 @@ class DecodeServer(WeightHost, FrameServerBase):
                  channel_advertise: int | None = None,
                  registry=None,
                  weights_version: str | None = None,
-                 weights_digest: str | None = None) -> None:
+                 weights_digest: str | None = None,
+                 class_floors: dict | None = None,
+                 latency_buckets=None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
 
@@ -730,9 +782,14 @@ class DecodeServer(WeightHost, FrameServerBase):
                 "templates do not ride the KV shipment)")
         self.batcher = batcher
         self._reg = registry or metrics_mod.get_default()
+        # no max_queue_depth here: the decode tier never sheds a
+        # landed package — the prefill work is already paid; overload
+        # is refused upstream where refusing is still free
         self.engine = ServeEngine(batcher, on_delta=self._on_delta,
                                   on_retired=self._on_retired,
-                                  registry=registry)
+                                  registry=registry,
+                                  class_floors=class_floors,
+                                  latency_buckets=latency_buckets)
         self.hub = ChannelHub(port=channel_port,
                               capacity=channel_capacity,
                               registry=self._reg)
@@ -936,7 +993,8 @@ class DecodeServer(WeightHost, FrameServerBase):
                      if "trace" in meta else None)
         try:
             self.engine.submit_prefilled(rid, pkg, meta["budget"],
-                                         trace_ctx=trace_ctx)
+                                         trace_ctx=trace_ctx,
+                                         request_class=meta["class"])
         except (ValueError, RuntimeError) as e:
             log.warning("decode tier: shipment for rid %s rejected: %s",
                         rid, e)

@@ -163,8 +163,10 @@ class FleetController:
             help="live replicas under fleet control")
         self._load_g = reg.gauge(
             "tony_fleet_load_per_replica",
-            help="mean reported load (queue depth + busy slots) per "
-                 "live replica — the scale-up signal")
+            help="mean SLO-relevant load per live replica (busy slots "
+                 "+ interactive/standard backlog; batch backlog "
+                 "excluded for class-aware replicas) — the scale-up "
+                 "signal")
         self._ups_c = reg.counter(
             "tony_fleet_scale_ups_total",
             help="scale-up actions taken (replicas added = actions x "
@@ -182,11 +184,27 @@ class FleetController:
     def _observe(self) -> tuple:
         """(live replica count, mean load per replica, utilization) —
         read from the router's STATS aggregation, the same numbers the
-        ``tony_router_replica_*`` gauges export."""
+        ``tony_router_replica_*`` gauges export.
+
+        Class-aware replicas report per-class ``queue_depths``; for
+        those the scale-up signal counts busy slots plus ONLY the
+        latency-sensitive backlog (interactive + standard). A deep
+        batch queue is deliberate oversubscription — it is what the
+        batch tier is FOR — and must never page in capacity on its
+        own. Classless replicas keep the aggregate ``reported_load``
+        fallback, so mixed fleets and old engines behave exactly as
+        before."""
         st = self.router.stats()
         reps = [r for r in st["replicas"].values() if r["up"]]
         n = len(reps)
-        load = (sum(r["reported_load"] for r in reps) / n) if n else 0.0
+        total = 0.0
+        for r in reps:
+            depths = r.get("queue_depths") or {}
+            # reported_load = waiting + busy slots, and waiting is the
+            # sum of the class depths — so subtracting the batch depth
+            # leaves busy slots + interactive/standard backlog
+            total += max(0, r["reported_load"] - depths.get("batch", 0))
+        load = (total / n) if n else 0.0
         slots = st.get("slots", 0)
         util = (st.get("active", 0) / slots) if slots else 1.0
         return n, load, util

@@ -41,7 +41,7 @@ import numpy as np
 from tony_tpu.serving import blobcodec
 from tony_tpu.serving.blobcodec import (MAX_HEADER_BYTES,  # noqa: F401
                                         _HLEN, np_dtype as _np_dtype)
-from tony_tpu.serving.protocol import ProtocolError
+from tony_tpu.serving.protocol import QOS_CLASSES, ProtocolError
 
 #: the ``kind`` tags distinguishing the three blob lanes sharing one
 #: wire shape (a template arriving on the kvship lane fails
@@ -74,14 +74,19 @@ def unpack_shipment(blob: bytes) -> tuple[dict, dict]:
 
 
 def pack_kv_meta(rid: int, budget: int, length: int, rng_key,
-                 rng_off: int = 0,
+                 rng_off: int = 0, cls: str = "standard",
                  trace: dict | None = None) -> dict:
     """The adoption-record meta for one prefilled row (see module
-    docstring); ``rng_key`` is the [2] uint32 per-request stream key."""
+    docstring); ``rng_key`` is the [2] uint32 per-request stream key.
+    ``cls`` is the request's QoS class — shipped only when non-default
+    (old wires unchanged) so the decode tier's class floors and
+    preemption apply to the adopted row."""
     k = np.asarray(rng_key, np.uint32).reshape(-1)
     meta = {"rid": int(rid), "budget": int(budget),
             "length": int(length),
             "rng": [int(k[0]), int(k[1])], "rng_off": int(rng_off)}
+    if cls != "standard":
+        meta["class"] = str(cls)
     if trace is not None:
         meta["trace"] = trace
     return meta
@@ -153,6 +158,10 @@ def parse_kv_meta(meta: dict) -> dict:
             or not all(isinstance(w, int) and not isinstance(w, bool)
                        and 0 <= w < (1 << 32) for w in rng)):
         raise ProtocolError(f"malformed shipment rng state: {rng!r}")
+    cls = meta.get("class", "standard")
+    if cls not in QOS_CLASSES:
+        raise ProtocolError(f"malformed shipment class: {cls!r}")
     out = dict(meta)
     out["rng"] = np.asarray(rng, np.uint32)
+    out["class"] = cls
     return out

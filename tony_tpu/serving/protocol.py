@@ -123,12 +123,27 @@ MIGRATE = 13
 #: ``{"ok": bool, ...}`` — op failures are request-scoped, never
 #: connection-scoped.
 WEIGHTS = 14
+#: s -> c (QoS-tiered serving): explicit overload shed — the server
+#: refuses to queue request ``rid`` and the client should retry after
+#: the JSON payload's ``retry_after_ms`` hint. Terminal for ``rid``
+#: (exactly one of TOKENS.../RETIRED, ERROR, or BUSY ends a request),
+#: and a statement about LOAD, not about the request: the identical
+#: ADMIT is expected to succeed once pressure clears, which is why it
+#: is a distinct frame rather than an ERROR. Only ``standard``/
+#: ``batch`` admissions are shed; ``interactive`` ones queue.
+BUSY = 15
 
 FRAME_NAMES = {ADMIT: "ADMIT", CANCEL: "CANCEL", POLL: "POLL",
                TOKENS: "TOKENS", RETIRED: "RETIRED", ERROR: "ERROR",
                STATS: "STATS", HELLO: "HELLO", HANDOFF: "HANDOFF",
                BIND: "BIND", PREFIX: "PREFIX", DRAIN: "DRAIN",
-               MIGRATE: "MIGRATE", WEIGHTS: "WEIGHTS"}
+               MIGRATE: "MIGRATE", WEIGHTS: "WEIGHTS", BUSY: "BUSY"}
+
+#: the serving plane's request classes, best SLO first: ``interactive``
+#: jumps queues and may preempt batch rows, ``standard`` is the classic
+#: FIFO tier (and what a class-less ADMIT means), ``batch`` yields to
+#: everyone and absorbs preemption/shedding under overload.
+QOS_CLASSES = ("interactive", "standard", "batch")
 
 #: sanity bound on one frame's body (type + rid + payload). A prompt of
 #: a million tokens is ~4 MB; anything past this is a corrupt length
@@ -386,6 +401,27 @@ def parse_rng(payload_or_obj) -> tuple[int, int] | None:
     except ProtocolError:
         pass
     return None
+
+
+def parse_class(payload_or_obj) -> str:
+    """Extract the OPTIONAL ``class`` field from an ADMIT payload:
+    ``{"class": "interactive"|"standard"|"batch"}`` names the request's
+    QoS tier. ABSENT means ``standard`` — an old class-less wire
+    behaves exactly as before — but unlike the other optional-field
+    helpers a PRESENT-but-invalid value raises ``ValueError``: a client
+    that asked for a class it misspelled must hear "no" (request-scoped
+    error), not silently serve at a different tier than it believes it
+    bought."""
+    obj = payload_or_obj if isinstance(payload_or_obj, dict) \
+        else unpack_json(payload_or_obj)
+    cls = obj.get("class")
+    if cls is None:
+        return "standard"
+    if cls not in QOS_CLASSES:
+        raise ValueError(
+            f"unknown request class {cls!r} (expected one of "
+            f"{', '.join(QOS_CLASSES)})")
+    return cls
 
 
 def parse_admit(payload: bytes) -> tuple[list[int], int, bool]:

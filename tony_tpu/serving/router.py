@@ -88,6 +88,12 @@ log = logging.getLogger(__name__)
 #: issue, and no worse than the per-replica counters it replaces.
 ROUTER_STREAM_BASE = 0
 
+#: how many replica BUSY sheds one batch session rides out via router
+#: re-queue before the shed propagates to the client. The cap exists to
+#: end the game when EVERY replica is shedding — by then the fleet is
+#: saying "come back later" and the client should hear it.
+BUSY_REQUEUE_CAP = 3
+
 
 class _ReplicaLink:
     """One persistent connection to a replica server, with a reader
@@ -156,6 +162,11 @@ class _ReplicaLink:
         #: decode slots with no live occupant per the last STATS — the
         #: equal-queue-depth placement tiebreak
         self.idle_slots = self.slots
+        #: per-class waiting counts from the replica's last STATS
+        #: (class-aware engines report ``queue_depths``); a classless
+        #: replica never populates it and everything falls back to the
+        #: aggregate load gauge
+        self.queue_depths: dict[str, int] = {}
         got_role = self.hello.get("role")
         if role != "engine" and got_role != role:
             self._sock.close()
@@ -203,6 +214,11 @@ class _ReplicaLink:
                 elif ftype == P.HANDOFF:
                     router._replica_handoff(self, rid,
                                             P.unpack_json(payload))
+                elif ftype == P.BUSY:
+                    obj = P.unpack_json(payload)
+                    router._replica_busy(
+                        self, rid,
+                        int(obj.get("retry_after_ms", 0) or 0))
                 elif ftype == P.STATS:
                     obj = P.unpack_json(payload)
                     self.reported_load = (int(obj.get("queue_depth", 0))
@@ -211,6 +227,13 @@ class _ReplicaLink:
                         self.slots = int(obj.get("slots", 0) or 0)
                     self.idle_slots = max(
                         0, self.slots - int(obj.get("active", 0)))
+                    got_d = obj.get("queue_depths")
+                    if isinstance(got_d, dict):
+                        self.queue_depths = {
+                            c: int(n) for c, n in got_d.items()
+                            if c in P.QOS_CLASSES
+                            and isinstance(n, int)
+                            and not isinstance(n, bool)}
                     if "weights_digest" in obj:
                         self.weights_digest = obj.get("weights_digest")
                     if "weights_version" in obj:
@@ -281,15 +304,31 @@ class _RouterSession:
     __slots__ = ("conn", "crid", "prompt", "budget", "streamed", "link",
                  "prefill_link", "handed_off", "rrid", "cancelled",
                  "trace_ctx", "prefix_id", "stream", "pinned_version",
-                 "migrating", "wlock")
+                 "migrating", "wlock", "cls", "t_submit", "t_last",
+                 "busy_retries")
 
     def __init__(self, conn: FrameConn, crid: int, prompt: list[int],
                  budget: int, trace_ctx: dict | None = None,
-                 prefix_id: str | None = None, stream: int = 0) -> None:
+                 prefix_id: str | None = None, stream: int = 0,
+                 cls: str = "standard") -> None:
         self.conn = conn
         self.crid = crid
         self.prompt = prompt
         self.budget = budget
+        #: the session's QoS class, forwarded on EVERY placement
+        #: (initial, failover, migration) so replica-side floors and
+        #: queue priority follow the session wherever it lands
+        self.cls = cls
+        #: admission wall-clock + last-delta wall-clock: the router's
+        #: own per-class TTFT/intertoken series (0.0 = no delta yet).
+        #: The router measures what the CLIENT experiences — replica
+        #: queueing, placement retries, and BUSY re-queues included —
+        #: which replica-side series by construction cannot see.
+        self.t_submit = time.monotonic()
+        self.t_last = 0.0
+        #: replica BUSY sheds this session already rode out (batch
+        #: re-queue is capped — past the cap the shed propagates)
+        self.busy_retries = 0
         #: the fleet-unique rng stream this session is pinned to — every
         #: placement (initial, failover, migration) forwards it with the
         #: already-streamed count as the offset, so SAMPLED
@@ -416,6 +455,38 @@ class ServingRouter(FrameServerBase):
             "tony_router_drains_total",
             help="replica drains completed (fence + migrate-all; "
                  "zero-session drains count too)")
+        self._busy_requeued_c = reg.counter(
+            "tony_router_busy_requeues_total",
+            help="batch sessions re-placed after a replica shed them "
+                 "with BUSY (the client never saw the shed)")
+        self._preempt_requeued_c = reg.counter(
+            "tony_router_preempt_requeues_total",
+            help="sessions re-placed after a decode-tier preemption "
+                 "eviction (the row could not fold back replica-side)")
+        # the router's own per-class latency series share the engine's
+        # names: in a shared-registry process the series are literally
+        # shared (get-or-create), and on a jax-free gateway host the
+        # router is the ONLY producer — the fleet dashboard reads one
+        # name either way
+        self._ttft_by_cls = {
+            c: reg.histogram(
+                "tony_serve_ttft_seconds",
+                help="time to first streamed token",
+                **{"class": c})
+            for c in P.QOS_CLASSES}
+        self._itl_by_cls = {
+            c: reg.histogram(
+                "tony_serve_intertoken_seconds",
+                help="gap between consecutive streamed tokens",
+                **{"class": c})
+            for c in P.QOS_CLASSES}
+        self._cls_depth_g = {
+            c: reg.gauge(
+                "tony_router_class_queue_depth",
+                help="fleet-wide waiting requests of the class (sum of "
+                     "the replicas' per-class STATS depths)",
+                **{"class": c})
+            for c in P.QOS_CLASSES}
         self._place_h = reg.histogram(
             "tony_router_place_seconds",
             help="wall time of one placement decision + forwarded "
@@ -614,7 +685,7 @@ class ServingRouter(FrameServerBase):
 
     def _pick_link(self, exclude=None, role: str | None = None,
                    prefer_prefix: str | None = None,
-                   prefer_version=None):
+                   prefer_version=None, cls: str = "standard"):
         """Least-loaded live, non-draining link of ``role``.
         ``exclude`` is one link or an iterable of links (a migration
         storm / multi-replica failure excludes a SET). Preference
@@ -624,7 +695,9 @@ class ServingRouter(FrameServerBase):
         ``prefer_prefix`` restricts to replicas advertising that prefix
         as RESIDENT when any exist (sessions go where the prefix KV
         already lives), falling back to the full pool on a cold
-        fleet."""
+        fleet. An ``interactive`` session further narrows to links with
+        an idle decode slot whenever any exist — the queue is exactly
+        what the class is paying to skip."""
         if exclude is None:
             ex = ()
         elif isinstance(exclude, _ReplicaLink):
@@ -648,6 +721,10 @@ class ServingRouter(FrameServerBase):
                             if prefer_prefix in l.prefixes]
                 if resident:
                     live = resident
+            if cls == "interactive":
+                idle = [l for l in live if l.idle_slots > 0]
+                if idle:
+                    live = idle
             return min(live, key=self._load_key)
 
     def _unassign_locked(self, sess: _RouterSession) -> None:
@@ -676,6 +753,17 @@ class ServingRouter(FrameServerBase):
 
     def _note_stats(self, link: _ReplicaLink) -> None:
         self._depth_g[link.addr].set(link.reported_load)
+        if link.queue_depths:
+            # fleet-wide per-class backlog: the autoscaler's signal
+            # (FleetController reads interactive pressure, never the
+            # batch backlog). list() copy — no lock on a reader thread.
+            totals = {c: 0 for c in P.QOS_CLASSES}
+            for l in list(self._links):
+                if l.alive:
+                    for c, n in l.queue_depths.items():
+                        totals[c] = totals.get(c, 0) + n
+            for c, g in self._cls_depth_g.items():
+                g.set(totals.get(c, 0))
 
     # -- client side (reader threads) ---------------------------------------
     def _hello_payload(self) -> dict:
@@ -801,6 +889,13 @@ class ServingRouter(FrameServerBase):
         prefix_id = P.parse_prefix_id(payload)
         if prefix_id is None and self._prefix_catalog:
             prefix_id = match_prefix(prompt, self._prefix_catalog)
+        try:
+            # absent = "standard" (old wires unchanged); an unknown
+            # class is a request-scoped error, not a silent downgrade
+            cls = P.parse_class(payload)
+        except ValueError as e:
+            conn.send(P.ERROR, rid, P.pack_json({"message": str(e)}))
+            return
         key = (conn.id, rid)
         # duplicate-rid reply goes out AFTER the lock is dropped: the
         # send can block on a slow client and this lock is the router's
@@ -811,7 +906,8 @@ class ServingRouter(FrameServerBase):
                 sess = _RouterSession(conn, rid, prompt, max_new,
                                       trace_ctx=P.parse_trace_ctx(payload),
                                       prefix_id=prefix_id,
-                                      stream=next(self._next_stream))
+                                      stream=next(self._next_stream),
+                                      cls=cls)
                 self._sessions[key] = sess
         if duplicate:
             conn.send(P.ERROR, rid, P.pack_json(
@@ -844,7 +940,8 @@ class ServingRouter(FrameServerBase):
                                     prefer_prefix=sess.prefix_id,
                                     prefer_version=sess.pinned_version)
             dlink = self._pick_link(exclude=exclude, role="decode",
-                                    prefer_version=sess.pinned_version)
+                                    prefer_version=sess.pinned_version,
+                                    cls=sess.cls)
             if plink is None or dlink is None:
                 return False
             admit_link, token_link = plink, dlink
@@ -852,7 +949,7 @@ class ServingRouter(FrameServerBase):
             plink = None
             admit_link = token_link = self._pick_link(
                 exclude=exclude, prefer_prefix=sess.prefix_id,
-                prefer_version=sess.pinned_version)
+                prefer_version=sess.pinned_version, cls=sess.cls)
             if admit_link is None:
                 return False
         if sess.prefix_id is not None:
@@ -912,6 +1009,10 @@ class ServingRouter(FrameServerBase):
                 # sequence on any replica sharing the fleet seed
                 "rng": {"stream": sess.stream,
                         "off": len(sess.streamed)}}
+        if sess.cls != "standard":
+            # old wires unchanged: the class field rides only when it
+            # says something non-default
+            body["class"] = sess.cls
         if sess.prefix_id is not None:
             # forwarded on failover re-placements too: the streamed
             # prefix folds in AFTER the shared prefix, so the re-placed
@@ -981,6 +1082,7 @@ class ServingRouter(FrameServerBase):
             stream = sess.stream
             pinned = sess.pinned_version
             budget = sess.budget
+            cls = sess.cls
         ex = set(exclude)
         ex.add(old_token)
         if self._disagg:
@@ -988,7 +1090,7 @@ class ServingRouter(FrameServerBase):
                                     prefer_prefix=prefix_id,
                                     prefer_version=pinned)
             dlink = self._pick_link(exclude=ex, role="decode",
-                                    prefer_version=pinned)
+                                    prefer_version=pinned, cls=cls)
             if plink is None or dlink is None:
                 return False
             admit_link, token_link = plink, dlink
@@ -996,7 +1098,7 @@ class ServingRouter(FrameServerBase):
             plink = None
             admit_link = token_link = self._pick_link(
                 exclude=ex, prefer_prefix=prefix_id,
-                prefer_version=pinned)
+                prefer_version=pinned, cls=cls)
             if admit_link is None:
                 return False
         new_rrid = next(self._next_rrid)
@@ -1034,6 +1136,8 @@ class ServingRouter(FrameServerBase):
                 "max_new_tokens": budget - snap_len,
                 "stream": True,
                 "rng": {"stream": stream, "off": snap_len}}
+        if cls != "standard":
+            body["class"] = cls
         if prefix_id is not None:
             body["prefix"] = prefix_id
         if plink is not None:
@@ -1239,6 +1343,17 @@ class ServingRouter(FrameServerBase):
             for l, r in cancels:
                 l.send(P.CANCEL, r)
             if send:
+                # the class's latency series, observed BEFORE the
+                # client send so a slow client socket never pollutes
+                # the serving-plane signal (wlock makes t_last safe)
+                now = time.monotonic()
+                if sess.t_last == 0.0:
+                    self._ttft_by_cls[sess.cls].observe(
+                        now - sess.t_submit)
+                else:
+                    self._itl_by_cls[sess.cls].observe(
+                        (now - sess.t_last) / len(send))
+                sess.t_last = now
                 sess.conn.send(P.TOKENS, sess.crid, P.pack_tokens(send))
         if completed:
             self._migrations_c.inc()
@@ -1246,6 +1361,7 @@ class ServingRouter(FrameServerBase):
     def _replica_retired(self, link: _ReplicaLink, rrid: int,
                          reason: str) -> None:
         tombstones = []
+        requeue = False
         with self._lock:
             sess = self._by_rrid.pop(rrid, None)
             if sess is None:
@@ -1276,27 +1392,58 @@ class ServingRouter(FrameServerBase):
             if not owns:
                 self._by_rrid[rrid] = sess
                 return
-            if reason == "stopped":
+            if reason == "preempted" and not sess.cancelled:
+                # the replica evicted this row to seat an interactive
+                # admission and could NOT fold it into its own queue (a
+                # KV-adopted decode row — the prompt lives with the
+                # router, not the replica): the ROUTER re-queues.
+                # Re-place like a failover — prompt + streamed prefix,
+                # rng pinned at the delivered count — so the stream
+                # resumes token-identically wherever a slot exists; the
+                # evicting replica stays eligible (a fresh placement
+                # enters its batch queue and waits its turn).
+                self._unassign_locked(sess)
+                if mig is not None and not mig.acked:
+                    self._by_rrid.pop(mig.new_rrid, None)
+                    for l in {mig.new_link, mig.new_prefill}:
+                        if l is not None:
+                            l.assigned -= 1
+                    tombstones = [(l, mig.new_rrid)
+                                  for l in {mig.new_link, mig.new_prefill}
+                                  if l is not None and l.alive]
+                sess.migrating = None
+                requeue = True
+            elif reason == "stopped":
                 # replica is draining/dying under us: keep the session,
                 # the link-down path re-places it with the prefix trim
                 self._by_rrid[rrid] = sess
                 return
-            self._sessions.pop((sess.conn.id, sess.crid), None)
-            self._unassign_locked(sess)
-            if mig is not None and not mig.acked:
-                # the OLD side finished the stream (eos/budget/cancel)
-                # before the migration ACKed: the takeover is moot —
-                # tombstone the pending second placement
-                self._by_rrid.pop(mig.new_rrid, None)
-                for l in {mig.new_link, mig.new_prefill}:
-                    if l is not None:
-                        l.assigned -= 1
-                tombstones = [(l, mig.new_rrid)
-                              for l in {mig.new_link, mig.new_prefill}
-                              if l is not None and l.alive]
-                sess.migrating = None
+            else:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+                self._unassign_locked(sess)
+                if mig is not None and not mig.acked:
+                    # the OLD side finished the stream (eos/budget/
+                    # cancel) before the migration ACKed: the takeover
+                    # is moot — tombstone the pending second placement
+                    self._by_rrid.pop(mig.new_rrid, None)
+                    for l in {mig.new_link, mig.new_prefill}:
+                        if l is not None:
+                            l.assigned -= 1
+                    tombstones = [(l, mig.new_rrid)
+                                  for l in {mig.new_link, mig.new_prefill}
+                                  if l is not None and l.alive]
+                    sess.migrating = None
         for l, r in tombstones:
             l.send(P.CANCEL, r)
+        if requeue:
+            self._preempt_requeued_c.inc()
+            if self._place(sess, exclude=None):
+                return
+            with self._lock:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+            sess.conn.send(P.ERROR, sess.crid, P.pack_json(
+                {"message": "no live replicas"}))
+            return
         sess.conn.send(P.RETIRED, sess.crid, P.pack_json(
             {"reason": reason, "tokens": len(sess.streamed)}))
 
@@ -1386,6 +1533,45 @@ class ServingRouter(FrameServerBase):
                 self._sessions.pop((sess.conn.id, sess.crid), None)
             msg = "no live replicas"
         sess.conn.send(P.ERROR, sess.crid, P.pack_json({"message": msg}))
+
+    def _replica_busy(self, link: _ReplicaLink, rrid: int,
+                      retry_after_ms: int) -> None:
+        """A replica shed this session's admission (its wait queue is
+        past the overload bound). BATCH sessions are the router's to
+        re-queue: re-place away from the shedding replica, capped at
+        :data:`BUSY_REQUEUE_CAP` sheds per session — when every replica
+        is saying "come back later", the client should hear it. For
+        every other class the shed PROPAGATES: BUSY is terminal for the
+        rid and the retry hint rides through untouched."""
+        with self._lock:
+            sess = self._by_rrid.pop(rrid, None)
+            if sess is None:
+                return
+            mig = sess.migrating
+            if mig is not None and not mig.acked and rrid == mig.new_rrid:
+                # the migration's second placement was shed: abandon it
+                # silently — the old half never stopped streaming; the
+                # drain loop just retries a less-loaded target
+                for l in {mig.new_link, mig.new_prefill}:
+                    if l is not None:
+                        l.assigned -= 1
+                sess.migrating = None
+                return
+            self._unassign_locked(sess)
+            sess.migrating = None
+            sess.busy_retries += 1
+            retry = (sess.cls == "batch" and not sess.cancelled
+                     and sess.busy_retries <= BUSY_REQUEUE_CAP)
+            if not retry:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+        if retry:
+            self._busy_requeued_c.inc()
+            if self._place(sess, exclude=link):
+                return
+            with self._lock:
+                self._sessions.pop((sess.conn.id, sess.crid), None)
+        sess.conn.send(P.BUSY, sess.crid, P.pack_json(
+            {"retry_after_ms": retry_after_ms}))
 
     def _replica_down(self, link: _ReplicaLink) -> None:
         """Replica loss: drain its sessions onto survivors, streamed
@@ -1544,11 +1730,17 @@ class ServingRouter(FrameServerBase):
                              for l in live
                              if not self._disagg or l.role == "decode"),
                 "sessions": len(self._sessions),
+                # fleet-aggregated per-class backlog (classless
+                # replicas contribute nothing — they never report it)
+                "queue_depths": {
+                    c: sum(l.queue_depths.get(c, 0) for l in live)
+                    for c in P.QOS_CLASSES},
                 "disaggregated": self._disagg,
                 "prefixes": sorted(self._prefix_catalog),
                 "replicas": {
                     l.addr: {"up": int(l.alive),
                              "reported_load": l.reported_load,
+                             "queue_depths": dict(l.queue_depths),
                              "assigned": l.assigned,
                              "role": l.role,
                              "draining": bool(l.draining),

@@ -239,7 +239,11 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
     def __init__(self, batcher, bind_host: str = "127.0.0.1",
                  port: int = 0, registry=None,
                  weights_version: str | None = None,
-                 weights_digest: str | None = None) -> None:
+                 weights_digest: str | None = None,
+                 class_floors: dict | None = None,
+                 max_queue_depth: int = 128,
+                 busy_retry_ms: int = 250,
+                 latency_buckets=None) -> None:
         super().__init__(bind_host, port)
         from tony_tpu.models.serve import ServeEngine
         self.batcher = batcher
@@ -256,7 +260,11 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
         self._sessions: dict[tuple[int, int], _Session] = {}
         self.engine = ServeEngine(batcher, on_delta=self._on_delta,
                                   on_retired=self._on_retired,
-                                  registry=registry)
+                                  registry=registry,
+                                  class_floors=class_floors,
+                                  max_queue_depth=max_queue_depth,
+                                  busy_retry_ms=busy_retry_ms,
+                                  latency_buckets=latency_buckets)
         self._engine_thread: threading.Thread | None = None
         reg = registry or metrics_mod.get_default()
         self._init_prefix_host(reg)
@@ -403,6 +411,15 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
         rng = P.parse_rng(payload)
         if rid == 0:
             raise P.ProtocolError("ADMIT rid must be nonzero")
+        try:
+            # absent = "standard" (old wires unchanged); an UNKNOWN
+            # class is a request-scoped error — the client asked for a
+            # tier that does not exist and must hear "no", not silently
+            # serve at a different one
+            cls = P.parse_class(payload)
+        except ValueError as e:
+            conn.send(P.ERROR, rid, P.pack_json({"message": str(e)}))
+            return
         key = (conn.id, rid)
         # the duplicate-rid reply is sent AFTER the lock is dropped: a
         # frame send can block on a slow client socket, and this lock
@@ -415,9 +432,22 @@ class ServingServer(WeightHost, PrefixHost, FrameServerBase):
             conn.send(P.ERROR, rid, P.pack_json(
                 {"message": f"request id {rid} is already active"}))
             return
+        # local import: models.serve pulls in jax, and this module must
+        # stay importable without it (router/simfleet/daemon only want
+        # FrameConn); by the time a request is admitted the engine --
+        # and therefore jax -- is already loaded
+        from tony_tpu.models.serve import EngineBusy
         try:
             self.engine.submit(key, prompt, max_new, trace_ctx=trace_ctx,
-                               prefix_id=prefix_id, rng=rng)
+                               prefix_id=prefix_id, rng=rng,
+                               request_class=cls)
+        except EngineBusy as e:
+            # the explicit shed: terminal for this rid, a statement
+            # about LOAD — the client re-admits after the hint
+            with self._lock:
+                self._sessions.pop(key, None)
+            conn.send(P.BUSY, rid, P.pack_json(
+                {"retry_after_ms": e.retry_after_ms}))
         except (ValueError, RuntimeError) as e:
             with self._lock:
                 self._sessions.pop(key, None)

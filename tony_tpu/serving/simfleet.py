@@ -31,6 +31,7 @@ from a bench trick to a first-class harness.
 
 from __future__ import annotations
 
+import collections
 import threading
 import time
 
@@ -51,10 +52,11 @@ def sim_token(seed: int, pos: int) -> int:
 
 class _SimSession:
     __slots__ = ("conn", "rid", "seed", "off", "emitted", "max_new",
-                 "ready_at")
+                 "ready_at", "cls")
 
     def __init__(self, conn: FrameConn, rid: int, seed: int, off: int,
-                 max_new: int, ready_at: float) -> None:
+                 max_new: int, ready_at: float,
+                 cls: str = "standard") -> None:
         self.conn = conn
         self.rid = rid
         self.seed = seed
@@ -62,6 +64,7 @@ class _SimSession:
         self.emitted = 0                    # delivered by PRIOR placements
         self.max_new = max_new
         self.ready_at = ready_at
+        self.cls = cls
 
 
 class SimReplica(FrameServerBase):
@@ -73,15 +76,26 @@ class SimReplica(FrameServerBase):
 
     def __init__(self, bind_host: str = "127.0.0.1", port: int = 0,
                  itl_s: float = 0.002, ttft_s: float = 0.0,
-                 slots: int = 16, weights_version: str | None = None)\
-            -> None:
+                 slots: int = 16, weights_version: str | None = None,
+                 max_queue_depth: int = 128,
+                 busy_retry_ms: int = 50) -> None:
         super().__init__(bind_host, port)
         self.itl_s = itl_s
         self.ttft_s = ttft_s
         self.slots = slots
         self.weights_version = weights_version
+        # overload discipline mirrors the real engine: admissions past
+        # ``slots`` wait in per-class queues, interactive waiters may
+        # preempt a decoding batch row (demoted back to its queue, oracle
+        # positions intact), and non-interactive admissions past
+        # ``max_queue_depth`` waiting sessions are shed with BUSY
+        self.max_queue_depth = max_queue_depth
+        self.busy_retry_ms = busy_retry_ms
         self._slock = threading.Lock()
         self._sessions: dict = {}           # (conn.id, rid) -> _SimSession
+        # waiting (not-yet-decoding) sessions per class, FIFO within one
+        self._waitq: dict = {c: collections.deque() for c in P.QOS_CLASSES}
+        self.preemptions = 0                # batch rows evicted-to-queue
         self._pump_thread: threading.Thread | None = None
         self.addr = ""
 
@@ -102,7 +116,9 @@ class SimReplica(FrameServerBase):
     def _stats_payload(self) -> dict:
         with self._slock:
             active = len(self._sessions)
-        return {"queue_depth": 0, "active": active, "slots": self.slots,
+            depths = {c: len(q) for c, q in self._waitq.items()}
+        return {"queue_depth": sum(depths.values()), "active": active,
+                "slots": self.slots, "queue_depths": depths,
                 "weights_version": self.weights_version}
 
     def _handle_frame(self, conn: FrameConn, ftype: int, rid: int,
@@ -113,19 +129,43 @@ class SimReplica(FrameServerBase):
                 conn.send(P.ERROR, rid, P.pack_json(
                     {"message": "sim replica: bad ADMIT"}))
                 return
+            try:
+                cls = P.parse_class(P.unpack_json(payload))
+            except ValueError as e:
+                conn.send(P.ERROR, rid, P.pack_json({"message": str(e)}))
+                return
             rng = P.parse_rng(payload)
             off = rng[1] if rng is not None else 0
             # the oracle seed is the ORIGINAL prompt's first token:
             # folded-in streamed prefixes append, so it survives every
             # re-placement of the session
             sess = _SimSession(conn, rid, seed=prompt[0], off=off,
-                               max_new=max_new,
-                               ready_at=time.monotonic() + self.ttft_s)
+                               max_new=max_new, ready_at=0.0, cls=cls)
+            shed = False
             with self._slock:
-                self._sessions[(conn.id, rid)] = sess
+                waiting = sum(len(q) for q in self._waitq.values())
+                if (self.max_queue_depth and cls != "interactive"
+                        and waiting >= self.max_queue_depth):
+                    shed = True
+                else:
+                    # all admissions queue; the pump grants slots in
+                    # class order, so ready_at is stamped at grant time
+                    self._waitq[cls].append(((conn.id, rid), sess))
+            if shed:
+                conn.send(P.BUSY, rid, P.pack_json(
+                    {"retry_after_ms": self.busy_retry_ms}))
         elif ftype == P.CANCEL:
             with self._slock:
                 sess = self._sessions.pop((conn.id, rid), None)
+                if sess is None:
+                    for q in self._waitq.values():
+                        for i, (key, s) in enumerate(q):
+                            if key == (conn.id, rid):
+                                sess = s
+                                del q[i]
+                                break
+                        if sess is not None:
+                            break
             if sess is not None:
                 conn.send(P.RETIRED, rid, P.pack_json(
                     {"reason": "cancelled", "tokens": sess.emitted}))
@@ -140,12 +180,46 @@ class SimReplica(FrameServerBase):
         with self._slock:
             for key in [k for k in self._sessions if k[0] == conn.id]:
                 self._sessions.pop(key, None)
+            for q in self._waitq.values():
+                kept = [(k, s) for (k, s) in q if k[0] != conn.id]
+                q.clear()
+                q.extend(kept)
 
     # -- the simulated engine ------------------------------------------------
+    def _grant_locked(self, now: float) -> None:
+        """Fill free decode slots from the wait queues in class-priority
+        order; if interactive work still waits once every slot is held,
+        evict the least-advanced decoding batch row back to the FRONT of
+        its queue (emitted count intact — on re-grant the stream resumes
+        at ``sim_token(seed, off + emitted)``: zero dup/drop by
+        construction, exactly the engine's evict-to-queue semantics)."""
+        for cls in P.QOS_CLASSES:
+            q = self._waitq[cls]
+            while q and len(self._sessions) < self.slots:
+                key, sess = q.popleft()
+                # prefill floor is paid at grant time (and paid AGAIN on
+                # re-grant after a preemption, like a real re-prefill)
+                sess.ready_at = now + self.ttft_s
+                self._sessions[key] = sess
+        iq = self._waitq["interactive"]
+        while iq:
+            batch = [(k, s) for k, s in self._sessions.items()
+                     if s.cls == "batch"]
+            if not batch:
+                break
+            key, victim = min(batch, key=lambda kv: kv[1].emitted)
+            self._sessions.pop(key)
+            self._waitq["batch"].appendleft((key, victim))
+            self.preemptions += 1
+            nk, ns = iq.popleft()
+            ns.ready_at = now + self.ttft_s
+            self._sessions[nk] = ns
+
     def _pump_loop(self) -> None:
         while not self._stopping.wait(self.itl_s):
             now = time.monotonic()
             with self._slock:
+                self._grant_locked(now)
                 items = list(self._sessions.items())
             for key, s in items:
                 if now < s.ready_at:
@@ -193,7 +267,9 @@ class SimFleet:
                  ttft_s: float = 0.0, slots: int = 16,
                  weights_version: str | None = None,
                  health_interval_s: float = 0.1,
-                 max_missed_pings: int = 3, registry=None) -> None:
+                 max_missed_pings: int = 3, registry=None,
+                 max_queue_depth: int = 128,
+                 busy_retry_ms: int = 50) -> None:
         self.n = n
         self.itl_s = itl_s
         self.ttft_s = ttft_s
@@ -202,6 +278,8 @@ class SimFleet:
         self.health_interval_s = health_interval_s
         self.max_missed_pings = max_missed_pings
         self.registry = registry
+        self.max_queue_depth = max_queue_depth
+        self.busy_retry_ms = busy_retry_ms
         self.replicas: dict = {}            # addr -> SimReplica
         self.router = None
 
@@ -223,7 +301,9 @@ class SimFleet:
             ttft_s=self.ttft_s, slots=self.slots,
             weights_version=(self.weights_version
                              if weights_version is None
-                             else weights_version))
+                             else weights_version),
+            max_queue_depth=self.max_queue_depth,
+            busy_retry_ms=self.busy_retry_ms)
         rep.start()
         self.replicas[rep.addr] = rep
         return rep.addr
@@ -329,3 +409,70 @@ class SimProvider:
     def release(self, addrs) -> None:
         for addr in addrs:
             self.fleet.reap(addr)
+
+
+def open_loop_load(port: int, classes, *, interval_s: float = 0.0,
+                   max_new: int = 8, prompt_len: int = 4,
+                   retries: int = 0, seed_base: int = 1000,
+                   host: str = "127.0.0.1",
+                   event_timeout: float = 30.0) -> list:
+    """Open-loop multi-class load generator: one submission every
+    ``interval_s`` seconds REGARDLESS of completions (overload does not
+    self-throttle — that is the point of open-loop), one request per
+    entry of ``classes`` (a class name, or ``""``/``None`` for a
+    classless ADMIT). Each request drains on its own thread and yields
+    a record::
+
+        {"cls", "ttft_s", "tokens", "shed", "retry_after_ms",
+         "error", "ok"}
+
+    where ``ok`` means the stream passed the oracle token-identity
+    check — exactly ``max_new`` tokens equal to
+    ``sim_token(seed_base + i, pos)`` for every position, across every
+    preemption/requeue/failover the request survived. ``ttft_s`` counts
+    from submit, so queueing and shedding delay show up in it."""
+    from tony_tpu.serving.client import (ServerBusy,
+                                         ServingConnectionError,
+                                         StreamingClient)
+
+    records = [{"cls": c or "standard", "ttft_s": None, "tokens": [],
+                "shed": False, "retry_after_ms": 0, "error": None,
+                "ok": False} for c in classes]
+
+    with StreamingClient(host, port) as client:
+        def drain(i: int, rid: int, t_submit: float) -> None:
+            rec = records[i]
+            try:
+                for delta in client.deltas(rid, timeout=event_timeout):
+                    if rec["ttft_s"] is None:
+                        rec["ttft_s"] = time.monotonic() - t_submit
+                    rec["tokens"].extend(delta)
+            except ServerBusy as e:
+                rec["shed"] = True
+                rec["retry_after_ms"] = e.retry_after_ms
+                return
+            except ServingConnectionError as e:
+                rec["error"] = str(e)
+                return
+            seed = seed_base + i
+            want = [sim_token(seed, p) for p in range(max_new)]
+            rec["ok"] = rec["tokens"] == want
+
+        threads = []
+        t0 = time.monotonic()
+        for i, cls in enumerate(classes):
+            wait = t0 + i * interval_s - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            prompt = [seed_base + i] * prompt_len
+            rid = client.submit(prompt, max_new,
+                                request_class=cls or None,
+                                retries=retries)
+            th = threading.Thread(
+                target=drain, name=f"tony-sim-load-{i}",
+                args=(i, rid, time.monotonic()), daemon=True)
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join(timeout=event_timeout + 5.0)
+    return records
